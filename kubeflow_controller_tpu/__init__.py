@@ -1,0 +1,27 @@
+"""kubeflow_controller_tpu — a TPU-native job orchestration framework.
+
+A brand-new implementation of the capabilities of ``caicloud/kubeflow-controller``
+(the 2018 ``TFJob`` Kubernetes controller, reference at /root/reference): a
+declarative job resource with PS / Worker / Local — and, new here, **TPU slice** —
+replica types, a level-triggered reconcile engine, per-replica cluster-spec
+generation, and status rollup.  The workload layer is JAX/XLA-native
+(``models/``, ``ops/``, ``parallel/``, ``workloads/``).
+
+Layer map (mirrors SURVEY.md §1):
+
+- ``api/``        — the TFJob resource schema (ref: vendor/.../apis/kubeflow/v1alpha1/types.go)
+- ``cluster/``    — in-memory API server + fake kubelet + TPU inventory (test substrate)
+- ``controller/`` — reconcile engine: workqueue, informers, expectations, sync loop
+                    (ref: pkg/controller/controller.go)
+- ``planner/``    — the desired-state diff engine (ref: pkg/tensorflow/)
+- ``updater/``    — status rollup (ref: pkg/controller/updater/)
+- ``checker/``    — job classification + health (ref: pkg/checker/)
+- ``models/``     — JAX/Flax model zoo (MNIST, ResNet-CIFAR, Llama-style transformer)
+- ``ops/``        — Pallas TPU kernels with XLA fallbacks
+- ``parallel/``   — mesh / sharding / collectives library (dp, fsdp, tp, sp, ring attention)
+- ``workloads/``  — runnable training entrypoints the controller launches in pods
+- ``cli/``        — process shell (ref: cmd/controller/main.go)
+"""
+
+__version__ = "0.1.0"
+GIT_SHA = "dev"

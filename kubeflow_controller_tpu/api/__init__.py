@@ -1,0 +1,46 @@
+"""The TFJob API schema and the core (pod/service) object model.
+
+Re-expresses the contract at
+vendor/github.com/caicloud/kubeflow-clientset/apis/kubeflow/v1alpha1/types.go
+as Python dataclasses, extended with a first-class TPU replica type
+(BASELINE.json north star).
+"""
+
+from .meta import ObjectMeta, OwnerReference, matches_selector  # noqa: F401
+from .core import (  # noqa: F401
+    Container,
+    EnvVar,
+    Pod,
+    PodSpec,
+    PodStatus,
+    PodTemplateSpec,
+    ResourceRequirements,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    PHASE_PENDING,
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+    PHASE_FAILED,
+    PHASE_UNKNOWN,
+)
+from .tfjob import (  # noqa: F401
+    GROUP,
+    VERSION,
+    KIND,
+    API_VERSION,
+    ChiefSpec,
+    ReplicaType,
+    TerminationPolicySpec,
+    TFJob,
+    TFJobCondition,
+    TFJobConditionType,
+    TFJobPhase,
+    TFJobSpec,
+    TFJobStatus,
+    TFReplicaSpec,
+    TFReplicaState,
+    TFReplicaStatus,
+    TPUSpec,
+    validate_tfjob,
+)

@@ -1,0 +1,151 @@
+"""Core object model: the subset of Pod/Service the controller materializes.
+
+The reference consumes k8s core/v1 wholesale through vendoring; this framework
+models exactly the surface the orchestration path touches — containers with
+command/args/env/resources/ports, pod phase, restart policy, node selector,
+and ClusterIP services with label selectors (ref: pkg/tensorflow/
+distributed.go:120-191 materializes pods and services from these fields).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .meta import ObjectMeta
+
+# Pod phases (ref: v1.PodPending/Running/Succeeded/Failed/Unknown, counted at
+# pkg/controller/util.go:26-30 and histogrammed at pkg/controller/updater/util.go:39-50).
+PHASE_PENDING = "Pending"
+PHASE_RUNNING = "Running"
+PHASE_SUCCEEDED = "Succeeded"
+PHASE_FAILED = "Failed"
+PHASE_UNKNOWN = "Unknown"
+
+# TPU resource name — the north star mandates google.com/tpu and *never*
+# nvidia.com/gpu in any generated PodSpec (BASELINE.json).
+RESOURCE_TPU = "google.com/tpu"
+
+
+@dataclass
+class EnvVar:
+    name: str = ""
+    value: str = ""
+
+
+@dataclass
+class ContainerPort:
+    name: str = ""
+    container_port: int = 0
+
+
+@dataclass
+class ResourceRequirements:
+    limits: Dict[str, str] = field(default_factory=dict)
+    requests: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+    ports: List[ContainerPort] = field(default_factory=list)
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    working_dir: str = ""
+
+    def set_env(self, name: str, value: str) -> None:
+        """Idempotent env upsert (materializers inject cluster wiring here)."""
+        for e in self.env:
+            if e.name == name:
+                e.value = value
+                return
+        self.env.append(EnvVar(name=name, value=value))
+
+
+@dataclass
+class PodSpec:
+    containers: List[Container] = field(default_factory=list)
+    restart_policy: str = "Always"
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    # Gang-scheduling group: all pods of one TPU slice share this (net-new
+    # capability vs the reference; see planner/tpu.py).
+    scheduling_gang: str = ""
+    hostname: str = ""
+    subdomain: str = ""
+
+
+@dataclass
+class PodStatus:
+    phase: str = PHASE_PENDING
+    reason: str = ""
+    message: str = ""
+    pod_ip: str = ""
+    host_ip: str = ""
+
+
+@dataclass
+class Pod:
+    api_version: str = "v1"
+    kind: str = "Pod"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+
+@dataclass
+class PodTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+@dataclass
+class ServicePort:
+    name: str = ""
+    port: int = 0
+    target_port: int = 0
+
+
+@dataclass
+class ServiceSpec:
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: List[ServicePort] = field(default_factory=list)
+    cluster_ip: str = ""
+
+
+@dataclass
+class ServiceStatus:
+    pass
+
+
+@dataclass
+class Service:
+    api_version: str = "v1"
+    kind: str = "Service"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+    status: ServiceStatus = field(default_factory=ServiceStatus)
+
+
+def is_pod_active(pod: Pod) -> bool:
+    """active = not Succeeded, not Failed, not being deleted
+    (ref: IsPodActive at vendor/.../controller_utils.go:832-840)."""
+    return (
+        pod.status.phase not in (PHASE_SUCCEEDED, PHASE_FAILED)
+        and pod.metadata.deletion_timestamp is None
+    )
+
+
+def filter_active_pods(pods: List[Pod]) -> List[Pod]:
+    """ref: FilterActivePods at vendor/.../controller_utils.go:817-830,
+    used at pkg/controller/controller.go:322-325."""
+    return [p for p in pods if is_pod_active(p)]
+
+
+def get_status(pods: List[Pod]) -> tuple[int, int]:
+    """(succeeded, failed) counts (ref: getStatus at pkg/controller/util.go:26-30)."""
+    succeeded = sum(1 for p in pods if p.status.phase == PHASE_SUCCEEDED)
+    failed = sum(1 for p in pods if p.status.phase == PHASE_FAILED)
+    return succeeded, failed

@@ -1,0 +1,100 @@
+"""Object metadata: the minimal apimachinery surface the controller depends on.
+
+Covers ObjectMeta (name/generateName/namespace/uid/resourceVersion/labels/
+ownerReferences/deletionTimestamp/finalizers), OwnerReference with the
+controller+blockOwnerDeletion bits (ref: pkg/controller/util.go:43-54 sets
+both to true), and label-selector matching (the controller selects replicas
+by an exact-match label set, ref: pkg/controller/helper.go:118-125).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class OwnerReference:
+    """ref: newControllerRef at pkg/controller/util.go:43-54."""
+
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: Optional[bool] = None
+    block_owner_deletion: Optional[bool] = None
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    generate_name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    resource_version: str = ""
+    creation_timestamp: Optional[float] = None
+    deletion_timestamp: Optional[float] = None
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    owner_references: List[OwnerReference] = field(default_factory=list)
+    finalizers: List[str] = field(default_factory=list)
+
+
+def get_controller_of(meta: ObjectMeta) -> Optional[OwnerReference]:
+    """The owner reference with controller=true, if any
+    (ref: metav1.GetControllerOf, used at pkg/controller/controller.go:459)."""
+    for ref in meta.owner_references:
+        if ref.controller:
+            return ref
+    return None
+
+
+def matches_selector(labels: Dict[str, str], selector: Dict[str, str]) -> bool:
+    """Exact-match label selector (the only kind the controller uses,
+    ref: pkg/controller/helper.go:118-125 builds a 4-label equality selector)."""
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def set_controller_ref(meta: ObjectMeta, owner_meta: ObjectMeta, api_version: str, kind: str) -> None:
+    """Append a controller ownerRef (controller=true, blockOwnerDeletion=true)."""
+    meta.owner_references.append(
+        OwnerReference(
+            api_version=api_version,
+            kind=kind,
+            name=owner_meta.name,
+            uid=owner_meta.uid,
+            controller=True,
+            block_owner_deletion=True,
+        )
+    )
+
+
+def validate_controller_ref(ref: Optional[OwnerReference]) -> None:
+    """ref: pkg/controller/control/util.go:25-42 — creation through the
+    control layer requires a controllerRef with Controller and
+    BlockOwnerDeletion both true."""
+    if ref is None:
+        raise ValueError("controllerRef is required")
+    if not ref.uid:
+        raise ValueError("controllerRef must have a non-empty UID")
+    if not ref.controller:
+        raise ValueError("controllerRef must have Controller=true")
+    if not ref.block_owner_deletion:
+        raise ValueError("controllerRef must have BlockOwnerDeletion=true")
+
+
+def key_of(meta: ObjectMeta) -> str:
+    """``namespace/name`` cache key (ref: cache.KeyFunc semantics used at
+    pkg/controller/controller.go:632-640)."""
+    if meta.namespace:
+        return f"{meta.namespace}/{meta.name}"
+    return meta.name
+
+
+def split_key(key: str) -> tuple[str, str]:
+    """Inverse of :func:`key_of` (ref: SplitMetaNamespaceKey at
+    controller.go:266)."""
+    if "/" in key:
+        ns, name = key.split("/", 1)
+        return ns, name
+    return "", key

@@ -1,0 +1,316 @@
+"""The TFJob resource: spec, status, phases, conditions, replica types.
+
+Re-expresses vendor/github.com/caicloud/kubeflow-clientset/apis/kubeflow/
+v1alpha1/types.go with the declared-but-dead surface brought to life and a
+first-class TPU replica type:
+
+- phases (types.go:106-133) — including ``Failed``, which the reference
+  declares but never sets; our updater sets it.
+- conditions (types.go:154-161) — Scheduled/Ready/Recovering/Recycling were
+  declared and never used; our updater populates them.
+- ``TFReplicaStatus.State`` and ``PodNames`` (types.go:163-171) — never
+  populated upstream; populated here.
+- ``TerminationPolicySpec.Chief`` (types.go:81-89) — unimplemented upstream
+  (termination hardcoded to "all workers succeeded" at
+  pkg/controller/updater/distributed.go:51-55); honored here.
+- ``TPUSpec`` — net-new (BASELINE.json north star): slice topology for
+  gang-created multi-host JAX jobs wired via ``jax.distributed``.
+"""
+
+from __future__ import annotations
+
+import enum
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .core import PodTemplateSpec, RESOURCE_TPU
+from .meta import ObjectMeta
+
+GROUP = "kubeflow.caicloud.io"
+VERSION = "v1alpha1"
+KIND = "TFJob"
+API_VERSION = f"{GROUP}/{VERSION}"
+
+# Resource plural used by clients/URLs (ref: examples/crd/crd.yml:8-12).
+PLURAL = "tfjobs"
+
+
+class ReplicaType(str, enum.Enum):
+    """ref: types.go:66-74 (PS/Worker/Local) + net-new TPU."""
+
+    PS = "PS"
+    WORKER = "Worker"
+    LOCAL = "Local"
+    TPU = "TPU"
+
+
+class TFJobPhase(str, enum.Enum):
+    """ref: types.go:106-133."""
+
+    NONE = "None"
+    UNKNOWN = "Unknown"
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+class TFJobConditionType(str, enum.Enum):
+    """ref: types.go:154-161 — declared upstream, populated by our updater."""
+
+    SCHEDULED = "Scheduled"
+    READY = "Ready"
+    RECOVERING = "Recovering"
+    RECYCLING = "Recycling"
+
+
+class TFReplicaState(str, enum.Enum):
+    """ref: types.go:175-181."""
+
+    UNKNOWN = "Unknown"
+    WAITING = "Waiting"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclass
+class ChiefSpec:
+    """ref: types.go:85-89 — names the replica whose success terminates the job."""
+
+    tf_replica_name: str = ""
+    tf_replica_index: int = 0
+
+
+@dataclass
+class TerminationPolicySpec:
+    """ref: types.go:81-83."""
+
+    chief: Optional[ChiefSpec] = None
+
+
+@dataclass
+class TPUSpec:
+    """Net-new: TPU slice topology carried by a TPU replica.
+
+    The controller owes the workload enough topology for
+    ``jax.distributed.initialize`` + mesh construction (SURVEY.md §2.4):
+    accelerator type (e.g. ``v5e-8``, ``v5p-32``), number of worker hosts in
+    the slice, chips per host, and the physical topology string XLA expects.
+    """
+
+    accelerator_type: str = "v5e-8"
+    # Hosts in the slice; 0 means "derive from accelerator_type".
+    num_hosts: int = 0
+    chips_per_host: int = 4
+    topology: str = ""
+    # Coordinator port for jax.distributed (the analog of the reference's
+    # hardcoded TF grpc port 2222, pkg/tensorflow/distributed.go:31-32).
+    coordinator_port: int = 8476
+
+
+# chips per slice for known accelerator types: "<family>-<chips>".
+_ACCEL_RE = re.compile(r"^v(\d+)(p|e|lite)?-(\d+)$")
+
+
+def tpu_slice_hosts(spec: TPUSpec) -> int:
+    """Number of worker hosts (processes) in the slice.
+
+    Derived from accelerator type when not given explicitly: chips come from
+    the suffix (``v5e-8`` -> 8 chips) and hosts = ceil(chips / chips_per_host).
+    """
+    if spec.num_hosts > 0:
+        return spec.num_hosts
+    m = _ACCEL_RE.match(spec.accelerator_type)
+    if not m:
+        return 1
+    chips = int(m.group(3))
+    cph = spec.chips_per_host or 4
+    return max(1, -(-chips // cph))
+
+
+def tpu_slice_chips(spec: TPUSpec) -> int:
+    m = _ACCEL_RE.match(spec.accelerator_type)
+    if m:
+        return int(m.group(3))
+    return tpu_slice_hosts(spec) * (spec.chips_per_host or 4)
+
+
+def validate_tpu_spec(spec: TPUSpec) -> None:
+    """Reject topologies where hosts x chips/host contradicts the slice size."""
+    if spec.coordinator_port <= 0 or spec.coordinator_port > 65535:
+        raise ValidationError(f"invalid coordinatorPort {spec.coordinator_port}")
+    if spec.num_hosts < 0 or spec.chips_per_host <= 0:
+        raise ValidationError("numHosts must be >= 0 and chipsPerHost > 0")
+    m = _ACCEL_RE.match(spec.accelerator_type)
+    if m and spec.num_hosts > 0:
+        chips = int(m.group(3))
+        if spec.num_hosts * spec.chips_per_host != chips:
+            raise ValidationError(
+                f"inconsistent TPU topology: {spec.accelerator_type} has {chips} chips "
+                f"but numHosts({spec.num_hosts}) x chipsPerHost({spec.chips_per_host}) "
+                f"= {spec.num_hosts * spec.chips_per_host}"
+            )
+
+
+@dataclass
+class TFReplicaSpec:
+    """ref: types.go:58-79."""
+
+    replicas: int = 1
+    tf_replica_type: ReplicaType = ReplicaType.WORKER
+    template: Optional[PodTemplateSpec] = None
+    termination_policy: Optional[TerminationPolicySpec] = None
+    # Net-new: present iff tf_replica_type == TPU.
+    tpu: Optional[TPUSpec] = None
+
+
+@dataclass
+class TFJobSpec:
+    """ref: types.go:41-55.
+
+    The four ``*_dir`` fields were declared and never read upstream; our
+    materializers plumb them into replica env (MODEL_DIR -> Orbax checkpoint
+    dir, etc. — SURVEY.md §5 checkpoint/resume)."""
+
+    runtime_id: str = ""
+    data_dir: str = ""
+    model_dir: str = ""
+    log_dir: str = ""
+    export_dir: str = ""
+    tf_replica_specs: List[TFReplicaSpec] = field(default_factory=list)
+
+
+@dataclass
+class TFJobCondition:
+    """ref: types.go:136-152."""
+
+    type: TFJobConditionType = TFJobConditionType.SCHEDULED
+    status: str = "Unknown"  # True / False / Unknown
+    reason: str = ""
+    message: str = ""
+    last_transition_time: Optional[float] = None
+
+
+@dataclass
+class TFReplicaStatus:
+    """ref: types.go:163-171 — ``state`` and ``pod_names`` populated here
+    (never upstream)."""
+
+    type: ReplicaType = ReplicaType.WORKER
+    state: TFReplicaState = TFReplicaState.UNKNOWN
+    pod_names: List[str] = field(default_factory=list)
+    tf_replicas_states: Dict[TFReplicaState, int] = field(default_factory=dict)
+
+
+@dataclass
+class TFJobStatus:
+    """ref: types.go:92-101."""
+
+    phase: TFJobPhase = TFJobPhase.NONE
+    reason: str = ""
+    conditions: List[TFJobCondition] = field(default_factory=list)
+    tf_replica_statuses: List[TFReplicaStatus] = field(default_factory=list)
+
+
+@dataclass
+class TFJob:
+    """ref: types.go:30-38."""
+
+    api_version: str = API_VERSION
+    kind: str = KIND
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TFJobSpec = field(default_factory=TFJobSpec)
+    status: TFJobStatus = field(default_factory=TFJobStatus)
+
+
+# ---------------------------------------------------------------------------
+# Validation — net-new (the reference performs no spec validation at all;
+# e.g. getTemplateIndex silently assumes exactly two replica specs,
+# pkg/tensorflow/distributed.go:201-209).
+# ---------------------------------------------------------------------------
+
+_DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def validate_tfjob(job: TFJob) -> None:
+    """Reject structurally invalid jobs before they reach the planner."""
+    name = job.metadata.name or job.metadata.generate_name
+    if not name:
+        raise ValidationError("metadata.name is required")
+    if job.metadata.name and not _DNS1123.match(job.metadata.name):
+        raise ValidationError(f"metadata.name {job.metadata.name!r} is not DNS-1123")
+    # generateName prefixes may legitimately end with '-'; validate the prefix
+    # so generated names (prefix + alnum suffix) are DNS-1123 too.
+    gn = job.metadata.generate_name
+    if gn and not re.match(r"^[a-z0-9]([-a-z0-9]*)?$", gn):
+        raise ValidationError(f"metadata.generateName {gn!r} is not a DNS-1123 prefix")
+    specs = job.spec.tf_replica_specs
+    if not specs:
+        raise ValidationError("spec.tfReplicaSpecs must be non-empty")
+    types_seen = [s.tf_replica_type for s in specs]
+    if len(set(types_seen)) != len(types_seen):
+        raise ValidationError("duplicate tfReplicaType in spec.tfReplicaSpecs")
+    for s in specs:
+        if s.replicas < 0:
+            raise ValidationError("replicas must be >= 0")
+        if s.template is None:
+            raise ValidationError(f"{s.tf_replica_type.value}: template is required")
+        if not s.template.spec.containers:
+            raise ValidationError(f"{s.tf_replica_type.value}: template needs >= 1 container")
+        if s.tf_replica_type == ReplicaType.LOCAL:
+            if len(specs) != 1:
+                raise ValidationError("Local jobs must have exactly one replica spec")
+            if s.replicas != 1:
+                raise ValidationError("Local jobs must have replicas == 1")
+        if s.tf_replica_type == ReplicaType.TPU:
+            if s.tpu is None:
+                raise ValidationError("TPU replica spec requires .tpu topology")
+            validate_tpu_spec(s.tpu)
+            for c in s.template.spec.containers:
+                if "nvidia.com/gpu" in c.resources.limits or "nvidia.com/gpu" in c.resources.requests:
+                    raise ValidationError("TPU replicas must not request nvidia.com/gpu")
+    if any(t == ReplicaType.LOCAL for t in types_seen) and len(types_seen) > 1:
+        raise ValidationError("Local replica type cannot be mixed with others")
+    # Chief termination policy must name an existing replica type/index.
+    for s in specs:
+        tp = s.termination_policy
+        if tp and tp.chief:
+            target = next((x for x in specs if x.tf_replica_type.value == tp.chief.tf_replica_name), None)
+            if target is None:
+                raise ValidationError(
+                    f"terminationPolicy.chief names unknown replica {tp.chief.tf_replica_name!r}"
+                )
+            if not (0 <= tp.chief.tf_replica_index < target.replicas):
+                raise ValidationError(
+                    f"terminationPolicy.chief index {tp.chief.tf_replica_index} out of range "
+                    f"for {target.tf_replica_type.value} with {target.replicas} replicas"
+                )
+
+
+def is_local_job(job: TFJob) -> bool:
+    """ref: pkg/checker/checker.go:24-27 — first replica spec's type == Local.
+
+    (Kept as the classifier of record; validation guarantees Local is never
+    mixed with other types, fixing the reference's silent assumption.)"""
+    specs = job.spec.tf_replica_specs
+    return bool(specs) and specs[0].tf_replica_type == ReplicaType.LOCAL
+
+
+def is_tpu_job(job: TFJob) -> bool:
+    """Net-new classifier: any replica spec of type TPU."""
+    return any(s.tf_replica_type == ReplicaType.TPU for s in job.spec.tf_replica_specs)
+
+
+def replica_spec_for(job: TFJob, typ: ReplicaType) -> Optional[TFReplicaSpec]:
+    """Type-keyed lookup, replacing the reference's index hardcoding
+    (pkg/tensorflow/distributed.go:201-209 assumes exactly 2 specs)."""
+    for s in job.spec.tf_replica_specs:
+        if s.tf_replica_type == typ:
+            return s
+    return None

@@ -1,0 +1,1 @@
+"""Shared utilities: serde, names, clock, signals."""

@@ -1,0 +1,35 @@
+"""Name and runtime-ID generation.
+
+Semantic equivalent of the vendored ``SimpleNameGenerator``
+(ref: vendor/k8s.io/kubernetes/pkg/api/v1/generate.go:48-72, wrapped by
+pkg/tensorflow/util.go:21-29): base + 5 random lowercase alphanumerics,
+total length clamped to the DNS-1123 limit of 63 characters.
+"""
+
+from __future__ import annotations
+
+import random
+import string
+
+# Same alphabet the k8s generator uses (lowercase alnum minus easily-confused
+# characters is upstream's choice; we keep plain lowercase alnum, 5 chars).
+_ALPHABET = string.ascii_lowercase + string.digits
+RANDOM_SUFFIX_LEN = 5
+MAX_NAME_LEN = 63
+
+
+def random_suffix(n: int = RANDOM_SUFFIX_LEN) -> str:
+    return "".join(random.choice(_ALPHABET) for _ in range(n))
+
+
+def generate_name(base: str) -> str:
+    """``base`` + 5 random alphanumerics, truncating base to fit 63 chars."""
+    suffix = random_suffix()
+    max_base = MAX_NAME_LEN - len(suffix)
+    return base[:max_base] + suffix
+
+
+def generate_runtime_id() -> str:
+    """Fresh 5-char runtime ID stamped on a job at first materialization
+    (ref: pkg/tensorflow/distributed.go:211-222, local.go:81-84)."""
+    return random_suffix()

@@ -1,0 +1,114 @@
+"""Generic dataclass <-> k8s-style JSON (camelCase) serialization.
+
+The reference relies on generated deepcopy + JSON tags on Go structs
+(ref: vendor/github.com/caicloud/kubeflow-clientset/apis/kubeflow/v1alpha1/
+zz_generated.deepcopy.go and the ``json:"..."`` tags in types.go).  The
+idiomatic Python equivalent is one reflective serializer over dataclasses:
+
+- field names round-trip as camelCase (``tf_replica_type`` <-> ``tfReplicaType``)
+  unless overridden via ``field(metadata={"json": "..."})``;
+- ``None`` fields are omitted on output (k8s ``omitempty`` semantics);
+- nested dataclasses, ``Optional``, ``list``, ``dict`` and ``Enum`` are handled
+  recursively;
+- ``from_dict`` tolerates unknown keys (forward compatibility, as the k8s
+  decoder does).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import enum
+import typing
+from typing import Any, Dict, Optional, Type, TypeVar, get_args, get_origin, get_type_hints
+
+T = TypeVar("T")
+
+
+def camel(name: str) -> str:
+    """snake_case -> camelCase (``tf_replica_specs`` -> ``tfReplicaSpecs``)."""
+    parts = name.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+def _json_key(f: dataclasses.Field) -> str:
+    return f.metadata.get("json", camel(f.name))
+
+
+def to_dict(obj: Any) -> Any:
+    """Recursively serialize a dataclass tree to plain JSON-able types."""
+    if obj is None:
+        return None
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out: Dict[str, Any] = {}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            # omitempty: drop None, empty strings, and empty collections
+            # (ints stay even at 0 — replicas: 0 is meaningful).
+            if v is None or v == "" or (isinstance(v, (list, dict, tuple)) and not v):
+                continue
+            out[_json_key(f)] = to_dict(v)
+        return out
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, dict):
+        return {to_dict(k): to_dict(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v) for v in obj]
+    return obj
+
+
+def _strip_optional(tp: Any) -> Any:
+    if get_origin(tp) is typing.Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def _coerce(tp: Any, v: Any) -> Any:
+    tp = _strip_optional(tp)
+    if v is None:
+        return None
+    origin = get_origin(tp)
+    if origin in (list, tuple):
+        (elem,) = get_args(tp) or (Any,)
+        return [_coerce(elem, x) for x in v]
+    if origin is dict:
+        args = get_args(tp)
+        key_tp = args[0] if len(args) == 2 else Any
+        val_tp = args[1] if len(args) == 2 else Any
+        return {_coerce(key_tp, k): _coerce(val_tp, x) for k, x in v.items()}
+    if isinstance(tp, type):
+        if dataclasses.is_dataclass(tp):
+            return from_dict(tp, v)
+        if issubclass(tp, enum.Enum):
+            return tp(v)
+    return v
+
+
+def from_dict(cls: Type[T], d: Optional[Dict[str, Any]]) -> Optional[T]:
+    """Recursively deserialize ``d`` into dataclass ``cls``.
+
+    Unknown keys are ignored; missing keys fall back to field defaults.
+    """
+    if d is None:
+        return None
+    hints = get_type_hints(cls)
+    kwargs: Dict[str, Any] = {}
+    for f in dataclasses.fields(cls):
+        key = _json_key(f)
+        if key in d:
+            kwargs[f.name] = _coerce(hints[f.name], d[key])
+    return cls(**kwargs)
+
+
+def deep_copy(obj: T) -> T:
+    """Semantic equivalent of the generated ``DeepCopy`` methods.
+
+    The reference's biggest planner bug is mutating a *shared* pod template per
+    replica index (ref: pkg/tensorflow/distributed.go:120-128, acknowledged at
+    docs/design_doc.md:262-268).  Everything that materializes per-replica
+    objects in this framework must go through ``deep_copy`` first.
+    """
+    return copy.deepcopy(obj)

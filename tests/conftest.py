@@ -1,0 +1,14 @@
+"""Test configuration.
+
+JAX tests run on a virtual 8-device CPU mesh (multi-chip TPU hardware is not
+available in CI; shardings are validated on forced host devices, the same
+mechanism the driver's dryrun uses).  Must be set before jax is imported
+anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,248 @@
+"""API-layer tests: serde round-trips, validation, classifiers, helpers.
+
+Modeled on the reference's only first-party unit test — the table-driven
+replica-type classifier test (pkg/checker/checker_test.go:26-54) — then
+extended to the full schema surface.
+"""
+
+import pytest
+
+from kubeflow_controller_tpu.api import (
+    API_VERSION,
+    Container,
+    Pod,
+    PodTemplateSpec,
+    ReplicaType,
+    ResourceRequirements,
+    TFJob,
+    TFJobSpec,
+    TFReplicaSpec,
+    TPUSpec,
+    validate_tfjob,
+)
+from kubeflow_controller_tpu.api.core import (
+    PHASE_FAILED,
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+    filter_active_pods,
+    get_status,
+)
+from kubeflow_controller_tpu.api.meta import ObjectMeta, key_of, split_key
+from kubeflow_controller_tpu.api.tfjob import (
+    ValidationError,
+    is_local_job,
+    is_tpu_job,
+    replica_spec_for,
+    tpu_slice_chips,
+    tpu_slice_hosts,
+)
+from kubeflow_controller_tpu.utils import serde
+from kubeflow_controller_tpu.utils.names import generate_name, generate_runtime_id
+
+
+def mk_template() -> PodTemplateSpec:
+    t = PodTemplateSpec()
+    t.spec.containers.append(Container(name="tensorflow", image="img", args=["a"]))
+    return t
+
+
+def mk_job(*types_and_replicas) -> TFJob:
+    job = TFJob(metadata=ObjectMeta(name="dist-mnist", namespace="default", uid="u1"))
+    for typ, n in types_and_replicas:
+        spec = TFReplicaSpec(replicas=n, tf_replica_type=typ, template=mk_template())
+        if typ == ReplicaType.TPU:
+            spec.tpu = TPUSpec(accelerator_type="v5e-8")
+        job.spec.tf_replica_specs.append(spec)
+    return job
+
+
+# ---- classifier (table-driven, mirroring checker_test.go:26-54) ----
+
+@pytest.mark.parametrize(
+    "types,expect_local",
+    [
+        ([ReplicaType.LOCAL], True),
+        ([ReplicaType.WORKER], False),
+        ([ReplicaType.PS, ReplicaType.WORKER], False),
+        ([ReplicaType.WORKER, ReplicaType.PS], False),
+        ([ReplicaType.TPU], False),
+    ],
+)
+def test_is_local_job(types, expect_local):
+    job = mk_job(*[(t, 1) for t in types])
+    assert is_local_job(job) == expect_local
+
+
+def test_is_tpu_job():
+    assert is_tpu_job(mk_job((ReplicaType.TPU, 2)))
+    assert not is_tpu_job(mk_job((ReplicaType.WORKER, 2)))
+
+
+# ---- serde ----
+
+def test_serde_round_trip_camel_case():
+    job = mk_job((ReplicaType.PS, 2), (ReplicaType.WORKER, 4))
+    job.spec.model_dir = "/ckpt"
+    d = serde.to_dict(job)
+    assert d["apiVersion"] == API_VERSION
+    assert d["spec"]["modelDir"] == "/ckpt"
+    assert d["spec"]["tfReplicaSpecs"][0]["tfReplicaType"] == "PS"
+    assert d["spec"]["tfReplicaSpecs"][1]["replicas"] == 4
+    back = serde.from_dict(TFJob, d)
+    assert back.spec.tf_replica_specs[1].tf_replica_type == ReplicaType.WORKER
+    assert back.spec.tf_replica_specs[1].replicas == 4
+    assert back.spec.model_dir == "/ckpt"
+
+
+def test_serde_omits_none_and_ignores_unknown():
+    d = serde.to_dict(TFJob(metadata=ObjectMeta(name="x")))
+    assert "deletionTimestamp" not in d["metadata"]
+    back = serde.from_dict(TFJob, {"metadata": {"name": "x", "futureField": 1}})
+    assert back.metadata.name == "x"
+
+
+def test_deep_copy_isolates_template_mutation():
+    # The reference's shared-template mutation bug (distributed.go:120-128).
+    job = mk_job((ReplicaType.WORKER, 2))
+    cp = serde.deep_copy(job)
+    cp.spec.tf_replica_specs[0].template.spec.containers[0].args.append("--task_index=1")
+    assert job.spec.tf_replica_specs[0].template.spec.containers[0].args == ["a"]
+
+
+# ---- validation ----
+
+def test_validate_ok():
+    validate_tfjob(mk_job((ReplicaType.PS, 2), (ReplicaType.WORKER, 4)))
+    validate_tfjob(mk_job((ReplicaType.LOCAL, 1)))
+    validate_tfjob(mk_job((ReplicaType.TPU, 4)))
+
+
+@pytest.mark.parametrize(
+    "mutate,msg",
+    [
+        (lambda j: setattr(j.metadata, "name", ""), "name"),
+        (lambda j: j.spec.tf_replica_specs.clear(), "non-empty"),
+        (lambda j: setattr(j.spec.tf_replica_specs[0], "replicas", -1), "replicas"),
+        (lambda j: setattr(j.spec.tf_replica_specs[0], "template", None), "template"),
+    ],
+)
+def test_validate_rejects(mutate, msg):
+    job = mk_job((ReplicaType.WORKER, 2))
+    mutate(job)
+    with pytest.raises(ValidationError, match=msg):
+        validate_tfjob(job)
+
+
+def test_validate_rejects_local_mixed_and_multi():
+    with pytest.raises(ValidationError):
+        validate_tfjob(mk_job((ReplicaType.LOCAL, 1), (ReplicaType.WORKER, 1)))
+    with pytest.raises(ValidationError):
+        validate_tfjob(mk_job((ReplicaType.LOCAL, 2)))
+
+
+def test_validate_rejects_gpu_on_tpu_replica():
+    job = mk_job((ReplicaType.TPU, 2))
+    job.spec.tf_replica_specs[0].template.spec.containers[0].resources = (
+        ResourceRequirements(limits={"nvidia.com/gpu": "1"})
+    )
+    with pytest.raises(ValidationError, match="nvidia.com/gpu"):
+        validate_tfjob(job)
+
+
+def test_validate_rejects_duplicate_types():
+    with pytest.raises(ValidationError, match="duplicate"):
+        validate_tfjob(mk_job((ReplicaType.WORKER, 1), (ReplicaType.WORKER, 2)))
+
+
+# ---- TPU topology ----
+
+@pytest.mark.parametrize(
+    "accel,hosts,chips",
+    [("v5e-8", 2, 8), ("v5e-16", 4, 16), ("v5p-32", 8, 32), ("v4-8", 2, 8)],
+)
+def test_tpu_slice_derivation(accel, hosts, chips):
+    spec = TPUSpec(accelerator_type=accel, chips_per_host=4)
+    assert tpu_slice_hosts(spec) == hosts
+    assert tpu_slice_chips(spec) == chips
+
+
+def test_tpu_slice_explicit_hosts_wins():
+    # Single-host v5e-8: 1 host x 8 chips/host.
+    spec = TPUSpec(accelerator_type="v5e-8", num_hosts=1, chips_per_host=8)
+    assert tpu_slice_hosts(spec) == 1
+    assert tpu_slice_chips(spec) == 8
+
+
+def test_validate_rejects_inconsistent_tpu_topology():
+    job = mk_job((ReplicaType.TPU, 1))
+    # v5e-8 has 8 chips but 1 host x 4 chips/host = 4: contradiction.
+    job.spec.tf_replica_specs[0].tpu = TPUSpec(
+        accelerator_type="v5e-8", num_hosts=1, chips_per_host=4
+    )
+    with pytest.raises(ValidationError, match="inconsistent TPU topology"):
+        validate_tfjob(job)
+
+
+def test_validate_chief_index_in_range():
+    from kubeflow_controller_tpu.api import ChiefSpec, TerminationPolicySpec
+
+    job = mk_job((ReplicaType.PS, 1), (ReplicaType.WORKER, 2))
+    job.spec.tf_replica_specs[1].termination_policy = TerminationPolicySpec(
+        chief=ChiefSpec(tf_replica_name="Worker", tf_replica_index=10)
+    )
+    with pytest.raises(ValidationError, match="out of range"):
+        validate_tfjob(job)
+    job.spec.tf_replica_specs[1].termination_policy.chief.tf_replica_index = 1
+    validate_tfjob(job)
+
+
+def test_validate_generate_name_prefix():
+    job = mk_job((ReplicaType.WORKER, 1))
+    job.metadata.name = ""
+    job.metadata.generate_name = "My_Job-"
+    with pytest.raises(ValidationError, match="DNS-1123 prefix"):
+        validate_tfjob(job)
+    job.metadata.generate_name = "my-job-"
+    validate_tfjob(job)
+
+
+def test_serde_enum_dict_keys_round_trip():
+    from kubeflow_controller_tpu.api import TFReplicaState, TFReplicaStatus
+
+    st = TFReplicaStatus(tf_replicas_states={TFReplicaState.RUNNING: 3, TFReplicaState.FAILED: 1})
+    d = serde.to_dict(st)
+    assert d["tfReplicasStates"] == {"Running": 3, "Failed": 1}
+    back = serde.from_dict(TFReplicaStatus, d)
+    assert back.tf_replicas_states[TFReplicaState.RUNNING] == 3
+    assert all(isinstance(k, TFReplicaState) for k in back.tf_replicas_states)
+
+
+# ---- helpers ----
+
+def test_replica_spec_for_any_order():
+    job = mk_job((ReplicaType.WORKER, 4), (ReplicaType.PS, 2))
+    assert replica_spec_for(job, ReplicaType.PS).replicas == 2
+    assert replica_spec_for(job, ReplicaType.WORKER).replicas == 4
+    assert replica_spec_for(job, ReplicaType.TPU) is None
+
+
+def test_pod_status_helpers():
+    pods = [Pod() for _ in range(4)]
+    pods[0].status.phase = PHASE_SUCCEEDED
+    pods[1].status.phase = PHASE_FAILED
+    pods[2].status.phase = PHASE_RUNNING
+    pods[3].metadata.deletion_timestamp = 123.0
+    assert get_status(pods) == (1, 1)
+    active = filter_active_pods(pods)
+    assert len(active) == 1 and active[0] is pods[2]
+
+
+def test_keys_and_names():
+    m = ObjectMeta(name="j", namespace="ns")
+    assert key_of(m) == "ns/j"
+    assert split_key("ns/j") == ("ns", "j")
+    assert split_key("j") == ("", "j")
+    n = generate_name("base-")
+    assert n.startswith("base-") and len(n) == len("base-") + 5
+    assert len(generate_runtime_id()) == 5
+    assert len(generate_name("x" * 100)) == 63
