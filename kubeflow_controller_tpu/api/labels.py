@@ -1,0 +1,52 @@
+"""Label and annotation vocabulary.
+
+The reference selects replicas with a 4-label equality selector
+{kubeflow.caicloud.io: "true", job_type, runtime_id, tf_job_name}
+(ref: pkg/controller/helper.go:118-125, getLabels at
+pkg/tensorflow/distributed.go:224-231) plus a per-replica ``index`` label
+stamped at materialization (ref: distributed.go:120-123).  We keep that
+vocabulary and add TPU gang-scheduling annotations (net-new).
+"""
+
+DOMAIN = "kubeflow.caicloud.io"
+
+# Selector labels (values: "true", replica type, runtime id, job name).
+LABEL_DOMAIN = DOMAIN
+LABEL_JOB_TYPE = "job_type"
+LABEL_RUNTIME_ID = "runtime_id"
+LABEL_JOB_NAME = "tf_job_name"
+# Per-replica index label (ref: distributed.go:122).
+LABEL_INDEX = "index"
+
+# --- TPU gang scheduling (net-new) ---
+# All pods of one slice share a gang name and declare the gang size; the
+# scheduler admits all of them atomically onto one slice or none at all.
+ANNOTATION_GANG_NAME = f"{DOMAIN}/gang-name"
+ANNOTATION_GANG_SIZE = f"{DOMAIN}/gang-size"
+ANNOTATION_ACCELERATOR = f"{DOMAIN}/accelerator-type"
+
+
+def selector_for(job_name: str, replica_type: str, runtime_id: str) -> dict:
+    """The exact 4-label selector of helper.go:118-125."""
+    return {
+        LABEL_DOMAIN: "true",
+        LABEL_JOB_TYPE: replica_type,
+        LABEL_RUNTIME_ID: runtime_id,
+        LABEL_JOB_NAME: job_name,
+    }
+
+
+def job_selector(job_name: str, runtime_id: str) -> dict:
+    """Job-level selector (no job_type).
+
+    The reference claims per replica type against an Everything() listing
+    (helper.go:116-148), which makes each per-type claim *release* owned pods
+    of the other types (owned + selector-mismatch -> release in the upstream
+    ref-manager state machine) — latent ownership churn every sync.  Claiming
+    once at job scope and partitioning by the job_type label avoids it.
+    """
+    return {
+        LABEL_DOMAIN: "true",
+        LABEL_RUNTIME_ID: runtime_id,
+        LABEL_JOB_NAME: job_name,
+    }

@@ -1,0 +1,20 @@
+"""The cluster substrate: an in-memory API server, fake kubelet, TPU inventory.
+
+The reference is tested (when it is tested at all) against a fake clientset
+over an ObjectTracker (ref: vendor/github.com/caicloud/kubeflow-clientset/
+clientset/versioned/fake/clientset_generated.go:33-46) and validated manually
+against a single-node cluster (ref: docs/development.md:24-33).  This package
+is that substrate made first-class: a faithful in-memory API server with
+CRUD + watch + resourceVersions + generateName + ownership semantics, a
+kubelet that transitions pod phases (optionally by running real local
+processes), and a TPU slice inventory with gang admission — so the entire
+controller can be exercised end-to-end with no cluster.
+
+The client interfaces are the seam where a real Kubernetes REST client would
+plug in unchanged (SURVEY.md §7).
+"""
+
+from .store import ObjectStore, WatchEvent, Watcher, APIError, Conflict, NotFound, AlreadyExists  # noqa: F401
+from .client import Cluster, PodClient, ServiceClient, TFJobClient  # noqa: F401
+from .kubelet import FakeKubelet, PhasePolicy  # noqa: F401
+from .tpu import TPUInventory, TPUSlice  # noqa: F401

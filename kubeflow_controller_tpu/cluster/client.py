@@ -1,0 +1,83 @@
+"""Typed clients over the object store — the clientset seam.
+
+Functional equivalent of the generated typed clients
+(ref: vendor/github.com/caicloud/kubeflow-clientset/clientset/versioned/
+typed/kubeflow/v1alpha1/tfjob.go:34-154 for TFJobs; client-go core/v1 for
+pods/services).  A real REST implementation of these three classes is all it
+would take to run the controller against a live API server.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..api.core import Pod, Service
+from ..api.tfjob import TFJob
+from .store import ObjectStore, Watcher
+
+TFJOBS = "tfjobs"
+PODS = "pods"
+SERVICES = "services"
+
+
+class _TypedClient:
+    kind: str = ""
+
+    def __init__(self, store: ObjectStore):
+        self._store = store
+
+    def create(self, obj):
+        return self._store.create(self.kind, obj)
+
+    def get(self, namespace: str, name: str):
+        return self._store.get(self.kind, namespace, name)
+
+    def list(self, namespace: Optional[str] = None, selector: Optional[Dict[str, str]] = None):
+        return self._store.list(self.kind, namespace, selector)
+
+    def update(self, obj):
+        return self._store.update(self.kind, obj)
+
+    def delete(self, namespace: str, name: str):
+        return self._store.delete(self.kind, namespace, name)
+
+    def watch(self, namespace: Optional[str] = None) -> Watcher:
+        return self._store.watch(self.kind, namespace)
+
+    def patch_meta(self, namespace: str, name: str, fn):
+        return self._store.patch_meta(self.kind, namespace, name, fn)
+
+
+class TFJobClient(_TypedClient):
+    kind = TFJOBS
+
+    def update_status(self, job: TFJob) -> TFJob:
+        return self._store.update_status(self.kind, job)
+
+
+class PodClient(_TypedClient):
+    kind = PODS
+
+    def list_pods(self, namespace: Optional[str] = None) -> List[Pod]:
+        return self.list(namespace)
+
+    def mark_deleting(self, namespace: str, name: str) -> Pod:
+        return self._store.mark_deleting(self.kind, namespace, name)
+
+
+class ServiceClient(_TypedClient):
+    kind = SERVICES
+
+    def list_services(self, namespace: Optional[str] = None) -> List[Service]:
+        return self.list(namespace)
+
+
+class Cluster:
+    """One handle bundling the store and its typed clients (the analog of
+    building both clientsets in cmd/controller/main.go:52-60)."""
+
+    def __init__(self, store: Optional[ObjectStore] = None):
+        self.store = store or ObjectStore()
+        self.tfjobs = TFJobClient(self.store)
+        self.pods = PodClient(self.store)
+        self.services = ServiceClient(self.store)

@@ -1,0 +1,214 @@
+"""Fake kubelet: drives pod phases the way a node agent would.
+
+The reference validates controller behavior by watching real pods on a dev
+cluster (ref: docs/design_doc.md:36-201); here the node side is simulated so
+the whole loop runs in-process (SURVEY.md §4 "fake the platform boundary").
+
+Two modes per pod:
+
+- **simulated**: Pending -> Running -> Succeeded/Failed on a configurable
+  policy clock.  PS replicas run forever, matching ``server.join()`` in the
+  reference workload (ref: examples/workdir/mnist_replica.py:121-122).
+- **executed**: the pod's first container command actually runs as a local
+  subprocess (env injected from the container spec); the exit code decides
+  the terminal phase.  This is how e2e tests run real JAX/MNIST workloads
+  "in pods" with no cluster, honoring restartPolicy OnFailure with bounded
+  restarts.
+
+TPU pods gate on the :class:`TPUInventory` gang scheduler before leaving
+Pending (all-or-nothing slice admission).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Set
+
+from ..api.core import (
+    PHASE_FAILED,
+    PHASE_PENDING,
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+    Pod,
+)
+from ..api.labels import LABEL_JOB_TYPE
+from .client import Cluster
+from .store import ADDED, DELETED, NotFound
+from .tpu import TPUInventory, pod_requests_tpu
+
+
+@dataclass
+class PhasePolicy:
+    """Clock for simulated pods."""
+
+    pending_s: float = 0.0
+    run_s: float = 0.02
+    # Replica types that never reach a terminal phase on their own.
+    run_forever_types: tuple = ("PS",)
+    # Pod names to fail once (fault injection for recovery tests).
+    fail_once: Set[str] = field(default_factory=set)
+
+    def outcome(self, pod: Pod) -> Optional[str]:
+        if pod.metadata.name in self.fail_once:
+            self.fail_once.discard(pod.metadata.name)
+            return PHASE_FAILED
+        if pod.metadata.labels.get(LABEL_JOB_TYPE) in self.run_forever_types:
+            return None  # runs forever
+        return PHASE_SUCCEEDED
+
+
+class FakeKubelet:
+    def __init__(
+        self,
+        cluster: Cluster,
+        policy: Optional[PhasePolicy] = None,
+        inventory: Optional[TPUInventory] = None,
+        execute: bool = False,
+        max_restarts: int = 2,
+    ):
+        self.cluster = cluster
+        self.policy = policy or PhasePolicy()
+        self.inventory = inventory
+        self.execute = execute
+        self.max_restarts = max_restarts
+        self._watcher = None
+        self._threads: Dict[str, threading.Thread] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._stop = threading.Event()
+        self._main: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._watcher = self.cluster.pods.watch()
+        # Pick up pods created before the watch started.
+        for pod in self.cluster.pods.list():
+            self._spawn(pod)
+        self._main = threading.Thread(target=self._run, name="fake-kubelet", daemon=True)
+        self._main.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watcher:
+            self._watcher.stop()
+        for proc in list(self._procs.values()):
+            if proc.poll() is None:
+                proc.terminate()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            ev = self._watcher.next(timeout=0.2)
+            if ev is None:
+                continue
+            if ev.type == ADDED:
+                self._spawn(ev.object)
+            elif ev.type == DELETED:
+                proc = self._procs.get(self._key(ev.object))
+                if proc is not None and proc.poll() is None:
+                    proc.terminate()
+
+    @staticmethod
+    def _key(pod: Pod) -> str:
+        return f"{pod.metadata.namespace}/{pod.metadata.name}"
+
+    def _spawn(self, pod: Pod) -> None:
+        key = self._key(pod)
+        if key in self._threads:
+            return
+        t = threading.Thread(target=self._drive, args=(pod,), name=f"kubelet-{key}", daemon=True)
+        self._threads[key] = t
+        t.start()
+
+    # -- phase driving -------------------------------------------------------
+
+    def set_phase(self, namespace: str, name: str, phase: str, reason: str = "") -> None:
+        """Directly transition a pod (also the manual hook for tests)."""
+        try:
+            pod = self.cluster.pods.get(namespace, name)
+        except NotFound:
+            return
+        pod.status.phase = phase
+        pod.status.reason = reason
+        # The kubelet is the sole status writer for its pods: last-write-wins.
+        pod.metadata.resource_version = ""
+        try:
+            self.cluster.store.update_status("pods", pod)
+        except NotFound:
+            pass
+
+    def _drive(self, pod: Pod) -> None:
+        ns, name = pod.metadata.namespace, pod.metadata.name
+        # TPU pods wait in Pending for gang admission.
+        if self.inventory is not None and pod_requests_tpu(pod):
+            while not self._stop.is_set():
+                if self.inventory.offer(pod):
+                    break
+                time.sleep(0.005)
+                if self._gone(ns, name):
+                    return
+            if self._stop.is_set():
+                return
+        if self.policy.pending_s:
+            time.sleep(self.policy.pending_s)
+        if self._gone(ns, name):
+            return
+        self.set_phase(ns, name, PHASE_RUNNING)
+        if self.execute and pod.spec.containers and (
+            pod.spec.containers[0].command or pod.spec.containers[0].args
+        ):
+            self._execute(pod)
+        else:
+            self._simulate(pod)
+
+    def _gone(self, ns: str, name: str) -> bool:
+        try:
+            p = self.cluster.pods.get(ns, name)
+            return p.metadata.deletion_timestamp is not None
+        except NotFound:
+            return True
+
+    def _simulate(self, pod: Pod) -> None:
+        ns, name = pod.metadata.namespace, pod.metadata.name
+        outcome = self.policy.outcome(pod)
+        if outcome is None:
+            return  # runs forever (PS)
+        time.sleep(self.policy.run_s)
+        if not self._gone(ns, name):
+            self.set_phase(ns, name, outcome)
+
+    def _execute(self, pod: Pod) -> None:
+        ns, name = pod.metadata.namespace, pod.metadata.name
+        c = pod.spec.containers[0]
+        cmd = list(c.command) + list(c.args)
+        env = dict(os.environ)
+        env.update({e.name: e.value for e in c.env})
+        restarts = 0
+        while not self._stop.is_set():
+            try:
+                proc = subprocess.Popen(
+                    cmd,
+                    env=env,
+                    cwd=c.working_dir or None,
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.PIPE,
+                )
+            except OSError as e:
+                self.set_phase(ns, name, PHASE_FAILED, reason=f"StartError: {e}")
+                return
+            self._procs[self._key(pod)] = proc
+            _, stderr = proc.communicate()
+            if self._stop.is_set() or self._gone(ns, name):
+                return
+            if proc.returncode == 0:
+                self.set_phase(ns, name, PHASE_SUCCEEDED)
+                return
+            if pod.spec.restart_policy in ("Always", "OnFailure") and restarts < self.max_restarts:
+                restarts += 1
+                continue
+            tail = (stderr or b"")[-500:].decode(errors="replace")
+            self.set_phase(ns, name, PHASE_FAILED, reason=f"Error: exit {proc.returncode}: {tail}")
+            return

@@ -1,0 +1,270 @@
+"""In-memory object store with k8s API-server semantics.
+
+Reproduces the behaviors the reference's controller correctness depends on
+(SURVEY.md §7 "hard parts"):
+
+- monotonically increasing resourceVersions, bumped on every write;
+- optimistic concurrency: update with a stale resourceVersion -> Conflict
+  (the reference does full-object Update with no retry at
+  pkg/controller/controller.go:643-649; our controller layers retry on top);
+- ``generateName`` materialization (base + 5 random alphanumerics, ref:
+  vendor/k8s.io/kubernetes/pkg/api/v1/generate.go:48-72);
+- watch streams that deliver ADDED/MODIFIED/DELETED in write order, each
+  carrying a deep copy (watchers can never mutate the store);
+- deletionTimestamp + cascading garbage collection of controller-owned
+  objects (net-new: the reference's delete handlers are stubs,
+  pkg/controller/controller.go:522-524, 601-603).
+
+Everything is guarded by one RLock; watch queues are unbounded
+``queue.Queue`` so writers never block on slow watchers.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from ..api.meta import ObjectMeta, get_controller_of, matches_selector
+from ..utils import serde
+from ..utils.names import generate_name
+
+
+class APIError(Exception):
+    pass
+
+
+class NotFound(APIError):
+    pass
+
+
+class AlreadyExists(APIError):
+    pass
+
+
+class Conflict(APIError):
+    """Stale resourceVersion on update (optimistic-concurrency failure)."""
+
+
+class Invalid(APIError):
+    pass
+
+
+# Watch event types (ref: watch.Added/Modified/Deleted in apimachinery).
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: str
+    object: Any  # deep copy of the stored object
+
+
+class Watcher:
+    """One watch stream: an unbounded queue of :class:`WatchEvent`."""
+
+    def __init__(self, store: "ObjectStore", kind: str, namespace: Optional[str]):
+        self._store = store
+        self.kind = kind
+        self.namespace = namespace
+        self.queue: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        self._stopped = False
+
+    def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        """Blocking pop; None on stop or timeout."""
+        try:
+            return self.queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def stop(self) -> None:
+        if not self._stopped:
+            self._stopped = True
+            self._store._remove_watcher(self)
+            self.queue.put(None)  # sentinel to unblock consumers
+
+
+class ObjectStore:
+    """The in-memory API server. Collections are keyed by plural kind
+    ("tfjobs", "pods", "services"); objects by (namespace, name)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objects: Dict[str, Dict[tuple, Any]] = {}
+        self._watchers: Dict[str, List[Watcher]] = {}
+        self._rv = 0
+        self._uid = 0
+
+    # -- internals -----------------------------------------------------------
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _next_uid(self) -> str:
+        self._uid += 1
+        return f"uid-{self._uid}"
+
+    def _collection(self, kind: str) -> Dict[tuple, Any]:
+        return self._objects.setdefault(kind, {})
+
+    def _notify(self, kind: str, ev_type: str, obj: Any) -> None:
+        for w in self._watchers.get(kind, []):
+            if w.namespace is None or w.namespace == obj.metadata.namespace:
+                w.queue.put(WatchEvent(ev_type, serde.deep_copy(obj)))
+
+    def _remove_watcher(self, w: Watcher) -> None:
+        with self._lock:
+            lst = self._watchers.get(w.kind, [])
+            if w in lst:
+                lst.remove(w)
+
+    # -- API surface ---------------------------------------------------------
+
+    def create(self, kind: str, obj: Any) -> Any:
+        with self._lock:
+            meta: ObjectMeta = obj.metadata
+            obj = serde.deep_copy(obj)
+            meta = obj.metadata
+            if not meta.name:
+                if not meta.generate_name:
+                    raise Invalid("either name or generateName is required")
+                # Retry on (unlikely) suffix collision, as the apiserver does.
+                for _ in range(8):
+                    candidate = generate_name(meta.generate_name)
+                    if (meta.namespace, candidate) not in self._collection(kind):
+                        meta.name = candidate
+                        break
+                else:
+                    raise AlreadyExists(f"could not generate unique name for {meta.generate_name}")
+            key = (meta.namespace, meta.name)
+            if key in self._collection(kind):
+                raise AlreadyExists(f"{kind} {key} already exists")
+            meta.uid = self._next_uid()
+            meta.resource_version = self._next_rv()
+            meta.creation_timestamp = time.time()
+            self._collection(kind)[key] = obj
+            self._notify(kind, ADDED, obj)
+            return serde.deep_copy(obj)
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        """A quorum/live read — this is what the adoption path's
+        ``canAdoptFunc`` uses to re-check UIDs (ref: pkg/controller/
+        helper.go:137-146, RecheckDeletionTimestamp at
+        controller_ref_manager.go:373-385)."""
+        with self._lock:
+            obj = self._collection(kind).get((namespace, name))
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            return serde.deep_copy(obj)
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        selector: Optional[Dict[str, str]] = None,
+    ) -> List[Any]:
+        with self._lock:
+            out = []
+            for (ns, _), obj in self._collection(kind).items():
+                if namespace is not None and ns != namespace:
+                    continue
+                if selector is not None and not matches_selector(obj.metadata.labels, selector):
+                    continue
+                out.append(serde.deep_copy(obj))
+            return out
+
+    def update(self, kind: str, obj: Any) -> Any:
+        with self._lock:
+            meta: ObjectMeta = obj.metadata
+            key = (meta.namespace, meta.name)
+            existing = self._collection(kind).get(key)
+            if existing is None:
+                raise NotFound(f"{kind} {key} not found")
+            if meta.resource_version and meta.resource_version != existing.metadata.resource_version:
+                raise Conflict(
+                    f"{kind} {key}: resourceVersion {meta.resource_version} "
+                    f"!= {existing.metadata.resource_version}"
+                )
+            obj = serde.deep_copy(obj)
+            # uid and creation timestamp are immutable.
+            obj.metadata.uid = existing.metadata.uid
+            obj.metadata.creation_timestamp = existing.metadata.creation_timestamp
+            obj.metadata.resource_version = self._next_rv()
+            self._collection(kind)[key] = obj
+            self._notify(kind, MODIFIED, obj)
+            return serde.deep_copy(obj)
+
+    def patch_meta(self, kind: str, namespace: str, name: str,
+                   fn: Callable[[ObjectMeta], None]) -> Any:
+        """Server-side metadata patch (the adoption/release path: owner-ref
+        merge patches, ref: pkg/controller/ref/service.go:126-164).  ``fn``
+        mutates the live metadata under the lock, so it cannot race other
+        writers; resourceVersion is bumped and watchers notified."""
+        with self._lock:
+            obj = self._collection(kind).get((namespace, name))
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            fn(obj.metadata)
+            obj.metadata.resource_version = self._next_rv()
+            self._notify(kind, MODIFIED, obj)
+            return serde.deep_copy(obj)
+
+    def update_status(self, kind: str, obj: Any) -> Any:
+        """Status-subresource style update: only .status is applied.  A
+        stale resourceVersion raises Conflict (as the real subresource does);
+        an empty resourceVersion means last-write-wins."""
+        with self._lock:
+            meta: ObjectMeta = obj.metadata
+            key = (meta.namespace, meta.name)
+            existing = self._collection(kind).get(key)
+            if existing is None:
+                raise NotFound(f"{kind} {key} not found")
+            if meta.resource_version and meta.resource_version != existing.metadata.resource_version:
+                raise Conflict(
+                    f"{kind} {key}: status resourceVersion {meta.resource_version} "
+                    f"!= {existing.metadata.resource_version}"
+                )
+            existing.status = serde.deep_copy(obj.status)
+            existing.metadata.resource_version = self._next_rv()
+            self._notify(kind, MODIFIED, existing)
+            return serde.deep_copy(existing)
+
+    def delete(self, kind: str, namespace: str, name: str, cascade: bool = True) -> None:
+        """Immediate delete + (optionally) cascading GC of controller-owned
+        objects — the capability the reference left as a stub."""
+        with self._lock:
+            obj = self._collection(kind).pop((namespace, name), None)
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            obj.metadata.deletion_timestamp = time.time()
+            self._notify(kind, DELETED, obj)
+            if cascade:
+                self._cascade_delete(obj.metadata.uid, namespace)
+
+    def _cascade_delete(self, owner_uid: str, namespace: str) -> None:
+        for kind in list(self._objects):
+            for (ns, name), child in list(self._collection(kind).items()):
+                if ns != namespace:
+                    continue
+                ref = get_controller_of(child.metadata)
+                if ref is not None and ref.uid == owner_uid:
+                    self.delete(kind, ns, name, cascade=True)
+
+    def mark_deleting(self, kind: str, namespace: str, name: str) -> Any:
+        """Set deletionTimestamp without removing (graceful-deletion state,
+        which FilterActivePods treats as inactive)."""
+        return self.patch_meta(
+            kind, namespace, name,
+            lambda m: setattr(m, "deletion_timestamp", time.time()),
+        )
+
+    def watch(self, kind: str, namespace: Optional[str] = None) -> Watcher:
+        with self._lock:
+            w = Watcher(self, kind, namespace)
+            self._watchers.setdefault(kind, []).append(w)
+            return w

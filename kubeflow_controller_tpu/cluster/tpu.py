@@ -1,0 +1,113 @@
+"""TPU slice inventory with gang admission — the fake platform boundary.
+
+Net-new capability (SURVEY.md §7 "hard parts: gang semantics for TPU
+slices"): all pods of one slice are admitted atomically onto one free slice
+or not at all, and the whole slice is a single failure domain.  The real
+counterpart is GKE's TPU slice scheduling; tests fake it here the same way
+the reference fakes its cluster (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api.core import Pod, RESOURCE_TPU
+from ..api.labels import ANNOTATION_ACCELERATOR, ANNOTATION_GANG_NAME, ANNOTATION_GANG_SIZE
+
+
+@dataclass
+class TPUSlice:
+    name: str
+    accelerator_type: str = "v5e-8"
+    num_hosts: int = 2
+    chips_per_host: int = 4
+    # gang currently bound to this slice ("" = free).
+    bound_gang: str = ""
+
+
+@dataclass
+class _Gang:
+    name: str
+    size: int
+    accelerator_type: str
+    pods: Dict[str, Pod] = field(default_factory=dict)  # pod name -> pod
+    slice_name: str = ""  # set once admitted
+
+
+def pod_requests_tpu(pod: Pod) -> bool:
+    return any(
+        RESOURCE_TPU in c.resources.requests or RESOURCE_TPU in c.resources.limits
+        for c in pod.spec.containers
+    )
+
+
+class TPUInventory:
+    """Tracks slices and gangs; admits gangs all-or-nothing."""
+
+    def __init__(self, slices: Optional[List[TPUSlice]] = None):
+        self._lock = threading.Lock()
+        self.slices: Dict[str, TPUSlice] = {s.name: s for s in (slices or [])}
+        self._gangs: Dict[str, _Gang] = {}
+
+    def add_slice(self, s: TPUSlice) -> None:
+        with self._lock:
+            self.slices[s.name] = s
+
+    def offer(self, pod: Pod) -> bool:
+        """Offer a TPU pod for scheduling.  Returns True iff the pod's gang is
+        (now) admitted onto a slice — i.e. the pod may leave Pending.
+
+        Non-gang TPU pods (no gang annotation) are admitted alone onto any
+        free slice."""
+        ann = pod.metadata.annotations
+        gang_name = ann.get(ANNOTATION_GANG_NAME, "")
+        accel = ann.get(ANNOTATION_ACCELERATOR, "")
+        with self._lock:
+            if not gang_name:
+                return self._find_free_slice(accel) is not None
+            size = int(ann.get(ANNOTATION_GANG_SIZE, "1"))
+            gang = self._gangs.setdefault(gang_name, _Gang(gang_name, size, accel))
+            gang.pods[pod.metadata.name] = pod
+            if gang.slice_name:
+                return True  # already admitted; late pod joins
+            if len(gang.pods) < gang.size:
+                return False  # gang incomplete: hold everything
+            sl = self._find_free_slice(accel)
+            if sl is None:
+                return False  # complete but no capacity: hold (no partial admission)
+            sl.bound_gang = gang_name
+            gang.slice_name = sl.name
+            return True
+
+    def _find_free_slice(self, accelerator_type: str) -> Optional[TPUSlice]:
+        for s in self.slices.values():
+            if s.bound_gang:
+                continue
+            if accelerator_type and s.accelerator_type != accelerator_type:
+                continue
+            return s
+        return None
+
+    def gang_slice(self, gang_name: str) -> str:
+        with self._lock:
+            g = self._gangs.get(gang_name)
+            return g.slice_name if g else ""
+
+    def release_gang(self, gang_name: str) -> None:
+        """Free the slice when a job completes or is recycled."""
+        with self._lock:
+            g = self._gangs.pop(gang_name, None)
+            if g and g.slice_name and g.slice_name in self.slices:
+                self.slices[g.slice_name].bound_gang = ""
+
+    def fail_slice(self, slice_name: str) -> List[str]:
+        """Simulate a whole-slice failure (the TPU failure domain).  Returns
+        the names of pods in the bound gang; the kubelet fails them all."""
+        with self._lock:
+            sl = self.slices.get(slice_name)
+            if sl is None or not sl.bound_gang:
+                return []
+            g = self._gangs.get(sl.bound_gang)
+            return list(g.pods.keys()) if g else []

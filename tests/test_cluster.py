@@ -1,0 +1,279 @@
+"""Fake-cluster substrate tests: store semantics, watch, kubelet, TPU gangs."""
+
+import sys
+import time
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import (
+    PHASE_FAILED,
+    PHASE_PENDING,
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+    Container,
+    EnvVar,
+    Pod,
+    ResourceRequirements,
+)
+from kubeflow_controller_tpu.api.labels import (
+    ANNOTATION_ACCELERATOR,
+    ANNOTATION_GANG_NAME,
+    ANNOTATION_GANG_SIZE,
+    LABEL_JOB_TYPE,
+)
+from kubeflow_controller_tpu.api.meta import ObjectMeta, OwnerReference
+from kubeflow_controller_tpu.api.tfjob import TFJob, TFJobPhase
+from kubeflow_controller_tpu.cluster import (
+    AlreadyExists,
+    Cluster,
+    Conflict,
+    FakeKubelet,
+    NotFound,
+    PhasePolicy,
+    TPUInventory,
+    TPUSlice,
+)
+from kubeflow_controller_tpu.cluster.store import ADDED, DELETED, MODIFIED
+
+
+def mk_pod(name, ns="default", labels=None, annotations=None, command=None, tpu=False):
+    pod = Pod(metadata=ObjectMeta(name=name, namespace=ns))
+    pod.metadata.labels = labels or {}
+    pod.metadata.annotations = annotations or {}
+    c = Container(name="main")
+    if command:
+        c.command = command
+    if tpu:
+        c.resources = ResourceRequirements(requests={"google.com/tpu": "4"})
+    pod.spec.containers.append(c)
+    return pod
+
+
+def wait_for(fn, timeout=5.0, interval=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+# ---- store semantics ----
+
+def test_create_get_update_conflict():
+    c = Cluster()
+    job = TFJob(metadata=ObjectMeta(name="j", namespace="ns"))
+    created = c.tfjobs.create(job)
+    assert created.metadata.uid and created.metadata.resource_version
+    stale = c.tfjobs.get("ns", "j")
+    fresh = c.tfjobs.get("ns", "j")
+    fresh.status.phase = TFJobPhase.RUNNING
+    c.tfjobs.update(fresh)
+    stale.status.phase = TFJobPhase.FAILED
+    with pytest.raises(Conflict):
+        c.tfjobs.update(stale)
+    with pytest.raises(AlreadyExists):
+        c.tfjobs.create(TFJob(metadata=ObjectMeta(name="j", namespace="ns")))
+    with pytest.raises(NotFound):
+        c.tfjobs.get("ns", "nope")
+
+
+def test_update_status_rv_semantics():
+    c = Cluster()
+    c.tfjobs.create(TFJob(metadata=ObjectMeta(name="j", namespace="ns")))
+    j = c.tfjobs.get("ns", "j")
+    j.status.phase = TFJobPhase.RUNNING
+    c.tfjobs.update_status(j)  # fresh rv: accepted
+    assert c.tfjobs.get("ns", "j").status.phase == TFJobPhase.RUNNING
+    # Stale rv -> Conflict (the status subresource honors optimistic locking).
+    j.status.phase = TFJobPhase.FAILED
+    j.metadata.resource_version = "1"
+    with pytest.raises(Conflict):
+        c.tfjobs.update_status(j)
+    # Empty rv -> last-write-wins.
+    j.metadata.resource_version = ""
+    c.tfjobs.update_status(j)
+    assert c.tfjobs.get("ns", "j").status.phase == TFJobPhase.FAILED
+
+
+def test_generate_name_and_store_isolation():
+    c = Cluster()
+    pod = Pod(metadata=ObjectMeta(generate_name="dist-mnist-worker-", namespace="ns"))
+    created = c.pods.create(pod)
+    assert created.metadata.name.startswith("dist-mnist-worker-")
+    assert len(created.metadata.name) == len("dist-mnist-worker-") + 5
+    # Mutating the returned copy must not touch the store.
+    created.metadata.labels["x"] = "y"
+    assert "x" not in c.pods.get("ns", created.metadata.name).metadata.labels
+
+
+def test_list_selector_and_namespace():
+    c = Cluster()
+    c.pods.create(mk_pod("a", ns="n1", labels={"t": "w"}))
+    c.pods.create(mk_pod("b", ns="n1", labels={"t": "ps"}))
+    c.pods.create(mk_pod("c", ns="n2", labels={"t": "w"}))
+    assert {p.metadata.name for p in c.pods.list("n1")} == {"a", "b"}
+    assert {p.metadata.name for p in c.pods.list("n1", selector={"t": "w"})} == {"a"}
+    assert len(c.pods.list()) == 3
+
+
+def test_watch_ordering_and_namespace_filter():
+    c = Cluster()
+    w = c.pods.watch("ns")
+    c.pods.create(mk_pod("p1", ns="ns"))
+    c.pods.create(mk_pod("other", ns="elsewhere"))
+    p = c.pods.get("ns", "p1")
+    p.status.phase = PHASE_RUNNING
+    c.store.update_status("pods", p)
+    c.pods.delete("ns", "p1")
+    evs = [w.next(timeout=1) for _ in range(3)]
+    assert [e.type for e in evs] == [ADDED, MODIFIED, DELETED]
+    assert all(e.object.metadata.name == "p1" for e in evs)
+    w.stop()
+    assert w.next(timeout=1) is None
+
+
+def test_cascade_delete_owned_objects():
+    c = Cluster()
+    job = c.tfjobs.create(TFJob(metadata=ObjectMeta(name="j", namespace="ns")))
+    pod = mk_pod("p", ns="ns")
+    pod.metadata.owner_references.append(
+        OwnerReference(kind="TFJob", name="j", uid=job.metadata.uid, controller=True)
+    )
+    c.pods.create(pod)
+    orphan = mk_pod("orphan", ns="ns")
+    c.pods.create(orphan)
+    c.tfjobs.delete("ns", "j")
+    with pytest.raises(NotFound):
+        c.pods.get("ns", "p")
+    assert c.pods.get("ns", "orphan")
+
+
+def test_patch_meta_adoption():
+    c = Cluster()
+    c.pods.create(mk_pod("p", ns="ns"))
+    c.pods.patch_meta(
+        "ns", "p",
+        lambda m: m.owner_references.append(OwnerReference(name="j", uid="u", controller=True)),
+    )
+    got = c.pods.get("ns", "p")
+    assert got.metadata.owner_references[0].uid == "u"
+
+
+# ---- fake kubelet: simulated ----
+
+def test_kubelet_worker_succeeds_ps_runs_forever():
+    c = Cluster()
+    kubelet = FakeKubelet(c, policy=PhasePolicy(run_s=0.01))
+    kubelet.start()
+    try:
+        c.pods.create(mk_pod("w0", labels={LABEL_JOB_TYPE: "Worker"}))
+        c.pods.create(mk_pod("ps0", labels={LABEL_JOB_TYPE: "PS"}))
+        wait_for(lambda: c.pods.get("default", "w0").status.phase == PHASE_SUCCEEDED)
+        assert c.pods.get("default", "ps0").status.phase == PHASE_RUNNING
+    finally:
+        kubelet.stop()
+
+
+def test_kubelet_fault_injection():
+    c = Cluster()
+    kubelet = FakeKubelet(c, policy=PhasePolicy(run_s=0.01, fail_once={"w0"}))
+    kubelet.start()
+    try:
+        c.pods.create(mk_pod("w0", labels={LABEL_JOB_TYPE: "Worker"}))
+        wait_for(lambda: c.pods.get("default", "w0").status.phase == PHASE_FAILED)
+    finally:
+        kubelet.stop()
+
+
+# ---- fake kubelet: executed subprocesses ----
+
+def test_kubelet_executes_real_process_with_env():
+    c = Cluster()
+    kubelet = FakeKubelet(c, execute=True)
+    kubelet.start()
+    try:
+        pod = mk_pod("runner", command=[sys.executable, "-c", "import os,sys; sys.exit(0 if os.environ.get('TASK_INDEX')=='3' else 1)"])
+        pod.spec.containers[0].env.append(EnvVar(name="TASK_INDEX", value="3"))
+        c.pods.create(pod)
+        wait_for(lambda: c.pods.get("default", "runner").status.phase == PHASE_SUCCEEDED)
+    finally:
+        kubelet.stop()
+
+
+def test_kubelet_execute_failure_after_restarts():
+    c = Cluster()
+    kubelet = FakeKubelet(c, execute=True, max_restarts=1)
+    kubelet.start()
+    try:
+        pod = mk_pod("bad", command=[sys.executable, "-c", "raise SystemExit(3)"])
+        pod.spec.restart_policy = "OnFailure"
+        c.pods.create(pod)
+        got = wait_for(
+            lambda: (lambda p: p if p.status.phase == PHASE_FAILED else None)(c.pods.get("default", "bad"))
+        )
+        assert "exit 3" in got.status.reason
+    finally:
+        kubelet.stop()
+
+
+# ---- TPU inventory: gang admission ----
+
+def tpu_pod(name, gang, size, accel="v5e-8"):
+    return mk_pod(
+        name,
+        tpu=True,
+        annotations={
+            ANNOTATION_GANG_NAME: gang,
+            ANNOTATION_GANG_SIZE: str(size),
+            ANNOTATION_ACCELERATOR: accel,
+        },
+    )
+
+
+def test_gang_all_or_nothing():
+    inv = TPUInventory([TPUSlice("slice-0", "v5e-8", num_hosts=2)])
+    p0, p1 = tpu_pod("h0", "g1", 2), tpu_pod("h1", "g1", 2)
+    assert not inv.offer(p0)  # incomplete gang: hold
+    assert inv.offer(p1)      # gang complete: admitted
+    assert inv.offer(p0)      # first pod re-offers, now admitted
+    assert inv.gang_slice("g1") == "slice-0"
+
+
+def test_gang_blocks_without_capacity_then_admits_after_release():
+    inv = TPUInventory([TPUSlice("slice-0", "v5e-8", num_hosts=2)])
+    assert inv.offer(tpu_pod("a0", "g1", 1))
+    assert inv.gang_slice("g1") == "slice-0"
+    assert not inv.offer(tpu_pod("b0", "g2", 1))  # no free slice
+    inv.release_gang("g1")
+    assert inv.offer(tpu_pod("b0", "g2", 1))
+
+
+def test_gang_accelerator_type_must_match():
+    inv = TPUInventory([TPUSlice("slice-0", "v5p-32", num_hosts=8)])
+    assert not inv.offer(tpu_pod("a0", "g1", 1, accel="v5e-8"))
+    assert inv.offer(tpu_pod("b0", "g2", 1, accel="v5p-32"))
+
+
+def test_kubelet_gates_tpu_pods_on_gang_admission():
+    c = Cluster()
+    inv = TPUInventory([TPUSlice("slice-0", "v5e-8", num_hosts=2)])
+    kubelet = FakeKubelet(c, policy=PhasePolicy(run_s=0.01), inventory=inv)
+    kubelet.start()
+    try:
+        c.pods.create(tpu_pod("h0", "g1", 2))
+        time.sleep(0.1)
+        assert c.pods.get("default", "h0").status.phase == PHASE_PENDING
+        c.pods.create(tpu_pod("h1", "g1", 2))
+        wait_for(lambda: c.pods.get("default", "h0").status.phase == PHASE_SUCCEEDED)
+        wait_for(lambda: c.pods.get("default", "h1").status.phase == PHASE_SUCCEEDED)
+    finally:
+        kubelet.stop()
+
+
+def test_slice_failure_domain():
+    inv = TPUInventory([TPUSlice("slice-0", "v5e-8", num_hosts=2)])
+    inv.offer(tpu_pod("h0", "g1", 2))
+    inv.offer(tpu_pod("h1", "g1", 2))
+    assert sorted(inv.fail_slice("slice-0")) == ["h0", "h1"]
