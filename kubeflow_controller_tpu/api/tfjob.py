@@ -144,13 +144,20 @@ def validate_tpu_spec(spec: TPUSpec) -> None:
     if spec.num_hosts < 0 or spec.chips_per_host <= 0:
         raise ValidationError("numHosts must be >= 0 and chipsPerHost > 0")
     m = _ACCEL_RE.match(spec.accelerator_type)
-    if m and spec.num_hosts > 0:
+    if m:
         chips = int(m.group(3))
-        if spec.num_hosts * spec.chips_per_host != chips:
+        if spec.num_hosts > 0:
+            if spec.num_hosts * spec.chips_per_host != chips:
+                raise ValidationError(
+                    f"inconsistent TPU topology: {spec.accelerator_type} has {chips} chips "
+                    f"but numHosts({spec.num_hosts}) x chipsPerHost({spec.chips_per_host}) "
+                    f"= {spec.num_hosts * spec.chips_per_host}"
+                )
+        elif chips % spec.chips_per_host != 0:
+            # Derived host count must divide the slice exactly.
             raise ValidationError(
-                f"inconsistent TPU topology: {spec.accelerator_type} has {chips} chips "
-                f"but numHosts({spec.num_hosts}) x chipsPerHost({spec.chips_per_host}) "
-                f"= {spec.num_hosts * spec.chips_per_host}"
+                f"inconsistent TPU topology: {spec.accelerator_type} has {chips} chips, "
+                f"not divisible by chipsPerHost({spec.chips_per_host})"
             )
 
 
@@ -245,6 +252,12 @@ def validate_tfjob(job: TFJob) -> None:
         raise ValidationError("metadata.name is required")
     if job.metadata.name and not _DNS1123.match(job.metadata.name):
         raise ValidationError(f"metadata.name {job.metadata.name!r} is not DNS-1123")
+    if len(job.metadata.name) > 63:
+        raise ValidationError("metadata.name exceeds the 63-char DNS-1123 limit")
+    if not job.metadata.name and len(job.metadata.generate_name) > 58:
+        raise ValidationError(
+            "metadata.generateName exceeds 58 chars (no room for the 5-char suffix)"
+        )
     # generateName prefixes may legitimately end with '-'; validate the prefix
     # so generated names (prefix + alnum suffix) are DNS-1123 too.
     gn = job.metadata.generate_name
@@ -272,6 +285,14 @@ def validate_tfjob(job: TFJob) -> None:
             if s.tpu is None:
                 raise ValidationError("TPU replica spec requires .tpu topology")
             validate_tpu_spec(s.tpu)
+            # The slice topology is the source of truth for the pod count;
+            # replicas must agree (or be left at the default 1).
+            hosts = tpu_slice_hosts(s.tpu)
+            if s.replicas not in (1, hosts):
+                raise ValidationError(
+                    f"TPU replicas({s.replicas}) contradicts slice host count "
+                    f"({hosts}) derived from {s.tpu.accelerator_type}"
+                )
             for c in s.template.spec.containers:
                 if "nvidia.com/gpu" in c.resources.limits or "nvidia.com/gpu" in c.resources.requests:
                     raise ValidationError("TPU replicas must not request nvidia.com/gpu")

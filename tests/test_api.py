@@ -114,7 +114,26 @@ def test_deep_copy_isolates_template_mutation():
 def test_validate_ok():
     validate_tfjob(mk_job((ReplicaType.PS, 2), (ReplicaType.WORKER, 4)))
     validate_tfjob(mk_job((ReplicaType.LOCAL, 1)))
-    validate_tfjob(mk_job((ReplicaType.TPU, 4)))
+    validate_tfjob(mk_job((ReplicaType.TPU, 2)))  # v5e-8 = 2 hosts
+
+
+def test_validate_rejects_tpu_replicas_contradicting_topology():
+    with pytest.raises(ValidationError, match="contradicts slice host count"):
+        validate_tfjob(mk_job((ReplicaType.TPU, 4)))  # v5e-8 derives 2 hosts
+
+
+def test_validate_rejects_indivisible_chips_per_host():
+    job = mk_job((ReplicaType.TPU, 1))
+    job.spec.tf_replica_specs[0].tpu = TPUSpec(accelerator_type="v5e-8", chips_per_host=3)
+    with pytest.raises(ValidationError, match="not divisible"):
+        validate_tfjob(job)
+
+
+def test_validate_rejects_overlong_name():
+    job = mk_job((ReplicaType.WORKER, 1))
+    job.metadata.name = "x" * 100
+    with pytest.raises(ValidationError, match="63-char"):
+        validate_tfjob(job)
 
 
 @pytest.mark.parametrize(
