@@ -1,0 +1,102 @@
+"""Per-replica and per-slice health.
+
+The reference's checker is a 27-LoC classifier (pkg/checker/checker.go); the
+north star asks for real health tracking with the TPU slice as the failure
+domain (BASELINE.json, SURVEY.md §5 "failure detection").  This module turns
+observed pods into a structured health report the updater, events, and CLI
+``describe`` all share.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from ..api.core import (
+    PHASE_FAILED,
+    PHASE_PENDING,
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+    Pod,
+    is_pod_active,
+)
+from ..api.tfjob import ReplicaType, TFJob
+from ..planner.materialize import pods_by_index
+from ..planner.plan import desired_replicas
+
+
+class Health(str, enum.Enum):
+    HEALTHY = "Healthy"        # all desired replicas active/succeeded
+    DEGRADED = "Degraded"      # some replicas missing or restarting
+    FAILED = "Failed"          # terminal failure present
+    COMPLETE = "Complete"      # all replicas succeeded
+
+
+@dataclass
+class ReplicaHealth:
+    type: ReplicaType
+    desired: int
+    running: int = 0
+    waiting: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    missing_indices: List[int] = field(default_factory=list)
+    health: Health = Health.DEGRADED
+
+
+@dataclass
+class JobHealth:
+    replicas: Dict[ReplicaType, ReplicaHealth] = field(default_factory=dict)
+
+    @property
+    def overall(self) -> Health:
+        states = [r.health for r in self.replicas.values()]
+        if Health.FAILED in states:
+            return Health.FAILED
+        if all(s == Health.COMPLETE for s in states) and states:
+            return Health.COMPLETE
+        if Health.DEGRADED in states:
+            return Health.DEGRADED
+        return Health.HEALTHY
+
+
+def check_health(job: TFJob, pods_by_type: Dict[ReplicaType, List[Pod]]) -> JobHealth:
+    out = JobHealth()
+    for spec in job.spec.tf_replica_specs:
+        typ = spec.tf_replica_type
+        desired = desired_replicas(spec)
+        pods = pods_by_type.get(typ, [])
+        rh = ReplicaHealth(type=typ, desired=desired)
+        by_idx = pods_by_index(pods)
+        for p in pods:
+            if p.status.phase == PHASE_RUNNING:
+                rh.running += 1
+            elif p.status.phase == PHASE_PENDING:
+                rh.waiting += 1
+            elif p.status.phase == PHASE_SUCCEEDED:
+                rh.succeeded += 1
+            elif p.status.phase == PHASE_FAILED:
+                rh.failed += 1
+        for i in range(desired):
+            plist = by_idx.get(i, [])
+            if not any(is_pod_active(p) or p.status.phase == PHASE_SUCCEEDED for p in plist):
+                rh.missing_indices.append(i)
+        restart = spec.template.spec.restart_policy if spec.template else "OnFailure"
+        replace = restart in ("OnFailure", "Always")
+        succeeded_indices = sum(
+            1 for i in range(desired)
+            if any(p.status.phase == PHASE_SUCCEEDED for p in by_idx.get(i, []))
+        )
+        if rh.failed and not replace:
+            rh.health = Health.FAILED
+        elif typ != ReplicaType.PS and desired > 0 and succeeded_indices == desired:
+            rh.health = Health.COMPLETE
+        elif rh.missing_indices or rh.failed:
+            # A TPU gang with any missing member is degraded as a whole —
+            # the slice is one failure domain.
+            rh.health = Health.DEGRADED
+        else:
+            rh.health = Health.HEALTHY
+        out.replicas[typ] = rh
+    return out

@@ -1,0 +1,15 @@
+"""The reconcile engine (ref: pkg/controller/).
+
+Primitives first (workqueue, expectations, informer — the vendored k8s
+machinery of SURVEY.md §2.3 re-implemented idiomatically), then the
+controller loop itself.
+"""
+
+from .workqueue import RateLimitingQueue, ShutDown  # noqa: F401
+from .expectations import ControllerExpectations  # noqa: F401
+from .informer import SharedInformer  # noqa: F401
+from .events import EventRecorder, Event  # noqa: F401
+from .helper import Helper  # noqa: F401
+from .refmanager import RefManager  # noqa: F401
+from .metrics import ReconcileMetrics  # noqa: F401
+from .controller import Controller  # noqa: F401

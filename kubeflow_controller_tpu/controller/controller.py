@@ -1,0 +1,345 @@
+"""The reconcile engine: informers -> workqueue -> sync -> planner -> writes.
+
+Semantic successor of pkg/controller/controller.go (the 649-line heart of the
+reference), preserving its level-triggered architecture:
+
+- three informers (TFJob, Pod, Service) feed a rate-limited workqueue with
+  ``namespace/name`` keys (ref: controller.go:98-165);
+- per-key serialization via the queue's dirty/processing discipline
+  (ref: controller.go:72-76);
+- the expectations cache guards the create/observe race
+  (ref: controller.go:278, 373-443);
+- ``run(threadiness)`` waits for cache sync then spawns workers in
+  get/sync/done loops with Forget-on-success / requeue-with-backoff
+  (ref: controller.go:174-259).
+
+Deliberate upgrades over the reference (each cited gap is from SURVEY.md):
+
+- pod/service **delete handlers are implemented** (stubs upstream,
+  controller.go:522-524, 601-603): deletions feed expectations and re-queue
+  the owner, so failed/vanished replicas are replaced;
+- the stamped ``runtime_id`` is **persisted** to the job spec before any
+  replica is created (upstream stamps it in-memory per sync, local.go:79-84);
+- status updates go through the status subresource with conflict retries
+  (upstream does a bare full-object Update, controller.go:643-649);
+- TPU jobs release their slice gang on terminal cleanup (net-new);
+- reconcile latency is measured per sync (the BASELINE reconcile-p50 metric).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..api.core import Pod, Service
+from ..api.labels import LABEL_JOB_TYPE, job_selector
+from ..api.meta import get_controller_of, key_of, split_key
+from ..api.tfjob import (
+    KIND,
+    ReplicaType,
+    TFJob,
+    ValidationError,
+    is_tpu_job,
+    replica_spec_for,
+    validate_tfjob,
+)
+from ..cluster.client import Cluster
+from ..cluster.store import Conflict, NotFound
+from ..cluster.tpu import TPUInventory
+from ..planner import plan_job
+from ..planner.materialize import gang_name, make_pod, make_service
+from ..planner.types import Action
+from ..updater import compute_status, should_update
+from ..utils import serde
+from ..utils.names import generate_runtime_id
+from .events import EventRecorder, TYPE_WARNING
+from .expectations import ControllerExpectations
+from .helper import Helper
+from .informer import SharedInformer
+from .metrics import ReconcileMetrics
+from .workqueue import RateLimitingQueue, ShutDown
+
+logger = logging.getLogger("kubeflow_controller_tpu.controller")
+
+MAX_STATUS_RETRIES = 5
+
+
+class Controller:
+    def __init__(
+        self,
+        cluster: Cluster,
+        inventory: Optional[TPUInventory] = None,
+        resync_period_s: float = 30.0,
+        recorder: Optional[EventRecorder] = None,
+    ):
+        self.cluster = cluster
+        self.inventory = inventory
+        self.recorder = recorder or EventRecorder()
+        self.helper = Helper(cluster, self.recorder)
+        self.queue = RateLimitingQueue(name="tfJobs")
+        self.expectations = ControllerExpectations()
+        self.metrics = ReconcileMetrics()
+
+        self.tfjob_informer = SharedInformer(cluster.tfjobs, resync_period_s, "tfjobs")
+        self.pod_informer = SharedInformer(cluster.pods, resync_period_s, "pods")
+        self.service_informer = SharedInformer(cluster.services, resync_period_s, "services")
+
+        # TFJob events all funnel into the queue (ref: controller.go:138-153).
+        self.tfjob_informer.add_event_handler(
+            on_add=self._enqueue,
+            on_update=lambda old, new: self._enqueue(new),
+            on_delete=self._on_tfjob_delete,
+        )
+        # Pod/Service feedback edges (ref: controller.go:447-599 + the
+        # upstream-stubbed delete handlers, implemented here).
+        self.pod_informer.add_event_handler(
+            on_add=lambda p: self._on_child_add(p),
+            on_update=lambda old, new: self._on_child_update(old, new),
+            on_delete=lambda p: self._on_child_delete(p),
+        )
+        self.service_informer.add_event_handler(
+            on_add=lambda s: self._on_child_add(s),
+            on_update=lambda old, new: self._on_child_update(old, new),
+            on_delete=lambda s: self._on_child_delete(s),
+        )
+
+        self._workers: List[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------------ run
+
+    def run(self, threadiness: int = 2, wait_sync_timeout: float = 10.0) -> None:
+        """Start informers, wait for cache sync, spawn workers
+        (ref: controller.go:174-198; threadiness=2 at main.go:70)."""
+        logger.info("starting TFJob controller")
+        for inf in (self.tfjob_informer, self.pod_informer, self.service_informer):
+            inf.start()
+        for inf in (self.tfjob_informer, self.pod_informer, self.service_informer):
+            if not inf.wait_for_cache_sync(wait_sync_timeout):
+                raise TimeoutError(f"timed out waiting for {inf.name} cache sync")
+        for i in range(threadiness):
+            t = threading.Thread(target=self._worker, name=f"tfjob-worker-{i}", daemon=True)
+            self._workers.append(t)
+            t.start()
+        logger.info("started %d workers", threadiness)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shut_down()
+        for inf in (self.tfjob_informer, self.pod_informer, self.service_informer):
+            inf.stop()
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._process_next_work_item()
+            except ShutDown:
+                return
+            except Exception:  # the worker itself must never die
+                logger.exception("unhandled error in worker loop")
+
+    def _process_next_work_item(self) -> None:
+        """ref: controller.go:210-259."""
+        key = self.queue.get(timeout=0.5)
+        if key is None:
+            return
+        t0 = time.monotonic()
+        error = False
+        try:
+            self.sync_handler(key)
+            self.queue.forget(key)
+        except Exception as e:
+            error = True
+            logger.warning("error syncing %s (requeue #%d): %s",
+                           key, self.queue.num_requeues(key), e)
+            self.queue.add_rate_limited(key)
+        finally:
+            self.queue.done(key)
+            self.metrics.record_sync(time.monotonic() - t0, error=error)
+
+    # --------------------------------------------------------------- events
+
+    def _enqueue(self, job: TFJob) -> None:
+        self.queue.add(key_of(job.metadata))
+
+    def _on_tfjob_delete(self, job: TFJob) -> None:
+        key = key_of(job.metadata)
+        self.expectations.delete_expectations(key)
+        if self.inventory is not None and is_tpu_job(job):
+            self.inventory.release_gang(gang_name(job))
+        self.queue.add(key)  # final sync performs cleanup if needed
+
+    def _resolve_controller_ref(self, obj) -> Optional[str]:
+        """ref: resolveControllerRef at controller.go:608-624 — owner key iff
+        the ref points at a live TFJob whose UID matches."""
+        ref = get_controller_of(obj.metadata)
+        if ref is None or ref.kind != KIND:
+            return None
+        job = self.tfjob_informer.get(obj.metadata.namespace, ref.name)
+        if job is None or job.metadata.uid != ref.uid:
+            return None
+        return key_of(job.metadata)
+
+    def _on_child_add(self, obj) -> None:
+        """ref: addPod/addService at controller.go:447-471, 526-547."""
+        if obj.metadata.deletion_timestamp is not None:
+            self._on_child_delete(obj)
+            return
+        key = self._resolve_controller_ref(obj)
+        if key is None:
+            return
+        self.expectations.creation_observed(key)
+        self.queue.add(key)
+
+    def _on_child_update(self, old, new) -> None:
+        """ref: updatePod at controller.go:474-520 — ignore same-RV resyncs
+        of children; notify both old and new owners on ref change."""
+        if old.metadata.resource_version == new.metadata.resource_version:
+            return
+        old_ref = get_controller_of(old.metadata)
+        new_ref = get_controller_of(new.metadata)
+        if old_ref is not None and (new_ref is None or old_ref.uid != new_ref.uid):
+            old_job = self.tfjob_informer.get(old.metadata.namespace, old_ref.name)
+            if old_job is not None:
+                self.queue.add(key_of(old_job.metadata))
+        key = self._resolve_controller_ref(new)
+        if key is not None:
+            self.queue.add(key)
+
+    def _on_child_delete(self, obj) -> None:
+        """The handler the reference left "To Be Implemented"
+        (controller.go:522-524, 601-603)."""
+        key = self._resolve_controller_ref(obj)
+        if key is None:
+            return
+        self.expectations.deletion_observed(key)
+        self.queue.add(key)
+
+    # ----------------------------------------------------------------- sync
+
+    def sync_handler(self, key: str) -> None:
+        """ref: syncTFJob at controller.go:264-357."""
+        ns, name = split_key(key)
+        job = self.tfjob_informer.get(ns, name)
+        if job is None:
+            # Deleted: expectations cleaned in the delete handler; cascade GC
+            # removes children server-side.
+            self.expectations.delete_expectations(key)
+            return
+        # Never mutate the informer cache (the reference mutates lister
+        # objects — the shared-template bug class).
+        job = serde.deep_copy(job)
+        try:
+            validate_tfjob(job)
+        except ValidationError as e:
+            self.recorder.event(job, TYPE_WARNING, "InvalidSpec", str(e))
+            return  # do not requeue: the spec must change first
+
+        deleting = job.metadata.deletion_timestamp is not None
+
+        # Persist the runtime ID once, before any replica exists (fixes the
+        # per-sync in-memory stamping of local.go:79-84).
+        if not job.spec.runtime_id and not deleting:
+            job.spec.runtime_id = generate_runtime_id()
+            try:
+                self.cluster.tfjobs.update(job)
+            except Conflict:
+                self.queue.add(key)  # re-read on next pass
+                return
+            except NotFound:
+                return
+            # Fall through with the stamped job: the informer will catch up.
+
+        needs_sync = self.expectations.satisfied_expectations(key)
+
+        pods_by_type, services_by_type = self._gather(job)
+
+        if needs_sync and not deleting:
+            self._manage(key, job, pods_by_type, services_by_type)
+
+        # Status rollup runs every sync, whether or not we acted.
+        new_status = compute_status(job, pods_by_type)
+        if should_update(job.status, new_status):
+            self._update_status(job, new_status)
+
+        # Terminal TPU jobs release their slice once cleanup is planned.
+        if (
+            self.inventory is not None
+            and is_tpu_job(job)
+            and new_status.phase.value in ("Succeeded", "Failed")
+        ):
+            self.inventory.release_gang(gang_name(job))
+
+    def _gather(self, job: TFJob):
+        """Claim pods/services once at job scope, then partition by replica
+        type (ref: controller.go:299-320 — but see api.labels.job_selector
+        for why we claim once instead of per type)."""
+        selector = job_selector(job.metadata.name, job.spec.runtime_id)
+        pods = self.helper.get_pods_for_tfjob(job, selector)
+        services = self.helper.get_services_for_tfjob(job, selector)
+        pods_by_type: Dict[ReplicaType, List[Pod]] = {}
+        services_by_type: Dict[ReplicaType, List[Service]] = {}
+        for spec in job.spec.tf_replica_specs:
+            typ = spec.tf_replica_type
+            pods_by_type[typ] = [
+                p for p in pods if p.metadata.labels.get(LABEL_JOB_TYPE) == typ.value
+            ]
+            services_by_type[typ] = [
+                s for s in services if s.metadata.labels.get(LABEL_JOB_TYPE) == typ.value
+            ]
+        return pods_by_type, services_by_type
+
+    def _manage(self, key, job, pods_by_type, services_by_type) -> None:
+        """Execute the plan (ref: manageTFJob at controller.go:359-445)."""
+        plan = plan_job(job, pods_by_type, services_by_type)
+        if plan.empty:
+            return
+        self.expectations.expect(key, plan.creations, plan.deletions)
+        for ev in plan.events:
+            spec = replica_spec_for(job, ev.replica_type)
+            try:
+                if ev.action == Action.ADD_SERVICE:
+                    self.helper.create_service(job, make_service(job, spec, ev.index))
+                    self.metrics.creates += 1
+                elif ev.action == Action.ADD_POD:
+                    self.helper.create_pod(job, make_pod(job, spec, ev.index))
+                    self.metrics.creates += 1
+                elif ev.action == Action.DELETE_POD:
+                    if self.helper.delete_pod(job, job.metadata.namespace, ev.name):
+                        self.metrics.deletes += 1
+                    else:
+                        # Already gone: no DELETED event will arrive.
+                        self.expectations.lower_expectations(key, del_delta=1)
+                elif ev.action == Action.DELETE_SERVICE:
+                    if self.helper.delete_service(job, job.metadata.namespace, ev.name):
+                        self.metrics.deletes += 1
+                    else:
+                        self.expectations.lower_expectations(key, del_delta=1)
+            except Exception:
+                # The watch event will never arrive; decrement so the TTL
+                # does not block the next sync (ref: controller.go:381-383).
+                if ev.action in (Action.ADD_POD, Action.ADD_SERVICE):
+                    self.expectations.lower_expectations(key, add_delta=1)
+                else:
+                    self.expectations.lower_expectations(key, del_delta=1)
+                raise
+
+    def _update_status(self, job: TFJob, new_status) -> None:
+        """Status write with conflict retry (the reference's bare Update with
+        no retry is its known weakness, controller.go:643-649)."""
+        for attempt in range(MAX_STATUS_RETRIES):
+            try:
+                fresh = self.cluster.tfjobs.get(job.metadata.namespace, job.metadata.name)
+            except NotFound:
+                return
+            fresh.status = new_status
+            try:
+                self.cluster.tfjobs.update_status(fresh)
+                self.metrics.status_updates += 1
+                return
+            except Conflict:
+                continue
+        logger.warning("giving up status update for %s after %d conflicts",
+                       key_of(job.metadata), MAX_STATUS_RETRIES)

@@ -1,0 +1,74 @@
+"""Event recorder: the user-facing audit stream.
+
+The reference broadcasts k8s Events (ref: pkg/controller/controller.go:107-110)
+with reasons SuccessfulCreate / FailedCreate etc. (ref: pkg/controller/
+control/types.go:20-29, emitted at control/service.go:72-84).  Here events are
+recorded in-memory (queryable by tests and the CLI) and logged structurally —
+the same three observability channels the reference has: logs, events, status
+(SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+logger = logging.getLogger("kubeflow_controller_tpu.events")
+
+# Event reasons (ref: pkg/controller/control/types.go:20-29).
+REASON_SUCCESSFUL_CREATE = "SuccessfulCreate"
+REASON_FAILED_CREATE = "FailedCreate"
+REASON_SUCCESSFUL_DELETE = "SuccessfulDelete"
+REASON_FAILED_DELETE = "FailedDelete"
+
+TYPE_NORMAL = "Normal"
+TYPE_WARNING = "Warning"
+
+
+@dataclass
+class Event:
+    object_kind: str
+    object_key: str  # namespace/name
+    type: str
+    reason: str
+    message: str
+    timestamp: float = field(default_factory=time.time)
+    count: int = 1
+
+
+class EventRecorder:
+    def __init__(self, component: str = "tfjob-controller", max_events: int = 4096):
+        self.component = component
+        self._lock = threading.Lock()
+        self._events: List[Event] = []
+        self._max = max_events
+
+    def event(self, obj, event_type: str, reason: str, message: str) -> None:
+        key = f"{obj.metadata.namespace}/{obj.metadata.name}"
+        kind = getattr(obj, "kind", type(obj).__name__)
+        with self._lock:
+            # Aggregate identical consecutive events (broadcaster behavior).
+            if self._events:
+                last = self._events[-1]
+                if (last.object_key, last.reason, last.message) == (key, reason, message):
+                    last.count += 1
+                    last.timestamp = time.time()
+                    return
+            self._events.append(Event(kind, key, event_type, reason, message))
+            if len(self._events) > self._max:
+                self._events = self._events[-self._max :]
+        log = logger.info if event_type == TYPE_NORMAL else logger.warning
+        log("event component=%s kind=%s object=%s reason=%s: %s",
+            self.component, kind, key, reason, message)
+
+    def events_for(self, namespace: str, name: str) -> List[Event]:
+        key = f"{namespace}/{name}"
+        with self._lock:
+            return [e for e in self._events if e.object_key == key]
+
+    def all_events(self) -> List[Event]:
+        with self._lock:
+            return list(self._events)

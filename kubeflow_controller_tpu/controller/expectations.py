@@ -1,0 +1,87 @@
+"""Controller expectations: the create/observe race guard.
+
+Semantic re-implementation of ``ControllerExpectationsInterface``
+(ref: vendor/k8s.io/kubernetes/pkg/controller/controller_utils.go:136-285).
+Between issuing a create and seeing its watch event, the informer cache
+under-counts reality; without this cache a second sync would double-create
+replicas.  The load-bearing contract (SURVEY.md §7 "hard parts"):
+
+- ``satisfied_expectations(key)`` is True when the recorded expectation is
+  **fulfilled** (adds <= 0 and dels <= 0, controller_utils.go:274-277) **or
+  expired** (older than 5 minutes, controller_utils.go:205-207) or absent;
+- observations may race ahead of expectations (counts can go negative —
+  upstream explicitly allows this, controller_utils.go:258-270).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict
+
+EXPECTATION_TTL_S = 5 * 60.0  # ExpectationsTimeout, controller_utils.go:125
+
+
+@dataclass
+class _Expectation:
+    adds: int = 0
+    dels: int = 0
+    timestamp: float = field(default_factory=time.time)
+
+    def fulfilled(self) -> bool:
+        return self.adds <= 0 and self.dels <= 0
+
+    def expired(self, now: float, ttl: float) -> bool:
+        return now - self.timestamp > ttl
+
+
+class ControllerExpectations:
+    def __init__(self, ttl_s: float = EXPECTATION_TTL_S):
+        self._ttl = ttl_s
+        self._lock = threading.Lock()
+        self._store: Dict[str, _Expectation] = {}
+
+    def expect_creations(self, key: str, count: int) -> None:
+        with self._lock:
+            self._store[key] = _Expectation(adds=count)
+
+    def expect_deletions(self, key: str, count: int) -> None:
+        with self._lock:
+            self._store[key] = _Expectation(dels=count)
+
+    def expect(self, key: str, adds: int, dels: int) -> None:
+        """One sync may both create and delete (replacement plans)."""
+        with self._lock:
+            self._store[key] = _Expectation(adds=adds, dels=dels)
+
+    def creation_observed(self, key: str) -> None:
+        self._lower(key, add_delta=1)
+
+    def deletion_observed(self, key: str) -> None:
+        self._lower(key, del_delta=1)
+
+    def lower_expectations(self, key: str, add_delta: int = 0, del_delta: int = 0) -> None:
+        """Used when a create call fails outright: the watch event will never
+        come, so decrement directly (ref: controller.go:381-383, 427-443)."""
+        self._lower(key, add_delta, del_delta)
+
+    def _lower(self, key: str, add_delta: int = 0, del_delta: int = 0) -> None:
+        with self._lock:
+            e = self._store.get(key)
+            if e is not None:
+                e.adds -= add_delta
+                e.dels -= del_delta
+
+    def satisfied_expectations(self, key: str) -> bool:
+        with self._lock:
+            e = self._store.get(key)
+            if e is None:
+                # No expectations recorded: a new controller or a new job —
+                # sync (ref: controller_utils.go:194-200).
+                return True
+            return e.fulfilled() or e.expired(time.time(), self._ttl)
+
+    def delete_expectations(self, key: str) -> None:
+        with self._lock:
+            self._store.pop(key, None)

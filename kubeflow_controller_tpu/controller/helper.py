@@ -1,0 +1,136 @@
+"""Helper: bridges planner output to cluster writes and cached reads.
+
+Semantic re-implementation of ``HelperInterface`` (ref: pkg/controller/
+helper.go:45-51):
+
+- ``create_pod`` / ``create_service``: stamp the controller ownerRef
+  (controller=true, blockOwnerDeletion=true, ref: util.go:43-54), validate it
+  (ref: control/util.go:25-42), refuse empty labels (ref: control/
+  service.go:67-69), create through the client, and emit
+  SuccessfulCreate/FailedCreate events (ref: control/service.go:72-84);
+- ``get_pods_for_tfjob`` / ``get_services_for_tfjob``: list by the 4-label
+  selector (ref: helper.go:118-125), then adopt/release through the
+  :class:`RefManager` with a live-read ``can_adopt`` gate re-checking the
+  job's UID (ref: helper.go:137-148).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..api.core import Pod, Service
+from ..api.meta import set_controller_ref, validate_controller_ref, get_controller_of
+from ..api.tfjob import API_VERSION, KIND, TFJob
+from ..cluster.client import Cluster
+from ..cluster.store import NotFound
+from ..utils import serde
+from .events import (
+    EventRecorder,
+    REASON_FAILED_CREATE,
+    REASON_SUCCESSFUL_CREATE,
+    REASON_SUCCESSFUL_DELETE,
+    TYPE_NORMAL,
+    TYPE_WARNING,
+)
+from .refmanager import RefManager
+
+
+class Helper:
+    def __init__(self, cluster: Cluster, recorder: EventRecorder):
+        self.cluster = cluster
+        self.recorder = recorder
+
+    # -- writes --------------------------------------------------------------
+
+    def create_pod(self, job: TFJob, pod: Pod) -> Pod:
+        pod = serde.deep_copy(pod)
+        pod.metadata.namespace = job.metadata.namespace
+        if not pod.metadata.labels:
+            raise ValueError("pod template has no labels; refusing to create")
+        set_controller_ref(pod.metadata, job.metadata, API_VERSION, KIND)
+        validate_controller_ref(get_controller_of(pod.metadata))
+        try:
+            created = self.cluster.pods.create(pod)
+        except Exception as e:
+            self.recorder.event(job, TYPE_WARNING, REASON_FAILED_CREATE,
+                                f"Error creating pod: {e}")
+            raise
+        self.recorder.event(job, TYPE_NORMAL, REASON_SUCCESSFUL_CREATE,
+                            f"Created pod: {created.metadata.name}")
+        return created
+
+    def create_service(self, job: TFJob, service: Service) -> Service:
+        service = serde.deep_copy(service)
+        service.metadata.namespace = job.metadata.namespace
+        if not service.metadata.labels:
+            raise ValueError("service template has no labels; refusing to create")
+        set_controller_ref(service.metadata, job.metadata, API_VERSION, KIND)
+        validate_controller_ref(get_controller_of(service.metadata))
+        try:
+            created = self.cluster.services.create(service)
+        except Exception as e:
+            self.recorder.event(job, TYPE_WARNING, REASON_FAILED_CREATE,
+                                f"Error creating service: {e}")
+            raise
+        self.recorder.event(job, TYPE_NORMAL, REASON_SUCCESSFUL_CREATE,
+                            f"Created service: {created.metadata.name}")
+        return created
+
+    def delete_pod(self, job: TFJob, namespace: str, name: str) -> bool:
+        """Index-preserving replacement and recycle need real deletes —
+        the capability the reference stubbed (controller.go:522-524).
+        Returns False when the pod was already gone (no DELETED watch event
+        will arrive; the caller must lower its deletion expectation)."""
+        try:
+            self.cluster.pods.delete(namespace, name)
+        except NotFound:
+            return False
+        self.recorder.event(job, TYPE_NORMAL, REASON_SUCCESSFUL_DELETE,
+                            f"Deleted pod: {name}")
+        return True
+
+    def delete_service(self, job: TFJob, namespace: str, name: str) -> bool:
+        try:
+            self.cluster.services.delete(namespace, name)
+        except NotFound:
+            return False
+        self.recorder.event(job, TYPE_NORMAL, REASON_SUCCESSFUL_DELETE,
+                            f"Deleted service: {name}")
+        return True
+
+    # -- reads + adoption ----------------------------------------------------
+
+    def _can_adopt_fn(self, job: TFJob):
+        """Live (uncached) re-read of the job, vetoing adoption if the cached
+        UID is stale or the job is being deleted (ref: helper.go:137-146)."""
+
+        def can_adopt() -> None:
+            fresh = self.cluster.tfjobs.get(job.metadata.namespace, job.metadata.name)
+            if fresh.metadata.uid != job.metadata.uid:
+                raise RuntimeError(
+                    f"original TFJob {job.metadata.name} is gone: got uid "
+                    f"{fresh.metadata.uid}, wanted {job.metadata.uid}"
+                )
+            if fresh.metadata.deletion_timestamp is not None:
+                raise RuntimeError(f"TFJob {job.metadata.name} is being deleted")
+
+        return can_adopt
+
+    def get_pods_for_tfjob(self, job: TFJob, selector: Dict[str, str]) -> List[Pod]:
+        # List everything in the namespace, then claim — the reference does
+        # the same ("It is a hack", helper.go:131-136) so adoption can see
+        # orphans whose labels do not match the selector yet.
+        pods = self.cluster.pods.list(job.metadata.namespace)
+        mgr = RefManager(
+            self.cluster.pods, job.metadata, KIND, API_VERSION,
+            selector, self._can_adopt_fn(job),
+        )
+        return mgr.claim(pods)
+
+    def get_services_for_tfjob(self, job: TFJob, selector: Dict[str, str]) -> List[Service]:
+        services = self.cluster.services.list(job.metadata.namespace)
+        mgr = RefManager(
+            self.cluster.services, job.metadata, KIND, API_VERSION,
+            selector, self._can_adopt_fn(job),
+        )
+        return mgr.claim(services)

@@ -1,0 +1,146 @@
+"""Shared informer: local cache + event handlers over a watch stream.
+
+Semantic re-implementation of the client-go SharedIndexInformer machinery the
+controller wires in its constructor (ref: pkg/controller/controller.go:98-165;
+factories built with 30s resync at cmd/controller/main.go:62-63):
+
+- initial LIST populates the cache and fires ADD handlers, after which
+  ``has_synced`` is True (the ``WaitForCacheSync`` gate, controller.go:183);
+- the WATCH loop keeps the cache fresh and fires add/update/delete handlers;
+- a periodic **resync** re-fires update handlers for every cached object with
+  old == new — the level-triggering backstop that re-drives reconciliation
+  even if an edge was missed (update handlers can detect a resync by equal
+  resourceVersions, as the reference does at controller.go:480-484).
+
+Handlers run on the informer thread in event order — the same serialization
+guarantee client-go provides a single event handler.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ..api.meta import key_of
+from ..cluster.store import ADDED, DELETED, MODIFIED, Watcher
+
+
+class SharedInformer:
+    def __init__(self, client, resync_period_s: float = 30.0, name: str = ""):
+        self._client = client
+        self._resync_s = resync_period_s
+        self.name = name or getattr(client, "kind", "objects")
+        self._lock = threading.RLock()
+        self._cache: Dict[str, Any] = {}
+        self._add_handlers: list[Callable[[Any], None]] = []
+        self._update_handlers: list[Callable[[Any, Any], None]] = []
+        self._delete_handlers: list[Callable[[Any], None]] = []
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+        self._watcher: Optional[Watcher] = None
+        self._thread: Optional[threading.Thread] = None
+        self._resync_thread: Optional[threading.Thread] = None
+
+    # -- registration --------------------------------------------------------
+
+    def add_event_handler(
+        self,
+        on_add: Optional[Callable[[Any], None]] = None,
+        on_update: Optional[Callable[[Any, Any], None]] = None,
+        on_delete: Optional[Callable[[Any], None]] = None,
+    ) -> None:
+        if on_add:
+            self._add_handlers.append(on_add)
+        if on_update:
+            self._update_handlers.append(on_update)
+        if on_delete:
+            self._delete_handlers.append(on_delete)
+
+    # -- cache reads (the "lister") -----------------------------------------
+
+    def get(self, namespace: str, name: str) -> Optional[Any]:
+        with self._lock:
+            return self._cache.get(f"{namespace}/{name}")
+
+    def list(self) -> list:
+        with self._lock:
+            return list(self._cache.values())
+
+    @property
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    def wait_for_cache_sync(self, timeout: float = 10.0) -> bool:
+        return self._synced.wait(timeout)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        # Open the watch BEFORE the initial list so no write is missed
+        # between the two (list-then-watch with a gap would drop events).
+        self._watcher = self._client.watch()
+        for obj in self._client.list():
+            k = key_of(obj.metadata)
+            with self._lock:
+                self._cache[k] = obj
+            self._dispatch_add(obj)
+        self._synced.set()
+        self._thread = threading.Thread(target=self._watch_loop, name=f"informer-{self.name}", daemon=True)
+        self._thread.start()
+        if self._resync_s > 0:
+            self._resync_thread = threading.Thread(
+                target=self._resync_loop, name=f"informer-{self.name}-resync", daemon=True
+            )
+            self._resync_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watcher:
+            self._watcher.stop()
+
+    # -- internals -----------------------------------------------------------
+
+    def _watch_loop(self) -> None:
+        while not self._stop.is_set():
+            ev = self._watcher.next(timeout=0.2)
+            if ev is None:
+                continue
+            k = key_of(ev.object.metadata)
+            if ev.type == ADDED:
+                with self._lock:
+                    known = k in self._cache
+                    self._cache[k] = ev.object
+                if known:
+                    # Already delivered by the initial list: treat as update.
+                    self._dispatch_update(ev.object, ev.object)
+                else:
+                    self._dispatch_add(ev.object)
+            elif ev.type == MODIFIED:
+                with self._lock:
+                    old = self._cache.get(k, ev.object)
+                    self._cache[k] = ev.object
+                self._dispatch_update(old, ev.object)
+            elif ev.type == DELETED:
+                with self._lock:
+                    self._cache.pop(k, None)
+                self._dispatch_delete(ev.object)
+
+    def _resync_loop(self) -> None:
+        while not self._stop.is_set():
+            if self._stop.wait(self._resync_s):
+                return
+            for obj in self.list():
+                self._dispatch_update(obj, obj)
+
+    def _dispatch_add(self, obj) -> None:
+        for h in self._add_handlers:
+            h(obj)
+
+    def _dispatch_update(self, old, new) -> None:
+        for h in self._update_handlers:
+            h(old, new)
+
+    def _dispatch_delete(self, obj) -> None:
+        for h in self._delete_handlers:
+            h(obj)

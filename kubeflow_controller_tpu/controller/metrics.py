@@ -1,0 +1,67 @@
+"""Reconcile-latency metrics.
+
+The reference has no metrics at all (SURVEY.md §5: glog only); the driver's
+target metric includes reconcile p50 (BASELINE.json), so sync latency is
+recorded here and exposed via percentiles.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+
+class ReconcileMetrics:
+    def __init__(self, max_samples: int = 100_000):
+        self._lock = threading.Lock()
+        self._samples: List[float] = []
+        self._max = max_samples
+        self.syncs = 0
+        self.sync_errors = 0
+        self.creates = 0
+        self.deletes = 0
+        self.status_updates = 0
+
+    def record_sync(self, duration_s: float, error: bool = False) -> None:
+        with self._lock:
+            self.syncs += 1
+            if error:
+                self.sync_errors += 1
+            self._samples.append(duration_s)
+            if len(self._samples) > self._max:
+                self._samples = self._samples[-self._max :]
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            s = sorted(self._samples)
+            idx = min(len(s) - 1, int(q / 100.0 * len(s)))
+            return s[idx]
+
+    @property
+    def p50(self) -> float:
+        return self.percentile(50)
+
+    @property
+    def p90(self) -> float:
+        return self.percentile(90)
+
+    @property
+    def p99(self) -> float:
+        return self.percentile(99)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            n = len(self._samples)
+        return {
+            "syncs": self.syncs,
+            "sync_errors": self.sync_errors,
+            "creates": self.creates,
+            "deletes": self.deletes,
+            "status_updates": self.status_updates,
+            "reconcile_p50_s": self.p50,
+            "reconcile_p90_s": self.p90,
+            "reconcile_p99_s": self.p99,
+            "samples": n,
+        }

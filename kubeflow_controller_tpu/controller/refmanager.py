@@ -1,0 +1,128 @@
+"""Controller-ref manager: the adopt/release state machine.
+
+Semantic re-implementation of the reference's Service ref manager
+(ref: pkg/controller/ref/base.go:62-115, service.go:87-164 — itself a port of
+k8s ``PodControllerRefManager``, controller_ref_manager.go:139-238), one
+generic class for pods and services alike:
+
+- owned by another controller -> skip;
+- owned by us + selector match -> keep;
+- owned by us + no match -> release (drop our ownerRef via metadata patch),
+  unless we are being deleted;
+- orphan + match -> adopt (append controller ownerRef), gated by a **live
+  quorum read** re-checking our UID and deletionTimestamp
+  (ref: RecheckDeletionTimestamp at controller_ref_manager.go:373-385,
+  wired at pkg/controller/helper.go:137-148), memoized per claim pass
+  (ref: sync.Once at base.go:38-45).
+
+NotFound/Invalid on release are ignored: the object is gone or already
+orphaned, which is the desired end state (ref: service.go:147-161).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ..api.meta import ObjectMeta, OwnerReference, get_controller_of, matches_selector
+from ..cluster.store import APIError, NotFound
+
+
+class RefManager:
+    def __init__(
+        self,
+        client,  # typed client with patch_meta(ns, name, fn)
+        controller_meta: ObjectMeta,
+        controller_kind: str,
+        controller_api_version: str,
+        selector: Dict[str, str],
+        can_adopt: Callable[[], None],  # raises to veto adoption
+    ):
+        self._client = client
+        self.controller_meta = controller_meta
+        self.controller_kind = controller_kind
+        self.controller_api_version = controller_api_version
+        self.selector = selector
+        self._can_adopt = can_adopt
+        self._can_adopt_result: Optional[Exception] = None
+        self._can_adopt_ran = False
+
+    def _check_can_adopt(self) -> None:
+        """Memoized (the sync.Once of base.go:38-45)."""
+        if not self._can_adopt_ran:
+            self._can_adopt_ran = True
+            try:
+                self._can_adopt()
+            except Exception as e:  # remember the veto for the whole pass
+                self._can_adopt_result = e
+        if self._can_adopt_result is not None:
+            raise self._can_adopt_result
+
+    def claim(self, objects: List) -> List:
+        """Run the claim state machine over candidate objects; returns the
+        objects this controller owns after adoption/release."""
+        claimed = []
+        errors: List[Exception] = []
+        for obj in objects:
+            try:
+                if self._claim_object(obj):
+                    claimed.append(obj)
+            except APIError as e:
+                errors.append(e)
+        if errors:
+            raise errors[0]
+        return claimed
+
+    def _claim_object(self, obj) -> bool:
+        ref = get_controller_of(obj.metadata)
+        matches = matches_selector(obj.metadata.labels, self.selector)
+        if ref is not None:
+            if ref.uid != self.controller_meta.uid:
+                return False  # owned by someone else
+            if matches:
+                return True  # ours and matching: keep
+            # Ours but selector no longer matches: release (unless deleting).
+            if self.controller_meta.deletion_timestamp is not None:
+                return False
+            self._release(obj)
+            return False
+        # Orphan.
+        if self.controller_meta.deletion_timestamp is not None or not matches:
+            return False
+        if obj.metadata.deletion_timestamp is not None:
+            return False
+        self._adopt(obj)
+        return True
+
+    def _controller_ref(self) -> OwnerReference:
+        return OwnerReference(
+            api_version=self.controller_api_version,
+            kind=self.controller_kind,
+            name=self.controller_meta.name,
+            uid=self.controller_meta.uid,
+            controller=True,
+            block_owner_deletion=True,
+        )
+
+    def _adopt(self, obj) -> None:
+        self._check_can_adopt()
+
+        def patch(meta: ObjectMeta) -> None:
+            if get_controller_of(meta) is not None:
+                return  # raced: someone else adopted first
+            meta.owner_references.append(self._controller_ref())
+
+        self._client.patch_meta(obj.metadata.namespace, obj.metadata.name, patch)
+        # Reflect the adoption on the in-memory candidate so the caller's
+        # claimed list carries the ownerRef.
+        obj.metadata.owner_references.append(self._controller_ref())
+
+    def _release(self, obj) -> None:
+        uid = self.controller_meta.uid
+
+        def patch(meta: ObjectMeta) -> None:
+            meta.owner_references = [r for r in meta.owner_references if r.uid != uid]
+
+        try:
+            self._client.patch_meta(obj.metadata.namespace, obj.metadata.name, patch)
+        except NotFound:
+            pass  # already gone: fine (ref: service.go:147-153)

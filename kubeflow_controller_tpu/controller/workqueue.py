@@ -1,0 +1,154 @@
+"""Rate-limited, deduplicating work queue.
+
+Semantic re-implementation of client-go's ``workqueue`` (used at
+pkg/controller/controller.go:132, 639):
+
+- **dedup**: an item added while queued is collapsed; an item added while
+  *being processed* is re-queued when ``done`` is called (never processed
+  concurrently with itself — this is what serializes per-key syncs,
+  ref: controller.go:72-76);
+- **rate limiting**: ``add_rate_limited`` delays re-adds with per-item
+  exponential backoff (base*2^failures up to a cap — the
+  ItemExponentialFailureRateLimiter); ``forget`` resets the failure count
+  on success (ref: controller.go:236-258 Forget-on-success / requeue-on-error);
+- **shutdown**: ``shut_down`` drains waiters; ``get`` raises ShutDown.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+
+class ShutDown(Exception):
+    pass
+
+
+class ItemExponentialFailureRateLimiter:
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 300.0):
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self._failures: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def when(self, item: str) -> float:
+        with self._lock:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+        return min(self.base_delay * (2 ** n), self.max_delay)
+
+    def forget(self, item: str) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+    def num_requeues(self, item: str) -> int:
+        with self._lock:
+            return self._failures.get(item, 0)
+
+
+class RateLimitingQueue:
+    def __init__(self, rate_limiter: Optional[ItemExponentialFailureRateLimiter] = None,
+                 name: str = "tfJobs"):
+        self.name = name
+        self._limiter = rate_limiter or ItemExponentialFailureRateLimiter()
+        self._cond = threading.Condition()
+        self._queue: List[str] = []
+        self._dirty: Set[str] = set()
+        self._processing: Set[str] = set()
+        # (ready_time, seq, item) min-heap for delayed adds.
+        self._waiting: List[tuple] = []
+        self._seq = 0
+        self._shutting_down = False
+        self._delay_thread = threading.Thread(
+            target=self._delay_loop, name=f"wq-{name}-delay", daemon=True
+        )
+        self._delay_thread.start()
+
+    # -- core add/get/done ---------------------------------------------------
+
+    def add(self, item: str) -> None:
+        with self._cond:
+            if self._shutting_down or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item in self._processing:
+                return  # re-queued by done()
+            self._queue.append(item)
+            self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None) -> Optional[str]:
+        """Blocks for the next item; None on timeout; raises ShutDown when
+        the queue is drained and shutting down."""
+        with self._cond:
+            deadline = None if timeout is None else time.time() + timeout
+            while not self._queue:
+                if self._shutting_down:
+                    raise ShutDown()
+                remaining = None if deadline is None else deadline - time.time()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cond.wait(timeout=remaining)
+            item = self._queue.pop(0)
+            self._processing.add(item)
+            self._dirty.discard(item)
+            return item
+
+    def done(self, item: str) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    # -- rate limiting -------------------------------------------------------
+
+    def add_rate_limited(self, item: str) -> None:
+        self.add_after(item, self._limiter.when(item))
+
+    def add_after(self, item: str, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._cond:
+            if self._shutting_down:
+                return
+            self._seq += 1
+            heapq.heappush(self._waiting, (time.time() + delay, self._seq, item))
+            self._cond.notify()
+
+    def forget(self, item: str) -> None:
+        self._limiter.forget(item)
+
+    def num_requeues(self, item: str) -> int:
+        return self._limiter.num_requeues(item)
+
+    def _delay_loop(self) -> None:
+        while True:
+            with self._cond:
+                if self._shutting_down and not self._waiting:
+                    return
+                now = time.time()
+                while self._waiting and self._waiting[0][0] <= now:
+                    _, _, item = heapq.heappop(self._waiting)
+                    if item not in self._dirty and not self._shutting_down:
+                        self._dirty.add(item)
+                        if item not in self._processing:
+                            self._queue.append(item)
+                            self._cond.notify()
+                wait = 0.05
+                if self._waiting:
+                    wait = min(wait, max(0.0, self._waiting[0][0] - now))
+            time.sleep(wait if wait > 0 else 0.001)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def shut_down(self) -> None:
+        with self._cond:
+            self._shutting_down = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)

@@ -1,0 +1,30 @@
+"""The planner: computes ordered create/delete events from desired vs observed.
+
+Semantic successor of pkg/tensorflow/ (the reference's "TF domain logic"),
+redesigned with the reference's admitted gaps fixed (SURVEY.md §7 step 4):
+
+- templates are deep-copied before per-index mutation (vs the shared-template
+  bug at distributed.go:120-128);
+- replica identity (type, index) is first-class, so failed replicas are
+  replaced **index-preservingly** (vs design_doc.md:228-260 "cannot know
+  which task_index died");
+- services are diffed per index, so partial service sets are repaired
+  (vs the TODO at distributed.go:78-92);
+- scale-down and terminal-state cleanup emit delete events (vs the unused
+  ActionShouldDelete at types.go:39-40 and the missing PS recycling);
+- a TPU replica type materializes gang-annotated pods wired for
+  ``jax.distributed`` (net-new, BASELINE.json north star).
+"""
+
+from .types import Action, PlanEvent, Plan  # noqa: F401
+from .plan import plan_job  # noqa: F401
+from .materialize import (  # noqa: F401
+    TF_PORT,
+    coordinator_service_name,
+    make_pod,
+    make_service,
+    pod_index,
+    pods_by_index,
+    service_name,
+    services_by_index,
+)

@@ -1,0 +1,249 @@
+"""Materializers: replica (type, index) -> concrete Pod / Service objects.
+
+Successor of GetSpec/GetService/generateTFClusterSpec (ref: pkg/tensorflow/
+distributed.go:120-191), with two deliberate redesigns:
+
+1. **Deep copy before mutation.**  The reference rewrites the shared
+   template's args per index (distributed.go:123-125 "TODO: check this
+   override"), so concurrently-built replicas see each other's task_index.
+   Every materializer here starts from ``serde.deep_copy``.
+
+2. **Deterministic service names.**  The reference names services
+   ``<job>-<type>-<idx>-<rand5>`` via generateName and must thread a
+   ``serviceNames`` side table into arg generation (distributed.go:164-191).
+   Deterministic names ``<job>-<rid>-<type><idx>`` make the cluster spec a
+   pure function of the job — enabling per-index service repair and the
+   single-coordinator TPU wiring with no bookkeeping.
+
+TF PS/Worker replicas get the classic CLI contract (``--worker_hosts=…``,
+``--ps_hosts=…``, ``--job_name=…``, ``--task_index=N``, port 2222 — ref:
+distributed.go:29-32, 130-162).  TPU replicas get the ``jax.distributed``
+contract instead (SURVEY.md §2.4): one well-known coordinator service plus
+per-process env, and a ``google.com/tpu`` chip request — never
+``nvidia.com/gpu``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..api.core import ContainerPort, Pod, Service, ServicePort
+from ..api.labels import (
+    ANNOTATION_ACCELERATOR,
+    ANNOTATION_GANG_NAME,
+    ANNOTATION_GANG_SIZE,
+    LABEL_INDEX,
+    selector_for,
+)
+from ..api.core import RESOURCE_TPU
+from ..api.tfjob import (
+    ReplicaType,
+    TFJob,
+    TFReplicaSpec,
+    replica_spec_for,
+    tpu_slice_hosts,
+)
+from ..utils import serde
+
+# The reference hardcodes TF grpc port 2222 (distributed.go:31-32).
+TF_PORT = 2222
+
+# Env contract consumed by the JAX workload layer (workloads/runtime.py).
+ENV_COORDINATOR = "JAX_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "JAX_NUM_PROCESSES"
+ENV_PROCESS_ID = "JAX_PROCESS_ID"
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
+ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_TPU_ACCELERATOR = "TPU_ACCELERATOR_TYPE"
+
+
+def labels_for(job: TFJob, typ: ReplicaType) -> Dict[str, str]:
+    """The 4-label replica selector (ref: getLabels, distributed.go:224-231)."""
+    return selector_for(job.metadata.name, typ.value, job.spec.runtime_id)
+
+
+def service_name(job: TFJob, typ: ReplicaType, index: int) -> str:
+    """Deterministic, DNS-1123, <= 63 chars.
+
+    Truncation (for long job names) removes characters from the *job name*,
+    never from the runtime-id/type/index suffix — names for different
+    replicas must stay distinct.
+
+    TPU replicas share ONE headless subdomain service per slice (no index):
+    per-host DNS is ``host-<i>.<subdomain>``, the GKE TPU pattern, rather
+    than one ClusterIP service per replica as the TF PS/Worker path uses.
+    """
+    if typ == ReplicaType.TPU:
+        suffix = f"-{job.spec.runtime_id}-tpu"
+    else:
+        suffix = f"-{job.spec.runtime_id}-{typ.value.lower()}{index}"
+    base = job.metadata.name[: 63 - len(suffix)]
+    return base + suffix
+
+
+def tpu_host_dns(job: TFJob, index: int) -> str:
+    """Stable per-host DNS name: ``host-<i>.<headless-subdomain>``."""
+    return f"host-{index}.{service_name(job, ReplicaType.TPU, 0)}"
+
+
+def coordinator_service_name(job: TFJob) -> str:
+    """The jax.distributed coordinator address is host 0 of the slice's
+    headless subdomain (SURVEY.md §5 'distributed communication backend')."""
+    return tpu_host_dns(job, 0)
+
+
+def gang_name(job: TFJob) -> str:
+    return f"{job.metadata.name}-{job.spec.runtime_id}"
+
+
+def pod_index(pod: Pod) -> Optional[int]:
+    v = pod.metadata.labels.get(LABEL_INDEX)
+    try:
+        return int(v) if v is not None else None
+    except ValueError:
+        return None
+
+
+def pods_by_index(pods: List[Pod]) -> Dict[int, List[Pod]]:
+    out: Dict[int, List[Pod]] = {}
+    for p in pods:
+        i = pod_index(p)
+        if i is not None:
+            out.setdefault(i, []).append(p)
+    return out
+
+
+def services_by_index(services: List[Service]) -> Dict[int, List[Service]]:
+    out: Dict[int, List[Service]] = {}
+    for s in services:
+        v = s.metadata.labels.get(LABEL_INDEX)
+        if v is None:
+            continue
+        try:
+            out.setdefault(int(v), []).append(s)
+        except ValueError:
+            continue
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Cluster-spec generation
+# ---------------------------------------------------------------------------
+
+def tf_cluster_args(job: TFJob, typ: ReplicaType, index: int) -> List[str]:
+    """The classic TF PS/Worker CLI contract
+    (ref: generateTFClusterSpec, distributed.go:130-162)."""
+    worker = replica_spec_for(job, ReplicaType.WORKER)
+    ps = replica_spec_for(job, ReplicaType.PS)
+    worker_hosts = ",".join(
+        f"{service_name(job, ReplicaType.WORKER, i)}:{TF_PORT}"
+        for i in range(worker.replicas if worker else 0)
+    )
+    ps_hosts = ",".join(
+        f"{service_name(job, ReplicaType.PS, i)}:{TF_PORT}"
+        for i in range(ps.replicas if ps else 0)
+    )
+    args = []
+    if worker_hosts:
+        args.append(f"--worker_hosts={worker_hosts}")
+    if ps_hosts:
+        args.append(f"--ps_hosts={ps_hosts}")
+    args.append(f"--job_name={'ps' if typ == ReplicaType.PS else 'worker'}")
+    args.append(f"--task_index={index}")
+    return args
+
+
+def _dir_env(job: TFJob) -> Dict[str, str]:
+    """Plumb the spec's reserved *Dir fields into replica env — they were
+    declared and never read upstream (types.go:44-51; SURVEY.md §5
+    checkpoint/resume)."""
+    out = {}
+    if job.spec.data_dir:
+        out["DATA_DIR"] = job.spec.data_dir
+    if job.spec.model_dir:
+        out["MODEL_DIR"] = job.spec.model_dir
+    if job.spec.log_dir:
+        out["LOG_DIR"] = job.spec.log_dir
+    if job.spec.export_dir:
+        out["EXPORT_DIR"] = job.spec.export_dir
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pod / Service materializers
+# ---------------------------------------------------------------------------
+
+def make_pod(job: TFJob, spec: TFReplicaSpec, index: int) -> Pod:
+    """Build the pod for replica (spec.tf_replica_type, index)."""
+    typ = spec.tf_replica_type
+    template = serde.deep_copy(spec.template)
+    pod = Pod(metadata=template.metadata, spec=template.spec)
+    pod.metadata.namespace = job.metadata.namespace
+    pod.metadata.name = ""
+    pod.metadata.generate_name = f"{job.metadata.name}-{typ.value.lower()}-{index}-"
+    pod.metadata.labels = {**pod.metadata.labels, **labels_for(job, typ),
+                           LABEL_INDEX: str(index)}
+    c = pod.spec.containers[0]
+    for name, value in _dir_env(job).items():
+        c.set_env(name, value)
+
+    if typ in (ReplicaType.PS, ReplicaType.WORKER):
+        c.args = list(c.args) + tf_cluster_args(job, typ, index)
+        if not any(p.container_port == TF_PORT for p in c.ports):
+            c.ports.append(ContainerPort(name="tf-port", container_port=TF_PORT))
+    elif typ == ReplicaType.TPU:
+        _wire_tpu_pod(job, spec, pod, index)
+    # Local: no wiring at all (ref: local.go — single pod, no services).
+    return pod
+
+
+def _wire_tpu_pod(job: TFJob, spec: TFReplicaSpec, pod: Pod, index: int) -> None:
+    tpu = spec.tpu
+    hosts = tpu_slice_hosts(tpu)
+    coord = f"{coordinator_service_name(job)}:{tpu.coordinator_port}"
+    # Per-host DNS via the headless subdomain service: hostname + subdomain
+    # resolve as host-<i>.<subdomain>.<ns>.svc (the GKE TPU pattern).
+    pod.spec.hostname = f"host-{index}"
+    pod.spec.subdomain = service_name(job, ReplicaType.TPU, 0)
+    c = pod.spec.containers[0]
+    c.set_env(ENV_COORDINATOR, coord)
+    c.set_env(ENV_NUM_PROCESSES, str(hosts))
+    c.set_env(ENV_PROCESS_ID, str(index))
+    c.set_env(ENV_TPU_WORKER_ID, str(index))
+    c.set_env(ENV_TPU_WORKER_HOSTNAMES, ",".join(
+        tpu_host_dns(job, i) for i in range(hosts)
+    ))
+    c.set_env(ENV_TPU_ACCELERATOR, tpu.accelerator_type)
+    # Chip request: never nvidia.com/gpu (BASELINE.json north star).
+    c.resources.requests[RESOURCE_TPU] = str(tpu.chips_per_host)
+    c.resources.limits[RESOURCE_TPU] = str(tpu.chips_per_host)
+    pod.metadata.annotations = {
+        **pod.metadata.annotations,
+        ANNOTATION_GANG_NAME: gang_name(job),
+        ANNOTATION_GANG_SIZE: str(hosts),
+        ANNOTATION_ACCELERATOR: tpu.accelerator_type,
+    }
+    if pod.spec.restart_policy == "Always":
+        # A slice process that dies must fail the pod so the whole gang is
+        # rescheduled (the slice is the failure domain) — never restart
+        # in-place with a torn collective.
+        pod.spec.restart_policy = "Never"
+
+
+def make_service(job: TFJob, spec: TFReplicaSpec, index: int) -> Service:
+    typ = spec.tf_replica_type
+    svc = Service()
+    svc.metadata.name = service_name(job, typ, index)
+    svc.metadata.namespace = job.metadata.namespace
+    svc.metadata.labels = {**labels_for(job, typ), LABEL_INDEX: str(index)}
+    if typ == ReplicaType.TPU:
+        # One headless subdomain service for the whole slice: selects every
+        # gang pod (no index), clusterIP None so per-pod DNS resolves.
+        port = spec.tpu.coordinator_port if spec.tpu else TF_PORT
+        svc.spec.selector = labels_for(job, typ)
+        svc.spec.cluster_ip = "None"
+    else:
+        port = TF_PORT
+        svc.spec.selector = {**labels_for(job, typ), LABEL_INDEX: str(index)}
+    svc.spec.ports = [ServicePort(name="port", port=port, target_port=port)]
+    return svc

@@ -1,0 +1,208 @@
+"""The diff engine: desired state vs observed pods/services -> ordered events.
+
+Successor of ``Job.Action()`` (ref: pkg/tensorflow/local.go:54-77,
+distributed.go:59-117).  The reference compares bare counts; this planner
+diffs **per replica index**, which is what makes failure recovery, service
+repair, scale-down and TPU gangs expressible at all (SURVEY.md §7 step 4).
+
+Event ordering preserves the reference's invariant — services before pods,
+workers before PS (ref: distributed.go:59-117) — so that a pod's generated
+cluster spec always refers to services that already exist.
+
+Replacement policy (net-new; the reference observes failures and does
+nothing, design_doc.md:228-260):
+
+- template restartPolicy OnFailure/Always -> a Failed pod is deleted and
+  re-created **at the same index** (in-place kubelet restarts handle crash
+  loops first; a Failed phase means those were exhausted);
+- restartPolicy Never -> the failure is terminal; the planner leaves it for
+  the updater to roll up into phase=Failed;
+- a TPU gang is one failure domain: any Failed TPU pod fails the whole gang,
+  and the planner replaces the **entire** gang at once (torn collectives
+  cannot be rejoined process-by-process).
+
+Terminal jobs (Succeeded/Failed) get cleanup-only plans: active pods and all
+services are deleted — the "Recycling" step the reference declared but never
+implemented (types.go:158-160, SURVEY.md §3.5).  Terminated pods are kept as
+records, as k8s Jobs do.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..api.core import (
+    PHASE_FAILED,
+    PHASE_SUCCEEDED,
+    Pod,
+    Service,
+    is_pod_active,
+)
+from ..api.tfjob import ReplicaType, TFJob, TFJobPhase, TFReplicaSpec, tpu_slice_hosts
+from .materialize import pods_by_index, services_by_index
+from .types import Action, Plan, PlanEvent
+
+# Service/pod ordering across types (ref: distributed.go:59-117 emits worker
+# services, PS services, worker pods, PS pods — generalized here).
+_TYPE_ORDER = [ReplicaType.WORKER, ReplicaType.PS, ReplicaType.TPU, ReplicaType.LOCAL]
+
+
+def desired_replicas(spec: TFReplicaSpec) -> int:
+    """TPU replica count is the slice's host count — the TPUSpec topology is
+    the source of truth (spec.replicas must agree; validated at the API)."""
+    if spec.tf_replica_type == ReplicaType.TPU and spec.tpu is not None:
+        return tpu_slice_hosts(spec.tpu)
+    return spec.replicas
+
+
+def desired_service_indices(spec: TFReplicaSpec) -> range:
+    typ = spec.tf_replica_type
+    if typ in (ReplicaType.PS, ReplicaType.WORKER):
+        return range(desired_replicas(spec))
+    if typ == ReplicaType.TPU:
+        return range(1)  # only the coordinator service (replica 0)
+    return range(0)  # Local: no services (ref: local.go)
+
+
+def _ordered_specs(job: TFJob) -> List[TFReplicaSpec]:
+    return sorted(
+        job.spec.tf_replica_specs,
+        key=lambda s: _TYPE_ORDER.index(s.tf_replica_type),
+    )
+
+
+def plan_job(
+    job: TFJob,
+    pods_by_type: Dict[ReplicaType, List[Pod]],
+    services_by_type: Dict[ReplicaType, List[Service]],
+) -> Plan:
+    if job.status.phase in (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED):
+        return _plan_cleanup(job, pods_by_type, services_by_type)
+
+    events: List[PlanEvent] = []
+    # Pass 1: services (so cluster specs always resolve).
+    for spec in _ordered_specs(job):
+        typ = spec.tf_replica_type
+        by_idx = services_by_index(services_by_type.get(typ, []))
+        want = desired_service_indices(spec)
+        for i in want:
+            if not by_idx.get(i):
+                events.append(PlanEvent(Action.ADD_SERVICE, typ, index=i))
+        for i, svcs in sorted(by_idx.items()):
+            if i not in want:
+                for s in svcs:
+                    events.append(
+                        PlanEvent(Action.DELETE_SERVICE, typ, index=i,
+                                  name=s.metadata.name, reason="scale-down")
+                    )
+    # Pass 2: pods.
+    for spec in _ordered_specs(job):
+        events.extend(_plan_pods(spec, pods_by_type.get(spec.tf_replica_type, [])))
+    return Plan(events)
+
+
+def _plan_pods(spec: TFReplicaSpec, pods: List[Pod]) -> List[PlanEvent]:
+    typ = spec.tf_replica_type
+    n = desired_replicas(spec)
+    by_idx = pods_by_index(pods)
+    restart = (spec.template.spec.restart_policy if spec.template else "OnFailure")
+    replace_on_failure = restart in ("OnFailure", "Always")
+
+    events: List[PlanEvent] = []
+
+    if typ == ReplicaType.TPU:
+        return _plan_tpu_gang(spec, n, by_idx, replace_on_failure)
+
+    for i in range(n):
+        plist = sorted(by_idx.get(i, []), key=lambda p: p.metadata.creation_timestamp or 0)
+        active = [p for p in plist if is_pod_active(p)]
+        succeeded = any(p.status.phase == PHASE_SUCCEEDED for p in plist)
+        failed = [p for p in plist if p.status.phase == PHASE_FAILED]
+        if active:
+            # Duplicate actives at one index (e.g. after adoption): keep the
+            # oldest, delete the rest.
+            for extra in active[1:]:
+                events.append(PlanEvent(Action.DELETE_POD, typ, index=i,
+                                        name=extra.metadata.name, reason="duplicate-index"))
+            continue
+        if succeeded and typ != ReplicaType.PS:
+            continue  # this index is done (finer-grained than the
+            # count-based `Replicas - succeeded` at distributed.go:63)
+        if failed and not replace_on_failure:
+            continue  # terminal failure: updater rolls up phase=Failed
+        if failed:
+            # Index-preserving replacement: clear the failed record(s) and
+            # re-create at the same index.
+            for p in failed:
+                events.append(PlanEvent(Action.DELETE_POD, typ, index=i,
+                                        name=p.metadata.name, reason="replace-failed"))
+        events.append(PlanEvent(Action.ADD_POD, typ, index=i,
+                                reason="replace-failed" if failed else ""))
+    # Scale-down: indices beyond the desired count.
+    for i, plist in sorted(by_idx.items()):
+        if i >= n:
+            for p in plist:
+                if is_pod_active(p):
+                    events.append(PlanEvent(Action.DELETE_POD, typ, index=i,
+                                            name=p.metadata.name, reason="scale-down"))
+    return events
+
+
+def _plan_tpu_gang(
+    spec: TFReplicaSpec, n: int, by_idx: Dict[int, List[Pod]], replace_on_failure: bool
+) -> List[PlanEvent]:
+    """All-or-nothing: if any member failed (and we replace), tear down every
+    surviving member and re-create the full gang."""
+    events: List[PlanEvent] = []
+    any_failed = any(
+        p.status.phase == PHASE_FAILED for plist in by_idx.values() for p in plist
+    )
+    all_succeeded = n > 0 and all(
+        any(p.status.phase == PHASE_SUCCEEDED for p in by_idx.get(i, [])) for i in range(n)
+    )
+    if all_succeeded:
+        return events
+    if any_failed and replace_on_failure:
+        # Delete EVERY member record — including Succeeded ones — so stale
+        # results cannot mix with the replacement gang's (a fresh gang is a
+        # fresh jax.distributed world; old per-host outcomes are void).
+        for i, plist in sorted(by_idx.items()):
+            for p in plist:
+                events.append(PlanEvent(Action.DELETE_POD, ReplicaType.TPU, index=i,
+                                        name=p.metadata.name, reason="gang-replace"))
+        for i in range(n):
+            events.append(PlanEvent(Action.ADD_POD, ReplicaType.TPU, index=i,
+                                    reason="gang-replace"))
+        return events
+    if any_failed:
+        return events  # terminal: updater fails the job
+    for i in range(n):
+        plist = by_idx.get(i, [])
+        if not any(is_pod_active(p) or p.status.phase == PHASE_SUCCEEDED for p in plist):
+            events.append(PlanEvent(Action.ADD_POD, ReplicaType.TPU, index=i))
+    # Scale-down beyond the slice host count.
+    for i, plist in sorted(by_idx.items()):
+        if i >= n:
+            for p in plist:
+                if is_pod_active(p):
+                    events.append(PlanEvent(Action.DELETE_POD, ReplicaType.TPU, index=i,
+                                            name=p.metadata.name, reason="scale-down"))
+    return events
+
+
+def _plan_cleanup(
+    job: TFJob,
+    pods_by_type: Dict[ReplicaType, List[Pod]],
+    services_by_type: Dict[ReplicaType, List[Service]],
+) -> Plan:
+    events: List[PlanEvent] = []
+    for typ, svcs in services_by_type.items():
+        for s in svcs:
+            events.append(PlanEvent(Action.DELETE_SERVICE, typ,
+                                    name=s.metadata.name, reason="recycle"))
+    for typ, pods in pods_by_type.items():
+        for p in pods:
+            if is_pod_active(p):
+                events.append(PlanEvent(Action.DELETE_POD, typ,
+                                        name=p.metadata.name, reason="recycle"))
+    return Plan(events)

@@ -1,0 +1,55 @@
+"""Planner event vocabulary.
+
+Successor of the reference's Event{ActionType, Number} (ref: pkg/tensorflow/
+types.go:19-43).  Differences by design: events carry (replica_type, index)
+identity instead of bare counts, and deletion is implemented (the reference
+declared ActionShouldDelete and never produced or handled it,
+types.go:39-40).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..api.tfjob import ReplicaType
+
+
+class Action(str, enum.Enum):
+    ADD_POD = "AddPod"
+    ADD_SERVICE = "AddService"
+    DELETE_POD = "DeletePod"
+    DELETE_SERVICE = "DeleteService"
+
+
+@dataclass
+class PlanEvent:
+    action: Action
+    replica_type: ReplicaType
+    index: int = 0
+    # For deletes: the concrete object name observed in the cluster.
+    name: str = ""
+    reason: str = ""
+
+
+@dataclass
+class Plan:
+    """Ordered event list plus bookkeeping the controller needs up-front."""
+
+    events: List[PlanEvent]
+    # Creations/deletions to expect before the next sync (expectations cache).
+    creations: int = 0
+    deletions: int = 0
+
+    def __post_init__(self):
+        self.creations = sum(
+            1 for e in self.events if e.action in (Action.ADD_POD, Action.ADD_SERVICE)
+        )
+        self.deletions = sum(
+            1 for e in self.events if e.action in (Action.DELETE_POD, Action.DELETE_SERVICE)
+        )
+
+    @property
+    def empty(self) -> bool:
+        return not self.events

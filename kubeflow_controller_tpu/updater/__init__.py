@@ -1,0 +1,3 @@
+"""Status rollup (ref: pkg/controller/updater/)."""
+
+from .status import compute_status, set_condition, should_update  # noqa: F401

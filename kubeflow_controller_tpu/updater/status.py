@@ -1,0 +1,237 @@
+"""Status computation: observed pods -> TFJobStatus.
+
+Successor of pkg/controller/updater/ (local.go, distributed.go, util.go),
+with the declared-but-dead status surface populated (SURVEY.md §7 step 5):
+
+- per-type replica status: the ``TFReplicasStates`` histogram (ref:
+  util.go:28-61) **plus** ``State`` and ``PodNames``, which upstream never
+  fills (types.go:163-171);
+- conditions Scheduled/Ready/Recovering/Recycling, which upstream declares
+  and never sets (types.go:154-161; TODOs at local.go:56-57,
+  distributed.go:52-53);
+- ``Failed`` phase, which upstream declares and never sets (types.go:129-132):
+  a replica whose pod fails under restartPolicy=Never is terminal;
+- chief termination policy (types.go:81-89, unimplemented upstream):
+  when a chief is named, its success/failure decides the job, replacing the
+  hardcoded "all workers succeeded" rule (distributed.go:51-55);
+- proper change detection via semantic comparison, instead of rebuilding
+  status every sync because "deep-equal is missing" (local.go:65-79).
+
+``compute_status`` is a pure function (job + observed pods in, fresh status
+out) so it unit-tests exactly like the reference's updaters (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..api.core import (
+    PHASE_FAILED,
+    PHASE_PENDING,
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+    Pod,
+    is_pod_active,
+)
+from ..api.tfjob import (
+    ChiefSpec,
+    ReplicaType,
+    TFJob,
+    TFJobCondition,
+    TFJobConditionType,
+    TFJobPhase,
+    TFJobStatus,
+    TFReplicaState,
+    TFReplicaStatus,
+)
+from ..planner.materialize import pods_by_index
+from ..planner.plan import desired_replicas
+from ..utils import serde
+
+_POD_TO_REPLICA_STATE = {
+    PHASE_PENDING: TFReplicaState.WAITING,
+    PHASE_RUNNING: TFReplicaState.RUNNING,
+    PHASE_SUCCEEDED: TFReplicaState.SUCCEEDED,
+    PHASE_FAILED: TFReplicaState.FAILED,
+}
+
+
+def _replica_state(pod: Pod) -> TFReplicaState:
+    return _POD_TO_REPLICA_STATE.get(pod.status.phase, TFReplicaState.UNKNOWN)
+
+
+def _aggregate_state(states: List[TFReplicaState], desired: int) -> TFReplicaState:
+    """One state summarizing a replica set: Failed dominates, then Running,
+    Waiting, Succeeded (all done), Unknown."""
+    if TFReplicaState.FAILED in states:
+        return TFReplicaState.FAILED
+    if TFReplicaState.RUNNING in states:
+        return TFReplicaState.RUNNING
+    if TFReplicaState.WAITING in states or len(states) < desired:
+        return TFReplicaState.WAITING
+    if states and all(s == TFReplicaState.SUCCEEDED for s in states):
+        return TFReplicaState.SUCCEEDED
+    return TFReplicaState.UNKNOWN
+
+
+def set_condition(
+    status: TFJobStatus,
+    ctype: TFJobConditionType,
+    value: bool,
+    reason: str = "",
+    message: str = "",
+    now: Optional[float] = None,
+) -> None:
+    sval = "True" if value else "False"
+    for c in status.conditions:
+        if c.type == ctype:
+            if c.status != sval:
+                c.status = sval
+                c.last_transition_time = now if now is not None else time.time()
+            c.reason = reason
+            c.message = message
+            return
+    status.conditions.append(
+        TFJobCondition(
+            type=ctype, status=sval, reason=reason, message=message,
+            last_transition_time=now if now is not None else time.time(),
+        )
+    )
+
+
+def _find_chief(job: TFJob) -> Optional[ChiefSpec]:
+    for s in job.spec.tf_replica_specs:
+        if s.termination_policy and s.termination_policy.chief:
+            return s.termination_policy.chief
+    return None
+
+
+def compute_status(
+    job: TFJob,
+    pods_by_type: Dict[ReplicaType, List[Pod]],
+    now: Optional[float] = None,
+) -> TFJobStatus:
+    status = serde.deep_copy(job.status)
+    prev_phase = status.phase
+
+    # -- per-type rollups (replaces updater/util.go:28-61) --
+    status.tf_replica_statuses = []
+    index_done: Dict[ReplicaType, Dict[int, str]] = {}
+    any_running = False
+    any_terminal_failure = False
+    recovering = False
+    scheduled = True
+    ready = True
+
+    for spec in job.spec.tf_replica_specs:
+        typ = spec.tf_replica_type
+        desired = desired_replicas(spec)
+        pods = pods_by_type.get(typ, [])
+        restart = spec.template.spec.restart_policy if spec.template else "OnFailure"
+        replace_on_failure = restart in ("OnFailure", "Always")
+
+        hist: Dict[TFReplicaState, int] = {}
+        states: List[TFReplicaState] = []
+        for p in pods:
+            st = _replica_state(p)
+            states.append(st)
+            hist[st] = hist.get(st, 0) + 1
+            if st == TFReplicaState.RUNNING:
+                any_running = True
+
+        by_idx = pods_by_index(pods)
+        done: Dict[int, str] = {}
+        for i in range(desired):
+            plist = by_idx.get(i, [])
+            if any(p.status.phase == PHASE_SUCCEEDED for p in plist):
+                done[i] = PHASE_SUCCEEDED
+            failed = [p for p in plist if p.status.phase == PHASE_FAILED]
+            has_active = any(is_pod_active(p) for p in plist)
+            if failed and not replace_on_failure and not has_active and i not in done:
+                done[i] = PHASE_FAILED
+                any_terminal_failure = True
+            elif failed and replace_on_failure and not has_active:
+                recovering = True
+            if not plist:
+                scheduled = False
+            if not any(p.status.phase == PHASE_RUNNING for p in plist) and i not in done:
+                ready = False
+        index_done[typ] = done
+
+        status.tf_replica_statuses.append(
+            TFReplicaStatus(
+                type=typ,
+                state=_aggregate_state(states, desired),
+                pod_names=sorted(p.metadata.name for p in pods),
+                tf_replicas_states=hist,
+            )
+        )
+
+    # -- phase (replaces local.go:53-63 / distributed.go:47-59) --
+    chief = _find_chief(job)
+    phase = prev_phase
+    if chief is not None:
+        ctyp = ReplicaType(chief.tf_replica_name)
+        outcome = index_done.get(ctyp, {}).get(chief.tf_replica_index)
+        if outcome == PHASE_SUCCEEDED:
+            phase = TFJobPhase.SUCCEEDED
+        elif outcome == PHASE_FAILED:
+            phase = TFJobPhase.FAILED
+        else:
+            phase = _running_or_pending(prev_phase, any_running)
+    else:
+        # Default rule: the job succeeds when every *deciding* replica index
+        # succeeded.  PS replicas never decide (they run forever — ref:
+        # distributed.go:51-55, mnist_replica.py:121-122).
+        deciding = [
+            s for s in job.spec.tf_replica_specs if s.tf_replica_type != ReplicaType.PS
+        ]
+        if any_terminal_failure:
+            phase = TFJobPhase.FAILED
+        elif deciding and all(
+            len(index_done.get(s.tf_replica_type, {})) == desired_replicas(s)
+            and all(v == PHASE_SUCCEEDED for v in index_done[s.tf_replica_type].values())
+            for s in deciding
+        ):
+            phase = TFJobPhase.SUCCEEDED
+        else:
+            phase = _running_or_pending(prev_phase, any_running)
+    # Terminal phases are sticky.
+    if prev_phase in (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED):
+        phase = prev_phase
+    status.phase = phase
+
+    # -- conditions (populating types.go:154-161) --
+    terminal = phase in (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED)
+    set_condition(status, TFJobConditionType.SCHEDULED, scheduled,
+                  reason="AllReplicasScheduled" if scheduled else "WaitingForReplicas", now=now)
+    set_condition(status, TFJobConditionType.READY, ready and not terminal,
+                  reason="AllReplicasReady" if ready else "ReplicasNotReady", now=now)
+    set_condition(status, TFJobConditionType.RECOVERING, recovering,
+                  reason="ReplacingFailedReplicas" if recovering else "", now=now)
+    has_active = any(
+        is_pod_active(p) for pods in pods_by_type.values() for p in pods
+    )
+    set_condition(status, TFJobConditionType.RECYCLING, terminal and has_active,
+                  reason="ReclaimingReplicas" if terminal and has_active else "", now=now)
+    return status
+
+
+def _running_or_pending(prev: TFJobPhase, any_running: bool) -> TFJobPhase:
+    if any_running or prev == TFJobPhase.RUNNING:
+        return TFJobPhase.RUNNING
+    return TFJobPhase.PENDING
+
+
+def should_update(old: TFJobStatus, new: TFJobStatus) -> bool:
+    """Semantic change detection — the deep-equal the reference lacked
+    (local.go:65-79 rebuilds and always updates).  Transition timestamps are
+    ignored so a no-op recompute never writes."""
+    return _strip_times(serde.to_dict(old)) != _strip_times(serde.to_dict(new))
+
+
+def _strip_times(d: dict) -> dict:
+    for c in d.get("conditions", []) or []:
+        c.pop("lastTransitionTime", None)
+    return d

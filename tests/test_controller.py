@@ -1,0 +1,170 @@
+"""Controller integration tests: the whole spine — API -> queue -> sync ->
+planner -> create -> watch -> status — against the fake cluster + kubelet
+(SURVEY.md §7 "minimum end-to-end slice" and beyond)."""
+
+import time
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import (
+    PHASE_FAILED,
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+    Container,
+    PodTemplateSpec,
+)
+from kubeflow_controller_tpu.api.labels import LABEL_INDEX, LABEL_JOB_TYPE
+from kubeflow_controller_tpu.api.meta import ObjectMeta
+from kubeflow_controller_tpu.api.tfjob import (
+    ReplicaType,
+    TFJob,
+    TFJobPhase,
+    TFReplicaSpec,
+    TPUSpec,
+)
+from kubeflow_controller_tpu.cluster import (
+    Cluster,
+    FakeKubelet,
+    PhasePolicy,
+    TPUInventory,
+    TPUSlice,
+)
+from kubeflow_controller_tpu.controller import Controller
+
+
+def mk_template(restart="OnFailure"):
+    t = PodTemplateSpec()
+    t.spec.containers.append(Container(name="tensorflow", image="img"))
+    t.spec.restart_policy = restart
+    return t
+
+
+def mk_job(name, *types_and_replicas, restart="OnFailure"):
+    job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+    for typ, n in types_and_replicas:
+        spec = TFReplicaSpec(replicas=n, tf_replica_type=typ, template=mk_template(restart))
+        if typ == ReplicaType.TPU:
+            spec.tpu = TPUSpec(accelerator_type="v5e-8", chips_per_host=4)
+        job.spec.tf_replica_specs.append(spec)
+    return job
+
+
+def wait_for(fn, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+@pytest.fixture
+def rig():
+    """cluster + controller + kubelet, fast clocks."""
+    cluster = Cluster()
+    inventory = TPUInventory([TPUSlice("slice-0", "v5e-8", num_hosts=2)])
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=0.05), inventory=inventory)
+    ctrl = Controller(cluster, inventory=inventory, resync_period_s=0.5)
+    kubelet.start()
+    ctrl.run(threadiness=2)
+    yield cluster, ctrl, kubelet, inventory
+    ctrl.stop()
+    kubelet.stop()
+
+
+def phase_of(cluster, name):
+    return cluster.tfjobs.get("default", name).status.phase
+
+
+def test_local_job_to_succeeded(rig):
+    cluster, ctrl, _, _ = rig
+    cluster.tfjobs.create(mk_job("local-mnist", (ReplicaType.LOCAL, 1)))
+    wait_for(lambda: phase_of(cluster, "local-mnist") == TFJobPhase.SUCCEEDED)
+    pods = cluster.pods.list("default")
+    assert len(pods) == 1
+    assert pods[0].metadata.labels[LABEL_JOB_TYPE] == "Local"
+    assert cluster.services.list("default") == []
+    # runtime_id persisted on the spec.
+    assert cluster.tfjobs.get("default", "local-mnist").spec.runtime_id
+
+
+def test_distributed_job_full_lifecycle(rig):
+    cluster, ctrl, _, _ = rig
+    cluster.tfjobs.create(mk_job("dist-mnist", (ReplicaType.PS, 2), (ReplicaType.WORKER, 4)))
+    # All 6 pods + 6 services materialize.
+    wait_for(lambda: len(cluster.pods.list("default")) == 6)
+    wait_for(lambda: len(cluster.services.list("default")) == 6)
+    # Workers succeed (kubelet), PS runs forever -> job Succeeded.
+    wait_for(lambda: phase_of(cluster, "dist-mnist") == TFJobPhase.SUCCEEDED)
+    # Recycle: PS pods and services get torn down after success.
+    wait_for(lambda: cluster.services.list("default") == [])
+    wait_for(lambda: all(
+        p.status.phase == PHASE_SUCCEEDED for p in cluster.pods.list("default")
+    ))
+    # Worker pods kept as records.
+    assert len(cluster.pods.list("default")) == 4
+    # No duplicate creations: exactly 6 pods were ever created (4 kept + 2 PS
+    # recycled) — check events.
+    creates = [e for e in ctrl.recorder.all_events() if e.reason == "SuccessfulCreate"]
+    assert sum(e.count for e in creates) == 12  # 6 pods + 6 services
+
+
+def test_failed_worker_recovers_index(rig):
+    cluster, ctrl, kubelet, _ = rig
+    kubelet.policy.fail_once = set()  # configure below after names known
+    cluster.tfjobs.create(mk_job("recover", (ReplicaType.WORKER, 2)))
+    wait_for(lambda: len(cluster.pods.list("default")) == 2)
+    # Fail index 0's pod manually (kubelet would have succeeded it).
+    target = next(p for p in cluster.pods.list("default")
+                  if p.metadata.labels[LABEL_INDEX] == "0")
+    kubelet.set_phase("default", target.metadata.name, PHASE_FAILED)
+    # Controller deletes the failed pod and creates a replacement at index 0.
+    def replaced():
+        pods = [p for p in cluster.pods.list("default")
+                if p.metadata.labels[LABEL_INDEX] == "0"]
+        return pods and all(p.metadata.name != target.metadata.name for p in pods)
+    wait_for(replaced)
+    wait_for(lambda: phase_of(cluster, "recover") == TFJobPhase.SUCCEEDED)
+
+
+def test_tpu_gang_job_to_succeeded(rig):
+    cluster, ctrl, _, inventory = rig
+    cluster.tfjobs.create(mk_job("tpu-train", (ReplicaType.TPU, 2)))
+    wait_for(lambda: phase_of(cluster, "tpu-train") == TFJobPhase.SUCCEEDED)
+    # Gang released: slice free again.
+    assert all(not s.bound_gang for s in inventory.slices.values())
+    # Exactly one (coordinator) service was created.
+    svc_creates = [e for e in ctrl.recorder.all_events()
+                   if e.reason == "SuccessfulCreate" and "service" in e.message]
+    assert sum(e.count for e in svc_creates) == 1
+
+
+def test_invalid_job_rejected_via_event(rig):
+    cluster, ctrl, _, _ = rig
+    bad = mk_job("bad", (ReplicaType.WORKER, 1))
+    bad.spec.tf_replica_specs[0].template = None
+    cluster.tfjobs.create(bad)
+    wait_for(lambda: any(
+        e.reason == "InvalidSpec" for e in ctrl.recorder.events_for("default", "bad")
+    ))
+    assert cluster.pods.list("default") == []
+
+
+def test_job_delete_cascades_children(rig):
+    cluster, ctrl, _, _ = rig
+    cluster.tfjobs.create(mk_job("doomed", (ReplicaType.PS, 1), (ReplicaType.WORKER, 1)))
+    wait_for(lambda: len(cluster.pods.list("default")) == 2)
+    cluster.tfjobs.delete("default", "doomed")
+    wait_for(lambda: cluster.pods.list("default") == [])
+    wait_for(lambda: cluster.services.list("default") == [])
+
+
+def test_reconcile_metrics_recorded(rig):
+    cluster, ctrl, _, _ = rig
+    cluster.tfjobs.create(mk_job("metrics", (ReplicaType.LOCAL, 1)))
+    wait_for(lambda: phase_of(cluster, "metrics") == TFJobPhase.SUCCEEDED)
+    snap = ctrl.metrics.snapshot()
+    assert snap["syncs"] > 0
+    assert snap["reconcile_p50_s"] >= 0.0
+    assert snap["creates"] >= 1

@@ -1,0 +1,233 @@
+"""Workqueue, expectations, informer, refmanager unit tests — the vendored-
+primitive semantics of SURVEY.md §2.3, which are load-bearing for the
+reconcile loop."""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import Pod
+from kubeflow_controller_tpu.api.meta import ObjectMeta, OwnerReference
+from kubeflow_controller_tpu.api.tfjob import TFJob
+from kubeflow_controller_tpu.cluster import Cluster
+from kubeflow_controller_tpu.controller import (
+    ControllerExpectations,
+    RateLimitingQueue,
+    RefManager,
+    SharedInformer,
+    ShutDown,
+)
+
+
+def drain(q, n, timeout=2.0):
+    out = []
+    for _ in range(n):
+        item = q.get(timeout=timeout)
+        if item is None:
+            break
+        out.append(item)
+    return out
+
+
+# ---- workqueue ----
+
+def test_queue_dedups_while_queued():
+    q = RateLimitingQueue()
+    q.add("a")
+    q.add("a")
+    q.add("b")
+    assert q.get() == "a"
+    assert q.get() == "b"
+    q.done("a")
+    q.done("b")
+    assert q.get(timeout=0.05) is None
+
+
+def test_queue_requeues_item_added_during_processing():
+    q = RateLimitingQueue()
+    q.add("a")
+    item = q.get()
+    q.add("a")  # while processing: must not be delivered concurrently
+    assert q.get(timeout=0.05) is None
+    q.done(item)
+    assert q.get(timeout=0.5) == "a"
+
+
+def test_queue_rate_limited_backoff_and_forget():
+    q = RateLimitingQueue()
+    q.add_rate_limited("x")  # failure #1: ~base delay
+    assert q.get(timeout=1.0) == "x"
+    q.done("x")
+    assert q.num_requeues("x") == 1
+    q.forget("x")
+    assert q.num_requeues("x") == 0
+
+
+def test_queue_shutdown_raises():
+    q = RateLimitingQueue()
+    results = []
+
+    def worker():
+        try:
+            q.get()
+        except ShutDown:
+            results.append("shutdown")
+
+    t = threading.Thread(target=worker)
+    t.start()
+    time.sleep(0.05)
+    q.shut_down()
+    t.join(timeout=1)
+    assert results == ["shutdown"]
+
+
+# ---- expectations ----
+
+def test_expectations_lifecycle():
+    e = ControllerExpectations()
+    key = "ns/job"
+    assert e.satisfied_expectations(key)  # no record -> sync
+    e.expect_creations(key, 2)
+    assert not e.satisfied_expectations(key)
+    e.creation_observed(key)
+    assert not e.satisfied_expectations(key)
+    e.creation_observed(key)
+    assert e.satisfied_expectations(key)
+    # Over-observation (watch races) keeps it satisfied.
+    e.creation_observed(key)
+    assert e.satisfied_expectations(key)
+
+
+def test_expectations_ttl_expiry():
+    e = ControllerExpectations(ttl_s=0.05)
+    e.expect_creations("k", 5)
+    assert not e.satisfied_expectations("k")
+    time.sleep(0.08)
+    assert e.satisfied_expectations("k")  # expired -> sync anyway
+
+
+def test_expectations_combined_and_lower():
+    e = ControllerExpectations()
+    e.expect("k", adds=1, dels=1)
+    assert not e.satisfied_expectations("k")
+    e.lower_expectations("k", add_delta=1)
+    assert not e.satisfied_expectations("k")
+    e.deletion_observed("k")
+    assert e.satisfied_expectations("k")
+
+
+# ---- informer ----
+
+def test_informer_sync_add_update_delete_and_cache():
+    c = Cluster()
+    c.tfjobs.create(TFJob(metadata=ObjectMeta(name="pre", namespace="ns")))
+    adds, updates, deletes = [], [], []
+    inf = SharedInformer(c.tfjobs, resync_period_s=0, name="t")
+    inf.add_event_handler(
+        on_add=lambda o: adds.append(o.metadata.name),
+        on_update=lambda o, n: updates.append(n.metadata.name),
+        on_delete=lambda o: deletes.append(o.metadata.name),
+    )
+    assert not inf.has_synced
+    inf.start()
+    assert inf.has_synced
+    assert adds == ["pre"]
+    assert inf.get("ns", "pre") is not None
+
+    c.tfjobs.create(TFJob(metadata=ObjectMeta(name="post", namespace="ns")))
+    j = c.tfjobs.get("ns", "post")
+    c.tfjobs.update(j)
+    c.tfjobs.delete("ns", "post")
+
+    deadline = time.time() + 2
+    while time.time() < deadline and "post" not in deletes:
+        time.sleep(0.01)
+    assert "post" in adds and "post" in updates and "post" in deletes
+    assert inf.get("ns", "post") is None
+    inf.stop()
+
+
+def test_informer_resync_refires_updates():
+    c = Cluster()
+    c.tfjobs.create(TFJob(metadata=ObjectMeta(name="j", namespace="ns")))
+    updates = []
+    inf = SharedInformer(c.tfjobs, resync_period_s=0.05, name="t")
+    inf.add_event_handler(on_update=lambda o, n: updates.append(n.metadata.resource_version))
+    inf.start()
+    time.sleep(0.2)
+    inf.stop()
+    assert len(updates) >= 2
+    # Resync delivers old == new (same resourceVersion).
+    assert all(rv == updates[0] for rv in updates)
+
+
+# ---- ref manager ----
+
+def _mk_owner(c, name="job"):
+    return c.tfjobs.create(TFJob(metadata=ObjectMeta(name=name, namespace="ns")))
+
+
+def _mk_pod(c, name, labels=None, owner=None):
+    p = Pod(metadata=ObjectMeta(name=name, namespace="ns", labels=labels or {}))
+    p.spec.containers = []
+    if owner is not None:
+        p.metadata.owner_references.append(
+            OwnerReference(kind="TFJob", name=owner.metadata.name,
+                           uid=owner.metadata.uid, controller=True,
+                           block_owner_deletion=True)
+        )
+    return c.pods.create(p)
+
+
+def _mgr(c, owner, selector):
+    def can_adopt():
+        fresh = c.tfjobs.get("ns", owner.metadata.name)
+        if fresh.metadata.uid != owner.metadata.uid:
+            raise RuntimeError("uid changed")
+
+    return RefManager(c.pods, owner.metadata, "TFJob", "kubeflow.caicloud.io/v1alpha1",
+                      selector, can_adopt)
+
+
+def test_refmanager_adopts_matching_orphan():
+    c = Cluster()
+    owner = _mk_owner(c)
+    _mk_pod(c, "orphan", labels={"app": "x"})
+    claimed = _mgr(c, owner, {"app": "x"}).claim(c.pods.list("ns"))
+    assert [p.metadata.name for p in claimed] == ["orphan"]
+    stored = c.pods.get("ns", "orphan")
+    assert stored.metadata.owner_references[0].uid == owner.metadata.uid
+
+
+def test_refmanager_releases_owned_nonmatching():
+    c = Cluster()
+    owner = _mk_owner(c)
+    _mk_pod(c, "mine", labels={"app": "other"}, owner=owner)
+    claimed = _mgr(c, owner, {"app": "x"}).claim(c.pods.list("ns"))
+    assert claimed == []
+    assert c.pods.get("ns", "mine").metadata.owner_references == []
+
+
+def test_refmanager_skips_foreign_and_keeps_matching():
+    c = Cluster()
+    owner = _mk_owner(c, "a")
+    other = _mk_owner(c, "b")
+    _mk_pod(c, "foreign", labels={"app": "x"}, owner=other)
+    _mk_pod(c, "mine", labels={"app": "x"}, owner=owner)
+    claimed = _mgr(c, owner, {"app": "x"}).claim(c.pods.list("ns"))
+    assert [p.metadata.name for p in claimed] == ["mine"]
+    # Foreign pod untouched.
+    assert c.pods.get("ns", "foreign").metadata.owner_references[0].uid == other.metadata.uid
+
+
+def test_refmanager_adoption_vetoed_on_stale_uid():
+    c = Cluster()
+    owner = _mk_owner(c)
+    _mk_pod(c, "orphan", labels={"app": "x"})
+    # Delete and recreate the job under the same name: new UID.
+    c.tfjobs.delete("ns", "job")
+    _mk_owner(c)
+    with pytest.raises(RuntimeError, match="uid changed"):
+        _mgr(c, owner, {"app": "x"}).claim(c.pods.list("ns"))
+    assert c.pods.get("ns", "orphan").metadata.owner_references == []

@@ -1,0 +1,310 @@
+"""Planner unit tests: pure-function diff engine (the reference's richest
+domain logic, pkg/tensorflow/distributed.go, rebuilt index-aware)."""
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import (
+    PHASE_FAILED,
+    PHASE_PENDING,
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+    Container,
+    Pod,
+    PodTemplateSpec,
+)
+from kubeflow_controller_tpu.api.labels import (
+    ANNOTATION_GANG_NAME,
+    ANNOTATION_GANG_SIZE,
+    LABEL_INDEX,
+)
+from kubeflow_controller_tpu.api.meta import ObjectMeta
+from kubeflow_controller_tpu.api.tfjob import (
+    ReplicaType,
+    TFJob,
+    TFJobPhase,
+    TFReplicaSpec,
+    TPUSpec,
+)
+from kubeflow_controller_tpu.planner import (
+    Action,
+    make_pod,
+    make_service,
+    plan_job,
+    service_name,
+)
+from kubeflow_controller_tpu.planner.materialize import (
+    ENV_COORDINATOR,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+    TF_PORT,
+)
+
+
+def mk_template(restart="OnFailure"):
+    t = PodTemplateSpec()
+    t.spec.containers.append(Container(name="tensorflow", image="img"))
+    t.spec.restart_policy = restart
+    return t
+
+
+def mk_job(*types_and_replicas, restart="OnFailure", tpu=None):
+    job = TFJob(metadata=ObjectMeta(name="dist-mnist", namespace="default", uid="u1"))
+    job.spec.runtime_id = "abc12"
+    for typ, n in types_and_replicas:
+        spec = TFReplicaSpec(replicas=n, tf_replica_type=typ, template=mk_template(restart))
+        if typ == ReplicaType.TPU:
+            spec.tpu = tpu or TPUSpec(accelerator_type="v5e-8", chips_per_host=4)
+        job.spec.tf_replica_specs.append(spec)
+    return job
+
+
+def mk_pod(job, typ, index, phase=PHASE_RUNNING, name=None, ts=1.0):
+    p = make_pod(job, next(s for s in job.spec.tf_replica_specs if s.tf_replica_type == typ), index)
+    p.metadata.name = name or f"{typ.value.lower()}-{index}-{phase.lower()}"
+    p.metadata.creation_timestamp = ts
+    p.status.phase = phase
+    return p
+
+
+def actions(plan):
+    return [(e.action, e.replica_type, e.index) for e in plan.events]
+
+
+# ---- fresh job: everything created, services before pods, workers before PS ----
+
+def test_fresh_distributed_job_ordering():
+    job = mk_job((ReplicaType.PS, 2), (ReplicaType.WORKER, 4))
+    plan = plan_job(job, {}, {})
+    acts = actions(plan)
+    # 4 worker svcs, 2 ps svcs, 4 worker pods, 2 ps pods (ref ordering).
+    assert acts[:4] == [(Action.ADD_SERVICE, ReplicaType.WORKER, i) for i in range(4)]
+    assert acts[4:6] == [(Action.ADD_SERVICE, ReplicaType.PS, i) for i in range(2)]
+    assert acts[6:10] == [(Action.ADD_POD, ReplicaType.WORKER, i) for i in range(4)]
+    assert acts[10:] == [(Action.ADD_POD, ReplicaType.PS, i) for i in range(2)]
+    assert plan.creations == 12 and plan.deletions == 0
+
+
+def test_local_job_single_pod_no_services():
+    job = mk_job((ReplicaType.LOCAL, 1))
+    plan = plan_job(job, {}, {})
+    assert actions(plan) == [(Action.ADD_POD, ReplicaType.LOCAL, 0)]
+
+
+def test_steady_state_empty_plan():
+    job = mk_job((ReplicaType.PS, 1), (ReplicaType.WORKER, 2))
+    pods = {
+        ReplicaType.WORKER: [mk_pod(job, ReplicaType.WORKER, i) for i in range(2)],
+        ReplicaType.PS: [mk_pod(job, ReplicaType.PS, 0)],
+    }
+    svcs = {
+        ReplicaType.WORKER: [make_service(job, job.spec.tf_replica_specs[1], i) for i in range(2)],
+        ReplicaType.PS: [make_service(job, job.spec.tf_replica_specs[0], 0)],
+    }
+    for lst in svcs.values():
+        for s in lst:
+            s.metadata.labels[LABEL_INDEX]  # sanity: index label present
+    assert plan_job(job, pods, svcs).empty
+
+
+# ---- repair paths the reference cannot do ----
+
+def test_failed_worker_replaced_at_same_index():
+    job = mk_job((ReplicaType.WORKER, 2))
+    pods = {ReplicaType.WORKER: [
+        mk_pod(job, ReplicaType.WORKER, 0, PHASE_RUNNING),
+        mk_pod(job, ReplicaType.WORKER, 1, PHASE_FAILED, name="w1-dead"),
+    ]}
+    svcs = {ReplicaType.WORKER: [make_service(job, job.spec.tf_replica_specs[0], i) for i in range(2)]}
+    plan = plan_job(job, pods, svcs)
+    assert actions(plan) == [
+        (Action.DELETE_POD, ReplicaType.WORKER, 1),
+        (Action.ADD_POD, ReplicaType.WORKER, 1),
+    ]
+    assert plan.events[0].name == "w1-dead"
+    assert all(e.reason == "replace-failed" for e in plan.events)
+
+
+def test_failed_worker_restart_never_not_replaced():
+    job = mk_job((ReplicaType.WORKER, 1), restart="Never")
+    pods = {ReplicaType.WORKER: [mk_pod(job, ReplicaType.WORKER, 0, PHASE_FAILED)]}
+    assert [a for a in actions(plan_job(job, pods, {})) if a[0] == Action.ADD_POD] == []
+
+
+def test_partial_service_repair():
+    # The reference only creates services when count==0 (distributed.go:78-92).
+    job = mk_job((ReplicaType.WORKER, 3))
+    svcs = {ReplicaType.WORKER: [make_service(job, job.spec.tf_replica_specs[0], 1)]}
+    pods = {ReplicaType.WORKER: [mk_pod(job, ReplicaType.WORKER, i) for i in range(3)]}
+    plan = plan_job(job, pods, svcs)
+    assert actions(plan) == [
+        (Action.ADD_SERVICE, ReplicaType.WORKER, 0),
+        (Action.ADD_SERVICE, ReplicaType.WORKER, 2),
+    ]
+
+
+def test_scale_down_deletes_extras():
+    job = mk_job((ReplicaType.WORKER, 1))
+    pods = {ReplicaType.WORKER: [
+        mk_pod(job, ReplicaType.WORKER, 0),
+        mk_pod(job, ReplicaType.WORKER, 1, name="extra"),
+    ]}
+    svcs = {ReplicaType.WORKER: [make_service(job, job.spec.tf_replica_specs[0], i) for i in range(2)]}
+    plan = plan_job(job, pods, svcs)
+    acts = actions(plan)
+    assert (Action.DELETE_POD, ReplicaType.WORKER, 1) in acts
+    assert (Action.DELETE_SERVICE, ReplicaType.WORKER, 1) in acts
+
+
+def test_duplicate_index_keeps_oldest():
+    job = mk_job((ReplicaType.WORKER, 1))
+    old = mk_pod(job, ReplicaType.WORKER, 0, name="old", ts=1.0)
+    new = mk_pod(job, ReplicaType.WORKER, 0, name="new", ts=2.0)
+    svcs = {ReplicaType.WORKER: [make_service(job, job.spec.tf_replica_specs[0], 0)]}
+    plan = plan_job(job, {ReplicaType.WORKER: [new, old]}, svcs)
+    assert [(e.action, e.name) for e in plan.events] == [(Action.DELETE_POD, "new")]
+
+
+def test_succeeded_worker_index_not_recreated():
+    job = mk_job((ReplicaType.WORKER, 2))
+    pods = {ReplicaType.WORKER: [
+        mk_pod(job, ReplicaType.WORKER, 0, PHASE_SUCCEEDED),
+    ]}
+    svcs = {ReplicaType.WORKER: [make_service(job, job.spec.tf_replica_specs[0], i) for i in range(2)]}
+    plan = plan_job(job, pods, svcs)
+    assert actions(plan) == [(Action.ADD_POD, ReplicaType.WORKER, 1)]
+
+
+# ---- terminal cleanup (the missing "Recycling") ----
+
+def test_succeeded_job_recycles_ps_and_services():
+    job = mk_job((ReplicaType.PS, 1), (ReplicaType.WORKER, 1))
+    job.status.phase = TFJobPhase.SUCCEEDED
+    pods = {
+        ReplicaType.WORKER: [mk_pod(job, ReplicaType.WORKER, 0, PHASE_SUCCEEDED)],
+        ReplicaType.PS: [mk_pod(job, ReplicaType.PS, 0, PHASE_RUNNING, name="ps-alive")],
+    }
+    svcs = {ReplicaType.PS: [make_service(job, job.spec.tf_replica_specs[0], 0)]}
+    plan = plan_job(job, pods, svcs)
+    kinds = {(e.action, e.name) for e in plan.events}
+    assert (Action.DELETE_POD, "ps-alive") in kinds
+    assert any(a == Action.DELETE_SERVICE for a, _ in kinds)
+    # The succeeded worker pod is kept as a record.
+    assert not any(n == pods[ReplicaType.WORKER][0].metadata.name for _, n in kinds)
+
+
+# ---- TPU gang ----
+
+def test_tpu_fresh_gang_coordinator_service_and_pods():
+    job = mk_job((ReplicaType.TPU, 2))
+    plan = plan_job(job, {}, {})
+    acts = actions(plan)
+    assert acts[0] == (Action.ADD_SERVICE, ReplicaType.TPU, 0)  # coordinator only
+    assert acts[1:] == [(Action.ADD_POD, ReplicaType.TPU, i) for i in range(2)]
+
+
+def test_tpu_gang_failure_replaces_whole_gang():
+    job = mk_job((ReplicaType.TPU, 2))
+    pods = {ReplicaType.TPU: [
+        mk_pod(job, ReplicaType.TPU, 0, PHASE_RUNNING, name="h0"),
+        mk_pod(job, ReplicaType.TPU, 1, PHASE_FAILED, name="h1"),
+    ]}
+    svcs = {ReplicaType.TPU: [make_service(job, job.spec.tf_replica_specs[0], 0)]}
+    plan = plan_job(job, pods, svcs)
+    acts = actions(plan)
+    deletes = [e.name for e in plan.events if e.action == Action.DELETE_POD]
+    assert sorted(deletes) == ["h0", "h1"]  # survivor torn down too
+    assert [a for a in acts if a[0] == Action.ADD_POD] == [
+        (Action.ADD_POD, ReplicaType.TPU, 0), (Action.ADD_POD, ReplicaType.TPU, 1)
+    ]
+
+
+# ---- materializers ----
+
+def test_make_pod_tf_cluster_args_and_template_isolation():
+    job = mk_job((ReplicaType.PS, 2), (ReplicaType.WORKER, 4))
+    worker_spec = job.spec.tf_replica_specs[1]
+    p1 = make_pod(job, worker_spec, 1)
+    p3 = make_pod(job, worker_spec, 3)
+    a1 = p1.spec.containers[0].args
+    assert f"--task_index=1" in a1 and "--job_name=worker" in a1
+    assert f"--task_index=3" in p3.spec.containers[0].args
+    # Shared template untouched (vs distributed.go:120-128).
+    assert worker_spec.template.spec.containers[0].args == []
+    wh = next(a for a in a1 if a.startswith("--worker_hosts="))
+    hosts = wh.split("=", 1)[1].split(",")
+    assert len(hosts) == 4
+    assert hosts[0] == f"{service_name(job, ReplicaType.WORKER, 0)}:{TF_PORT}"
+    ph = next(a for a in a1 if a.startswith("--ps_hosts="))
+    assert len(ph.split("=", 1)[1].split(",")) == 2
+    assert p1.metadata.labels[LABEL_INDEX] == "1"
+    assert p1.metadata.generate_name.startswith("dist-mnist-worker-1-")
+
+
+def test_make_pod_tpu_env_and_resources():
+    job = mk_job((ReplicaType.TPU, 2))
+    spec = job.spec.tf_replica_specs[0]
+    pod = make_pod(job, spec, 1)
+    env = {e.name: e.value for e in pod.spec.containers[0].env}
+    assert env[ENV_NUM_PROCESSES] == "2"
+    assert env[ENV_PROCESS_ID] == "1"
+    subdomain = service_name(job, ReplicaType.TPU, 0)
+    assert env[ENV_COORDINATOR] == f"host-0.{subdomain}:8476"
+    assert env["TPU_WORKER_HOSTNAMES"] == f"host-0.{subdomain},host-1.{subdomain}"
+    assert pod.spec.hostname == "host-1" and pod.spec.subdomain == subdomain
+    assert pod.spec.containers[0].resources.requests["google.com/tpu"] == "4"
+    assert "nvidia.com/gpu" not in pod.spec.containers[0].resources.requests
+    assert pod.metadata.annotations[ANNOTATION_GANG_SIZE] == "2"
+    assert pod.metadata.annotations[ANNOTATION_GANG_NAME] == "dist-mnist-abc12"
+    # Always is coerced to Never for slice processes.
+    assert pod.spec.restart_policy in ("Never", "OnFailure")
+
+
+def test_make_service_deterministic_and_selector():
+    job = mk_job((ReplicaType.WORKER, 1))
+    svc = make_service(job, job.spec.tf_replica_specs[0], 0)
+    assert svc.metadata.name == "dist-mnist-abc12-worker0"
+    assert svc.spec.selector[LABEL_INDEX] == "0"
+    assert svc.spec.ports[0].port == TF_PORT
+
+
+def test_service_name_truncation_preserves_identity():
+    # A 63-char job name must still yield distinct per-index service names.
+    job = mk_job((ReplicaType.WORKER, 2))
+    job.metadata.name = "j" * 63
+    names = {service_name(job, ReplicaType.WORKER, i) for i in range(12)}
+    assert len(names) == 12
+    assert all(len(n) <= 63 for n in names)
+    assert all(n.endswith(f"-abc12-worker{i}") for i, n in enumerate(sorted(
+        names, key=lambda x: int(x.rsplit("worker", 1)[1])
+    )))
+
+
+def test_tpu_headless_service():
+    job = mk_job((ReplicaType.TPU, 2))
+    svc = make_service(job, job.spec.tf_replica_specs[0], 0)
+    assert svc.metadata.name == "dist-mnist-abc12-tpu"
+    assert svc.spec.cluster_ip == "None"  # headless
+    assert LABEL_INDEX not in svc.spec.selector  # selects the whole gang
+    assert svc.spec.ports[0].port == 8476
+
+
+def test_tpu_gang_replace_clears_succeeded_records():
+    job = mk_job((ReplicaType.TPU, 2))
+    pods = {ReplicaType.TPU: [
+        mk_pod(job, ReplicaType.TPU, 0, PHASE_SUCCEEDED, name="h0-done"),
+        mk_pod(job, ReplicaType.TPU, 1, PHASE_FAILED, name="h1-dead"),
+    ]}
+    svcs = {ReplicaType.TPU: [make_service(job, job.spec.tf_replica_specs[0], 0)]}
+    plan = plan_job(job, pods, svcs)
+    deletes = sorted(e.name for e in plan.events if e.action == Action.DELETE_POD)
+    # The Succeeded record is torn down too: a fresh gang is a fresh world.
+    assert deletes == ["h0-done", "h1-dead"]
+
+
+def test_dir_fields_plumbed_to_env():
+    job = mk_job((ReplicaType.LOCAL, 1))
+    job.spec.model_dir = "/ckpt"
+    job.spec.data_dir = "/data"
+    pod = make_pod(job, job.spec.tf_replica_specs[0], 0)
+    env = {e.name: e.value for e in pod.spec.containers[0].env}
+    assert env["MODEL_DIR"] == "/ckpt" and env["DATA_DIR"] == "/data"

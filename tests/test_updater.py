@@ -1,0 +1,139 @@
+"""Status updater tests: phase rules, histograms, conditions, chief policy."""
+
+from kubeflow_controller_tpu.api.core import (
+    PHASE_FAILED,
+    PHASE_PENDING,
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+)
+from kubeflow_controller_tpu.api.tfjob import (
+    ChiefSpec,
+    ReplicaType,
+    TerminationPolicySpec,
+    TFJobConditionType,
+    TFJobPhase,
+    TFReplicaState,
+)
+from kubeflow_controller_tpu.checker import check_health
+from kubeflow_controller_tpu.checker.health import Health
+from kubeflow_controller_tpu.updater import compute_status, should_update
+
+from test_planner import mk_job, mk_pod
+
+
+def cond(status, ctype):
+    return next(c for c in status.conditions if c.type == ctype)
+
+
+def test_fresh_job_pending_and_unscheduled():
+    job = mk_job((ReplicaType.WORKER, 2))
+    st = compute_status(job, {})
+    assert st.phase == TFJobPhase.PENDING
+    assert cond(st, TFJobConditionType.SCHEDULED).status == "False"
+
+
+def test_running_then_succeeded_workers_ps_ignored():
+    job = mk_job((ReplicaType.PS, 1), (ReplicaType.WORKER, 2))
+    pods = {
+        ReplicaType.WORKER: [mk_pod(job, ReplicaType.WORKER, i, PHASE_RUNNING) for i in range(2)],
+        ReplicaType.PS: [mk_pod(job, ReplicaType.PS, 0, PHASE_RUNNING)],
+    }
+    st = compute_status(job, pods)
+    assert st.phase == TFJobPhase.RUNNING
+    assert cond(st, TFJobConditionType.READY).status == "True"
+    # All workers done; PS still running -> Succeeded (ref: distributed.go:51-55).
+    pods[ReplicaType.WORKER] = [
+        mk_pod(job, ReplicaType.WORKER, i, PHASE_SUCCEEDED) for i in range(2)
+    ]
+    job.status = st
+    st2 = compute_status(job, pods)
+    assert st2.phase == TFJobPhase.SUCCEEDED
+    assert cond(st2, TFJobConditionType.RECYCLING).status == "True"  # PS alive
+
+
+def test_histograms_states_and_podnames_populated():
+    job = mk_job((ReplicaType.WORKER, 2))
+    pods = {ReplicaType.WORKER: [
+        mk_pod(job, ReplicaType.WORKER, 0, PHASE_RUNNING, name="w0"),
+        mk_pod(job, ReplicaType.WORKER, 1, PHASE_PENDING, name="w1"),
+    ]}
+    st = compute_status(job, pods)
+    rs = st.tf_replica_statuses[0]
+    assert rs.type == ReplicaType.WORKER
+    assert rs.tf_replicas_states == {TFReplicaState.RUNNING: 1, TFReplicaState.WAITING: 1}
+    assert rs.pod_names == ["w0", "w1"]  # never populated upstream
+    assert rs.state == TFReplicaState.RUNNING
+
+
+def test_terminal_failure_sets_failed_phase():
+    # restartPolicy=Never + Failed pod -> phase Failed (never set upstream).
+    job = mk_job((ReplicaType.WORKER, 1), restart="Never")
+    pods = {ReplicaType.WORKER: [mk_pod(job, ReplicaType.WORKER, 0, PHASE_FAILED)]}
+    st = compute_status(job, pods)
+    assert st.phase == TFJobPhase.FAILED
+
+
+def test_replaceable_failure_is_recovering_not_failed():
+    job = mk_job((ReplicaType.WORKER, 1), restart="OnFailure")
+    pods = {ReplicaType.WORKER: [mk_pod(job, ReplicaType.WORKER, 0, PHASE_FAILED)]}
+    st = compute_status(job, pods)
+    assert st.phase in (TFJobPhase.PENDING, TFJobPhase.RUNNING)
+    assert cond(st, TFJobConditionType.RECOVERING).status == "True"
+
+
+def test_chief_policy_decides_termination():
+    job = mk_job((ReplicaType.PS, 1), (ReplicaType.WORKER, 3))
+    job.spec.tf_replica_specs[1].termination_policy = TerminationPolicySpec(
+        chief=ChiefSpec(tf_replica_name="Worker", tf_replica_index=0)
+    )
+    pods = {
+        ReplicaType.WORKER: [
+            mk_pod(job, ReplicaType.WORKER, 0, PHASE_SUCCEEDED),
+            mk_pod(job, ReplicaType.WORKER, 1, PHASE_RUNNING),
+            mk_pod(job, ReplicaType.WORKER, 2, PHASE_RUNNING),
+        ],
+        ReplicaType.PS: [mk_pod(job, ReplicaType.PS, 0, PHASE_RUNNING)],
+    }
+    st = compute_status(job, pods)
+    assert st.phase == TFJobPhase.SUCCEEDED  # chief done, others still running
+
+
+def test_terminal_phase_sticky():
+    job = mk_job((ReplicaType.WORKER, 1))
+    job.status.phase = TFJobPhase.SUCCEEDED
+    st = compute_status(job, {})
+    assert st.phase == TFJobPhase.SUCCEEDED
+
+
+def test_should_update_semantic_comparison():
+    job = mk_job((ReplicaType.WORKER, 1))
+    pods = {ReplicaType.WORKER: [mk_pod(job, ReplicaType.WORKER, 0, PHASE_RUNNING)]}
+    st1 = compute_status(job, pods)
+    job.status = st1
+    st2 = compute_status(job, pods)
+    assert not should_update(st1, st2)  # no-op recompute writes nothing
+    pods[ReplicaType.WORKER][0].status.phase = PHASE_SUCCEEDED
+    st3 = compute_status(job, pods)
+    assert should_update(st1, st3)
+
+
+def test_tpu_job_succeeds_when_all_hosts_done():
+    job = mk_job((ReplicaType.TPU, 2))
+    pods = {ReplicaType.TPU: [
+        mk_pod(job, ReplicaType.TPU, i, PHASE_SUCCEEDED) for i in range(2)
+    ]}
+    st = compute_status(job, pods)
+    assert st.phase == TFJobPhase.SUCCEEDED
+
+
+# ---- health checker ----
+
+def test_health_report():
+    job = mk_job((ReplicaType.WORKER, 2))
+    pods = {ReplicaType.WORKER: [mk_pod(job, ReplicaType.WORKER, 0, PHASE_RUNNING)]}
+    h = check_health(job, pods)
+    rh = h.replicas[ReplicaType.WORKER]
+    assert rh.running == 1 and rh.missing_indices == [1]
+    assert rh.health == Health.DEGRADED
+    pods[ReplicaType.WORKER].append(mk_pod(job, ReplicaType.WORKER, 1, PHASE_RUNNING))
+    assert check_health(job, pods).overall == Health.HEALTHY
