@@ -1,0 +1,3 @@
+"""Process shell (ref: cmd/controller/main.go)."""
+
+from .main import main  # noqa: F401

@@ -1,0 +1,206 @@
+"""tfjob-controller CLI — the process shell.
+
+Flag parity with the reference binary (ref: cmd/controller/main.go:76-78:
+``-kubeconfig``, ``-master``, ``-version``; version/GitSHA banner at
+main.go:85-88; two workers at main.go:70; 30s resync at main.go:62-63),
+adapted to this framework's substrate: with no cluster available the
+controller runs against the in-memory API server (``--in-memory``), applying
+job manifests from files, driving them with the fake kubelet (optionally
+executing container commands as real local subprocesses), and reporting
+status/events/metrics.
+
+Usage:
+    python -m kubeflow_controller_tpu.cli version
+    python -m kubeflow_controller_tpu.cli run --in-memory \
+        --manifests examples/jobs/ --execute --until-done
+    python -m kubeflow_controller_tpu.cli validate -f job.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import pathlib
+import sys
+import time
+from typing import List
+
+import yaml
+
+from .. import GIT_SHA, __version__
+from ..api.tfjob import TFJob, TFJobPhase, validate_tfjob, ValidationError
+from ..cluster import Cluster, FakeKubelet, PhasePolicy, TPUInventory, TPUSlice
+from ..controller import Controller
+from ..utils import serde
+from .signals import setup_signal_handler
+
+logger = logging.getLogger("kubeflow_controller_tpu.cli")
+
+
+def load_manifests(paths: List[str]) -> List[TFJob]:
+    jobs = []
+    files: List[pathlib.Path] = []
+    for p in paths:
+        path = pathlib.Path(p)
+        if path.is_dir():
+            files.extend(sorted(path.glob("*.y*ml")) + sorted(path.glob("*.json")))
+        else:
+            files.append(path)
+    for f in files:
+        with open(f) as fh:
+            docs = list(yaml.safe_load_all(fh)) if f.suffix != ".json" else [json.load(fh)]
+        for doc in docs:
+            if not doc:
+                continue
+            job = serde.from_dict(TFJob, doc)
+            jobs.append(job)
+    return jobs
+
+
+def cmd_version(_args) -> int:
+    print(f"tfjob-controller version {__version__}, git sha {GIT_SHA}, "
+          f"python {sys.version.split()[0]}")
+    return 0
+
+
+def cmd_validate(args) -> int:
+    rc = 0
+    try:
+        jobs = load_manifests(args.files)
+    except (OSError, yaml.YAMLError) as e:
+        print(f"error loading manifests: {e}", file=sys.stderr)
+        return 1
+    for job in jobs:
+        name = job.metadata.name or job.metadata.generate_name or "<unnamed>"
+        try:
+            validate_tfjob(job)
+            print(f"{name}: OK")
+        except ValidationError as e:
+            print(f"{name}: INVALID: {e}")
+            rc = 1
+    return rc
+
+
+def cmd_run(args) -> int:
+    logging.basicConfig(
+        level=logging.DEBUG if args.v >= 4 else logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    )
+    if not args.in_memory:
+        print("error: only --in-memory mode is available in this environment "
+              "(no kubeconfig/cluster support compiled in); pass --in-memory",
+              file=sys.stderr)
+        return 2
+
+    stop = setup_signal_handler()
+    cluster = Cluster()
+    slices = [
+        TPUSlice(f"slice-{i}", args.tpu_slice_type, num_hosts=args.tpu_slice_hosts)
+        for i in range(args.tpu_slices)
+    ]
+    inventory = TPUInventory(slices)
+    kubelet = FakeKubelet(
+        cluster,
+        policy=PhasePolicy(run_s=args.sim_run_seconds),
+        inventory=inventory,
+        execute=args.execute,
+    )
+    ctrl = Controller(cluster, inventory=inventory, resync_period_s=args.resync_period)
+    kubelet.start()
+    ctrl.run(threadiness=args.threadiness)
+    logger.info("tfjob-controller %s (git %s) started: %d workers, %.0fs resync",
+                __version__, GIT_SHA, args.threadiness, args.resync_period)
+
+    try:
+        jobs = load_manifests(args.manifests) if args.manifests else []
+    except (OSError, yaml.YAMLError) as e:
+        print(f"error loading manifests: {e}", file=sys.stderr)
+        ctrl.stop()
+        kubelet.stop()
+        return 1
+    for job in jobs:
+        created = cluster.tfjobs.create(job)
+        logger.info("applied TFJob %s/%s", created.metadata.namespace or "default",
+                    created.metadata.name)
+
+    terminal = (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED)
+    try:
+        while not stop.is_set():
+            time.sleep(0.2)
+            if args.until_done and jobs:
+                all_jobs = cluster.tfjobs.list()
+                if all_jobs and all(j.status.phase in terminal for j in all_jobs):
+                    break
+    finally:
+        ctrl.stop()
+        kubelet.stop()
+
+    rc = 0
+    for j in cluster.tfjobs.list():
+        key = f"{j.metadata.namespace}/{j.metadata.name}"
+        print(f"{key}: phase={j.status.phase.value}")
+        for rs in j.status.tf_replica_statuses:
+            hist = {k.value: v for k, v in rs.tf_replicas_states.items()}
+            print(f"  {rs.type.value}: state={rs.state.value} pods={len(rs.pod_names)} {hist}")
+        if args.events:
+            for e in ctrl.recorder.events_for(j.metadata.namespace, j.metadata.name):
+                print(f"  event {e.type} {e.reason}: {e.message} (x{e.count})")
+        if j.status.phase == TFJobPhase.FAILED:
+            rc = 3
+    snap = ctrl.metrics.snapshot()
+    print(f"metrics: syncs={snap['syncs']} errors={snap['sync_errors']} "
+          f"creates={snap['creates']} deletes={snap['deletes']} "
+          f"reconcile_p50={snap['reconcile_p50_s'] * 1e3:.2f}ms "
+          f"p99={snap['reconcile_p99_s'] * 1e3:.2f}ms")
+    return rc
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tfjob-controller",
+                                description="TPU-native TFJob controller")
+    p.add_argument("-version", "--version", action="store_true",
+                   help="print version and exit (ref flag parity)")
+    p.add_argument("-kubeconfig", "--kubeconfig", default="",
+                   help="path to a kubeconfig (reserved; real-cluster mode "
+                        "is not compiled into this build)")
+    p.add_argument("-master", "--master", default="",
+                   help="API server address override (reserved, as above)")
+    sub = p.add_subparsers(dest="cmd")
+
+    sub.add_parser("version", help="print version and exit")
+
+    v = sub.add_parser("validate", help="validate TFJob manifests")
+    v.add_argument("-f", "--files", nargs="+", required=True)
+
+    r = sub.add_parser("run", help="run the controller")
+    r.add_argument("--in-memory", action="store_true",
+                   help="run against the in-memory cluster substrate")
+    r.add_argument("--manifests", nargs="*", default=[],
+                   help="TFJob manifest files/dirs to apply at startup")
+    r.add_argument("--execute", action="store_true",
+                   help="kubelet executes container commands as local processes")
+    r.add_argument("--until-done", action="store_true",
+                   help="exit once every applied job reaches a terminal phase")
+    r.add_argument("--events", action="store_true", help="print per-job events at exit")
+    r.add_argument("--threadiness", type=int, default=2, help="sync workers (ref: 2)")
+    r.add_argument("--resync-period", type=float, default=30.0, help="informer resync (ref: 30s)")
+    r.add_argument("--sim-run-seconds", type=float, default=0.05,
+                   help="simulated pod run time when not using --execute")
+    r.add_argument("--tpu-slices", type=int, default=1, help="fake TPU slices in inventory")
+    r.add_argument("--tpu-slice-type", default="v5e-8")
+    r.add_argument("--tpu-slice-hosts", type=int, default=2)
+    r.add_argument("-v", type=int, default=0, help="log verbosity (glog parity)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.version or args.cmd == "version":
+        return cmd_version(args)
+    if args.cmd == "validate":
+        return cmd_validate(args)
+    if args.cmd == "run":
+        return cmd_run(args)
+    build_parser().print_help()
+    return 0

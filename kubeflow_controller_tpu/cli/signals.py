@@ -1,0 +1,24 @@
+"""Signal handling (ref: pkg/util/signals/signals.go:29-43): the first
+SIGINT/SIGTERM requests a graceful stop; the second exits immediately."""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+
+
+def setup_signal_handler() -> threading.Event:
+    stop = threading.Event()
+    state = {"hits": 0}
+
+    def handler(signum, frame):
+        state["hits"] += 1
+        if state["hits"] == 1:
+            stop.set()
+        else:
+            sys.exit(1)
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, handler)
+    return stop
