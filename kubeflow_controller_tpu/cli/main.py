@@ -68,7 +68,9 @@ def cmd_validate(args) -> int:
     rc = 0
     try:
         jobs = load_manifests(args.files)
-    except (OSError, yaml.YAMLError) as e:
+    except (OSError, yaml.YAMLError, ValueError, TypeError) as e:
+        # ValueError/TypeError: serde-level schema errors (bad enum, wrong
+        # field shape) — the very input class `validate` exists to diagnose.
         print(f"error loading manifests: {e}", file=sys.stderr)
         return 1
     for job in jobs:
@@ -112,20 +114,18 @@ def cmd_run(args) -> int:
     logger.info("tfjob-controller %s (git %s) started: %d workers, %.0fs resync",
                 __version__, GIT_SHA, args.threadiness, args.resync_period)
 
-    try:
-        jobs = load_manifests(args.manifests) if args.manifests else []
-    except (OSError, yaml.YAMLError) as e:
-        print(f"error loading manifests: {e}", file=sys.stderr)
-        ctrl.stop()
-        kubelet.stop()
-        return 1
-    for job in jobs:
-        created = cluster.tfjobs.create(job)
-        logger.info("applied TFJob %s/%s", created.metadata.namespace or "default",
-                    created.metadata.name)
-
     terminal = (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED)
+    jobs = []
     try:
+        try:
+            jobs = load_manifests(args.manifests) if args.manifests else []
+        except (OSError, yaml.YAMLError, ValueError, TypeError) as e:
+            print(f"error loading manifests: {e}", file=sys.stderr)
+            return 1
+        for job in jobs:
+            created = cluster.tfjobs.create(job)
+            logger.info("applied TFJob %s/%s", created.metadata.namespace or "default",
+                        created.metadata.name)
         while not stop.is_set():
             time.sleep(0.2)
             if args.until_done and jobs:

@@ -1,0 +1,58 @@
+"""TPU-native parallelism layer: device meshes, sharding rules, collectives,
+and sequence parallelism (ring attention).
+
+The reference's only distribution strategy is grpc parameter-server data
+parallelism wired by host lists (ref: pkg/tensorflow/distributed.go:130-162).
+The TPU-native equivalent (SURVEY.md §2.4) is SPMD over a
+``jax.sharding.Mesh`` with XLA collectives riding ICI: the controller
+gang-schedules slice hosts and injects coordinator env; this package turns
+that env into a mesh and sharding rules the workload layer trains under.
+"""
+
+from .mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_PIPELINE,
+    AXIS_SEQUENCE,
+    AXIS_TENSOR,
+    MeshSpec,
+    build_mesh,
+    mesh_shape_for,
+)
+from .sharding import (
+    ShardingRules,
+    DEFAULT_RULES,
+    logical_to_pspec,
+    shard_pytree_specs,
+    with_logical_constraint,
+)
+from .collectives import (
+    all_gather,
+    psum,
+    psum_scatter,
+    ring_permute,
+)
+from .ring import ring_attention
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_EXPERT",
+    "AXIS_FSDP",
+    "AXIS_PIPELINE",
+    "AXIS_SEQUENCE",
+    "AXIS_TENSOR",
+    "MeshSpec",
+    "build_mesh",
+    "mesh_shape_for",
+    "ShardingRules",
+    "DEFAULT_RULES",
+    "logical_to_pspec",
+    "shard_pytree_specs",
+    "with_logical_constraint",
+    "all_gather",
+    "psum",
+    "psum_scatter",
+    "ring_permute",
+    "ring_attention",
+]

@@ -1,0 +1,50 @@
+"""Collective wrappers used inside ``shard_map`` regions.
+
+Thin, named layers over lax collectives so kernels and tests share one
+vocabulary.  These ride ICI when the mesh axis lives within a slice — the
+TPU-native replacement for the reference's grpc data plane (SURVEY.md §5
+"distributed communication backend").
+"""
+
+from __future__ import annotations
+
+from typing import Union, Tuple
+
+from jax import lax
+
+AxisName = Union[str, Tuple[str, ...]]
+
+
+def psum(x, axis: AxisName):
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: AxisName):
+    return lax.pmean(x, axis)
+
+
+def all_gather(x, axis: AxisName, *, gather_axis: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def psum_scatter(x, axis: AxisName, *, scatter_axis: int = 0, tiled: bool = True):
+    """reduce_scatter: the memory-efficient half of an all-reduce; grads in
+    FSDP take this path so each shard only materializes its slice."""
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=tiled)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    return lax.axis_size(axis)
+
+
+def ring_permute(x, axis: str, *, shift: int = 1):
+    """Send x to the next device on a ring over ``axis`` (ppermute).  The
+    building block of ring attention and ring all-reduce: N-1 neighbor hops
+    keep every transfer on the nearest ICI link."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm=perm)

@@ -1,0 +1,110 @@
+"""Device mesh construction.
+
+The mesh is the TPU-native replacement for the reference's cluster spec
+(ref: pkg/tensorflow/distributed.go:130-162): instead of naming grpc
+endpoints, parallelism is expressed as named mesh axes over which XLA
+inserts collectives.  Axis order is chosen so the innermost (fastest-
+varying) axes carry the highest-bandwidth traffic: tensor/sequence
+parallelism ride ICI within a slice; data parallelism is outermost and may
+cross DCN between slices.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical mesh axis names, outermost first.
+AXIS_PIPELINE = "pp"   # pipeline stages (inter-slice / DCN friendly)
+AXIS_DATA = "dp"       # pure data parallelism (replicated params)
+AXIS_FSDP = "fsdp"     # data parallelism with sharded params/optimizer
+AXIS_EXPERT = "ep"     # expert parallelism for MoE layers
+AXIS_SEQUENCE = "sp"   # sequence/context parallelism (ring attention)
+AXIS_TENSOR = "tp"     # tensor (megatron-style) parallelism, innermost/ICI
+
+AXIS_ORDER = (AXIS_PIPELINE, AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQUENCE, AXIS_TENSOR)
+
+
+@dataclass
+class MeshSpec:
+    """Declarative mesh: axis name -> size.  At most one axis may be -1
+    ("absorb all remaining devices")."""
+
+    pp: int = 1
+    dp: int = 1
+    fsdp: int = -1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def sizes(self) -> Dict[str, int]:
+        return {
+            AXIS_PIPELINE: self.pp,
+            AXIS_DATA: self.dp,
+            AXIS_FSDP: self.fsdp,
+            AXIS_EXPERT: self.ep,
+            AXIS_SEQUENCE: self.sp,
+            AXIS_TENSOR: self.tp,
+        }
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        """Fill the -1 axis so the product equals ``n_devices``."""
+        sizes = self.sizes()
+        bad = {a: s for a, s in sizes.items() if s != -1 and s < 1}
+        if bad:
+            raise ValueError(f"mesh axis sizes must be >= 1 (or -1 to infer): {bad}")
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {wild}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} wants {fixed} devices but {n_devices} are available"
+            )
+        return sizes
+
+
+def mesh_shape_for(n_devices: int, spec: Optional[MeshSpec] = None) -> Tuple[Tuple[str, int], ...]:
+    """Resolved (axis, size) pairs in canonical order, dropping nothing —
+    size-1 axes are kept so PartitionSpecs stay valid on any topology."""
+    spec = spec or MeshSpec()
+    sizes = spec.resolve(n_devices)
+    return tuple((a, sizes[a]) for a in AXIS_ORDER)
+
+
+def build_mesh(
+    spec: Optional[MeshSpec] = None,
+    devices: Optional[List[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` over all (or the given) devices.
+
+    Keeps every canonical axis (size 1 where unused) so model code can
+    always refer to dp/fsdp/tp/sp/pp/ep without caring which are active —
+    the same PartitionSpec compiles from 1 chip to a full pod.
+    """
+    devs = devices if devices is not None else jax.devices()
+    shape = mesh_shape_for(len(devs), spec)
+    axis_names = tuple(a for a, _ in shape)
+    dims = tuple(s for _, s in shape)
+    arr = np.asarray(devs, dtype=object).reshape(dims)
+    return Mesh(arr, axis_names)
+
+
+def data_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """Axes over which the global batch is split (dp + fsdp)."""
+    return tuple(a for a in (AXIS_DATA, AXIS_FSDP) if a in mesh.axis_names)
+
+
+def data_parallel_size(mesh: Mesh) -> int:
+    return math.prod(mesh.shape[a] for a in data_axes(mesh))

@@ -1,0 +1,120 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+Long-context is first-class here (the reference scales only by worker
+count — SURVEY.md §2.4).  The global sequence is split over the ``sp``
+mesh axis; each device keeps its query block resident and K/V blocks
+rotate around the ring via ``ppermute`` (one nearest-neighbor ICI hop per
+step), while a flash-style running softmax (max ``m``, denominator ``l``,
+numerator ``o``) accumulates the exact result — memory stays
+O(seq_local²) instead of O(seq²), communication overlaps compute.
+
+Layout: [batch, seq, heads, head_dim] with seq sharded over ``sp``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from .collectives import ring_permute
+from .mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQUENCE, AXIS_TENSOR
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, m, l, o, *, q_start, kv_start, causal, scale):
+    """Fold one K/V block into the running (m, l, o) accumulators."""
+    # [B, H, Tq, Tk] scores in f32 regardless of input dtype.
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        q_pos = q_start + jnp.arange(tq)[:, None]
+        kv_pos = kv_start + jnp.arange(tk)[None, :]
+        s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+    m_blk = jnp.max(s, axis=-1, keepdims=True)           # [B,H,Tq,1]
+    m_new = jnp.maximum(m, m_blk)
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new)                               # [B,H,Tq,Tk]
+    l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    o_new = o * correction + pv
+    return m_new, l_new, o_new
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
+    """Body run per-device under shard_map; q/k/v are local shards."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    q_start = idx * t_local
+
+    m = jnp.full((b, h, t_local, 1), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((b, h, t_local, 1), dtype=jnp.float32)
+    o = jnp.zeros((b, h, t_local, d), dtype=jnp.float32)
+
+    # n is static (mesh size), so unroll in Python: the last step folds its
+    # block without a trailing dead rotation.
+    k_cur, v_cur = k, v
+    for s in range(n):
+        # After s forward rotations device idx holds the block that started
+        # on device (idx - s) mod n.
+        kv_start = ((idx - s) % n) * t_local
+        m, l, o = _block_attend(
+            q, k_cur, v_cur, m, l, o,
+            q_start=q_start, kv_start=kv_start, causal=causal, scale=scale,
+        )
+        if s < n - 1:
+            k_cur = ring_permute(k_cur, axis_name)
+            v_cur = ring_permute(v_cur, axis_name)
+    out = o / jnp.maximum(l, 1e-30)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # back to BTHD
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    axis_name: str = AXIS_SEQUENCE,
+    batch_axes=(AXIS_DATA, AXIS_FSDP),
+    head_axis: str = AXIS_TENSOR,
+) -> jax.Array:
+    """Exact attention with q/k/v of global shape [B, T, H, D], T sharded
+    over ``axis_name``.  Safe when the axis has size 1 (plain attention)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    spec = P(batch_axes, axis_name, head_axis, None)
+    fn = shard_map(
+        functools.partial(
+            _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def attention_reference(q, k, v, *, causal: bool = True, scale: Optional[float] = None):
+    """Naive O(T²) attention in f32 — the numerics oracle for tests."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -1,0 +1,123 @@
+"""Logical-axis sharding rules.
+
+Model code names *logical* axes ("batch", "embed", "mlp", ...); a rule
+table maps them onto mesh axes.  Changing the parallelism strategy is a
+rule-table edit, not a model edit — the scaling-book recipe: pick a mesh,
+annotate shardings, let XLA insert the collectives.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import AXIS_DATA, AXIS_EXPERT, AXIS_FSDP, AXIS_SEQUENCE, AXIS_TENSOR
+
+# A rule maps one logical axis to a mesh axis, a tuple of mesh axes, or None
+# (replicated).
+Rule = Tuple[str, Union[str, Tuple[str, ...], None]]
+
+
+@dataclass(frozen=True)
+class ShardingRules:
+    rules: Tuple[Rule, ...]
+
+    def mesh_axes(self, logical: Optional[str]):
+        if logical is None:
+            return None
+        for name, axes in self.rules:
+            if name == logical:
+                return axes
+        return None  # unknown logical axis -> replicated
+
+
+# Default rule table for transformer training: batch split over dp+fsdp,
+# params sharded over fsdp (ZeRO-3 style) and tp (megatron style), sequence
+# over sp for ring attention, experts over ep.
+DEFAULT_RULES = ShardingRules(rules=(
+    ("batch", (AXIS_DATA, AXIS_FSDP)),
+    ("seq", AXIS_SEQUENCE),
+    ("embed", AXIS_FSDP),          # fsdp shards the embed dim of params
+    ("heads", AXIS_TENSOR),
+    ("kv_heads", AXIS_TENSOR),
+    ("head_dim", None),
+    ("mlp", AXIS_TENSOR),
+    ("vocab", AXIS_TENSOR),
+    ("expert", AXIS_EXPERT),
+    ("layers", None),
+))
+
+
+def logical_to_pspec(
+    logical_axes: Sequence[Optional[str]],
+    rules: ShardingRules = DEFAULT_RULES,
+) -> P:
+    """('batch','seq','embed') -> PartitionSpec(('dp','fsdp'),'sp',None).
+
+    A mesh axis may shard only one dim of an array; when two logical axes
+    would claim the same mesh axis (e.g. activations carrying both 'batch'
+    and 'embed' under fsdp), the earlier dim wins and later claims drop to
+    replicated.
+    """
+    taken: set = set()
+    out = []
+    for a in logical_axes:
+        axes = rules.mesh_axes(a)
+        tup = (axes,) if isinstance(axes, str) else tuple(axes or ())
+        if any(m in taken for m in tup):
+            out.append(None)
+            continue
+        taken.update(tup)
+        out.append(axes)
+    return P(*out)
+
+
+def shard_pytree_specs(logical_tree, rules: ShardingRules = DEFAULT_RULES):
+    """Map a pytree of logical-axis tuples to a pytree of PartitionSpecs.
+
+    Leaves must be tuples of logical names — a bare string would silently
+    be iterated character-by-character, so it is rejected."""
+    def convert(axes):
+        if isinstance(axes, str):
+            raise TypeError(
+                f"logical axes must be a tuple, got bare string {axes!r} "
+                f"(write ({axes!r},))"
+            )
+        return logical_to_pspec(axes, rules)
+
+    return jax.tree.map(
+        convert,
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x
+        ),
+    )
+
+
+def named_sharding(mesh: Mesh, *logical_axes, rules: ShardingRules = DEFAULT_RULES) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_pspec(logical_axes, rules))
+
+
+def with_logical_constraint(x, logical_axes, rules: ShardingRules = DEFAULT_RULES):
+    """``with_sharding_constraint`` by logical names; no-op outside a mesh
+    context so model code runs unchanged on a single device.  Mesh presence
+    is detected explicitly — errors inside a real mesh propagate."""
+    if not _mesh_axes_in_scope():
+        return x
+    return jax.lax.with_sharding_constraint(x, logical_to_pspec(logical_axes, rules))
+
+
+def _mesh_axes_in_scope() -> bool:
+    """True when a named mesh is active via either jax.set_mesh (abstract
+    mesh) or the legacy ``with mesh:`` context manager."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is not None and mesh.axis_names:
+        return True
+    try:  # legacy physical-mesh context (private API, best effort)
+        from jax._src import mesh as _mesh_lib
+        return bool(_mesh_lib.thread_resources.env.physical_mesh.axis_names)
+    except Exception:
+        return False

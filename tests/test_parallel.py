@@ -1,0 +1,120 @@
+"""Parallel layer: mesh resolution, logical sharding rules, ring attention
+numerics vs the naive oracle — all on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_controller_tpu.parallel import (
+    DEFAULT_RULES,
+    MeshSpec,
+    build_mesh,
+    logical_to_pspec,
+    ring_attention,
+)
+from kubeflow_controller_tpu.parallel.mesh import data_parallel_size, mesh_shape_for
+from kubeflow_controller_tpu.parallel.ring import attention_reference
+
+
+class TestMeshSpec:
+    def test_wildcard_absorbs_remaining(self):
+        sizes = MeshSpec(dp=2, fsdp=-1, tp=2).resolve(8)
+        assert sizes["fsdp"] == 2 and sizes["dp"] == 2 and sizes["tp"] == 2
+
+    def test_fixed_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            MeshSpec(dp=3, fsdp=1).resolve(8)
+
+    def test_two_wildcards_raise(self):
+        with pytest.raises(ValueError):
+            MeshSpec(dp=-1, fsdp=-1).resolve(8)
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            MeshSpec(dp=3, fsdp=-1).resolve(8)
+
+    def test_canonical_order(self):
+        shape = mesh_shape_for(8, MeshSpec(tp=2, fsdp=-1))
+        assert [a for a, _ in shape] == ["pp", "dp", "fsdp", "ep", "sp", "tp"]
+
+    def test_build_mesh_all_devices(self):
+        mesh = build_mesh(MeshSpec(fsdp=-1))
+        assert mesh.devices.size == 8
+        assert mesh.shape["fsdp"] == 8
+        assert data_parallel_size(mesh) == 8
+
+
+class TestShardingRules:
+    def test_batch_maps_to_dp_fsdp(self):
+        # 'embed' would claim fsdp a second time -> dropped to replicated.
+        assert logical_to_pspec(("batch", "seq", "embed")) == P(
+            ("dp", "fsdp"), "sp", None
+        )
+
+    def test_param_embed_shards_over_fsdp(self):
+        assert logical_to_pspec(("embed", "mlp")) == P("fsdp", "tp")
+
+    def test_bare_string_leaf_rejected(self):
+        from kubeflow_controller_tpu.parallel import shard_pytree_specs
+        with pytest.raises(TypeError):
+            shard_pytree_specs({"w": "batch"})
+
+    def test_constraint_applies_under_mesh(self):
+        from kubeflow_controller_tpu.parallel import with_logical_constraint
+        mesh = build_mesh(MeshSpec(dp=2, fsdp=2, sp=1, tp=2))
+        x = jnp.zeros((4, 8, 6))
+        # No mesh context: identity.
+        assert with_logical_constraint(x, ("batch", "seq", "heads")) is x
+        with jax.set_mesh(mesh):
+            y = jax.jit(lambda a: with_logical_constraint(a, ("batch", "seq", "heads")))(x)
+        assert y.shape == x.shape
+
+    def test_unknown_logical_replicated(self):
+        assert logical_to_pspec(("nonesuch",)) == P(None)
+
+    def test_none_axis_replicated(self):
+        assert logical_to_pspec((None, "mlp")) == P(None, "tp")
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference_sp4(self, causal):
+        mesh = build_mesh(MeshSpec(fsdp=2, sp=4, tp=1))
+        key = jax.random.PRNGKey(0)
+        b, t, h, d = 4, 32, 2, 16
+        q, k, v = (
+            jax.random.normal(kk, (b, t, h, d), dtype=jnp.float32)
+            for kk in jax.random.split(key, 3)
+        )
+        with jax.set_mesh(mesh):
+            out = ring_attention(q, k, v, mesh, causal=causal)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_sp1_degenerates_to_plain_attention(self):
+        mesh = build_mesh(MeshSpec(dp=2, fsdp=2, sp=1, tp=2))
+        key = jax.random.PRNGKey(1)
+        b, t, h, d = 4, 16, 2, 8
+        q, k, v = (
+            jax.random.normal(kk, (b, t, h, d), dtype=jnp.float32)
+            for kk in jax.random.split(key, 3)
+        )
+        with jax.set_mesh(mesh):
+            out = ring_attention(q, k, v, mesh, causal=True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_jit_compiles_under_mesh(self):
+        mesh = build_mesh(MeshSpec(fsdp=2, sp=4))
+        key = jax.random.PRNGKey(2)
+        b, t, h, d = 2, 32, 2, 8
+        q, k, v = (
+            jax.random.normal(kk, (b, t, h, d), dtype=jnp.float32)
+            for kk in jax.random.split(key, 3)
+        )
+        with jax.set_mesh(mesh):
+            f = jax.jit(lambda a, b_, c: ring_attention(a, b_, c, mesh, causal=True))
+            out = f(q, k, v)
+        assert out.shape == (b, t, h, d)
