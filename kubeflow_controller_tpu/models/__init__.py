@@ -1,0 +1,46 @@
+"""Model zoo for the judged workload configs (BASELINE.json):
+
+- ``mnist``: softmax regression + one-hidden-layer MLP, the JAX re-expression
+  of the reference's example workloads (ref: examples/workdir/mnist_softmax.py,
+  examples/workdir/mnist_replica.py:142-170).
+- ``llama``: Llama-2 decoder (RMSNorm / RoPE / SwiGLU / GQA) with logical
+  sharding annotations for FSDP/TP/SP — the flagship multi-host TPU workload.
+
+The reference keeps workloads entirely outside the controller in user
+containers (SURVEY.md §1); this package is those containers' contents,
+TPU-native.
+"""
+
+from .mnist import (
+    MLPConfig,
+    mlp_accuracy,
+    mlp_apply,
+    mlp_init,
+    mlp_loss,
+    softmax_apply,
+    softmax_init,
+)
+from .llama import (
+    LlamaConfig,
+    llama_forward,
+    llama_init,
+    llama_loss,
+    llama_param_logical_axes,
+    llama_param_pspecs,
+)
+
+__all__ = [
+    "MLPConfig",
+    "mlp_accuracy",
+    "mlp_apply",
+    "mlp_init",
+    "mlp_loss",
+    "softmax_apply",
+    "softmax_init",
+    "LlamaConfig",
+    "llama_forward",
+    "llama_init",
+    "llama_loss",
+    "llama_param_logical_axes",
+    "llama_param_pspecs",
+]

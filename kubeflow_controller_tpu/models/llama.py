@@ -1,0 +1,241 @@
+"""Llama-2 decoder, TPU-first.
+
+The flagship workload for the multi-host judged config (BASELINE.json:
+"Multi-host JAX Llama-2-7B pretrain on v5p-32 slice").  Design choices:
+
+- **Functional pytree params, layers stacked** on a leading axis and walked
+  with ``lax.scan`` — one traced layer, L iterations: compile time stays
+  flat in depth and XLA pipelines the weight-gather of layer i+1 under the
+  compute of layer i.
+- **Logical sharding axes** on every param (embed/heads/mlp/vocab...) so the
+  same model runs FSDP, tensor-parallel, or sequence-parallel purely by
+  rule table + mesh shape (parallel/sharding.py).
+- **Ring attention** over the ``sp`` axis when a mesh is supplied —
+  long-context is first-class, not a bolt-on.
+- **bfloat16 activations, f32 norms/softmax/loss**: MXU-friendly matmuls
+  with stable statistics.
+- ``jax.checkpoint`` per layer (rematerialisation) trades FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..parallel.ring import attention_reference, ring_attention
+from ..parallel.sharding import (
+    DEFAULT_RULES,
+    ShardingRules,
+    shard_pytree_specs,
+    with_logical_constraint,
+)
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    intermediate: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"        # activation dtype
+    param_dtype: str = "float32"
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def llama2_7b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny(**overrides) -> "LlamaConfig":
+        """Test/dryrun-sized config; same code path as 7B."""
+        cfg = LlamaConfig(
+            vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            intermediate=128, max_seq_len=128, dtype="float32", remat=False,
+        )
+        return replace(cfg, **overrides)
+
+
+# ---------------------------------------------------------------------------
+# Params
+# ---------------------------------------------------------------------------
+
+def llama_init(key: jax.Array, cfg: LlamaConfig) -> Params:
+    """Scaled-normal init (0.02, residual projections scaled by depth)."""
+    dtype = jnp.dtype(cfg.param_dtype)
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    keys = jax.random.split(key, 10)
+
+    def norm(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape) * scale).astype(dtype)
+
+    resid_scale = 0.02 / (2 * cfg.n_layers) ** 0.5
+    L = cfg.n_layers
+    return {
+        "embed": norm(keys[0], (cfg.vocab_size, cfg.dim)),
+        "layers": {
+            "attn_norm": jnp.ones((L, cfg.dim), dtype=dtype),
+            "wq": norm(keys[1], (L, cfg.dim, nh, hd)),
+            "wk": norm(keys[2], (L, cfg.dim, nkv, hd)),
+            "wv": norm(keys[3], (L, cfg.dim, nkv, hd)),
+            "wo": norm(keys[4], (L, nh, hd, cfg.dim), scale=resid_scale),
+            "mlp_norm": jnp.ones((L, cfg.dim), dtype=dtype),
+            "w_gate": norm(keys[5], (L, cfg.dim, cfg.intermediate)),
+            "w_up": norm(keys[6], (L, cfg.dim, cfg.intermediate)),
+            "w_down": norm(keys[7], (L, cfg.intermediate, cfg.dim), scale=resid_scale),
+        },
+        "final_norm": jnp.ones((cfg.dim,), dtype=dtype),
+        "lm_head": norm(keys[8], (cfg.dim, cfg.vocab_size)),
+    }
+
+
+def llama_param_logical_axes(cfg: LlamaConfig) -> Params:
+    """Logical axis names per param, mirroring the param tree."""
+    del cfg
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", None),
+            "wq": ("layers", "embed", "heads", "head_dim"),
+            "wk": ("layers", "embed", "kv_heads", "head_dim"),
+            "wv": ("layers", "embed", "kv_heads", "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed"),
+            "mlp_norm": ("layers", None),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": (None,),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def llama_param_pspecs(cfg: LlamaConfig, rules: ShardingRules = DEFAULT_RULES):
+    return shard_pytree_specs(llama_param_logical_axes(cfg), rules)
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+def rmsnorm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * rms).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def rope_freqs(cfg: LlamaConfig, positions: jax.Array) -> jax.Array:
+    """[T, head_dim//2] complex-free rotation angles."""
+    inv = 1.0 / (cfg.rope_theta ** (jnp.arange(0, cfg.head_dim, 2) / cfg.head_dim))
+    return positions[:, None].astype(jnp.float32) * inv[None, :]
+
+
+def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
+    """Rotate pairs of channels; x: [B, T, H, D], angles: [T, D//2]."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
+def _attention(q, k, v, mesh: Optional[Mesh], causal: bool, rules: ShardingRules):
+    """Ring attention when the rule table maps 'seq' onto a real mesh axis
+    of size > 1; otherwise plain attention (XLA fuses it) under whatever
+    sharding constraints are already in place."""
+    seq_axis = rules.mesh_axes("seq")
+    if (
+        mesh is None
+        or not isinstance(seq_axis, str)
+        or seq_axis not in mesh.axis_names
+        or mesh.shape[seq_axis] <= 1
+    ):
+        return attention_reference(q, k, v, causal=causal)
+    return ring_attention(
+        q, k, v, mesh,
+        causal=causal,
+        axis_name=seq_axis,
+        batch_axes=rules.mesh_axes("batch"),
+        head_axis=rules.mesh_axes("heads"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def llama_forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+    rules: ShardingRules = DEFAULT_RULES,
+) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, vocab] f32."""
+    dtype = jnp.dtype(cfg.dtype)
+    B, T = tokens.shape
+    x = params["embed"][tokens].astype(dtype)
+    x = with_logical_constraint(x, ("batch", "seq", None), rules)
+    angles = rope_freqs(cfg, jnp.arange(T))
+    repeats = cfg.n_heads // cfg.n_kv_heads
+
+    def layer(x, lp):
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dtype))
+        k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dtype))
+        v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dtype))
+        q = apply_rope(q, angles)
+        k = apply_rope(k, angles)
+        if repeats > 1:  # GQA: expand kv heads to query heads
+            k = jnp.repeat(k, repeats, axis=2)
+            v = jnp.repeat(v, repeats, axis=2)
+        q = with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"), rules)
+        k = with_logical_constraint(k, ("batch", "seq", "heads", "head_dim"), rules)
+        v = with_logical_constraint(v, ("batch", "seq", "heads", "head_dim"), rules)
+        attn = _attention(q, k, v, mesh, causal=True, rules=rules)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))
+
+        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dtype))
+        up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(dtype))
+        ff = jax.nn.silu(gate) * up
+        ff = with_logical_constraint(ff, ("batch", "seq", "mlp"), rules)
+        x = x + jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(dtype))
+        x = with_logical_constraint(x, ("batch", "seq", None), rules)
+        return x, None
+
+    layer_fn = jax.checkpoint(layer) if cfg.remat else layer
+    x, _ = jax.lax.scan(lambda carry, lp: layer_fn(carry, lp), x, params["layers"])
+
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(dtype))
+    logits = with_logical_constraint(logits, ("batch", "seq", "vocab"), rules)
+    return logits.astype(jnp.float32)
+
+
+def llama_loss(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+    rules: ShardingRules = DEFAULT_RULES,
+) -> jax.Array:
+    """Next-token cross-entropy, mean over all positions."""
+    logits = llama_forward(params, tokens, cfg, mesh, rules)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)

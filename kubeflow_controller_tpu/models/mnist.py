@@ -1,0 +1,74 @@
+"""MNIST models: softmax regression and a one-hidden-layer MLP.
+
+Functional parity with the reference's two example workloads:
+
+- softmax regression ``y = softmax(Wx + b)`` (ref: examples/workdir/
+  mnist_softmax.py:44-52);
+- one-hidden-layer NN, hidden width 100, truncated-normal init scaled by
+  1/sqrt(IMAGE_PIXELS) (ref: examples/workdir/mnist_replica.py:142-170).
+
+Pure functions over param pytrees; batches stay large and matmul-shaped so
+XLA tiles them onto the MXU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+IMAGE_PIXELS = 28 * 28
+NUM_CLASSES = 10
+
+Params = Dict[str, jax.Array]
+
+
+def softmax_init(key: jax.Array, dtype=jnp.float32) -> Params:
+    """Zero init, as the reference does (mnist_softmax.py:46-47)."""
+    del key
+    return {
+        "w": jnp.zeros((IMAGE_PIXELS, NUM_CLASSES), dtype=dtype),
+        "b": jnp.zeros((NUM_CLASSES,), dtype=dtype),
+    }
+
+
+def softmax_apply(params: Params, x: jax.Array) -> jax.Array:
+    """Logits for a [batch, 784] image batch."""
+    return x @ params["w"] + params["b"]
+
+
+@dataclass(frozen=True)
+class MLPConfig:
+    hidden: int = 100  # ref: mnist_replica.py:49 (hidden_units flag default)
+    dtype: str = "float32"
+
+
+def mlp_init(key: jax.Array, cfg: MLPConfig = MLPConfig()) -> Params:
+    k1, k2 = jax.random.split(key)
+    dtype = jnp.dtype(cfg.dtype)
+    scale_in = IMAGE_PIXELS ** -0.5
+    scale_h = cfg.hidden ** -0.5
+    return {
+        "w1": (jax.random.truncated_normal(k1, -2, 2, (IMAGE_PIXELS, cfg.hidden)) * scale_in).astype(dtype),
+        "b1": jnp.zeros((cfg.hidden,), dtype=dtype),
+        "w2": (jax.random.truncated_normal(k2, -2, 2, (cfg.hidden, NUM_CLASSES)) * scale_h).astype(dtype),
+        "b2": jnp.zeros((NUM_CLASSES,), dtype=dtype),
+    }
+
+
+def mlp_apply(params: Params, x: jax.Array) -> jax.Array:
+    h = jax.nn.relu(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def mlp_loss(params: Params, x: jax.Array, y: jax.Array, apply_fn=mlp_apply) -> jax.Array:
+    """Mean cross-entropy over the batch; labels are int class ids."""
+    logits = apply_fn(params, x).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
+
+
+def mlp_accuracy(params: Params, x: jax.Array, y: jax.Array, apply_fn=mlp_apply) -> jax.Array:
+    return jnp.mean(jnp.argmax(apply_fn(params, x), axis=-1) == y)

@@ -1,0 +1,15 @@
+"""Pallas TPU kernels for the hot ops, with CPU-interpreter fallbacks.
+
+The reference has zero native kernels (SURVEY.md §2.4 — it is an
+orchestration controller); the kernels here serve the *workload* layer the
+rebuild adds.  Each op ships three tiers:
+
+1. a Pallas TPU kernel (MXU/VMEM-aware blocking),
+2. the same kernel under ``interpret=True`` for CPU tests,
+3. a plain-jnp reference used as numerics oracle and autodiff path.
+"""
+
+from .attention import flash_attention
+from .rmsnorm import fused_rmsnorm
+
+__all__ = ["flash_attention", "fused_rmsnorm"]

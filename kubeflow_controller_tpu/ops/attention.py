@@ -1,0 +1,121 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Blocked online-softmax attention: the q block stays resident in VMEM while
+k/v blocks stream through, keeping the O(T²) score matrix out of HBM.  The
+grid walks (batch*heads, q_blocks); the k loop runs inside the kernel as a
+``fori_loop`` so the running max/denominator live in registers/VMEM.
+
+On non-TPU backends the same kernel runs under ``interpret=True`` (slow,
+for tests); ``attention_reference`` in parallel/ring.py is the oracle.
+
+Status: numerically validated on TPU v5e (bf16 err < 2e-2 vs oracle), but
+the current one-kernel-per-(bh, q-block) grid with the k loop inside is
+far off XLA's fused attention at T<=4k — measured 13.8ms vs 0.09ms for
+[4,1024,8,128] on v5e.  The model layer therefore defaults to the XLA
+path; this kernel is opt-in until the blocking is reworked (stream k/v via
+a third grid dimension with double-buffered DMA instead of a VMEM-resident
+full K/V per step).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  scale: float, q_block: int, seq_len: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
+    bq, d = q.shape
+    q_start = qi * q_block
+
+    num_k_blocks = seq_len // block_k
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k_start = ki * block_k
+        k = k_ref[0, pl.dslice(k_start, block_k), :].astype(jnp.float32)   # [bk, d]
+        v = v_ref[0, pl.dslice(k_start, block_k), :].astype(jnp.float32)
+        s = q @ k.T                                    # [bq, bk]
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + p @ v
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq, 1), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((bq, 1), dtype=jnp.float32)
+    acc0 = jnp.zeros((bq, d), dtype=jnp.float32)
+
+    if causal:
+        # Only blocks at or before the q block's diagonal contribute.
+        last = (q_start + bq - 1) // block_k + 1
+        m, l, acc = jax.lax.fori_loop(0, last, body, (m0, l0, acc0))
+    else:
+        m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
+
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """q/k/v: [batch, seq, heads, head_dim] -> same shape.
+
+    Requires seq divisible by the block sizes (clamped to seq).  Runs the
+    Pallas kernel on TPU, the interpreter elsewhere.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, t, h, d = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k:
+        raise ValueError(f"seq len {t} not divisible by blocks ({block_q},{block_k})")
+
+    # [B, T, H, D] -> [B*H, T, D]: one grid row per (batch, head).
+    def to_bh(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    grid = (b * h, t // block_q)
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal, scale=scale,
+        q_block=block_q, seq_len=t,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        interpret=interpret,
+    )(qb, kb, vb)
+    return jnp.transpose(out.reshape(b, h, t, d), (0, 2, 1, 3))

@@ -67,11 +67,14 @@ def logical_to_pspec(
     for a in logical_axes:
         axes = rules.mesh_axes(a)
         tup = (axes,) if isinstance(axes, str) else tuple(axes or ())
-        if any(m in taken for m in tup):
+        free = tuple(m for m in tup if m not in taken)
+        taken.update(free)
+        if not free:
             out.append(None)
-            continue
-        taken.update(tup)
-        out.append(axes)
+        elif len(free) == 1:
+            out.append(free[0])
+        else:
+            out.append(free)
     return P(*out)
 
 

@@ -1,0 +1,54 @@
+"""Pallas kernels vs jnp oracles (interpreter mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_controller_tpu.ops import flash_attention, fused_rmsnorm
+from kubeflow_controller_tpu.parallel.ring import attention_reference
+
+
+def _qkv(key, b, t, h, d, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return (
+        jax.random.normal(k1, (b, t, h, d), dtype=dtype),
+        jax.random.normal(k2, (b, t, h, d), dtype=dtype),
+        jax.random.normal(k3, (b, t, h, d), dtype=dtype),
+    )
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv(jax.random.PRNGKey(0), 2, 64, 2, 16)
+        out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_single_block(self):
+        q, k, v = _qkv(jax.random.PRNGKey(1), 1, 16, 1, 8)
+        out = flash_attention(q, k, v, causal=True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_indivisible_seq_raises(self):
+        q, k, v = _qkv(jax.random.PRNGKey(2), 1, 48, 1, 8)
+        with pytest.raises(ValueError):
+            flash_attention(q, k, v, block_q=32, block_k=32)
+
+
+class TestFusedRMSNorm:
+    def test_matches_oracle(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 32, 64))
+        scale = jax.random.normal(jax.random.PRNGKey(1), (64,)) + 1.0
+        out = fused_rmsnorm(x, scale, eps=1e-5)
+        xf = x.astype(jnp.float32)
+        ref = xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + 1e-5) * scale
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+    def test_ragged_rows_fall_back_to_single_block(self):
+        x = jax.random.normal(jax.random.PRNGKey(2), (3, 7, 16))
+        scale = jnp.ones((16,))
+        out = fused_rmsnorm(x, scale, block_rows=4)
+        assert out.shape == x.shape

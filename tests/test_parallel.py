@@ -56,6 +56,10 @@ class TestShardingRules:
     def test_param_embed_shards_over_fsdp(self):
         assert logical_to_pspec(("embed", "mlp")) == P("fsdp", "tp")
 
+    def test_partial_conflict_keeps_free_axes(self):
+        # 'embed' takes fsdp; 'batch' -> ('dp','fsdp') keeps the free dp.
+        assert logical_to_pspec(("embed", "batch")) == P("fsdp", "dp")
+
     def test_bare_string_leaf_rejected(self):
         from kubeflow_controller_tpu.parallel import shard_pytree_specs
         with pytest.raises(TypeError):
