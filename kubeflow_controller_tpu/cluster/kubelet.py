@@ -69,27 +69,58 @@ class FakeKubelet:
         inventory: Optional[TPUInventory] = None,
         execute: bool = False,
         max_restarts: int = 2,
+        warm_start: bool = True,
     ):
         self.cluster = cluster
         self.policy = policy or PhasePolicy()
         self.inventory = inventory
         self.execute = execute
         self.max_restarts = max_restarts
+        # Warm-start: fork `python -m ...` pod commands from a pre-imported
+        # zygote instead of cold-starting an interpreter per pod (the
+        # image-pull-amortization analog; see zygote.py).
+        self.warm_start = warm_start
+        self._pool = None
+        self._pool_lock = threading.Lock()
         self._watcher = None
         self._threads: Dict[str, threading.Thread] = {}
         self._procs: Dict[str, subprocess.Popen] = {}
+        self._warm: Dict[str, object] = {}
         self._stop = threading.Event()
         self._main: Optional[threading.Thread] = None
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
+        if self.execute and self.warm_start:
+            self._prewarm()
         self._watcher = self.cluster.pods.watch()
         # Pick up pods created before the watch started.
         for pod in self.cluster.pods.list():
             self._spawn(pod)
         self._main = threading.Thread(target=self._run, name="fake-kubelet", daemon=True)
         self._main.start()
+
+    def wait_warm(self, timeout: float = 60.0) -> bool:
+        """Block until the zygote is ready (no-op without warm start)."""
+        if self._pool is None:
+            return True
+        return self._pool._ready.wait(timeout=timeout)
+
+    def _prewarm(self):
+        """Create (once) and return the warm pool; start the zygote in the
+        background so its framework preimport (the image-pull analog) is
+        off every pod's critical path."""
+        from .warmpool import WarmPool
+
+        with self._pool_lock:
+            if self._pool is None:
+                repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))))
+                self._pool = WarmPool(repo_root=repo_root)
+                threading.Thread(target=self._pool.start, name="warmpool-prewarm",
+                                 daemon=True).start()
+            return self._pool
 
     def stop(self) -> None:
         self._stop.set()
@@ -98,6 +129,8 @@ class FakeKubelet:
         for proc in list(self._procs.values()):
             if proc.poll() is None:
                 proc.terminate()
+        if self._pool is not None:
+            self._pool.stop()
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -107,9 +140,13 @@ class FakeKubelet:
             if ev.type == ADDED:
                 self._spawn(ev.object)
             elif ev.type == DELETED:
-                proc = self._procs.get(self._key(ev.object))
+                key = self._key(ev.object)
+                proc = self._procs.get(key)
                 if proc is not None and proc.poll() is None:
                     proc.terminate()
+                warm = self._warm.get(key)
+                if warm is not None and self._pool is not None:
+                    self._pool.kill(warm)
 
     @staticmethod
     def _key(pod: Pod) -> str:
@@ -119,9 +156,20 @@ class FakeKubelet:
         key = self._key(pod)
         if key in self._threads:
             return
-        t = threading.Thread(target=self._drive, args=(pod,), name=f"kubelet-{key}", daemon=True)
+        t = threading.Thread(target=self._drive_and_reap, args=(pod,),
+                             name=f"kubelet-{key}", daemon=True)
         self._threads[key] = t
         t.start()
+
+    def _drive_and_reap(self, pod: Pod) -> None:
+        key = self._key(pod)
+        try:
+            self._drive(pod)
+        finally:
+            # A pod name never re-enters Running after its driver returns
+            # (generateName makes replacements unique), so drop bookkeeping
+            # rather than leak one entry per pod ever run.
+            self._procs.pop(key, None)
 
     # -- phase driving -------------------------------------------------------
 
@@ -181,11 +229,18 @@ class FakeKubelet:
             self.set_phase(ns, name, outcome)
 
     def _execute(self, pod: Pod) -> None:
+        from .warmpool import python_module_argv
+
         ns, name = pod.metadata.namespace, pod.metadata.name
         c = pod.spec.containers[0]
         cmd = list(c.command) + list(c.args)
         env = dict(os.environ)
         env.update({e.name: e.value for e in c.env})
+        if self.warm_start:
+            argv = python_module_argv(cmd)
+            if argv is not None:
+                self._execute_warm(pod, argv, env)
+                return
         restarts = 0
         while not self._stop.is_set():
             try:
@@ -212,3 +267,34 @@ class FakeKubelet:
             tail = (stderr or b"")[-500:].decode(errors="replace")
             self.set_phase(ns, name, PHASE_FAILED, reason=f"Error: exit {proc.returncode}: {tail}")
             return
+
+    def _execute_warm(self, pod: Pod, argv, env) -> None:
+        """Fork the pod process from the warm zygote (see zygote.py)."""
+        ns, name = pod.metadata.namespace, pod.metadata.name
+        key = self._key(pod)
+        pool = self._prewarm()
+        c = pod.spec.containers[0]
+        restarts = 0
+        try:
+            while not self._stop.is_set():
+                try:
+                    proc = pool.spawn(argv, env, c.working_dir, key)
+                except OSError as e:
+                    self.set_phase(ns, name, PHASE_FAILED, reason=f"StartError: {e}")
+                    return
+                self._warm[key] = proc
+                code = proc.wait(poll_stop=lambda: self._stop.is_set() or self._gone(ns, name))
+                if code is None or self._stop.is_set() or self._gone(ns, name):
+                    pool.kill(proc)
+                    return
+                if code == 0:
+                    self.set_phase(ns, name, PHASE_SUCCEEDED)
+                    return
+                if pod.spec.restart_policy in ("Always", "OnFailure") and restarts < self.max_restarts:
+                    restarts += 1
+                    continue
+                tail = proc.stderr_tail().decode(errors="replace")
+                self.set_phase(ns, name, PHASE_FAILED, reason=f"Error: exit {code}: {tail}")
+                return
+        finally:
+            self._warm.pop(key, None)

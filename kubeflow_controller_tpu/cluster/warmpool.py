@@ -1,0 +1,173 @@
+"""Kubelet-side client for the warm-start zygote (see zygote.py)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class WarmProc:
+    """Handle for one pod process forked from the zygote."""
+
+    req_id: int
+    pid: int = 0
+    exit_code: Optional[int] = None
+    stderr_path: str = ""
+    _done: threading.Event = field(default_factory=threading.Event)
+
+    def wait(self, poll_stop=None) -> Optional[int]:
+        """Block until exit; ``poll_stop()`` True aborts the wait."""
+        while not self._done.wait(timeout=0.1):
+            if poll_stop is not None and poll_stop():
+                return None
+        return self.exit_code
+
+    def stderr_tail(self, limit: int = 500) -> bytes:
+        try:
+            with open(self.stderr_path, "rb") as f:
+                data = f.read()
+            return data[-limit:]
+        except OSError:
+            return b""
+
+
+class WarmPool:
+    """Owns the zygote subprocess; thread-safe spawn/kill."""
+
+    def __init__(self, repo_root: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._procs: Dict[int, WarmProc] = {}
+        self._zygote: Optional[subprocess.Popen] = None
+        self._reader: Optional[threading.Thread] = None
+        self._tmpdir = tempfile.mkdtemp(prefix="warmpool-")
+        self._repo_root = repo_root
+        self._ready = threading.Event()
+
+    def start(self) -> None:
+        with self._lock:
+            if self._zygote is not None:
+                return
+            env = dict(os.environ)
+            if self._repo_root:
+                env["PYTHONPATH"] = self._repo_root + os.pathsep + env.get("PYTHONPATH", "")
+            self._zygote = subprocess.Popen(
+                [sys.executable, "-m", "kubeflow_controller_tpu.cluster.zygote"],
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL,
+                env=env,
+                cwd=self._repo_root or None,
+            )
+            self._reader = threading.Thread(
+                target=self._read_loop, name="warmpool-reader", daemon=True
+            )
+            self._reader.start()
+        self._ready.wait(timeout=60)
+
+    def _read_loop(self) -> None:
+        z = self._zygote
+        for raw in z.stdout:
+            try:
+                msg = json.loads(raw)
+            except ValueError:
+                continue
+            if msg.get("event") == "ready":
+                self._ready.set()
+                continue
+            proc = self._procs.get(msg.get("id"))
+            if proc is None:
+                continue
+            if msg["event"] == "started":
+                proc.pid = msg["pid"]
+            elif msg["event"] == "exit":
+                proc.exit_code = msg["code"]
+                with self._lock:
+                    self._procs.pop(proc.req_id, None)
+                proc._done.set()
+        # zygote died: fail everything outstanding and allow a restart
+        with self._lock:
+            self._zygote = None
+            outstanding = list(self._procs.values())
+            self._procs.clear()
+            self._ready.clear()
+        for proc in outstanding:
+            if proc.exit_code is None:
+                proc.exit_code = -1
+                proc._done.set()
+
+    def spawn(self, argv, env, cwd, key: str) -> WarmProc:
+        """argv: the command AFTER the interpreter, e.g. ["-m", "mod", ...].
+
+        Raises OSError if the zygote is (or just went) unreachable; callers
+        surface that as a pod StartError."""
+        self.start()
+        with self._lock:
+            if self._zygote is None or self._zygote.poll() is not None:
+                raise OSError("warm-start zygote is not running")
+            self._next_id += 1
+            rid = self._next_id
+            safe = key.replace("/", "_")
+            proc = WarmProc(
+                req_id=rid,
+                stderr_path=os.path.join(self._tmpdir, f"{safe}-{rid}.err"),
+            )
+            self._procs[rid] = proc
+            req = {
+                "id": rid,
+                "argv": list(argv),
+                "env": dict(env),
+                "cwd": cwd or "",
+                "stdout": os.path.join(self._tmpdir, f"{safe}-{rid}.out"),
+                "stderr": proc.stderr_path,
+            }
+            try:
+                self._zygote.stdin.write((json.dumps(req) + "\n").encode())
+                self._zygote.stdin.flush()
+            except (BrokenPipeError, OSError) as e:
+                self._procs.pop(rid, None)
+                raise OSError(f"warm-start zygote unreachable: {e}") from e
+        return proc
+
+    def kill(self, proc: WarmProc) -> None:
+        with self._lock:
+            if self._zygote is None or proc.exit_code is not None:
+                return
+            try:
+                self._zygote.stdin.write(
+                    (json.dumps({"kill": proc.req_id}) + "\n").encode())
+                self._zygote.stdin.flush()
+            except (BrokenPipeError, ValueError):
+                pass
+
+    def stop(self) -> None:
+        with self._lock:
+            z, self._zygote = self._zygote, None
+        if z is None:
+            return
+        try:
+            z.stdin.close()  # zygote sees EOF, kills children, exits
+            z.wait(timeout=5)
+        except (OSError, subprocess.TimeoutExpired):
+            z.terminate()
+
+
+def python_module_argv(command) -> Optional[list]:
+    """If the pod command is `<python> -m module args...` (or starts with
+    "-m"), return the argv after the interpreter; else None (not warmable)."""
+    cmd = list(command)
+    if not cmd:
+        return None
+    if cmd[0] == "-m":
+        return cmd
+    base = os.path.basename(cmd[0])
+    if base.startswith("python") and len(cmd) >= 3 and cmd[1] == "-m":
+        return cmd[1:]
+    return None

@@ -1,0 +1,179 @@
+"""Warm-start zygote: forkserver for executed pods.
+
+Pod cold-start on a small host is dominated by interpreter + framework
+import (~3s per process here — the analog of image pull + container start,
+which the reference's cluster pays per pod too: ~4s spread for 6 pods,
+ref: docs/design_doc.md:137-149).  The kubelet amortizes it by keeping ONE
+warm process that has pre-imported the heavy modules and forks each pod's
+process from it — the multiprocessing-forkserver pattern.
+
+The zygote stays **single-threaded** (select on stdin + WNOHANG reaping)
+so forking is safe, and never initializes a jax backend — children pick
+their own platform (the workloads' ``--platform`` flag runs
+``jax.config.update`` post-fork).
+
+Protocol (JSON lines over stdin/stdout):
+  -> {"id": 1, "argv": ["-m", "mod", ...], "env": {...}, "cwd": "...",
+      "stdout": "/path", "stderr": "/path"}
+  <- {"id": 1, "event": "started", "pid": 123}
+  -> {"kill": 1}
+  <- {"id": 1, "event": "exit", "code": 0}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import runpy
+import select
+import signal
+import sys
+import time
+from typing import Dict
+
+
+PREIMPORT = (
+    "jax",
+    "jax.numpy",
+    "optax",
+    "numpy",
+    "kubeflow_controller_tpu.models",
+    "kubeflow_controller_tpu.workloads.data",
+    "kubeflow_controller_tpu.workloads.trainer",
+    "kubeflow_controller_tpu.workloads.mnist_local",
+    "kubeflow_controller_tpu.workloads.mnist_dist",
+    "kubeflow_controller_tpu.workloads.llama_pretrain",
+)
+
+
+def _child(req: dict) -> None:
+    """Runs in the forked child: become the pod process."""
+    try:
+        os.setsid()  # own process group so kills don't hit the zygote
+        # Drop the protocol pipe fds: holding the request pipe (fd 0) or the
+        # dup'd reply pipe open would keep the kubelet's reader alive after
+        # the zygote dies, masking its death while any child runs.
+        try:
+            devnull = os.open(os.devnull, os.O_RDONLY)
+            os.dup2(devnull, 0)
+            os.close(devnull)
+        except OSError:
+            pass
+        if _REPLY_FD[0] is not None:
+            try:
+                os.close(_REPLY_FD[0])
+            except OSError:
+                pass
+        for stream, path, mode in (
+            (1, req.get("stdout"), os.O_WRONLY | os.O_CREAT | os.O_APPEND),
+            (2, req.get("stderr"), os.O_WRONLY | os.O_CREAT | os.O_APPEND),
+        ):
+            if path:
+                fd = os.open(path, mode, 0o644)
+                os.dup2(fd, stream)
+                os.close(fd)
+        env = req.get("env") or {}
+        os.environ.update(env)
+        if req.get("cwd"):
+            os.chdir(req["cwd"])
+        argv = list(req["argv"])
+        if argv[:1] == ["-m"]:
+            module, args = argv[1], argv[2:]
+        else:  # tolerate a leading interpreter path
+            i = argv.index("-m")
+            module, args = argv[i + 1], argv[i + 2:]
+        sys.argv = [module] + args
+        try:
+            runpy.run_module(module, run_name="__main__", alter_sys=True)
+            code = 0
+        except SystemExit as e:
+            code = int(e.code or 0) if not isinstance(e.code, str) else 1
+    except BaseException:  # noqa: BLE001 - report, never return to zygote loop
+        import traceback
+
+        traceback.print_exc()
+        code = 1
+    finally:
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(code)
+
+
+# Reply-pipe fd, stashed so forked children can close it (see _child).
+_REPLY_FD = [None]
+
+
+def _kill_group(pid: int) -> None:
+    """SIGTERM a child's process group, falling back to the pid itself if
+    the group does not exist yet (fork->setsid race on immediate deletes)."""
+    try:
+        os.killpg(pid, signal.SIGTERM)
+    except ProcessLookupError:
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+
+
+def main() -> int:
+    for mod in PREIMPORT:
+        try:
+            __import__(mod)
+        except Exception:  # pragma: no cover - optional preloads
+            pass
+    reply_fd = os.dup(1)
+    _REPLY_FD[0] = reply_fd
+    out = os.fdopen(reply_fd, "w", buffering=1)
+    # Reserve fd 1 for the protocol; anything the zygote itself prints goes
+    # to stderr instead.
+    os.dup2(2, 1)
+
+    out.write(json.dumps({"event": "ready"}) + "\n")
+    pids: Dict[int, int] = {}  # id -> pid
+    buf = b""
+    stdin_fd = sys.stdin.fileno()
+    while True:
+        ready, _, _ = select.select([stdin_fd], [], [], 0.05)
+        if ready:
+            chunk = os.read(stdin_fd, 65536)
+            if not chunk:
+                break  # kubelet went away: shut down
+            buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                if not line.strip():
+                    continue
+                req = json.loads(line)
+                if "kill" in req:
+                    pid = pids.get(req["kill"])
+                    if pid:
+                        _kill_group(pid)
+                    continue
+                pid = os.fork()
+                if pid == 0:
+                    _child(req)  # never returns
+                pids[req["id"]] = pid
+                out.write(json.dumps(
+                    {"id": req["id"], "event": "started", "pid": pid}) + "\n")
+        # Reap exited children.
+        for rid, pid in list(pids.items()):
+            done, status = os.waitpid(pid, os.WNOHANG)
+            if done:
+                del pids[rid]
+                out.write(json.dumps({
+                    "id": rid, "event": "exit",
+                    "code": os.waitstatus_to_exitcode(status),
+                }) + "\n")
+    for pid in pids.values():
+        _kill_group(pid)
+    deadline = time.time() + 3
+    for pid in list(pids.values()):
+        while time.time() < deadline:
+            if os.waitpid(pid, os.WNOHANG)[0]:
+                break
+            time.sleep(0.02)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,9 +18,12 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 IMAGE_PIXELS = 28 * 28
 NUM_CLASSES = 10
+
+from ..utils.rand import as_seed
 
 Params = Dict[str, jax.Array]
 
@@ -46,14 +49,24 @@ class MLPConfig:
 
 
 def mlp_init(key: jax.Array, cfg: MLPConfig = MLPConfig()) -> Params:
-    k1, k2 = jax.random.split(key)
+    """Truncated-normal init scaled by 1/sqrt(fan_in), as the reference's
+    hidden layer does (mnist_replica.py:145-152).  Host-side numpy: a jit
+    of truncated_normal costs seconds on small-CPU hosts."""
+    rng = np.random.default_rng(as_seed(key))
     dtype = jnp.dtype(cfg.dtype)
-    scale_in = IMAGE_PIXELS ** -0.5
-    scale_h = cfg.hidden ** -0.5
+
+    def trunc(shape, scale):
+        a = rng.standard_normal(size=shape)
+        bad = np.abs(a) > 2
+        while bad.any():  # rejection-resample the tails, like tf.truncated_normal
+            a[bad] = rng.standard_normal(size=int(bad.sum()))
+            bad = np.abs(a) > 2
+        return jnp.asarray((a * scale).astype(np.float32), dtype=dtype)
+
     return {
-        "w1": (jax.random.truncated_normal(k1, -2, 2, (IMAGE_PIXELS, cfg.hidden)) * scale_in).astype(dtype),
+        "w1": trunc((IMAGE_PIXELS, cfg.hidden), IMAGE_PIXELS ** -0.5),
         "b1": jnp.zeros((cfg.hidden,), dtype=dtype),
-        "w2": (jax.random.truncated_normal(k2, -2, 2, (cfg.hidden, NUM_CLASSES)) * scale_h).astype(dtype),
+        "w2": trunc((cfg.hidden, NUM_CLASSES), cfg.hidden ** -0.5),
         "b2": jnp.zeros((NUM_CLASSES,), dtype=dtype),
     }
 

@@ -1,0 +1,13 @@
+"""Seed coercion shared by host-side (numpy) initializers and data gen."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def as_seed(key_or_seed) -> int:
+    """Accept an int seed or a jax PRNGKey; a key collapses to its counter
+    word so existing PRNGKey call sites stay deterministic."""
+    if isinstance(key_or_seed, (int, np.integer)):
+        return int(key_or_seed)
+    return int(np.asarray(key_or_seed).ravel()[-1])

@@ -1,0 +1,19 @@
+"""Runnable training workloads — the contents of the user containers.
+
+The reference keeps ML compute entirely outside the controller, in example
+scripts wired up by generated CLI args (ref: examples/workdir/
+mnist_replica.py:113-141, SURVEY.md §1 "workload layer").  These modules
+are the TPU-native counterparts, launched by the fake kubelet's execute
+mode (or a real cluster) as pod commands:
+
+- ``mnist_local``  — single-process MNIST (ref: mnist_softmax.py).
+- ``mnist_dist``   — data-parallel MNIST; all-reduce over the device mesh
+  replaces the grpc PS/Worker data plane (SURVEY.md §2.4).
+- ``llama_pretrain`` — Llama-2 pretrain step driver with FSDP/TP/SP
+  shardings and Orbax checkpoint/resume via the controller-plumbed
+  MODEL_DIR.
+
+Each reads the controller's env contract through :mod:`runtime` —
+coordinator address, process count/id, TPU topology — the analog of the
+reference's ``generateTFClusterSpec`` consumption.
+"""

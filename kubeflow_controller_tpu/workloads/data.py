@@ -1,0 +1,68 @@
+"""Deterministic synthetic datasets.
+
+The build/CI environment has zero egress, so MNIST cannot be downloaded
+(the reference pulls it at runtime — ref: examples/workdir/
+mnist_softmax.py:33, input_data.read_data_sets).  Instead: a fixed random
+teacher generates a linearly-separable-ish 784->10 problem with the same
+shapes and dtypes as MNIST, so accuracy is a meaningful, reproducible
+metric; and a fixed bigram chain generates token streams with learnable
+structure for LM training.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+IMAGE_PIXELS = 28 * 28
+NUM_CLASSES = 10
+
+_TEACHER_SEED = 20180214  # reference repo's birth year/month, fixed forever
+
+# Generation is host-side numpy, not jax.random: on a small-CPU host the
+# counter-based threefry PRNG plus its jit compile costs seconds per worker
+# process — real data loaders are host-side too, and determinism only needs
+# fixed seeds.
+from ..utils.rand import as_seed as _as_seed
+
+Seed = Union[int, jax.Array]
+
+
+def synthetic_mnist(seed: Seed, n: int) -> Tuple[jax.Array, jax.Array]:
+    """n examples of (x [n,784] f32, y [n] int32): a frozen 10-component
+    Gaussian mixture (one cluster per digit class), with the component
+    scale tuned so models top out around the reference's ~0.92 local-MNIST
+    accuracy (ref: docs/get_started.md:29-38) rather than saturating."""
+    mix = np.random.default_rng(_TEACHER_SEED)
+    means = mix.standard_normal((NUM_CLASSES, IMAGE_PIXELS), dtype=np.float32) * 0.12
+    rng = np.random.default_rng(_as_seed(seed))
+    y = rng.integers(0, NUM_CLASSES, size=n)
+    x = means[y] + rng.standard_normal((n, IMAGE_PIXELS), dtype=np.float32)
+    return jnp.asarray(x), jnp.asarray(y, dtype=jnp.int32)
+
+
+def synthetic_tokens(seed: Seed, n_seqs: int, seq_len: int, vocab: int) -> jax.Array:
+    """[n_seqs, seq_len] int32 from a frozen first-order bigram chain —
+    enough structure that next-token loss drops well below log(vocab)."""
+    chain = np.random.default_rng(_TEACHER_SEED + 1)
+    # Each token strongly prefers a fixed successor.
+    succ = chain.integers(0, vocab, size=vocab)
+    rng = np.random.default_rng(_as_seed(seed))
+    out = np.empty((n_seqs, seq_len), dtype=np.int32)
+    out[:, 0] = rng.integers(0, vocab, size=n_seqs)
+    flips = rng.random((n_seqs, seq_len)) < 0.1
+    noise = rng.integers(0, vocab, size=(n_seqs, seq_len))
+    for t in range(1, seq_len):
+        out[:, t] = np.where(flips[:, t], noise[:, t], succ[out[:, t - 1]])
+    return jnp.asarray(out)
+
+
+def shard_for_process(x: jax.Array, process_id: int, num_processes: int) -> jax.Array:
+    """Static per-process slice of the leading axis — how each host of a
+    slice feeds its share of the global batch."""
+    n = x.shape[0]
+    per = n // num_processes
+    return x[process_id * per:(process_id + 1) * per]

@@ -1,0 +1,131 @@
+"""Llama pretrain driver — the flagship TPU replica workload.
+
+Judged config: "Multi-host JAX Llama-2-7B pretrain on v5p-32 slice"
+(BASELINE.json).  The controller gang-creates the slice hosts and injects
+the jax.distributed env; this driver joins the cluster, builds the global
+mesh, shards params by the logical rule table (FSDP/TP/SP), and runs a
+remat'd, donated train step with Orbax checkpoint/resume through the
+controller-plumbed MODEL_DIR.
+
+Default size is tiny so execute-mode pods finish in seconds; --preset
+llama2-7b selects the real thing on real slices.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="llama pretrain")
+    p.add_argument("--preset", choices=["tiny", "llama2-7b"], default="tiny")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch-size", type=int, default=8, help="global batch (sequences)")
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--fsdp", type=int, default=-1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--platform", default=os.environ.get("WORKLOAD_PLATFORM", ""))
+    args = p.parse_args(argv)
+
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..models import (
+        LlamaConfig,
+        llama_init,
+        llama_loss,
+        llama_param_pspecs,
+    )
+    from ..parallel import MeshSpec, build_mesh, logical_to_pspec
+    from . import data as d
+    from .runtime import JobRuntime
+    from .trainer import default_optimizer
+
+    rt = JobRuntime.from_env()
+    rt.initialize()
+
+    cfg = LlamaConfig.llama2_7b() if args.preset == "llama2-7b" else LlamaConfig.tiny(
+        max_seq_len=args.seq_len
+    )
+    mesh = build_mesh(MeshSpec(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp))
+    pspecs = llama_param_pspecs(cfg)
+
+    with jax.set_mesh(mesh):
+        init_key = jax.random.PRNGKey(0)
+        params = jax.jit(
+            lambda k: llama_init(k, cfg), out_shardings=jax.tree.map(
+                lambda s: NamedSharding(mesh, s), pspecs
+            )
+        )(init_key)
+        opt = default_optimizer(args.lr, weight_decay=0.1)
+        opt_state = opt.init(params)
+
+        start_step = 0
+        ckpt = None
+        if rt.model_dir:
+            from .checkpoint import CheckpointManager
+
+            ckpt = CheckpointManager(rt.model_dir)
+            if ckpt.latest_step() is not None:
+                params, opt_state, start_step = ckpt.restore(params, opt_state)
+                print(f"Resumed from step {start_step} in {rt.model_dir}")
+
+        batch_spec = logical_to_pspec(("batch", "seq"))
+        batch_sharding = NamedSharding(mesh, batch_spec)
+
+        def loss_fn(p, tokens):
+            return llama_loss(p, tokens, cfg, mesh=mesh)
+
+        @jax.jit
+        def step_fn(p, s, tokens):
+            loss, grads = jax.value_and_grad(loss_fn)(p, tokens)
+            updates, s = opt.update(grads, s, p)
+            p = optax.apply_updates(p, updates)
+            return p, s, loss
+
+        # Global batch must be divisible by the data-parallel extent.
+        from ..parallel.mesh import data_parallel_size
+
+        dp_size = data_parallel_size(mesh)
+        bs = max(dp_size, args.batch_size - args.batch_size % dp_size)
+        tokens_all = d.synthetic_tokens(
+            jax.random.PRNGKey(1), max(64, 2 * bs), args.seq_len, cfg.vocab_size
+        )
+        start = time.time()
+        loss = None
+        for i in range(start_step, start_step + args.steps):
+            lo = (i * bs) % max(1, tokens_all.shape[0] - bs + 1)
+            tokens = jax.device_put(tokens_all[lo:lo + bs], batch_sharding)
+            params, opt_state, loss = step_fn(params, opt_state, tokens)
+            if ckpt and args.checkpoint_every and (i + 1) % args.checkpoint_every == 0:
+                ckpt.save(i + 1, params, opt_state)
+        loss = float(loss) if loss is not None else float("nan")
+        elapsed = time.time() - start
+
+    tokens_per_s = args.steps * bs * args.seq_len / max(elapsed, 1e-9)
+    print(f"Mesh: {dict(mesh.shape)} over {jax.device_count()} devices, "
+          f"process {rt.process_id}/{rt.num_processes}")
+    print(f"Training elapsed time: {elapsed:f} s")
+    print(f"Final loss: {loss:f}; throughput: {tokens_per_s:.0f} tokens/s")
+    if ckpt:
+        ckpt.save(start_step + args.steps, params, opt_state)
+        print(f"Checkpoint saved to {rt.model_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

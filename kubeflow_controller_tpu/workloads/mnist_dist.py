@@ -1,0 +1,130 @@
+"""Distributed MNIST — the Worker/TPU replica workload.
+
+The reference wires N workers + M parameter servers over grpc and ships
+gradients to the PS every step (ref: examples/workdir/mnist_replica.py:
+113-141, 251-264).  TPU-native, the PS tier disappears: parameters are
+replicated (or sharded) over the device mesh and gradients all-reduce over
+ICI — this script is the data-parallel re-expression of the same training
+run (200 steps, batch 100 by default, matching docs/get_started.md:49-63).
+
+Roles:
+- launched with the TF-contract args the planner still generates for
+  PS/Worker replicas (``--job_name --task_index ...``): a ``ps`` role
+  parks forever, the analog of ``server.join()`` (mnist_replica.py:121-122)
+  — the data plane it used to host now rides XLA collectives;
+  a ``worker`` role trains its shard.
+- launched under the TPU replica env contract: joins via jax.distributed
+  (runtime.initialize) and trains over the global mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="distributed MNIST")
+    # TF-contract args injected by the planner (planner/materialize.py
+    # tf_cluster_args; ref: distributed.go:130-162).
+    p.add_argument("--job_name", default="")
+    p.add_argument("--task_index", type=int, default=-1)
+    p.add_argument("--worker_hosts", default="")
+    p.add_argument("--ps_hosts", default="")
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch-size", type=int, default=100, help="global batch")
+    p.add_argument("--lr", type=float, default=5e-3)
+    p.add_argument("--train-size", type=int, default=8192)
+    p.add_argument("--eval-size", type=int, default=2048)
+    p.add_argument("--target-accuracy", type=float, default=0.0)
+    p.add_argument("--platform", default=os.environ.get("WORKLOAD_PLATFORM", ""))
+    args = p.parse_args(argv)
+
+    if args.job_name == "ps":
+        # PS data plane replaced by XLA collectives; park until the gang is
+        # torn down, like server.join() (the updater ignores PS state for
+        # job success — ref: pkg/controller/updater/distributed.go:47-59).
+        # sigwait only catches signals that are blocked; unblocked, SIGTERM
+        # would run its default disposition and exit 143 instead of 0.
+        park = {signal.SIGTERM, signal.SIGINT}
+        signal.pthread_sigmask(signal.SIG_BLOCK, park)
+        signal.sigwait(park)
+        return 0
+
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..models import mnist as m
+    from ..parallel import AXIS_DATA, MeshSpec, build_mesh
+    from . import data as d
+    from .runtime import JobRuntime
+    from .trainer import batch_stack, default_optimizer, train_scan
+
+    rt = JobRuntime.from_env()
+    rt.initialize()
+
+    # Worker replicas each train their static shard of the global batch and
+    # run their own mesh over local devices; TPU replicas form one global
+    # mesh across processes.
+    workers = max(1, len(args.worker_hosts.split(",")) if args.worker_hosts else rt.num_processes)
+    worker_id = args.task_index if args.task_index >= 0 else rt.process_id
+
+    mesh = build_mesh(MeshSpec(dp=-1, fsdp=1))
+
+    x, y = d.synthetic_mnist(jax.random.PRNGKey(1), args.train_size)
+    ex, ey = d.synthetic_mnist(jax.random.PRNGKey(2), args.eval_size)
+    if args.task_index >= 0 and workers > 1:
+        # Classic worker pods are separate training processes (async-PS
+        # analog): each owns a static shard of the data.
+        x = d.shard_for_process(x, worker_id, workers)
+        y = d.shard_for_process(y, worker_id, workers)
+
+    params = m.mlp_init(jax.random.PRNGKey(0))
+    opt = default_optimizer(args.lr)
+    opt_state = opt.init(params)
+
+    # Round the global batch down to a multiple of the data-parallel size
+    # (the reference's batch 100 over e.g. 8 devices -> 96 per step).
+    dp = mesh.shape[AXIS_DATA]
+    bs = max(dp, args.batch_size - args.batch_size % dp)
+    start = time.time()
+    with jax.set_mesh(mesh):
+        xb, yb = batch_stack(x, y, args.steps, bs)
+        step_sharding = NamedSharding(mesh, P(None, AXIS_DATA))
+        batches = (
+            jax.device_put(xb, step_sharding),
+            jax.device_put(yb, step_sharding),
+        )
+        params, opt_state, loss = train_scan(
+            lambda p, b: m.mlp_loss(p, b[0], b[1]), opt, params, opt_state, batches
+        )
+        loss = float(loss)
+    elapsed = time.time() - start
+
+    acc = float(m.mlp_accuracy(params, ex, ey))
+    print(f"Worker {worker_id}/{workers} on {jax.device_count()} devices "
+          f"(mesh dp={mesh.shape[AXIS_DATA]})")
+    print(f"Training elapsed time: {elapsed:f} s")
+    print(f"Final loss: {loss:f}; eval accuracy: {acc:f}")
+    if rt.model_dir and (args.task_index <= 0 or rt.is_chief):
+        from .checkpoint import CheckpointManager
+
+        CheckpointManager(rt.model_dir).save(args.steps, params, opt_state)
+        print(f"Checkpoint saved to {rt.model_dir}")
+    if args.target_accuracy and acc < args.target_accuracy:
+        print(f"accuracy {acc} below target {args.target_accuracy}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,86 @@
+"""Single-process MNIST — the Local replica workload.
+
+Parity target: the reference's local example trains softmax regression and
+prints accuracy (ref: examples/workdir/mnist_softmax.py:44-72,
+docs/get_started.md:29-38 "0.9234 after 100k steps").  Run as the pod
+command by the kubelet's execute mode; exits 0 on success so the pod (and
+the job) reach Succeeded.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="local MNIST")
+    p.add_argument("--model", choices=["softmax", "mlp"], default="mlp")
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--lr", type=float, default=5e-3)
+    p.add_argument("--eval-size", type=int, default=2048)
+    p.add_argument("--train-size", type=int, default=8192)
+    p.add_argument("--target-accuracy", type=float, default=0.0,
+                   help="exit non-zero if final accuracy is below this")
+    p.add_argument("--platform", default=os.environ.get("WORKLOAD_PLATFORM", ""),
+                   help="force a jax platform (cpu/tpu); default: leave as is")
+    args = p.parse_args(argv)
+
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from ..models import mnist as m
+    from . import data as d
+    from .runtime import JobRuntime
+    from .trainer import batch_stack, default_optimizer, train_scan
+
+    rt = JobRuntime.from_env()
+    key = jax.random.PRNGKey(0)
+    x, y = d.synthetic_mnist(jax.random.PRNGKey(1), args.train_size)
+    ex, ey = d.synthetic_mnist(jax.random.PRNGKey(2), args.eval_size)
+
+    if args.model == "softmax":
+        params = m.softmax_init(key)
+        apply_fn = m.softmax_apply
+    else:
+        params = m.mlp_init(key)
+        apply_fn = m.mlp_apply
+
+    opt = default_optimizer(args.lr)
+    opt_state = opt.init(params)
+
+    start = time.time()
+    batches = batch_stack(x, y, args.steps, args.batch_size)
+    params, opt_state, loss = train_scan(
+        lambda p, b: m.mlp_loss(p, b[0], b[1], apply_fn=apply_fn),
+        opt, params, opt_state, batches,
+    )
+    loss = float(loss)
+    elapsed = time.time() - start
+
+    acc = float(m.mlp_accuracy(params, ex, ey, apply_fn=apply_fn))
+    # Same sign-off line format as the reference workload
+    # (ref: examples/workdir/mnist_replica.py:263 "Training elapsed time").
+    print(f"Training elapsed time: {elapsed:f} s")
+    print(f"Final loss: {loss:f}; eval accuracy: {acc:f}")
+    if rt.model_dir:
+        from .checkpoint import CheckpointManager
+
+        CheckpointManager(rt.model_dir).save(args.steps, params, opt_state)
+        print(f"Checkpoint saved to {rt.model_dir}")
+    if args.target_accuracy and acc < args.target_accuracy:
+        print(f"accuracy {acc} below target {args.target_accuracy}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

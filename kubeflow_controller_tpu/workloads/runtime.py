@@ -1,0 +1,74 @@
+"""The workload side of the controller<->workload env contract.
+
+The controller injects coordinator/topology env into TPU replica pods
+(planner/materialize.py:_wire_tpu_pod); this module consumes it — the
+analog of the reference workload parsing --worker_hosts/--task_index
+(ref: examples/workdir/mnist_replica.py:106-120) with jax.distributed in
+place of tf.train.Server.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..planner.materialize import (
+    ENV_COORDINATOR,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+    ENV_TPU_ACCELERATOR,
+    ENV_TPU_WORKER_HOSTNAMES,
+)
+
+
+@dataclass
+class JobRuntime:
+    """Everything a training process learns from its environment."""
+
+    coordinator: str = ""
+    num_processes: int = 1
+    process_id: int = 0
+    accelerator_type: str = ""
+    worker_hostnames: List[str] = field(default_factory=list)
+    data_dir: str = ""
+    model_dir: str = ""
+    log_dir: str = ""
+    export_dir: str = ""
+    _initialized: bool = False
+
+    @staticmethod
+    def from_env(env: Optional[Dict[str, str]] = None) -> "JobRuntime":
+        e = os.environ if env is None else env
+        hostnames = [h for h in e.get(ENV_TPU_WORKER_HOSTNAMES, "").split(",") if h]
+        return JobRuntime(
+            coordinator=e.get(ENV_COORDINATOR, ""),
+            num_processes=int(e.get(ENV_NUM_PROCESSES, "1") or "1"),
+            process_id=int(e.get(ENV_PROCESS_ID, "0") or "0"),
+            accelerator_type=e.get(ENV_TPU_ACCELERATOR, ""),
+            worker_hostnames=hostnames,
+            data_dir=e.get("DATA_DIR", ""),
+            model_dir=e.get("MODEL_DIR", ""),
+            log_dir=e.get("LOG_DIR", ""),
+            export_dir=e.get("EXPORT_DIR", ""),
+        )
+
+    def initialize(self) -> None:
+        """Join the job's jax.distributed cluster when it has more than one
+        process.  Single-process jobs (and the one-chip CI environment)
+        skip straight to local devices — same code path either way."""
+        if self._initialized or self.num_processes <= 1:
+            self._initialized = True
+            return
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=self.coordinator,
+            num_processes=self.num_processes,
+            process_id=self.process_id,
+        )
+        self._initialized = True
+
+    @property
+    def is_chief(self) -> bool:
+        return self.process_id == 0
