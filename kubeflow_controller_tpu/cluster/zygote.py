@@ -43,6 +43,9 @@ PREIMPORT = (
     "kubeflow_controller_tpu.workloads.mnist_local",
     "kubeflow_controller_tpu.workloads.mnist_dist",
     "kubeflow_controller_tpu.workloads.llama_pretrain",
+    "kubeflow_controller_tpu.workloads.flax_mnist",
+    "kubeflow_controller_tpu.workloads.cifar_allreduce",
+    "kubeflow_controller_tpu.models.vision",
 )
 
 

@@ -1,0 +1,137 @@
+"""Vision models in flax.linen: MNIST CNN and CIFAR ResNets.
+
+Covers the judged configs "4-worker all-reduce ResNet-50/CIFAR TFJob" and
+"JAX data-parallel Flax-MNIST via new TPU replica type" (BASELINE.json
+configs[2], configs[3]).  NHWC layout throughout — the TPU-friendly conv
+layout XLA tiles onto the MXU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+NUM_CLASSES = 10
+
+
+class FlaxMNISTCNN(nn.Module):
+    """Small convnet for 28x28x1 images — the Flax-MNIST workload model."""
+
+    features: Sequence[int] = (32, 64)
+    dense: int = 256
+
+    @nn.compact
+    def __call__(self, x):
+        for f in self.features:
+            x = nn.Conv(f, (3, 3), padding="SAME")(x)
+            x = nn.relu(x)
+            x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.dense)(x))
+        return nn.Dense(NUM_CLASSES)(x)
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    norm: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME",
+                    use_bias=False)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), self.strides,
+                               use_bias=False, name="proj")(x)
+            residual = self.norm()(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    norm: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME",
+                    use_bias=False)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False)(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters * 4, (1, 1), self.strides,
+                               use_bias=False, name="proj")(x)
+            residual = self.norm()(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """CIFAR-style ResNet: 3x3 stem, no max-pool (32x32 inputs)."""
+
+    stage_sizes: Sequence[int]
+    block: Any
+    num_classes: int = NUM_CLASSES
+    width: int = 64
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5)
+        x = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False,
+                    name="stem")(x)
+        x = norm()(x)
+        x = nn.relu(x)
+        for stage, size in enumerate(self.stage_sizes):
+            for b in range(size):
+                strides = (2, 2) if stage > 0 and b == 0 else (1, 1)
+                x = self.block(self.width * 2 ** stage, strides, norm)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, name="head")(x)
+
+
+def resnet18(**kw) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), block=ResNetBlock, **kw)
+
+
+def resnet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=BottleneckBlock, **kw)
+
+
+def vision_init(model: nn.Module, key: jax.Array, sample_shape) -> dict:
+    """-> variables {"params": ..., maybe "batch_stats": ...}."""
+    return model.init(key, jnp.zeros((1, *sample_shape), jnp.float32))
+
+
+def vision_loss(
+    model: nn.Module, variables: dict, x: jax.Array, y: jax.Array
+) -> Tuple[jax.Array, dict]:
+    """Mean CE; returns (loss, new_batch_stats or {})."""
+    has_bn = "batch_stats" in variables
+    if has_bn:
+        logits, mut = model.apply(variables, x, mutable=["batch_stats"])
+    else:
+        logits, mut = model.apply(variables, x), {}
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
+    return loss, mut
+
+
+def vision_accuracy(model: nn.Module, variables: dict, x, y) -> jax.Array:
+    kwargs = {"train": False} if "batch_stats" in variables else {}
+    logits = model.apply(variables, x, **kwargs)
+    return jnp.mean(jnp.argmax(logits, -1) == y)

@@ -107,6 +107,29 @@ def test_failing_workload_marks_job_failed(rig):
     wait_phase(cluster, "exec-fail", TFJobPhase.FAILED)
 
 
+def test_worker_only_allreduce_job(rig):
+    """The no-PS judged config (BASELINE.json configs[2]): a single Worker
+    spec plans and runs — the reference's planner hardcoded exactly two
+    replica specs (ref: distributed.go:201-209) and could not express this."""
+    cluster, _, _ = rig
+    job = mk_exec_job(
+        "exec-allreduce", "cifar_allreduce",
+        "--model", "cnn", "--steps", "4", "--batch-size", "16",
+        "--train-size", "128", "--eval-size", "64",
+        typ=ReplicaType.WORKER, replicas=2, restart="OnFailure",
+    )
+    cluster.tfjobs.create(job)
+    wait_phase(cluster, "exec-allreduce", TFJobPhase.SUCCEEDED, timeout=180.0)
+    # Worker pods got the TF-contract args with no --ps_hosts.
+    pods = [p for p in cluster.pods.list("default")
+            if p.metadata.labels.get("job_type") == "Worker"]
+    assert len(pods) == 2
+    for p in pods:
+        args = p.spec.containers[0].args
+        assert any(a.startswith("--worker_hosts=") for a in args)
+        assert not any(a.startswith("--ps_hosts=") for a in args)
+
+
 def test_tpu_job_executes_llama_with_checkpoint(rig, tmp_path):
     cluster, _, _ = rig
     model_dir = str(tmp_path / "llama-ck")
