@@ -28,6 +28,7 @@ from .llama import (
     llama_param_logical_axes,
     llama_param_pspecs,
 )
+from .generate import forward_with_cache, generate, init_cache
 
 __all__ = [
     "MLPConfig",
@@ -43,4 +44,7 @@ __all__ = [
     "llama_loss",
     "llama_param_logical_axes",
     "llama_param_pspecs",
+    "forward_with_cache",
+    "generate",
+    "init_cache",
 ]

@@ -1,0 +1,129 @@
+"""KV-cache decoding and generation for the Llama decoder.
+
+Training uses dense causal attention (llama.py); inference keeps a static
+[L, B, S, kv_heads, head_dim] cache and attends each new token against the
+written prefix under an absolute-position mask — static shapes throughout,
+so the whole generate loop jits as one ``lax.scan`` (no per-token Python
+dispatch, no recompilation per length).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .llama import LlamaConfig, apply_rope, rmsnorm, rope_freqs
+
+Cache = Dict[str, jax.Array]
+NEG_INF = -1e30
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Cache:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    dtype = jnp.dtype(cfg.dtype)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def forward_with_cache(
+    params,
+    tokens: jax.Array,
+    cache: Cache,
+    start_pos,
+    cfg: LlamaConfig,
+) -> Tuple[jax.Array, Cache]:
+    """tokens [B, T] appended at absolute position ``start_pos`` (traced ok).
+    Returns (logits [B, T, vocab] f32, updated cache)."""
+    dtype = jnp.dtype(cfg.dtype)
+    B, T = tokens.shape
+    S = cache["k"].shape[2]
+    x = params["embed"][tokens].astype(dtype)
+    positions = start_pos + jnp.arange(T)
+    angles = rope_freqs(cfg, positions)  # K is written pre-rotated
+    repeats = cfg.n_heads // cfg.n_kv_heads
+
+    q_pos = positions[:, None]                      # [T, 1]
+    kv_pos = jnp.arange(S)[None, :]                 # [1, S]
+    mask = (kv_pos <= q_pos)[None, None, :, :]      # [1,1,T,S]
+
+    def layer(x, scanned):
+        lp, kc, vc = scanned                        # kc/vc: [B, S, kvH, D]
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dtype))
+        k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dtype))
+        v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dtype))
+        q = apply_rope(q, angles)
+        k = apply_rope(k, angles)
+        kc = jax.lax.dynamic_update_slice_in_dim(kc, k.astype(kc.dtype), start_pos, axis=1)
+        vc = jax.lax.dynamic_update_slice_in_dim(vc, v.astype(vc.dtype), start_pos, axis=1)
+        kk, vv = kc, vc
+        if repeats > 1:
+            kk = jnp.repeat(kk, repeats, axis=2)
+            vv = jnp.repeat(vv, repeats, axis=2)
+        s = jnp.einsum("bthd,bshd->bhts", q, kk,
+                       preferred_element_type=jnp.float32) * cfg.head_dim ** -0.5
+        s = jnp.where(mask, s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bhts,bshd->bthd", p, vv.astype(jnp.float32)).astype(dtype)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))
+
+        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        ff = jax.nn.silu(jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dtype))) \
+            * jnp.einsum("btd,df->btf", h, lp["w_up"].astype(dtype))
+        x = x + jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(dtype))
+        return x, (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(dtype))
+    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+
+
+def _sample(logits, key, temperature: float, top_k: Optional[int]):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None:
+        thresh = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < thresh, NEG_INF, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def generate(
+    params,
+    prompt: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """prompt [B, T_p] -> [B, T_p + max_new_tokens].  Greedy when
+    temperature == 0.  The decode loop is one jitted scan."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    B, T_p = prompt.shape
+    max_len = T_p + max_new_tokens
+    cache = init_cache(cfg, B, max_len)
+
+    logits, cache = forward_with_cache(params, prompt, cache, 0, cfg)
+    k0, key = jax.random.split(key)
+    first = _sample(logits[:, -1], k0, temperature, top_k)
+
+    def step(carry, key_t):
+        cache, tok, pos = carry
+        logits, cache = forward_with_cache(params, tok[:, None], cache, pos, cfg)
+        nxt = _sample(logits[:, -1], key_t, temperature, top_k)
+        return (cache, nxt, pos + 1), nxt
+
+    # The prefill already sampled token 1 of max_new; the scan produces the
+    # remaining max_new - 1 (each step's forward feeds the NEXT sample, so
+    # no step's compute is discarded).
+    keys = jax.random.split(key, max_new_tokens - 1)
+    _, rest = jax.lax.scan(step, (cache, first, T_p), keys)
+    generated = jnp.concatenate([first[:, None], rest.T], axis=1)
+    return jnp.concatenate([prompt, generated], axis=1)

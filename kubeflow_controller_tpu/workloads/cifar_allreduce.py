@@ -49,7 +49,7 @@ def main(argv=None) -> int:
     from ..parallel import AXIS_DATA, MeshSpec, build_mesh
     from . import data as d
     from .runtime import JobRuntime
-    from .trainer import batch_stack
+    from .trainer import batch_stack, train_scan_stateful
 
     rt = JobRuntime.from_env()
     rt.initialize()
@@ -79,36 +79,20 @@ def main(argv=None) -> int:
     opt = optax.sgd(args.lr, momentum=0.9)
     opt_state = opt.init(params)
 
-    def body(carry, batch):
-        params, batch_stats, opt_state = carry
+    def loss_fn(p, batch, batch_stats):
         bx, by = batch
-
-        def loss_fn(p):
-            vars_in = {"params": p, **(
-                {"batch_stats": batch_stats} if batch_stats else {})}
-            loss, mut = v.vision_loss(model, vars_in, bx, by)
-            return loss, mut
-
-        (loss, mut), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        if mut:
-            batch_stats = mut["batch_stats"]
-        return (params, batch_stats, opt_state), loss
-
-    @jax.jit
-    def run(params, batch_stats, opt_state, batches):
-        (params, batch_stats, opt_state), losses = jax.lax.scan(
-            body, (params, batch_stats, opt_state), batches)
-        return params, batch_stats, opt_state, losses[-1]
+        vars_in = {"params": p, **(
+            {"batch_stats": batch_stats} if batch_stats else {})}
+        loss, mut = v.vision_loss(model, vars_in, bx, by)
+        return loss, (mut["batch_stats"] if mut else batch_stats)
 
     start = time.time()
     with jax.set_mesh(mesh):
         xb, yb = batch_stack(x, y, args.steps, bs)
         sharding = NamedSharding(mesh, P(None, AXIS_DATA))
         batches = (jax.device_put(xb, sharding), jax.device_put(yb, sharding))
-        params, batch_stats, opt_state, loss = run(
-            params, batch_stats, opt_state, batches)
+        params, batch_stats, opt_state, loss = train_scan_stateful(
+            loss_fn, opt, params, opt_state, batch_stats, batches)
         loss = float(loss)
     elapsed = time.time() - start
 

@@ -24,6 +24,37 @@ def make_train_step(
     return jax.jit(step, donate_argnums=(0, 1))
 
 
+def train_scan_stateful(
+    loss_fn: Callable[[Any, Any, Any], Tuple[jax.Array, Any]],
+    optimizer: optax.GradientTransformation,
+    params: Any,
+    opt_state: Any,
+    state: Any,
+    batches: Any,
+) -> Tuple[Any, Any, Any, jax.Array]:
+    """Whole training loop as ONE jitted ``lax.scan`` over stacked batches —
+    a single dispatch instead of one per step, which matters enormously for
+    small models where per-step Python/dispatch overhead rivals the math.
+
+    ``loss_fn(params, batch, state) -> (loss, new_state)`` threads mutable
+    model state (e.g. BatchNorm statistics) through the scan.
+    Returns (params, state, opt_state, last_loss)."""
+
+    def body(carry, batch):
+        p, st, s = carry
+        (loss, st), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, batch, st)
+        updates, s = optimizer.update(grads, s, p)
+        p = optax.apply_updates(p, updates)
+        return (p, st, s), loss
+
+    @jax.jit
+    def run(p, st, s, batches):
+        (p, st, s), losses = jax.lax.scan(body, (p, st, s), batches)
+        return p, st, s, losses[-1]
+
+    return run(params, state, opt_state, batches)
+
+
 def train_scan(
     loss_fn: Callable[[Any, Any], jax.Array],
     optimizer: optax.GradientTransformation,
@@ -31,24 +62,13 @@ def train_scan(
     opt_state: Any,
     batches: Any,
 ) -> Tuple[Any, Any, jax.Array]:
-    """Run the whole training loop as ONE jitted ``lax.scan`` over stacked
-    batches — a single dispatch instead of one per step, which matters
-    enormously for small models where per-step Python/dispatch overhead
-    rivals the math.  Returns (params, opt_state, last_loss)."""
-
-    def body(carry, batch):
-        p, s = carry
-        loss, grads = jax.value_and_grad(loss_fn)(p, batch)
-        updates, s = optimizer.update(grads, s, p)
-        p = optax.apply_updates(p, updates)
-        return (p, s), loss
-
-    @jax.jit
-    def run(p, s, batches):
-        (p, s), losses = jax.lax.scan(body, (p, s), batches)
-        return p, s, losses[-1]
-
-    return run(params, opt_state, batches)
+    """Stateless variant of :func:`train_scan_stateful`.
+    Returns (params, opt_state, last_loss)."""
+    params, _, opt_state, loss = train_scan_stateful(
+        lambda p, b, st: (loss_fn(p, b), st),
+        optimizer, params, opt_state, None, batches,
+    )
+    return params, opt_state, loss
 
 
 def batch_stack(x: jax.Array, y: jax.Array, steps: int, batch_size: int):
