@@ -69,9 +69,17 @@ def forward_with_cache(
         x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))
 
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-        ff = jax.nn.silu(jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dtype))) \
-            * jnp.einsum("btd,df->btf", h, lp["w_up"].astype(dtype))
-        x = x + jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(dtype))
+        if cfg.n_experts:
+            from .moe import moe_ffn
+
+            out = moe_ffn(h, lp["router"], lp["w_gate"], lp["w_up"],
+                          lp["w_down"], top_k=cfg.moe_top_k,
+                          capacity_factor=cfg.capacity_factor)
+        else:
+            ff = jax.nn.silu(jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dtype))) \
+                * jnp.einsum("btd,df->btf", h, lp["w_up"].astype(dtype))
+            out = jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(dtype))
+        x = x + out
         return x, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(
@@ -104,6 +112,8 @@ def generate(
 ) -> jax.Array:
     """prompt [B, T_p] -> [B, T_p + max_new_tokens].  Greedy when
     temperature == 0.  The decode loop is one jitted scan."""
+    if max_new_tokens <= 0:
+        return prompt
     if key is None:
         key = jax.random.PRNGKey(0)
     B, T_p = prompt.shape

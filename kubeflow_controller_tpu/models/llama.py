@@ -51,6 +51,10 @@ class LlamaConfig:
     dtype: str = "bfloat16"        # activation dtype
     param_dtype: str = "float32"
     remat: bool = True
+    # Mixture-of-Experts (0 = dense FFN).  Experts shard over the ep axis.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -85,6 +89,20 @@ def llama_init(key: jax.Array, cfg: LlamaConfig) -> Params:
 
     resid_scale = 0.02 / (2 * cfg.n_layers) ** 0.5
     L = cfg.n_layers
+    if cfg.n_experts:
+        E = cfg.n_experts
+        ffn = {
+            "router": norm(keys[9], (L, cfg.dim, E)),
+            "w_gate": norm(keys[5], (L, E, cfg.dim, cfg.intermediate)),
+            "w_up": norm(keys[6], (L, E, cfg.dim, cfg.intermediate)),
+            "w_down": norm(keys[7], (L, E, cfg.intermediate, cfg.dim), scale=resid_scale),
+        }
+    else:
+        ffn = {
+            "w_gate": norm(keys[5], (L, cfg.dim, cfg.intermediate)),
+            "w_up": norm(keys[6], (L, cfg.dim, cfg.intermediate)),
+            "w_down": norm(keys[7], (L, cfg.intermediate, cfg.dim), scale=resid_scale),
+        }
     return {
         "embed": norm(keys[0], (cfg.vocab_size, cfg.dim)),
         "layers": {
@@ -94,9 +112,7 @@ def llama_init(key: jax.Array, cfg: LlamaConfig) -> Params:
             "wv": norm(keys[3], (L, cfg.dim, nkv, hd)),
             "wo": norm(keys[4], (L, nh, hd, cfg.dim), scale=resid_scale),
             "mlp_norm": jnp.ones((L, cfg.dim), dtype=dtype),
-            "w_gate": norm(keys[5], (L, cfg.dim, cfg.intermediate)),
-            "w_up": norm(keys[6], (L, cfg.dim, cfg.intermediate)),
-            "w_down": norm(keys[7], (L, cfg.intermediate, cfg.dim), scale=resid_scale),
+            **ffn,
         },
         "final_norm": jnp.ones((cfg.dim,), dtype=dtype),
         "lm_head": norm(keys[8], (cfg.dim, cfg.vocab_size)),
@@ -105,7 +121,19 @@ def llama_init(key: jax.Array, cfg: LlamaConfig) -> Params:
 
 def llama_param_logical_axes(cfg: LlamaConfig) -> Params:
     """Logical axis names per param, mirroring the param tree."""
-    del cfg
+    if cfg.n_experts:
+        ffn = {
+            "router": ("layers", "embed", None),
+            "w_gate": ("layers", "expert", "embed", "mlp"),
+            "w_up": ("layers", "expert", "embed", "mlp"),
+            "w_down": ("layers", "expert", "mlp", "embed"),
+        }
+    else:
+        ffn = {
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        }
     return {
         "embed": ("vocab", "embed"),
         "layers": {
@@ -115,9 +143,7 @@ def llama_param_logical_axes(cfg: LlamaConfig) -> Params:
             "wv": ("layers", "embed", "kv_heads", "head_dim"),
             "wo": ("layers", "heads", "head_dim", "embed"),
             "mlp_norm": ("layers", None),
-            "w_gate": ("layers", "embed", "mlp"),
-            "w_up": ("layers", "embed", "mlp"),
-            "w_down": ("layers", "mlp", "embed"),
+            **ffn,
         },
         "final_norm": (None,),
         "lm_head": ("embed", "vocab"),
@@ -190,6 +216,20 @@ def llama_forward(
     x = params["embed"][tokens].astype(dtype)
     x = with_logical_constraint(x, ("batch", "seq", None), rules)
     angles = rope_freqs(cfg, jnp.arange(T))
+    layer = _decoder_layer_fn(cfg, angles, mesh, rules)
+
+    layer_fn = jax.checkpoint(layer) if cfg.remat else layer
+    x, _ = jax.lax.scan(lambda carry, lp: layer_fn(carry, lp), x, params["layers"])
+
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(dtype))
+    logits = with_logical_constraint(logits, ("batch", "seq", "vocab"), rules)
+    return logits.astype(jnp.float32)
+
+
+def _decoder_layer_fn(cfg: LlamaConfig, angles, mesh, rules):
+    """One decoder layer as a scan-compatible ``(x, lp) -> (x, None)``."""
+    dtype = jnp.dtype(cfg.dtype)
     repeats = cfg.n_heads // cfg.n_kv_heads
 
     def layer(x, lp):
@@ -209,16 +249,62 @@ def llama_forward(
         x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))
 
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-        gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dtype))
-        up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(dtype))
-        ff = jax.nn.silu(gate) * up
-        ff = with_logical_constraint(ff, ("batch", "seq", "mlp"), rules)
-        x = x + jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(dtype))
+        if cfg.n_experts:
+            from .moe import moe_ffn
+
+            out = moe_ffn(
+                h, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+                top_k=cfg.moe_top_k, capacity_factor=cfg.capacity_factor,
+                rules=rules,
+            )
+        else:
+            gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dtype))
+            up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(dtype))
+            ff = jax.nn.silu(gate) * up
+            ff = with_logical_constraint(ff, ("batch", "seq", "mlp"), rules)
+            out = jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(dtype))
+        x = x + out
         x = with_logical_constraint(x, ("batch", "seq", None), rules)
         return x, None
 
+    return layer
+
+
+def llama_forward_pp(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    *,
+    n_microbatches: int = 2,
+    rules: ShardingRules = DEFAULT_RULES,
+) -> jax.Array:
+    """Pipeline-parallel forward: layers split into ``pp`` stages, the
+    batch into microbatches streaming GPipe-style (parallel/pipeline.py).
+    Degenerates to the plain forward when the pp axis has size 1."""
+    from ..parallel.mesh import AXIS_PIPELINE
+    from ..parallel.pipeline import gpipe, split_stages
+
+    dtype = jnp.dtype(cfg.dtype)
+    B, T = tokens.shape
+    if B % n_microbatches:
+        raise ValueError(f"batch {B} not divisible by {n_microbatches} microbatches")
+    x = params["embed"][tokens].astype(dtype)
+    angles = rope_freqs(cfg, jnp.arange(T))
+    # Inside the pipeline body only the pp axis is manual; attention must
+    # not re-enter shard_map, so force the plain-attention path.
+    layer = _decoder_layer_fn(cfg, angles, None, rules)
     layer_fn = jax.checkpoint(layer) if cfg.remat else layer
-    x, _ = jax.lax.scan(lambda carry, lp: layer_fn(carry, lp), x, params["layers"])
+
+    def stage_fn(stage_layers, xm):
+        out, _ = jax.lax.scan(lambda c, lp: layer_fn(c, lp), xm, stage_layers)
+        return out
+
+    S = mesh.shape[AXIS_PIPELINE]
+    stages = split_stages(params["layers"], S)
+    micro = x.reshape(n_microbatches, B // n_microbatches, T, -1)
+    out = gpipe(stage_fn, stages, micro, mesh)
+    x = out.reshape(B, T, -1)
 
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(dtype))

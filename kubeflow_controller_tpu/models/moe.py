@@ -1,0 +1,102 @@
+"""Mixture-of-Experts FFN with expert parallelism over the ``ep`` mesh axis.
+
+GShard/Switch-style capacity dispatch, all einsums so XLA tiles everything
+onto the MXU and inserts the all-to-all-equivalent collectives from the
+shardings: tokens are routed top-k, given positions inside each expert's
+fixed capacity buffer (overflow drops, the standard trade), dispatched with
+a one-hot tensor, transformed by per-expert SwiGLU weights (expert dim
+sharded over ``ep``), and combined weighted by the router probabilities.
+
+The reference has no MoE (SURVEY.md §2.4: EP absent); this is net-new
+capability that makes the ``ep`` mesh axis real.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.sharding import DEFAULT_RULES, ShardingRules, with_logical_constraint
+
+
+def router_topk(
+    logits: jax.Array, k: int
+) -> Tuple[jax.Array, jax.Array]:
+    """[..., E] router logits -> (probs [..., k], indices [..., k]).
+    Probabilities are softmaxed over the selected k (Mixtral convention)."""
+    vals, idx = jax.lax.top_k(logits, k)
+    return jax.nn.softmax(vals, axis=-1), idx
+
+
+def moe_ffn(
+    x: jax.Array,
+    router_w: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    capacity: int = 0,
+    rules: ShardingRules = DEFAULT_RULES,
+) -> jax.Array:
+    """x [B, T, D]; router_w [D, E]; w_gate/w_up [E, D, F]; w_down [E, F, D].
+
+    Returns [B, T, D].  Capacity per expert C = ceil(T * top_k / E *
+    capacity_factor) unless ``capacity`` pins it explicitly; tokens routed
+    past an expert's capacity are dropped (contribute zero), as in
+    Switch/GShard.  Note the T-dependence: a T=1 decode step never drops
+    (top-k experts are distinct) while a long prefill might, so cached and
+    dense paths agree exactly only when nothing overflows — pin
+    ``capacity`` to make paths bit-identical under overflow.
+    """
+    import math
+
+    B, T, D = x.shape
+    E = router_w.shape[-1]
+    C = capacity or max(1, math.ceil(T * top_k / E * capacity_factor))
+    dtype = x.dtype
+
+    logits = jnp.einsum("btd,de->bte", x, router_w.astype(dtype)).astype(jnp.float32)
+    probs, idx = router_topk(logits, top_k)           # [B,T,k]
+
+    # One-hot expert assignment per routing slot: [B, T, k, E].
+    assign = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+    # Position of each (token, slot) inside its expert's buffer, counted in
+    # routing order over the flattened (T, k) axis: [B, T, k, E].
+    flat = assign.reshape(B, T * top_k, E)
+    pos_flat = jnp.cumsum(flat, axis=1) - flat        # exclusive cumsum
+    pos = pos_flat.reshape(B, T, top_k, E)
+    keep = (pos < C) * assign                         # drop overflow
+    # Dispatch/combine tensors: [B, T, E, C].
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)  # [B,T,k,E,C]
+    dispatch = jnp.einsum("btke,btkec->btec", keep, pos_oh)
+    combine = jnp.einsum("btk,btke,btkec->btec", probs, keep, pos_oh)
+
+    # Expert buffers [B, E, C, D], expert dim sharded over ep.
+    xe = jnp.einsum("btec,btd->becd", dispatch.astype(dtype), x)
+    xe = with_logical_constraint(xe, ("batch", "expert", None, None), rules)
+    gate = jnp.einsum("becd,edf->becf", xe, w_gate.astype(dtype))
+    up = jnp.einsum("becd,edf->becf", xe, w_up.astype(dtype))
+    h = jax.nn.silu(gate) * up
+    ye = jnp.einsum("becf,efd->becd", h, w_down.astype(dtype))
+    ye = with_logical_constraint(ye, ("batch", "expert", None, None), rules)
+    return jnp.einsum("btec,becd->btd", combine.astype(dtype), ye)
+
+
+def moe_ffn_reference(x, router_w, w_gate, w_up, w_down, *, top_k: int = 2):
+    """Dense oracle: every token computed through its top-k experts with no
+    capacity limit — the numerics target when nothing overflows."""
+    B, T, D = x.shape
+    E = router_w.shape[-1]
+    logits = jnp.einsum("btd,de->bte", x, router_w).astype(jnp.float32)
+    probs, idx = router_topk(logits, top_k)
+    # Compute all experts densely: [B,T,E,D] -> weighted sum of selected.
+    gate = jnp.einsum("btd,edf->btef", x, w_gate)
+    up = jnp.einsum("btd,edf->btef", x, w_up)
+    h = jax.nn.silu(gate) * up
+    y_all = jnp.einsum("btef,efd->bted", h, w_down)
+    sel = jnp.einsum("btk,btke->bte", probs, jax.nn.one_hot(idx, E, dtype=probs.dtype))
+    return jnp.einsum("bte,bted->btd", sel.astype(x.dtype), y_all)

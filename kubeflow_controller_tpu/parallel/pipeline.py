@@ -1,0 +1,93 @@
+"""Pipeline parallelism over the ``pp`` mesh axis (GPipe schedule).
+
+The layer stack (already stacked on a leading axis for ``lax.scan``) is
+split into S contiguous stages; stage params carry a leading stage axis
+sharded over ``pp``, and the schedule is expressed as plain SPMD: a
+``vmap`` over the stage axis computes every stage's current microbatch in
+parallel (each pp device computes exactly its stage), and ``jnp.roll``
+along the stage axis hands activations to the next stage — XLA lowers the
+roll of a pp-sharded array to a collective permute over ICI.  S-1 bubble
+steps at each end, the classic GPipe trade; no shard_map, so the other
+mesh axes (dp/fsdp/sp/tp/ep) keep sharding inside each stage as usual.
+
+The reference has no pipeline concept (SURVEY.md §2.4); this makes the
+declared ``pp`` axis real.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import AXIS_PIPELINE
+
+
+def split_stages(stacked_params: Any, n_stages: int) -> Any:
+    """[L, ...] stacked layer params -> [S, L//S, ...]."""
+
+    def reshape(a):
+        L = a.shape[0]
+        if L % n_stages:
+            raise ValueError(f"{L} layers not divisible by {n_stages} stages")
+        return a.reshape(n_stages, L // n_stages, *a.shape[1:])
+
+    return jax.tree.map(reshape, stacked_params)
+
+
+def _constrain_pp(x, axis_name: str):
+    from .sharding import _mesh_axes_in_scope
+
+    if not _mesh_axes_in_scope():
+        return x  # eager single-device tests: nothing to constrain
+    return jax.lax.with_sharding_constraint(x, P(axis_name))
+
+
+def gpipe(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = AXIS_PIPELINE,
+) -> jax.Array:
+    """Run ``stage_fn(params_for_stage, x) -> y`` as a pipeline.
+
+    ``stage_params``: pytree with leading stage axis S (see split_stages).
+    ``microbatches``: [n_micro, ...] activations fed to stage 0.
+    Returns [n_micro, ...] outputs of the last stage.
+    """
+    S = jax.tree.leaves(stage_params)[0].shape[0]
+    if mesh is not None and axis_name in mesh.shape:
+        assert mesh.shape[axis_name] in (1, S), (
+            f"stage axis {S} vs pp mesh size {mesh.shape[axis_name]}"
+        )
+    n_micro = microbatches.shape[0]
+    if S == 1:
+        params = jax.tree.map(lambda a: a[0], stage_params)
+        return jax.vmap(lambda x: stage_fn(params, x))(microbatches)
+
+    # Shard the stage axis of the params over pp so each device holds (and
+    # computes with) only its own stage's weights — the memory point of
+    # pipeline parallelism.
+    stage_params = jax.tree.map(lambda a: _constrain_pp(a, axis_name), stage_params)
+    vstage = jax.vmap(stage_fn)
+    zero = jnp.zeros_like(microbatches[0])
+    # act[s] = activation currently entering stage s.
+    act = jnp.broadcast_to(zero, (S, *zero.shape))
+    act = _constrain_pp(act, axis_name)
+    out = jnp.zeros_like(microbatches)
+
+    for t in range(n_micro + S - 1):
+        feed = microbatches[min(t, n_micro - 1)]
+        act = act.at[0].set(jnp.where(t < n_micro, feed, act[0]))
+        y = vstage(stage_params, act)
+        y = _constrain_pp(y, axis_name)
+        pos = t - (S - 1)
+        if pos >= 0:
+            out = out.at[pos].set(y[-1])
+        # y[s] becomes the input of stage s+1 (roll -> collective permute).
+        act = jnp.roll(y, 1, axis=0)
+    return out

@@ -1,0 +1,103 @@
+"""MoE: capacity dispatch vs dense oracle, llama integration, ep sharding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_controller_tpu.models import LlamaConfig, llama_init, llama_loss, llama_forward
+from kubeflow_controller_tpu.models.generate import forward_with_cache, init_cache
+from kubeflow_controller_tpu.models.llama import llama_param_pspecs
+from kubeflow_controller_tpu.models.moe import moe_ffn, moe_ffn_reference
+from kubeflow_controller_tpu.parallel import MeshSpec, build_mesh
+
+
+def _weights(key, D=16, E=4, F=32):
+    ks = jax.random.split(key, 4)
+    return (
+        jax.random.normal(ks[0], (D, E)) * 0.3,
+        jax.random.normal(ks[1], (E, D, F)) * 0.1,
+        jax.random.normal(ks[2], (E, D, F)) * 0.1,
+        jax.random.normal(ks[3], (E, F, D)) * 0.1,
+    )
+
+
+class TestMoEFFN:
+    def test_matches_dense_oracle_with_ample_capacity(self):
+        """With capacity >= T*k no token drops, so the einsum dispatch must
+        reproduce the dense computation exactly."""
+        router, wg, wu, wd = _weights(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
+        out = moe_ffn(x, router, wg, wu, wd, top_k=2, capacity_factor=100.0)
+        ref = moe_ffn_reference(x, router, wg, wu, wd, top_k=2)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_capacity_drops_are_bounded(self):
+        """Tight capacity zeroes some tokens' outputs but never corrupts the
+        kept ones (each kept slot still matches the oracle's per-slot term)."""
+        router, wg, wu, wd = _weights(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 16, 16))
+        out_tight = moe_ffn(x, router, wg, wu, wd, top_k=1, capacity_factor=0.5)
+        out_full = moe_ffn(x, router, wg, wu, wd, top_k=1, capacity_factor=100.0)
+        # Tight output is a per-token subset: each token either matches the
+        # full result or is exactly zero (dropped).
+        o_t, o_f = np.asarray(out_tight[0]), np.asarray(out_full[0])
+        for t in range(16):
+            assert (
+                np.allclose(o_t[t], o_f[t], atol=1e-5)
+                or np.allclose(o_t[t], 0.0, atol=1e-6)
+            ), t
+
+    def test_grads_flow(self):
+        router, wg, wu, wd = _weights(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
+
+        def loss(w):
+            return jnp.sum(moe_ffn(x, w[0], w[1], w[2], w[3]) ** 2)
+
+        g = jax.grad(loss)((router, wg, wu, wd))
+        assert all(float(jnp.linalg.norm(gi)) > 0 for gi in g)
+
+
+class TestMoELlama:
+    def cfg(self):
+        return LlamaConfig.tiny(n_experts=4, moe_top_k=2)
+
+    def test_forward_and_loss(self):
+        cfg = self.cfg()
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        assert params["layers"]["w_gate"].shape == (2, 4, 64, 128)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+        logits = llama_forward(params, tokens, cfg)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        loss = llama_loss(params, tokens, cfg)
+        assert float(loss) > 0
+
+    def test_decode_matches_dense(self):
+        cfg = self.cfg()
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 8), 0, cfg.vocab_size)
+        dense = llama_forward(params, tokens, cfg)
+        cache = init_cache(cfg, 1, 8)
+        cached, _ = forward_with_cache(params, tokens, cache, 0, cfg)
+        # MoE routing depends on position within the forward batch; prefill
+        # processes the same 8 tokens in one block, so results must agree.
+        np.testing.assert_allclose(np.asarray(cached), np.asarray(dense),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_ep_sharded_matches_unsharded(self):
+        cfg = LlamaConfig.tiny(n_experts=4, moe_top_k=2, remat=False)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0, cfg.vocab_size)
+        ref = llama_forward(params, tokens, cfg)
+        mesh = build_mesh(MeshSpec(dp=1, fsdp=2, ep=2, tp=2, sp=1))
+        pspecs = llama_param_pspecs(cfg)
+        sharded = jax.tree.map(
+            lambda a, s: jax.device_put(a, jax.sharding.NamedSharding(mesh, s)),
+            params, pspecs,
+        )
+        with jax.set_mesh(mesh):
+            out = jax.jit(lambda p, t: llama_forward(p, t, cfg, mesh=mesh))(
+                sharded, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
