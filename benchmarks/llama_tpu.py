@@ -1,0 +1,102 @@
+"""Llama pretrain throughput on real TPU.
+
+Method notes (important on tunneled/relayed TPU backends): repeated
+dispatch of one jitted step can pipeline asynchronously and report
+impossible speeds — ``block_until_ready`` alone is not a trustworthy
+barrier through the relay.  So K optimizer steps run inside ONE jitted
+``lax.scan`` and the final loss is read back to the host, which forces
+completion of the whole chain; per-call overhead amortizes across K.
+
+FLOP accounting is 6*N*D (params x tokens, fwd+bwd, no remat recompute
+counted) — the standard "model FLOPs" so numbers compare across
+frameworks; with full remat the hardware additionally executes ~1 extra
+forward (~8ND total).
+
+Measured on v5e (1 chip, bf16, full remat), 953M-param Llama
+(dim 2048, L16, H16, inter 5632, T 1024):
+  B=16: ~15.6k tokens/s, ~89 model-TFLOP/s (6ND) == ~60% of bf16 peak
+        counting the remat recompute.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def run(batch: int, seq: int, steps: int, dim: int, layers: int, heads: int,
+        intermediate: int, policy: str) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubeflow_controller_tpu.models import LlamaConfig, llama_init, llama_loss
+    from kubeflow_controller_tpu.parallel import MeshSpec, build_mesh
+
+    cfg = LlamaConfig(
+        vocab_size=32000, dim=dim, n_layers=layers, n_heads=heads,
+        n_kv_heads=heads, intermediate=intermediate, max_seq_len=seq,
+        dtype="bfloat16", param_dtype="bfloat16", remat=True,
+        remat_policy=policy,
+    )
+    mesh = build_mesh(MeshSpec(fsdp=-1))
+    params = jax.jit(lambda k: llama_init(k, cfg))(jax.random.PRNGKey(0))
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    opt = optax.adafactor(3e-4)
+    opt_state = opt.init(params)
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1), (steps, batch, seq), 0, cfg.vocab_size)
+
+    with jax.set_mesh(mesh):
+        @jax.jit
+        def run_steps(p, s, toks):
+            def body(carry, t):
+                p, s = carry
+                loss, g = jax.value_and_grad(
+                    lambda p: llama_loss(p, t, cfg, mesh=mesh))(p)
+                u, s = opt.update(g, s, p)
+                return (optax.apply_updates(p, u), s), loss
+
+            (p, s), losses = jax.lax.scan(body, (p, s), toks)
+            return p, s, losses[-1]
+
+        _, _, loss = run_steps(params, opt_state, toks)
+        float(loss)  # compile + complete
+        t0 = time.time()
+        _, _, loss = run_steps(params, opt_state, toks)
+        loss_val = float(loss)  # host read == completion barrier
+        dt = (time.time() - t0) / steps
+
+    return {
+        "params_m": round(n_params / 1e6, 1),
+        "ms_per_step": round(dt * 1e3, 1),
+        "tokens_per_s": round(batch * seq / dt),
+        "model_tflops": round(6 * n_params * batch * seq / dt / 1e12, 1),
+        "loss": round(loss_val, 3),
+        "batch": batch, "seq": seq, "remat_policy": policy,
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--seq", type=int, default=1024)
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--dim", type=int, default=2048)
+    p.add_argument("--layers", type=int, default=16)
+    p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--intermediate", type=int, default=5632)
+    p.add_argument("--remat-policy", default="full", choices=["full", "dots"])
+    args = p.parse_args()
+    out = run(args.batch, args.seq, args.steps, args.dim, args.layers,
+              args.heads, args.intermediate, args.remat_policy)
+    import json
+
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    sys.exit(main())

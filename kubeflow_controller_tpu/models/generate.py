@@ -14,7 +14,7 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from .llama import LlamaConfig, apply_rope, rmsnorm, rope_freqs
+from .llama import LlamaConfig, apply_rope, ffn_block, rmsnorm, rope_freqs
 
 Cache = Dict[str, jax.Array]
 NEG_INF = -1e30
@@ -69,17 +69,7 @@ def forward_with_cache(
         x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))
 
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-        if cfg.n_experts:
-            from .moe import moe_ffn
-
-            out = moe_ffn(h, lp["router"], lp["w_gate"], lp["w_up"],
-                          lp["w_down"], top_k=cfg.moe_top_k,
-                          capacity_factor=cfg.capacity_factor)
-        else:
-            ff = jax.nn.silu(jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dtype))) \
-                * jnp.einsum("btd,df->btf", h, lp["w_up"].astype(dtype))
-            out = jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(dtype))
-        x = x + out
+        x = x + ffn_block(h, lp, cfg)
         return x, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(

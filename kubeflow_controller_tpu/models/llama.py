@@ -55,6 +55,11 @@ class LlamaConfig:
     n_experts: int = 0
     moe_top_k: int = 2
     capacity_factor: float = 1.25
+    # Remat policy: "full" recomputes the whole layer in backward;
+    # "dots" saves matmul outputs and recomputes only cheap elementwise ops
+    # (jax.checkpoint_policies.dots_with_no_batch_dims_saveable) — less
+    # recompute for modestly more HBM.
+    remat_policy: str = "full"
 
     @property
     def head_dim(self) -> int:
@@ -218,13 +223,46 @@ def llama_forward(
     angles = rope_freqs(cfg, jnp.arange(T))
     layer = _decoder_layer_fn(cfg, angles, mesh, rules)
 
-    layer_fn = jax.checkpoint(layer) if cfg.remat else layer
+    layer_fn = _maybe_remat(layer, cfg)
     x, _ = jax.lax.scan(lambda carry, lp: layer_fn(carry, lp), x, params["layers"])
 
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(dtype))
     logits = with_logical_constraint(logits, ("batch", "seq", "vocab"), rules)
     return logits.astype(jnp.float32)
+
+
+def _maybe_remat(layer, cfg: LlamaConfig):
+    if not cfg.remat:
+        return layer
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint(
+            layer, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+    if cfg.remat_policy != "full":
+        raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}; "
+                         f"expected 'full' or 'dots'")
+    return jax.checkpoint(layer)
+
+
+def ffn_block(h: jax.Array, lp, cfg: LlamaConfig,
+              rules: ShardingRules = DEFAULT_RULES) -> jax.Array:
+    """SwiGLU FFN or MoE, shared by the training forward and the KV-cache
+    decode path so the two cannot drift."""
+    dtype = h.dtype
+    if cfg.n_experts:
+        from .moe import moe_ffn
+
+        return moe_ffn(
+            h, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+            top_k=cfg.moe_top_k, capacity_factor=cfg.capacity_factor,
+            rules=rules,
+        )
+    gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dtype))
+    up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(dtype))
+    ff = jax.nn.silu(gate) * up
+    ff = with_logical_constraint(ff, ("batch", "seq", "mlp"), rules)
+    return jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(dtype))
 
 
 def _decoder_layer_fn(cfg: LlamaConfig, angles, mesh, rules):
@@ -249,21 +287,7 @@ def _decoder_layer_fn(cfg: LlamaConfig, angles, mesh, rules):
         x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))
 
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-        if cfg.n_experts:
-            from .moe import moe_ffn
-
-            out = moe_ffn(
-                h, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
-                top_k=cfg.moe_top_k, capacity_factor=cfg.capacity_factor,
-                rules=rules,
-            )
-        else:
-            gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dtype))
-            up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(dtype))
-            ff = jax.nn.silu(gate) * up
-            ff = with_logical_constraint(ff, ("batch", "seq", "mlp"), rules)
-            out = jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(dtype))
-        x = x + out
+        x = x + ffn_block(h, lp, cfg, rules)
         x = with_logical_constraint(x, ("batch", "seq", None), rules)
         return x, None
 
@@ -294,7 +318,7 @@ def llama_forward_pp(
     # Inside the pipeline body only the pp axis is manual; attention must
     # not re-enter shard_map, so force the plain-attention path.
     layer = _decoder_layer_fn(cfg, angles, None, rules)
-    layer_fn = jax.checkpoint(layer) if cfg.remat else layer
+    layer_fn = _maybe_remat(layer, cfg)
 
     def stage_fn(stage_layers, xm):
         out, _ = jax.lax.scan(lambda c, lp: layer_fn(c, lp), xm, stage_layers)

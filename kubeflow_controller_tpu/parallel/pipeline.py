@@ -38,11 +38,15 @@ def split_stages(stacked_params: Any, n_stages: int) -> Any:
 
 
 def _constrain_pp(x, axis_name: str):
+    """Pin dim 0 to the pp axis, leaving every other dim UNCONSTRAINED so
+    ep/tp/fsdp shardings inside each stage survive (a bare P('pp') would
+    force-replicate all trailing dims)."""
     from .sharding import _mesh_axes_in_scope
 
     if not _mesh_axes_in_scope():
         return x  # eager single-device tests: nothing to constrain
-    return jax.lax.with_sharding_constraint(x, P(axis_name))
+    spec = P(axis_name, *([P.UNCONSTRAINED] * (x.ndim - 1)))
+    return jax.lax.with_sharding_constraint(x, spec)
 
 
 def gpipe(
@@ -76,18 +80,26 @@ def gpipe(
     vstage = jax.vmap(stage_fn)
     zero = jnp.zeros_like(microbatches[0])
     # act[s] = activation currently entering stage s.
-    act = jnp.broadcast_to(zero, (S, *zero.shape))
-    act = _constrain_pp(act, axis_name)
-    out = jnp.zeros_like(microbatches)
+    act0 = _constrain_pp(jnp.broadcast_to(zero, (S, *zero.shape)), axis_name)
+    out0 = jnp.zeros_like(microbatches)
 
-    for t in range(n_micro + S - 1):
-        feed = microbatches[min(t, n_micro - 1)]
+    # fori_loop, not a Python loop: trace size stays constant in the number
+    # of microbatches (pipelines shrink their bubble by raising n_micro).
+    def step(t, carry):
+        act, out = carry
+        feed = jnp.take(microbatches, jnp.minimum(t, n_micro - 1), axis=0)
         act = act.at[0].set(jnp.where(t < n_micro, feed, act[0]))
         y = vstage(stage_params, act)
         y = _constrain_pp(y, axis_name)
         pos = t - (S - 1)
-        if pos >= 0:
-            out = out.at[pos].set(y[-1])
+        out = jax.lax.dynamic_update_index_in_dim(
+            out,
+            jnp.where(pos >= 0, y[-1], jnp.take(out, jnp.maximum(pos, 0), axis=0)),
+            jnp.maximum(pos, 0),
+            axis=0,
+        )
         # y[s] becomes the input of stage s+1 (roll -> collective permute).
-        act = jnp.roll(y, 1, axis=0)
+        return jnp.roll(y, 1, axis=0), out
+
+    _, out = jax.lax.fori_loop(0, n_micro + S - 1, step, (act0, out0))
     return out
