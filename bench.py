@@ -1,19 +1,24 @@
 """Headline benchmark: dist-mnist TFJob wall-clock-to-Succeeded.
 
 The driver's target metric (BASELINE.json): time from TFJob creation to
-``status.phase == Succeeded`` for the distributed MNIST job — the same
-2-PS/4-worker, 200-step, batch-100 run the reference documents at 9.54s of
-pure training on a dev box (ref: docs/get_started.md:49-63), except here
-the clock covers the WHOLE job: reconcile, pod+service materialization,
-gang execution of real JAX training processes, status rollup.
+``status.phase == Succeeded`` for the distributed MNIST job.  Config here
+is the judged BASELINE.json one — **1 PS + 2 workers**, 200 steps, global
+batch 100.  The two worker pods form one jax.distributed cluster and train
+ONE shared model (gradients all-reduce every step over the global mesh),
+the collective re-expression of the reference's PS data plane.
 
-``vs_baseline`` is the speedup over the reference's published 9.536664s
-training elapsed (>1.0 = faster than the baseline number).  The JSON also
-carries reconcile percentiles and workload details.
+``vs_baseline`` compares against the reference's published 9.536664s
+"Training elapsed time" (ref: docs/get_started.md:49-63).  That number is
+from a DIFFERENT config and clock: 4 workers + 2 PS on unknown 2018
+hardware, timing training only — while this clock covers the whole job
+(reconcile, pod+service materialization, distributed rendezvous, training,
+status rollup).  The reference publishes nothing directly comparable
+(BASELINE.md), so vs_baseline is indicative, not apples-to-apples; the
+mismatch is recorded in the JSON details.
 
 Workers train on the cpu platform: the benchmark measures the framework's
 orchestration + training loop end-to-end, and the one tunneled TPU chip
-cannot be shared by 4 concurrent worker processes.
+cannot be shared by concurrent worker processes.
 """
 
 from __future__ import annotations
@@ -110,10 +115,18 @@ def main() -> int:
         "vs_baseline": round(BASELINE_S / elapsed, 3),
         "details": {
             "baseline_s": BASELINE_S,
+            "baseline_note": (
+                "reference number is 4xWorker+2xPS training-only elapsed on "
+                "unknown 2018 hardware (docs/get_started.md:49-63); this run "
+                "is the judged 1xPS+2xWorker config timing the WHOLE job — "
+                "not apples-to-apples, see BASELINE.md"
+            ),
             "reconcile_p50_ms": round(result["metrics"]["reconcile_p50_s"] * 1e3, 3),
             "reconcile_p99_ms": round(result["metrics"]["reconcile_p99_s"] * 1e3, 3),
             "syncs": result["metrics"]["syncs"],
-            "workload": "1xPS + 2xWorker, 200 steps, global batch 100, all-reduce DP",
+            "workload": ("1xPS + 2xWorker, 200 steps, global batch 100; workers "
+                         "form one jax.distributed cluster and all-reduce into "
+                         "one shared model"),
         },
     }))
     return 0

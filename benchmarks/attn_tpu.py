@@ -1,0 +1,92 @@
+"""Flash-attention kernel vs XLA fused attention on real TPU (fwd+bwd).
+
+Decides where models/llama.py:_attention selects the Pallas kernel: the
+crossover is recorded in docs/PERF.md and encoded as
+LlamaConfig.flash_min_seq.  Same trustworthy-timing method as
+llama_tpu.py: K repetitions inside one jitted lax.scan, host read as the
+completion barrier.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def bench_one(impl: str, b: int, t: int, h: int, d: int, steps: int,
+              causal: bool = True) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_controller_tpu.ops import flash_attention
+    from kubeflow_controller_tpu.parallel.ring import attention_reference
+
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 3)
+    shape = (b, t, h, d)
+    q = jax.random.normal(ks[0], shape, dtype=jnp.bfloat16)
+    k = jax.random.normal(ks[1], shape, dtype=jnp.bfloat16)
+    v = jax.random.normal(ks[2], shape, dtype=jnp.bfloat16)
+
+    if impl == "flash":
+        fn = lambda q, k, v: flash_attention(q, k, v, causal=causal)
+    else:
+        fn = lambda q, k, v: attention_reference(q, k, v, causal=causal)
+
+    def loss(q, k, v):
+        return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+    grad = jax.grad(loss, argnums=(0, 1, 2))
+
+    @jax.jit
+    def run(q, k, v):
+        def body(c, _):
+            # Carry-dependent input: without it XLA hoists the whole grad
+            # out of the scan and the loop times nothing.
+            dq, dk, dv = grad(q + (c * 1e-30).astype(q.dtype), k, v)
+            return c + jnp.sum(dq[0, 0, 0, :4].astype(jnp.float32)), None
+
+        out, _ = jax.lax.scan(body, jnp.float32(0), None, length=steps)
+        return out
+
+    float(run(q, k, v))  # compile
+    dt = float("inf")    # min of 3: relay latency noise is large
+    for _ in range(3):
+        t0 = time.time()
+        float(run(q, k, v))  # host read == barrier
+        dt = min(dt, (time.time() - t0) / steps)
+    # fwd+bwd attention FLOPs: fwd 4*B*H*T^2*D (QK^T + PV), bwd ~2.5x fwd.
+    causal_factor = 0.5 if causal else 1.0
+    flops = 3.5 * 4 * b * h * t * t * d * causal_factor
+    return {
+        "impl": impl, "b": b, "t": t, "h": h, "d": d,
+        "ms": round(dt * 1e3, 2),
+        "tflops": round(flops / dt / 1e12, 1),
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--tokens", type=int, default=16384,
+                   help="B*T held constant across the T sweep")
+    p.add_argument("--seqs", type=int, nargs="+",
+                   default=[1024, 2048, 4096, 8192])
+    args = p.parse_args()
+    results = []
+    for t in args.seqs:
+        b = max(1, args.tokens // t)
+        for impl in ("xla", "flash"):
+            r = bench_one(impl, b, t, args.heads, args.head_dim, args.steps)
+            results.append(r)
+            print(json.dumps(r), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    sys.exit(main())

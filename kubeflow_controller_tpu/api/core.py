@@ -64,6 +64,12 @@ class Container:
                 return
         self.env.append(EnvVar(name=name, value=value))
 
+    def set_env_default(self, name: str, value: str) -> None:
+        """Set env only if the template didn't already provide it — cluster
+        wiring the user may legitimately override (e.g. coordinator address)."""
+        if not any(e.name == name for e in self.env):
+            self.env.append(EnvVar(name=name, value=value))
+
 
 @dataclass
 class PodSpec:

@@ -1,0 +1,307 @@
+"""Fake HTTP API server: the in-memory ObjectStore behind real HTTP.
+
+The test backend for the REST transport (cluster/rest.py) — the HTTP-level
+analog of the fake clientset the reference's generated code ships for
+controller tests (ref: clientset/versioned/fake/clientset_generated.go:
+33-46 over an ObjectTracker).  Same store, same semantics (resourceVersion
+conflicts, generateName, watch ordering, cascade GC); what's added is the
+wire: URL routing, JSON bodies, k8s Status errors, merge patches, and
+streaming watch responses.
+
+Run an in-process server, point a RestCluster at ``http://127.0.0.1:port``,
+and the controller exercises the exact code path it would use against a
+live API server.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple, Type
+from urllib.parse import parse_qs, urlparse
+
+from ..api.core import Pod, Service
+from ..api.tfjob import TFJob
+from ..utils import serde
+from .rest import CORE_API, TFJOB_API, TFJOB_GROUP, TFJOB_VERSION
+from .store import (
+    AlreadyExists,
+    APIError,
+    Conflict,
+    Invalid,
+    NotFound,
+    ObjectStore,
+)
+
+_KINDS: Dict[str, Tuple[Type, str, str]] = {
+    # plural -> (dataclass, apiVersion, Kind)
+    "tfjobs": (TFJob, f"{TFJOB_GROUP}/{TFJOB_VERSION}", "TFJob"),
+    "pods": (Pod, "v1", "Pod"),
+    "services": (Service, "v1", "Service"),
+}
+
+
+def _parse_selector(q: Dict[str, list]) -> Optional[Dict[str, str]]:
+    raw = (q.get("labelSelector") or [None])[0]
+    if not raw:
+        return None
+    out = {}
+    for part in raw.split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def _status(code: int, reason: str, message: str) -> Tuple[int, dict]:
+    return code, {
+        "kind": "Status", "apiVersion": "v1", "status": "Failure",
+        "reason": reason, "message": message, "code": code,
+    }
+
+
+def _error_status(e: APIError) -> Tuple[int, dict]:
+    if isinstance(e, NotFound):
+        return _status(404, "NotFound", str(e))
+    if isinstance(e, AlreadyExists):
+        return _status(409, "AlreadyExists", str(e))
+    if isinstance(e, Conflict):
+        return _status(409, "Conflict", str(e))
+    if isinstance(e, Invalid):
+        return _status(422, "Invalid", str(e))
+    return _status(500, "InternalError", str(e))
+
+
+class _Route:
+    """Parsed request path: collection or item, which kind, namespace."""
+
+    def __init__(self, plural: str, namespace: Optional[str],
+                 name: Optional[str], subresource: Optional[str],
+                 watch: bool, selector: Optional[Dict[str, str]]):
+        self.plural = plural
+        self.namespace = namespace
+        self.name = name
+        self.subresource = subresource
+        self.watch = watch
+        self.selector = selector
+
+
+def _route(path: str, query: str) -> Optional[_Route]:
+    q = parse_qs(query)
+    for prefix in (TFJOB_API, CORE_API):
+        if not path.startswith(prefix + "/"):
+            continue
+        parts = [p for p in path[len(prefix):].split("/") if p]
+        ns = None
+        if parts and parts[0] == "namespaces":
+            if len(parts) < 3:
+                return None
+            ns = parts[1]
+            parts = parts[2:]
+        if not parts or parts[0] not in _KINDS:
+            return None
+        plural = parts[0]
+        # Cross-API guard: tfjobs only under the CRD prefix, core only core.
+        if (plural == "tfjobs") != (prefix == TFJOB_API):
+            return None
+        name = parts[1] if len(parts) > 1 else None
+        sub = parts[2] if len(parts) > 2 else None
+        return _Route(plural, ns, name, sub,
+                      (q.get("watch") or ["false"])[0] == "true",
+                      _parse_selector(q))
+    return None
+
+
+class FakeAPIServer:
+    """ThreadingHTTPServer over an ObjectStore; start() returns the URL."""
+
+    def __init__(self, store: Optional[ObjectStore] = None, token: str = ""):
+        self.store = store or ObjectStore()
+        self.token = token
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> str:
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _deny(self) -> bool:
+                if not outer.token:
+                    return False
+                auth = self.headers.get("Authorization", "")
+                if auth == f"Bearer {outer.token}":
+                    return False
+                self._send(*_status(401, "Unauthorized", "bad token"))
+                return True
+
+            def _send(self, code: int, body: Any) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def _dispatch(self, method: str) -> None:
+                if self._deny():
+                    return
+                u = urlparse(self.path)
+                r = _route(u.path, u.query)
+                if r is None:
+                    self._send(*_status(404, "NotFound", f"no route {u.path}"))
+                    return
+                try:
+                    outer._handle(self, method, r)
+                except APIError as e:
+                    self._send(*_error_status(e))
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_PUT(self):
+                self._dispatch("PUT")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+            def do_PATCH(self):
+                self._dispatch("PATCH")
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="fake-apiserver", daemon=True)
+        self._thread.start()
+        host, port = self._httpd.server_address
+        return f"http://{host}:{port}"
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    # -- request handling ------------------------------------------------------
+
+    def _wire(self, plural: str, obj: Any) -> dict:
+        _, api_version, kind = _KINDS[plural]
+        d = serde.to_dict(obj)
+        d["apiVersion"] = api_version
+        d["kind"] = kind
+        return d
+
+    def _parse(self, plural: str, d: dict) -> Any:
+        cls, _, _ = _KINDS[plural]
+        return serde.from_dict(cls, d)
+
+    def _handle(self, h, method: str, r: _Route) -> None:
+        store = self.store
+        if r.name is None:
+            if method == "GET" and r.watch:
+                self._stream_watch(h, r)
+                return
+            if method == "GET":
+                items = store.list(r.plural, r.namespace, r.selector)
+                _, api_version, kind = _KINDS[r.plural]
+                h._send(200, {
+                    "apiVersion": api_version, "kind": kind + "List",
+                    "items": [self._wire(r.plural, o) for o in items],
+                })
+                return
+            if method == "POST":
+                obj = self._parse(r.plural, h._body())
+                if r.namespace:
+                    obj.metadata.namespace = r.namespace
+                out = store.create(r.plural, obj)
+                h._send(201, self._wire(r.plural, out))
+                return
+            raise NotFound(f"{method} not supported on collection")
+
+        ns = r.namespace or "default"
+        if method == "GET":
+            h._send(200, self._wire(r.plural, store.get(r.plural, ns, r.name)))
+            return
+        if method == "PUT" and r.subresource == "status":
+            obj = self._parse(r.plural, h._body())
+            obj.metadata.namespace, obj.metadata.name = ns, r.name
+            h._send(200, self._wire(r.plural, store.update_status(r.plural, obj)))
+            return
+        if method == "PUT":
+            obj = self._parse(r.plural, h._body())
+            obj.metadata.namespace, obj.metadata.name = ns, r.name
+            h._send(200, self._wire(r.plural, store.update(r.plural, obj)))
+            return
+        if method == "PATCH":
+            patch = h._body()
+            meta_patch = patch.get("metadata", {})
+
+            def apply(meta):
+                if "labels" in meta_patch:
+                    meta.labels = dict(meta_patch["labels"] or {})
+                if "annotations" in meta_patch:
+                    meta.annotations = dict(meta_patch["annotations"] or {})
+                if "ownerReferences" in meta_patch:
+                    from ..api.meta import OwnerReference
+
+                    meta.owner_references = [
+                        serde.from_dict(OwnerReference, o)
+                        for o in (meta_patch["ownerReferences"] or [])
+                    ]
+                if "finalizers" in meta_patch:
+                    meta.finalizers = list(meta_patch["finalizers"] or [])
+
+            h._send(200, self._wire(r.plural, store.patch_meta(r.plural, ns, r.name, apply)))
+            return
+        if method == "DELETE":
+            store.delete(r.plural, ns, r.name)
+            h._send(200, _status(200, "Success", "deleted")[1])
+            return
+        raise NotFound(f"{method} not supported on item")
+
+    def _stream_watch(self, h, r: _Route) -> None:
+        """Chunked streaming of store watch events as JSON lines, until the
+        client goes away."""
+        w = self.store.watch(r.plural, r.namespace)
+        try:
+            h.send_response(200)
+            h.send_header("Content-Type", "application/json")
+            h.send_header("Transfer-Encoding", "chunked")
+            h.end_headers()
+
+            def chunk(data: bytes) -> None:
+                h.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                h.wfile.flush()
+
+            while True:
+                ev = w.next(timeout=0.5)
+                if ev is None:
+                    if self._httpd is None:
+                        break
+                    chunk(b"\n")  # keepalive; also detects dead clients
+                    continue
+                line = json.dumps({
+                    "type": ev.type,
+                    "object": self._wire(r.plural, ev.object),
+                }).encode() + b"\n"
+                chunk(line)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            w.stop()

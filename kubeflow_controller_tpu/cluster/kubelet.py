@@ -22,6 +22,7 @@ Pending (all-or-nothing slice admission).
 from __future__ import annotations
 
 import os
+import socket
 import subprocess
 import threading
 import time
@@ -85,6 +86,9 @@ class FakeKubelet:
         self._watcher = None
         self._threads: Dict[str, threading.Thread] = {}
         self._procs: Dict[str, subprocess.Popen] = {}
+        # Fake cluster DNS: coordinator service hostname -> local port.
+        self._svc_ports: Dict[str, int] = {}
+        self._svc_lock = threading.Lock()
         self._warm: Dict[str, object] = {}
         self._stop = threading.Event()
         self._main: Optional[threading.Thread] = None
@@ -170,6 +174,7 @@ class FakeKubelet:
             # (generateName makes replacements unique), so drop bookkeeping
             # rather than leak one entry per pod ever run.
             self._procs.pop(key, None)
+            self._threads.pop(key, None)
 
     # -- phase driving -------------------------------------------------------
 
@@ -228,6 +233,38 @@ class FakeKubelet:
         if not self._gone(ns, name):
             self.set_phase(ns, name, outcome)
 
+    def _resolve_coordinator(self, env: Dict[str, str]) -> None:
+        """Fake cluster DNS for the jax.distributed coordinator.
+
+        The materializer wires coordinator addresses as service DNS names
+        (resolvable by real cluster DNS, not on this host).  Map each
+        distinct coordinator hostname to a stable free localhost port so
+        every pod of a gang rendezvouses at the same 127.0.0.1 address —
+        the same indirection kube-dns provides, collapsed to one machine.
+        """
+        from ..planner.materialize import ENV_COORDINATOR
+
+        addr = env.get(ENV_COORDINATOR, "")
+        if not addr or ":" not in addr:
+            return
+        host = addr.rsplit(":", 1)[0]
+        if host in ("localhost", "127.0.0.1"):
+            return
+        try:
+            socket.inet_aton(host)
+            return  # already an IP literal
+        except OSError:
+            pass
+        with self._svc_lock:
+            port = self._svc_ports.get(host)
+            if port is None:
+                s = socket.socket()
+                s.bind(("127.0.0.1", 0))
+                port = s.getsockname()[1]
+                s.close()
+                self._svc_ports[host] = port
+        env[ENV_COORDINATOR] = f"127.0.0.1:{port}"
+
     def _execute(self, pod: Pod) -> None:
         from .warmpool import python_module_argv
 
@@ -236,6 +273,7 @@ class FakeKubelet:
         cmd = list(c.command) + list(c.args)
         env = dict(os.environ)
         env.update({e.name: e.value for e in c.env})
+        self._resolve_coordinator(env)
         if self.warm_start:
             argv = python_module_argv(cmd)
             if argv is not None:

@@ -150,13 +150,15 @@ class WarmPool:
     def stop(self) -> None:
         with self._lock:
             z, self._zygote = self._zygote, None
-        if z is None:
-            return
-        try:
-            z.stdin.close()  # zygote sees EOF, kills children, exits
-            z.wait(timeout=5)
-        except (OSError, subprocess.TimeoutExpired):
-            z.terminate()
+        if z is not None:
+            try:
+                z.stdin.close()  # zygote sees EOF, kills children, exits
+                z.wait(timeout=5)
+            except (OSError, subprocess.TimeoutExpired):
+                z.terminate()
+        import shutil
+
+        shutil.rmtree(self._tmpdir, ignore_errors=True)
 
 
 def python_module_argv(command) -> Optional[list]:

@@ -76,6 +76,9 @@ def _child(req: dict) -> None:
                 os.dup2(fd, stream)
                 os.close(fd)
         env = req.get("env") or {}
+        # Replace, not merge: cold-start pods get Popen(env=...) verbatim,
+        # so warm-forked pods must not inherit zygote-only vars either.
+        os.environ.clear()
         os.environ.update(env)
         if req.get("cwd"):
             os.chdir(req["cwd"])

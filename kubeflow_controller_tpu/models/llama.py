@@ -55,11 +55,27 @@ class LlamaConfig:
     n_experts: int = 0
     moe_top_k: int = 2
     capacity_factor: float = 1.25
-    # Remat policy: "full" recomputes the whole layer in backward;
-    # "dots" saves matmul outputs and recomputes only cheap elementwise ops
-    # (jax.checkpoint_policies.dots_with_no_batch_dims_saveable) — less
-    # recompute for modestly more HBM.
+    # Remat policy — the FLOPs/HBM dial for the backward pass:
+    #   "full":    save only layer boundaries; recompute everything (~8ND
+    #              executed per step).  Minimum memory.
+    #   "dots":    save every matmul output without batch-only dims
+    #              (jax.checkpoint_policies.dots_with_no_batch_dims_saveable).
+    #              Minimum recompute, most HBM — OOMs ~1GB-scale models at
+    #              B*T=16k on one v5e chip.
+    #   "ffn":     save the three FFN matmul outputs (the FLOPs-dominant
+    #              block, ~60% of layer FLOPs) and recompute attention —
+    #              the middle setting that fits where "dots" OOMs.
+    #   "gateup":  save only the two D->intermediate matmuls; recompute the
+    #              down-projection too.  Slightly less HBM than "ffn".
     remat_policy: str = "full"
+    # Attention implementation:
+    #   "auto":  Pallas flash kernel (ops/attention.py) on TPU at T >= 1024
+    #            where it measures 2.4-3.9x faster than XLA's fused
+    #            attention (docs/PERF.md); XLA otherwise.
+    #   "flash": force the Pallas kernel (interpreter off-TPU — tests).
+    #   "xla":   force plain attention (XLA fuses it).
+    # Ring attention still takes priority when 'seq' maps to a real sp axis.
+    attention: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -183,25 +199,65 @@ def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
 
 
-def _attention(q, k, v, mesh: Optional[Mesh], causal: bool, rules: ShardingRules):
+def _attention(q, k, v, mesh: Optional[Mesh], causal: bool, rules: ShardingRules,
+               cfg: Optional[LlamaConfig] = None):
     """Ring attention when the rule table maps 'seq' onto a real mesh axis
-    of size > 1; otherwise plain attention (XLA fuses it) under whatever
-    sharding constraints are already in place."""
+    of size > 1; else the Pallas flash kernel where it wins (long T on
+    TPU); else plain attention (XLA fuses it) under whatever sharding
+    constraints are already in place."""
     seq_axis = rules.mesh_axes("seq")
     if (
-        mesh is None
-        or not isinstance(seq_axis, str)
-        or seq_axis not in mesh.axis_names
-        or mesh.shape[seq_axis] <= 1
+        mesh is not None
+        and isinstance(seq_axis, str)
+        and seq_axis in mesh.axis_names
+        and mesh.shape[seq_axis] > 1
     ):
-        return attention_reference(q, k, v, causal=causal)
-    return ring_attention(
-        q, k, v, mesh,
-        causal=causal,
-        axis_name=seq_axis,
-        batch_axes=rules.mesh_axes("batch"),
-        head_axis=rules.mesh_axes("heads"),
-    )
+        return ring_attention(
+            q, k, v, mesh,
+            causal=causal,
+            axis_name=seq_axis,
+            batch_axes=rules.mesh_axes("batch"),
+            head_axis=rules.mesh_axes("heads"),
+        )
+    if cfg is not None and cfg.attention in ("auto", "flash"):
+        out = _flash_path(q, k, v, mesh, causal, rules, cfg)
+        if out is not None:
+            return out
+    return attention_reference(q, k, v, causal=causal)
+
+
+def _flash_path(q, k, v, mesh: Optional[Mesh], causal: bool,
+                rules: ShardingRules, cfg: LlamaConfig):
+    """The Pallas kernel when applicable, or None to fall back to XLA.
+
+    "auto" applies it on TPU at T >= 1024 (the measured win region,
+    docs/PERF.md); "flash" forces it.  Under a mesh the kernel runs
+    per-shard via shard_map with the same logical specs the surrounding
+    constraints use (tp shards heads, dp/fsdp shard batch; seq is
+    unsharded here — the sp>1 case took the ring path above)."""
+    import functools
+
+    from ..ops.attention import flash_attention
+
+    t = q.shape[1]
+    block = min(1024, t)
+    if t % block:
+        return None
+    if cfg.attention == "auto" and (
+        t < 1024 or jax.default_backend() != "tpu"
+    ):
+        return None
+    fn = functools.partial(flash_attention, causal=causal,
+                           block_q=block, block_k=block)
+    if mesh is None:
+        return fn(q, k, v)
+    from ..parallel.sharding import logical_to_pspec
+
+    spec = logical_to_pspec(("batch", "seq", "heads", "head_dim"), rules)
+    sm = jax.shard_map(lambda a, b, c: fn(a, b, c), mesh=mesh,
+                       in_specs=(spec, spec, spec), out_specs=spec,
+                       check_vma=False)
+    return sm(q, k, v)
 
 
 # ---------------------------------------------------------------------------
@@ -235,14 +291,24 @@ def llama_forward(
 def _maybe_remat(layer, cfg: LlamaConfig):
     if not cfg.remat:
         return layer
-    if cfg.remat_policy == "dots":
-        return jax.checkpoint(
-            layer, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-        )
-    if cfg.remat_policy != "full":
+    policies = jax.checkpoint_policies
+    named = {
+        "full": None,
+        "dots": policies.dots_with_no_batch_dims_saveable,
+        "ffn": policies.save_only_these_names("ffn_gate", "ffn_up", "ffn_down"),
+        "gateup": policies.save_only_these_names("ffn_gate", "ffn_up"),
+        # "gateup" + the attention projection output: additionally skips
+        # re-running the (flash) attention forward in the backward pass.
+        "gateup_attn": policies.save_only_these_names(
+            "ffn_gate", "ffn_up", "attn_proj"),
+    }
+    if cfg.remat_policy not in named:
         raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}; "
-                         f"expected 'full' or 'dots'")
-    return jax.checkpoint(layer)
+                         f"expected one of {sorted(named)}")
+    policy = named[cfg.remat_policy]
+    if policy is None:
+        return jax.checkpoint(layer)
+    return jax.checkpoint(layer, policy=policy)
 
 
 def ffn_block(h: jax.Array, lp, cfg: LlamaConfig,
@@ -258,11 +324,25 @@ def ffn_block(h: jax.Array, lp, cfg: LlamaConfig,
             top_k=cfg.moe_top_k, capacity_factor=cfg.capacity_factor,
             rules=rules,
         )
-    gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dtype))
-    up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(dtype))
+    # checkpoint_name marks the layer's FLOPs-dominant matmul outputs so the
+    # named remat policies ("ffn"/"gateup") can save exactly these and
+    # recompute the rest.  Only inserted when the policy consumes them: the
+    # name_p primitive blocks XLA fusions, measured 3.5x slower under the
+    # plain "full" policy on v5e (docs/PERF.md).
+    if cfg.remat_policy in ("ffn", "gateup", "gateup_attn"):
+        from jax.ad_checkpoint import checkpoint_name
+    else:
+        def checkpoint_name(x, _):
+            return x
+
+    gate = checkpoint_name(
+        jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dtype)), "ffn_gate")
+    up = checkpoint_name(
+        jnp.einsum("btd,df->btf", h, lp["w_up"].astype(dtype)), "ffn_up")
     ff = jax.nn.silu(gate) * up
     ff = with_logical_constraint(ff, ("batch", "seq", "mlp"), rules)
-    return jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(dtype))
+    return checkpoint_name(
+        jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(dtype)), "ffn_down")
 
 
 def _decoder_layer_fn(cfg: LlamaConfig, angles, mesh, rules):
@@ -283,8 +363,13 @@ def _decoder_layer_fn(cfg: LlamaConfig, angles, mesh, rules):
         q = with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"), rules)
         k = with_logical_constraint(k, ("batch", "seq", "heads", "head_dim"), rules)
         v = with_logical_constraint(v, ("batch", "seq", "heads", "head_dim"), rules)
-        attn = _attention(q, k, v, mesh, causal=True, rules=rules)
-        x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))
+        attn = _attention(q, k, v, mesh, causal=True, rules=rules, cfg=cfg)
+        proj = jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))
+        if cfg.remat_policy == "gateup_attn":
+            from jax.ad_checkpoint import checkpoint_name
+
+            proj = checkpoint_name(proj, "attn_proj")
+        x = x + proj
 
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         x = x + ffn_block(h, lp, cfg, rules)

@@ -191,10 +191,34 @@ def make_pod(job: TFJob, spec: TFReplicaSpec, index: int) -> Pod:
         c.args = list(c.args) + tf_cluster_args(job, typ, index)
         if not any(p.container_port == TF_PORT for p in c.ports):
             c.ports.append(ContainerPort(name="tf-port", container_port=TF_PORT))
+        if typ == ReplicaType.WORKER:
+            _wire_worker_collectives(job, c, index)
     elif typ == ReplicaType.TPU:
         _wire_tpu_pod(job, spec, pod, index)
     # Local: no wiring at all (ref: local.go — single pod, no services).
     return pod
+
+
+def _wire_worker_collectives(job: TFJob, c, index: int) -> None:
+    """Give classic Worker replicas the jax.distributed contract too.
+
+    The reference's workers exchange gradients only through the PS grpc
+    data plane (ref: mnist_replica.py:137-141); TPU-native, the workers
+    themselves form one jax.distributed cluster (coordinator = worker 0's
+    service, which already exposes TF_PORT) and all-reduce over XLA
+    collectives, training ONE shared model — not N independent shards.
+    ``set_env_default`` so a template-provided address (e.g. a test's
+    127.0.0.1 override) wins over the generated service DNS name.
+    """
+    worker = replica_spec_for(job, ReplicaType.WORKER)
+    n = worker.replicas if worker else 1
+    if n <= 1:
+        return
+    coord = f"{service_name(job, ReplicaType.WORKER, 0)}:{TF_PORT}"
+    c.set_env_default(ENV_COORDINATOR, coord)
+    c.set_env_default(ENV_NUM_PROCESSES, str(n))
+    # Per-pod, never meaningful as a uniform template value: always stamp.
+    c.set_env(ENV_PROCESS_ID, str(index))
 
 
 def _wire_tpu_pod(job: TFJob, spec: TFReplicaSpec, pod: Pod, index: int) -> None:

@@ -44,13 +44,29 @@ class CheckpointManager:
 
     def restore(self, target_params: Any, target_opt_state: Any) -> Tuple[Any, Any, int]:
         """Restore the latest checkpoint onto abstract/like targets; returns
-        (params, opt_state, step).  Raises if none exists."""
+        (params, opt_state, step).  Raises if none exists.
+
+        Shardings are preserved: a target leaf that is a live mesh-sharded
+        ``jax.Array`` (the normal case — params are initialized with their
+        NamedShardings before restore, e.g. llama_pretrain) restores
+        directly into that layout rather than fully-replicated onto default
+        devices, which would OOM or mis-place multi-host models on resume.
+        """
         import orbax.checkpoint as ocp
+        from jax.sharding import NamedSharding
 
         step = self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
+
+        def abstract(x):
+            s = getattr(x, "sharding", None)
+            if isinstance(s, NamedSharding):
+                return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
+            return ocp.utils.to_shape_dtype_struct(x)
+
         ref = {"params": target_params, "opt_state": target_opt_state}
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, ref)
-        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(jax.tree.map(abstract, ref))
+        )
         return restored["params"], restored["opt_state"], step

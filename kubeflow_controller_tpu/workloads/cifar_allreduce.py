@@ -41,27 +41,31 @@ def main(argv=None) -> int:
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
-    import jax.numpy as jnp
     import optax
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..models import vision as v
     from ..parallel import AXIS_DATA, MeshSpec, build_mesh
     from . import data as d
     from .runtime import JobRuntime
-    from .trainer import batch_stack, train_scan_stateful
+    from .trainer import (
+        batch_stack,
+        global_batches,
+        replicate_global,
+        train_scan_stateful,
+    )
 
     rt = JobRuntime.from_env()
+    rt.merge_tf_args(args.job_name, args.task_index, args.worker_hosts)
     rt.initialize()
-    workers = max(1, len(args.worker_hosts.split(",")) if args.worker_hosts
-                  else rt.num_processes)
-    worker_id = args.task_index if args.task_index >= 0 else rt.process_id
+    # Worker pods all-reduce over ONE global mesh spanning the gang
+    # (MultiWorkerMirrored semantics — one shared model, no grpc ring).
+    pc, proc = jax.process_count(), jax.process_index()
 
     mesh = build_mesh(MeshSpec(dp=-1, fsdp=1))
     dp = mesh.shape[AXIS_DATA]
     bs = max(dp, args.batch_size - args.batch_size % dp)
 
-    x, y = d.synthetic_cifar(1000 + worker_id, args.train_size)
+    x, y = d.synthetic_cifar(1000 + proc, args.train_size)
     ex, ey = d.synthetic_cifar(2, args.eval_size)
 
     if args.model == "cnn":
@@ -88,18 +92,19 @@ def main(argv=None) -> int:
 
     start = time.time()
     with jax.set_mesh(mesh):
-        xb, yb = batch_stack(x, y, args.steps, bs)
-        sharding = NamedSharding(mesh, P(None, AXIS_DATA))
-        batches = (jax.device_put(xb, sharding), jax.device_put(yb, sharding))
+        xb, yb = batch_stack(x, y, args.steps, bs // pc)
+        batches = global_batches(mesh, AXIS_DATA, (xb, yb), bs)
         params, batch_stats, opt_state, loss = train_scan_stateful(
             loss_fn, opt, params, opt_state, batch_stats, batches)
         loss = float(loss)
-    elapsed = time.time() - start
+        elapsed = time.time() - start
 
-    final_vars = {"params": params, **(
-        {"batch_stats": batch_stats} if batch_stats else {})}
-    acc = float(v.vision_accuracy(model, final_vars, ex, ey))
-    print(f"Worker {worker_id}/{workers} ({args.model}) on {dp}-way mesh")
+        final_vars = {"params": params, **(
+            {"batch_stats": batch_stats} if batch_stats else {})}
+        exg, eyg = replicate_global(mesh, ex, ey)
+        acc = float(jax.jit(
+            lambda vs, a, b: v.vision_accuracy(model, vs, a, b))(final_vars, exg, eyg))
+    print(f"Worker {proc}/{pc} ({args.model}) on {dp}-way mesh")
     print(f"Training elapsed time: {elapsed:f} s")
     print(f"Final loss: {loss:f}; eval accuracy: {acc:f}")
     if args.target_accuracy and acc < args.target_accuracy:

@@ -2,19 +2,21 @@
 
 The reference wires N workers + M parameter servers over grpc and ships
 gradients to the PS every step (ref: examples/workdir/mnist_replica.py:
-113-141, 251-264).  TPU-native, the PS tier disappears: parameters are
-replicated (or sharded) over the device mesh and gradients all-reduce over
-ICI — this script is the data-parallel re-expression of the same training
-run (200 steps, batch 100 by default, matching docs/get_started.md:49-63).
+113-141, 251-264).  TPU-native, the PS tier disappears: the worker pods
+form ONE jax.distributed cluster (coordinator env injected by the planner,
+or derived from ``--worker_hosts`` exactly as the reference workload feeds
+tf.train.ClusterSpec), parameters are replicated over the global mesh, and
+gradients all-reduce over XLA collectives — one shared model, the same
+semantics as the reference's PS training with the grpc data plane replaced
+by ICI/gloo (200 steps, batch 100 by default, matching
+docs/get_started.md:49-63).
 
 Roles:
-- launched with the TF-contract args the planner still generates for
-  PS/Worker replicas (``--job_name --task_index ...``): a ``ps`` role
-  parks forever, the analog of ``server.join()`` (mnist_replica.py:121-122)
-  — the data plane it used to host now rides XLA collectives;
-  a ``worker`` role trains its shard.
-- launched under the TPU replica env contract: joins via jax.distributed
-  (runtime.initialize) and trains over the global mesh.
+- ``ps``: parks forever, the analog of ``server.join()``
+  (mnist_replica.py:121-122) — the data plane it used to host now rides
+  XLA collectives.
+- ``worker`` / TPU replica: joins via jax.distributed (runtime.initialize),
+  feeds its shard of every global batch, trains over the global mesh.
 """
 
 from __future__ import annotations
@@ -60,35 +62,36 @@ def main(argv=None) -> int:
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     from ..models import mnist as m
     from ..parallel import AXIS_DATA, MeshSpec, build_mesh
     from . import data as d
     from .runtime import JobRuntime
-    from .trainer import batch_stack, default_optimizer, train_scan
+    from .trainer import (
+        batch_stack,
+        default_optimizer,
+        global_batches,
+        replicate_global,
+        train_scan,
+    )
 
     rt = JobRuntime.from_env()
+    rt.merge_tf_args(args.job_name, args.task_index, args.worker_hosts)
     rt.initialize()
 
-    # Worker replicas each train their static shard of the global batch and
-    # run their own mesh over local devices; TPU replicas form one global
-    # mesh across processes.
-    workers = max(1, len(args.worker_hosts.split(",")) if args.worker_hosts else rt.num_processes)
-    worker_id = args.task_index if args.task_index >= 0 else rt.process_id
-
+    # One global mesh over every process's devices: classic Worker gangs and
+    # TPU slices land on the same code path.
+    pc, proc = jax.process_count(), jax.process_index()
     mesh = build_mesh(MeshSpec(dp=-1, fsdp=1))
 
     x, y = d.synthetic_mnist(jax.random.PRNGKey(1), args.train_size)
     ex, ey = d.synthetic_mnist(jax.random.PRNGKey(2), args.eval_size)
-    if args.task_index >= 0 and workers > 1:
-        # Classic worker pods are separate training processes (async-PS
-        # analog): each owns a static shard of the data.
-        x = d.shard_for_process(x, worker_id, workers)
-        y = d.shard_for_process(y, worker_id, workers)
+    if pc > 1:
+        # Each process owns a static shard of the data and feeds its share
+        # of every global batch.
+        x = d.shard_for_process(x, proc, pc)
+        y = d.shard_for_process(y, proc, pc)
 
-    params = m.mlp_init(jax.random.PRNGKey(0))
+    params = m.mlp_init(jax.random.PRNGKey(0))  # same seed -> same init everywhere
     opt = default_optimizer(args.lr)
     opt_state = opt.init(params)
 
@@ -98,28 +101,27 @@ def main(argv=None) -> int:
     bs = max(dp, args.batch_size - args.batch_size % dp)
     start = time.time()
     with jax.set_mesh(mesh):
-        xb, yb = batch_stack(x, y, args.steps, bs)
-        step_sharding = NamedSharding(mesh, P(None, AXIS_DATA))
-        batches = (
-            jax.device_put(xb, step_sharding),
-            jax.device_put(yb, step_sharding),
-        )
+        xb, yb = batch_stack(x, y, args.steps, bs // pc)
+        batches = global_batches(mesh, AXIS_DATA, (xb, yb), bs)
         params, opt_state, loss = train_scan(
             lambda p, b: m.mlp_loss(p, b[0], b[1]), opt, params, opt_state, batches
         )
         loss = float(loss)
-    elapsed = time.time() - start
+        elapsed = time.time() - start
+        exg, eyg = replicate_global(mesh, ex, ey)
+        acc = float(jax.jit(m.mlp_accuracy)(params, exg, eyg))
 
-    acc = float(m.mlp_accuracy(params, ex, ey))
-    print(f"Worker {worker_id}/{workers} on {jax.device_count()} devices "
-          f"(mesh dp={mesh.shape[AXIS_DATA]})")
+    print(f"Worker {proc}/{pc} on {jax.device_count()} devices "
+          f"(mesh dp={dp})")
     print(f"Training elapsed time: {elapsed:f} s")
     print(f"Final loss: {loss:f}; eval accuracy: {acc:f}")
-    if rt.model_dir and (args.task_index <= 0 or rt.is_chief):
+    if rt.model_dir:
         from .checkpoint import CheckpointManager
 
+        # Collective under a multi-process mesh: every process participates.
         CheckpointManager(rt.model_dir).save(args.steps, params, opt_state)
-        print(f"Checkpoint saved to {rt.model_dir}")
+        if proc == 0:
+            print(f"Checkpoint saved to {rt.model_dir}")
     if args.target_accuracy and acc < args.target_accuracy:
         print(f"accuracy {acc} below target {args.target_accuracy}", file=sys.stderr)
         return 1

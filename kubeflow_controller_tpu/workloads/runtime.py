@@ -53,6 +53,21 @@ class JobRuntime:
             export_dir=e.get("EXPORT_DIR", ""),
         )
 
+    def merge_tf_args(self, job_name: str, task_index: int, worker_hosts: str) -> None:
+        """Classic TF-contract fallback: when the env contract is absent
+        (direct CLI runs outside the controller), derive the jax.distributed
+        wiring from ``--worker_hosts/--task_index`` — the same inputs the
+        reference workload feeds tf.train.ClusterSpec (ref:
+        mnist_replica.py:106-120).  Worker 0's host doubles as coordinator."""
+        if self.num_processes > 1 or job_name == "ps" or task_index < 0:
+            return
+        hosts = [h for h in worker_hosts.split(",") if h]
+        if len(hosts) <= 1:
+            return
+        self.coordinator = self.coordinator or hosts[0]
+        self.num_processes = len(hosts)
+        self.process_id = task_index
+
     def initialize(self) -> None:
         """Join the job's jax.distributed cluster when it has more than one
         process.  Single-process jobs (and the one-chip CI environment)

@@ -80,6 +80,46 @@ def batch_stack(x: jax.Array, y: jax.Array, steps: int, batch_size: int):
     return x[idx], y[idx]
 
 
+def global_batches(mesh, axis: str, arrays, global_batch: int):
+    """Host-local stacked batches -> ONE global array per input, steps
+    unsharded and the batch dim sharded over ``axis``.
+
+    Single-process: a plain device_put.  Multi-process (classic Worker gangs
+    and multi-host TPU slices): each process contributes its
+    ``global_batch / process_count`` rows of every step's batch
+    (``jax.make_array_from_process_local_data``), so the scan trains one
+    shared model over the union of the workers' shards — the all-reduce
+    re-expression of the reference's PS data plane, not N private runs.
+    """
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(None, axis))
+    if jax.process_count() == 1:
+        return tuple(jax.device_put(a, sharding) for a in arrays)
+    out = []
+    for a in arrays:
+        gshape = (a.shape[0], global_batch) + tuple(a.shape[2:])
+        out.append(jax.make_array_from_process_local_data(
+            sharding, np.asarray(a), gshape))
+    return tuple(out)
+
+
+def replicate_global(mesh, *arrays):
+    """Fully-replicated global arrays (every process passes identical data;
+    used for eval sets so accuracy is computable under a multi-process mesh)."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P())
+    if jax.process_count() == 1:
+        return tuple(jax.device_put(a, sharding) for a in arrays)
+    return tuple(
+        jax.make_array_from_process_local_data(sharding, np.asarray(a), a.shape)
+        for a in arrays
+    )
+
+
 def default_optimizer(lr: float, *, clip: Optional[float] = 1.0,
                       weight_decay: float = 0.0) -> optax.GradientTransformation:
     chain = []

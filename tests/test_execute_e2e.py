@@ -38,7 +38,11 @@ def workload_container(module, *extra_args, env=None):
                  "--platform", "cpu", *extra_args],
         working_dir=REPO,
     )
-    for k, v in (env or {}).items():
+    # Pods must not inherit the test harness's 8-virtual-device XLA_FLAGS:
+    # a 2-worker gang would rendezvous 16 gloo ranks on a tiny CI host.
+    all_env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+    all_env.update(env or {})
+    for k, v in all_env.items():
         c.env.append(EnvVar(name=k, value=v))
     return c
 
