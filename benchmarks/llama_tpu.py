@@ -109,7 +109,7 @@ def run_subprocess(args_list) -> dict:
     return {"error": (out.stderr or "no output")[-400:].strip()}
 
 
-def sweep(steps: int, out_path: str, peak: float) -> int:
+def sweep(steps: int, out_path: str, peak: float, shape: dict) -> int:
     # The grid: remat policies at the judged 953M size, B and T scaling.
     # Flash attention is on (LlamaConfig.attention="auto") for every point.
     grid = [
@@ -129,6 +129,11 @@ def sweep(steps: int, out_path: str, peak: float) -> int:
         r = run_subprocess([
             "--batch", g["batch"], "--seq", g["seq"], "--steps", steps,
             "--remat-policy", g["policy"],
+            # Forward peak + model shape so per-point mfu_pct is computed
+            # against the same values the artifact header records.
+            "--peak-tflops", peak, "--dim", shape["dim"],
+            "--layers", shape["layers"], "--heads", shape["heads"],
+            "--intermediate", shape["intermediate"],
         ])
         r.setdefault("batch", g["batch"])
         r.setdefault("seq", g["seq"])
@@ -141,7 +146,9 @@ def sweep(steps: int, out_path: str, peak: float) -> int:
         "bench": "llama_tpu_single_chip",
         "accounting": "6ND model FLOPs (no remat recompute counted)",
         "peak_tflops_bf16": peak,
-        "model": "953M Llama (dim 2048, L16, H16, inter 5632), adafactor, bf16",
+        "model": (f"Llama (dim {shape['dim']}, L{shape['layers']}, "
+                  f"H{shape['heads']}, inter {shape['intermediate']}), "
+                  "adafactor, bf16"),
         "best": best,
         "results": results,
     }
@@ -168,7 +175,9 @@ def main() -> int:
     p.add_argument("--out", default="benchmarks/llama_tpu_v5e.json")
     args = p.parse_args()
     if args.sweep:
-        return sweep(args.steps, args.out, args.peak_tflops)
+        return sweep(args.steps, args.out, args.peak_tflops,
+                     dict(dim=args.dim, layers=args.layers, heads=args.heads,
+                          intermediate=args.intermediate))
     out = run(args.batch, args.seq, args.steps, args.dim, args.layers,
               args.heads, args.intermediate, args.remat_policy,
               args.peak_tflops)

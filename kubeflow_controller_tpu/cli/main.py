@@ -14,6 +14,12 @@ Usage:
     python -m kubeflow_controller_tpu.cli run --in-memory \
         --manifests examples/jobs/ --execute --until-done
     python -m kubeflow_controller_tpu.cli validate -f job.yaml
+
+Real-cluster (two-process) mode — the controller speaks HTTP to an API
+server, exactly the reference's deployment shape:
+    python -m kubeflow_controller_tpu.cli serve --port 8081 &
+    python -m kubeflow_controller_tpu.cli -master http://127.0.0.1:8081 run \
+        --manifests examples/jobs/local.yaml --until-done
 """
 
 from __future__ import annotations
@@ -31,6 +37,7 @@ import yaml
 from .. import GIT_SHA, __version__
 from ..api.tfjob import TFJob, TFJobPhase, validate_tfjob, ValidationError
 from ..cluster import Cluster, FakeKubelet, PhasePolicy, TPUInventory, TPUSlice
+from ..cluster.store import APIError
 from ..controller import Controller
 from ..utils import serde
 from .signals import setup_signal_handler
@@ -84,19 +91,9 @@ def cmd_validate(args) -> int:
     return rc
 
 
-def cmd_run(args) -> int:
-    logging.basicConfig(
-        level=logging.DEBUG if args.v >= 4 else logging.INFO,
-        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
-    )
-    if not args.in_memory:
-        print("error: only --in-memory mode is available in this environment "
-              "(no kubeconfig/cluster support compiled in); pass --in-memory",
-              file=sys.stderr)
-        return 2
-
-    stop = setup_signal_handler()
-    cluster = Cluster()
+def _build_substrate(args, cluster):
+    """The fake-cluster node side shared by `serve` and `run --in-memory`:
+    TPU inventory from the flags + a kubelet driving the given cluster."""
     slices = [
         TPUSlice(f"slice-{i}", args.tpu_slice_type, num_hosts=args.tpu_slice_hosts)
         for i in range(args.tpu_slices)
@@ -108,8 +105,68 @@ def cmd_run(args) -> int:
         inventory=inventory,
         execute=args.execute,
     )
-    ctrl = Controller(cluster, inventory=inventory, resync_period_s=args.resync_period)
+    return inventory, kubelet
+
+
+def cmd_serve(args) -> int:
+    """Run the in-memory API server (+ kubelet) as a standalone process —
+    the cluster half of real-cluster mode.  A controller in another process
+    connects with ``run -master http://127.0.0.1:<port>``."""
+    from ..cluster.apiserver import FakeAPIServer
+    from ..cluster.store import ObjectStore
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.v >= 4 else logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    )
+    stop = setup_signal_handler()
+    store = ObjectStore()
+    server = FakeAPIServer(store, token=args.token, port=args.port)
+    _, kubelet = _build_substrate(args, Cluster(store=store))
+    url = server.start()
     kubelet.start()
+    print(f"api server listening on {url}", flush=True)
+    try:
+        while not stop.is_set():
+            time.sleep(0.2)
+    finally:
+        kubelet.stop()
+        server.stop()
+    return 0
+
+
+def cmd_run(args) -> int:
+    logging.basicConfig(
+        level=logging.DEBUG if args.v >= 4 else logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    )
+    use_rest = bool(args.kubeconfig or args.master)
+    if not args.in_memory and not use_rest:
+        print("error: pass --in-memory, or -kubeconfig/-master for an API "
+              "server (see the `serve` subcommand)", file=sys.stderr)
+        return 2
+
+    stop = setup_signal_handler()
+    kubelet = None
+    if use_rest:
+        # Real-cluster mode: BuildConfigFromFlags parity
+        # (ref: cmd/controller/main.go:47-60).  The API server owns the
+        # kubelet/inventory; this process is only the controller.
+        from ..cluster.rest import KubeconfigError, RestCluster
+
+        try:
+            cluster = RestCluster.from_flags(args.kubeconfig, args.master)
+            cluster.tfjobs.list()  # connectivity probe: fail fast and clean
+        except (KubeconfigError, OSError, APIError) as e:
+            print(f"error building cluster config: {e}", file=sys.stderr)
+            return 2
+        inventory = None
+    else:
+        cluster = Cluster()
+        inventory, kubelet = _build_substrate(args, cluster)
+    ctrl = Controller(cluster, inventory=inventory, resync_period_s=args.resync_period)
+    if kubelet is not None:
+        kubelet.start()
     ctrl.run(threadiness=args.threadiness)
     logger.info("tfjob-controller %s (git %s) started: %d workers, %.0fs resync",
                 __version__, GIT_SHA, args.threadiness, args.resync_period)
@@ -132,12 +189,22 @@ def cmd_run(args) -> int:
                 all_jobs = cluster.tfjobs.list()
                 if all_jobs and all(j.status.phase in terminal for j in all_jobs):
                     break
+    except APIError as e:
+        # Mid-run API server loss (REST mode): fail cleanly, not a traceback.
+        print(f"error talking to API server: {e}", file=sys.stderr)
+        return 2
     finally:
         ctrl.stop()
-        kubelet.stop()
+        if kubelet is not None:
+            kubelet.stop()
 
     rc = 0
-    for j in cluster.tfjobs.list():
+    try:
+        final_jobs = cluster.tfjobs.list()
+    except APIError as e:
+        print(f"error talking to API server: {e}", file=sys.stderr)
+        return 2
+    for j in final_jobs:
         key = f"{j.metadata.namespace}/{j.metadata.name}"
         print(f"{key}: phase={j.status.phase.value}")
         for rs in j.status.tf_replica_statuses:
@@ -162,13 +229,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-version", "--version", action="store_true",
                    help="print version and exit (ref flag parity)")
     p.add_argument("-kubeconfig", "--kubeconfig", default="",
-                   help="path to a kubeconfig (reserved; real-cluster mode "
-                        "is not compiled into this build)")
+                   help="path to a kubeconfig; selects the REST transport "
+                        "(ref flag parity: cmd/controller/main.go:47-60)")
     p.add_argument("-master", "--master", default="",
-                   help="API server address override (reserved, as above)")
+                   help="API server address; overrides the kubeconfig server")
     sub = p.add_subparsers(dest="cmd")
 
     sub.add_parser("version", help="print version and exit")
+
+    s = sub.add_parser("serve", help="run the in-memory API server + kubelet "
+                                     "as a standalone process")
+    s.add_argument("--port", type=int, default=0,
+                   help="listen port (default: ephemeral, printed at startup)")
+    s.add_argument("--token", default="", help="require this bearer token")
+    s.add_argument("--execute", action="store_true",
+                   help="kubelet executes container commands as local processes")
+    s.add_argument("--sim-run-seconds", type=float, default=0.05)
+    s.add_argument("--tpu-slices", type=int, default=1)
+    s.add_argument("--tpu-slice-type", default="v5e-8")
+    s.add_argument("--tpu-slice-hosts", type=int, default=2)
+    s.add_argument("-v", type=int, default=0)
 
     v = sub.add_parser("validate", help="validate TFJob manifests")
     v.add_argument("-f", "--files", nargs="+", required=True)
@@ -200,6 +280,8 @@ def main(argv=None) -> int:
         return cmd_version(args)
     if args.cmd == "validate":
         return cmd_validate(args)
+    if args.cmd == "serve":
+        return cmd_serve(args)
     if args.cmd == "run":
         return cmd_run(args)
     build_parser().print_help()

@@ -43,14 +43,27 @@ _KINDS: Dict[str, Tuple[Type, str, str]] = {
 
 
 def _parse_selector(q: Dict[str, list]) -> Optional[Dict[str, str]]:
+    """Equality selectors only (k=v / k==v) — what the controller's label
+    scheme uses.  Set-based / inequality operators are rejected loudly
+    rather than silently matching the wrong objects."""
     raw = (q.get("labelSelector") or [None])[0]
     if not raw:
         return None
     out = {}
     for part in raw.split(","):
-        if "=" in part:
+        part = part.strip()
+        if not part:
+            continue
+        if "!=" in part or part.endswith(" in") or " in " in part or " notin " in part:
+            raise Invalid(f"unsupported label selector operator in {part!r}; "
+                          "only equality (k=v) is supported")
+        if "==" in part:
+            k, v = part.split("==", 1)
+        elif "=" in part:
             k, v = part.split("=", 1)
-            out[k.strip()] = v.strip()
+        else:
+            raise Invalid(f"cannot parse label selector clause {part!r}")
+        out[k.strip()] = v.strip()
     return out
 
 
@@ -116,9 +129,11 @@ def _route(path: str, query: str) -> Optional[_Route]:
 class FakeAPIServer:
     """ThreadingHTTPServer over an ObjectStore; start() returns the URL."""
 
-    def __init__(self, store: Optional[ObjectStore] = None, token: str = ""):
+    def __init__(self, store: Optional[ObjectStore] = None, token: str = "",
+                 port: int = 0):
         self.store = store or ObjectStore()
         self.token = token
+        self.port = port  # 0 = ephemeral
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -151,14 +166,22 @@ class FakeAPIServer:
                 self.wfile.write(data)
 
             def _body(self) -> dict:
-                n = int(self.headers.get("Content-Length", 0))
-                return json.loads(self.rfile.read(n) or b"{}")
+                return json.loads(self._raw_body or b"{}")
 
             def _dispatch(self, method: str) -> None:
+                # Drain the request body up front: an early response (401,
+                # 404) that leaves body bytes in the socket would corrupt
+                # the next request on a keep-alive connection.
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                self._raw_body = self.rfile.read(n) if n else b""
                 if self._deny():
                     return
                 u = urlparse(self.path)
-                r = _route(u.path, u.query)
+                try:
+                    r = _route(u.path, u.query)
+                except APIError as e:
+                    self._send(*_error_status(e))
+                    return
                 if r is None:
                     self._send(*_status(404, "NotFound", f"no route {u.path}"))
                     return
@@ -184,7 +207,7 @@ class FakeAPIServer:
             def do_PATCH(self):
                 self._dispatch("PATCH")
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="fake-apiserver", daemon=True)
@@ -271,7 +294,8 @@ class FakeAPIServer:
             return
         if method == "DELETE":
             store.delete(r.plural, ns, r.name)
-            h._send(200, _status(200, "Success", "deleted")[1])
+            h._send(200, {"kind": "Status", "apiVersion": "v1",
+                          "status": "Success", "code": 200})
             return
         raise NotFound(f"{method} not supported on item")
 

@@ -137,7 +137,19 @@ class FakeKubelet:
             self._pool.stop()
 
     def _run(self) -> None:
+        last_reap = time.monotonic()
         while not self._stop.is_set():
+            # Node-side gang reaping: free slices whose gang has no live pod
+            # left.  Required in two-process (REST) mode where the controller
+            # holds no inventory handle; harmless redundancy otherwise.
+            if self.inventory is not None and time.monotonic() - last_reap > 0.5:
+                last_reap = time.monotonic()
+                live = {
+                    p.metadata.name for p in self.cluster.pods.list()
+                    if p.status.phase not in (PHASE_SUCCEEDED, PHASE_FAILED)
+                    and p.metadata.deletion_timestamp is None
+                }
+                self.inventory.release_idle_gangs(live)
             ev = self._watcher.next(timeout=0.2)
             if ev is None:
                 continue

@@ -24,6 +24,7 @@ analog to vendor.
 from __future__ import annotations
 
 import calendar
+import http.client
 import json
 import queue
 import ssl
@@ -235,17 +236,32 @@ class RestWatcher:
         self._cls = cls
         self.queue: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
         self._stopped = threading.Event()
+        self._connected = threading.Event()
+        # Incremented each time a broken stream is RE-established: events in
+        # the gap are gone (the server does not replay), so consumers holding
+        # a cache must re-list — client-go reflectors do the same.  The
+        # informer polls this counter (informer.py:_watch_loop).
+        self.gaps = 0
         self._resp = None
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"watch-{path}")
         self._thread.start()
+        # Block until the server has accepted the watch (response headers
+        # arrive only after the server registered the event stream), so an
+        # object created right after watch() cannot slip into the gap.
+        self._connected.wait(timeout=10.0)
 
     def _run(self) -> None:
+        ever_connected = False
         while not self._stopped.is_set():
             try:
                 self._resp = self._transport._request(
                     "GET", self._path, params=self._params, stream=True,
                     timeout=3600.0)
+                if ever_connected:
+                    self.gaps += 1  # after reconnect, so a re-list now is safe
+                ever_connected = True
+                self._connected.set()
                 for raw in self._resp:
                     if self._stopped.is_set():
                         return
@@ -257,9 +273,15 @@ class RestWatcher:
                         continue
                     obj = serde.from_dict(self._cls, _normalize_meta(ev["object"]))
                     self.queue.put(WatchEvent(ev["type"], obj))
-            except (APIError, OSError, ValueError):
+            except (APIError, OSError, ValueError, AttributeError,
+                    http.client.HTTPException):
+                # HTTPException: IncompleteRead when the server dies
+                # mid-chunk (not an OSError).  AttributeError: http.client
+                # raises it when stop() closes the response out from under a
+                # blocked chunked read.
                 if self._stopped.is_set():
                     return
+                self._connected.clear()
                 time.sleep(0.2)  # reconnect, as client-go reflectors do
 
     def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:

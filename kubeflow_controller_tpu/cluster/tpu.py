@@ -102,6 +102,25 @@ class TPUInventory:
             if g and g.slice_name and g.slice_name in self.slices:
                 self.slices[g.slice_name].bound_gang = ""
 
+    def release_idle_gangs(self, active_pod_names) -> List[str]:
+        """Release every gang none of whose member pods is still active —
+        the node-side backstop that frees slices when the controller that
+        acquired them runs in another process (REST/two-process mode, where
+        the controller's ``release_gang`` calls happen against a different
+        ``TPUInventory`` instance — or none at all).  Idempotent with the
+        controller's own terminal-cleanup release.
+
+        A still-forming gang can be released spuriously if its first pod was
+        created after the caller snapshotted the pod list; that self-heals
+        because Pending TPU pods re-``offer`` in a loop until admitted."""
+        active = set(active_pod_names)
+        with self._lock:
+            idle = [name for name, g in self._gangs.items()
+                    if not (set(g.pods) & active)]
+        for name in idle:
+            self.release_gang(name)
+        return idle
+
     def fail_slice(self, slice_name: str) -> List[str]:
         """Simulate a whole-slice failure (the TPU failure domain).  Returns
         the names of pods in the bound gang; the kubelet fails them all."""

@@ -102,7 +102,24 @@ class SharedInformer:
     # -- internals -----------------------------------------------------------
 
     def _watch_loop(self) -> None:
+        # Transports that can drop events (REST watch reconnect) expose a
+        # `gaps` counter; a bump means the stream was re-established and
+        # anything in between is lost — re-list and diff, as client-go
+        # reflectors do.  The in-memory watcher never gaps (no attribute).
+        seen_gaps = getattr(self._watcher, "gaps", 0)
         while not self._stop.is_set():
+            gaps = getattr(self._watcher, "gaps", 0)
+            if gaps != seen_gaps:
+                seen_gaps = gaps
+                # Drain events queued before/through the gap FIRST: a stale
+                # pre-gap event applied after the re-list could resurrect an
+                # object deleted during the gap (client-go flushes its FIFO
+                # via Replace() for the same reason).  Anything drained that
+                # was actually fresh (post-reconnect) is re-captured by the
+                # list below, which reads newer state than those events.
+                while self._watcher.next(timeout=0) is not None:
+                    pass
+                self._relist()
             ev = self._watcher.next(timeout=0.2)
             if ev is None:
                 continue
@@ -125,6 +142,29 @@ class SharedInformer:
                 with self._lock:
                     self._cache.pop(k, None)
                 self._dispatch_delete(ev.object)
+
+    def _relist(self) -> None:
+        """Full list + diff against the cache, firing the handlers the lost
+        watch events would have fired."""
+        try:
+            fresh = {key_of(o.metadata): o for o in self._client.list()}
+        except Exception:  # noqa: BLE001 — server still flapping; next gap retries
+            return
+        with self._lock:
+            stale_keys = set(self._cache) - set(fresh)
+        for k, obj in fresh.items():
+            with self._lock:
+                old = self._cache.get(k)
+                self._cache[k] = obj
+            if old is None:
+                self._dispatch_add(obj)
+            else:
+                self._dispatch_update(old, obj)
+        for k in stale_keys:
+            with self._lock:
+                gone = self._cache.pop(k, None)
+            if gone is not None:
+                self._dispatch_delete(gone)
 
     def _resync_loop(self) -> None:
         while not self._stop.is_set():

@@ -1,0 +1,369 @@
+"""REST transport tests: the typed clients (cluster/rest.py) against the
+in-process HTTP API server (cluster/apiserver.py), and the controller
+running end-to-end over HTTP — the exact code path ``-kubeconfig`` selects
+(ref: cmd/controller/main.go:47-60; typed client surface at
+vendor/.../typed/kubeflow/v1alpha1/tfjob.go:34-154)."""
+
+import time
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import Container, PodTemplateSpec, Pod
+from kubeflow_controller_tpu.api.meta import ObjectMeta, OwnerReference
+from kubeflow_controller_tpu.api.tfjob import (
+    ReplicaType,
+    TFJob,
+    TFJobPhase,
+    TFReplicaSpec,
+)
+from kubeflow_controller_tpu.cluster import Cluster, FakeKubelet, PhasePolicy
+from kubeflow_controller_tpu.cluster.apiserver import FakeAPIServer
+from kubeflow_controller_tpu.cluster.rest import (
+    Kubeconfig,
+    KubeconfigError,
+    RestCluster,
+)
+from kubeflow_controller_tpu.cluster.store import (
+    ADDED,
+    AlreadyExists,
+    APIError,
+    Conflict,
+    DELETED,
+    MODIFIED,
+    NotFound,
+)
+from kubeflow_controller_tpu.controller import Controller
+
+
+def mk_job(name, *types_and_replicas):
+    job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+    for typ, n in types_and_replicas:
+        t = PodTemplateSpec()
+        t.spec.containers.append(Container(name="tensorflow", image="img"))
+        t.spec.restart_policy = "OnFailure"
+        job.spec.tf_replica_specs.append(
+            TFReplicaSpec(replicas=n, tf_replica_type=typ, template=t))
+    return job
+
+
+def wait_for(fn, timeout=15.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+@pytest.fixture
+def server():
+    srv = FakeAPIServer()
+    url = srv.start()
+    yield srv, url
+    srv.stop()
+
+
+@pytest.fixture
+def rest(server):
+    srv, url = server
+    yield RestCluster(Kubeconfig(server=url))
+
+
+class TestRestCRUD:
+    def test_tfjob_roundtrip(self, rest):
+        created = rest.tfjobs.create(mk_job("j1", (ReplicaType.LOCAL, 1)))
+        assert created.metadata.resource_version
+        got = rest.tfjobs.get("default", "j1")
+        assert got.metadata.uid == created.metadata.uid
+        assert got.spec.tf_replica_specs[0].tf_replica_type == ReplicaType.LOCAL
+        assert [j.metadata.name for j in rest.tfjobs.list("default")] == ["j1"]
+        rest.tfjobs.delete("default", "j1")
+        with pytest.raises(NotFound):
+            rest.tfjobs.get("default", "j1")
+
+    def test_create_duplicate_is_already_exists(self, rest):
+        rest.tfjobs.create(mk_job("dup", (ReplicaType.LOCAL, 1)))
+        with pytest.raises(AlreadyExists):
+            rest.tfjobs.create(mk_job("dup", (ReplicaType.LOCAL, 1)))
+
+    def test_stale_update_conflicts(self, rest):
+        created = rest.tfjobs.create(mk_job("c1", (ReplicaType.LOCAL, 1)))
+        fresh = rest.tfjobs.get("default", "c1")
+        fresh.spec.runtime_id = "aaaaa"
+        rest.tfjobs.update(fresh)
+        created.spec.runtime_id = "bbbbb"  # stale resourceVersion
+        with pytest.raises(Conflict):
+            rest.tfjobs.update(created)
+
+    def test_generate_name(self, rest):
+        pod = Pod()
+        pod.metadata.namespace = "default"
+        pod.metadata.generate_name = "job-worker-"
+        out = rest.pods.create(pod)
+        assert out.metadata.name.startswith("job-worker-")
+        assert len(out.metadata.name) > len("job-worker-")
+
+    def test_label_selector_list(self, rest):
+        for i, color in enumerate(["red", "blue", "red"]):
+            p = Pod()
+            p.metadata.namespace = "default"
+            p.metadata.name = f"p{i}"
+            p.metadata.labels = {"color": color}
+            rest.pods.create(p)
+        reds = rest.pods.list("default", selector={"color": "red"})
+        assert sorted(p.metadata.name for p in reds) == ["p0", "p2"]
+
+    def test_status_subresource_ignores_spec(self, rest):
+        rest.tfjobs.create(mk_job("s1", (ReplicaType.LOCAL, 1)))
+        j = rest.tfjobs.get("default", "s1")
+        j.status.phase = TFJobPhase.RUNNING
+        j.spec.runtime_id = "hacked"  # must not land through /status
+        out = rest.tfjobs.update_status(j)
+        assert out.status.phase == TFJobPhase.RUNNING
+        assert rest.tfjobs.get("default", "s1").spec.runtime_id != "hacked"
+
+    def test_patch_meta_adoption(self, server, rest):
+        srv, _ = server
+        rest.tfjobs.create(mk_job("owner", (ReplicaType.LOCAL, 1)))
+        owner = rest.tfjobs.get("default", "owner")
+        p = Pod()
+        p.metadata.namespace = "default"
+        p.metadata.name = "orphan"
+        rest.pods.create(p)
+
+        def adopt(meta):
+            meta.owner_references = [OwnerReference(
+                api_version="kubeflow.caicloud.io/v1alpha1", kind="TFJob",
+                name="owner", uid=owner.metadata.uid,
+                controller=True, block_owner_deletion=True)]
+            meta.labels["adopted"] = "true"
+
+        out = rest.pods.patch_meta("default", "orphan", adopt)
+        assert out.metadata.owner_references[0].uid == owner.metadata.uid
+        # Authoritative state lives in the server's store.
+        stored = srv.store.get("pods", "default", "orphan")
+        assert stored.metadata.labels["adopted"] == "true"
+        assert stored.metadata.owner_references[0].controller is True
+
+
+class TestRestWatch:
+    def test_watch_stream_add_modify_delete(self, rest):
+        w = rest.tfjobs.watch("default")
+        try:
+            rest.tfjobs.create(mk_job("w1", (ReplicaType.LOCAL, 1)))
+            ev = w.next(timeout=5.0)
+            assert ev is not None and ev.type == ADDED
+            assert ev.object.metadata.name == "w1"
+
+            j = rest.tfjobs.get("default", "w1")
+            j.spec.runtime_id = "zzzzz"
+            rest.tfjobs.update(j)
+            ev = w.next(timeout=5.0)
+            assert ev is not None and ev.type == MODIFIED
+            assert ev.object.spec.runtime_id == "zzzzz"
+
+            rest.tfjobs.delete("default", "w1")
+            ev = w.next(timeout=5.0)
+            assert ev is not None and ev.type == DELETED
+        finally:
+            w.stop()
+
+
+class TestWatchGapRelist:
+    def test_informer_relists_after_server_restart(self):
+        """Events lost while the watch stream is down must be recovered by a
+        re-list on reconnect (client-go reflector semantics)."""
+        import socket
+
+        from kubeflow_controller_tpu.cluster.store import ObjectStore
+        from kubeflow_controller_tpu.controller.informer import SharedInformer
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        store = ObjectStore()
+        srv = FakeAPIServer(store, port=port)
+        url = srv.start()
+        rest = RestCluster(Kubeconfig(server=url))
+        informer = SharedInformer(rest.tfjobs, resync_period_s=0, name="tfjobs")
+        informer.start()
+        try:
+            rest.tfjobs.create(mk_job("before", (ReplicaType.LOCAL, 1)))
+            wait_for(lambda: informer.get("default", "before") is not None)
+
+            srv.stop()  # the watch stream drops
+            # Mutations the informer cannot see while disconnected:
+            store.create("tfjobs", mk_job("during", (ReplicaType.LOCAL, 1)))
+            store.delete("tfjobs", "default", "before")
+            srv2 = FakeAPIServer(store, port=port)
+            srv2.start()
+            try:
+                wait_for(lambda: informer.get("default", "during") is not None)
+                wait_for(lambda: informer.get("default", "before") is None)
+            finally:
+                srv2.stop()
+        finally:
+            informer.stop()
+
+
+class TestAuth:
+    def test_bearer_token_required(self):
+        srv = FakeAPIServer(token="sekrit")
+        url = srv.start()
+        try:
+            bad = RestCluster(Kubeconfig(server=url))
+            with pytest.raises(APIError):
+                bad.tfjobs.list("default")
+            good = RestCluster(Kubeconfig(server=url, token="sekrit"))
+            assert good.tfjobs.list("default") == []
+        finally:
+            srv.stop()
+
+
+class TestKubeconfig:
+    def test_load_and_master_override(self, tmp_path):
+        cfg = tmp_path / "kubeconfig"
+        cfg.write_text("""
+apiVersion: v1
+kind: Config
+current-context: ctx
+contexts:
+- name: ctx
+  context: {cluster: c, user: u}
+clusters:
+- name: c
+  cluster: {server: "http://10.0.0.1:8080"}
+users:
+- name: u
+  user: {token: tok123}
+""")
+        kc = Kubeconfig.load(str(cfg))
+        assert kc.server == "http://10.0.0.1:8080"
+        assert kc.token == "tok123"
+        kc2 = Kubeconfig.load(str(cfg), master="http://127.0.0.1:9999")
+        assert kc2.server == "http://127.0.0.1:9999"
+
+    def test_no_server_raises(self, tmp_path):
+        cfg = tmp_path / "empty"
+        cfg.write_text("apiVersion: v1\nkind: Config\n")
+        with pytest.raises(KubeconfigError):
+            Kubeconfig.load(str(cfg))
+
+    def test_from_flags_requires_one(self):
+        with pytest.raises(KubeconfigError):
+            RestCluster.from_flags("", "")
+
+
+class TestControllerOverREST:
+    """The same Controller object, fed a RestCluster: API -> HTTP -> store ->
+    watch stream -> informers -> sync -> HTTP writes.  The kubelet drives pod
+    phases in the server's store directly, as a node agent would."""
+
+    @pytest.fixture
+    def rig(self, server):
+        srv, url = server
+        substrate = Cluster(store=srv.store)
+        kubelet = FakeKubelet(substrate, policy=PhasePolicy(run_s=0.05))
+        rest = RestCluster(Kubeconfig(server=url))
+        ctrl = Controller(rest, resync_period_s=0.5)
+        kubelet.start()
+        ctrl.run(threadiness=2)
+        yield rest, ctrl
+        ctrl.stop()
+        kubelet.stop()
+
+    def test_local_job_to_succeeded(self, rig):
+        rest, _ = rig
+        rest.tfjobs.create(mk_job("local-rest", (ReplicaType.LOCAL, 1)))
+        wait_for(lambda: rest.tfjobs.get("default", "local-rest").status.phase
+                 == TFJobPhase.SUCCEEDED)
+        assert len(rest.pods.list("default")) == 1
+
+    def test_distributed_job_to_succeeded(self, rig):
+        rest, _ = rig
+        rest.tfjobs.create(
+            mk_job("dist-rest", (ReplicaType.PS, 1), (ReplicaType.WORKER, 2)))
+        wait_for(lambda: rest.tfjobs.get("default", "dist-rest").status.phase
+                 == TFJobPhase.SUCCEEDED)
+        job = rest.tfjobs.get("default", "dist-rest")
+        types = {rs.type for rs in job.status.tf_replica_statuses}
+        assert {ReplicaType.PS, ReplicaType.WORKER} <= types
+        # Ownership was stamped over the wire.
+        for pod in rest.pods.list("default"):
+            refs = pod.metadata.owner_references
+            assert refs and refs[0].kind == "TFJob" and refs[0].controller
+
+
+class TestGangReleaseOverREST:
+    def test_sequential_tpu_jobs_reuse_the_slice(self, server):
+        """In two-process mode the controller has no inventory handle; the
+        kubelet-side reaper must free the slice when a gang's pods finish,
+        or every TPU job after the first hangs Pending forever."""
+        from kubeflow_controller_tpu.api.tfjob import TPUSpec
+        from kubeflow_controller_tpu.cluster import TPUInventory, TPUSlice
+
+        srv, url = server
+        substrate = Cluster(store=srv.store)
+        inventory = TPUInventory([TPUSlice("slice-0", "v5e-8", num_hosts=2)])
+        kubelet = FakeKubelet(substrate, policy=PhasePolicy(run_s=0.05),
+                              inventory=inventory)
+        rest = RestCluster(Kubeconfig(server=url))
+        ctrl = Controller(rest, resync_period_s=0.5)  # inventory=None: REST mode
+        kubelet.start()
+        ctrl.run(threadiness=2)
+        try:
+            for name in ("tpu-a", "tpu-b"):
+                job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+                t = PodTemplateSpec()
+                t.spec.containers.append(
+                    Container(name="tensorflow", image="img"))
+                t.spec.restart_policy = "OnFailure"
+                spec = TFReplicaSpec(replicas=2, tf_replica_type=ReplicaType.TPU,
+                                     template=t)
+                spec.tpu = TPUSpec(accelerator_type="v5e-8", chips_per_host=4)
+                job.spec.tf_replica_specs.append(spec)
+                rest.tfjobs.create(job)
+                wait_for(lambda: rest.tfjobs.get("default", name).status.phase
+                         == TFJobPhase.SUCCEEDED, timeout=20.0)
+        finally:
+            ctrl.stop()
+            kubelet.stop()
+
+
+class TestCLITwoProcess:
+    """`serve` + `run -master` as real subprocesses — the reference's
+    deployment shape (controller binary pointed at an API server)."""
+
+    def test_serve_and_run(self, tmp_path):
+        import os
+        import re
+        import signal
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        srv = subprocess.Popen(
+            [sys.executable, "-m", "kubeflow_controller_tpu.cli", "serve"],
+            cwd=repo, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        try:
+            line = srv.stdout.readline()
+            m = re.search(r"listening on (http://\S+)", line)
+            assert m, f"no listen line: {line!r}"
+            url = m.group(1)
+            out = subprocess.run(
+                [sys.executable, "-m", "kubeflow_controller_tpu.cli",
+                 "-master", url, "run",
+                 "--manifests", "examples/jobs/local.yaml", "--until-done"],
+                cwd=repo, env=env, capture_output=True, text=True, timeout=120)
+            assert out.returncode == 0, out.stderr[-2000:]
+            assert "phase=Succeeded" in out.stdout
+        finally:
+            srv.send_signal(signal.SIGINT)
+            try:
+                srv.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                srv.kill()
