@@ -192,8 +192,13 @@ class RestTransport:
             raise APIError(f"{method} {url}: {e.reason}") from None
         if stream:
             return resp
-        with resp:
-            return json.loads(resp.read() or b"null")
+        try:
+            with resp:
+                return json.loads(resp.read() or b"null")
+        except (OSError, http.client.HTTPException, ValueError) as e:
+            # Server lost mid-body (IncompleteRead / reset) or garbage JSON:
+            # surface as APIError so callers' cleanup paths catch it.
+            raise APIError(f"{method} {url}: {e!r}") from None
 
 
 # ---------------------------------------------------------------------------
@@ -273,12 +278,18 @@ class RestWatcher:
                         continue
                     obj = serde.from_dict(self._cls, _normalize_meta(ev["object"]))
                     self.queue.put(WatchEvent(ev["type"], obj))
-            except (APIError, OSError, ValueError, AttributeError,
+            except AttributeError:
+                # http.client raises AttributeError when stop() closes the
+                # response out from under a blocked chunked read; any OTHER
+                # AttributeError is a real bug (e.g. in deserialization) and
+                # must crash visibly, not loop silently.
+                if self._stopped.is_set():
+                    return
+                raise
+            except (APIError, OSError, ValueError,
                     http.client.HTTPException):
                 # HTTPException: IncompleteRead when the server dies
-                # mid-chunk (not an OSError).  AttributeError: http.client
-                # raises it when stop() closes the response out from under a
-                # blocked chunked read.
+                # mid-chunk (not an OSError).
                 if self._stopped.is_set():
                     return
                 self._connected.clear()

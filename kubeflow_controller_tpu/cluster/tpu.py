@@ -50,6 +50,9 @@ class TPUInventory:
         self._lock = threading.Lock()
         self.slices: Dict[str, TPUSlice] = {s.name: s for s in (slices or [])}
         self._gangs: Dict[str, _Gang] = {}
+        # Gangs seen idle by the last release_idle_gangs scan (two-scan
+        # confirmation guards the snapshot race — see release_idle_gangs).
+        self._idle_candidates: set = set()
 
     def add_slice(self, s: TPUSlice) -> None:
         with self._lock:
@@ -110,16 +113,21 @@ class TPUInventory:
         ``TPUInventory`` instance — or none at all).  Idempotent with the
         controller's own terminal-cleanup release.
 
-        A still-forming gang can be released spuriously if its first pod was
-        created after the caller snapshotted the pod list; that self-heals
-        because Pending TPU pods re-``offer`` in a loop until admitted."""
+        A gang is only released after being idle in TWO consecutive calls:
+        a gang admitted between the caller's pod-list snapshot and this call
+        would otherwise be released while its (running) pods proceed —
+        running pods never re-offer, so slice exclusivity would break.  The
+        second call sees a fresh snapshot containing those pods and clears
+        the candidacy."""
         active = set(active_pod_names)
         with self._lock:
-            idle = [name for name, g in self._gangs.items()
-                    if not (set(g.pods) & active)]
-        for name in idle:
+            idle = {name for name, g in self._gangs.items()
+                    if not (set(g.pods) & active)}
+            confirmed = list(idle & self._idle_candidates)
+            self._idle_candidates = idle - set(confirmed)
+        for name in confirmed:
             self.release_gang(name)
-        return idle
+        return confirmed
 
     def fail_slice(self, slice_name: str) -> List[str]:
         """Simulate a whole-slice failure (the TPU failure domain).  Returns

@@ -55,6 +55,10 @@ class LlamaConfig:
     n_experts: int = 0
     moe_top_k: int = 2
     capacity_factor: float = 1.25
+    # Router auxiliary losses (used when n_experts > 0): load-balancing
+    # coefficient (Switch uses 1e-2) and ST-MoE router z-loss coefficient.
+    moe_aux_coef: float = 1e-2
+    moe_z_coef: float = 1e-3
     # Remat policy — the FLOPs/HBM dial for the backward pass:
     #   "full":    save only layer boundaries; recompute everything (~8ND
     #              executed per step).  Minimum memory.
@@ -270,8 +274,13 @@ def llama_forward(
     cfg: LlamaConfig,
     mesh: Optional[Mesh] = None,
     rules: ShardingRules = DEFAULT_RULES,
-) -> jax.Array:
-    """tokens [B, T] int32 -> logits [B, T, vocab] f32."""
+    *,
+    return_aux: bool = False,
+):
+    """tokens [B, T] int32 -> logits [B, T, vocab] f32.
+
+    With ``return_aux=True`` also returns the MoE router stats averaged
+    over layers ({aux_loss, z_loss, overflow_frac}, zeros for dense)."""
     dtype = jnp.dtype(cfg.dtype)
     B, T = tokens.shape
     x = params["embed"][tokens].astype(dtype)
@@ -280,12 +289,15 @@ def llama_forward(
     layer = _decoder_layer_fn(cfg, angles, mesh, rules)
 
     layer_fn = _maybe_remat(layer, cfg)
-    x, _ = jax.lax.scan(lambda carry, lp: layer_fn(carry, lp), x, params["layers"])
+    x, aux = jax.lax.scan(lambda carry, lp: layer_fn(carry, lp), x, params["layers"])
 
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(dtype))
     logits = with_logical_constraint(logits, ("batch", "seq", "vocab"), rules)
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    if return_aux:
+        return logits, {k: jnp.mean(v) for k, v in aux.items()}
+    return logits
 
 
 def _maybe_remat(layer, cfg: LlamaConfig):
@@ -317,13 +329,8 @@ def ffn_block(h: jax.Array, lp, cfg: LlamaConfig,
     decode path so the two cannot drift."""
     dtype = h.dtype
     if cfg.n_experts:
-        from .moe import moe_ffn
-
-        return moe_ffn(
-            h, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
-            top_k=cfg.moe_top_k, capacity_factor=cfg.capacity_factor,
-            rules=rules,
-        )
+        y, _ = ffn_block_stats(h, lp, cfg, rules)
+        return y
     # checkpoint_name marks the layer's FLOPs-dominant matmul outputs so the
     # named remat policies ("ffn"/"gateup") can save exactly these and
     # recompute the rest.  Only inserted when the policy consumes them: the
@@ -345,8 +352,21 @@ def ffn_block(h: jax.Array, lp, cfg: LlamaConfig,
         jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(dtype)), "ffn_down")
 
 
+def ffn_block_stats(h: jax.Array, lp, cfg: LlamaConfig,
+                    rules: ShardingRules = DEFAULT_RULES):
+    """MoE FFN returning (y, router stats) — see moe.moe_ffn_stats."""
+    from .moe import moe_ffn_stats
+
+    return moe_ffn_stats(
+        h, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+        top_k=cfg.moe_top_k, capacity_factor=cfg.capacity_factor,
+        rules=rules,
+    )
+
+
 def _decoder_layer_fn(cfg: LlamaConfig, angles, mesh, rules):
-    """One decoder layer as a scan-compatible ``(x, lp) -> (x, None)``."""
+    """One decoder layer as a scan-compatible ``(x, lp) -> (x, aux)`` where
+    ``aux`` is the layer's MoE router stats (zeros for dense layers)."""
     dtype = jnp.dtype(cfg.dtype)
     repeats = cfg.n_heads // cfg.n_kv_heads
 
@@ -372,9 +392,15 @@ def _decoder_layer_fn(cfg: LlamaConfig, angles, mesh, rules):
         x = x + proj
 
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-        x = x + ffn_block(h, lp, cfg, rules)
+        if cfg.n_experts:
+            ff, aux = ffn_block_stats(h, lp, cfg, rules)
+        else:
+            ff = ffn_block(h, lp, cfg, rules)
+            aux = {"aux_loss": jnp.float32(0), "z_loss": jnp.float32(0),
+                   "overflow_frac": jnp.float32(0)}
+        x = x + ff
         x = with_logical_constraint(x, ("batch", "seq", None), rules)
-        return x, None
+        return x, aux
 
     return layer
 
@@ -406,6 +432,10 @@ def llama_forward_pp(
     layer_fn = _maybe_remat(layer, cfg)
 
     def stage_fn(stage_layers, xm):
+        # MoE router stats are dropped on the pipeline path: collecting
+        # scalars through the gpipe loop would thread them through every
+        # stage buffer.  Balance-sensitive MoE training should monitor aux
+        # on the non-pp path (llama_loss adds the aux terms there).
         out, _ = jax.lax.scan(lambda c, lp: layer_fn(c, lp), xm, stage_layers)
         return out
 
@@ -421,6 +451,76 @@ def llama_forward_pp(
     return logits.astype(jnp.float32)
 
 
+def llama_loss_and_grads_pp(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    *,
+    n_microbatches: int = 2,
+    rules: ShardingRules = DEFAULT_RULES,
+):
+    """Loss + full-parameter grads with the 1F1B pipeline schedule
+    (parallel/pipeline.py:pipeline_1f1b): stage activations live in a ring
+    buffer of depth 2S-1, so peak activation memory no longer grows with
+    the microbatch count the way differentiating llama_forward_pp (GPipe)
+    does.  Numerically matches ``jax.grad(llama_loss)`` for dense configs
+    (MoE router aux terms are not collected on the pipeline path — see
+    llama_forward_pp).
+
+    Returns ``(loss, grads)`` with ``grads`` matching the ``params`` tree.
+    """
+    from ..parallel.mesh import AXIS_PIPELINE
+    from ..parallel.pipeline import pipeline_1f1b, split_stages
+
+    dtype = jnp.dtype(cfg.dtype)
+    B, T = tokens.shape
+    if B % n_microbatches:
+        raise ValueError(f"batch {B} not divisible by {n_microbatches} microbatches")
+    x = params["embed"][tokens].astype(dtype)
+    angles = rope_freqs(cfg, jnp.arange(T))
+    layer = _decoder_layer_fn(cfg, angles, None, rules)
+    layer_fn = _maybe_remat(layer, cfg)
+
+    def stage_fn(stage_layers, xm):
+        out, _ = jax.lax.scan(
+            lambda c, lp: (layer_fn(c, lp)[0], None), xm, stage_layers)
+        return out
+
+    def loss_fn(lp, y, targets_m):
+        h = rmsnorm(y, lp["final_norm"], cfg.norm_eps)
+        logits = jnp.einsum(
+            "btd,dv->btv", h, lp["lm_head"].astype(dtype)).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        nll = -jnp.take_along_axis(logp, targets_m[:, 1:, None], axis=-1)
+        return jnp.mean(nll)
+
+    S = mesh.shape[AXIS_PIPELINE]
+    stages = split_stages(params["layers"], S)
+    micro = x.reshape(n_microbatches, B // n_microbatches, T, -1)
+    targets = tokens.reshape(n_microbatches, B // n_microbatches, T)
+    loss_params = {"final_norm": params["final_norm"],
+                   "lm_head": params["lm_head"]}
+
+    loss, gstage, gloss, gmicro = pipeline_1f1b(
+        stage_fn, stages, micro, loss_fn, loss_params, targets, mesh)
+
+    glayers = jax.tree.map(
+        lambda a: a.reshape(a.shape[0] * a.shape[1], *a.shape[2:]), gstage)
+    # Embedding backward: scatter-add the input cotangents at the token ids
+    # (the VJP of the gather `params["embed"][tokens]`).
+    gx = gmicro.reshape(B * T, -1)
+    gembed = jnp.zeros_like(params["embed"]).at[tokens.reshape(-1)].add(
+        gx.astype(params["embed"].dtype))
+    grads = {
+        "embed": gembed,
+        "layers": glayers,
+        "final_norm": gloss["final_norm"].astype(params["final_norm"].dtype),
+        "lm_head": gloss["lm_head"].astype(params["lm_head"].dtype),
+    }
+    return loss, grads
+
+
 def llama_loss(
     params: Params,
     tokens: jax.Array,
@@ -428,9 +528,20 @@ def llama_loss(
     mesh: Optional[Mesh] = None,
     rules: ShardingRules = DEFAULT_RULES,
 ) -> jax.Array:
-    """Next-token cross-entropy, mean over all positions."""
-    logits = llama_forward(params, tokens, cfg, mesh, rules)
+    """Next-token cross-entropy, mean over all positions.  For MoE configs
+    the router auxiliary losses are added (load balancing + z-loss, weighted
+    by cfg.moe_aux_coef / cfg.moe_z_coef) — without the balancing term the
+    router collapses onto a few experts in real training."""
+    if cfg.n_experts:
+        logits, aux = llama_forward(params, tokens, cfg, mesh, rules,
+                                    return_aux=True)
+    else:
+        logits = llama_forward(params, tokens, cfg, mesh, rules)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return jnp.mean(nll)
+    ce = jnp.mean(nll)
+    if cfg.n_experts:
+        return (ce + cfg.moe_aux_coef * aux["aux_loss"]
+                + cfg.moe_z_coef * aux["z_loss"])
+    return ce

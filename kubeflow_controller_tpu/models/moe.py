@@ -42,15 +42,47 @@ def moe_ffn(
     capacity: int = 0,
     rules: ShardingRules = DEFAULT_RULES,
 ) -> jax.Array:
+    """Like :func:`moe_ffn_stats` but returns only the output."""
+    y, _ = moe_ffn_stats(
+        x, router_w, w_gate, w_up, w_down, top_k=top_k,
+        capacity_factor=capacity_factor, capacity=capacity, rules=rules)
+    return y
+
+
+def moe_ffn_stats(
+    x: jax.Array,
+    router_w: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    capacity: int = 0,
+    rules: ShardingRules = DEFAULT_RULES,
+):
     """x [B, T, D]; router_w [D, E]; w_gate/w_up [E, D, F]; w_down [E, F, D].
 
-    Returns [B, T, D].  Capacity per expert C = ceil(T * top_k / E *
-    capacity_factor) unless ``capacity`` pins it explicitly; tokens routed
-    past an expert's capacity are dropped (contribute zero), as in
-    Switch/GShard.  Note the T-dependence: a T=1 decode step never drops
-    (top-k experts are distinct) while a long prefill might, so cached and
-    dense paths agree exactly only when nothing overflows — pin
+    Returns ``(y [B, T, D], stats)``.  Capacity per expert C = ceil(T *
+    top_k / E * capacity_factor) unless ``capacity`` pins it explicitly;
+    tokens routed past an expert's capacity are dropped (contribute zero),
+    as in Switch/GShard.  Note the T-dependence: a T=1 decode step never
+    drops (top-k experts are distinct) while a long prefill might, so cached
+    and dense paths agree exactly only when nothing overflows — pin
     ``capacity`` to make paths bit-identical under overflow.
+
+    ``stats`` (all f32 scalars, differentiable where it matters):
+
+    - ``aux_loss`` — Switch/GShard load-balancing loss ``E * sum_e f_e *
+      P_e`` with ``f_e`` the fraction of routing slots assigned to expert e
+      (hard counts) and ``P_e`` the mean full-softmax router probability
+      (the differentiable half).  ==1 at perfect balance, ->E on collapse.
+      Without it real MoE training collapses onto a few experts.
+    - ``z_loss`` — ST-MoE router z-loss ``mean(logsumexp(logits)^2)``,
+      keeps router logits from drifting to magnitudes where softmax
+      saturates (and bf16 overflows).
+    - ``overflow_frac`` — fraction of routing slots dropped by the capacity
+      limit (not differentiable; a monitoring signal for capacity_factor).
     """
     import math
 
@@ -83,7 +115,22 @@ def moe_ffn(
     h = jax.nn.silu(gate) * up
     ye = jnp.einsum("becf,efd->becd", h, w_down.astype(dtype))
     ye = with_logical_constraint(ye, ("batch", "expert", None, None), rules)
-    return jnp.einsum("btec,becd->btd", combine.astype(dtype), ye)
+    y = jnp.einsum("btec,becd->btd", combine.astype(dtype), ye)
+
+    # Router statistics.  f_e: hard assignment fraction over all (token,
+    # slot) pairs (stop-gradient by construction — one_hot of argmax);
+    # P_e: mean softmax probability, the term the gradient flows through.
+    full_probs = jax.nn.softmax(logits, axis=-1)      # [B,T,E] f32
+    f = jnp.mean(assign, axis=(0, 1, 2))              # [E]
+    p = jnp.mean(full_probs, axis=(0, 1))             # [E]
+    aux_loss = E * jnp.sum(f * p)
+    z_loss = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+    n_assigned = jnp.sum(assign)
+    overflow_frac = jax.lax.stop_gradient(
+        1.0 - jnp.sum(keep) / jnp.maximum(n_assigned, 1.0))
+    stats = {"aux_loss": aux_loss, "z_loss": z_loss,
+             "overflow_frac": overflow_frac}
+    return y, stats
 
 
 def moe_ffn_reference(x, router_w, w_gate, w_up, w_down, *, top_k: int = 2):

@@ -103,3 +103,165 @@ def gpipe(
 
     _, out = jax.lax.fori_loop(0, n_micro + S - 1, step, (act0, out0))
     return out
+
+
+def pipeline_1f1b(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    loss_fn: Callable[[Any, jax.Array, jax.Array], jax.Array],
+    loss_params: Any,
+    loss_aux: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = AXIS_PIPELINE,
+):
+    """1F1B schedule: fused forward+backward pipeline with gradient
+    accumulation across microbatches.
+
+    Each loop step runs ONE stage-forward and ONE stage-backward per stage
+    (vmapped over the stage axis, activations/cotangents handed between
+    stages by ``jnp.roll`` — a collective permute over pp).  Stage backward
+    is a per-stage ``jax.vjp`` re-run at backward time, so only stage INPUT
+    activations are saved — in a ring buffer of depth 2S-1, giving peak
+    activation memory O(S^2 * microbatch), independent of the number of
+    microbatches M.  Differentiating :func:`gpipe` instead saves every
+    loop-step carry: O((M+S) * S * microbatch) — the GPipe memory wall that
+    1F1B exists to remove; raising M to shrink the bubble (fraction
+    (S-1)/(M+S-1)) no longer raises peak memory.
+
+    Schedule (time t, stage s): forward of microbatch ``m = t - s``;
+    backward of ``m = t - (2S-2-s)``; the last stage backwards a microbatch
+    in the same step that forwards it.  Total 2(M + 2S - 2) stage-passes of
+    work per device vs GPipe's 2(M + S - 1) with XLA-scheduled backward —
+    the extra 2(S-1) is the drain of the explicit backward pipeline.
+
+    Args:
+      stage_fn: ``(params_for_stage, x) -> y`` with ``y.shape == x.shape``.
+      stage_params: pytree with leading stage axis S (see split_stages).
+      microbatches: [M, ...] inputs to stage 0.
+      loss_fn: ``(loss_params, y_m, aux_m) -> scalar`` applied to the last
+        stage's output of each microbatch (e.g. final-norm + lm_head + CE).
+      loss_params: params of loss_fn (grads are accumulated for them too).
+      loss_aux: [M, ...] per-microbatch extras for loss_fn (e.g. targets).
+
+    Returns ``(mean_loss, stage_grads, loss_param_grads, input_grads)``
+    where ``input_grads`` is [M, ...] d(loss)/d(microbatches) — feed it to
+    the embedding lookup's backward.  All grads are summed over microbatches
+    and scaled by 1/M, matching ``jax.grad`` of the mean-over-microbatches
+    loss.
+    """
+    S = jax.tree.leaves(stage_params)[0].shape[0]
+    if mesh is not None and axis_name in mesh.shape:
+        assert mesh.shape[axis_name] in (1, S), (
+            f"stage axis {S} vs pp mesh size {mesh.shape[axis_name]}"
+        )
+    M = microbatches.shape[0]
+
+    def one_loss(lp, y, aux):
+        return loss_fn(lp, y, aux)
+
+    if S == 1:
+        # Degenerate path: plain gradient accumulation over microbatches.
+        params = jax.tree.map(lambda a: a[0], stage_params)
+
+        def mb_loss(p, lp, x, aux):
+            return one_loss(lp, stage_fn(p, x), aux)
+
+        def acc(carry, xa):
+            x, aux = xa
+            (l, (gp, glp, gx)) = jax.value_and_grad(
+                mb_loss, argnums=(0, 1, 2))(params, loss_params, x, aux)
+            loss, gps, glps = carry
+            return (loss + l,
+                    jax.tree.map(jnp.add, gps, gp),
+                    jax.tree.map(jnp.add, glps, glp)), gx
+
+        zerog = jax.tree.map(jnp.zeros_like, params)
+        zerolg = jax.tree.map(jnp.zeros_like, loss_params)
+        (loss, gp, glp), gx = jax.lax.scan(
+            acc, (jnp.float32(0), zerog, zerolg), (microbatches, loss_aux))
+        scale = 1.0 / M
+        return (loss * scale,
+                jax.tree.map(lambda a: (a * scale)[None], gp),
+                jax.tree.map(lambda a: a * scale, glp),
+                gx * scale)
+
+    stage_params = jax.tree.map(lambda a: _constrain_pp(a, axis_name), stage_params)
+    vstage = jax.vmap(stage_fn)
+
+    def bwd_one(p, x, g):
+        """Re-runs the stage forward and pulls the cotangent back — per-stage
+        rematerialization, the reason only stage inputs need saving."""
+        _, vjp = jax.vjp(stage_fn, p, x)
+        return vjp(g)
+
+    vbwd = jax.vmap(bwd_one)
+
+    zero = jnp.zeros_like(microbatches[0])
+    R = 2 * S - 1  # ring depth: stage s reads back 2(S-1-s) <= 2S-2 steps
+    act0 = _constrain_pp(jnp.broadcast_to(zero, (S, *zero.shape)), axis_name)
+    ring0 = _constrain_pp(
+        jnp.zeros((S, R, *zero.shape), zero.dtype), axis_name)
+    gcarry0 = act0
+    gstage0 = jax.tree.map(jnp.zeros_like, stage_params)
+    gloss0 = jax.tree.map(
+        lambda a: jnp.zeros_like(a, dtype=jnp.float32), loss_params)
+    gmicro0 = jnp.zeros_like(microbatches)
+    sidx = jnp.arange(S)
+
+    def step(t, carry):
+        act, ring, gcarry, loss, gstage, gloss, gmicro = carry
+        # ---- forward half (identical flow to gpipe) ----
+        feed = jnp.take(microbatches, jnp.minimum(t, M - 1), axis=0)
+        act = act.at[0].set(jnp.where(t < M, feed, act[0]))
+        ring = ring.at[:, t % R].set(act)
+        y = vstage(stage_params, act)
+        y = _constrain_pp(y, axis_name)
+
+        # ---- loss + seed at the last stage (microbatch m_last = t-(S-1)) --
+        m_last = t - (S - 1)
+        valid_last = jnp.logical_and(m_last >= 0, m_last < M)
+        aux_m = jnp.take(loss_aux, jnp.clip(m_last, 0, M - 1), axis=0)
+        (l, (glp, seed)) = jax.value_and_grad(
+            lambda lp, ym: one_loss(lp, ym, aux_m), argnums=(0, 1),
+        )(loss_params, y[-1])
+        loss = loss + jnp.where(valid_last, l, 0.0)
+        gloss = jax.tree.map(
+            lambda a, g: a + jnp.where(valid_last, g, 0.0), gloss, glp)
+
+        # ---- backward half: stage s handles m_b = t - (2S-2-s) ----
+        m_b = t - (2 * S - 2 - sidx)                        # [S]
+        valid_b = jnp.logical_and(m_b >= 0, m_b < M)        # [S]
+        gin = gcarry.at[-1].set(seed.astype(gcarry.dtype))
+        # Saved input for each stage's backward microbatch.
+        read_at = (t - 2 * (S - 1 - sidx)) % R              # [S]
+        x_saved = jax.vmap(lambda r, i: jnp.take(r, i, axis=0))(ring, read_at)
+        gp, gx = vbwd(stage_params, x_saved, gin)
+
+        def mask(g):
+            shape = (S,) + (1,) * (g.ndim - 1)
+            return jnp.where(valid_b.reshape(shape), g, 0)
+
+        gstage = jax.tree.map(lambda a, g: a + mask(g), gstage, gp)
+        gx = mask(gx)
+        # d/d(microbatch input): stage 0's input cotangent.
+        gmicro = jax.lax.dynamic_update_index_in_dim(
+            gmicro,
+            jnp.where(valid_b[0], gx[0],
+                      jnp.take(gmicro, jnp.clip(m_b[0], 0, M - 1), axis=0)),
+            jnp.clip(m_b[0], 0, M - 1), axis=0)
+
+        # Hand off: activations up (roll +1), cotangents down (roll -1).
+        return (jnp.roll(y, 1, axis=0), ring, jnp.roll(gx, -1, axis=0),
+                loss, gstage, gloss, gmicro)
+
+    n_steps = M + 2 * S - 2
+    _, _, _, loss, gstage, gloss, gmicro = jax.lax.fori_loop(
+        0, n_steps, step,
+        (act0, ring0, gcarry0, jnp.float32(0), gstage0, gloss0, gmicro0))
+    scale = 1.0 / M
+    return (loss * scale,
+            jax.tree.map(lambda a: a * scale, gstage),
+            jax.tree.map(lambda a: a * scale, gloss),
+            gmicro * scale)

@@ -59,6 +59,98 @@ class TestMoEFFN:
         assert all(float(jnp.linalg.norm(gi)) > 0 for gi in g)
 
 
+class TestRouterAuxLosses:
+    def test_balanced_vs_collapsed_aux(self):
+        """aux_loss is ~1 for a balanced router and approaches E when the
+        router collapses onto one expert."""
+        from kubeflow_controller_tpu.models.moe import moe_ffn_stats
+
+        _, wg, wu, wd = _weights(jax.random.PRNGKey(0))
+        # Positive activations so a router column with large positive weights
+        # wins for EVERY token (logits = x @ W would flip sign with zero-mean x).
+        x = jax.random.uniform(jax.random.PRNGKey(1), (4, 32, 16),
+                               minval=0.5, maxval=1.5)
+        # Near-zero router weights -> near-uniform softmax, balanced top-k.
+        balanced_router = jax.random.normal(jax.random.PRNGKey(2), (16, 4)) * 1e-3
+        _, s_bal = moe_ffn_stats(x, balanced_router, wg, wu, wd, top_k=2,
+                                 capacity_factor=100.0)
+        # A router biased hard toward expert 0 for every token.
+        collapsed_router = jnp.zeros((16, 4)).at[:, 0].set(10.0)
+        _, s_col = moe_ffn_stats(x, collapsed_router, wg, wu, wd, top_k=2,
+                                 capacity_factor=100.0)
+        assert 0.9 < float(s_bal["aux_loss"]) < 1.3
+        assert float(s_col["aux_loss"]) > 1.8  # E=4, top-2 collapse -> ~2
+        assert float(s_col["aux_loss"]) > float(s_bal["aux_loss"])
+
+    def test_overflow_fraction(self):
+        from kubeflow_controller_tpu.models.moe import moe_ffn_stats
+
+        router, wg, wu, wd = _weights(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 16, 16))
+        _, ample = moe_ffn_stats(x, router, wg, wu, wd, top_k=1,
+                                 capacity_factor=100.0)
+        _, tight = moe_ffn_stats(x, router, wg, wu, wd, top_k=1,
+                                 capacity_factor=0.5)
+        assert float(ample["overflow_frac"]) == 0.0
+        assert 0.0 < float(tight["overflow_frac"]) < 1.0
+        assert float(ample["z_loss"]) >= 0.0
+
+    def test_aux_loss_balances_training(self):
+        """Descending the aux loss from a collapsed router spreads hard
+        assignments back across experts — the property that prevents expert
+        collapse in real MoE training."""
+        import optax
+
+        from kubeflow_controller_tpu.models.moe import moe_ffn_stats
+
+        _, wg, wu, wd = _weights(jax.random.PRNGKey(0))
+        x = jax.random.uniform(jax.random.PRNGKey(1), (4, 32, 16),
+                               minval=0.5, maxval=1.5)  # see balanced test
+        router = jax.random.normal(jax.random.PRNGKey(2), (16, 4)) * 0.01
+        # Mild collapse onto expert 0: every token still picks it first, but
+        # the softmax is not saturated (a +2.0 bias puts router gradients at
+        # ~1e-14 where adam's epsilon nulls the update).
+        router = router.at[:, 0].add(0.3)
+
+        def aux(r):
+            _, s = moe_ffn_stats(x, r, wg, wu, wd, top_k=2,
+                                 capacity_factor=100.0)
+            return s["aux_loss"]
+
+        opt = optax.adam(5e-2)
+        state = opt.init(router)
+        first = float(aux(router))
+
+        @jax.jit
+        def step(r, s):
+            g = jax.grad(aux)(r)
+            u, s = opt.update(g, s, r)
+            return optax.apply_updates(r, u), s
+
+        for _ in range(40):
+            router, state = step(router, state)
+        last = float(aux(router))
+        assert first > 1.8  # started collapsed
+        assert last < 1.3, f"aux did not rebalance: {first} -> {last}"
+
+    def test_llama_loss_includes_aux_terms(self):
+        cfg = LlamaConfig.tiny(n_experts=4, moe_top_k=2)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                    cfg.vocab_size)
+        loss_with = llama_loss(params, tokens, cfg)
+        import dataclasses
+
+        cfg_no_aux = dataclasses.replace(cfg, moe_aux_coef=0.0, moe_z_coef=0.0)
+        loss_without = llama_loss(params, tokens, cfg_no_aux)
+        # Aux terms are positive, so the full loss must be strictly larger.
+        assert float(loss_with) > float(loss_without)
+        # And forward exposes the averaged stats.
+        _, aux = llama_forward(params, tokens, cfg, return_aux=True)
+        assert set(aux) == {"aux_loss", "z_loss", "overflow_frac"}
+        assert float(aux["aux_loss"]) > 0
+
+
 class TestMoELlama:
     def cfg(self):
         return LlamaConfig.tiny(n_experts=4, moe_top_k=2)
