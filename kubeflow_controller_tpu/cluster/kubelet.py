@@ -90,6 +90,10 @@ class FakeKubelet:
         self._svc_ports: Dict[str, int] = {}
         self._svc_lock = threading.Lock()
         self._warm: Dict[str, object] = {}
+        # Pod keys whose failure was injected (fail_slice): the drive loop
+        # must not restart them in place — the slice is gone; replacement
+        # is the controller's job.
+        self._injected_failures: Set[str] = set()
         self._stop = threading.Event()
         self._main: Optional[threading.Thread] = None
 
@@ -187,6 +191,7 @@ class FakeKubelet:
             # rather than leak one entry per pod ever run.
             self._procs.pop(key, None)
             self._threads.pop(key, None)
+            self._injected_failures.discard(key)
 
     # -- phase driving -------------------------------------------------------
 
@@ -229,6 +234,32 @@ class FakeKubelet:
         else:
             self._simulate(pod)
 
+    def fail_slice(self, slice_name: str, reason: str = "SliceFailed") -> list:
+        """Inject a whole-slice failure — the TPU failure domain (SURVEY §5):
+        every pod of the gang bound to the slice has its process killed and
+        is marked Failed.  In-place restart is suppressed (the hardware is
+        gone); index-preserving gang replacement is the controller's job.
+        Returns the failed pod names."""
+        if self.inventory is None:
+            return []
+        names = set(self.inventory.fail_slice(slice_name))
+        failed = []
+        for pod in self.cluster.pods.list():
+            if pod.metadata.name not in names:
+                continue
+            key = self._key(pod)
+            self._injected_failures.add(key)
+            proc = self._procs.get(key)
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+            warm = self._warm.get(key)
+            if warm is not None and self._pool is not None:
+                self._pool.kill(warm)
+            self.set_phase(pod.metadata.namespace, pod.metadata.name,
+                           PHASE_FAILED, reason=reason)
+            failed.append(pod.metadata.name)
+        return failed
+
     def _gone(self, ns: str, name: str) -> bool:
         try:
             p = self.cluster.pods.get(ns, name)
@@ -242,6 +273,9 @@ class FakeKubelet:
         if outcome is None:
             return  # runs forever (PS)
         time.sleep(self.policy.run_s)
+        if self._key(pod) in self._injected_failures:
+            self._injected_failures.discard(self._key(pod))
+            return  # fail_slice already marked the pod Failed
         if not self._gone(ns, name):
             self.set_phase(ns, name, outcome)
 
@@ -293,6 +327,9 @@ class FakeKubelet:
                 return
         restarts = 0
         while not self._stop.is_set():
+            if self._key(pod) in self._injected_failures:
+                self._injected_failures.discard(self._key(pod))
+                return  # slice failed before/between spawns; stay Failed
             try:
                 proc = subprocess.Popen(
                     cmd,
@@ -308,6 +345,9 @@ class FakeKubelet:
             _, stderr = proc.communicate()
             if self._stop.is_set() or self._gone(ns, name):
                 return
+            if self._key(pod) in self._injected_failures:
+                self._injected_failures.discard(self._key(pod))
+                return  # phase already Failed by fail_slice; no restart
             if proc.returncode == 0:
                 self.set_phase(ns, name, PHASE_SUCCEEDED)
                 return
@@ -327,6 +367,9 @@ class FakeKubelet:
         restarts = 0
         try:
             while not self._stop.is_set():
+                if key in self._injected_failures:
+                    self._injected_failures.discard(key)
+                    return  # slice failed before/between spawns; stay Failed
                 try:
                     proc = pool.spawn(argv, env, c.working_dir, key)
                 except OSError as e:
@@ -337,6 +380,9 @@ class FakeKubelet:
                 if code is None or self._stop.is_set() or self._gone(ns, name):
                     pool.kill(proc)
                     return
+                if key in self._injected_failures:
+                    self._injected_failures.discard(key)
+                    return  # phase already Failed by fail_slice; no restart
                 if code == 0:
                     self.set_phase(ns, name, PHASE_SUCCEEDED)
                     return

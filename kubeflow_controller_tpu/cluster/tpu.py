@@ -25,6 +25,9 @@ class TPUSlice:
     chips_per_host: int = 4
     # gang currently bound to this slice ("" = free).
     bound_gang: str = ""
+    # False once the slice has failed: never admits another gang (the fake
+    # analog of a cordoned node pool).
+    healthy: bool = True
 
 
 @dataclass
@@ -86,7 +89,7 @@ class TPUInventory:
 
     def _find_free_slice(self, accelerator_type: str) -> Optional[TPUSlice]:
         for s in self.slices.values():
-            if s.bound_gang:
+            if s.bound_gang or not s.healthy:
                 continue
             if accelerator_type and s.accelerator_type != accelerator_type:
                 continue
@@ -130,11 +133,18 @@ class TPUInventory:
         return confirmed
 
     def fail_slice(self, slice_name: str) -> List[str]:
-        """Simulate a whole-slice failure (the TPU failure domain).  Returns
-        the names of pods in the bound gang; the kubelet fails them all."""
+        """Simulate a whole-slice failure (the TPU failure domain).  The
+        slice is quarantined (healthy=False: it never admits another gang)
+        and the bound gang is evicted, so the controller's replacement gang
+        must be re-placed onto DIFFERENT hardware.  Returns the names of
+        pods in the evicted gang; the kubelet fails them all."""
         with self._lock:
             sl = self.slices.get(slice_name)
-            if sl is None or not sl.bound_gang:
+            if sl is None:
                 return []
-            g = self._gangs.get(sl.bound_gang)
+            sl.healthy = False
+            if not sl.bound_gang:
+                return []
+            g = self._gangs.pop(sl.bound_gang, None)
+            sl.bound_gang = ""
             return list(g.pods.keys()) if g else []

@@ -5,6 +5,15 @@ Training uses dense causal attention (llama.py); inference keeps a static
 written prefix under an absolute-position mask — static shapes throughout,
 so the whole generate loop jits as one ``lax.scan`` (no per-token Python
 dispatch, no recompilation per length).
+
+Sharded decode: every activation and the KV cache carry logical sharding
+constraints (batch over dp/fsdp, heads over tp — the megatron inference
+layout); run the jitted decode under ``jax.set_mesh`` with params placed by
+llama_param_pspecs and XLA keeps the cache resident per-shard, inserting
+one all-reduce per layer (wo) + one for the lm_head, exactly as in
+training.  The seq axis of the cache is deliberately NOT sharded: decode
+appends at a dynamic position, which would force a resharding gather under
+sp.  Outside a mesh the constraints are no-ops (single-device decode).
 """
 
 from __future__ import annotations
@@ -14,16 +23,32 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..parallel.sharding import DEFAULT_RULES, ShardingRules, with_logical_constraint
 from .llama import LlamaConfig, apply_rope, ffn_block, rmsnorm, rope_freqs
 
 Cache = Dict[str, jax.Array]
 NEG_INF = -1e30
 
+# Logical layout of the KV cache; the seq dim stays unsharded (decode
+# appends at a dynamic position — sharding it over sp would gather).
+CACHE_AXES = ("layers", "batch", None, "kv_heads", "head_dim")
 
-def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Cache:
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
+               rules: ShardingRules = DEFAULT_RULES) -> Cache:
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
     dtype = jnp.dtype(cfg.dtype)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    return {"k": with_logical_constraint(jnp.zeros(shape, dtype), CACHE_AXES, rules),
+            "v": with_logical_constraint(jnp.zeros(shape, dtype), CACHE_AXES, rules)}
+
+
+def cache_pspecs(rules: ShardingRules = DEFAULT_RULES):
+    """PartitionSpecs for the KV cache (device_put target for a sharded
+    decode loop's carry)."""
+    from ..parallel.sharding import logical_to_pspec
+
+    spec = logical_to_pspec(CACHE_AXES, rules)
+    return {"k": spec, "v": spec}
 
 
 def forward_with_cache(
@@ -32,6 +57,7 @@ def forward_with_cache(
     cache: Cache,
     start_pos,
     cfg: LlamaConfig,
+    rules: ShardingRules = DEFAULT_RULES,
 ) -> Tuple[jax.Array, Cache]:
     """tokens [B, T] appended at absolute position ``start_pos`` (traced ok).
     Returns (logits [B, T, vocab] f32, updated cache)."""
@@ -39,6 +65,7 @@ def forward_with_cache(
     B, T = tokens.shape
     S = cache["k"].shape[2]
     x = params["embed"][tokens].astype(dtype)
+    x = with_logical_constraint(x, ("batch", None, None), rules)
     positions = start_pos + jnp.arange(T)
     angles = rope_freqs(cfg, positions)  # K is written pre-rotated
     repeats = cfg.n_heads // cfg.n_kv_heads
@@ -47,29 +74,40 @@ def forward_with_cache(
     kv_pos = jnp.arange(S)[None, :]                 # [1, S]
     mask = (kv_pos <= q_pos)[None, None, :, :]      # [1,1,T,S]
 
+    kv_axes = CACHE_AXES[1:]  # per-layer view: no leading layers dim
+
     def layer(x, scanned):
         lp, kc, vc = scanned                        # kc/vc: [B, S, kvH, D]
         h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
         q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dtype))
         k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dtype))
         v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dtype))
+        q = with_logical_constraint(q, ("batch", None, "heads", "head_dim"), rules)
+        k = with_logical_constraint(k, kv_axes, rules)
+        v = with_logical_constraint(v, kv_axes, rules)
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
         kc = jax.lax.dynamic_update_slice_in_dim(kc, k.astype(kc.dtype), start_pos, axis=1)
         vc = jax.lax.dynamic_update_slice_in_dim(vc, v.astype(vc.dtype), start_pos, axis=1)
+        kc = with_logical_constraint(kc, kv_axes, rules)
+        vc = with_logical_constraint(vc, kv_axes, rules)
         kk, vv = kc, vc
         if repeats > 1:
             kk = jnp.repeat(kk, repeats, axis=2)
             vv = jnp.repeat(vv, repeats, axis=2)
         s = jnp.einsum("bthd,bshd->bhts", q, kk,
                        preferred_element_type=jnp.float32) * cfg.head_dim ** -0.5
+        s = with_logical_constraint(s, ("batch", "heads", None, None), rules)
         s = jnp.where(mask, s, NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
         attn = jnp.einsum("bhts,bshd->bthd", p, vv.astype(jnp.float32)).astype(dtype)
+        attn = with_logical_constraint(attn, ("batch", None, "heads", "head_dim"), rules)
         x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))
+        x = with_logical_constraint(x, ("batch", None, None), rules)
 
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-        x = x + ffn_block(h, lp, cfg)
+        x = x + ffn_block(h, lp, cfg, rules)
+        x = with_logical_constraint(x, ("batch", None, None), rules)
         return x, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(
@@ -77,6 +115,7 @@ def forward_with_cache(
     )
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(dtype))
+    logits = with_logical_constraint(logits, ("batch", None, "vocab"), rules)
     return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
 
 
@@ -99,24 +138,28 @@ def generate(
     temperature: float = 0.0,
     top_k: Optional[int] = None,
     key: Optional[jax.Array] = None,
+    rules: ShardingRules = DEFAULT_RULES,
 ) -> jax.Array:
     """prompt [B, T_p] -> [B, T_p + max_new_tokens].  Greedy when
-    temperature == 0.  The decode loop is one jitted scan."""
+    temperature == 0.  The decode loop is one jitted scan.  Under an active
+    mesh (jax.set_mesh) with params sharded by llama_param_pspecs this runs
+    tp/dp-sharded decode; see the module docstring."""
     if max_new_tokens <= 0:
         return prompt
     if key is None:
         key = jax.random.PRNGKey(0)
     B, T_p = prompt.shape
     max_len = T_p + max_new_tokens
-    cache = init_cache(cfg, B, max_len)
+    cache = init_cache(cfg, B, max_len, rules)
 
-    logits, cache = forward_with_cache(params, prompt, cache, 0, cfg)
+    logits, cache = forward_with_cache(params, prompt, cache, 0, cfg, rules)
     k0, key = jax.random.split(key)
     first = _sample(logits[:, -1], k0, temperature, top_k)
 
     def step(carry, key_t):
         cache, tok, pos = carry
-        logits, cache = forward_with_cache(params, tok[:, None], cache, pos, cfg)
+        logits, cache = forward_with_cache(params, tok[:, None], cache, pos,
+                                           cfg, rules)
         nxt = _sample(logits[:, -1], key_t, temperature, top_k)
         return (cache, nxt, pos + 1), nxt
 

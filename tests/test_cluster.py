@@ -276,7 +276,15 @@ def test_kubelet_gates_tpu_pods_on_gang_admission():
 
 
 def test_slice_failure_domain():
-    inv = TPUInventory([TPUSlice("slice-0", "v5e-8", num_hosts=2)])
+    inv = TPUInventory([TPUSlice("slice-0", "v5e-8", num_hosts=2),
+                        TPUSlice("slice-1", "v5e-8", num_hosts=2)])
     inv.offer(tpu_pod("h0", "g1", 2))
     inv.offer(tpu_pod("h1", "g1", 2))
     assert sorted(inv.fail_slice("slice-0")) == ["h0", "h1"]
+    # The failed slice is quarantined and its gang evicted: a replacement
+    # gang must land on different hardware.
+    assert inv.slices["slice-0"].healthy is False
+    assert inv.slices["slice-0"].bound_gang == ""
+    inv.offer(tpu_pod("r0", "g2", 2))
+    assert inv.offer(tpu_pod("r1", "g2", 2))
+    assert inv.gang_slice("g2") == "slice-1"

@@ -67,3 +67,59 @@ class TestKVCache:
                      top_k=20, key=jax.random.PRNGKey(7))
         assert a.shape == (2, 7)
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestShardedDecode:
+    """tp/dp-sharded decode on the 8-device mesh vs the unsharded paths
+    (VERDICT round-1 item 5: sharded inference is table stakes)."""
+
+    def _sharded(self, cfg, params, mesh):
+        from jax.sharding import NamedSharding
+
+        from kubeflow_controller_tpu.models.llama import llama_param_pspecs
+
+        pspecs = llama_param_pspecs(cfg)
+        return jax.tree.map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            params, pspecs)
+
+    def test_sharded_prefill_matches_dense(self):
+        from kubeflow_controller_tpu.parallel import MeshSpec, build_mesh
+
+        cfg, params = setup()
+        tokens = jax.random.randint(jax.random.PRNGKey(5), (4, 16), 0,
+                                    cfg.vocab_size)
+        dense = llama_forward(params, tokens, cfg)
+        mesh = build_mesh(MeshSpec(dp=2, tp=2, fsdp=2))
+        sharded = self._sharded(cfg, params, mesh)
+        with jax.set_mesh(mesh):
+            def prefill(p, t):
+                cache = init_cache(cfg, 4, 16)
+                return forward_with_cache(p, t, cache, 0, cfg)[0]
+
+            out = jax.jit(prefill)(sharded, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_sharded_greedy_generate_matches_unsharded(self):
+        from kubeflow_controller_tpu.parallel import MeshSpec, build_mesh
+
+        cfg, params = setup()
+        prompt = jax.random.randint(jax.random.PRNGKey(6), (4, 8), 0,
+                                    cfg.vocab_size)
+        ref = generate(params, prompt, cfg, max_new_tokens=6)
+        mesh = build_mesh(MeshSpec(dp=2, tp=2, fsdp=2))
+        sharded = self._sharded(cfg, params, mesh)
+        with jax.set_mesh(mesh):
+            out = jax.jit(
+                lambda p, t: generate(p, t, cfg, max_new_tokens=6)
+            )(sharded, prompt)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_cache_pspecs_cover_cache_tree(self):
+        from kubeflow_controller_tpu.models.generate import cache_pspecs
+
+        cfg, _ = setup()
+        cache = init_cache(cfg, 2, 8)
+        specs = cache_pspecs()
+        assert set(specs) == set(cache)
