@@ -1,0 +1,30 @@
+# Build entrypoints — parity with the reference's Makefile (ref: Makefile:
+# 36-42: `make test` runs go test over non-vendor packages; CI chains
+# lint+test at .travis.yml:1-14).
+
+PY ?= python
+
+.PHONY: all test lint bench dryrun validate
+
+all: lint test
+
+test:
+	$(PY) -m pytest tests/ -q
+
+lint:
+	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
+		$(PY) -m ruff check kubeflow_controller_tpu tests; \
+	else \
+		echo "ruff not installed; falling back to byte-compile check"; \
+		$(PY) -m compileall -q kubeflow_controller_tpu tests bench.py __graft_entry__.py; \
+	fi
+
+validate:
+	$(PY) -m kubeflow_controller_tpu.cli validate -f examples/jobs/
+
+bench:
+	$(PY) bench.py
+
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"

@@ -135,6 +135,36 @@ class Service:
     status: ServiceStatus = field(default_factory=ServiceStatus)
 
 
+@dataclass
+class ObjectReference:
+    """corev1.ObjectReference subset: what an Event points at."""
+
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+
+
+@dataclass
+class EventObject:
+    """corev1.Event subset — the audit stream as API objects, visible the
+    way ``kubectl describe`` shows them (ref: the broadcaster wiring at
+    pkg/controller/controller.go:107-110; reasons at control/types.go:20-29).
+    Named EventObject to distinguish it from the in-memory recorder Event."""
+
+    api_version: str = "v1"
+    kind: str = "Event"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object: ObjectReference = field(default_factory=ObjectReference)
+    reason: str = ""
+    message: str = ""
+    type: str = "Normal"
+    count: int = 1
+    first_timestamp: float = 0.0
+    last_timestamp: float = 0.0
+    source_component: str = ""
+
+
 def is_pod_active(pod: Pod) -> bool:
     """active = not Succeeded, not Failed, not being deleted
     (ref: IsPodActive at vendor/.../controller_utils.go:832-840)."""

@@ -24,6 +24,10 @@ LABEL_INDEX = "index"
 ANNOTATION_GANG_NAME = f"{DOMAIN}/gang-name"
 ANNOTATION_GANG_SIZE = f"{DOMAIN}/gang-size"
 ANNOTATION_ACCELERATOR = f"{DOMAIN}/accelerator-type"
+# Multislice: how many slices the gang spans and which slice this pod
+# belongs to (pods are placed per-slice; DCN connects slices).
+ANNOTATION_NUM_SLICES = f"{DOMAIN}/num-slices"
+ANNOTATION_SLICE_INDEX = f"{DOMAIN}/slice-index"
 
 
 def selector_for(job_name: str, replica_type: str, runtime_id: str) -> dict:

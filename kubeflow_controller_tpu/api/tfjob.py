@@ -108,6 +108,11 @@ class TPUSpec:
     # Coordinator port for jax.distributed (the analog of the reference's
     # hardcoded TF grpc port 2222, pkg/tensorflow/distributed.go:31-32).
     coordinator_port: int = 8476
+    # Slices the replica spans (multislice/DCN): one jax.distributed cluster
+    # over num_slices * hosts-per-slice processes, ICI within a slice, DCN
+    # across — the standard layout is dp across slices.  The gang scheduler
+    # binds this many slices atomically.
+    num_slices: int = 1
 
 
 # chips per slice for known accelerator types: "<family>-<chips>".
@@ -130,6 +135,11 @@ def tpu_slice_hosts(spec: TPUSpec) -> int:
     return max(1, -(-chips // cph))
 
 
+def tpu_total_hosts(spec: TPUSpec) -> int:
+    """Total worker hosts (= jax.distributed processes) across all slices."""
+    return max(1, spec.num_slices) * tpu_slice_hosts(spec)
+
+
 def tpu_slice_chips(spec: TPUSpec) -> int:
     m = _ACCEL_RE.match(spec.accelerator_type)
     if m:
@@ -143,6 +153,8 @@ def validate_tpu_spec(spec: TPUSpec) -> None:
         raise ValidationError(f"invalid coordinatorPort {spec.coordinator_port}")
     if spec.num_hosts < 0 or spec.chips_per_host <= 0:
         raise ValidationError("numHosts must be >= 0 and chipsPerHost > 0")
+    if spec.num_slices < 1:
+        raise ValidationError("numSlices must be >= 1")
     m = _ACCEL_RE.match(spec.accelerator_type)
     if m:
         chips = int(m.group(3))
@@ -287,11 +299,12 @@ def validate_tfjob(job: TFJob) -> None:
             validate_tpu_spec(s.tpu)
             # The slice topology is the source of truth for the pod count;
             # replicas must agree (or be left at the default 1).
-            hosts = tpu_slice_hosts(s.tpu)
+            hosts = tpu_total_hosts(s.tpu)
             if s.replicas not in (1, hosts):
                 raise ValidationError(
-                    f"TPU replicas({s.replicas}) contradicts slice host count "
-                    f"({hosts}) derived from {s.tpu.accelerator_type}"
+                    f"TPU replicas({s.replicas}) contradicts host count "
+                    f"({hosts}) derived from {s.tpu.num_slices} x "
+                    f"{s.tpu.accelerator_type}"
                 )
             for c in s.template.spec.containers:
                 if "nvidia.com/gpu" in c.resources.limits or "nvidia.com/gpu" in c.resources.requests:

@@ -21,7 +21,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple, Type
 from urllib.parse import parse_qs, urlparse
 
-from ..api.core import Pod, Service
+from ..api.core import EventObject, Pod, Service
 from ..api.tfjob import TFJob
 from ..utils import serde
 from .rest import CORE_API, TFJOB_API, TFJOB_GROUP, TFJOB_VERSION
@@ -39,6 +39,7 @@ _KINDS: Dict[str, Tuple[Type, str, str]] = {
     "tfjobs": (TFJob, f"{TFJOB_GROUP}/{TFJOB_VERSION}", "TFJob"),
     "pods": (Pod, "v1", "Pod"),
     "services": (Service, "v1", "Service"),
+    "events": (EventObject, "v1", "Event"),
 }
 
 

@@ -18,6 +18,7 @@ from .store import ObjectStore, Watcher
 TFJOBS = "tfjobs"
 PODS = "pods"
 SERVICES = "services"
+EVENTS = "events"
 
 
 class _TypedClient:
@@ -72,6 +73,10 @@ class ServiceClient(_TypedClient):
         return self.list(namespace)
 
 
+class EventClient(_TypedClient):
+    kind = EVENTS
+
+
 class Cluster:
     """One handle bundling the store and its typed clients (the analog of
     building both clientsets in cmd/controller/main.go:52-60)."""
@@ -81,3 +86,4 @@ class Cluster:
         self.tfjobs = TFJobClient(self.store)
         self.pods = PodClient(self.store)
         self.services = ServiceClient(self.store)
+        self.events = EventClient(self.store)

@@ -325,24 +325,38 @@ class FakeKubelet:
             if argv is not None:
                 self._execute_warm(pod, argv, env)
                 return
+        import tempfile
+
         restarts = 0
         while not self._stop.is_set():
             if self._key(pod) in self._injected_failures:
                 self._injected_failures.discard(self._key(pod))
                 return  # slice failed before/between spawns; stay Failed
+            # stderr goes to a FILE, not a pipe: a concurrent fork elsewhere
+            # in this thread-heavy process (the warm-pool zygote master
+            # forks without exec) can inherit a pipe's write end in the
+            # window before Popen closes it, and a long-lived holder means
+            # communicate() never sees EOF — the pod would hang Running
+            # forever after its process exited.  Files have no EOF wait.
+            errf = tempfile.TemporaryFile()
             try:
-                proc = subprocess.Popen(
-                    cmd,
-                    env=env,
-                    cwd=c.working_dir or None,
-                    stdout=subprocess.DEVNULL,
-                    stderr=subprocess.PIPE,
-                )
-            except OSError as e:
-                self.set_phase(ns, name, PHASE_FAILED, reason=f"StartError: {e}")
-                return
-            self._procs[self._key(pod)] = proc
-            _, stderr = proc.communicate()
+                try:
+                    proc = subprocess.Popen(
+                        cmd,
+                        env=env,
+                        cwd=c.working_dir or None,
+                        stdout=subprocess.DEVNULL,
+                        stderr=errf,
+                    )
+                except OSError as e:
+                    self.set_phase(ns, name, PHASE_FAILED, reason=f"StartError: {e}")
+                    return
+                self._procs[self._key(pod)] = proc
+                proc.wait()
+                errf.seek(0)
+                stderr = errf.read()
+            finally:
+                errf.close()
             if self._stop.is_set() or self._gone(ns, name):
                 return
             if self._key(pod) in self._injected_failures:

@@ -35,7 +35,7 @@ import urllib.parse
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional, Type
 
-from ..api.core import Pod, Service
+from ..api.core import EventObject, Pod, Service
 from ..api.meta import ObjectMeta
 from ..api.tfjob import TFJob
 from ..utils import serde
@@ -448,6 +448,12 @@ class RestServiceClient(_RestTypedClient):
         return self.list(namespace)
 
 
+class RestEventClient(_RestTypedClient):
+    cls = EventObject
+    plural = "events"
+    kind_name = "Event"
+
+
 class RestCluster:
     """Drop-in for cluster.Cluster backed by HTTP — what ``-kubeconfig``
     selects in the CLI.  No ``.store``: there is no in-process substrate,
@@ -459,6 +465,7 @@ class RestCluster:
         self.tfjobs = RestTFJobClient(self.transport)
         self.pods = RestPodClient(self.transport)
         self.services = RestServiceClient(self.transport)
+        self.events = RestEventClient(self.transport)
 
     @staticmethod
     def from_flags(kubeconfig: str, master: str = "") -> "RestCluster":

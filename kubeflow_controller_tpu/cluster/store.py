@@ -191,13 +191,16 @@ class ObjectStore:
                     f"!= {existing.metadata.resource_version}"
                 )
             obj = serde.deep_copy(obj)
-            # uid and creation timestamp are immutable.
+            # uid, creation and deletion timestamps are immutable via update.
             obj.metadata.uid = existing.metadata.uid
             obj.metadata.creation_timestamp = existing.metadata.creation_timestamp
+            obj.metadata.deletion_timestamp = existing.metadata.deletion_timestamp
             obj.metadata.resource_version = self._next_rv()
             self._collection(kind)[key] = obj
             self._notify(kind, MODIFIED, obj)
-            return serde.deep_copy(obj)
+            out = serde.deep_copy(obj)
+            self._maybe_finalize(kind, key)
+            return out
 
     def patch_meta(self, kind: str, namespace: str, name: str,
                    fn: Callable[[ObjectMeta], None]) -> Any:
@@ -212,7 +215,9 @@ class ObjectStore:
             fn(obj.metadata)
             obj.metadata.resource_version = self._next_rv()
             self._notify(kind, MODIFIED, obj)
-            return serde.deep_copy(obj)
+            out = serde.deep_copy(obj)
+            self._maybe_finalize(kind, (namespace, name))
+            return out
 
     def update_status(self, kind: str, obj: Any) -> Any:
         """Status-subresource style update: only .status is applied.  A
@@ -235,16 +240,39 @@ class ObjectStore:
             return serde.deep_copy(existing)
 
     def delete(self, kind: str, namespace: str, name: str, cascade: bool = True) -> None:
-        """Immediate delete + (optionally) cascading GC of controller-owned
-        objects — the capability the reference left as a stub."""
+        """Delete an object.  With finalizers present this is GRACEFUL, as
+        on a real API server: deletionTimestamp is stamped and the object
+        stays (MODIFIED) until every finalizer is removed via update/patch —
+        at which point it is finalized (DELETED + cascade).  Without
+        finalizers: immediate delete + (optionally) cascading GC of
+        controller-owned objects — the capability the reference left as a
+        stub."""
         with self._lock:
-            obj = self._collection(kind).pop((namespace, name), None)
+            obj = self._collection(kind).get((namespace, name))
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
+            if obj.metadata.finalizers:
+                if obj.metadata.deletion_timestamp is None:
+                    obj.metadata.deletion_timestamp = time.time()
+                    obj.metadata.resource_version = self._next_rv()
+                    self._notify(kind, MODIFIED, obj)
+                return
+            self._collection(kind).pop((namespace, name))
             obj.metadata.deletion_timestamp = time.time()
             self._notify(kind, DELETED, obj)
             if cascade:
                 self._cascade_delete(obj.metadata.uid, namespace)
+
+    def _maybe_finalize(self, kind: str, key: tuple) -> bool:
+        """Remove an object whose deletion was blocked on finalizers once
+        the last finalizer is gone (k8s finalization semantics)."""
+        obj = self._collection(kind).get(key)
+        if obj is None or obj.metadata.deletion_timestamp is None or obj.metadata.finalizers:
+            return False
+        self._collection(kind).pop(key)
+        self._notify(kind, DELETED, obj)
+        self._cascade_delete(obj.metadata.uid, key[0])
+        return True
 
     def _cascade_delete(self, owner_uid: str, namespace: str) -> None:
         for kind in list(self._objects):
@@ -257,11 +285,18 @@ class ObjectStore:
 
     def mark_deleting(self, kind: str, namespace: str, name: str) -> Any:
         """Set deletionTimestamp without removing (graceful-deletion state,
-        which FilterActivePods treats as inactive)."""
-        return self.patch_meta(
-            kind, namespace, name,
-            lambda m: setattr(m, "deletion_timestamp", time.time()),
-        )
+        which FilterActivePods treats as inactive).  Deliberately does NOT
+        finalize an object with no finalizers: the node agent owns the final
+        delete, as a kubelet does for a terminating pod."""
+        with self._lock:
+            obj = self._collection(kind).get((namespace, name))
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            if obj.metadata.deletion_timestamp is None:
+                obj.metadata.deletion_timestamp = time.time()
+                obj.metadata.resource_version = self._next_rv()
+                self._notify(kind, MODIFIED, obj)
+            return serde.deep_copy(obj)
 
     def watch(self, kind: str, namespace: Optional[str] = None) -> Watcher:
         with self._lock:

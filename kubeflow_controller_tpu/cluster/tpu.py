@@ -14,7 +14,12 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ..api.core import Pod, RESOURCE_TPU
-from ..api.labels import ANNOTATION_ACCELERATOR, ANNOTATION_GANG_NAME, ANNOTATION_GANG_SIZE
+from ..api.labels import (
+    ANNOTATION_ACCELERATOR,
+    ANNOTATION_GANG_NAME,
+    ANNOTATION_GANG_SIZE,
+    ANNOTATION_NUM_SLICES,
+)
 
 
 @dataclass
@@ -35,8 +40,14 @@ class _Gang:
     name: str
     size: int
     accelerator_type: str
+    num_slices: int = 1
     pods: Dict[str, Pod] = field(default_factory=dict)  # pod name -> pod
-    slice_name: str = ""  # set once admitted
+    slice_names: List[str] = field(default_factory=list)  # set once admitted
+
+    @property
+    def slice_name(self) -> str:
+        """First bound slice ("" before admission) — single-slice view."""
+        return self.slice_names[0] if self.slice_names else ""
 
 
 def pod_requests_tpu(pod: Pod) -> bool:
@@ -63,37 +74,46 @@ class TPUInventory:
 
     def offer(self, pod: Pod) -> bool:
         """Offer a TPU pod for scheduling.  Returns True iff the pod's gang is
-        (now) admitted onto a slice — i.e. the pod may leave Pending.
+        (now) admitted onto its slices — i.e. the pod may leave Pending.
 
-        Non-gang TPU pods (no gang annotation) are admitted alone onto any
-        free slice."""
+        A gang spanning N slices (multislice) is admitted all-or-nothing
+        onto N free healthy slices.  Non-gang TPU pods (no gang annotation)
+        are admitted alone onto any free slice."""
         ann = pod.metadata.annotations
         gang_name = ann.get(ANNOTATION_GANG_NAME, "")
         accel = ann.get(ANNOTATION_ACCELERATOR, "")
         with self._lock:
             if not gang_name:
-                return self._find_free_slice(accel) is not None
+                return self._find_free_slices(accel, 1) is not None
             size = int(ann.get(ANNOTATION_GANG_SIZE, "1"))
-            gang = self._gangs.setdefault(gang_name, _Gang(gang_name, size, accel))
+            n_slices = int(ann.get(ANNOTATION_NUM_SLICES, "1") or "1")
+            gang = self._gangs.setdefault(
+                gang_name, _Gang(gang_name, size, accel, num_slices=n_slices))
             gang.pods[pod.metadata.name] = pod
-            if gang.slice_name:
+            if gang.slice_names:
                 return True  # already admitted; late pod joins
             if len(gang.pods) < gang.size:
                 return False  # gang incomplete: hold everything
-            sl = self._find_free_slice(accel)
-            if sl is None:
+            found = self._find_free_slices(accel, gang.num_slices)
+            if found is None:
                 return False  # complete but no capacity: hold (no partial admission)
-            sl.bound_gang = gang_name
-            gang.slice_name = sl.name
+            for sl in found:
+                sl.bound_gang = gang_name
+            gang.slice_names = [sl.name for sl in found]
             return True
 
-    def _find_free_slice(self, accelerator_type: str) -> Optional[TPUSlice]:
+    def _find_free_slices(self, accelerator_type: str,
+                          n: int) -> Optional[List[TPUSlice]]:
+        """n free healthy slices of the type, or None if fewer exist."""
+        out = []
         for s in self.slices.values():
             if s.bound_gang or not s.healthy:
                 continue
             if accelerator_type and s.accelerator_type != accelerator_type:
                 continue
-            return s
+            out.append(s)
+            if len(out) == n:
+                return out
         return None
 
     def gang_slice(self, gang_name: str) -> str:
@@ -101,12 +121,18 @@ class TPUInventory:
             g = self._gangs.get(gang_name)
             return g.slice_name if g else ""
 
+    def gang_slices(self, gang_name: str) -> List[str]:
+        with self._lock:
+            g = self._gangs.get(gang_name)
+            return list(g.slice_names) if g else []
+
     def release_gang(self, gang_name: str) -> None:
-        """Free the slice when a job completes or is recycled."""
+        """Free every bound slice when a job completes or is recycled."""
         with self._lock:
             g = self._gangs.pop(gang_name, None)
-            if g and g.slice_name and g.slice_name in self.slices:
-                self.slices[g.slice_name].bound_gang = ""
+            for name in (g.slice_names if g else []):
+                if name in self.slices:
+                    self.slices[name].bound_gang = ""
 
     def release_idle_gangs(self, active_pod_names) -> List[str]:
         """Release every gang none of whose member pods is still active —
@@ -135,9 +161,11 @@ class TPUInventory:
     def fail_slice(self, slice_name: str) -> List[str]:
         """Simulate a whole-slice failure (the TPU failure domain).  The
         slice is quarantined (healthy=False: it never admits another gang)
-        and the bound gang is evicted, so the controller's replacement gang
-        must be re-placed onto DIFFERENT hardware.  Returns the names of
-        pods in the evicted gang; the kubelet fails them all."""
+        and the bound gang is evicted from ALL its slices (one slice dying
+        tears the collective for the whole multislice gang; the other
+        slices stay healthy and are freed for the replacement).  Returns
+        the names of pods in the evicted gang; the kubelet fails them
+        all."""
         with self._lock:
             sl = self.slices.get(slice_name)
             if sl is None:
@@ -146,5 +174,7 @@ class TPUInventory:
             if not sl.bound_gang:
                 return []
             g = self._gangs.pop(sl.bound_gang, None)
-            sl.bound_gang = ""
+            for name in (g.slice_names if g else [sl.name]):
+                if name in self.slices:
+                    self.slices[name].bound_gang = ""
             return list(g.pods.keys()) if g else []

@@ -65,6 +65,9 @@ logger = logging.getLogger("kubeflow_controller_tpu.controller")
 
 MAX_STATUS_RETRIES = 5
 
+# Finalizer guarding explicit child cleanup on TFJob deletion.
+FINALIZER = "kubeflow.caicloud.io/tfjob-cleanup"
+
 
 class Controller:
     def __init__(
@@ -76,7 +79,10 @@ class Controller:
     ):
         self.cluster = cluster
         self.inventory = inventory
-        self.recorder = recorder or EventRecorder()
+        # Default recorder writes real Event API objects (kubectl-describe
+        # visibility) in addition to the in-memory/log stream.
+        self.recorder = recorder or EventRecorder(
+            sink=getattr(cluster, "events", None))
         self.helper = Helper(cluster, self.recorder)
         self.queue = RateLimitingQueue(name="tfJobs")
         self.expectations = ControllerExpectations()
@@ -231,13 +237,39 @@ class Controller:
         # Never mutate the informer cache (the reference mutates lister
         # objects — the shared-template bug class).
         job = serde.deep_copy(job)
+
+        deleting = job.metadata.deletion_timestamp is not None
+
+        # Finalizer-based cleanup, replacing reliance on server-side cascade
+        # (which real CRD deployments may lack): every live job carries our
+        # finalizer; a deleting job is cleaned up explicitly — release the
+        # gang, delete children — and only then is the finalizer removed so
+        # the API server finalizes the object (ref: the delete handlers the
+        # reference stubbed at controller.go:522-524, 601-603).  This runs
+        # BEFORE validation: a job whose spec went invalid after creation
+        # must still be deletable, or it lingers forever.
+        if deleting:
+            self._finalize_job(key, job)
+            return
+
         try:
             validate_tfjob(job)
         except ValidationError as e:
             self.recorder.event(job, TYPE_WARNING, "InvalidSpec", str(e))
             return  # do not requeue: the spec must change first
 
-        deleting = job.metadata.deletion_timestamp is not None
+        if FINALIZER not in job.metadata.finalizers:
+            def add_finalizer(m):
+                if FINALIZER not in m.finalizers and m.deletion_timestamp is None:
+                    m.finalizers.append(FINALIZER)
+
+            try:
+                # Continue the sync with the patched object: its bumped
+                # resourceVersion would otherwise Conflict the runtime-ID
+                # update below on every new job's first sync.
+                job = self.cluster.tfjobs.patch_meta(ns, name, add_finalizer)
+            except NotFound:
+                return
 
         # Persist the runtime ID once, before any replica exists (fixes the
         # per-sync in-memory stamping of local.go:79-84).
@@ -271,6 +303,38 @@ class Controller:
             and new_status.phase.value in ("Succeeded", "Failed")
         ):
             self.inventory.release_gang(gang_name(job))
+
+    def _finalize_job(self, key: str, job: TFJob) -> None:
+        """Cleanup under our finalizer: release the TPU gang, delete child
+        pods/services explicitly, then drop the finalizer — the API server
+        finalizes (removes) the job once the list empties."""
+        ns, name = job.metadata.namespace, job.metadata.name
+        if self.inventory is not None and is_tpu_job(job):
+            self.inventory.release_gang(gang_name(job))
+        if job.spec.runtime_id:  # no children can exist before stamping
+            selector = job_selector(name, job.spec.runtime_id)
+            for pod in self.cluster.pods.list(ns, selector=selector):
+                try:
+                    self.cluster.pods.delete(ns, pod.metadata.name)
+                    self.metrics.deletes += 1
+                except NotFound:
+                    pass
+            for svc in self.cluster.services.list(ns, selector=selector):
+                try:
+                    self.cluster.services.delete(ns, svc.metadata.name)
+                    self.metrics.deletes += 1
+                except NotFound:
+                    pass
+        if FINALIZER in job.metadata.finalizers:
+            def drop(m):
+                if FINALIZER in m.finalizers:
+                    m.finalizers.remove(FINALIZER)
+
+            try:
+                self.cluster.tfjobs.patch_meta(ns, name, drop)
+            except NotFound:
+                pass
+        self.expectations.delete_expectations(key)
 
     def _gather(self, job: TFJob):
         """Claim pods/services once at job scope, then partition by replica

@@ -40,15 +40,29 @@ class Event:
 
 
 class EventRecorder:
-    def __init__(self, component: str = "tfjob-controller", max_events: int = 4096):
+    def __init__(self, component: str = "tfjob-controller", max_events: int = 4096,
+                 sink=None):
+        """``sink``: an events client (cluster.events) — when given, every
+        event is ALSO written as a real Event API object, count-aggregated,
+        visible via the API the way ``kubectl describe`` shows them (ref:
+        broadcaster at pkg/controller/controller.go:107-110).  Best-effort,
+        as in k8s: API failures never break the controller."""
         self.component = component
         self._lock = threading.Lock()
         self._events: List[Event] = []
         self._max = max_events
+        self._sink = sink
+        # Sink state under its own lock: dedup index (aggregate key ->
+        # Event object name) and creation order for GC.  A separate lock so
+        # sink I/O (possibly HTTP) never blocks in-memory recording.
+        self._sink_lock = threading.Lock()
+        self._sink_names: dict = {}  # aggregate key -> Event object name
+        self._sink_created: list = []  # (namespace, name) in creation order
 
     def event(self, obj, event_type: str, reason: str, message: str) -> None:
         key = f"{obj.metadata.namespace}/{obj.metadata.name}"
         kind = getattr(obj, "kind", type(obj).__name__)
+        aggregated = False
         with self._lock:
             # Aggregate identical consecutive events (broadcaster behavior).
             if self._events:
@@ -56,13 +70,66 @@ class EventRecorder:
                 if (last.object_key, last.reason, last.message) == (key, reason, message):
                     last.count += 1
                     last.timestamp = time.time()
-                    return
-            self._events.append(Event(kind, key, event_type, reason, message))
-            if len(self._events) > self._max:
-                self._events = self._events[-self._max :]
-        log = logger.info if event_type == TYPE_NORMAL else logger.warning
-        log("event component=%s kind=%s object=%s reason=%s: %s",
-            self.component, kind, key, reason, message)
+                    aggregated = True
+            if not aggregated:
+                self._events.append(Event(kind, key, event_type, reason, message))
+                if len(self._events) > self._max:
+                    self._events = self._events[-self._max :]
+        if not aggregated:
+            log = logger.info if event_type == TYPE_NORMAL else logger.warning
+            log("event component=%s kind=%s object=%s reason=%s: %s",
+                self.component, kind, key, reason, message)
+        if self._sink is not None:
+            self._write_sink(obj, kind, key, event_type, reason, message)
+
+    def _write_sink(self, obj, kind: str, key: str, event_type: str,
+                    reason: str, message: str) -> None:
+        from ..api.core import EventObject, ObjectReference
+        from ..cluster.store import APIError, NotFound
+
+        ns = obj.metadata.namespace or "default"
+        agg = (key, reason, message)
+        now = time.time()
+        with self._sink_lock:  # serialize get/update/create across workers
+            try:
+                name = self._sink_names.get(agg)
+                if name:
+                    try:
+                        ev = self._sink.get(ns, name)
+                        ev.count += 1
+                        ev.last_timestamp = now
+                        self._sink.update(ev)
+                        return
+                    except NotFound:
+                        pass  # GC'd or restarted: recreate below
+                ev = EventObject()
+                ev.metadata.generate_name = f"{obj.metadata.name}."
+                ev.metadata.namespace = ns
+                ev.involved_object = ObjectReference(
+                    kind=kind, namespace=ns, name=obj.metadata.name,
+                    uid=obj.metadata.uid)
+                ev.type = event_type
+                ev.reason = reason
+                ev.message = message
+                ev.first_timestamp = ev.last_timestamp = now
+                ev.source_component = self.component
+                created = self._sink.create(ev)
+                # Bound both the dedup index (evict oldest entry, not the
+                # whole map — clearing would recreate every aggregate) and
+                # the stored objects (delete oldest: the TTL-expiry analog
+                # real k8s applies to Events).
+                if len(self._sink_names) >= self._max:
+                    self._sink_names.pop(next(iter(self._sink_names)))
+                self._sink_names[agg] = created.metadata.name
+                self._sink_created.append((ns, created.metadata.name))
+                if len(self._sink_created) > self._max:
+                    old_ns, old_name = self._sink_created.pop(0)
+                    try:
+                        self._sink.delete(old_ns, old_name)
+                    except APIError:
+                        pass
+            except APIError:
+                pass  # best-effort audit stream
 
     def events_for(self, namespace: str, name: str) -> List[Event]:
         key = f"{namespace}/{name}"

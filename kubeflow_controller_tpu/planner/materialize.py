@@ -32,6 +32,8 @@ from ..api.labels import (
     ANNOTATION_ACCELERATOR,
     ANNOTATION_GANG_NAME,
     ANNOTATION_GANG_SIZE,
+    ANNOTATION_NUM_SLICES,
+    ANNOTATION_SLICE_INDEX,
     LABEL_INDEX,
     selector_for,
 )
@@ -42,6 +44,7 @@ from ..api.tfjob import (
     TFReplicaSpec,
     replica_spec_for,
     tpu_slice_hosts,
+    tpu_total_hosts,
 )
 from ..utils import serde
 
@@ -55,6 +58,9 @@ ENV_PROCESS_ID = "JAX_PROCESS_ID"
 ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
 ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
 ENV_TPU_ACCELERATOR = "TPU_ACCELERATOR_TYPE"
+# Multislice (DCN) contract — the names GKE multislice / megascale use.
+ENV_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+ENV_SLICE_ID = "MEGASCALE_SLICE_ID"
 
 
 def labels_for(job: TFJob, typ: ReplicaType) -> Dict[str, str]:
@@ -223,29 +229,41 @@ def _wire_worker_collectives(job: TFJob, c, index: int) -> None:
 
 def _wire_tpu_pod(job: TFJob, spec: TFReplicaSpec, pod: Pod, index: int) -> None:
     tpu = spec.tpu
-    hosts = tpu_slice_hosts(tpu)
+    per_slice = tpu_slice_hosts(tpu)
+    total = tpu_total_hosts(tpu)
+    slice_idx, local_idx = divmod(index, per_slice)
     coord = f"{coordinator_service_name(job)}:{tpu.coordinator_port}"
     # Per-host DNS via the headless subdomain service: hostname + subdomain
     # resolve as host-<i>.<subdomain>.<ns>.svc (the GKE TPU pattern).
     pod.spec.hostname = f"host-{index}"
     pod.spec.subdomain = service_name(job, ReplicaType.TPU, 0)
     c = pod.spec.containers[0]
+    # jax.distributed spans ALL slices: one coordinator, global process ids
+    # (ICI within a slice, DCN across — dp across slices is the standard
+    # mesh layout, consumed via JobRuntime.num_slices).
     c.set_env(ENV_COORDINATOR, coord)
-    c.set_env(ENV_NUM_PROCESSES, str(hosts))
+    c.set_env(ENV_NUM_PROCESSES, str(total))
     c.set_env(ENV_PROCESS_ID, str(index))
-    c.set_env(ENV_TPU_WORKER_ID, str(index))
+    # TPU runtime env is per-slice: worker id and peer hostnames within
+    # this pod's slice only (the GKE multislice contract).
+    c.set_env(ENV_TPU_WORKER_ID, str(local_idx))
     c.set_env(ENV_TPU_WORKER_HOSTNAMES, ",".join(
-        tpu_host_dns(job, i) for i in range(hosts)
+        tpu_host_dns(job, i)
+        for i in range(slice_idx * per_slice, (slice_idx + 1) * per_slice)
     ))
     c.set_env(ENV_TPU_ACCELERATOR, tpu.accelerator_type)
+    c.set_env(ENV_NUM_SLICES, str(tpu.num_slices))
+    c.set_env(ENV_SLICE_ID, str(slice_idx))
     # Chip request: never nvidia.com/gpu (BASELINE.json north star).
     c.resources.requests[RESOURCE_TPU] = str(tpu.chips_per_host)
     c.resources.limits[RESOURCE_TPU] = str(tpu.chips_per_host)
     pod.metadata.annotations = {
         **pod.metadata.annotations,
         ANNOTATION_GANG_NAME: gang_name(job),
-        ANNOTATION_GANG_SIZE: str(hosts),
+        ANNOTATION_GANG_SIZE: str(total),
         ANNOTATION_ACCELERATOR: tpu.accelerator_type,
+        ANNOTATION_NUM_SLICES: str(tpu.num_slices),
+        ANNOTATION_SLICE_INDEX: str(slice_idx),
     }
     if pod.spec.restart_policy == "Always":
         # A slice process that dies must fail the pod so the whole gang is

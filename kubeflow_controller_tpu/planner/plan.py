@@ -38,7 +38,7 @@ from ..api.core import (
     Service,
     is_pod_active,
 )
-from ..api.tfjob import ReplicaType, TFJob, TFJobPhase, TFReplicaSpec, tpu_slice_hosts
+from ..api.tfjob import ReplicaType, TFJob, TFJobPhase, TFReplicaSpec, tpu_total_hosts
 from .materialize import pods_by_index, services_by_index
 from .types import Action, Plan, PlanEvent
 
@@ -48,10 +48,11 @@ _TYPE_ORDER = [ReplicaType.WORKER, ReplicaType.PS, ReplicaType.TPU, ReplicaType.
 
 
 def desired_replicas(spec: TFReplicaSpec) -> int:
-    """TPU replica count is the slice's host count — the TPUSpec topology is
-    the source of truth (spec.replicas must agree; validated at the API)."""
+    """TPU replica count is the topology's host count across all slices —
+    the TPUSpec is the source of truth (spec.replicas must agree; validated
+    at the API)."""
     if spec.tf_replica_type == ReplicaType.TPU and spec.tpu is not None:
-        return tpu_slice_hosts(spec.tpu)
+        return tpu_total_hosts(spec.tpu)
     return spec.replicas
 
 

@@ -16,7 +16,9 @@ from typing import Dict, List, Optional
 from ..planner.materialize import (
     ENV_COORDINATOR,
     ENV_NUM_PROCESSES,
+    ENV_NUM_SLICES,
     ENV_PROCESS_ID,
+    ENV_SLICE_ID,
     ENV_TPU_ACCELERATOR,
     ENV_TPU_WORKER_HOSTNAMES,
 )
@@ -31,6 +33,11 @@ class JobRuntime:
     process_id: int = 0
     accelerator_type: str = ""
     worker_hostnames: List[str] = field(default_factory=list)
+    # Multislice (DCN): slices this job spans and which one this process is
+    # on.  Mesh guidance: put dp across slices (ICI-heavy axes — tp/sp —
+    # inside a slice), e.g. MeshSpec(dp=num_slices, ...).
+    num_slices: int = 1
+    slice_id: int = 0
     data_dir: str = ""
     model_dir: str = ""
     log_dir: str = ""
@@ -47,6 +54,8 @@ class JobRuntime:
             process_id=int(e.get(ENV_PROCESS_ID, "0") or "0"),
             accelerator_type=e.get(ENV_TPU_ACCELERATOR, ""),
             worker_hostnames=hostnames,
+            num_slices=int(e.get(ENV_NUM_SLICES, "1") or "1"),
+            slice_id=int(e.get(ENV_SLICE_ID, "0") or "0"),
             data_dir=e.get("DATA_DIR", ""),
             model_dir=e.get("MODEL_DIR", ""),
             log_dir=e.get("LOG_DIR", ""),

@@ -118,7 +118,7 @@ def test_validate_ok():
 
 
 def test_validate_rejects_tpu_replicas_contradicting_topology():
-    with pytest.raises(ValidationError, match="contradicts slice host count"):
+    with pytest.raises(ValidationError, match="contradicts host count"):
         validate_tfjob(mk_job((ReplicaType.TPU, 4)))  # v5e-8 derives 2 hosts
 
 
@@ -265,3 +265,31 @@ def test_keys_and_names():
     assert n.startswith("base-") and len(n) == len("base-") + 5
     assert len(generate_runtime_id()) == 5
     assert len(generate_name("x" * 100)) == 63
+
+
+# ---- Multislice (DCN) topology ----
+
+def test_multislice_total_hosts():
+    from kubeflow_controller_tpu.api.tfjob import tpu_total_hosts
+
+    spec = TPUSpec(accelerator_type="v5e-8", chips_per_host=4, num_slices=2)
+    assert tpu_slice_hosts(spec) == 2
+    assert tpu_total_hosts(spec) == 4
+
+
+def test_multislice_replicas_must_agree():
+    job = mk_job((ReplicaType.TPU, 4))
+    job.spec.tf_replica_specs[0].tpu = TPUSpec(
+        accelerator_type="v5e-8", chips_per_host=4, num_slices=2)
+    validate_tfjob(job)  # 2 slices x 2 hosts = 4 == replicas
+    job.spec.tf_replica_specs[0].replicas = 2  # per-slice count: wrong
+    with pytest.raises(ValidationError):
+        validate_tfjob(job)
+
+
+def test_multislice_num_slices_positive():
+    job = mk_job((ReplicaType.TPU, 1))
+    job.spec.tf_replica_specs[0].tpu = TPUSpec(
+        accelerator_type="v5e-8", chips_per_host=4, num_slices=0)
+    with pytest.raises(ValidationError):
+        validate_tfjob(job)

@@ -288,3 +288,50 @@ def test_slice_failure_domain():
     inv.offer(tpu_pod("r0", "g2", 2))
     assert inv.offer(tpu_pod("r1", "g2", 2))
     assert inv.gang_slice("g2") == "slice-1"
+
+
+# ---- Multislice (DCN) gang scheduling ----
+
+def multislice_pod(name, gang, size, n_slices, accel="v5e-8"):
+    from kubeflow_controller_tpu.api.labels import ANNOTATION_NUM_SLICES
+
+    p = tpu_pod(name, gang, size, accel)
+    p.metadata.annotations[ANNOTATION_NUM_SLICES] = str(n_slices)
+    return p
+
+
+def test_multislice_gang_binds_n_slices():
+    inv = TPUInventory([TPUSlice(f"slice-{i}", "v5e-8", num_hosts=2)
+                        for i in range(3)])
+    # Gang of 4 pods spanning 2 slices (2 hosts each).
+    pods = [multislice_pod(f"h{i}", "g1", 4, 2) for i in range(4)]
+    assert not inv.offer(pods[0])
+    assert not inv.offer(pods[1])
+    assert not inv.offer(pods[2])
+    assert inv.offer(pods[3])  # complete: admitted onto 2 slices atomically
+    bound = inv.gang_slices("g1")
+    assert len(bound) == 2
+    assert sum(1 for s in inv.slices.values() if s.bound_gang == "g1") == 2
+    # A second 2-slice gang cannot fit (only 1 slice left).
+    pods2 = [multislice_pod(f"x{i}", "g2", 4, 2) for i in range(4)]
+    for p in pods2:
+        admitted = inv.offer(p)
+    assert not admitted
+    # Releasing the first frees both its slices; g2 then fits.
+    inv.release_gang("g1")
+    assert inv.offer(pods2[0])  # complete gang re-offer: admitted now
+    assert len(inv.gang_slices("g2")) == 2
+
+
+def test_multislice_fail_one_slice_evicts_whole_gang():
+    inv = TPUInventory([TPUSlice(f"slice-{i}", "v5e-8", num_hosts=2)
+                        for i in range(3)])
+    pods = [multislice_pod(f"h{i}", "g1", 4, 2) for i in range(4)]
+    for p in pods:
+        inv.offer(p)
+    s0, s1 = inv.gang_slices("g1")
+    assert sorted(inv.fail_slice(s0)) == ["h0", "h1", "h2", "h3"]
+    # Failed slice quarantined; the OTHER slice is healthy and free again.
+    assert inv.slices[s0].healthy is False
+    assert inv.slices[s1].healthy is True
+    assert inv.slices[s1].bound_gang == ""

@@ -112,7 +112,11 @@ def test_distributed_job_full_lifecycle(rig):
 
 def test_failed_worker_recovers_index(rig):
     cluster, ctrl, kubelet, _ = rig
-    kubelet.policy.fail_once = set()  # configure below after names known
+    # Slow the simulated run so the manual failure injection below cannot
+    # race the pod's own Succeeded transition (a 0.05s window flakes when
+    # the host is loaded); the replacement pod also runs 2s — still well
+    # inside the wait_for timeout.
+    kubelet.policy.run_s = 2.0
     cluster.tfjobs.create(mk_job("recover", (ReplicaType.WORKER, 2)))
     wait_for(lambda: len(cluster.pods.list("default")) == 2)
     # Fail index 0's pod manually (kubelet would have succeeded it).
@@ -168,3 +172,86 @@ def test_reconcile_metrics_recorded(rig):
     assert snap["syncs"] > 0
     assert snap["reconcile_p50_s"] >= 0.0
     assert snap["creates"] >= 1
+
+
+def test_multislice_tpu_job_full_lifecycle(rig):
+    """A 2-slice TPU gang (4 pods over 2 x v5e-8) schedules all-or-nothing
+    across slices, runs, succeeds, and frees BOTH slices."""
+    cluster, ctrl, _, inventory = rig
+    inventory.add_slice(TPUSlice("slice-1", "v5e-8", num_hosts=2))
+    job = mk_job("multislice", (ReplicaType.TPU, 4))
+    job.spec.tf_replica_specs[0].tpu = TPUSpec(
+        accelerator_type="v5e-8", chips_per_host=4, num_slices=2)
+    job.spec.tf_replica_specs[0].replicas = 4
+    cluster.tfjobs.create(job)
+    wait_for(lambda: phase_of(cluster, "multislice") == TFJobPhase.SUCCEEDED)
+    pods = cluster.pods.list("default")
+    assert len(pods) == 4
+    assert all(not s.bound_gang for s in inventory.slices.values())
+
+
+def test_finalizer_guards_deletion_cleanup(rig):
+    """Deletion is finalizer-gated: the job lingers with deletionTimestamp
+    until the controller releases the gang and deletes children explicitly,
+    then the API server finalizes it (ref: the stubbed delete handlers at
+    controller.go:522-524, 601-603)."""
+    from kubeflow_controller_tpu.controller.controller import FINALIZER
+
+    cluster, ctrl, _, inventory = rig
+    cluster.tfjobs.create(mk_job("fin", (ReplicaType.TPU, 2)))
+    wait_for(lambda: phase_of(cluster, "fin") == TFJobPhase.SUCCEEDED)
+    # The controller stamped its finalizer on the live job.
+    job = cluster.tfjobs.get("default", "fin")
+    assert FINALIZER in job.metadata.finalizers
+    cluster.tfjobs.delete("default", "fin")
+    # Fully gone only after the controller's cleanup removed the finalizer.
+    def gone():
+        try:
+            cluster.tfjobs.get("default", "fin")
+            return False
+        except Exception:
+            return True
+    wait_for(gone)
+    assert cluster.pods.list("default") == []
+    assert cluster.services.list("default") == []
+    assert all(not s.bound_gang for s in inventory.slices.values())
+
+
+def test_events_are_api_objects(rig):
+    """The recorder writes real Event objects (kubectl-describe parity) with
+    count aggregation."""
+    cluster, ctrl, _, _ = rig
+    cluster.tfjobs.create(mk_job("evjob", (ReplicaType.WORKER, 2)))
+    wait_for(lambda: phase_of(cluster, "evjob") == TFJobPhase.SUCCEEDED)
+    events = cluster.events.list("default")
+    assert events, "no Event objects were written"
+    creates = [e for e in events
+               if e.reason == "SuccessfulCreate"
+               and e.involved_object.name == "evjob"]
+    assert creates
+    assert all(e.involved_object.kind == "TFJob" for e in creates)
+    assert all(e.source_component == "tfjob-controller" for e in creates)
+    # 2 worker pods + 2 services created; counts aggregate per message, so
+    # total count across create events is 4.
+    assert sum(e.count for e in creates) == 4
+
+
+def test_invalid_job_still_deletable(rig):
+    """A job whose spec goes invalid AFTER creation must still be
+    finalizable on delete — cleanup must not sit behind validation."""
+    cluster, ctrl, _, _ = rig
+    cluster.tfjobs.create(mk_job("gone-bad", (ReplicaType.WORKER, 1)))
+    wait_for(lambda: phase_of(cluster, "gone-bad") == TFJobPhase.SUCCEEDED)
+    # Invalidate the stored spec (the fake API server has no admission).
+    j = cluster.tfjobs.get("default", "gone-bad")
+    j.spec.tf_replica_specs[0].template = None
+    cluster.tfjobs.update(j)
+    cluster.tfjobs.delete("default", "gone-bad")
+    def gone():
+        try:
+            cluster.tfjobs.get("default", "gone-bad")
+            return False
+        except Exception:
+            return True
+    wait_for(gone)
+    assert cluster.pods.list("default") == []

@@ -308,3 +308,47 @@ def test_dir_fields_plumbed_to_env():
     pod = make_pod(job, job.spec.tf_replica_specs[0], 0)
     env = {e.name: e.value for e in pod.spec.containers[0].env}
     assert env["MODEL_DIR"] == "/ckpt" and env["DATA_DIR"] == "/data"
+
+
+def test_multislice_pod_wiring():
+    """2-slice gang: global jax.distributed ids, per-slice TPU runtime env,
+    slice annotations (the DCN analog of generateTFClusterSpec)."""
+    from kubeflow_controller_tpu.api.labels import (
+        ANNOTATION_GANG_SIZE,
+        ANNOTATION_NUM_SLICES,
+        ANNOTATION_SLICE_INDEX,
+    )
+    from kubeflow_controller_tpu.planner.materialize import (
+        ENV_NUM_PROCESSES,
+        ENV_NUM_SLICES,
+        ENV_PROCESS_ID,
+        ENV_SLICE_ID,
+        ENV_TPU_WORKER_HOSTNAMES,
+        ENV_TPU_WORKER_ID,
+        make_pod,
+    )
+
+    job = mk_job((ReplicaType.TPU, 4),
+                 tpu=TPUSpec(accelerator_type="v5e-8", chips_per_host=4,
+                             num_slices=2))
+    spec = job.spec.tf_replica_specs[0]
+    envs = []
+    for index in range(4):
+        pod = make_pod(job, spec, index)
+        env = {e.name: e.value for e in pod.spec.containers[0].env}
+        envs.append(env)
+        ann = pod.metadata.annotations
+        assert ann[ANNOTATION_GANG_SIZE] == "4"
+        assert ann[ANNOTATION_NUM_SLICES] == "2"
+        assert ann[ANNOTATION_SLICE_INDEX] == str(index // 2)
+    # Global process ids span both slices; TPU worker ids are per-slice.
+    assert [e[ENV_PROCESS_ID] for e in envs] == ["0", "1", "2", "3"]
+    assert all(e[ENV_NUM_PROCESSES] == "4" for e in envs)
+    assert [e[ENV_TPU_WORKER_ID] for e in envs] == ["0", "1", "0", "1"]
+    assert all(e[ENV_NUM_SLICES] == "2" for e in envs)
+    assert [e[ENV_SLICE_ID] for e in envs] == ["0", "0", "1", "1"]
+    # Each pod's hostname list covers only its own slice's two hosts.
+    assert envs[0][ENV_TPU_WORKER_HOSTNAMES] == envs[1][ENV_TPU_WORKER_HOSTNAMES]
+    assert envs[2][ENV_TPU_WORKER_HOSTNAMES] == envs[3][ENV_TPU_WORKER_HOSTNAMES]
+    assert envs[0][ENV_TPU_WORKER_HOSTNAMES] != envs[2][ENV_TPU_WORKER_HOSTNAMES]
+    assert all("host-0" in envs[0][ENV_TPU_WORKER_HOSTNAMES] for _ in [0])
