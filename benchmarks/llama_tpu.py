@@ -33,7 +33,8 @@ V5E_BF16_PEAK_TFLOPS = 197.0
 
 
 def run(batch: int, seq: int, steps: int, dim: int, layers: int, heads: int,
-        intermediate: int, policy: str, peak_tflops: float) -> dict:
+        intermediate: int, policy: str, peak_tflops: float,
+        loss_chunks: int = 0) -> dict:
     import jax
     import optax
 
@@ -44,7 +45,7 @@ def run(batch: int, seq: int, steps: int, dim: int, layers: int, heads: int,
         vocab_size=32000, dim=dim, n_layers=layers, n_heads=heads,
         n_kv_heads=heads, intermediate=intermediate, max_seq_len=seq,
         dtype="bfloat16", param_dtype="bfloat16", remat=True,
-        remat_policy=policy,
+        remat_policy=policy, loss_chunks=loss_chunks,
     )
     mesh = build_mesh(MeshSpec(fsdp=-1))
     params = jax.jit(lambda k: llama_init(k, cfg))(jax.random.PRNGKey(0))
@@ -89,6 +90,7 @@ def run(batch: int, seq: int, steps: int, dim: int, layers: int, heads: int,
         "mfu_pct": round(100 * tflops / peak_tflops, 1),
         "loss": round(loss_val, 3),
         "batch": batch, "seq": seq, "remat_policy": policy,
+        "loss_chunks": loss_chunks,
     }
 
 
@@ -118,10 +120,12 @@ def sweep(steps: int, out_path: str, peak: float, shape: dict) -> int:
         dict(batch=16, seq=1024, policy="ffn"),
         dict(batch=16, seq=1024, policy="gateup"),
         dict(batch=16, seq=1024, policy="gateup_attn"),
+        dict(batch=16, seq=1024, policy="gateup_attn", chunks=8),
         dict(batch=32, seq=1024, policy="gateup"),
         dict(batch=8, seq=2048, policy="gateup"),
         dict(batch=8, seq=2048, policy="full"),
         dict(batch=4, seq=4096, policy="gateup"),
+        dict(batch=4, seq=4096, policy="gateup", chunks=16),
         dict(batch=4, seq=4096, policy="full"),
     ]
     results = []
@@ -129,6 +133,7 @@ def sweep(steps: int, out_path: str, peak: float, shape: dict) -> int:
         r = run_subprocess([
             "--batch", g["batch"], "--seq", g["seq"], "--steps", steps,
             "--remat-policy", g["policy"],
+            "--loss-chunks", g.get("chunks", 0),
             # Forward peak + model shape so per-point mfu_pct is computed
             # against the same values the artifact header records.
             "--peak-tflops", peak, "--dim", shape["dim"],
@@ -138,6 +143,7 @@ def sweep(steps: int, out_path: str, peak: float, shape: dict) -> int:
         r.setdefault("batch", g["batch"])
         r.setdefault("seq", g["seq"])
         r.setdefault("remat_policy", g["policy"])
+        r.setdefault("loss_chunks", g.get("chunks", 0))
         results.append(r)
         print(json.dumps(r), flush=True)
     ok = [r for r in results if "model_tflops" in r]
@@ -169,6 +175,8 @@ def main() -> int:
     p.add_argument("--intermediate", type=int, default=5632)
     p.add_argument("--remat-policy", default="full",
                    choices=["full", "dots", "ffn", "gateup", "gateup_attn"])
+    p.add_argument("--loss-chunks", type=int, default=0,
+                   help="chunked cross-entropy (0 = dense logits)")
     p.add_argument("--peak-tflops", type=float, default=V5E_BF16_PEAK_TFLOPS)
     p.add_argument("--sweep", action="store_true",
                    help="run the config grid and write the JSON artifact")
@@ -180,7 +188,7 @@ def main() -> int:
                           intermediate=args.intermediate))
     out = run(args.batch, args.seq, args.steps, args.dim, args.layers,
               args.heads, args.intermediate, args.remat_policy,
-              args.peak_tflops)
+              args.peak_tflops, loss_chunks=args.loss_chunks)
     print(json.dumps(out))
     return 0
 

@@ -135,6 +135,72 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def _rest_cluster_or_die(args):
+    from ..cluster.rest import KubeconfigError, RestCluster
+
+    try:
+        cluster = RestCluster.from_flags(args.kubeconfig, args.master)
+        cluster.tfjobs.list()  # connectivity probe
+        return cluster
+    except (KubeconfigError, OSError, APIError) as e:
+        print(f"error talking to API server: {e}", file=sys.stderr)
+        return None
+
+
+def cmd_get(args) -> int:
+    """kubectl-get analog: one line per TFJob (REST mode only)."""
+    cluster = _rest_cluster_or_die(args)
+    if cluster is None:
+        return 2
+    jobs = cluster.tfjobs.list(args.namespace or None)
+    if not jobs:
+        print("No resources found.")
+        return 0
+    print(f"{'NAMESPACE':<12} {'NAME':<32} {'PHASE':<10} REPLICAS")
+    for j in jobs:
+        kinds = ",".join(
+            f"{s.tf_replica_type.value}x{s.replicas}" for s in j.spec.tf_replica_specs
+        )
+        print(f"{j.metadata.namespace:<12} {j.metadata.name:<32} "
+              f"{j.status.phase.value:<10} {kinds}")
+    return 0
+
+
+def cmd_describe(args) -> int:
+    """kubectl-describe analog: spec summary, status rollup, child pods,
+    and the job's Event objects (REST mode only)."""
+    from ..cluster.store import NotFound
+
+    cluster = _rest_cluster_or_die(args)
+    if cluster is None:
+        return 2
+    ns = args.namespace or "default"
+    try:
+        j = cluster.tfjobs.get(ns, args.name)
+    except NotFound:
+        print(f"tfjob {ns}/{args.name} not found", file=sys.stderr)
+        return 1
+    print(f"Name:      {j.metadata.name}")
+    print(f"Namespace: {j.metadata.namespace}")
+    print(f"RuntimeID: {j.spec.runtime_id}")
+    print(f"Phase:     {j.status.phase.value}"
+          + (f"  ({j.status.reason})" if j.status.reason else ""))
+    for c in j.status.conditions:
+        print(f"Condition: {c.type.value}={c.status} {c.reason}")
+    for rs in j.status.tf_replica_statuses:
+        hist = {k.value: v for k, v in rs.tf_replicas_states.items()}
+        print(f"Replicas:  {rs.type.value}: state={rs.state.value} {hist}")
+        for pn in rs.pod_names:
+            print(f"           pod {pn}")
+    events = [e for e in cluster.events.list(ns)
+              if e.involved_object.name == args.name]
+    if events:
+        print("Events:")
+        for e in sorted(events, key=lambda e: e.first_timestamp):
+            print(f"  {e.type:<8} {e.reason:<18} x{e.count}  {e.message}")
+    return 0
+
+
 def cmd_run(args) -> int:
     logging.basicConfig(
         level=logging.DEBUG if args.v >= 4 else logging.INFO,
@@ -152,13 +218,8 @@ def cmd_run(args) -> int:
         # Real-cluster mode: BuildConfigFromFlags parity
         # (ref: cmd/controller/main.go:47-60).  The API server owns the
         # kubelet/inventory; this process is only the controller.
-        from ..cluster.rest import KubeconfigError, RestCluster
-
-        try:
-            cluster = RestCluster.from_flags(args.kubeconfig, args.master)
-            cluster.tfjobs.list()  # connectivity probe: fail fast and clean
-        except (KubeconfigError, OSError, APIError) as e:
-            print(f"error building cluster config: {e}", file=sys.stderr)
+        cluster = _rest_cluster_or_die(args)
+        if cluster is None:
             return 2
         inventory = None
     else:
@@ -253,6 +314,15 @@ def build_parser() -> argparse.ArgumentParser:
     v = sub.add_parser("validate", help="validate TFJob manifests")
     v.add_argument("-f", "--files", nargs="+", required=True)
 
+    g = sub.add_parser("get", help="list TFJobs (REST mode: pass -master)")
+    g.add_argument("-n", "--namespace", default="",
+                   help="namespace filter (default: all)")
+
+    d = sub.add_parser("describe", help="describe one TFJob + its events "
+                                        "(REST mode: pass -master)")
+    d.add_argument("name")
+    d.add_argument("-n", "--namespace", default="default")
+
     r = sub.add_parser("run", help="run the controller")
     r.add_argument("--in-memory", action="store_true",
                    help="run against the in-memory cluster substrate")
@@ -275,6 +345,19 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    try:
+        return _main(argv)
+    except BrokenPipeError:
+        # Downstream pager/head closed the pipe: exit quietly like any
+        # well-behaved CLI (BSD-style 141 would also do; 0 keeps scripts calm).
+        try:
+            sys.stdout.close()
+        except OSError:
+            pass
+        return 0
+
+
+def _main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.version or args.cmd == "version":
         return cmd_version(args)
@@ -282,6 +365,10 @@ def main(argv=None) -> int:
         return cmd_validate(args)
     if args.cmd == "serve":
         return cmd_serve(args)
+    if args.cmd == "get":
+        return cmd_get(args)
+    if args.cmd == "describe":
+        return cmd_describe(args)
     if args.cmd == "run":
         return cmd_run(args)
     build_parser().print_help()

@@ -47,17 +47,23 @@ class EventRecorder:
         visible via the API the way ``kubectl describe`` shows them (ref:
         broadcaster at pkg/controller/controller.go:107-110).  Best-effort,
         as in k8s: API failures never break the controller."""
+        import queue
+
         self.component = component
         self._lock = threading.Lock()
         self._events: List[Event] = []
         self._max = max_events
         self._sink = sink
-        # Sink state under its own lock: dedup index (aggregate key ->
-        # Event object name) and creation order for GC.  A separate lock so
-        # sink I/O (possibly HTTP) never blocks in-memory recording.
-        self._sink_lock = threading.Lock()
+        # Sink writes happen on ONE background flusher thread (the k8s
+        # broadcaster model): recorder.event() in the sync path only
+        # enqueues, so a slow API server never stalls reconciles on audit
+        # traffic.  Bounded queue; overflow drops (best-effort stream).
+        self._sink_queue: "queue.Queue" = queue.Queue(maxsize=1024)
         self._sink_names: dict = {}  # aggregate key -> Event object name
         self._sink_created: list = []  # (namespace, name) in creation order
+        if sink is not None:
+            threading.Thread(target=self._sink_loop, name="event-sink",
+                             daemon=True).start()
 
     def event(self, obj, event_type: str, reason: str, message: str) -> None:
         key = f"{obj.metadata.namespace}/{obj.metadata.name}"
@@ -80,56 +86,69 @@ class EventRecorder:
             log("event component=%s kind=%s object=%s reason=%s: %s",
                 self.component, kind, key, reason, message)
         if self._sink is not None:
-            self._write_sink(obj, kind, key, event_type, reason, message)
+            import queue
 
-    def _write_sink(self, obj, kind: str, key: str, event_type: str,
-                    reason: str, message: str) -> None:
+            try:
+                self._sink_queue.put_nowait(
+                    (kind, obj.metadata.namespace or "default",
+                     obj.metadata.name, obj.metadata.uid,
+                     key, event_type, reason, message))
+            except queue.Full:
+                pass  # drop under pressure: audit stream is best-effort
+
+    def _sink_loop(self) -> None:
+        while True:
+            item = self._sink_queue.get()
+            self._write_sink(*item)
+
+    def _write_sink(self, kind: str, ns: str, obj_name: str, uid: str,
+                    key: str, event_type: str, reason: str,
+                    message: str) -> None:
+        """Runs ONLY on the flusher thread: no locking needed for the dedup
+        index, and API latency never touches the sync path."""
         from ..api.core import EventObject, ObjectReference
         from ..cluster.store import APIError, NotFound
 
-        ns = obj.metadata.namespace or "default"
         agg = (key, reason, message)
         now = time.time()
-        with self._sink_lock:  # serialize get/update/create across workers
-            try:
-                name = self._sink_names.get(agg)
-                if name:
-                    try:
-                        ev = self._sink.get(ns, name)
-                        ev.count += 1
-                        ev.last_timestamp = now
-                        self._sink.update(ev)
-                        return
-                    except NotFound:
-                        pass  # GC'd or restarted: recreate below
-                ev = EventObject()
-                ev.metadata.generate_name = f"{obj.metadata.name}."
-                ev.metadata.namespace = ns
-                ev.involved_object = ObjectReference(
-                    kind=kind, namespace=ns, name=obj.metadata.name,
-                    uid=obj.metadata.uid)
-                ev.type = event_type
-                ev.reason = reason
-                ev.message = message
-                ev.first_timestamp = ev.last_timestamp = now
-                ev.source_component = self.component
-                created = self._sink.create(ev)
-                # Bound both the dedup index (evict oldest entry, not the
-                # whole map — clearing would recreate every aggregate) and
-                # the stored objects (delete oldest: the TTL-expiry analog
-                # real k8s applies to Events).
-                if len(self._sink_names) >= self._max:
-                    self._sink_names.pop(next(iter(self._sink_names)))
-                self._sink_names[agg] = created.metadata.name
-                self._sink_created.append((ns, created.metadata.name))
-                if len(self._sink_created) > self._max:
-                    old_ns, old_name = self._sink_created.pop(0)
-                    try:
-                        self._sink.delete(old_ns, old_name)
-                    except APIError:
-                        pass
-            except APIError:
-                pass  # best-effort audit stream
+        try:
+            name = self._sink_names.get(agg)
+            if name:
+                try:
+                    ev = self._sink.get(ns, name)
+                    ev.count += 1
+                    ev.last_timestamp = now
+                    self._sink.update(ev)
+                    return
+                except NotFound:
+                    pass  # GC'd or restarted: recreate below
+            ev = EventObject()
+            ev.metadata.generate_name = f"{obj_name}."
+            ev.metadata.namespace = ns
+            ev.involved_object = ObjectReference(
+                kind=kind, namespace=ns, name=obj_name, uid=uid)
+            ev.type = event_type
+            ev.reason = reason
+            ev.message = message
+            ev.first_timestamp = ev.last_timestamp = now
+            ev.source_component = self.component
+            created = self._sink.create(ev)
+            # Bound both the dedup index (evict oldest entry, not the
+            # whole map — clearing would recreate every aggregate) and
+            # the stored objects (delete oldest: the TTL-expiry analog
+            # real k8s applies to Events).
+            if len(self._sink_names) >= self._max:
+                self._sink_names.pop(next(iter(self._sink_names)))
+            self._sink_names[agg] = created.metadata.name
+            self._sink_created.append((ns, created.metadata.name))
+            if len(self._sink_created) > self._max:
+                old_ns, old_name = self._sink_created.pop(0)
+                try:
+                    self._sink.delete(old_ns, old_name)
+                except APIError:
+                    pass
+        except APIError:
+            pass  # best-effort audit stream
 
     def events_for(self, namespace: str, name: str) -> List[Event]:
         key = f"{namespace}/{name}"

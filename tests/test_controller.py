@@ -223,8 +223,11 @@ def test_events_are_api_objects(rig):
     cluster, ctrl, _, _ = rig
     cluster.tfjobs.create(mk_job("evjob", (ReplicaType.WORKER, 2)))
     wait_for(lambda: phase_of(cluster, "evjob") == TFJobPhase.SUCCEEDED)
-    events = cluster.events.list("default")
-    assert events, "no Event objects were written"
+    # Sink writes flush on a background thread (broadcaster model).
+    events = wait_for(lambda: [
+        e for e in cluster.events.list("default")
+        if e.reason == "SuccessfulCreate" and e.involved_object.name == "evjob"
+    ] and cluster.events.list("default"))
     creates = [e for e in events
                if e.reason == "SuccessfulCreate"
                and e.involved_object.name == "evjob"]

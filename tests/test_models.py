@@ -124,3 +124,57 @@ class TestLlama:
         def check(a, s):
             assert len(s) <= a.ndim, (a.shape, s)
         jax.tree.map(check, params, pspecs)
+
+
+class TestChunkedCE:
+    """cfg.loss_chunks: the loss without the [B,T,vocab] logits tensor."""
+
+    def _setup(self):
+        import dataclasses
+
+        cfg = LlamaConfig.tiny(max_seq_len=32)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                    cfg.vocab_size)
+        return cfg, dataclasses.replace(cfg, loss_chunks=4), params, tokens
+
+    def test_matches_dense_loss(self):
+        cfg, cfg_c, params, tokens = self._setup()
+        dense = llama_loss(params, tokens, cfg)
+        chunked = llama_loss(params, tokens, cfg_c)
+        np.testing.assert_allclose(float(dense), float(chunked), rtol=2e-5)
+
+    def test_grads_match_dense(self):
+        cfg, cfg_c, params, tokens = self._setup()
+        gd = jax.grad(lambda p: llama_loss(p, tokens, cfg))(params)
+        gc = jax.grad(lambda p: llama_loss(p, tokens, cfg_c))(params)
+        for a, b, name in ((gd["lm_head"], gc["lm_head"], "lm_head"),
+                           (gd["embed"], gc["embed"], "embed"),
+                           (gd["layers"]["wq"], gc["layers"]["wq"], "wq")):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-3, err_msg=name)
+
+    def test_sharded_matches(self):
+        from jax.sharding import NamedSharding
+
+        from kubeflow_controller_tpu.models.llama import llama_param_pspecs
+        from kubeflow_controller_tpu.parallel import MeshSpec, build_mesh
+
+        cfg, cfg_c, params, tokens = self._setup()
+        dense = llama_loss(params, tokens, cfg)
+        mesh = build_mesh(MeshSpec(dp=2, tp=2, fsdp=2))
+        sharded = jax.tree.map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            params, llama_param_pspecs(cfg))
+        with jax.set_mesh(mesh):
+            out = jax.jit(lambda p, t: llama_loss(p, t, cfg_c, mesh=mesh))(
+                sharded, tokens)
+        np.testing.assert_allclose(float(out), float(dense), rtol=5e-5)
+
+    def test_indivisible_seq_raises(self):
+        import dataclasses
+
+        cfg, _, params, tokens = self._setup()
+        bad = dataclasses.replace(cfg, loss_chunks=5)  # 32 % 5 != 0
+        with pytest.raises(ValueError):
+            llama_loss(params, tokens, bad)

@@ -5,7 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from kubeflow_controller_tpu.ops import flash_attention, fused_rmsnorm
+from kubeflow_controller_tpu.ops import flash_attention
 from kubeflow_controller_tpu.parallel.ring import attention_reference
 
 
@@ -73,19 +73,3 @@ class TestFlashAttention:
             lambda q, k, v: jnp.mean(attention_reference(q, k, v, causal=True) ** 2)
         )(q, k, v)
         np.testing.assert_allclose(np.asarray(g), np.asarray(ref), atol=5e-5, rtol=5e-5)
-
-
-class TestFusedRMSNorm:
-    def test_matches_oracle(self):
-        x = jax.random.normal(jax.random.PRNGKey(0), (4, 32, 64))
-        scale = jax.random.normal(jax.random.PRNGKey(1), (64,)) + 1.0
-        out = fused_rmsnorm(x, scale, eps=1e-5)
-        xf = x.astype(jnp.float32)
-        ref = xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + 1e-5) * scale
-        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
-
-    def test_ragged_rows_fall_back_to_single_block(self):
-        x = jax.random.normal(jax.random.PRNGKey(2), (3, 7, 16))
-        scale = jnp.ones((16,))
-        out = fused_rmsnorm(x, scale, block_rows=4)
-        assert out.shape == x.shape

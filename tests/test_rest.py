@@ -367,3 +367,48 @@ class TestCLITwoProcess:
                 srv.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 srv.kill()
+
+
+class TestCLIGetDescribe:
+    def test_get_and_describe_over_rest(self, server):
+        from kubeflow_controller_tpu.cli.main import main as cli_main
+
+        srv, url = server
+        substrate = Cluster(store=srv.store)
+        kubelet = FakeKubelet(substrate, policy=PhasePolicy(run_s=0.05))
+        rest = RestCluster(Kubeconfig(server=url))
+        ctrl = Controller(rest, resync_period_s=0.5)
+        kubelet.start()
+        ctrl.run(threadiness=2)
+        try:
+            rest.tfjobs.create(
+                mk_job("cli-job", (ReplicaType.WORKER, 2)))
+            wait_for(lambda: rest.tfjobs.get("default", "cli-job").status.phase
+                     == TFJobPhase.SUCCEEDED)
+        finally:
+            ctrl.stop()
+            kubelet.stop()
+
+        import contextlib
+        import io
+
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = cli_main(["-master", url, "get"])
+        assert rc == 0
+        assert "cli-job" in out.getvalue()
+        assert "Succeeded" in out.getvalue()
+
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = cli_main(["-master", url, "describe", "cli-job"])
+        assert rc == 0
+        text = out.getvalue()
+        assert "Phase:     Succeeded" in text
+        assert "SuccessfulCreate" in text  # events came from the API
+
+    def test_describe_missing_job(self, server):
+        from kubeflow_controller_tpu.cli.main import main as cli_main
+
+        _, url = server
+        assert cli_main(["-master", url, "describe", "nope"]) == 1
