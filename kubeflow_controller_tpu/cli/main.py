@@ -135,12 +135,17 @@ def cmd_serve(args) -> int:
     return 0
 
 
-def _rest_cluster_or_die(args):
+def _rest_cluster_or_die(args, probe: bool = True):
+    """Build the REST cluster; with ``probe`` a cheap connectivity check
+    fails fast (used by `run`, whose informers would otherwise block).
+    Read-only commands skip the probe — their first real request plays
+    that role — and handle APIError themselves."""
     from ..cluster.rest import KubeconfigError, RestCluster
 
     try:
         cluster = RestCluster.from_flags(args.kubeconfig, args.master)
-        cluster.tfjobs.list()  # connectivity probe
+        if probe:
+            cluster.tfjobs.list()
         return cluster
     except (KubeconfigError, OSError, APIError) as e:
         print(f"error talking to API server: {e}", file=sys.stderr)
@@ -149,10 +154,14 @@ def _rest_cluster_or_die(args):
 
 def cmd_get(args) -> int:
     """kubectl-get analog: one line per TFJob (REST mode only)."""
-    cluster = _rest_cluster_or_die(args)
+    cluster = _rest_cluster_or_die(args, probe=False)
     if cluster is None:
         return 2
-    jobs = cluster.tfjobs.list(args.namespace or None)
+    try:
+        jobs = cluster.tfjobs.list(args.namespace or None)
+    except APIError as e:
+        print(f"error talking to API server: {e}", file=sys.stderr)
+        return 2
     if not jobs:
         print("No resources found.")
         return 0
@@ -171,7 +180,7 @@ def cmd_describe(args) -> int:
     and the job's Event objects (REST mode only)."""
     from ..cluster.store import NotFound
 
-    cluster = _rest_cluster_or_die(args)
+    cluster = _rest_cluster_or_die(args, probe=False)
     if cluster is None:
         return 2
     ns = args.namespace or "default"
@@ -180,6 +189,9 @@ def cmd_describe(args) -> int:
     except NotFound:
         print(f"tfjob {ns}/{args.name} not found", file=sys.stderr)
         return 1
+    except APIError as e:
+        print(f"error talking to API server: {e}", file=sys.stderr)
+        return 2
     print(f"Name:      {j.metadata.name}")
     print(f"Namespace: {j.metadata.namespace}")
     print(f"RuntimeID: {j.spec.runtime_id}")
@@ -192,8 +204,11 @@ def cmd_describe(args) -> int:
         print(f"Replicas:  {rs.type.value}: state={rs.state.value} {hist}")
         for pn in rs.pod_names:
             print(f"           pod {pn}")
-    events = [e for e in cluster.events.list(ns)
-              if e.involved_object.name == args.name]
+    try:
+        events = [e for e in cluster.events.list(ns)
+                  if e.involved_object.name == args.name]
+    except APIError:
+        events = []  # server lost mid-describe: show what we have
     if events:
         print("Events:")
         for e in sorted(events, key=lambda e: e.first_timestamp):

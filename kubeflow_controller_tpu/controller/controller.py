@@ -80,7 +80,9 @@ class Controller:
         self.cluster = cluster
         self.inventory = inventory
         # Default recorder writes real Event API objects (kubectl-describe
-        # visibility) in addition to the in-memory/log stream.
+        # visibility) in addition to the in-memory/log stream.  We only own
+        # (and thus close) a recorder we created.
+        self._owns_recorder = recorder is None
         self.recorder = recorder or EventRecorder(
             sink=getattr(cluster, "events", None))
         self.helper = Helper(cluster, self.recorder)
@@ -136,6 +138,8 @@ class Controller:
         self.queue.shut_down()
         for inf in (self.tfjob_informer, self.pod_informer, self.service_informer):
             inf.stop()
+        if self._owns_recorder:
+            self.recorder.close()  # drain pending Event API writes
 
     def _worker(self) -> None:
         while not self._stop.is_set():

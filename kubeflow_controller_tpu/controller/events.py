@@ -61,9 +61,12 @@ class EventRecorder:
         self._sink_queue: "queue.Queue" = queue.Queue(maxsize=1024)
         self._sink_names: dict = {}  # aggregate key -> Event object name
         self._sink_created: list = []  # (namespace, name) in creation order
+        self._sink_thread = None
+        self._closed = False
         if sink is not None:
-            threading.Thread(target=self._sink_loop, name="event-sink",
-                             daemon=True).start()
+            self._sink_thread = threading.Thread(
+                target=self._sink_loop, name="event-sink", daemon=True)
+            self._sink_thread.start()
 
     def event(self, obj, event_type: str, reason: str, message: str) -> None:
         key = f"{obj.metadata.namespace}/{obj.metadata.name}"
@@ -85,7 +88,7 @@ class EventRecorder:
             log = logger.info if event_type == TYPE_NORMAL else logger.warning
             log("event component=%s kind=%s object=%s reason=%s: %s",
                 self.component, kind, key, reason, message)
-        if self._sink is not None:
+        if self._sink is not None and not self._closed:
             import queue
 
             try:
@@ -96,10 +99,26 @@ class EventRecorder:
             except queue.Full:
                 pass  # drop under pressure: audit stream is best-effort
 
+    def close(self, timeout: float = 5.0) -> None:
+        """Drain pending sink writes and stop the flusher (idempotent).
+        Without this, events recorded just before process exit would be
+        lost in the queue."""
+        if self._sink_thread is None or self._closed:
+            self._closed = True
+            return
+        self._closed = True
+        self._sink_queue.put(None)  # sentinel: flusher exits after draining
+        self._sink_thread.join(timeout=timeout)
+
     def _sink_loop(self) -> None:
         while True:
             item = self._sink_queue.get()
-            self._write_sink(*item)
+            if item is None:
+                return  # close(): everything enqueued before is drained
+            try:
+                self._write_sink(*item)
+            except Exception:  # noqa: BLE001 — the flusher must survive
+                logger.warning("event sink write failed", exc_info=True)
 
     def _write_sink(self, kind: str, ns: str, obj_name: str, uid: str,
                     key: str, event_type: str, reason: str,

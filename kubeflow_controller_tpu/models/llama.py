@@ -86,6 +86,13 @@ class LlamaConfig:
     #   "xla":   force plain attention (XLA fuses it).
     # Ring attention still takes priority when 'seq' maps to a real sp axis.
     attention: str = "auto"
+    # Sequence-parallel attention when the sp mesh axis is real:
+    #   "ring":    K/V blocks rotate by ppermute (N-1 nearest-neighbor ICI
+    #              hops overlapped with compute) — scales to large N.
+    #   "ulysses": two all-to-alls reshard seq<->heads and each device runs
+    #              full-sequence attention on its head slice — fewer, bigger
+    #              collectives; needs heads % (tp*sp) == 0.
+    sp_attention: str = "ring"
 
     @property
     def head_dim(self) -> int:
@@ -211,10 +218,11 @@ def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
 
 def _attention(q, k, v, mesh: Optional[Mesh], causal: bool, rules: ShardingRules,
                cfg: Optional[LlamaConfig] = None):
-    """Ring attention when the rule table maps 'seq' onto a real mesh axis
-    of size > 1; else the Pallas flash kernel where it wins (long T on
-    TPU); else plain attention (XLA fuses it) under whatever sharding
-    constraints are already in place."""
+    """Sequence-parallel attention (ring or Ulysses per cfg.sp_attention)
+    when the rule table maps 'seq' onto a real mesh axis of size > 1; else
+    the Pallas flash kernel where it wins (long T on TPU); else plain
+    attention (XLA fuses it) under whatever sharding constraints are
+    already in place."""
     seq_axis = rules.mesh_axes("seq")
     if (
         mesh is not None
@@ -222,6 +230,16 @@ def _attention(q, k, v, mesh: Optional[Mesh], causal: bool, rules: ShardingRules
         and seq_axis in mesh.axis_names
         and mesh.shape[seq_axis] > 1
     ):
+        if cfg is not None and cfg.sp_attention == "ulysses":
+            from ..parallel.ulysses import ulysses_attention
+
+            return ulysses_attention(
+                q, k, v, mesh,
+                causal=causal,
+                axis_name=seq_axis,
+                batch_axes=rules.mesh_axes("batch"),
+                head_axis=rules.mesh_axes("heads"),
+            )
         return ring_attention(
             q, k, v, mesh,
             causal=causal,

@@ -1,5 +1,5 @@
 """TPU-native parallelism layer: device meshes, sharding rules, collectives,
-and sequence parallelism (ring attention).
+and sequence parallelism (ring + Ulysses all-to-all attention).
 
 The reference's only distribution strategy is grpc parameter-server data
 parallelism wired by host lists (ref: pkg/tensorflow/distributed.go:130-162).
@@ -34,6 +34,7 @@ from .collectives import (
     ring_permute,
 )
 from .ring import ring_attention
+from .ulysses import ulysses_attention
 
 __all__ = [
     "AXIS_DATA",
@@ -55,4 +56,5 @@ __all__ = [
     "psum_scatter",
     "ring_permute",
     "ring_attention",
+    "ulysses_attention",
 ]

@@ -1,0 +1,90 @@
+"""Ulysses (all-to-all) sequence parallelism: the head-scatter alternative
+to ring attention for long contexts.
+
+Where ring attention keeps queries resident and rotates K/V blocks around
+the ``sp`` ring (N-1 nearest-neighbor hops, parallel/ring.py), Ulysses does
+TWO all-to-alls: the sequence-sharded [B, T/n, H, D] tensors are exchanged
+into head-sharded [B, T, H/n, D] layout, every device runs ordinary FULL
+-sequence attention over its head slice, and one more all-to-all restores
+the sequence sharding.  Trade-offs (DeepSpeed-Ulysses vs ring):
+
+- communication: 2 all-to-alls of activation size, independent of N, vs
+  N-1 K/V rotations — Ulysses wins when the interconnect does fast
+  all-to-all (small N on one ICI domain); ring wins at large N where its
+  per-hop traffic overlaps compute.
+- memory: each device materializes full-T attention for H/n heads —
+  O(T * T) score rows locally unless the inner attention is flash; ring
+  stays O(T_local^2) per block.
+- constraint: heads (after any tp split) must divide by the sp size.
+
+The reference has neither (SURVEY.md §2.4: SP absent upstream); both make
+the declared ``sp`` axis real.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from .mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQUENCE, AXIS_TENSOR
+from .ring import attention_reference
+
+
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float,
+                   inner: Callable):
+    """Per-device body under shard_map; q/k/v are [B, T/n, H_local, D]."""
+    n = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(
+            f"Ulysses needs heads ({h} after tp split) divisible by the "
+            f"sp axis size ({n})")
+    # seq-sharded -> head-sharded: split heads n ways, gather full seq.
+    a2a = functools.partial(lax.all_to_all, axis_name=axis_name,
+                            split_axis=2, concat_axis=1, tiled=True)
+    qg, kg, vg = a2a(q), a2a(k), a2a(v)       # [B, T, H/n, D]
+    out = inner(qg, kg, vg, causal=causal, scale=scale)
+    # head-sharded -> seq-sharded: split seq, gather heads back.
+    return lax.all_to_all(out, axis_name=axis_name, split_axis=1,
+                          concat_axis=2, tiled=True)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    axis_name: str = AXIS_SEQUENCE,
+    batch_axes=(AXIS_DATA, AXIS_FSDP),
+    head_axis: str = AXIS_TENSOR,
+    inner: Optional[Callable] = None,
+) -> jax.Array:
+    """Exact attention with q/k/v of global shape [B, T, H, D], T sharded
+    over ``axis_name`` — same contract as ring_attention, different
+    collective pattern.  ``inner`` is the full-sequence attention run on
+    each head slice (default: the f32 reference; pass a flash wrapper for
+    O(T) memory)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if inner is None:
+        inner = attention_reference
+    spec = P(batch_axes, axis_name, head_axis, None)
+    fn = shard_map(
+        functools.partial(
+            _ulysses_local, axis_name=axis_name, causal=causal, scale=scale,
+            inner=inner,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -30,13 +30,25 @@ class CheckpointManager:
             options=ocp.CheckpointManagerOptions(max_to_keep=keep, create=True),
         )
 
-    def save(self, step: int, params: Any, opt_state: Any) -> None:
+    def save(self, step: int, params: Any, opt_state: Any,
+             wait: bool = True) -> None:
+        """Durable by default (returns after the write is finalized).  Pass
+        wait=False for in-training-loop saves: Orbax serializes in the
+        background so the next step overlaps the write; a step only becomes
+        visible to latest_step()/restore() once finalized, so resume safety
+        is unaffected — but call wait() (or a final wait=True save) before
+        declaring success, or a background write failure goes unnoticed."""
         import orbax.checkpoint as ocp
 
         self._mgr.save(
             step,
             args=ocp.args.StandardSave({"params": params, "opt_state": opt_state}),
         )
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def wait(self) -> None:
+        """Block until every in-flight async save is durable."""
         self._mgr.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:

@@ -30,7 +30,14 @@ def main(argv=None) -> int:
     p.add_argument("--fsdp", type=int, default=-1)
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--sp-attention", choices=["ring", "ulysses"], default="ring",
+                   help="sequence-parallel attention schedule when --sp > 1")
     p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--profile-dir", default="",
+                   help="write a jax.profiler trace of the training loop "
+                        "here (view with tensorboard/xprof); defaults to "
+                        "LOG_DIR/trace when LOG_DIR is plumbed and this "
+                        "flag is 'auto'")
     p.add_argument("--platform", default=os.environ.get("WORKLOAD_PLATFORM", ""))
     args = p.parse_args(argv)
 
@@ -61,6 +68,10 @@ def main(argv=None) -> int:
     cfg = LlamaConfig.llama2_7b() if args.preset == "llama2-7b" else LlamaConfig.tiny(
         max_seq_len=args.seq_len
     )
+    if args.sp_attention != cfg.sp_attention:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, sp_attention=args.sp_attention)
     mesh = build_mesh(MeshSpec(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp))
     pspecs = llama_param_pspecs(cfg)
 
@@ -105,14 +116,25 @@ def main(argv=None) -> int:
         tokens_all = d.synthetic_tokens(
             jax.random.PRNGKey(1), max(64, 2 * bs), args.seq_len, cfg.vocab_size
         )
+        profile_dir = args.profile_dir
+        if profile_dir == "auto":
+            profile_dir = os.path.join(rt.log_dir, "trace") if rt.log_dir else ""
+        if profile_dir:
+            jax.profiler.start_trace(profile_dir)
         start = time.time()
         loss = None
-        for i in range(start_step, start_step + args.steps):
-            lo = (i * bs) % max(1, tokens_all.shape[0] - bs + 1)
-            tokens = jax.device_put(tokens_all[lo:lo + bs], batch_sharding)
-            params, opt_state, loss = step_fn(params, opt_state, tokens)
-            if ckpt and args.checkpoint_every and (i + 1) % args.checkpoint_every == 0:
-                ckpt.save(i + 1, params, opt_state)
+        try:
+            for i in range(start_step, start_step + args.steps):
+                lo = (i * bs) % max(1, tokens_all.shape[0] - bs + 1)
+                tokens = jax.device_put(tokens_all[lo:lo + bs], batch_sharding)
+                params, opt_state, loss = step_fn(params, opt_state, tokens)
+                if ckpt and args.checkpoint_every and (i + 1) % args.checkpoint_every == 0:
+                    ckpt.save(i + 1, params, opt_state, wait=False)  # overlap
+        finally:
+            if profile_dir:
+                jax.block_until_ready(loss)
+                jax.profiler.stop_trace()
+                print(f"Profile trace written to {profile_dir}")
         loss = float(loss) if loss is not None else float("nan")
         elapsed = time.time() - start
 
@@ -122,6 +144,7 @@ def main(argv=None) -> int:
     print(f"Training elapsed time: {elapsed:f} s")
     print(f"Final loss: {loss:f}; throughput: {tokens_per_s:.0f} tokens/s")
     if ckpt:
+        # Final save is a durability barrier (in-loop saves were async).
         ckpt.save(start_step + args.steps, params, opt_state)
         print(f"Checkpoint saved to {rt.model_dir}")
     return 0
