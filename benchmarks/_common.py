@@ -1,0 +1,23 @@
+"""Shared benchmark plumbing."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+
+def run_bench_subprocess(script_path: str, args_list) -> dict:
+    """One measurement per process: an OOMing config must not poison the
+    TPU client for subsequent grid points.  Scrapes the last JSON line the
+    child printed; on failure returns {"error": stderr tail}."""
+    out = subprocess.run(
+        [sys.executable, script_path, *map(str, args_list)],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(script_path))),
+    )
+    for line in reversed(out.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)
+    return {"error": (out.stderr or "no output")[-400:].strip()}

@@ -1,0 +1,126 @@
+"""KV-cache decode throughput on real TPU — the inference counterpart of
+llama_tpu.py.
+
+The whole generate loop (prefill + per-token decode) is ONE jitted scan
+(models/generate.py), so the relay-safe timing recipe applies: time the
+second call of the jitted function and read the output back as the
+completion barrier (docs/PERF.md "Measurement caveats").
+
+Decode is memory-bandwidth-bound (every step streams all params + the KV
+prefix per token), so the interesting numbers are ms/token at B=1
+(latency) and tokens/s at larger B (throughput).
+
+    python benchmarks/decode_tpu.py --sweep --out benchmarks/decode_tpu_v5e.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def run(batch: int, prompt_len: int, new_tokens: int, dim: int, layers: int,
+        heads: int, intermediate: int) -> dict:
+    import jax
+
+    from kubeflow_controller_tpu.models import LlamaConfig, llama_init
+    from kubeflow_controller_tpu.models.generate import generate
+
+    cfg = LlamaConfig(
+        vocab_size=32000, dim=dim, n_layers=layers, n_heads=heads,
+        n_kv_heads=heads, intermediate=intermediate,
+        max_seq_len=prompt_len + new_tokens,
+        dtype="bfloat16", param_dtype="bfloat16", remat=False,
+    )
+    params = jax.jit(lambda k: llama_init(k, cfg))(jax.random.PRNGKey(0))
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size)
+
+    gen = jax.jit(lambda p, t: generate(p, t, cfg, max_new_tokens=new_tokens))
+    # block_until_ready is NOT a trustworthy barrier through the tunneled
+    # backend (async futures complete "instantly"); a host VALUE read is
+    # (docs/PERF.md "Measurement caveats").
+    out = gen(params, prompt)
+    int(out.sum())  # compile + complete
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        out = gen(params, prompt)
+        int(out.sum())  # host read = completion barrier
+        best = min(best, time.time() - t0)
+    total_new = batch * new_tokens
+    # Rough split: prefill processes B*prompt_len tokens in parallel; the
+    # decode scan dominates wall time at these sizes, so report end-to-end
+    # figures plus the per-token rate over the whole call.
+    return {
+        "params_m": round(n_params / 1e6, 1),
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "total_s": round(best, 3),
+        "ms_per_token_per_seq": round(best / new_tokens * 1e3, 2),
+        "gen_tokens_per_s": round(total_new / best),
+        "check_shape": list(out.shape),
+    }
+
+
+def run_subprocess(args_list) -> dict:
+    from benchmarks._common import run_bench_subprocess
+
+    return run_bench_subprocess(os.path.abspath(__file__), args_list)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--new-tokens", type=int, default=128)
+    p.add_argument("--dim", type=int, default=2048)
+    p.add_argument("--layers", type=int, default=16)
+    p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--intermediate", type=int, default=5632)
+    p.add_argument("--sweep", action="store_true")
+    p.add_argument("--out", default="benchmarks/decode_tpu_v5e.json")
+    args = p.parse_args()
+    shape = [
+        "--dim", args.dim, "--layers", args.layers, "--heads", args.heads,
+        "--intermediate", args.intermediate,
+    ]
+    if args.sweep:
+        grid = [dict(batch=1), dict(batch=8), dict(batch=32)]
+        results = []
+        for g in grid:
+            r = run_subprocess([
+                "--batch", g["batch"], "--prompt-len", args.prompt_len,
+                "--new-tokens", args.new_tokens, *shape])
+            r.setdefault("batch", g["batch"])
+            results.append(r)
+            print(json.dumps(r), flush=True)
+        ok = [r for r in results if "gen_tokens_per_s" in r]
+        artifact = {
+            "bench": "llama_decode_single_chip",
+            "model": (f"Llama (dim {args.dim}, L{args.layers}, H{args.heads}, "
+                      f"inter {args.intermediate}), bf16, KV-cache greedy decode"),
+            "prompt_len": args.prompt_len,
+            "new_tokens": args.new_tokens,
+            "results": results,
+            "best_throughput": max(ok, key=lambda r: r["gen_tokens_per_s"]) if ok else None,
+        }
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(json.dumps({"artifact": args.out,
+                          "best": artifact["best_throughput"]}))
+        return 0 if ok else 1
+    out = run(args.batch, args.prompt_len, args.new_tokens, args.dim,
+              args.layers, args.heads, args.intermediate)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    sys.exit(main())

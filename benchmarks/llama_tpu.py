@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -95,20 +96,9 @@ def run(batch: int, seq: int, steps: int, dim: int, layers: int, heads: int,
 
 
 def run_subprocess(args_list) -> dict:
-    """One measurement per process: an OOMing config must not poison the
-    TPU client for subsequent grid points."""
-    import os
-    import subprocess
+    from benchmarks._common import run_bench_subprocess
 
-    out = subprocess.run(
-        [sys.executable, __file__, *map(str, args_list)],
-        capture_output=True, text=True,
-        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    )
-    for line in reversed(out.stdout.strip().splitlines()):
-        if line.startswith("{"):
-            return json.loads(line)
-    return {"error": (out.stderr or "no output")[-400:].strip()}
+    return run_bench_subprocess(os.path.abspath(__file__), args_list)
 
 
 def sweep(steps: int, out_path: str, peak: float, shape: dict) -> int:

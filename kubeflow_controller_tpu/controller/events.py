@@ -103,11 +103,19 @@ class EventRecorder:
         """Drain pending sink writes and stop the flusher (idempotent).
         Without this, events recorded just before process exit would be
         lost in the queue."""
+        import queue
+
         if self._sink_thread is None or self._closed:
             self._closed = True
             return
         self._closed = True
-        self._sink_queue.put(None)  # sentinel: flusher exits after draining
+        try:
+            # Never block shutdown: if the queue is full (flusher wedged on
+            # a hung API server), drop the sentinel — the daemon thread dies
+            # with the process and join below just times out.
+            self._sink_queue.put_nowait(None)
+        except queue.Full:
+            pass
         self._sink_thread.join(timeout=timeout)
 
     def _sink_loop(self) -> None:

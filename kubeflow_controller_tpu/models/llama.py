@@ -231,7 +231,23 @@ def _attention(q, k, v, mesh: Optional[Mesh], causal: bool, rules: ShardingRules
         and mesh.shape[seq_axis] > 1
     ):
         if cfg is not None and cfg.sp_attention == "ulysses":
+            from ..parallel.ring import attention_reference as _ref
             from ..parallel.ulysses import ulysses_attention
+
+            def inner(qg, kg, vg, *, causal, scale):
+                # Inside the shard_map body each device sees the FULL
+                # sequence for its head slice: use the flash kernel in its
+                # win region or the O(T^2) reference would OOM at exactly
+                # the long contexts Ulysses exists for.
+                t = qg.shape[1]
+                block = min(1024, t)
+                if (jax.default_backend() == "tpu" and t >= 1024
+                        and t % block == 0):
+                    from ..ops.attention import flash_attention
+
+                    return flash_attention(qg, kg, vg, causal=causal,
+                                           block_q=block, block_k=block)
+                return _ref(qg, kg, vg, causal=causal, scale=scale)
 
             return ulysses_attention(
                 q, k, v, mesh,
@@ -239,6 +255,7 @@ def _attention(q, k, v, mesh: Optional[Mesh], causal: bool, rules: ShardingRules
                 axis_name=seq_axis,
                 batch_axes=rules.mesh_axes("batch"),
                 head_axis=rules.mesh_axes("heads"),
+                inner=inner,
             )
         return ring_attention(
             q, k, v, mesh,

@@ -144,8 +144,14 @@ def main(argv=None) -> int:
     print(f"Training elapsed time: {elapsed:f} s")
     print(f"Final loss: {loss:f}; throughput: {tokens_per_s:.0f} tokens/s")
     if ckpt:
-        # Final save is a durability barrier (in-loop saves were async).
-        ckpt.save(start_step + args.steps, params, opt_state)
+        # Durability barrier: if the in-loop (async) save already wrote the
+        # final step, just wait for it — re-saving the same step raises
+        # StepAlreadyExistsError in Orbax.
+        final = start_step + args.steps
+        if args.checkpoint_every and final % args.checkpoint_every == 0:
+            ckpt.wait()
+        else:
+            ckpt.save(final, params, opt_state)
         print(f"Checkpoint saved to {rt.model_dir}")
     return 0
 
