@@ -110,12 +110,14 @@ class EventRecorder:
             return
         self._closed = True
         try:
-            # Never block shutdown: if the queue is full (flusher wedged on
-            # a hung API server), drop the sentinel — the daemon thread dies
-            # with the process and join below just times out.
-            self._sink_queue.put_nowait(None)
+            # Bounded block: a healthy-but-backlogged flusher frees a slot
+            # within the timeout (preserving the drain guarantee); a flusher
+            # wedged on a hung API server does not, and we drop the sentinel
+            # rather than hang shutdown — the daemon thread dies with the
+            # process.
+            self._sink_queue.put(None, timeout=timeout)
         except queue.Full:
-            pass
+            return
         self._sink_thread.join(timeout=timeout)
 
     def _sink_loop(self) -> None:

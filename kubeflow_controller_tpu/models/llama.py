@@ -238,14 +238,21 @@ def _attention(q, k, v, mesh: Optional[Mesh], causal: bool, rules: ShardingRules
                 # Inside the shard_map body each device sees the FULL
                 # sequence for its head slice: use the flash kernel in its
                 # win region or the O(T^2) reference would OOM at exactly
-                # the long contexts Ulysses exists for.
+                # the long contexts Ulysses exists for.  Honors
+                # cfg.attention the way _flash_path does: "xla" forces the
+                # plain path, "flash" forces the kernel, "auto" gates on
+                # TPU + T >= 1024.
                 t = qg.shape[1]
                 block = min(1024, t)
-                if (jax.default_backend() == "tpu" and t >= 1024
-                        and t % block == 0):
+                use_flash = (cfg.attention == "flash"
+                             or (cfg.attention == "auto"
+                                 and jax.default_backend() == "tpu"
+                                 and t >= 1024))
+                if use_flash and t % block == 0:
                     from ..ops.attention import flash_attention
 
                     return flash_attention(qg, kg, vg, causal=causal,
+                                           scale=scale,
                                            block_q=block, block_k=block)
                 return _ref(qg, kg, vg, causal=causal, scale=scale)
 
