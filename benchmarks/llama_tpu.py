@@ -35,7 +35,7 @@ V5E_BF16_PEAK_TFLOPS = 197.0
 
 def run(batch: int, seq: int, steps: int, dim: int, layers: int, heads: int,
         intermediate: int, policy: str, peak_tflops: float,
-        loss_chunks: int = 0) -> dict:
+        loss_chunks: int = 0, experts: int = 0, top_k: int = 2) -> dict:
     import jax
     import optax
 
@@ -47,10 +47,18 @@ def run(batch: int, seq: int, steps: int, dim: int, layers: int, heads: int,
         n_kv_heads=heads, intermediate=intermediate, max_seq_len=seq,
         dtype="bfloat16", param_dtype="bfloat16", remat=True,
         remat_policy=policy, loss_chunks=loss_chunks,
+        n_experts=experts, moe_top_k=top_k,
     )
     mesh = build_mesh(MeshSpec(fsdp=-1))
     params = jax.jit(lambda k: llama_init(k, cfg))(jax.random.PRNGKey(0))
     n_params = sum(x.size for x in jax.tree.leaves(params))
+    # MoE: 6ND must count ACTIVATED params — each token runs top_k of E
+    # experts, so counting all expert weights inflates MFU beyond 100%.
+    n_active = n_params
+    if experts:
+        expert_params = sum(
+            params["layers"][k].size for k in ("w_gate", "w_up", "w_down"))
+        n_active = n_params - expert_params + expert_params * top_k // experts
     opt = optax.adafactor(3e-4)
     opt_state = opt.init(params)
     toks = jax.random.randint(
@@ -82,16 +90,17 @@ def run(batch: int, seq: int, steps: int, dim: int, layers: int, heads: int,
         loss_val = float(loss)  # host read == completion barrier
         dt = (time.time() - t0) / steps
 
-    tflops = 6 * n_params * batch * seq / dt / 1e12
+    tflops = 6 * n_active * batch * seq / dt / 1e12
     return {
         "params_m": round(n_params / 1e6, 1),
+        "active_params_m": round(n_active / 1e6, 1),
         "ms_per_step": round(dt * 1e3, 1),
         "tokens_per_s": round(batch * seq / dt),
         "model_tflops": round(tflops, 1),
         "mfu_pct": round(100 * tflops / peak_tflops, 1),
         "loss": round(loss_val, 3),
         "batch": batch, "seq": seq, "remat_policy": policy,
-        "loss_chunks": loss_chunks,
+        "loss_chunks": loss_chunks, "experts": experts,
     }
 
 
@@ -117,6 +126,10 @@ def sweep(steps: int, out_path: str, peak: float, shape: dict) -> int:
         dict(batch=4, seq=4096, policy="gateup"),
         dict(batch=4, seq=4096, policy="gateup", chunks=16),
         dict(batch=4, seq=4096, policy="full"),
+        # Long-context: possible at all only because flash attention never
+        # materializes the T^2 scores (XLA attention fails to compile at
+        # T=8192 on one chip — docs/PERF.md kernel table).
+        dict(batch=2, seq=8192, policy="gateup"),
     ]
     results = []
     for g in grid:
@@ -167,6 +180,8 @@ def main() -> int:
                    choices=["full", "dots", "ffn", "gateup", "gateup_attn"])
     p.add_argument("--loss-chunks", type=int, default=0,
                    help="chunked cross-entropy (0 = dense logits)")
+    p.add_argument("--experts", type=int, default=0, help="MoE experts (0=dense)")
+    p.add_argument("--top-k", type=int, default=2)
     p.add_argument("--peak-tflops", type=float, default=V5E_BF16_PEAK_TFLOPS)
     p.add_argument("--sweep", action="store_true",
                    help="run the config grid and write the JSON artifact")
@@ -178,7 +193,8 @@ def main() -> int:
                           intermediate=args.intermediate))
     out = run(args.batch, args.seq, args.steps, args.dim, args.layers,
               args.heads, args.intermediate, args.remat_policy,
-              args.peak_tflops, loss_chunks=args.loss_chunks)
+              args.peak_tflops, loss_chunks=args.loss_chunks,
+              experts=args.experts, top_k=args.top_k)
     print(json.dumps(out))
     return 0
 

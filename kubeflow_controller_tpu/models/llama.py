@@ -59,6 +59,9 @@ class LlamaConfig:
     # coefficient (Switch uses 1e-2) and ST-MoE router z-loss coefficient.
     moe_aux_coef: float = 1e-2
     moe_z_coef: float = 1e-3
+    # Routing implementation: "einsum" (k-folded one-hot; TPU winner) or
+    # "scatter" (cheap-scatter backends) — see moe.moe_ffn_stats.
+    moe_dispatch: str = "einsum"
     # Remat policy — the FLOPs/HBM dial for the backward pass:
     #   "full":    save only layer boundaries; recompute everything (~8ND
     #              executed per step).  Minimum memory.
@@ -416,7 +419,7 @@ def ffn_block_stats(h: jax.Array, lp, cfg: LlamaConfig,
     return moe_ffn_stats(
         h, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
         top_k=cfg.moe_top_k, capacity_factor=cfg.capacity_factor,
-        rules=rules,
+        rules=rules, dispatch=cfg.moe_dispatch,
     )
 
 

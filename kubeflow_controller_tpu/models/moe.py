@@ -41,11 +41,13 @@ def moe_ffn(
     capacity_factor: float = 1.25,
     capacity: int = 0,
     rules: ShardingRules = DEFAULT_RULES,
+    dispatch: str = "einsum",
 ) -> jax.Array:
     """Like :func:`moe_ffn_stats` but returns only the output."""
     y, _ = moe_ffn_stats(
         x, router_w, w_gate, w_up, w_down, top_k=top_k,
-        capacity_factor=capacity_factor, capacity=capacity, rules=rules)
+        capacity_factor=capacity_factor, capacity=capacity, rules=rules,
+        dispatch=dispatch)
     return y
 
 
@@ -60,6 +62,7 @@ def moe_ffn_stats(
     capacity_factor: float = 1.25,
     capacity: int = 0,
     rules: ShardingRules = DEFAULT_RULES,
+    dispatch: str = "einsum",
 ):
     """x [B, T, D]; router_w [D, E]; w_gate/w_up [E, D, F]; w_down [E, F, D].
 
@@ -83,6 +86,19 @@ def moe_ffn_stats(
       saturates (and bf16 overflows).
     - ``overflow_frac`` — fraction of routing slots dropped by the capacity
       limit (not differentiable; a monitoring signal for capacity_factor).
+
+    ``dispatch`` selects the routing implementation — both compute the
+    SAME function (same capacity/drop semantics, tested equal):
+
+    - ``"einsum"`` (default): one-hot dispatch/combine tensors [B,T,E,C]
+      with the k axis folded away before the one-hot (a token routes to at
+      most one slot per expert) — all MXU-shaped dense math, the measured
+      winner on TPU.
+    - ``"scatter"``: tokens scatter-add into the expert buffers and gather
+      back by slot index — O(B·T·k·D) data movement on paper, but TPU
+      scatters serialize: measured 15% SLOWER than the einsum path at
+      653M/E8 on v5e (docs/PERF.md).  Kept for backends where scatters
+      are cheap.
     """
     import math
 
@@ -102,20 +118,56 @@ def moe_ffn_stats(
     pos_flat = jnp.cumsum(flat, axis=1) - flat        # exclusive cumsum
     pos = pos_flat.reshape(B, T, top_k, E)
     keep = (pos < C) * assign                         # drop overflow
-    # Dispatch/combine tensors: [B, T, E, C].
-    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)  # [B,T,k,E,C]
-    dispatch = jnp.einsum("btke,btkec->btec", keep, pos_oh)
-    combine = jnp.einsum("btk,btke,btkec->btec", probs, keep, pos_oh)
 
-    # Expert buffers [B, E, C, D], expert dim sharded over ep.
-    xe = jnp.einsum("btec,btd->becd", dispatch.astype(dtype), x)
-    xe = with_logical_constraint(xe, ("batch", "expert", None, None), rules)
-    gate = jnp.einsum("becd,edf->becf", xe, w_gate.astype(dtype))
-    up = jnp.einsum("becd,edf->becf", xe, w_up.astype(dtype))
-    h = jax.nn.silu(gate) * up
-    ye = jnp.einsum("becf,efd->becd", h, w_down.astype(dtype))
-    ye = with_logical_constraint(ye, ("batch", "expert", None, None), rules)
-    y = jnp.einsum("btec,becd->btd", combine.astype(dtype), ye)
+    def expert_ffn(xe):
+        """xe [B, E, C, D] -> [B, E, C, D], expert dim sharded over ep."""
+        xe = with_logical_constraint(xe, ("batch", "expert", None, None), rules)
+        gate = jnp.einsum("becd,edf->becf", xe, w_gate.astype(dtype))
+        up = jnp.einsum("becd,edf->becf", xe, w_up.astype(dtype))
+        h = jax.nn.silu(gate) * up
+        ye = jnp.einsum("becf,efd->becd", h, w_down.astype(dtype))
+        return with_logical_constraint(ye, ("batch", "expert", None, None), rules)
+
+    if dispatch == "scatter":
+        S = T * top_k
+        # Per routing slot: its expert, its buffer position, kept or not.
+        slot_e = idx.reshape(B, S)                                  # [B,S]
+        slot_pos = jnp.take_along_axis(
+            pos_flat, slot_e[..., None], axis=-1)[..., 0].astype(jnp.int32)
+        slot_keep = slot_pos < C                                    # [B,S]
+        # Flat buffer target e*C + pos; dropped slots aim out of bounds
+        # and are discarded by scatter mode="drop".
+        target = jnp.where(slot_keep, slot_e * C + slot_pos, E * C)
+        xtok = jnp.repeat(x, top_k, axis=1)                         # [B,S,D]
+        # unique_indices is NOT claimed: kept targets are unique, but every
+        # dropped slot shares the same out-of-bounds index.
+        xe = jnp.zeros((B, E * C, D), dtype).at[
+            jnp.arange(B)[:, None], target
+        ].add(xtok, mode="drop")
+        ye = expert_ffn(xe.reshape(B, E, C, D)).reshape(B, E * C, D)
+        # Gather each slot's result back and weight by its router prob.
+        y_slot = jnp.take_along_axis(
+            ye, jnp.minimum(target, E * C - 1)[..., None], axis=1)
+        y_slot = jnp.where(slot_keep[..., None], y_slot, 0)
+        y = jnp.einsum(
+            "btk,btkd->btd", probs.astype(dtype),
+            y_slot.reshape(B, T, top_k, D))
+    elif dispatch == "einsum":
+        # A token routes to at most ONE slot per expert (top-k experts are
+        # distinct), so the k axis folds away BEFORE the one-hot: the
+        # [B,T,k,E,C] intermediate of the textbook GShard formulation never
+        # materializes (k-fold less one-hot traffic).
+        keep_e = jnp.sum(keep, axis=2)                          # [B,T,E] 0/1
+        pos_e = jnp.sum(keep * pos, axis=2).astype(jnp.int32)   # [B,T,E]
+        prob_e = jnp.einsum("btk,btke->bte", probs, keep)       # [B,T,E]
+        pos_oh = jax.nn.one_hot(pos_e, C, dtype=jnp.float32)    # [B,T,E,C]
+        disp = keep_e[..., None] * pos_oh
+        combine = prob_e[..., None] * pos_oh
+        xe = jnp.einsum("btec,btd->becd", disp.astype(dtype), x)
+        ye = expert_ffn(xe)
+        y = jnp.einsum("btec,becd->btd", combine.astype(dtype), ye)
+    else:
+        raise ValueError(f"unknown dispatch {dispatch!r}")
 
     # Router statistics.  f_e: hard assignment fraction over all (token,
     # slot) pairs (stop-gradient by construction — one_hot of argmax);

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from kubeflow_controller_tpu.models import LlamaConfig, llama_init, llama_loss, llama_forward
 from kubeflow_controller_tpu.models.generate import forward_with_cache, init_cache
@@ -193,3 +194,47 @@ class TestMoELlama:
                 sharded, tokens)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-4, rtol=2e-4)
+
+
+class TestDispatchModes:
+    """scatter and (k-folded) einsum dispatch compute the same function —
+    including under capacity overflow — so the TPU-measured default can
+    change per backend without touching semantics."""
+
+    @pytest.mark.parametrize("cap", [100.0, 0.5])
+    def test_scatter_matches_einsum(self, cap):
+        from kubeflow_controller_tpu.models.moe import moe_ffn_stats
+
+        router, wg, wu, wd = _weights(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16))
+        ye, se = moe_ffn_stats(x, router, wg, wu, wd, top_k=2,
+                               capacity_factor=cap, dispatch="einsum")
+        ys, ss = moe_ffn_stats(x, router, wg, wu, wd, top_k=2,
+                               capacity_factor=cap, dispatch="scatter")
+        np.testing.assert_allclose(np.asarray(ye), np.asarray(ys),
+                                   atol=1e-5, rtol=1e-5)
+        for k in se:
+            np.testing.assert_allclose(float(se[k]), float(ss[k]), rtol=1e-6)
+
+    def test_scatter_grads_match(self):
+        from kubeflow_controller_tpu.models.moe import moe_ffn_stats
+
+        router, wg, wu, wd = _weights(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16))
+
+        def loss(r, mode):
+            return jnp.sum(moe_ffn_stats(x, r, wg, wu, wd,
+                                         dispatch=mode)[0] ** 2)
+
+        ge = jax.grad(lambda r: loss(r, "einsum"))(router)
+        gs = jax.grad(lambda r: loss(r, "scatter"))(router)
+        np.testing.assert_allclose(np.asarray(ge), np.asarray(gs),
+                                   atol=1e-5, rtol=1e-4)
+
+    def test_unknown_dispatch_raises(self):
+        from kubeflow_controller_tpu.models.moe import moe_ffn_stats
+
+        router, wg, wu, wd = _weights(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 8, 16))
+        with pytest.raises(ValueError):
+            moe_ffn_stats(x, router, wg, wu, wd, dispatch="sort")
