@@ -121,8 +121,9 @@ def cmd_serve(args) -> int:
     )
     stop = setup_signal_handler()
     store = ObjectStore()
-    server = FakeAPIServer(store, token=args.token, port=args.port)
     _, kubelet = _build_substrate(args, Cluster(store=store))
+    server = FakeAPIServer(store, token=args.token, port=args.port,
+                           kubelet=kubelet)
     url = server.start()
     kubelet.start()
     print(f"api server listening on {url}", flush=True)
@@ -165,13 +166,17 @@ def cmd_get(args) -> int:
     if not jobs:
         print("No resources found.")
         return 0
-    print(f"{'NAMESPACE':<12} {'NAME':<32} {'PHASE':<10} REPLICAS")
+    print(f"{'NAMESPACE':<12} {'NAME':<32} {'PHASE':<12} REPLICAS")
     for j in jobs:
         kinds = ",".join(
             f"{s.tf_replica_type.value}x{s.replicas}" for s in j.spec.tf_replica_specs
         )
+        # kubectl parity: deletionTimestamp set -> Terminating (a job stays
+        # in this state until a running controller processes its finalizer).
+        phase = ("Terminating" if j.metadata.deletion_timestamp is not None
+                 else j.status.phase.value)
         print(f"{j.metadata.namespace:<12} {j.metadata.name:<32} "
-              f"{j.status.phase.value:<10} {kinds}")
+              f"{phase:<12} {kinds}")
     return 0
 
 
@@ -213,6 +218,46 @@ def cmd_describe(args) -> int:
         print("Events:")
         for e in sorted(events, key=lambda e: e.first_timestamp):
             print(f"  {e.type:<8} {e.reason:<18} x{e.count}  {e.message}")
+    return 0
+
+
+def cmd_logs(args) -> int:
+    """kubectl-logs analog: a pod's combined stdout+stderr (REST mode)."""
+    from ..cluster.store import NotFound
+
+    cluster = _rest_cluster_or_die(args, probe=False)
+    if cluster is None:
+        return 2
+    ns = args.namespace or "default"
+    try:
+        sys.stdout.write(cluster.pods.read_log(ns, args.name))
+    except NotFound as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except APIError as e:
+        print(f"error talking to API server: {e}", file=sys.stderr)
+        return 2
+    return 0
+
+
+def cmd_delete(args) -> int:
+    """kubectl-delete analog for TFJobs (REST mode); finalizer-gated
+    cleanup runs controller-side."""
+    from ..cluster.store import NotFound
+
+    cluster = _rest_cluster_or_die(args, probe=False)
+    if cluster is None:
+        return 2
+    ns = args.namespace or "default"
+    try:
+        cluster.tfjobs.delete(ns, args.name)
+    except NotFound:
+        print(f"tfjob {ns}/{args.name} not found", file=sys.stderr)
+        return 1
+    except APIError as e:
+        print(f"error talking to API server: {e}", file=sys.stderr)
+        return 2
+    print(f"tfjob \"{args.name}\" deleted")
     return 0
 
 
@@ -338,6 +383,15 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("name")
     d.add_argument("-n", "--namespace", default="default")
 
+    lg = sub.add_parser("logs", help="print a pod's combined stdout+stderr "
+                                     "(REST mode: pass -master)")
+    lg.add_argument("name")
+    lg.add_argument("-n", "--namespace", default="default")
+
+    de = sub.add_parser("delete", help="delete a TFJob (REST mode: pass -master)")
+    de.add_argument("name")
+    de.add_argument("-n", "--namespace", default="default")
+
     r = sub.add_parser("run", help="run the controller")
     r.add_argument("--in-memory", action="store_true",
                    help="run against the in-memory cluster substrate")
@@ -384,6 +438,10 @@ def _main(argv=None) -> int:
         return cmd_get(args)
     if args.cmd == "describe":
         return cmd_describe(args)
+    if args.cmd == "logs":
+        return cmd_logs(args)
+    if args.cmd == "delete":
+        return cmd_delete(args)
     if args.cmd == "run":
         return cmd_run(args)
     build_parser().print_help()

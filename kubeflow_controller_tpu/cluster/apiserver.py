@@ -131,10 +131,13 @@ class FakeAPIServer:
     """ThreadingHTTPServer over an ObjectStore; start() returns the URL."""
 
     def __init__(self, store: Optional[ObjectStore] = None, token: str = "",
-                 port: int = 0):
+                 port: int = 0, kubelet=None):
         self.store = store or ObjectStore()
         self.token = token
         self.port = port  # 0 = ephemeral
+        # Optional node agent: enables the pod log subresource (the real
+        # API server proxies /pods/{name}/log to the kubelet the same way).
+        self.kubelet = kubelet
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -259,6 +262,17 @@ class FakeAPIServer:
             raise NotFound(f"{method} not supported on collection")
 
         ns = r.namespace or "default"
+        if method == "GET" and r.plural == "pods" and r.subresource == "log":
+            if self.kubelet is None:
+                raise NotFound("no kubelet attached: pod logs unavailable")
+            store.get(r.plural, ns, r.name)  # 404 for unknown pods
+            data = self.kubelet.logs(ns, r.name)
+            h.send_response(200)
+            h.send_header("Content-Type", "text/plain")
+            h.send_header("Content-Length", str(len(data)))
+            h.end_headers()
+            h.wfile.write(data)
+            return
         if method == "GET":
             h._send(200, self._wire(r.plural, store.get(r.plural, ns, r.name)))
             return

@@ -94,6 +94,12 @@ class FakeKubelet:
         # must not restart them in place — the slice is gone; replacement
         # is the controller's job.
         self._injected_failures: Set[str] = set()
+        # Pod log files (kubectl-logs analog): key -> list of file paths in
+        # chronological order (one per restart / warm spawn).
+        import tempfile
+
+        self._log_dir = tempfile.mkdtemp(prefix="kubelet-logs-")
+        self._log_paths: Dict[str, list] = {}
         self._stop = threading.Event()
         self._main: Optional[threading.Thread] = None
 
@@ -131,6 +137,8 @@ class FakeKubelet:
             return self._pool
 
     def stop(self) -> None:
+        import shutil
+
         self._stop.set()
         if self._watcher:
             self._watcher.stop()
@@ -139,6 +147,54 @@ class FakeKubelet:
                 proc.terminate()
         if self._pool is not None:
             self._pool.stop()
+        shutil.rmtree(self._log_dir, ignore_errors=True)
+
+    def logs(self, namespace: str, name: str, tail_bytes: int = 0) -> bytes:
+        """Combined stdout+stderr of an executed pod's process(es), in
+        chronological order across restarts — the kubectl-logs analog.
+        Empty for simulated pods (no process ran)."""
+        out = b""
+        for path in self._log_paths.get(f"{namespace}/{name}", []):
+            try:
+                with open(path, "rb") as f:
+                    out += f.read()
+            except OSError:
+                pass
+        if tail_bytes and len(out) > tail_bytes:
+            out = out[-tail_bytes:]
+        return out
+
+    def _new_log_file(self, key: str):
+        """Create (and register) the next log file for a pod key."""
+        import uuid
+
+        safe = key.replace("/", "_")
+        path = os.path.join(self._log_dir, f"{safe}-{uuid.uuid4().hex[:6]}.log")
+        self._log_paths.setdefault(key, []).append(path)
+        return open(path, "wb")
+
+    def _last_log_tail(self, key: str, limit: int = 500) -> bytes:
+        """Tail of the LAST run's log only — failure reasons must reflect
+        the run that failed, not earlier attempts' output."""
+        paths = self._log_paths.get(key, [])
+        if not paths:
+            return b""
+        try:
+            with open(paths[-1], "rb") as f:
+                return f.read()[-limit:]
+        except OSError:
+            return b""
+
+    def _drop_logs(self, key: str) -> None:
+        """Forget (and delete) a pod's log files — called when the pod
+        OBJECT is deleted, so logs of a kept terminal pod stay readable but
+        a recreated same-name pod never serves its predecessor's output,
+        and a long-lived kubelet does not grow unbounded."""
+        for path in self._log_paths.pop(key, []):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
     def _run(self) -> None:
         last_reap = time.monotonic()
@@ -167,6 +223,7 @@ class FakeKubelet:
                 warm = self._warm.get(key)
                 if warm is not None and self._pool is not None:
                     self._pool.kill(warm)
+                self._drop_logs(key)
 
     @staticmethod
     def _key(pod: Pod) -> str:
@@ -325,38 +382,36 @@ class FakeKubelet:
             if argv is not None:
                 self._execute_warm(pod, argv, env)
                 return
-        import tempfile
-
         restarts = 0
         while not self._stop.is_set():
             if self._key(pod) in self._injected_failures:
                 self._injected_failures.discard(self._key(pod))
                 return  # slice failed before/between spawns; stay Failed
-            # stderr goes to a FILE, not a pipe: a concurrent fork elsewhere
-            # in this thread-heavy process (the warm-pool zygote master
-            # forks without exec) can inherit a pipe's write end in the
-            # window before Popen closes it, and a long-lived holder means
-            # communicate() never sees EOF — the pod would hang Running
-            # forever after its process exited.  Files have no EOF wait.
-            errf = tempfile.TemporaryFile()
+            # Output goes to a FILE (the pod's log, kubectl-logs analog),
+            # never a pipe: a concurrent fork elsewhere in this thread-heavy
+            # process (the warm-pool zygote master forks without exec) can
+            # inherit a pipe's write end in the window before Popen closes
+            # it, and a long-lived holder means communicate() never sees
+            # EOF — the pod would hang Running forever after its process
+            # exited.  Files have no EOF wait.
+            logf = self._new_log_file(self._key(pod))
             try:
                 try:
                     proc = subprocess.Popen(
                         cmd,
                         env=env,
                         cwd=c.working_dir or None,
-                        stdout=subprocess.DEVNULL,
-                        stderr=errf,
+                        stdout=logf,
+                        stderr=logf,  # combined stream, as kubectl shows it
                     )
                 except OSError as e:
                     self.set_phase(ns, name, PHASE_FAILED, reason=f"StartError: {e}")
                     return
                 self._procs[self._key(pod)] = proc
                 proc.wait()
-                errf.seek(0)
-                stderr = errf.read()
             finally:
-                errf.close()
+                logf.close()
+            stderr = self._last_log_tail(self._key(pod))
             if self._stop.is_set() or self._gone(ns, name):
                 return
             if self._key(pod) in self._injected_failures:
@@ -390,6 +445,9 @@ class FakeKubelet:
                     self.set_phase(ns, name, PHASE_FAILED, reason=f"StartError: {e}")
                     return
                 self._warm[key] = proc
+                # Register the pool's files as this pod's logs.
+                self._log_paths.setdefault(key, []).extend(
+                    [proc.stdout_path, proc.stderr_path])
                 code = proc.wait(poll_stop=lambda: self._stop.is_set() or self._gone(ns, name))
                 if code is None or self._stop.is_set() or self._gone(ns, name):
                     pool.kill(proc)

@@ -423,6 +423,17 @@ class RestPodClient(_RestTypedClient):
     def list_pods(self, namespace: Optional[str] = None) -> List[Pod]:
         return self.list(namespace)
 
+    def read_log(self, namespace: str, name: str) -> str:
+        """GET .../pods/{name}/log — combined stdout+stderr, kubectl-logs
+        style (served by the API server's attached node agent)."""
+        resp = self._t._request(
+            "GET", self._item(namespace, name) + "/log", stream=True)
+        try:
+            with resp:
+                return resp.read().decode(errors="replace")
+        except (OSError, http.client.HTTPException) as e:
+            raise APIError(f"reading log of {namespace}/{name}: {e!r}") from None
+
     def mark_deleting(self, namespace: str, name: str) -> Pod:
         """Graceful pod deletion: the API server stamps deletionTimestamp
         and the kubelet finishes — a plain DELETE on the wire."""

@@ -19,6 +19,7 @@ class WarmProc:
     req_id: int
     pid: int = 0
     exit_code: Optional[int] = None
+    stdout_path: str = ""
     stderr_path: str = ""
     _done: threading.Event = field(default_factory=threading.Event)
 
@@ -117,6 +118,7 @@ class WarmPool:
             safe = key.replace("/", "_")
             proc = WarmProc(
                 req_id=rid,
+                stdout_path=os.path.join(self._tmpdir, f"{safe}-{rid}.out"),
                 stderr_path=os.path.join(self._tmpdir, f"{safe}-{rid}.err"),
             )
             self._procs[rid] = proc
@@ -125,7 +127,7 @@ class WarmPool:
                 "argv": list(argv),
                 "env": dict(env),
                 "cwd": cwd or "",
-                "stdout": os.path.join(self._tmpdir, f"{safe}-{rid}.out"),
+                "stdout": proc.stdout_path,
                 "stderr": proc.stderr_path,
             }
             try:

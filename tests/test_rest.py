@@ -412,3 +412,77 @@ class TestCLIGetDescribe:
 
         _, url = server
         assert cli_main(["-master", url, "describe", "nope"]) == 1
+
+
+class TestPodLogsOverREST:
+    def test_logs_and_delete_cli(self, tmp_path):
+        """Pod logs flow kubelet -> API server log subresource -> REST
+        client -> CLI; delete flows CLI -> finalizer cleanup."""
+        import contextlib
+        import io
+        import sys as _sys
+
+        from kubeflow_controller_tpu.api.core import Pod
+        from kubeflow_controller_tpu.cli.main import main as cli_main
+        from kubeflow_controller_tpu.cluster.store import ObjectStore
+
+        store = ObjectStore()
+        substrate = Cluster(store=store)
+        kubelet = FakeKubelet(substrate, policy=PhasePolicy(run_s=0.05),
+                              execute=True, warm_start=False)
+        srv = FakeAPIServer(store, kubelet=kubelet)
+        url = srv.start()
+        rest = RestCluster(Kubeconfig(server=url))
+        ctrl = Controller(rest, resync_period_s=0.5)
+        kubelet.start()
+        ctrl.run(threadiness=2)
+        try:
+            pod = Pod()
+            pod.metadata.namespace = "default"
+            pod.metadata.name = "sayer"
+            pod.spec.containers.append(Container(
+                name="c", image="img",
+                command=[_sys.executable, "-c",
+                         "print('hello from the pod'); "
+                         "import sys; print('and stderr', file=sys.stderr)"]))
+            rest.pods.create(pod)
+            wait_for(lambda: rest.pods.get("default", "sayer").status.phase
+                     == "Succeeded")
+            text = rest.pods.read_log("default", "sayer")
+            assert "hello from the pod" in text
+            assert "and stderr" in text
+
+            out = io.StringIO()
+            with contextlib.redirect_stdout(out):
+                rc = cli_main(["-master", url, "logs", "sayer"])
+            assert rc == 0 and "hello from the pod" in out.getvalue()
+
+            # CLI delete of a TFJob goes through finalizer cleanup.
+            rest.tfjobs.create(mk_job("deljob", (ReplicaType.LOCAL, 1)))
+            wait_for(lambda: rest.tfjobs.get("default", "deljob").status.phase
+                     == TFJobPhase.SUCCEEDED)
+            out = io.StringIO()
+            with contextlib.redirect_stdout(out):
+                rc = cli_main(["-master", url, "delete", "deljob"])
+            assert rc == 0
+            def job_gone():
+                try:
+                    rest.tfjobs.get("default", "deljob")
+                    return False
+                except NotFound:
+                    return True
+            wait_for(job_gone)
+        finally:
+            ctrl.stop()
+            kubelet.stop()
+            srv.stop()
+
+    def test_logs_without_kubelet_404(self, server, rest):
+        from kubeflow_controller_tpu.api.core import Pod
+
+        pod = Pod()
+        pod.metadata.namespace = "default"
+        pod.metadata.name = "p"
+        rest.pods.create(pod)
+        with pytest.raises(NotFound):
+            rest.pods.read_log("default", "p")
