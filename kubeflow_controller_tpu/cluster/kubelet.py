@@ -149,10 +149,12 @@ class FakeKubelet:
             self._pool.stop()
         shutil.rmtree(self._log_dir, ignore_errors=True)
 
-    def logs(self, namespace: str, name: str, tail_bytes: int = 0) -> bytes:
-        """Combined stdout+stderr of an executed pod's process(es), in
-        chronological order across restarts — the kubectl-logs analog.
-        Empty for simulated pods (no process ran)."""
+    def logs(self, namespace: str, name: str) -> bytes:
+        """An executed pod's output — per run (across restarts) stdout then
+        stderr, runs in chronological order; the kubectl-logs analog.  The
+        two streams are separate files (stderr must stay unpolluted for
+        failure reasons), so unlike a real container runtime they are NOT
+        interleaved within a run.  Empty for simulated pods."""
         out = b""
         for path in self._log_paths.get(f"{namespace}/{name}", []):
             try:
@@ -160,28 +162,27 @@ class FakeKubelet:
                     out += f.read()
             except OSError:
                 pass
-        if tail_bytes and len(out) > tail_bytes:
-            out = out[-tail_bytes:]
         return out
 
-    def _new_log_file(self, key: str):
+    def _new_log_file(self, key: str, suffix: str):
         """Create (and register) the next log file for a pod key."""
         import uuid
 
         safe = key.replace("/", "_")
-        path = os.path.join(self._log_dir, f"{safe}-{uuid.uuid4().hex[:6]}.log")
+        path = os.path.join(
+            self._log_dir, f"{safe}-{uuid.uuid4().hex[:6]}.{suffix}")
         self._log_paths.setdefault(key, []).append(path)
-        return open(path, "wb")
+        return open(path, "wb"), path
 
-    def _last_log_tail(self, key: str, limit: int = 500) -> bytes:
-        """Tail of the LAST run's log only — failure reasons must reflect
-        the run that failed, not earlier attempts' output."""
-        paths = self._log_paths.get(key, [])
-        if not paths:
-            return b""
+    @staticmethod
+    def _file_tail(path: str, limit: int = 500) -> bytes:
+        """Last ``limit`` bytes without reading the whole file."""
         try:
-            with open(paths[-1], "rb") as f:
-                return f.read()[-limit:]
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - limit))
+                return f.read()
         except OSError:
             return b""
 
@@ -387,22 +388,26 @@ class FakeKubelet:
             if self._key(pod) in self._injected_failures:
                 self._injected_failures.discard(self._key(pod))
                 return  # slice failed before/between spawns; stay Failed
-            # Output goes to a FILE (the pod's log, kubectl-logs analog),
-            # never a pipe: a concurrent fork elsewhere in this thread-heavy
+            # Output goes to FILES (the pod's logs, kubectl-logs analog),
+            # never pipes: a concurrent fork elsewhere in this thread-heavy
             # process (the warm-pool zygote master forks without exec) can
             # inherit a pipe's write end in the window before Popen closes
             # it, and a long-lived holder means communicate() never sees
             # EOF — the pod would hang Running forever after its process
-            # exited.  Files have no EOF wait.
-            logf = self._new_log_file(self._key(pod))
+            # exited.  Files have no EOF wait.  stdout/stderr are separate
+            # files (same layout as the warm pool): block-buffered stdout
+            # in a combined file could displace the traceback out of the
+            # failure-reason tail.
+            outf, _ = self._new_log_file(self._key(pod), "out")
+            errf, err_path = self._new_log_file(self._key(pod), "err")
             try:
                 try:
                     proc = subprocess.Popen(
                         cmd,
                         env=env,
                         cwd=c.working_dir or None,
-                        stdout=logf,
-                        stderr=logf,  # combined stream, as kubectl shows it
+                        stdout=outf,
+                        stderr=errf,
                     )
                 except OSError as e:
                     self.set_phase(ns, name, PHASE_FAILED, reason=f"StartError: {e}")
@@ -410,8 +415,8 @@ class FakeKubelet:
                 self._procs[self._key(pod)] = proc
                 proc.wait()
             finally:
-                logf.close()
-            stderr = self._last_log_tail(self._key(pod))
+                outf.close()
+                errf.close()
             if self._stop.is_set() or self._gone(ns, name):
                 return
             if self._key(pod) in self._injected_failures:
@@ -423,7 +428,7 @@ class FakeKubelet:
             if pod.spec.restart_policy in ("Always", "OnFailure") and restarts < self.max_restarts:
                 restarts += 1
                 continue
-            tail = (stderr or b"")[-500:].decode(errors="replace")
+            tail = self._file_tail(err_path).decode(errors="replace")
             self.set_phase(ns, name, PHASE_FAILED, reason=f"Error: exit {proc.returncode}: {tail}")
             return
 

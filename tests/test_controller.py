@@ -290,3 +290,28 @@ def test_many_concurrent_jobs_stress(rig):
     pod_creates = [e for e in ctrl.recorder.all_events()
                    if e.reason == "SuccessfulCreate" and "pod" in e.message]
     assert sum(e.count for e in pod_creates) == 7 * 1 + 7 * 3 + 6 * 2
+
+
+def test_live_rescale_up_and_down(rig):
+    """Editing replicas on a LIVE job reconciles both directions: scale-up
+    creates the missing indices, scale-down deletes the excess — the
+    reference declared ActionShouldDelete and never produced it (ref:
+    types.go:39-40); its planner could not resize anything."""
+    cluster, ctrl, _, _ = rig
+    cluster.tfjobs.create(mk_job("resize", (ReplicaType.PS, 2)))  # PS: runs forever
+    wait_for(lambda: len(cluster.pods.list("default")) == 2)
+
+    j = cluster.tfjobs.get("default", "resize")
+    j.spec.tf_replica_specs[0].replicas = 4
+    cluster.tfjobs.update(j)
+    wait_for(lambda: len([p for p in cluster.pods.list("default")
+                          if p.status.phase == PHASE_RUNNING]) == 4)
+    indices = sorted(p.metadata.labels[LABEL_INDEX]
+                     for p in cluster.pods.list("default"))
+    assert indices == ["0", "1", "2", "3"]
+
+    j = cluster.tfjobs.get("default", "resize")
+    j.spec.tf_replica_specs[0].replicas = 1
+    cluster.tfjobs.update(j)
+    wait_for(lambda: len(cluster.pods.list("default")) == 1)
+    assert cluster.pods.list("default")[0].metadata.labels[LABEL_INDEX] == "0"
