@@ -81,7 +81,13 @@ def main() -> int:
     for t in args.seqs:
         b = max(1, args.tokens // t)
         for impl in ("xla", "flash"):
-            r = bench_one(impl, b, t, args.heads, args.head_dim, args.steps)
+            try:
+                r = bench_one(impl, b, t, args.heads, args.head_dim, args.steps)
+            except Exception as e:  # noqa: BLE001 — record the failure point
+                # e.g. XLA attention fails to compile/fit at T=8192 on one
+                # chip — that asymmetry IS the result (docs/PERF.md).
+                r = {"impl": impl, "seq": t, "batch": b,
+                     "error": str(e)[:200]}
             results.append(r)
             print(json.dumps(r), flush=True)
     return 0
