@@ -68,6 +68,8 @@ def bench_one(impl: str, b: int, t: int, h: int, d: int, steps: int,
 
 
 def main() -> int:
+    import os
+
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--heads", type=int, default=16)
     p.add_argument("--head-dim", type=int, default=128)
@@ -76,19 +78,33 @@ def main() -> int:
                    help="B*T held constant across the T sweep")
     p.add_argument("--seqs", type=int, nargs="+",
                    default=[1024, 2048, 4096, 8192])
+    p.add_argument("--impl", choices=["xla", "flash"], default="",
+                   help="run ONE point in-process (the sweep spawns these)")
     args = p.parse_args()
-    results = []
+    if args.impl:
+        # Single point, in-process (the subprocess worker of the sweep).
+        t = args.seqs[0]
+        r = bench_one(args.impl, max(1, args.tokens // t), t,
+                      args.heads, args.head_dim, args.steps)
+        print(json.dumps(r))
+        return 0
+    # Sweep: one subprocess per point — a failing config (e.g. XLA attention
+    # at T=8192, which cannot compile on one chip: that asymmetry IS the
+    # result) must not poison the TPU client for later points.
+    from benchmarks._common import run_bench_subprocess
+
     for t in args.seqs:
         b = max(1, args.tokens // t)
         for impl in ("xla", "flash"):
-            try:
-                r = bench_one(impl, b, t, args.heads, args.head_dim, args.steps)
-            except Exception as e:  # noqa: BLE001 — record the failure point
-                # e.g. XLA attention fails to compile/fit at T=8192 on one
-                # chip — that asymmetry IS the result (docs/PERF.md).
-                r = {"impl": impl, "seq": t, "batch": b,
-                     "error": str(e)[:200]}
-            results.append(r)
+            r = run_bench_subprocess(os.path.abspath(__file__), [
+                "--impl", impl, "--seqs", t, "--tokens", args.tokens,
+                "--heads", args.heads, "--head-dim", args.head_dim,
+                "--steps", args.steps,
+            ])
+            # Same record shape for errors as for successes.
+            r.setdefault("impl", impl)
+            r.setdefault("t", t)
+            r.setdefault("b", b)
             print(json.dumps(r), flush=True)
     return 0
 
