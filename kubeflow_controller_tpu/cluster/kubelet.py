@@ -206,7 +206,7 @@ class FakeKubelet:
             if self.inventory is not None and time.monotonic() - last_reap > 0.5:
                 last_reap = time.monotonic()
                 live = {
-                    p.metadata.name for p in self.cluster.pods.list()
+                    self._key(p) for p in self.cluster.pods.list()
                     if p.status.phase not in (PHASE_SUCCEEDED, PHASE_FAILED)
                     and p.metadata.deletion_timestamp is None
                 }
@@ -300,12 +300,12 @@ class FakeKubelet:
         Returns the failed pod names."""
         if self.inventory is None:
             return []
-        names = set(self.inventory.fail_slice(slice_name))
+        keys = set(self.inventory.fail_slice(slice_name))
         failed = []
         for pod in self.cluster.pods.list():
-            if pod.metadata.name not in names:
-                continue
             key = self._key(pod)
+            if key not in keys:
+                continue
             self._injected_failures.add(key)
             proc = self._procs.get(key)
             if proc is not None and proc.poll() is None:

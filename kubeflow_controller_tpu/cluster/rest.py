@@ -234,11 +234,18 @@ class RestWatcher:
     store.Watcher (next/stop)."""
 
     def __init__(self, transport: RestTransport, path: str,
-                 params: Dict[str, str], cls: Type):
+                 params: Dict[str, str], cls: Type,
+                 connect_grace: float = 2.0):
         self._transport = transport
         self._path = path
         self._params = params
         self._cls = cls
+        # How long pre-connect failures are retried before they become
+        # fatal: long enough to tolerate a concurrently-starting server
+        # (two-process mode binds its port within a few hundred ms), short
+        # enough that a down server surfaces an error in ~2 s instead of
+        # each informer eating a 10 s timeout serially (advisor round-2).
+        self._connect_grace = connect_grace
         self.queue: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
         self._stopped = threading.Event()
         self._connected = threading.Event()
@@ -248,16 +255,26 @@ class RestWatcher:
         # informer polls this counter (informer.py:_watch_loop).
         self.gaps = 0
         self._resp = None
+        # First connect outcome: set on success OR on a failure that
+        # outlived the grace window, so a down server surfaces its error
+        # quickly instead of being waited out.
+        self._first_attempt = threading.Event()
+        self._first_error: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"watch-{path}")
         self._thread.start()
         # Block until the server has accepted the watch (response headers
         # arrive only after the server registered the event stream), so an
         # object created right after watch() cannot slip into the gap.
-        self._connected.wait(timeout=10.0)
+        self._first_attempt.wait(timeout=10.0)
+        if self._first_error is not None:
+            self.stop()
+            raise APIError(
+                f"watch {path}: connect failed: {self._first_error}")
 
     def _run(self) -> None:
         ever_connected = False
+        grace_deadline = time.monotonic() + self._connect_grace
         while not self._stopped.is_set():
             try:
                 self._resp = self._transport._request(
@@ -267,6 +284,7 @@ class RestWatcher:
                     self.gaps += 1  # after reconnect, so a re-list now is safe
                 ever_connected = True
                 self._connected.set()
+                self._first_attempt.set()
                 for raw in self._resp:
                     if self._stopped.is_set():
                         return
@@ -287,11 +305,21 @@ class RestWatcher:
                     return
                 raise
             except (APIError, OSError, ValueError,
-                    http.client.HTTPException):
+                    http.client.HTTPException) as e:
                 # HTTPException: IncompleteRead when the server dies
                 # mid-chunk (not an OSError).
                 if self._stopped.is_set():
                     return
+                if not ever_connected:
+                    if time.monotonic() >= grace_deadline:
+                        # Never connected and the grace window is spent:
+                        # report to the constructor and bail — the watcher
+                        # is unusable and __init__ raises.
+                        self._first_error = e
+                        self._first_attempt.set()
+                        return
+                    time.sleep(0.2)  # server may still be starting: retry
+                    continue
                 self._connected.clear()
                 time.sleep(0.2)  # reconnect, as client-go reflectors do
 

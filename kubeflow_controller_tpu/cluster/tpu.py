@@ -41,7 +41,10 @@ class _Gang:
     size: int
     accelerator_type: str
     num_slices: int = 1
-    pods: Dict[str, Pod] = field(default_factory=dict)  # pod name -> pod
+    # "namespace/name" -> pod.  Namespace-qualified so a same-named pod in
+    # another namespace can neither mask a dead gang's idleness nor be
+    # killed by a foreign slice failure.
+    pods: Dict[str, Pod] = field(default_factory=dict)
     slice_names: List[str] = field(default_factory=list)  # set once admitted
 
     @property
@@ -89,7 +92,7 @@ class TPUInventory:
             n_slices = int(ann.get(ANNOTATION_NUM_SLICES, "1") or "1")
             gang = self._gangs.setdefault(
                 gang_name, _Gang(gang_name, size, accel, num_slices=n_slices))
-            gang.pods[pod.metadata.name] = pod
+            gang.pods[f"{pod.metadata.namespace}/{pod.metadata.name}"] = pod
             if gang.slice_names:
                 return True  # already admitted; late pod joins
             if len(gang.pods) < gang.size:
@@ -134,7 +137,7 @@ class TPUInventory:
                 if name in self.slices:
                     self.slices[name].bound_gang = ""
 
-    def release_idle_gangs(self, active_pod_names) -> List[str]:
+    def release_idle_gangs(self, active_pod_keys) -> List[str]:
         """Release every gang none of whose member pods is still active —
         the node-side backstop that frees slices when the controller that
         acquired them runs in another process (REST/two-process mode, where
@@ -147,8 +150,13 @@ class TPUInventory:
         would otherwise be released while its (running) pods proceed —
         running pods never re-offer, so slice exclusivity would break.  The
         second call sees a fresh snapshot containing those pods and clears
-        the candidacy."""
-        active = set(active_pod_names)
+        the candidacy.
+
+        ``active_pod_keys`` are namespace-qualified "namespace/name" keys
+        (the kubelet's own key format): a bare-name match would let a
+        same-named pod in another namespace keep a dead gang's slices
+        bound forever."""
+        active = set(active_pod_keys)
         with self._lock:
             idle = {name for name, g in self._gangs.items()
                     if not (set(g.pods) & active)}
@@ -164,8 +172,8 @@ class TPUInventory:
         and the bound gang is evicted from ALL its slices (one slice dying
         tears the collective for the whole multislice gang; the other
         slices stay healthy and are freed for the replacement).  Returns
-        the names of pods in the evicted gang; the kubelet fails them
-        all."""
+        the "namespace/name" keys of pods in the evicted gang; the kubelet
+        fails them all."""
         with self._lock:
             sl = self.slices.get(slice_name)
             if sl is None:

@@ -472,10 +472,16 @@ def llama_forward_pp(
     *,
     n_microbatches: int = 2,
     rules: ShardingRules = DEFAULT_RULES,
-) -> jax.Array:
+    return_aux: bool = False,
+):
     """Pipeline-parallel forward: layers split into ``pp`` stages, the
     batch into microbatches streaming GPipe-style (parallel/pipeline.py).
-    Degenerates to the plain forward when the pp axis has size 1."""
+    Degenerates to the plain forward when the pp axis has size 1.
+
+    With ``return_aux=True`` also returns the MoE router stats averaged
+    over layers and microbatches ({aux_loss, z_loss, overflow_frac}, zeros
+    for dense) — same contract as :func:`llama_forward`; the per-stage
+    scalars are threaded through the gpipe schedule."""
     from ..parallel.mesh import AXIS_PIPELINE
     from ..parallel.pipeline import gpipe, split_stages
 
@@ -491,23 +497,25 @@ def llama_forward_pp(
     layer_fn = _maybe_remat(layer, cfg)
 
     def stage_fn(stage_layers, xm):
-        # MoE router stats are dropped on the pipeline path: collecting
-        # scalars through the gpipe loop would thread them through every
-        # stage buffer.  Balance-sensitive MoE training should monitor aux
-        # on the non-pp path (llama_loss adds the aux terms there).
-        out, _ = jax.lax.scan(lambda c, lp: layer_fn(c, lp), xm, stage_layers)
-        return out
+        out, aux = jax.lax.scan(lambda c, lp: layer_fn(c, lp), xm, stage_layers)
+        # Per-stage sums of the per-layer router stats; gpipe sums them
+        # over stages and microbatches, the caller normalizes to means.
+        return out, jax.tree.map(lambda v: jnp.sum(v), aux)
 
     S = mesh.shape[AXIS_PIPELINE]
     stages = split_stages(params["layers"], S)
     micro = x.reshape(n_microbatches, B // n_microbatches, T, -1)
-    out = gpipe(stage_fn, stages, micro, mesh)
+    out, aux_sums = gpipe(stage_fn, stages, micro, mesh, stage_aux=True)
     x = out.reshape(B, T, -1)
 
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(dtype))
     logits = with_logical_constraint(logits, ("batch", "seq", "vocab"), rules)
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    if return_aux:
+        denom = cfg.n_layers * n_microbatches
+        return logits, {k: v / denom for k, v in aux_sums.items()}
+    return logits
 
 
 def llama_loss_and_grads_pp(
@@ -523,9 +531,12 @@ def llama_loss_and_grads_pp(
     (parallel/pipeline.py:pipeline_1f1b): stage activations live in a ring
     buffer of depth 2S-1, so peak activation memory no longer grows with
     the microbatch count the way differentiating llama_forward_pp (GPipe)
-    does.  Numerically matches ``jax.grad(llama_loss)`` for dense configs
-    (MoE router aux terms are not collected on the pipeline path — see
-    llama_forward_pp).
+    does.  Numerically matches ``jax.grad(llama_loss)`` for dense configs.
+    For MoE configs the router aux/z penalties (weighted by cfg.moe_aux_coef
+    / cfg.moe_z_coef, per-layer mean as on the non-pp path) are threaded
+    through the schedule as per-stage scalars, so load balancing trains
+    under pp; the per-microbatch mean approximates the full-batch aux the
+    same way any gradient accumulation does.
 
     Returns ``(loss, grads)`` with ``grads`` matching the ``params`` tree.
     """
@@ -541,10 +552,22 @@ def llama_loss_and_grads_pp(
     layer = _decoder_layer_fn(cfg, angles, None, rules)
     layer_fn = _maybe_remat(layer, cfg)
 
-    def stage_fn(stage_layers, xm):
-        out, _ = jax.lax.scan(
-            lambda c, lp: (layer_fn(c, lp)[0], None), xm, stage_layers)
-        return out
+    if cfg.n_experts:
+        def stage_fn(stage_layers, xm):
+            def body(c, lp):
+                y, aux = layer_fn(c, lp)
+                pen = (cfg.moe_aux_coef * aux["aux_loss"]
+                       + cfg.moe_z_coef * aux["z_loss"])
+                return y, pen
+            out, pens = jax.lax.scan(body, xm, stage_layers)
+            # Weighted penalty per stage, normalized so the sum over stages
+            # equals the non-pp path's per-layer MEAN times the coefficients.
+            return out, jnp.sum(pens) / cfg.n_layers
+    else:
+        def stage_fn(stage_layers, xm):
+            out, _ = jax.lax.scan(
+                lambda c, lp: (layer_fn(c, lp)[0], None), xm, stage_layers)
+            return out
 
     def loss_fn(lp, y, targets_m):
         h = rmsnorm(y, lp["final_norm"], cfg.norm_eps)
@@ -562,7 +585,8 @@ def llama_loss_and_grads_pp(
                    "lm_head": params["lm_head"]}
 
     loss, gstage, gloss, gmicro = pipeline_1f1b(
-        stage_fn, stages, micro, loss_fn, loss_params, targets, mesh)
+        stage_fn, stages, micro, loss_fn, loss_params, targets, mesh,
+        stage_aux=bool(cfg.n_experts))
 
     glayers = jax.tree.map(
         lambda a: a.reshape(a.shape[0] * a.shape[1], *a.shape[2:]), gstage)

@@ -56,12 +56,18 @@ def gpipe(
     mesh: Mesh,
     *,
     axis_name: str = AXIS_PIPELINE,
-) -> jax.Array:
+    stage_aux: bool = False,
+):
     """Run ``stage_fn(params_for_stage, x) -> y`` as a pipeline.
 
     ``stage_params``: pytree with leading stage axis S (see split_stages).
     ``microbatches``: [n_micro, ...] activations fed to stage 0.
     Returns [n_micro, ...] outputs of the last stage.
+
+    With ``stage_aux=True`` the stage returns ``(y, aux)`` where ``aux`` is
+    a pytree of per-stage extras (e.g. MoE router stats); gpipe sums them
+    over stages and real microbatches (bubble steps masked out) and returns
+    ``(outputs, aux_sums)``.
     """
     S = jax.tree.leaves(stage_params)[0].shape[0]
     if mesh is not None and axis_name in mesh.shape:
@@ -71,7 +77,11 @@ def gpipe(
     n_micro = microbatches.shape[0]
     if S == 1:
         params = jax.tree.map(lambda a: a[0], stage_params)
-        return jax.vmap(lambda x: stage_fn(params, x))(microbatches)
+        out = jax.vmap(lambda x: stage_fn(params, x))(microbatches)
+        if stage_aux:
+            out, aux = out
+            return out, jax.tree.map(lambda a: jnp.sum(a, axis=0), aux)
+        return out
 
     # Shard the stage axis of the params over pp so each device holds (and
     # computes with) only its own stage's weights — the memory point of
@@ -82,14 +92,32 @@ def gpipe(
     # act[s] = activation currently entering stage s.
     act0 = _constrain_pp(jnp.broadcast_to(zero, (S, *zero.shape)), axis_name)
     out0 = jnp.zeros_like(microbatches)
+    sidx = jnp.arange(S)
+
+    def aux0():
+        shapes = jax.eval_shape(stage_fn,
+                                jax.tree.map(lambda a: a[0], stage_params),
+                                microbatches[0])[1]
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
     # fori_loop, not a Python loop: trace size stays constant in the number
     # of microbatches (pipelines shrink their bubble by raising n_micro).
     def step(t, carry):
-        act, out = carry
+        act, out, aux_acc = carry
         feed = jnp.take(microbatches, jnp.minimum(t, n_micro - 1), axis=0)
         act = act.at[0].set(jnp.where(t < n_micro, feed, act[0]))
         y = vstage(stage_params, act)
+        if stage_aux:
+            y, aux = y
+            # Stage s at time t runs microbatch t-s; bubble steps (garbage
+            # activations warming up / draining) must not pollute the sums.
+            valid = jnp.logical_and(t - sidx >= 0, t - sidx < n_micro)
+
+            def acc(a, g):
+                m = valid.reshape((S,) + (1,) * (g.ndim - 1))
+                return a + jnp.sum(jnp.where(m, g, 0), axis=0)
+
+            aux_acc = jax.tree.map(acc, aux_acc, aux)
         y = _constrain_pp(y, axis_name)
         pos = t - (S - 1)
         out = jax.lax.dynamic_update_index_in_dim(
@@ -99,9 +127,12 @@ def gpipe(
             axis=0,
         )
         # y[s] becomes the input of stage s+1 (roll -> collective permute).
-        return jnp.roll(y, 1, axis=0), out
+        return jnp.roll(y, 1, axis=0), out, aux_acc
 
-    _, out = jax.lax.fori_loop(0, n_micro + S - 1, step, (act0, out0))
+    _, out, aux_acc = jax.lax.fori_loop(
+        0, n_micro + S - 1, step, (act0, out0, aux0() if stage_aux else 0))
+    if stage_aux:
+        return out, aux_acc
     return out
 
 
@@ -115,6 +146,7 @@ def pipeline_1f1b(
     mesh: Mesh,
     *,
     axis_name: str = AXIS_PIPELINE,
+    stage_aux: bool = False,
 ):
     """1F1B schedule: fused forward+backward pipeline with gradient
     accumulation across microbatches.
@@ -144,6 +176,13 @@ def pipeline_1f1b(
         stage's output of each microbatch (e.g. final-norm + lm_head + CE).
       loss_params: params of loss_fn (grads are accumulated for them too).
       loss_aux: [M, ...] per-microbatch extras for loss_fn (e.g. targets).
+      stage_aux: when True, ``stage_fn`` returns ``(y, penalty)`` with
+        ``penalty`` a scalar ALREADY weighted into loss units (e.g. MoE
+        aux/z losses times their coefficients, averaged over the stage's
+        layers).  Penalties of real microbatches are added to the loss and
+        their gradients flow into ``stage_grads`` (the backward seeds the
+        penalty output with cotangent 1), so load-balancing terms train
+        under the pipeline schedule instead of being silently dropped.
 
     Returns ``(mean_loss, stage_grads, loss_param_grads, input_grads)``
     where ``input_grads`` is [M, ...] d(loss)/d(microbatches) — feed it to
@@ -161,12 +200,19 @@ def pipeline_1f1b(
     def one_loss(lp, y, aux):
         return loss_fn(lp, y, aux)
 
+    def run_stage(p, x):
+        """Normalize stage_fn to the (y, penalty) shape."""
+        if stage_aux:
+            return stage_fn(p, x)
+        return stage_fn(p, x), jnp.float32(0)
+
     if S == 1:
         # Degenerate path: plain gradient accumulation over microbatches.
         params = jax.tree.map(lambda a: a[0], stage_params)
 
         def mb_loss(p, lp, x, aux):
-            return one_loss(lp, stage_fn(p, x), aux)
+            y, pen = run_stage(p, x)
+            return one_loss(lp, y, aux) + pen
 
         def acc(carry, xa):
             x, aux = xa
@@ -188,13 +234,16 @@ def pipeline_1f1b(
                 gx * scale)
 
     stage_params = jax.tree.map(lambda a: _constrain_pp(a, axis_name), stage_params)
-    vstage = jax.vmap(stage_fn)
+    vstage = jax.vmap(run_stage)
 
     def bwd_one(p, x, g):
         """Re-runs the stage forward and pulls the cotangent back — per-stage
-        rematerialization, the reason only stage inputs need saving."""
-        _, vjp = jax.vjp(stage_fn, p, x)
-        return vjp(g)
+        rematerialization, the reason only stage inputs need saving.  The
+        penalty output is seeded with cotangent 1 (it adds directly to the
+        loss); the invalid-microbatch mask is applied to the RESULT, so
+        bubble steps contribute nothing."""
+        _, vjp = jax.vjp(run_stage, p, x)
+        return vjp((g, jnp.float32(1)))
 
     vbwd = jax.vmap(bwd_one)
 
@@ -216,8 +265,13 @@ def pipeline_1f1b(
         feed = jnp.take(microbatches, jnp.minimum(t, M - 1), axis=0)
         act = act.at[0].set(jnp.where(t < M, feed, act[0]))
         ring = ring.at[:, t % R].set(act)
-        y = vstage(stage_params, act)
+        y, pen = vstage(stage_params, act)
         y = _constrain_pp(y, axis_name)
+        # Stage s forwards microbatch m_f = t - s; its (already weighted)
+        # penalty joins the loss only for real microbatches.
+        m_f = t - sidx
+        valid_f = jnp.logical_and(m_f >= 0, m_f < M)
+        loss = loss + jnp.sum(jnp.where(valid_f, pen, 0.0))
 
         # ---- loss + seed at the last stage (microbatch m_last = t-(S-1)) --
         m_last = t - (S - 1)

@@ -146,13 +146,21 @@ def main(argv=None) -> int:
     if ckpt:
         # Durability barrier: if the in-loop (async) save already wrote the
         # final step, just wait for it — re-saving the same step raises
-        # StepAlreadyExistsError in Orbax.
+        # StepAlreadyExistsError in Orbax.  The wait()-only branch requires
+        # that an in-loop save for `final` was actually issued THIS run
+        # (args.steps > 0): a --steps 0 resume enters the loop zero times,
+        # and waiting on nothing while printing "Checkpoint saved" would
+        # claim a save that never happened.
         final = start_step + args.steps
-        if args.checkpoint_every and final % args.checkpoint_every == 0:
+        if (args.steps > 0 and args.checkpoint_every
+                and final % args.checkpoint_every == 0):
             ckpt.wait()
+            print(f"Checkpoint saved to {rt.model_dir}")
+        elif ckpt.latest_step() == final:
+            print(f"Checkpoint for step {final} already in {rt.model_dir}")
         else:
             ckpt.save(final, params, opt_state)
-        print(f"Checkpoint saved to {rt.model_dir}")
+            print(f"Checkpoint saved to {rt.model_dir}")
     return 0
 
 

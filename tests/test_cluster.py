@@ -280,7 +280,7 @@ def test_slice_failure_domain():
                         TPUSlice("slice-1", "v5e-8", num_hosts=2)])
     inv.offer(tpu_pod("h0", "g1", 2))
     inv.offer(tpu_pod("h1", "g1", 2))
-    assert sorted(inv.fail_slice("slice-0")) == ["h0", "h1"]
+    assert sorted(inv.fail_slice("slice-0")) == ["default/h0", "default/h1"]
     # The failed slice is quarantined and its gang evicted: a replacement
     # gang must land on different hardware.
     assert inv.slices["slice-0"].healthy is False
@@ -288,6 +288,22 @@ def test_slice_failure_domain():
     inv.offer(tpu_pod("r0", "g2", 2))
     assert inv.offer(tpu_pod("r1", "g2", 2))
     assert inv.gang_slice("g2") == "slice-1"
+
+
+def test_idle_gang_release_is_namespace_aware():
+    """A same-named pod in ANOTHER namespace must not keep a dead gang's
+    slice bound (advisor round-2: bare-name live sets leak slices)."""
+    inv = TPUInventory([TPUSlice("slice-0", "v5e-8", num_hosts=2)])
+    inv.offer(tpu_pod("h0", "g1", 2))
+    inv.offer(tpu_pod("h1", "g1", 2))
+    assert inv.gang_slice("g1") == "slice-0"
+    # Gang pods (namespace "default") are all dead; an unrelated live pod
+    # named "h0" exists in namespace "other".
+    live = {"other/h0"}
+    inv.release_idle_gangs(live)          # first scan: candidate
+    released = inv.release_idle_gangs(live)  # second scan: confirmed
+    assert released == ["g1"]
+    assert inv.slices["slice-0"].bound_gang == ""
 
 
 # ---- Multislice (DCN) gang scheduling ----
@@ -330,7 +346,8 @@ def test_multislice_fail_one_slice_evicts_whole_gang():
     for p in pods:
         inv.offer(p)
     s0, s1 = inv.gang_slices("g1")
-    assert sorted(inv.fail_slice(s0)) == ["h0", "h1", "h2", "h3"]
+    assert sorted(inv.fail_slice(s0)) == [
+        "default/h0", "default/h1", "default/h2", "default/h3"]
     # Failed slice quarantined; the OTHER slice is healthy and free again.
     assert inv.slices[s0].healthy is False
     assert inv.slices[s1].healthy is True
