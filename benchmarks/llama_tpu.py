@@ -35,7 +35,8 @@ V5E_BF16_PEAK_TFLOPS = 197.0
 
 def run(batch: int, seq: int, steps: int, dim: int, layers: int, heads: int,
         intermediate: int, policy: str, peak_tflops: float,
-        loss_chunks: int = 0, experts: int = 0, top_k: int = 2) -> dict:
+        loss_chunks: int = 0, experts: int = 0, top_k: int = 2,
+        moe_dispatch: str = "einsum", attention: str = "auto") -> dict:
     import jax
     import optax
 
@@ -47,7 +48,8 @@ def run(batch: int, seq: int, steps: int, dim: int, layers: int, heads: int,
         n_kv_heads=heads, intermediate=intermediate, max_seq_len=seq,
         dtype="bfloat16", param_dtype="bfloat16", remat=True,
         remat_policy=policy, loss_chunks=loss_chunks,
-        n_experts=experts, moe_top_k=top_k,
+        n_experts=experts, moe_top_k=top_k, moe_dispatch=moe_dispatch,
+        attention=attention,
     )
     mesh = build_mesh(MeshSpec(fsdp=-1))
     params = jax.jit(lambda k: llama_init(k, cfg))(jax.random.PRNGKey(0))
@@ -113,7 +115,14 @@ def run_subprocess(args_list) -> dict:
 def sweep(steps: int, out_path: str, peak: float, shape: dict) -> int:
     # The grid: remat policies at the judged 953M size, B and T scaling.
     # Flash attention is on (LlamaConfig.attention="auto") for every point.
+    # The MoE A/B triple (docs/PERF.md): 653M-total/238M-active E8 top2 at
+    # dim 1024 / L8 / inter 2816, vs the iso-active 238M dense (inter 5632).
+    moe_shape = dict(dim=1024, layers=8, heads=16, intermediate=2816)
+    iso_dense = dict(dim=1024, layers=8, heads=16, intermediate=5632)
     grid = [
+        # The round-1 baseline row: XLA fused attention instead of the
+        # Pallas flash kernel — keeps the 45% -> 61% story in ONE artifact.
+        dict(batch=16, seq=1024, policy="full", attention="xla"),
         dict(batch=16, seq=1024, policy="full"),
         dict(batch=16, seq=1024, policy="dots"),
         dict(batch=16, seq=1024, policy="ffn"),
@@ -130,23 +139,39 @@ def sweep(steps: int, out_path: str, peak: float, shape: dict) -> int:
         # materializes the T^2 scores (XLA attention fails to compile at
         # T=8192 on one chip — docs/PERF.md kernel table).
         dict(batch=2, seq=8192, policy="gateup"),
+        # MoE A/B: iso-active dense bar, then capacity-einsum dispatch,
+        # then the dropless grouped-matmul kernels (ops/grouped_matmul.py).
+        dict(batch=8, seq=1024, policy="gateup", shape=iso_dense),
+        dict(batch=8, seq=1024, policy="gateup", shape=moe_shape,
+             experts=8, dispatch="einsum"),
+        dict(batch=8, seq=1024, policy="gateup", shape=moe_shape,
+             experts=8, dispatch="grouped"),
     ]
     results = []
     for g in grid:
+        s = g.get("shape", shape)
         r = run_subprocess([
             "--batch", g["batch"], "--seq", g["seq"], "--steps", steps,
             "--remat-policy", g["policy"],
             "--loss-chunks", g.get("chunks", 0),
+            "--experts", g.get("experts", 0),
+            "--moe-dispatch", g.get("dispatch", "einsum"),
+            "--attention", g.get("attention", "auto"),
             # Forward peak + model shape so per-point mfu_pct is computed
             # against the same values the artifact header records.
-            "--peak-tflops", peak, "--dim", shape["dim"],
-            "--layers", shape["layers"], "--heads", shape["heads"],
-            "--intermediate", shape["intermediate"],
+            "--peak-tflops", peak, "--dim", s["dim"],
+            "--layers", s["layers"], "--heads", s["heads"],
+            "--intermediate", s["intermediate"],
         ])
         r.setdefault("batch", g["batch"])
         r.setdefault("seq", g["seq"])
         r.setdefault("remat_policy", g["policy"])
         r.setdefault("loss_chunks", g.get("chunks", 0))
+        for key in ("experts", "dispatch", "attention"):
+            if g.get(key):
+                r.setdefault(key, g[key])
+        if "shape" in g:
+            r["shape"] = g["shape"]
         results.append(r)
         print(json.dumps(r), flush=True)
     ok = [r for r in results if "model_tflops" in r]
@@ -182,6 +207,10 @@ def main() -> int:
                    help="chunked cross-entropy (0 = dense logits)")
     p.add_argument("--experts", type=int, default=0, help="MoE experts (0=dense)")
     p.add_argument("--top-k", type=int, default=2)
+    p.add_argument("--moe-dispatch", default="einsum",
+                   choices=["einsum", "scatter", "grouped"])
+    p.add_argument("--attention", default="auto",
+                   choices=["auto", "flash", "xla"])
     p.add_argument("--peak-tflops", type=float, default=V5E_BF16_PEAK_TFLOPS)
     p.add_argument("--sweep", action="store_true",
                    help="run the config grid and write the JSON artifact")
@@ -194,7 +223,8 @@ def main() -> int:
     out = run(args.batch, args.seq, args.steps, args.dim, args.layers,
               args.heads, args.intermediate, args.remat_policy,
               args.peak_tflops, loss_chunks=args.loss_chunks,
-              experts=args.experts, top_k=args.top_k)
+              experts=args.experts, top_k=args.top_k,
+              moe_dispatch=args.moe_dispatch, attention=args.attention)
     print(json.dumps(out))
     return 0
 

@@ -3,8 +3,9 @@
 The reference's checker is a 27-LoC classifier (pkg/checker/checker.go); the
 north star asks for real health tracking with the TPU slice as the failure
 domain (BASELINE.json, SURVEY.md §5 "failure detection").  This module turns
-observed pods into a structured health report the updater, events, and CLI
-``describe`` all share.
+observed pods into a structured health report consumed by the updater (the
+READY condition's message, updater/status.py) and the CLI ``describe``
+Health section (cli/main.py:_describe_health).
 """
 
 from __future__ import annotations

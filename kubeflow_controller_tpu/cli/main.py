@@ -209,6 +209,7 @@ def cmd_describe(args) -> int:
         print(f"Replicas:  {rs.type.value}: state={rs.state.value} {hist}")
         for pn in rs.pod_names:
             print(f"           pod {pn}")
+    _describe_health(cluster, j, ns)
     try:
         events = [e for e in cluster.events.list(ns)
                   if e.involved_object.name == args.name]
@@ -219,6 +220,41 @@ def cmd_describe(args) -> int:
         for e in sorted(events, key=lambda e: e.first_timestamp):
             print(f"  {e.type:<8} {e.reason:<18} x{e.count}  {e.message}")
     return 0
+
+
+def _describe_health(cluster, job, ns: str) -> None:
+    """Per-replica/per-slice health (checker/health.py) from the job's
+    live pods — the slice is the TPU failure domain, so a gang with any
+    missing member reports Degraded as a whole."""
+    from ..api.labels import LABEL_JOB_TYPE, job_selector
+    from ..api.tfjob import ReplicaType
+    from ..checker import check_health
+
+    try:
+        all_pods = cluster.pods.list(ns)
+    except APIError:
+        return  # server lost mid-describe: skip the section
+    # Same selector the controller claims with (name + runtime_id): pods
+    # from a deleted same-named incarnation must not pollute the report.
+    want = job_selector(job.metadata.name, job.spec.runtime_id)
+    by_type = {}
+    for p in all_pods:
+        if any(p.metadata.labels.get(k) != v for k, v in want.items()):
+            continue
+        try:
+            typ = ReplicaType(p.metadata.labels.get(LABEL_JOB_TYPE))
+        except ValueError:
+            continue
+        by_type.setdefault(typ, []).append(p)
+    health = check_health(job, by_type)
+    print(f"Health:    {health.overall.value}")
+    for typ, rh in health.replicas.items():
+        missing = (f", missing indices {rh.missing_indices}"
+                   if rh.missing_indices else "")
+        print(f"  {typ.value}: {rh.health.value} "
+              f"({rh.running} running, {rh.waiting} waiting, "
+              f"{rh.succeeded} succeeded, {rh.failed} failed "
+              f"of {rh.desired}{missing})")
 
 
 def cmd_logs(args) -> int:

@@ -235,16 +235,17 @@ class RestWatcher:
 
     def __init__(self, transport: RestTransport, path: str,
                  params: Dict[str, str], cls: Type,
-                 connect_grace: float = 2.0):
+                 connect_grace: float = 5.0):
         self._transport = transport
         self._path = path
         self._params = params
         self._cls = cls
         # How long pre-connect failures are retried before they become
         # fatal: long enough to tolerate a concurrently-starting server
-        # (two-process mode binds its port within a few hundred ms), short
-        # enough that a down server surfaces an error in ~2 s instead of
-        # each informer eating a 10 s timeout serially (advisor round-2).
+        # even under parallel-test/CI load (process spawn can take seconds
+        # there — the same reality the kubelet-exec tests budget for),
+        # short enough that a down server surfaces an error in ~5 s instead
+        # of each informer eating a 10 s timeout serially (advisor round-2).
         self._connect_grace = connect_grace
         self.queue: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
         self._stopped = threading.Event()

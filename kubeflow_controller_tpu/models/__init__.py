@@ -23,8 +23,10 @@ from .mnist import (
 from .llama import (
     LlamaConfig,
     llama_forward,
+    llama_forward_pp,
     llama_init,
     llama_loss,
+    llama_loss_and_grads_pp,
     llama_param_logical_axes,
     llama_param_pspecs,
 )
@@ -40,8 +42,10 @@ __all__ = [
     "softmax_init",
     "LlamaConfig",
     "llama_forward",
+    "llama_forward_pp",
     "llama_init",
     "llama_loss",
+    "llama_loss_and_grads_pp",
     "llama_param_logical_axes",
     "llama_param_pspecs",
     "forward_with_cache",

@@ -59,8 +59,9 @@ class LlamaConfig:
     # coefficient (Switch uses 1e-2) and ST-MoE router z-loss coefficient.
     moe_aux_coef: float = 1e-2
     moe_z_coef: float = 1e-3
-    # Routing implementation: "einsum" (k-folded one-hot; TPU winner) or
-    # "scatter" (cheap-scatter backends) — see moe.moe_ffn_stats.
+    # Routing implementation: "einsum" (k-folded one-hot; the mesh path),
+    # "scatter" (cheap-scatter backends), or "grouped" (dropless
+    # grouped-matmul Pallas kernels; single-shard) — see moe.moe_ffn_stats.
     moe_dispatch: str = "einsum"
     # Remat policy — the FLOPs/HBM dial for the backward pass:
     #   "full":    save only layer boundaries; recompute everything (~8ND
@@ -176,7 +177,14 @@ def llama_param_logical_axes(cfg: LlamaConfig) -> Params:
             "w_down": ("layers", "mlp", "embed"),
         }
     return {
-        "embed": ("vocab", "embed"),
+        # Megatron-style vocab-parallel table: the INDEXED dim is sharded
+        # (SPMD partitions a gather over the operand's indexed dim cleanly
+        # with its mask+psum rewrite), the feature dim replicated.  Sharding
+        # the feature dim instead propagates a D-sharding onto the gather
+        # output that conflicts with the batch-sharded activation constraint
+        # and forces SPMD's "involuntary full rematerialization"
+        # (replicate-then-partition) fallback — the r2 dryrun warning.
+        "embed": ("vocab", None),
         "layers": {
             "attn_norm": ("layers", None),
             "wq": ("layers", "embed", "heads", "head_dim"),
@@ -420,6 +428,7 @@ def ffn_block_stats(h: jax.Array, lp, cfg: LlamaConfig,
         h, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
         top_k=cfg.moe_top_k, capacity_factor=cfg.capacity_factor,
         rules=rules, dispatch=cfg.moe_dispatch,
+        save_names=cfg.remat_policy in ("ffn", "gateup", "gateup_attn"),
     )
 
 
@@ -496,16 +505,26 @@ def llama_forward_pp(
     layer = _decoder_layer_fn(cfg, angles, None, rules)
     layer_fn = _maybe_remat(layer, cfg)
 
-    def stage_fn(stage_layers, xm):
-        out, aux = jax.lax.scan(lambda c, lp: layer_fn(c, lp), xm, stage_layers)
-        # Per-stage sums of the per-layer router stats; gpipe sums them
-        # over stages and microbatches, the caller normalizes to means.
-        return out, jax.tree.map(lambda v: jnp.sum(v), aux)
+    if return_aux:
+        def stage_fn(stage_layers, xm):
+            out, aux = jax.lax.scan(lambda c, lp: layer_fn(c, lp), xm, stage_layers)
+            # Per-stage sums of the per-layer router stats; gpipe sums them
+            # over stages and microbatches, the caller normalizes to means.
+            return out, jax.tree.map(lambda v: jnp.sum(v), aux)
+    else:
+        # Aux dropped at the stage boundary: accumulating it through the
+        # fori_loop carry is not free (loop-carried values can't be DCE'd).
+        def stage_fn(stage_layers, xm):
+            out, _ = jax.lax.scan(
+                lambda c, lp: (layer_fn(c, lp)[0], None), xm, stage_layers)
+            return out
 
     S = mesh.shape[AXIS_PIPELINE]
     stages = split_stages(params["layers"], S)
     micro = x.reshape(n_microbatches, B // n_microbatches, T, -1)
-    out, aux_sums = gpipe(stage_fn, stages, micro, mesh, stage_aux=True)
+    out = gpipe(stage_fn, stages, micro, mesh, stage_aux=return_aux)
+    if return_aux:
+        out, aux_sums = out
     x = out.reshape(B, T, -1)
 
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)

@@ -63,8 +63,14 @@ def moe_ffn_stats(
     capacity: int = 0,
     rules: ShardingRules = DEFAULT_RULES,
     dispatch: str = "einsum",
+    save_names: bool = False,
 ):
     """x [B, T, D]; router_w [D, E]; w_gate/w_up [E, D, F]; w_down [E, F, D].
+
+    ``save_names``: insert ``checkpoint_name`` markers ("ffn_gate"/
+    "ffn_up") on the grouped path's matmul outputs so the named remat
+    policies can save them; only set when the active policy consumes the
+    names (an unused name_p marker blocks XLA fusions — docs/PERF.md).
 
     Returns ``(y [B, T, D], stats)``.  Capacity per expert C = ceil(T *
     top_k / E * capacity_factor) unless ``capacity`` pins it explicitly;
@@ -87,18 +93,25 @@ def moe_ffn_stats(
     - ``overflow_frac`` — fraction of routing slots dropped by the capacity
       limit (not differentiable; a monitoring signal for capacity_factor).
 
-    ``dispatch`` selects the routing implementation — both compute the
-    SAME function (same capacity/drop semantics, tested equal):
+    ``dispatch`` selects the routing implementation:
 
-    - ``"einsum"`` (default): one-hot dispatch/combine tensors [B,T,E,C]
-      with the k axis folded away before the one-hot (a token routes to at
-      most one slot per expert) — all MXU-shaped dense math, the measured
-      winner on TPU.
+    - ``"einsum"``: one-hot dispatch/combine tensors [B,T,E,C] with the k
+      axis folded away before the one-hot (a token routes to at most one
+      slot per expert) — all MXU-shaped dense math; the mesh-sharded path
+      (ep/dp constraints drive XLA's collectives).
     - ``"scatter"``: tokens scatter-add into the expert buffers and gather
       back by slot index — O(B·T·k·D) data movement on paper, but TPU
       scatters serialize: measured 15% SLOWER than the einsum path at
       653M/E8 on v5e (docs/PERF.md).  Kept for backends where scatters
       are cheap.
+    - ``"grouped"``: megablocks-style — tokens sorted by expert into a
+      group-aligned layout and run through grouped-matmul Pallas kernels
+      (ops/grouped_matmul.py).  DROPLESS: capacity does not apply
+      (overflow_frac == 0); matches :func:`moe_ffn_reference`.  Falls back
+      to "einsum" (one warning) when it cannot run: under an active mesh
+      (the sharded path needs the einsum formulation's constraints), or at
+      shapes below the TPU tiling grain (D/F not multiples of 128, or
+      B*T*k not a multiple of 8).
     """
     import math
 
@@ -110,14 +123,36 @@ def moe_ffn_stats(
     logits = jnp.einsum("btd,de->bte", x, router_w.astype(dtype)).astype(jnp.float32)
     probs, idx = router_topk(logits, top_k)           # [B,T,k]
 
+    grouped = dispatch == "grouped"
+    if grouped:
+        from ..parallel.sharding import _mesh_parallel_in_scope
+
+        F = w_gate.shape[-1]
+        why = ""
+        if _mesh_parallel_in_scope():
+            why = "an active mesh (single-shard only)"
+        elif D % 128 or F % 128:
+            why = f"dims not multiples of 128 (D={D}, F={F})"
+        elif (B * T * top_k) % 8:
+            why = f"B*T*k = {B * T * top_k} not a multiple of 8"
+        if why:
+            import warnings
+
+            warnings.warn(
+                f"moe dispatch='grouped' cannot run under {why}; falling "
+                "back to 'einsum'", stacklevel=2)
+            grouped, dispatch = False, "einsum"
+
     # One-hot expert assignment per routing slot: [B, T, k, E].
     assign = jax.nn.one_hot(idx, E, dtype=jnp.float32)
-    # Position of each (token, slot) inside its expert's buffer, counted in
-    # routing order over the flattened (T, k) axis: [B, T, k, E].
-    flat = assign.reshape(B, T * top_k, E)
-    pos_flat = jnp.cumsum(flat, axis=1) - flat        # exclusive cumsum
-    pos = pos_flat.reshape(B, T, top_k, E)
-    keep = (pos < C) * assign                         # drop overflow
+    if not grouped:
+        # Position of each (token, slot) inside its expert's capacity
+        # buffer, counted in routing order over the flattened (T, k) axis:
+        # [B, T, k, E].  The grouped path is dropless — no capacity math.
+        flat = assign.reshape(B, T * top_k, E)
+        pos_flat = jnp.cumsum(flat, axis=1) - flat    # exclusive cumsum
+        pos = pos_flat.reshape(B, T, top_k, E)
+        keep = (pos < C) * assign                     # drop overflow
 
     def expert_ffn(xe):
         """xe [B, E, C, D] -> [B, E, C, D], expert dim sharded over ep."""
@@ -128,7 +163,11 @@ def moe_ffn_stats(
         ye = jnp.einsum("becf,efd->becd", h, w_down.astype(dtype))
         return with_logical_constraint(ye, ("batch", "expert", None, None), rules)
 
-    if dispatch == "scatter":
+    if grouped:
+        y = _grouped_ffn(x, probs, idx, w_gate.astype(dtype),
+                         w_up.astype(dtype), w_down.astype(dtype),
+                         save_names=save_names)
+    elif dispatch == "scatter":
         S = T * top_k
         # Per routing slot: its expert, its buffer position, kept or not.
         slot_e = idx.reshape(B, S)                                  # [B,S]
@@ -177,12 +216,146 @@ def moe_ffn_stats(
     p = jnp.mean(full_probs, axis=(0, 1))             # [E]
     aux_loss = E * jnp.sum(f * p)
     z_loss = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
-    n_assigned = jnp.sum(assign)
-    overflow_frac = jax.lax.stop_gradient(
-        1.0 - jnp.sum(keep) / jnp.maximum(n_assigned, 1.0))
+    if grouped:
+        overflow_frac = jnp.float32(0)               # dropless by design
+    else:
+        n_assigned = jnp.sum(assign)
+        overflow_frac = jax.lax.stop_gradient(
+            1.0 - jnp.sum(keep) / jnp.maximum(n_assigned, 1.0))
     stats = {"aux_loss": aux_loss, "z_loss": z_loss,
              "overflow_frac": overflow_frac}
     return y, stats
+
+
+def _grouped_ffn(x, probs, idx, w_gate, w_up, w_down, block_m: int = 128,
+                 save_names: bool = False):
+    """Dropless expert FFN via grouped-matmul kernels.
+
+    Layout construction (all index math; the only O(tokens·D) data moves
+    are two row GATHERS — no TPU scatters of vectors anywhere, forward or
+    backward):
+
+    1. Flatten routing slots ([B,T,k] -> N), stable-sort by expert.
+    2. Lay each expert's slots into a *group-aligned* region: expert e's
+       rows start at a block_m-aligned offset, so every block_m-row tile
+       belongs to exactly one expert — the contract of ops/grouped_matmul.
+       Static padded length M = N + E·block_m (≤ 3-6% waste at bench
+       shapes); pad rows read a zero row and are never read back.
+    3. Gather tokens into the layout, run gate/up/down as grouped matmuls,
+       gather each slot's result back, combine weighted by router probs.
+
+    The gathers are bijections (plus a sentinel zero row), so their VJPs
+    are expressed as gathers of the cotangent via the inverse index maps
+    (_dispatch_rows/_combine_rows) instead of jax's default scatter-add.
+    """
+    from ..ops.grouped_matmul import gmm
+
+    B, T, D = x.shape
+    E = w_gate.shape[0]
+    k = idx.shape[-1]
+    n_tok = B * T
+    n_slots = n_tok * k
+    bm = block_m
+    while n_slots % bm:
+        bm //= 2
+    assert bm >= 8, f"caller must guarantee 8 | B*T*k (got {n_slots})"
+    h_flat = x.reshape(n_tok, D)
+
+    slot_expert = idx.reshape(n_slots)
+    sort_idx = jnp.argsort(slot_expert)               # stable: slot order kept
+    sorted_experts = jnp.take(slot_expert, sort_idx)
+    counts = jnp.sum(jax.nn.one_hot(slot_expert, E, dtype=jnp.int32), axis=0)
+    group_start = jnp.cumsum(counts) - counts
+    padded_counts = ((counts + bm - 1) // bm) * bm
+    pad_offsets = jnp.cumsum(padded_counts) - padded_counts
+    M = n_slots + E * bm                              # static upper bound
+
+    # Destination row of sorted slot j inside the aligned layout.
+    rank = jnp.arange(n_slots) - jnp.take(group_start, sorted_experts)
+    dest = (jnp.take(pad_offsets, sorted_experts) + rank).astype(jnp.int32)
+    # Which expert owns each row tile (tiles past the last group clamp to
+    # E-1 and compute garbage nobody reads).
+    ends = pad_offsets + padded_counts
+    tile_experts = jnp.searchsorted(
+        ends, jnp.arange(M // bm) * bm, side="right").astype(jnp.int32)
+    tile_experts = jnp.minimum(tile_experts, E - 1)
+
+    # Inverse maps (1-D int scatters — cheap).  Sentinels point at the
+    # appended zero row.
+    token_of_sorted = (sort_idx // k).astype(jnp.int32)
+    inv_src = jnp.full((M,), n_tok, jnp.int32).at[dest].set(token_of_sorted)
+    slot_dest = jnp.zeros((n_slots,), jnp.int32).at[sort_idx].set(dest)
+    inv_pos = jnp.full((M,), n_slots, jnp.int32).at[dest].set(
+        sort_idx.astype(jnp.int32))
+
+    if save_names:
+        from jax.ad_checkpoint import checkpoint_name
+    else:
+        def checkpoint_name(v, _):
+            return v
+
+    x_pad = _dispatch_rows(h_flat, inv_src, slot_dest.reshape(n_tok, k))
+    gate = checkpoint_name(gmm(x_pad, w_gate, tile_experts, bm), "ffn_gate")
+    up = checkpoint_name(gmm(x_pad, w_up, tile_experts, bm), "ffn_up")
+    hh = jax.nn.silu(gate) * up
+    y_pad = checkpoint_name(gmm(hh, w_down, tile_experts, bm), "ffn_down")
+    y_slot = _combine_rows(y_pad, slot_dest, inv_pos)     # [N, D]
+    return jnp.einsum("btk,btkd->btd", probs.astype(x.dtype),
+                      y_slot.reshape(B, T, k, D))
+
+
+@jax.custom_vjp
+def _dispatch_rows(h, inv_src, slot_dest2d):
+    """[n_tok, D] -> [M, D]: row p = h[inv_src[p]] (sentinel -> zero row).
+    VJP: dh[t] = sum over t's k slots of dy[slot_dest2d[t, :]] — gathers
+    via the inverse map instead of a scatter-add."""
+    h_pad = jnp.concatenate([h, jnp.zeros((1, h.shape[1]), h.dtype)], axis=0)
+    return jnp.take(h_pad, inv_src, axis=0)
+
+
+def _float0(shape):
+    import numpy as np
+
+    return np.zeros(shape, dtype=jax.dtypes.float0)
+
+
+def _dispatch_rows_fwd(h, inv_src, slot_dest2d):
+    return (_dispatch_rows(h, inv_src, slot_dest2d),
+            (slot_dest2d, inv_src.shape))
+
+
+def _dispatch_rows_bwd(res, dy):
+    slot_dest2d, inv_src_shape = res
+    k = slot_dest2d.shape[1]
+    dh = jnp.take(dy, slot_dest2d[:, 0], axis=0)
+    for j in range(1, k):
+        dh = dh + jnp.take(dy, slot_dest2d[:, j], axis=0)
+    return dh, _float0(inv_src_shape), _float0(slot_dest2d.shape)
+
+
+_dispatch_rows.defvjp(_dispatch_rows_fwd, _dispatch_rows_bwd)
+
+
+@jax.custom_vjp
+def _combine_rows(y_pad, slot_dest, inv_pos):
+    """[M, D] -> [N, D]: slot s reads y_pad[slot_dest[s]].
+    VJP: dy_pad[p] = d[inv_pos[p]] (sentinel -> zero) — the mapping is a
+    bijection on real rows, so the cotangent is a gather too."""
+    return jnp.take(y_pad, slot_dest, axis=0)
+
+
+def _combine_rows_fwd(y_pad, slot_dest, inv_pos):
+    return _combine_rows(y_pad, slot_dest, inv_pos), (inv_pos, slot_dest.shape)
+
+
+def _combine_rows_bwd(res, d):
+    inv_pos, slot_dest_shape = res
+    d_pad = jnp.concatenate([d, jnp.zeros((1, d.shape[1]), d.dtype)], axis=0)
+    return (jnp.take(d_pad, inv_pos, axis=0), _float0(slot_dest_shape),
+            _float0(inv_pos.shape))
+
+
+_combine_rows.defvjp(_combine_rows_fwd, _combine_rows_bwd)
 
 
 def moe_ffn_reference(x, router_w, w_gate, w_up, w_down, *, top_k: int = 2):

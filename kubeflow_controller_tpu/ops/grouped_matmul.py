@@ -1,0 +1,197 @@
+"""Grouped (per-expert) matmul as Pallas TPU kernels — the MoE hot path.
+
+The capacity-dispatch einsum path (models/moe.py) pays O(B·T·E·C·D) FLOPs
+in its one-hot dispatch/combine tensors — measured 41 ms/step of pure
+routing tax at 653M/E8 on v5e (docs/PERF.md).  This module removes it the
+megablocks way: tokens are sorted by expert into a *group-aligned* row
+layout (every ``bm``-row tile belongs to exactly one expert), and the
+expert FFN becomes three grouped matmuls that keep the MXU fed:
+
+- ``gmm(lhs [M,K], rhs [E,K,N], tile_experts) -> [M,N]`` — each row tile i
+  is multiplied by ``rhs[tile_experts[i]]``.  The expert id per tile is a
+  scalar-prefetch array, so the correct expert's weight tile is DMA'd
+  while the previous tile computes — no gather of weights, no one-hot.
+- ``tgmm(lhs [M,K], dout [M,N], tile_experts, E) -> [E,K,N]`` — the weight
+  gradient: per-expert ``lhs_eᵀ @ dout_e``.  The m dimension is innermost
+  in the grid, so all tiles of one expert visit an output block
+  consecutively and accumulate in VMEM scratch.
+
+``gmm`` carries a custom VJP (dlhs = gmm against rhsᵀ; drhs = tgmm), so
+the whole MoE FFN trains through these kernels.
+
+Group alignment (each tile single-expert) costs ≤ E·(bm-1) padding rows —
+~3-6% at the benchmark shapes with bm=128 and balanced routing — and buys
+a kernel with no boundary masking at all; the padding rows read a zero row
+and their outputs are never gathered back (models/moe.py:_grouped_ffn).
+
+The reference has no MoE and no kernels (SURVEY.md §2.4); net-new.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _pick_block(dim: int, want: int) -> int:
+    """Largest power-of-two block <= want that divides dim (>= 128 when
+    possible — MXU/lane alignment)."""
+    b = want
+    while b > 128 and dim % b:
+        b //= 2
+    if dim % b:
+        raise ValueError(f"dimension {dim} not divisible by any block <= {want}")
+    return b
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# gmm: out[i*bm:(i+1)*bm] = lhs[i*bm:(i+1)*bm] @ rhs[tile_experts[i]]
+# ---------------------------------------------------------------------------
+
+def _gmm_kernel(te_ref, lhs_ref, rhs_ref, out_ref, acc_ref):
+    @pl.when(pl.program_id(2) == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jnp.dot(lhs_ref[...], rhs_ref[0],
+                            preferred_element_type=jnp.float32)
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    def _():
+        out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+
+
+def _gmm_fwd_impl(lhs, rhs, tile_experts, bm, bn, bk):
+    M, K = lhs.shape
+    E, K2, N = rhs.shape
+    assert K == K2, (K, K2)
+    assert M % bm == 0 and tile_experts.shape == (M // bm,)
+    bn = _pick_block(N, bn)
+    bk = _pick_block(K, bk)
+    grid = (M // bm, N // bn, K // bk)
+    return pl.pallas_call(
+        _gmm_kernel,
+        out_shape=jax.ShapeDtypeStruct((M, N), lhs.dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((bm, bk), lambda i, j, k, te: (i, k)),
+                pl.BlockSpec((1, bk, bn), lambda i, j, k, te: (te[i], k, j)),
+            ],
+            out_specs=pl.BlockSpec((bm, bn), lambda i, j, k, te: (i, j)),
+            scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(tile_experts, lhs, rhs)
+
+
+# ---------------------------------------------------------------------------
+# tgmm: out[e] = sum over tiles i with tile_experts[i]==e of lhs_iᵀ @ dout_i
+# ---------------------------------------------------------------------------
+
+def _tgmm_kernel(te_ref, lhs_ref, dout_ref, out_ref, acc_ref):
+    m = pl.program_id(2)
+    first_of_expert = jnp.logical_or(
+        m == 0, te_ref[jnp.maximum(m, 1) - 1] != te_ref[m])
+
+    @pl.when(first_of_expert)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jnp.dot(lhs_ref[...].T, dout_ref[...],
+                            preferred_element_type=jnp.float32)
+    # Write-through every step: the last tile of the expert leaves the
+    # complete sum in the block before the revisit sequence ends.
+    out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+
+
+def _tgmm_impl(lhs, dout, tile_experts, n_experts, bm, bkk, bn):
+    """[E, K, N] with out[e] = lhsᵀ_e @ dout_e.  Row tiles of one expert
+    are consecutive (group-aligned layout), and m is the innermost grid
+    dim, so each output block's revisit run covers exactly its expert's
+    tiles."""
+    M, K = lhs.shape
+    M2, N = dout.shape
+    assert M == M2
+    bkk = _pick_block(K, bkk)
+    bn = _pick_block(N, bn)
+    grid = (K // bkk, N // bn, M // bm)
+    out = pl.pallas_call(
+        _tgmm_kernel,
+        out_shape=jax.ShapeDtypeStruct((n_experts, K, N), jnp.float32),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((bm, bkk), lambda k, n, m, te: (m, k)),
+                pl.BlockSpec((bm, bn), lambda k, n, m, te: (m, n)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, bkk, bn), lambda k, n, m, te: (te[m], k, n)),
+            scratch_shapes=[pltpu.VMEM((1, bkk, bn), jnp.float32)],
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(tile_experts, lhs, dout)
+    # Experts with zero tiles are never visited; their blocks are garbage.
+    visited = jnp.zeros((n_experts,), jnp.bool_).at[tile_experts].set(True)
+    return jnp.where(visited[:, None, None], out, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Differentiable gmm
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def gmm(lhs, rhs, tile_experts, bm: int = 128, bn: int = 512, bk: int = 512):
+    """Grouped matmul: row tile i of ``lhs`` is multiplied by
+    ``rhs[tile_experts[i]]``.
+
+    lhs [M, K] (M % bm == 0), rhs [E, K, N], tile_experts [M//bm] int32 in
+    [0, E).  Rows must be grouped so each bm-row tile belongs to one
+    expert (models/moe.py builds this layout).  Differentiable in lhs and
+    rhs; tile_experts is index data.
+    """
+    return _gmm_fwd_impl(lhs, rhs, tile_experts, bm, bn, bk)
+
+
+def _gmm_fwd(lhs, rhs, tile_experts, bm, bn, bk):
+    return _gmm_fwd_impl(lhs, rhs, tile_experts, bm, bn, bk), (
+        lhs, rhs, tile_experts)
+
+
+def _gmm_bwd(bm, bn, bk, res, dout):
+    lhs, rhs, tile_experts = res
+    # dlhs: same grouped matmul against rhsᵀ (contract over N).
+    dlhs = _gmm_fwd_impl(dout, rhs.transpose(0, 2, 1), tile_experts,
+                         bm, bn, bk)
+    # drhs: per-expert lhsᵀ @ dout.
+    drhs = _tgmm_impl(lhs, dout, tile_experts, rhs.shape[0], bm, bk, bn)
+    zeros_int = np.zeros(tile_experts.shape, dtype=jax.dtypes.float0)
+    return dlhs.astype(lhs.dtype), drhs.astype(rhs.dtype), zeros_int
+
+
+gmm.defvjp(_gmm_fwd, _gmm_bwd)
+
+
+def gmm_reference(lhs, rhs, tile_experts, bm: int = 128):
+    """Dense oracle for tests: per-tile jnp matmul against the tile's
+    expert weights."""
+    M, K = lhs.shape
+    tiles = lhs.reshape(M // bm, bm, K)
+    picked = rhs[tile_experts]                       # [tiles, K, N]
+    return jnp.einsum("tmk,tkn->tmn", tiles, picked).reshape(M, -1)

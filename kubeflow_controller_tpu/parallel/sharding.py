@@ -45,7 +45,11 @@ DEFAULT_RULES = ShardingRules(rules=(
     ("kv_heads", AXIS_TENSOR),
     ("head_dim", None),
     ("mlp", AXIS_TENSOR),
-    ("vocab", AXIS_TENSOR),
+    # vocab claims tp first (megatron vocab-parallel lm_head/table), and
+    # falls back to fsdp so the 0.5GB-scale table + optimizer moments stay
+    # ZeRO-sharded on tp=1 fsdp-only meshes.  On activations ("batch",...,
+    # "vocab") batch already holds fsdp, so logits stay tp-sharded only.
+    ("vocab", (AXIS_TENSOR, AXIS_FSDP)),
     ("expert", AXIS_EXPERT),
     ("layers", None),
 ))
@@ -111,6 +115,22 @@ def with_logical_constraint(x, logical_axes, rules: ShardingRules = DEFAULT_RULE
     if not _mesh_axes_in_scope():
         return x
     return jax.lax.with_sharding_constraint(x, logical_to_pspec(logical_axes, rules))
+
+
+def _mesh_parallel_in_scope() -> bool:
+    """True when an active mesh has an axis of size > 1 (actual SPMD).
+    A size-1 mesh (e.g. single-chip runs under jax.set_mesh) behaves like
+    single-device for kernel-path selection."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is not None and mesh.axis_names:
+        return any(mesh.shape[a] > 1 for a in mesh.axis_names)
+    try:  # legacy physical-mesh context (private API, best effort)
+        from jax._src import mesh as _mesh_lib
+
+        pm = _mesh_lib.thread_resources.env.physical_mesh
+        return bool(pm.axis_names) and any(s > 1 for s in pm.shape.values())
+    except Exception:
+        return False
 
 
 def _mesh_axes_in_scope() -> bool:

@@ -203,11 +203,22 @@ def compute_status(
     status.phase = phase
 
     # -- conditions (populating types.go:154-161) --
+    # The READY message carries the structured health report (checker/
+    # health.py) so `describe` and the status surface tell one story.
+    from ..checker import check_health
+
+    health = check_health(job, pods_by_type)
+    health_msg = "; ".join(
+        f"{t.value}={rh.health.value} {rh.running}/{rh.desired} running"
+        + (f", missing {rh.missing_indices}" if rh.missing_indices else "")
+        for t, rh in health.replicas.items()
+    )
     terminal = phase in (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED)
     set_condition(status, TFJobConditionType.SCHEDULED, scheduled,
                   reason="AllReplicasScheduled" if scheduled else "WaitingForReplicas", now=now)
     set_condition(status, TFJobConditionType.READY, ready and not terminal,
-                  reason="AllReplicasReady" if ready else "ReplicasNotReady", now=now)
+                  reason="AllReplicasReady" if ready else "ReplicasNotReady",
+                  message=health_msg, now=now)
     set_condition(status, TFJobConditionType.RECOVERING, recovering,
                   reason="ReplacingFailedReplicas" if recovering else "", now=now)
     has_active = any(

@@ -30,6 +30,21 @@ def main(argv=None) -> int:
     p.add_argument("--fsdp", type=int, default=-1)
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline stages; > 1 trains with the 1F1B schedule "
+                        "(n_layers must divide evenly)")
+    p.add_argument("--microbatches", type=int, default=4,
+                   help="pipeline microbatches per step when --pp > 1")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel mesh extent (MoE experts shard over it)")
+    p.add_argument("--experts", type=int, default=0,
+                   help="MoE expert count (0 = dense FFN)")
+    p.add_argument("--top-k", type=int, default=2, help="MoE router top-k")
+    p.add_argument("--moe-dispatch", choices=["einsum", "scatter", "grouped"],
+                   default="einsum",
+                   help="MoE routing implementation; 'grouped' = dropless "
+                        "grouped-matmul kernels (single-shard; falls back "
+                        "to einsum under a >1-device mesh)")
     p.add_argument("--sp-attention", choices=["ring", "ulysses"], default="ring",
                    help="sequence-parallel attention schedule when --sp > 1")
     p.add_argument("--checkpoint-every", type=int, default=0)
@@ -68,11 +83,20 @@ def main(argv=None) -> int:
     cfg = LlamaConfig.llama2_7b() if args.preset == "llama2-7b" else LlamaConfig.tiny(
         max_seq_len=args.seq_len
     )
+    overrides = {}
     if args.sp_attention != cfg.sp_attention:
+        overrides["sp_attention"] = args.sp_attention
+    if args.experts:
+        overrides.update(n_experts=args.experts, moe_top_k=args.top_k,
+                         moe_dispatch=args.moe_dispatch)
+    if overrides:
         import dataclasses
 
-        cfg = dataclasses.replace(cfg, sp_attention=args.sp_attention)
-    mesh = build_mesh(MeshSpec(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp))
+        cfg = dataclasses.replace(cfg, **overrides)
+    if args.pp > 1 and cfg.n_layers % args.pp:
+        p.error(f"--pp {args.pp} does not divide n_layers {cfg.n_layers}")
+    mesh = build_mesh(MeshSpec(dp=args.dp, fsdp=args.fsdp, tp=args.tp,
+                               sp=args.sp, pp=args.pp, ep=args.ep))
     pspecs = llama_param_pspecs(cfg)
 
     with jax.set_mesh(mesh):
@@ -98,21 +122,40 @@ def main(argv=None) -> int:
         batch_spec = logical_to_pspec(("batch", "seq"))
         batch_sharding = NamedSharding(mesh, batch_spec)
 
-        def loss_fn(p, tokens):
-            return llama_loss(p, tokens, cfg, mesh=mesh)
+        if args.pp > 1:
+            # 1F1B fused forward/backward pipeline schedule — activations
+            # ring-buffered per stage, so peak memory is independent of the
+            # microbatch count (parallel/pipeline.py:pipeline_1f1b).  MoE
+            # router aux losses thread through the schedule as per-stage
+            # penalties.
+            from ..models import llama_loss_and_grads_pp
 
-        @jax.jit
-        def step_fn(p, s, tokens):
-            loss, grads = jax.value_and_grad(loss_fn)(p, tokens)
-            updates, s = opt.update(grads, s, p)
-            p = optax.apply_updates(p, updates)
-            return p, s, loss
+            @jax.jit
+            def step_fn(p, s, tokens):
+                loss, grads = llama_loss_and_grads_pp(
+                    p, tokens, cfg, mesh, n_microbatches=args.microbatches)
+                updates, s = opt.update(grads, s, p)
+                p = optax.apply_updates(p, updates)
+                return p, s, loss
+        else:
+            def loss_fn(p, tokens):
+                return llama_loss(p, tokens, cfg, mesh=mesh)
 
-        # Global batch must be divisible by the data-parallel extent.
+            @jax.jit
+            def step_fn(p, s, tokens):
+                loss, grads = jax.value_and_grad(loss_fn)(p, tokens)
+                updates, s = opt.update(grads, s, p)
+                p = optax.apply_updates(p, updates)
+                return p, s, loss
+
+        # Global batch must be divisible by the data-parallel extent; under
+        # the pipeline schedule each MICROBATCH must itself shard evenly
+        # over the data axes, so the unit is dp_size * microbatches.
         from ..parallel.mesh import data_parallel_size
 
         dp_size = data_parallel_size(mesh)
-        bs = max(dp_size, args.batch_size - args.batch_size % dp_size)
+        unit = dp_size * args.microbatches if args.pp > 1 else dp_size
+        bs = max(unit, args.batch_size - args.batch_size % unit)
         tokens_all = d.synthetic_tokens(
             jax.random.PRNGKey(1), max(64, 2 * bs), args.seq_len, cfg.vocab_size
         )

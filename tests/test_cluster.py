@@ -197,7 +197,10 @@ def test_kubelet_executes_real_process_with_env():
         pod = mk_pod("runner", command=[sys.executable, "-c", "import os,sys; sys.exit(0 if os.environ.get('TASK_INDEX')=='3' else 1)"])
         pod.spec.containers[0].env.append(EnvVar(name="TASK_INDEX", value="3"))
         c.pods.create(pod)
-        wait_for(lambda: c.pods.get("default", "runner").status.phase == PHASE_SUCCEEDED)
+        # Subprocess spawn can take seconds under parallel-test load;
+        # the default 5s window flakes.
+        wait_for(lambda: c.pods.get("default", "runner").status.phase == PHASE_SUCCEEDED,
+                 timeout=30.0)
     finally:
         kubelet.stop()
 

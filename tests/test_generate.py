@@ -58,6 +58,39 @@ class TestKVCache:
             cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
 
+    def test_blocked_cache_reads_match_dense_path(self):
+        """The length-masked blocked read (_cache_attention_blocked) must
+        reproduce the full-S masked read at every step, including prefill
+        spanning several blocks and steps mid-block."""
+        cfg, params = setup()
+        T = 11
+        tokens = jax.random.randint(jax.random.PRNGKey(4), (2, T), 0, cfg.vocab_size)
+        S = 16  # 4 blocks of 4
+        cache_b = init_cache(cfg, 2, S)
+        cache_d = init_cache(cfg, 2, S)
+        # Prefill 6 tokens (crosses a block edge), then single-token steps.
+        lb, cache_b = forward_with_cache(params, tokens[:, :6], cache_b, 0,
+                                         cfg, kv_block=4)
+        ld, cache_d = forward_with_cache(params, tokens[:, :6], cache_d, 0,
+                                         cfg, kv_block=S)  # S == block -> dense
+        np.testing.assert_allclose(np.asarray(lb), np.asarray(ld),
+                                   atol=2e-4, rtol=2e-4)
+        for t in range(6, T):
+            lb, cache_b = forward_with_cache(params, tokens[:, t:t + 1],
+                                             cache_b, t, cfg, kv_block=4)
+            ld, cache_d = forward_with_cache(params, tokens[:, t:t + 1],
+                                             cache_d, t, cfg, kv_block=S)
+            np.testing.assert_allclose(np.asarray(lb), np.asarray(ld),
+                                       atol=2e-4, rtol=2e-4)
+
+    def test_blocked_generate_matches_default(self):
+        cfg, params = setup()
+        prompt = jax.random.randint(jax.random.PRNGKey(8), (2, 5), 0,
+                                    cfg.vocab_size)
+        ref = generate(params, prompt, cfg, max_new_tokens=7)
+        out = generate(params, prompt, cfg, max_new_tokens=7, kv_block=4)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
     def test_sampled_generate_shape_and_determinism(self):
         cfg, params = setup()
         prompt = jnp.zeros((2, 3), jnp.int32)
@@ -113,6 +146,23 @@ class TestShardedDecode:
         with jax.set_mesh(mesh):
             out = jax.jit(
                 lambda p, t: generate(p, t, cfg, max_new_tokens=6)
+            )(sharded, prompt)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_sharded_blocked_decode_matches_unsharded(self):
+        """Blocked cache reads under tp/dp sharding (the production decode
+        layout) must still match the unsharded result."""
+        from kubeflow_controller_tpu.parallel import MeshSpec, build_mesh
+
+        cfg, params = setup()
+        prompt = jax.random.randint(jax.random.PRNGKey(9), (4, 6), 0,
+                                    cfg.vocab_size)
+        ref = generate(params, prompt, cfg, max_new_tokens=6)
+        mesh = build_mesh(MeshSpec(dp=2, tp=2, fsdp=2))
+        sharded = self._sharded(cfg, params, mesh)
+        with jax.set_mesh(mesh):
+            out = jax.jit(
+                lambda p, t: generate(p, t, cfg, max_new_tokens=6, kv_block=4)
             )(sharded, prompt)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 

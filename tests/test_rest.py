@@ -406,6 +406,10 @@ class TestCLIGetDescribe:
         text = out.getvalue()
         assert "Phase:     Succeeded" in text
         assert "SuccessfulCreate" in text  # events came from the API
+        # The per-replica health report (checker/health.py) renders from
+        # the job's live pods.
+        assert "Health:    Complete" in text
+        assert "Worker: Complete" in text
 
     def test_describe_missing_job(self, server):
         from kubeflow_controller_tpu.cli.main import main as cli_main

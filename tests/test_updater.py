@@ -41,6 +41,8 @@ def test_running_then_succeeded_workers_ps_ignored():
     st = compute_status(job, pods)
     assert st.phase == TFJobPhase.RUNNING
     assert cond(st, TFJobConditionType.READY).status == "True"
+    # The READY message carries the per-replica health report.
+    assert "Worker=Healthy 2/2 running" in cond(st, TFJobConditionType.READY).message
     # All workers done; PS still running -> Succeeded (ref: distributed.go:51-55).
     pods[ReplicaType.WORKER] = [
         mk_pod(job, ReplicaType.WORKER, i, PHASE_SUCCEEDED) for i in range(2)
