@@ -23,10 +23,13 @@ both values and grads.
 
 The model layer (models/llama.py:_attention) selects this kernel on TPU at
 T >= 1024.  Measured v5e fwd+bwd vs XLA fused attention (B*T=16k tokens,
-H=16, d=128, causal, min of 3): 2.4x at T=1024 (6.7ms vs 16.0ms), 2.7x at
-T=2048, 3.9x at T=4096; at T=8192 XLA's full-scores attention fails to
-compile while this kernel runs 16ms.  Reproduce with
-``python benchmarks/attn_tpu.py``.
+H=16, d=128, causal): ~2-4x faster with the gap growing in T, and at
+T=8192 XLA's full-scores attention fails to compile on one chip while
+this kernel runs.  Absolute ms drift ±30% between sessions through the
+relayed backend, so the checked-in artifact is the single source of
+numbers: ``benchmarks/attn_tpu_v5e.json``, regenerated with
+``python benchmarks/attn_tpu.py --out benchmarks/attn_tpu_v5e.json``
+(summarized in docs/PERF.md).
 """
 
 from __future__ import annotations
