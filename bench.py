@@ -139,6 +139,20 @@ def run_dist_mnist() -> dict:
             time.sleep(0.05)
         elapsed = time.time() - t0
         snap = ctrl.metrics.snapshot()
+        # Worker-side phase breakdown (rendezvous/train/total) from the
+        # warm-pool pod logs — shows where non-training wall time goes.
+        # Filter to the MEASURED job's pods: the warmup job logs its own
+        # (cold-compile) phase lines into the same pool tmpdir.
+        phase_lines = []
+        pool = getattr(kubelet, "_pool", None)
+        if pool is not None:
+            import glob
+
+            for f in glob.glob(os.path.join(pool._tmpdir,
+                                            "bench-dist-mnist-*.out")):
+                for ln in open(f, errors="replace"):
+                    if ln.startswith("Phase times:"):
+                        phase_lines.append(ln.strip())
     finally:
         import shutil
 
@@ -148,7 +162,8 @@ def run_dist_mnist() -> dict:
 
     if phase != TFJobPhase.SUCCEEDED:
         raise RuntimeError(f"bench job ended {phase}: {j.status.reason}")
-    return {"elapsed_s": elapsed, "metrics": snap, "warmup_ok": warmup_ok}
+    return {"elapsed_s": elapsed, "metrics": snap, "warmup_ok": warmup_ok,
+            "phases": phase_lines}
 
 
 def main() -> int:
@@ -171,6 +186,7 @@ def main() -> int:
             "reconcile_p99_ms": round(result["metrics"]["reconcile_p99_s"] * 1e3, 3),
             "syncs": result["metrics"]["syncs"],
             "compile_cache_warm": result["warmup_ok"],
+            "worker_phases": result["phases"],
             "workload": ("1xPS + 2xWorker, 200 steps, global batch 100; workers "
                          "form one jax.distributed cluster and all-reduce into "
                          "one shared model"),

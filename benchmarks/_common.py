@@ -11,10 +11,17 @@ import sys
 def run_bench_subprocess(script_path: str, args_list) -> dict:
     """One measurement per process: an OOMing config must not poison the
     TPU client for subsequent grid points.  Scrapes the last JSON line the
-    child printed; on failure returns {"error": stderr tail}."""
+    child printed; on failure returns {"error": stderr tail}.
+
+    Children share a persistent XLA compilation cache: through the relayed
+    backend a single compile costs minutes, so re-running a sweep (or
+    resuming one that died) must not pay it twice."""
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jaxcache-bench")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
     out = subprocess.run(
         [sys.executable, script_path, *map(str, args_list)],
-        capture_output=True, text=True,
+        capture_output=True, text=True, env=env,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(script_path))),
     )
     for line in reversed(out.stdout.strip().splitlines()):

@@ -80,6 +80,9 @@ def main() -> int:
                    default=[1024, 2048, 4096, 8192])
     p.add_argument("--impl", choices=["xla", "flash"], default="",
                    help="run ONE point in-process (the sweep spawns these)")
+    p.add_argument("--out", default="",
+                   help="write the sweep's JSON artifact here (e.g. "
+                        "benchmarks/attn_tpu_v5e.json)")
     args = p.parse_args()
     if args.impl:
         # Single point, in-process (the subprocess worker of the sweep).
@@ -93,6 +96,7 @@ def main() -> int:
     # result) must not poison the TPU client for later points.
     from benchmarks._common import run_bench_subprocess
 
+    results = []
     for t in args.seqs:
         b = max(1, args.tokens // t)
         for impl in ("xla", "flash"):
@@ -105,7 +109,21 @@ def main() -> int:
             r.setdefault("impl", impl)
             r.setdefault("t", t)
             r.setdefault("b", b)
+            results.append(r)
             print(json.dumps(r), flush=True)
+    if args.out:
+        artifact = {
+            "bench": "flash_vs_xla_attention_fwd_bwd",
+            "method": ("min-of-3, K steps inside one jitted scan, host read "
+                       "as barrier; B*T held constant; one subprocess per "
+                       "point so a failing config cannot poison later ones"),
+            "config": {"tokens": args.tokens, "heads": args.heads,
+                       "head_dim": args.head_dim, "causal": True},
+            "results": results,
+        }
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(json.dumps({"artifact": args.out}))
     return 0
 
 

@@ -23,7 +23,8 @@ import time
 
 
 def run(batch: int, prompt_len: int, new_tokens: int, dim: int, layers: int,
-        heads: int, intermediate: int) -> dict:
+        heads: int, intermediate: int, kv_block: int = 0,
+        kv_quant: bool = False) -> dict:
     import jax
 
     from kubeflow_controller_tpu.models import LlamaConfig, llama_init
@@ -40,7 +41,12 @@ def run(batch: int, prompt_len: int, new_tokens: int, dim: int, layers: int,
     prompt = jax.random.randint(
         jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size)
 
-    gen = jax.jit(lambda p, t: generate(p, t, cfg, max_new_tokens=new_tokens))
+    # kv_block=0: default blocked reads (generate.DECODE_KV_BLOCK).  To
+    # force the dense full-S read for an A/B, pass kv_block = S (a
+    # single-block cache takes the dense path).
+    kb = kv_block or None
+    gen = jax.jit(lambda p, t: generate(p, t, cfg, max_new_tokens=new_tokens,
+                                        kv_block=kb, kv_quant=kv_quant))
     # block_until_ready is NOT a trustworthy barrier through the tunneled
     # backend (async futures complete "instantly"); a host VALUE read is
     # (docs/PERF.md "Measurement caveats").
@@ -64,6 +70,8 @@ def run(batch: int, prompt_len: int, new_tokens: int, dim: int, layers: int,
         "total_s": round(best, 3),
         "ms_per_token_per_seq": round(best / new_tokens * 1e3, 2),
         "gen_tokens_per_s": round(total_new / best),
+        "kv_block": kv_block,
+        "kv_quant": kv_quant,
         "check_shape": list(out.shape),
     }
 
@@ -72,6 +80,24 @@ def run_subprocess(args_list) -> dict:
     from benchmarks._common import run_bench_subprocess
 
     return run_bench_subprocess(os.path.abspath(__file__), args_list)
+
+
+def _write_artifact(args, results) -> list:
+    """Incremental write after every row: points cost minutes of relay
+    compile each, so an interrupted sweep must keep what it measured."""
+    ok = [r for r in results if "gen_tokens_per_s" in r]
+    artifact = {
+        "bench": "llama_decode_single_chip",
+        "model": (f"Llama (dim {args.dim}, L{args.layers}, H{args.heads}, "
+                  f"inter {args.intermediate}), bf16, KV-cache greedy decode"),
+        "prompt_len": args.prompt_len,
+        "new_tokens": args.new_tokens,
+        "results": results,
+        "best_throughput": max(ok, key=lambda r: r["gen_tokens_per_s"]) if ok else None,
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=1)
+    return ok
 
 
 def main() -> int:
@@ -83,6 +109,11 @@ def main() -> int:
     p.add_argument("--layers", type=int, default=16)
     p.add_argument("--heads", type=int, default=16)
     p.add_argument("--intermediate", type=int, default=5632)
+    p.add_argument("--kv-block", type=int, default=0,
+                   help="cache-read block (0 = default blocked reads; pass "
+                        "prompt+new to force the dense full-S read)")
+    p.add_argument("--kv-quant", action="store_true",
+                   help="int8 KV cache (per-row scales)")
     p.add_argument("--sweep", action="store_true")
     p.add_argument("--out", default="benchmarks/decode_tpu_v5e.json")
     args = p.parse_args()
@@ -91,32 +122,43 @@ def main() -> int:
         "--intermediate", args.intermediate,
     ]
     if args.sweep:
-        grid = [dict(batch=1), dict(batch=8), dict(batch=32)]
+        grid = [
+            # Short-context points (S=256, single cache block -> dense
+            # read; comparable with the round-2 artifact).
+            dict(batch=1), dict(batch=8), dict(batch=32),
+            # Long-context A/B: S=2048 (8 blocks).  Length-masked blocked
+            # reads vs the dense full-S masked read the cache used before
+            # (kv_block = S forces the old behavior).
+            dict(batch=8, prompt=1024, new=1024),
+            dict(batch=8, prompt=1024, new=1024, kv_block=2048),
+            dict(batch=32, prompt=1024, new=1024),
+            dict(batch=32, prompt=1024, new=1024, kv_block=2048),
+            # int8 KV: halves the cache stream on top of blocked reads.
+            dict(batch=32, prompt=1024, new=1024, quant=True),
+        ]
         results = []
         for g in grid:
             r = run_subprocess([
-                "--batch", g["batch"], "--prompt-len", args.prompt_len,
-                "--new-tokens", args.new_tokens, *shape])
+                "--batch", g["batch"],
+                "--prompt-len", g.get("prompt", args.prompt_len),
+                "--new-tokens", g.get("new", args.new_tokens),
+                "--kv-block", g.get("kv_block", 0),
+                *(["--kv-quant"] if g.get("quant") else []), *shape])
             r.setdefault("batch", g["batch"])
+            r.setdefault("prompt_len", g.get("prompt", args.prompt_len))
+            r.setdefault("new_tokens", g.get("new", args.new_tokens))
+            r.setdefault("kv_block", g.get("kv_block", 0))
+            r.setdefault("kv_quant", bool(g.get("quant")))
             results.append(r)
             print(json.dumps(r), flush=True)
-        ok = [r for r in results if "gen_tokens_per_s" in r]
-        artifact = {
-            "bench": "llama_decode_single_chip",
-            "model": (f"Llama (dim {args.dim}, L{args.layers}, H{args.heads}, "
-                      f"inter {args.intermediate}), bf16, KV-cache greedy decode"),
-            "prompt_len": args.prompt_len,
-            "new_tokens": args.new_tokens,
-            "results": results,
-            "best_throughput": max(ok, key=lambda r: r["gen_tokens_per_s"]) if ok else None,
-        }
-        with open(args.out, "w") as f:
-            json.dump(artifact, f, indent=1)
+            ok = _write_artifact(args, results)
         print(json.dumps({"artifact": args.out,
-                          "best": artifact["best_throughput"]}))
+                          "best": max(ok, key=lambda r: r["gen_tokens_per_s"])
+                          if ok else None}))
         return 0 if ok else 1
     out = run(args.batch, args.prompt_len, args.new_tokens, args.dim,
-              args.layers, args.heads, args.intermediate)
+              args.layers, args.heads, args.intermediate,
+              kv_block=args.kv_block, kv_quant=args.kv_quant)
     print(json.dumps(out))
     return 0
 

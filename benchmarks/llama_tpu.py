@@ -139,12 +139,14 @@ def sweep(steps: int, out_path: str, peak: float, shape: dict) -> int:
         # materializes the T^2 scores (XLA attention fails to compile at
         # T=8192 on one chip — docs/PERF.md kernel table).
         dict(batch=2, seq=8192, policy="gateup"),
+        dict(batch=2, seq=8192, policy="gateup_attn"),
         # MoE A/B: iso-active dense bar, then capacity-einsum dispatch,
-        # then the dropless grouped-matmul kernels (ops/grouped_matmul.py).
+        # then the dropless grouped-matmul kernels (ops/grouped_matmul.py)
+        # under the MoE-aware remat policy.
         dict(batch=8, seq=1024, policy="gateup", shape=iso_dense),
         dict(batch=8, seq=1024, policy="gateup", shape=moe_shape,
              experts=8, dispatch="einsum"),
-        dict(batch=8, seq=1024, policy="gateup", shape=moe_shape,
+        dict(batch=8, seq=1024, policy="moe", shape=moe_shape,
              experts=8, dispatch="grouped"),
     ]
     results = []
@@ -174,6 +176,15 @@ def sweep(steps: int, out_path: str, peak: float, shape: dict) -> int:
             r["shape"] = g["shape"]
         results.append(r)
         print(json.dumps(r), flush=True)
+        # Incremental write: a sweep interrupted at row k keeps rows < k
+        # (each point costs minutes of relay compile time).
+        best = _write_artifact(out_path, peak, shape, results)
+    print(json.dumps({"best": best, "artifact": out_path}))
+    return 0 if best else 1
+
+
+def _write_artifact(out_path: str, peak: float, shape: dict, results):
+    """Writes the artifact; returns the current best row (or None)."""
     ok = [r for r in results if "model_tflops" in r]
     best = max(ok, key=lambda r: r["model_tflops"]) if ok else None
     artifact = {
@@ -188,8 +199,7 @@ def sweep(steps: int, out_path: str, peak: float, shape: dict) -> int:
     }
     with open(out_path, "w") as f:
         json.dump(artifact, f, indent=1)
-    print(json.dumps({"best": best, "artifact": out_path}))
-    return 0 if best else 1
+    return best
 
 
 def main() -> int:
@@ -202,7 +212,8 @@ def main() -> int:
     p.add_argument("--heads", type=int, default=16)
     p.add_argument("--intermediate", type=int, default=5632)
     p.add_argument("--remat-policy", default="full",
-                   choices=["full", "dots", "ffn", "gateup", "gateup_attn"])
+                   choices=["full", "dots", "ffn", "gateup", "gateup_attn",
+                            "moe"])
     p.add_argument("--loss-chunks", type=int, default=0,
                    help="chunked cross-entropy (0 = dense logits)")
     p.add_argument("--experts", type=int, default=0, help="MoE experts (0=dense)")

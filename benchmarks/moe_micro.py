@@ -1,0 +1,139 @@
+"""Micro-benchmark of the grouped-MoE pieces on the real chip: routing
+index math, the three grouped matmuls, the two row gathers — to find where
+a step's time actually goes before tuning blocks.  Not an artifact bench;
+a tuning tool."""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, *args, reps=160):
+    """Time `reps` executions inside ONE jitted lax.scan with a scalar
+    carry threaded into the input — per-call dispatch through the relayed
+    backend is a ~60-85 ms FIXED cost, so reps must be large enough to
+    amortize it below the noise (docs/PERF.md measurement caveats)."""
+    x0 = args[0]
+
+    @jax.jit
+    def scanned(x0, rest):
+        def body(x, _):
+            y = fn(x, *rest)
+            leaves = jax.tree.leaves(y)
+            s = sum(jnp.sum(l).astype(jnp.float32) for l in leaves)
+            return x + (s * 0).astype(x.dtype), None
+
+        out, _ = jax.lax.scan(body, x0, None, length=reps)
+        return jnp.sum(out.astype(jnp.float32))
+
+    float(scanned(x0, args[1:]))  # compile + complete
+    t0 = time.time()
+    float(scanned(x0, args[1:]))
+    return (time.time() - t0) / reps * 1e3
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--bt", type=int, default=8192, help="B*T tokens")
+    p.add_argument("--dim", type=int, default=1024)
+    p.add_argument("--inter", type=int, default=2816)
+    p.add_argument("--experts", type=int, default=8)
+    p.add_argument("--topk", type=int, default=2)
+    p.add_argument("--bm", type=int, default=128)
+    p.add_argument("--bn", type=int, default=512)
+    p.add_argument("--bk", type=int, default=512)
+    a = p.parse_args()
+
+    from kubeflow_controller_tpu.ops.grouped_matmul import gmm
+
+    N = a.bt * a.topk
+    D, F, E, bm = a.dim, a.inter, a.experts, a.bm
+    M = N + E * bm
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (a.bt, D), jnp.bfloat16)
+    wg = jax.random.normal(key, (E, D, F), jnp.bfloat16)
+    wd = jax.random.normal(key, (E, F, D), jnp.bfloat16)
+    slot_expert = jax.random.randint(key, (N,), 0, E)
+
+    @jax.jit
+    def route(slot_expert):
+        sort_idx = jnp.argsort(slot_expert)
+        sorted_experts = jnp.take(slot_expert, sort_idx)
+        counts = jnp.sum(jax.nn.one_hot(slot_expert, E, dtype=jnp.int32), axis=0)
+        group_start = jnp.cumsum(counts) - counts
+        padded = ((counts + bm - 1) // bm) * bm
+        pad_off = jnp.cumsum(padded) - padded
+        rank = jnp.arange(N) - jnp.take(group_start, sorted_experts)
+        dest = (jnp.take(pad_off, sorted_experts) + rank).astype(jnp.int32)
+        ends = pad_off + padded
+        te = jnp.minimum(jnp.searchsorted(
+            ends, jnp.arange(M // bm) * bm, side="right"), E - 1).astype(jnp.int32)
+        inv_src = jnp.full((M,), a.bt, jnp.int32).at[dest].set(
+            (sort_idx // a.topk).astype(jnp.int32))
+        return te, inv_src, dest
+
+    te, inv_src, dest = jax.block_until_ready(route(slot_expert))
+    print(f"route(index math): {timeit(route, slot_expert):.2f} ms")
+
+    @jax.jit
+    def gather(x, inv_src):
+        x_pad = jnp.concatenate([x, jnp.zeros((1, D), x.dtype)], axis=0)
+        return jnp.take(x_pad, inv_src, axis=0)
+
+    x_pad = jax.block_until_ready(gather(x, inv_src))
+    print(f"gather [{M}x{D}]: {timeit(gather, x, inv_src):.2f} ms")
+
+    f = jax.jit(lambda l, r: gmm(l, r, te, bm, a.bn, a.bk))
+    print(f"gmm up [{M}x{D}]@[{E}x{D}x{F}] bm={bm} bn={a.bn} bk={a.bk}: "
+          f"{timeit(f, x_pad, wg):.2f} ms")
+    h = jax.block_until_ready(f(x_pad, wg))
+    fd = jax.jit(lambda l, r: gmm(l, r, te, bm, a.bn, a.bk))
+    print(f"gmm down [{M}x{F}]@[{E}x{F}x{D}]: {timeit(fd, h, wd):.2f} ms")
+
+    flops = 2 * M * D * F
+    gmm_ms = timeit(f, x_pad, wg)
+    xla_ms = timeit(lambda l, r: l @ r, x_pad, wg[0])
+    print(f"xla dense same-FLOPs [{M}x{D}]@[{D}x{F}]: {xla_ms:.2f} ms "
+          f"({flops / 1e9 / xla_ms:.0f} TFLOP/s) vs gmm {gmm_ms:.2f} ms "
+          f"({flops / 1e9 / gmm_ms:.0f} TFLOP/s)")
+
+    # Whole-FFN comparison: grouped vs einsum vs iso-active dense SwiGLU,
+    # forward and grad.
+    from kubeflow_controller_tpu.models.moe import moe_ffn_stats
+
+    B, T = 8, a.bt // 8
+    x3 = jax.random.normal(key, (B, T, D), jnp.bfloat16)
+    rw = jax.random.normal(key, (D, E), jnp.bfloat16) * 0.1
+    wu = jax.random.normal(key, (E, D, F), jnp.bfloat16)
+    wdn = jax.random.normal(key, (E, F, D), jnp.bfloat16)
+    wg2, wu2, wd2 = (jax.random.normal(key, (D, 2 * F), jnp.bfloat16),
+                     jax.random.normal(key, (D, 2 * F), jnp.bfloat16),
+                     jax.random.normal(key, (2 * F, D), jnp.bfloat16))
+
+    def moe_f(x, mode):
+        return moe_ffn_stats(x, rw, wg, wu, wdn, top_k=a.topk,
+                             dispatch=mode)[0]
+
+    def dense_f(x):
+        return jnp.einsum(
+            "btf,fd->btd",
+            jax.nn.silu(jnp.einsum("btd,df->btf", x, wg2))
+            * jnp.einsum("btd,df->btf", x, wu2), wd2)
+
+    for name, fn in [("grouped", lambda x: moe_f(x, "grouped")),
+                     ("einsum", lambda x: moe_f(x, "einsum")),
+                     ("dense-iso", dense_f)]:
+        fwd = timeit(fn, x3, reps=80)
+        grad = timeit(
+            lambda x: jax.grad(lambda z: jnp.sum(fn(z).astype(jnp.float32)))(x),
+            x3, reps=80)
+        print(f"ffn {name}: fwd {fwd:.2f} ms, grad {grad:.2f} ms")
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    sys.exit(main())

@@ -1,0 +1,198 @@
+"""Sequence-parallel attention schedule cost — the first SP timing table.
+
+Two measurements bound the ring/Ulysses overhead without a multi-chip
+machine:
+
+1. **1-chip TPU machinery A/B** (``--tpu``): plain attention vs the same
+   shapes routed through ``ring_attention`` / ``ulysses_attention`` on an
+   sp=1 mesh.  With one shard the ring makes zero ppermute hops and
+   Ulysses' all-to-alls are identity, so the delta IS the shard_map +
+   schedule machinery cost — the fixed overhead SP adds before any
+   communication happens.
+
+2. **8-device CPU mesh scaling** (``--cpu``): fwd+bwd wall time at a fixed
+   GLOBAL sequence length while sp grows 1 -> 8.  CPU milliseconds are not
+   TPU milliseconds, but the *shape* of the curve exposes schedule
+   pathologies (a schedule that serializes or copies superlinearly shows
+   up immediately; per-step collective counts are identical on TPU).
+
+Artifact: ``python benchmarks/sp_bench.py --tpu --cpu --out
+benchmarks/sp_sched.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def timeit_grad(fn, *args, reps=40):
+    """fwd+bwd time per call, measured inside one jitted scan (see
+    moe_micro.timeit for why per-call dispatch cannot be trusted)."""
+    import jax
+    import jax.numpy as jnp
+
+    def loss(x, rest):
+        return jnp.sum(fn(x, *rest).astype(jnp.float32))
+
+    g = jax.grad(loss)
+
+    @jax.jit
+    def scanned(x0, rest):
+        def body(x, _):
+            dx = g(x, rest)
+            return x + 0 * dx, None
+
+        out, _ = jax.lax.scan(body, x0, None, length=reps)
+        return jnp.sum(out.astype(jnp.float32))
+
+    float(scanned(args[0], args[1:]))
+    t0 = time.time()
+    float(scanned(args[0], args[1:]))
+    return (time.time() - t0) / reps * 1e3
+
+
+def bench_tpu_machinery(B, T, H, D, reps):
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_controller_tpu.ops.attention import flash_attention
+    from kubeflow_controller_tpu.parallel import MeshSpec, build_mesh
+    from kubeflow_controller_tpu.parallel.ring import (
+        attention_reference,
+        ring_attention,
+    )
+    from kubeflow_controller_tpu.parallel.ulysses import ulysses_attention
+
+    key = jax.random.PRNGKey(0)
+    shape = (B, T, H, D)
+    q = jax.random.normal(key, shape, jnp.bfloat16)
+    k = jax.random.normal(key, shape, jnp.bfloat16)
+    v = jax.random.normal(key, shape, jnp.bfloat16)
+    mesh = build_mesh(MeshSpec(fsdp=-1))  # all size-1 axes on one chip
+    rows = {}
+    with jax.set_mesh(mesh):
+        rows["plain"] = timeit_grad(
+            lambda q, k, v: attention_reference(q, k, v, causal=True),
+            q, k, v, reps=reps)
+        rows["flash"] = timeit_grad(
+            lambda q, k, v: flash_attention(q, k, v, causal=True),
+            q, k, v, reps=reps)
+        rows["ring_sp1"] = timeit_grad(
+            lambda q, k, v: ring_attention(q, k, v, mesh, causal=True),
+            q, k, v, reps=reps)
+        rows["ulysses_sp1"] = timeit_grad(
+            lambda q, k, v: ulysses_attention(q, k, v, mesh, causal=True),
+            q, k, v, reps=reps)
+    return {"config": {"B": B, "T": T, "H": H, "D": D,
+                       "what": "fwd+bwd ms, 1 real TPU chip, sp=1 mesh"},
+            "ms": {k2: round(v2, 2) for k2, v2 in rows.items()}}
+
+
+def bench_cpu_scaling(B, T, H, D, reps):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from kubeflow_controller_tpu.parallel import MeshSpec, build_mesh, logical_to_pspec
+    from kubeflow_controller_tpu.parallel.ring import ring_attention
+    from kubeflow_controller_tpu.parallel.ulysses import ulysses_attention
+
+    key = jax.random.PRNGKey(0)
+    shape = (B, T, H, D)
+    out = []
+    for sp in (1, 2, 4, 8):
+        # Spare devices park on ep (no attention array uses it): batch
+        # stays unsharded so small B never constrains the sp sweep.
+        mesh = build_mesh(MeshSpec(sp=sp, ep=-1, fsdp=1))
+        spec = logical_to_pspec(("batch", "seq", "heads", "head_dim"))
+        sharding = NamedSharding(mesh, spec)
+        q = jax.device_put(jax.random.normal(key, shape, jnp.float32), sharding)
+        k = jax.device_put(jax.random.normal(key, shape, jnp.float32), sharding)
+        v = jax.device_put(jax.random.normal(key, shape, jnp.float32), sharding)
+        row = {"sp": sp}
+        with jax.set_mesh(mesh):
+            row["ring_ms"] = round(timeit_grad(
+                lambda q, k, v: ring_attention(q, k, v, mesh, causal=True),
+                q, k, v, reps=reps), 2)
+            if H % (sp or 1) == 0:
+                row["ulysses_ms"] = round(timeit_grad(
+                    lambda q, k, v: ulysses_attention(q, k, v, mesh, causal=True),
+                    q, k, v, reps=reps), 2)
+        out.append(row)
+        print(json.dumps(row), flush=True)
+    return {"config": {"B": B, "T": T, "H": H, "D": D,
+                       "what": "fwd+bwd ms, 8 virtual CPU devices, global T "
+                               "fixed while sp grows (relative shape only)"},
+            "rows": out}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--tpu", action="store_true")
+    p.add_argument("--cpu", action="store_true")
+    p.add_argument("--cpu-inner", action="store_true",
+                   help="(internal) run the CPU scaling in THIS process — "
+                        "requires JAX_PLATFORMS=cpu and 8 virtual devices")
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--seq", type=int, default=2048,
+                   help="global sequence length (the plain-attention "
+                        "baseline materializes [B,H,T,T] f32 scores, so "
+                        "keep B*T^2 within one chip's HBM)")
+    p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--reps", type=int, default=40)
+    p.add_argument("--out", default="")
+    args = p.parse_args()
+
+    if args.cpu_inner:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        out = bench_cpu_scaling(args.batch, args.seq, args.heads,
+                                args.head_dim, args.reps)
+        print("CPU_SCALING " + json.dumps(out), flush=True)
+        return 0
+
+    artifact = {"bench": "sp_schedule_cost"}
+    if args.tpu:
+        artifact["tpu_machinery_sp1"] = bench_tpu_machinery(
+            args.batch, args.seq, args.heads, args.head_dim, args.reps)
+        print(json.dumps(artifact["tpu_machinery_sp1"]), flush=True)
+    if args.cpu:
+        # Own process: a jax client that already initialized the TPU
+        # backend cannot host the 8-virtual-device CPU mesh.
+        import os
+        import subprocess
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8").strip()
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--cpu-inner",
+             "--batch", str(args.batch), "--seq", str(args.seq),
+             "--heads", str(args.heads), "--head-dim", str(args.head_dim),
+             "--reps", str(args.reps)],
+            capture_output=True, text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        for line in out.stdout.splitlines():
+            if line.startswith("CPU_SCALING "):
+                artifact["cpu_scaling"] = json.loads(line[len("CPU_SCALING "):])
+                break
+        else:
+            artifact["cpu_scaling"] = {
+                "error": (out.stderr or "no output")[-400:].strip()}
+        print(json.dumps(artifact["cpu_scaling"]), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(json.dumps({"artifact": args.out}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    sys.exit(main())

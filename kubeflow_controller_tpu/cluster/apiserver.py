@@ -287,25 +287,12 @@ class FakeAPIServer:
             h._send(200, self._wire(r.plural, store.update(r.plural, obj)))
             return
         if method == "PATCH":
-            patch = h._body()
-            meta_patch = patch.get("metadata", {})
-
-            def apply(meta):
-                if "labels" in meta_patch:
-                    meta.labels = dict(meta_patch["labels"] or {})
-                if "annotations" in meta_patch:
-                    meta.annotations = dict(meta_patch["annotations"] or {})
-                if "ownerReferences" in meta_patch:
-                    from ..api.meta import OwnerReference
-
-                    meta.owner_references = [
-                        serde.from_dict(OwnerReference, o)
-                        for o in (meta_patch["ownerReferences"] or [])
-                    ]
-                if "finalizers" in meta_patch:
-                    meta.finalizers = list(meta_patch["finalizers"] or [])
-
-            h._send(200, self._wire(r.plural, store.patch_meta(r.plural, ns, r.name, apply)))
+            # Every PATCH body is one dialect: RFC 7386 merge, applied
+            # server-side (maps merge per-key, null deletes, lists replace)
+            # — metadata-only bodies included, so the REST client's
+            # patch()/patch_meta() cannot diverge by code path.
+            h._send(200, self._wire(
+                r.plural, store.patch(r.plural, ns, r.name, h._body())))
             return
         if method == "DELETE":
             store.delete(r.plural, ns, r.name)

@@ -48,6 +48,10 @@ class _TypedClient:
     def patch_meta(self, namespace: str, name: str, fn):
         return self._store.patch_meta(self.kind, namespace, name, fn)
 
+    def patch(self, namespace: str, name: str, body: Dict):
+        """Arbitrary object patch (RFC 7386 merge) — PatchService analog."""
+        return self._store.patch(self.kind, namespace, name, body)
+
 
 class TFJobClient(_TypedClient):
     kind = TFJOBS

@@ -408,18 +408,38 @@ class _RestTypedClient:
         return RestWatcher(self._t, self._collection(namespace),
                            {"watch": "true"}, self.cls)
 
+    def patch(self, namespace: str, name: str, body: dict):
+        """Arbitrary object patch as an RFC 7386 merge patch — the
+        PatchService analog (ref: pkg/controller/control/service.go:50-53)
+        for every kind: ``patch(ns, n, {"spec": {...}})`` mutates just
+        those fields server-side."""
+        out = self._t._request(
+            "PATCH", self._item(namespace, name), body=body,
+            content_type="application/merge-patch+json")
+        return self._from_wire(out)
+
     def patch_meta(self, namespace: str, name: str,
                    fn: Callable[[ObjectMeta], None]):
         """Read-modify-write expressed as a JSON merge patch on metadata —
         the wire form the reference uses for adoption/release
-        (ref: pkg/controller/ref/service.go:126-164).  Lists (ownerReferences,
-        finalizers) are replaced wholesale, exactly as a merge patch does."""
+        (ref: pkg/controller/ref/service.go:126-164).  Lists
+        (ownerReferences, finalizers) replace wholesale; label/annotation
+        maps merge per-key, so keys ``fn`` removed are expressed as RFC
+        7386 nulls."""
         current = self.get(namespace, name)
         meta = current.metadata
+        before_labels = dict(meta.labels)
+        before_annotations = dict(meta.annotations)
         fn(meta)
+
+        def map_patch(before: dict, after: dict) -> dict:
+            out = {k: v for k, v in after.items() if before.get(k) != v}
+            out.update({k: None for k in before if k not in after})
+            return out
+
         meta_patch = {
-            "labels": serde.to_dict(meta.labels) or {},
-            "annotations": serde.to_dict(meta.annotations) or {},
+            "labels": map_patch(before_labels, dict(meta.labels)),
+            "annotations": map_patch(before_annotations, dict(meta.annotations)),
             "ownerReferences": serde.to_dict(meta.owner_references) or [],
             "finalizers": list(meta.finalizers),
         }

@@ -219,6 +219,29 @@ class ObjectStore:
             self._maybe_finalize(kind, (namespace, name))
             return out
 
+    def patch(self, kind: str, namespace: str, name: str, body: Dict) -> Any:
+        """Full-object JSON merge patch (RFC 7386) — the PatchService analog
+        (ref: pkg/controller/control/service.go:50-53), generalized to every
+        kind.  Server-side under the lock, so it cannot race other writers;
+        immutable metadata (uid, name/namespace, timestamps) is preserved,
+        resourceVersion bumps, watchers see MODIFIED."""
+        with self._lock:
+            existing = self._collection(kind).get((namespace, name))
+            if existing is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            merged = serde.json_merge_patch(serde.to_dict(existing), body)
+            obj = serde.from_dict(type(existing), merged)
+            obj.metadata.namespace, obj.metadata.name = namespace, name
+            obj.metadata.uid = existing.metadata.uid
+            obj.metadata.creation_timestamp = existing.metadata.creation_timestamp
+            obj.metadata.deletion_timestamp = existing.metadata.deletion_timestamp
+            obj.metadata.resource_version = self._next_rv()
+            self._collection(kind)[(namespace, name)] = obj
+            self._notify(kind, MODIFIED, obj)
+            out = serde.deep_copy(obj)
+            self._maybe_finalize(kind, (namespace, name))
+            return out
+
     def update_status(self, kind: str, obj: Any) -> Any:
         """Status-subresource style update: only .status is applied.  A
         stale resourceVersion raises Conflict (as the real subresource does);

@@ -76,6 +76,13 @@ class Helper:
                             f"Created service: {created.metadata.name}")
         return created
 
+    def patch_service(self, namespace: str, name: str, body: dict) -> Service:
+        """Arbitrary service mutation as a server-side merge patch — the
+        PatchService surface the reference exposes on its service control
+        (ref: pkg/controller/control/service.go:50-53), e.g.
+        ``patch_service(ns, n, {"spec": {"selector": {...}}})``."""
+        return self.cluster.services.patch(namespace, name, body)
+
     def delete_pod(self, job: TFJob, namespace: str, name: str) -> bool:
         """Index-preserving replacement and recycle need real deletes —
         the capability the reference stubbed (controller.go:522-524).

@@ -6,6 +6,15 @@ written prefix under an absolute-position mask — static shapes throughout,
 so the whole generate loop jits as one ``lax.scan`` (no per-token Python
 dispatch, no recompilation per length).
 
+Two decode bandwidth levers (decode streams params + cache every step):
+
+- **Blocked, length-masked cache reads** (default): attention reads only
+  the ceil(written/DECODE_KV_BLOCK) blocks covering the prefix, with an
+  online softmax — not the full static S (see _cache_attention_blocked).
+- **int8 KV quantization** (``LlamaConfig... quantize_kv / kv_dtype
+  arg``): K/V stored int8 with one f32 scale per [position, kv-head] row,
+  halving cache reads vs bf16; dequantize happens per read block.
+
 Sharded decode: every activation and the KV cache carry logical sharding
 constraints (batch over dp/fsdp, heads over tp — the megatron inference
 layout); run the jitted decode under ``jax.set_mesh`` with params placed by
@@ -41,20 +50,49 @@ DECODE_KV_BLOCK = 256
 
 
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
-               rules: ShardingRules = DEFAULT_RULES) -> Cache:
+               rules: ShardingRules = DEFAULT_RULES,
+               quantize: bool = False) -> Cache:
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    if quantize:
+        # int8 rows + one f32 scale per [L,B,S,kvH] row: cache reads halve
+        # vs bf16 (decode is bandwidth-bound; docs/PERF.md).
+        sshape = shape[:-1]
+        return {
+            "k": with_logical_constraint(
+                jnp.zeros(shape, jnp.int8), CACHE_AXES, rules),
+            "v": with_logical_constraint(
+                jnp.zeros(shape, jnp.int8), CACHE_AXES, rules),
+            "k_scale": with_logical_constraint(
+                jnp.zeros(sshape, jnp.float32), CACHE_AXES[:-1], rules),
+            "v_scale": with_logical_constraint(
+                jnp.zeros(sshape, jnp.float32), CACHE_AXES[:-1], rules),
+        }
     dtype = jnp.dtype(cfg.dtype)
     return {"k": with_logical_constraint(jnp.zeros(shape, dtype), CACHE_AXES, rules),
             "v": with_logical_constraint(jnp.zeros(shape, dtype), CACHE_AXES, rules)}
 
 
-def cache_pspecs(rules: ShardingRules = DEFAULT_RULES):
+def _quantize_rows(x: jax.Array):
+    """[..., D] -> (int8 [..., D], f32 scale [...]) with symmetric per-row
+    scaling (max-abs / 127)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def cache_pspecs(rules: ShardingRules = DEFAULT_RULES, quantize: bool = False):
     """PartitionSpecs for the KV cache (device_put target for a sharded
     decode loop's carry)."""
     from ..parallel.sharding import logical_to_pspec
 
     spec = logical_to_pspec(CACHE_AXES, rules)
-    return {"k": spec, "v": spec}
+    out = {"k": spec, "v": spec}
+    if quantize:
+        sspec = logical_to_pspec(CACHE_AXES[:-1], rules)
+        out.update({"k_scale": sspec, "v_scale": sspec})
+    return out
 
 
 def _cache_attention_dense(q, kk, vv, mask, rules):
@@ -69,7 +107,8 @@ def _cache_attention_dense(q, kk, vv, mask, rules):
     return jnp.einsum("bhts,bshd->bthd", p, vv.astype(jnp.float32)).astype(q.dtype)
 
 
-def _cache_attention_blocked(q, kc, vc, start_pos, block, rules):
+def _cache_attention_blocked(q, kc, vc, start_pos, block, rules,
+                             k_scale=None, v_scale=None):
     """Length-masked cache read: online-softmax attention over the cache in
     ``block``-sized chunks, looping only over ceil((start_pos+T)/block)
     blocks — HBM traffic per step follows the written prefix, not the
@@ -77,10 +116,15 @@ def _cache_attention_blocked(q, kc, vc, start_pos, block, rules):
     ([B,T,kvH,rep,D]) so the repeated cache never materializes.
 
     q [B,T,H,D] (RoPE applied); kc/vc [B,S,kvH,D]; start_pos traced OK
-    (the fori_loop gets a dynamic trip count -> while_loop)."""
+    (the fori_loop gets a dynamic trip count -> while_loop).
+
+    With ``k_scale``/``v_scale`` ([B,S,kvH] f32) the cache is int8 and
+    only int8 rows stream from HBM; scales fold into the score matrix
+    (per k-position column) and the softmax weights (per v-position)."""
     B, T, H, D = q.shape
     S, kvH = kc.shape[1], kc.shape[2]
     rep = H // kvH
+    quant = k_scale is not None
     qg = (q.astype(jnp.float32) * D ** -0.5).reshape(B, T, kvH, rep, D)
     q_pos = start_pos + jnp.arange(T)                        # [T]
     n_blocks = (start_pos + T + block - 1) // block          # traced
@@ -96,6 +140,10 @@ def _cache_attention_blocked(q, kc, vc, start_pos, block, rules):
         vb = jax.lax.dynamic_slice_in_dim(
             vc, i * block, block, axis=1).astype(jnp.float32)
         s = jnp.einsum("btgrd,bsgd->btgrs", qg, kb)
+        if quant:
+            ks = jax.lax.dynamic_slice_in_dim(
+                k_scale, i * block, block, axis=1)           # [B,block,kvH]
+            s = s * ks.transpose(0, 2, 1)[:, None, :, None, :]
         kv_pos = i * block + jnp.arange(block)               # [block]
         msk = kv_pos[None, :] <= q_pos[:, None]              # [T, block]
         s = jnp.where(msk[None, :, None, None, :], s, NEG_INF)
@@ -105,7 +153,12 @@ def _cache_attention_blocked(q, kc, vc, start_pos, block, rules):
         p = jnp.exp(s - m_new[..., None]) * msk[None, :, None, None, :]
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[..., None] + jnp.einsum("btgrs,bsgd->btgrd", p, vb)
+        pv = p
+        if quant:
+            vs = jax.lax.dynamic_slice_in_dim(
+                v_scale, i * block, block, axis=1)
+            pv = p * vs.transpose(0, 2, 1)[:, None, :, None, :]
+        acc = acc * alpha[..., None] + jnp.einsum("btgrs,bsgd->btgrd", pv, vb)
         return m_new, l, acc
 
     _, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
@@ -152,9 +205,14 @@ def forward_with_cache(
     mask = (kv_pos <= q_pos)[None, None, :, :]      # [1,1,T,S]
 
     kv_axes = CACHE_AXES[1:]  # per-layer view: no leading layers dim
+    quant = "k_scale" in cache
 
     def layer(x, scanned):
-        lp, kc, vc = scanned                        # kc/vc: [B, S, kvH, D]
+        if quant:
+            lp, kc, vc, ksc, vsc = scanned          # kc/vc int8, scales f32
+        else:
+            lp, kc, vc = scanned                    # kc/vc: [B, S, kvH, D]
+            ksc = vsc = None
         h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
         q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dtype))
         k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dtype))
@@ -164,14 +222,30 @@ def forward_with_cache(
         v = with_logical_constraint(v, kv_axes, rules)
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
-        kc = jax.lax.dynamic_update_slice_in_dim(kc, k.astype(kc.dtype), start_pos, axis=1)
-        vc = jax.lax.dynamic_update_slice_in_dim(vc, v.astype(vc.dtype), start_pos, axis=1)
+        if quant:
+            kq, ks = _quantize_rows(k)
+            vq, vs = _quantize_rows(v)
+            kc = jax.lax.dynamic_update_slice_in_dim(kc, kq, start_pos, axis=1)
+            vc = jax.lax.dynamic_update_slice_in_dim(vc, vq, start_pos, axis=1)
+            ksc = jax.lax.dynamic_update_slice_in_dim(ksc, ks, start_pos, axis=1)
+            vsc = jax.lax.dynamic_update_slice_in_dim(vsc, vs, start_pos, axis=1)
+        else:
+            kc = jax.lax.dynamic_update_slice_in_dim(
+                kc, k.astype(kc.dtype), start_pos, axis=1)
+            vc = jax.lax.dynamic_update_slice_in_dim(
+                vc, v.astype(vc.dtype), start_pos, axis=1)
         kc = with_logical_constraint(kc, kv_axes, rules)
         vc = with_logical_constraint(vc, kv_axes, rules)
         if blocked:
-            attn = _cache_attention_blocked(q, kc, vc, start_pos, block, rules)
+            attn = _cache_attention_blocked(q, kc, vc, start_pos, block, rules,
+                                            k_scale=ksc, v_scale=vsc)
         else:
-            kk, vv = kc, vc
+            if quant:
+                kk = kc.astype(jnp.float32) * ksc[..., None]
+                vv = vc.astype(jnp.float32) * vsc[..., None]
+                kk, vv = kk.astype(dtype), vv.astype(dtype)
+            else:
+                kk, vv = kc, vc
             if repeats > 1:
                 kk = jnp.repeat(kk, repeats, axis=2)
                 vv = jnp.repeat(vv, repeats, axis=2)
@@ -183,15 +257,26 @@ def forward_with_cache(
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         x = x + ffn_block(h, lp, cfg, rules)
         x = with_logical_constraint(x, ("batch", None, None), rules)
+        if quant:
+            return x, (kc, vc, ksc, vsc)
         return x, (kc, vc)
 
-    x, (k_new, v_new) = jax.lax.scan(
-        layer, x, (params["layers"], cache["k"], cache["v"])
-    )
+    if quant:
+        x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+            layer, x, (params["layers"], cache["k"], cache["v"],
+                       cache["k_scale"], cache["v_scale"])
+        )
+        new_cache = {"k": k_new, "v": v_new,
+                     "k_scale": ks_new, "v_scale": vs_new}
+    else:
+        x, (k_new, v_new) = jax.lax.scan(
+            layer, x, (params["layers"], cache["k"], cache["v"])
+        )
+        new_cache = {"k": k_new, "v": v_new}
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(dtype))
     logits = with_logical_constraint(logits, ("batch", None, "vocab"), rules)
-    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+    return logits.astype(jnp.float32), new_cache
 
 
 def _sample(logits, key, temperature: float, top_k: Optional[int]):
@@ -215,6 +300,7 @@ def generate(
     key: Optional[jax.Array] = None,
     rules: ShardingRules = DEFAULT_RULES,
     kv_block: Optional[int] = None,
+    kv_quant: bool = False,
 ) -> jax.Array:
     """prompt [B, T_p] -> [B, T_p + max_new_tokens].  Greedy when
     temperature == 0.  The decode loop is one jitted scan.  Under an active
@@ -232,7 +318,7 @@ def generate(
     block = kv_block or DECODE_KV_BLOCK
     if max_len > block:
         max_len = -(-max_len // block) * block
-    cache = init_cache(cfg, B, max_len, rules)
+    cache = init_cache(cfg, B, max_len, rules, quantize=kv_quant)
     # Replicate the embedding table once, OUTSIDE the decode scan (see
     # forward_with_cache); inside the loop the same constraint is then an
     # identity and the per-token gather is purely local.

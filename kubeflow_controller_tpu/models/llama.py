@@ -380,6 +380,11 @@ def _maybe_remat(layer, cfg: LlamaConfig):
         # re-running the (flash) attention forward in the backward pass.
         "gateup_attn": policies.save_only_these_names(
             "ffn_gate", "ffn_up", "attn_proj"),
+        # MoE (grouped dispatch): save all three grouped-matmul outputs AND
+        # the dispatched activations, so the backward re-runs only the
+        # cheap routing index math — not the row gathers or any gmm.
+        "moe": policies.save_only_these_names(
+            "ffn_gate", "ffn_up", "ffn_down", "moe_x", "attn_proj"),
     }
     if cfg.remat_policy not in named:
         raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}; "
@@ -403,7 +408,7 @@ def ffn_block(h: jax.Array, lp, cfg: LlamaConfig,
     # recompute the rest.  Only inserted when the policy consumes them: the
     # name_p primitive blocks XLA fusions, measured 3.5x slower under the
     # plain "full" policy on v5e (docs/PERF.md).
-    if cfg.remat_policy in ("ffn", "gateup", "gateup_attn"):
+    if cfg.remat_policy in ("ffn", "gateup", "gateup_attn", "moe"):
         from jax.ad_checkpoint import checkpoint_name
     else:
         def checkpoint_name(x, _):
@@ -428,7 +433,7 @@ def ffn_block_stats(h: jax.Array, lp, cfg: LlamaConfig,
         h, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
         top_k=cfg.moe_top_k, capacity_factor=cfg.capacity_factor,
         rules=rules, dispatch=cfg.moe_dispatch,
-        save_names=cfg.remat_policy in ("ffn", "gateup", "gateup_attn"),
+        save_names=cfg.remat_policy in ("ffn", "gateup", "gateup_attn", "moe"),
     )
 
 
@@ -453,7 +458,7 @@ def _decoder_layer_fn(cfg: LlamaConfig, angles, mesh, rules):
         v = with_logical_constraint(v, ("batch", "seq", "heads", "head_dim"), rules)
         attn = _attention(q, k, v, mesh, causal=True, rules=rules, cfg=cfg)
         proj = jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))
-        if cfg.remat_policy == "gateup_attn":
+        if cfg.remat_policy in ("gateup_attn", "moe"):
             from jax.ad_checkpoint import checkpoint_name
 
             proj = checkpoint_name(proj, "attn_proj")

@@ -104,14 +104,18 @@ def moe_ffn_stats(
       scatters serialize: measured 15% SLOWER than the einsum path at
       653M/E8 on v5e (docs/PERF.md).  Kept for backends where scatters
       are cheap.
-    - ``"grouped"``: megablocks-style — tokens sorted by expert into a
-      group-aligned layout and run through grouped-matmul Pallas kernels
-      (ops/grouped_matmul.py).  DROPLESS: capacity does not apply
-      (overflow_frac == 0); matches :func:`moe_ffn_reference`.  Falls back
-      to "einsum" (one warning) when it cannot run: under an active mesh
-      (the sharded path needs the einsum formulation's constraints), or at
-      shapes below the TPU tiling grain (D/F not multiples of 128, or
-      B*T*k not a multiple of 8).
+    - ``"grouped"``: megablocks-style — tokens laid out by expert into a
+      group-aligned layout (sort-free: one-hot cumsum ranks) and run
+      through grouped-matmul Pallas kernels (ops/grouped_matmul.py).
+      DROPLESS: capacity does not apply (overflow_frac == 0); matches
+      :func:`moe_ffn_reference`.  Measured 13% slower than "einsum" at
+      the E8/top2 bench config (docs/PERF.md has the full decomposition
+      — the dW kernel and XLA's slow row-gathers, not the dispatch
+      design); prefer it when drops are unacceptable or E·C >> T·k.
+      Falls back to "einsum" (one warning) when it cannot run: under an
+      active mesh (the sharded path needs the einsum formulation's
+      constraints), or at shapes below the TPU tiling grain (D/F not
+      multiples of 128, or B*T*k not a multiple of 8).
     """
     import math
 
@@ -227,20 +231,22 @@ def moe_ffn_stats(
     return y, stats
 
 
-def _grouped_ffn(x, probs, idx, w_gate, w_up, w_down, block_m: int = 128,
+def _grouped_ffn(x, probs, idx, w_gate, w_up, w_down, block_m: int = 256,
                  save_names: bool = False):
     """Dropless expert FFN via grouped-matmul kernels.
 
     Layout construction (all index math; the only O(tokens·D) data moves
     are two row GATHERS — no TPU scatters of vectors anywhere, forward or
-    backward):
+    backward, and no sort: each slot's rank inside its expert comes from
+    an exclusive cumsum over the one-hot assignment):
 
-    1. Flatten routing slots ([B,T,k] -> N), stable-sort by expert.
-    2. Lay each expert's slots into a *group-aligned* region: expert e's
-       rows start at a block_m-aligned offset, so every block_m-row tile
-       belongs to exactly one expert — the contract of ops/grouped_matmul.
-       Static padded length M = N + E·block_m (≤ 3-6% waste at bench
-       shapes); pad rows read a zero row and are never read back.
+    1. Flatten routing slots ([B,T,k] -> N); slot s of expert e lands at
+       row ``pad_offset[e] + rank(s within e)``.
+    2. Expert regions are *group-aligned*: expert e's rows start at a
+       block_m-aligned offset, so every block_m-row tile belongs to one
+       expert — the contract of ops/grouped_matmul.  Static padded length
+       M = N + E·block_m (≤ 3-6% waste at bench shapes); pad rows read a
+       zero row and are never read back.
     3. Gather tokens into the layout, run gate/up/down as grouped matmuls,
        gather each slot's result back, combine weighted by router probs.
 
@@ -262,17 +268,16 @@ def _grouped_ffn(x, probs, idx, w_gate, w_up, w_down, block_m: int = 128,
     h_flat = x.reshape(n_tok, D)
 
     slot_expert = idx.reshape(n_slots)
-    sort_idx = jnp.argsort(slot_expert)               # stable: slot order kept
-    sorted_experts = jnp.take(slot_expert, sort_idx)
-    counts = jnp.sum(jax.nn.one_hot(slot_expert, E, dtype=jnp.int32), axis=0)
-    group_start = jnp.cumsum(counts) - counts
+    onehot = jax.nn.one_hot(slot_expert, E, dtype=jnp.int32)     # [N, E]
+    pos = jnp.cumsum(onehot, axis=0) - onehot                    # exclusive
+    rank = jnp.take_along_axis(pos, slot_expert[:, None], axis=1)[:, 0]
+    counts = jnp.sum(onehot, axis=0)
     padded_counts = ((counts + bm - 1) // bm) * bm
     pad_offsets = jnp.cumsum(padded_counts) - padded_counts
     M = n_slots + E * bm                              # static upper bound
 
-    # Destination row of sorted slot j inside the aligned layout.
-    rank = jnp.arange(n_slots) - jnp.take(group_start, sorted_experts)
-    dest = (jnp.take(pad_offsets, sorted_experts) + rank).astype(jnp.int32)
+    # Destination row of each slot (original slot order — no sort needed).
+    dest = (jnp.take(pad_offsets, slot_expert) + rank).astype(jnp.int32)
     # Which expert owns each row tile (tiles past the last group clamp to
     # E-1 and compute garbage nobody reads).
     ends = pad_offsets + padded_counts
@@ -282,11 +287,11 @@ def _grouped_ffn(x, probs, idx, w_gate, w_up, w_down, block_m: int = 128,
 
     # Inverse maps (1-D int scatters — cheap).  Sentinels point at the
     # appended zero row.
-    token_of_sorted = (sort_idx // k).astype(jnp.int32)
-    inv_src = jnp.full((M,), n_tok, jnp.int32).at[dest].set(token_of_sorted)
-    slot_dest = jnp.zeros((n_slots,), jnp.int32).at[sort_idx].set(dest)
+    slot_dest = dest
+    inv_src = jnp.full((M,), n_tok, jnp.int32).at[dest].set(
+        (jnp.arange(n_slots) // k).astype(jnp.int32))
     inv_pos = jnp.full((M,), n_slots, jnp.int32).at[dest].set(
-        sort_idx.astype(jnp.int32))
+        jnp.arange(n_slots, dtype=jnp.int32))
 
     if save_names:
         from jax.ad_checkpoint import checkpoint_name
@@ -294,7 +299,8 @@ def _grouped_ffn(x, probs, idx, w_gate, w_up, w_down, block_m: int = 128,
         def checkpoint_name(v, _):
             return v
 
-    x_pad = _dispatch_rows(h_flat, inv_src, slot_dest.reshape(n_tok, k))
+    x_pad = checkpoint_name(
+        _dispatch_rows(h_flat, inv_src, slot_dest.reshape(n_tok, k)), "moe_x")
     gate = checkpoint_name(gmm(x_pad, w_gate, tile_experts, bm), "ffn_gate")
     up = checkpoint_name(gmm(x_pad, w_up, tile_experts, bm), "ffn_up")
     hh = jax.nn.silu(gate) * up

@@ -39,14 +39,18 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _pick_block(dim: int, want: int) -> int:
-    """Largest power-of-two block <= want that divides dim (>= 128 when
-    possible — MXU/lane alignment)."""
-    b = want
-    while b > 128 and dim % b:
-        b //= 2
-    if dim % b:
-        raise ValueError(f"dimension {dim} not divisible by any block <= {want}")
-    return b
+    """Largest multiple-of-128 block <= want that divides dim (Mosaic lane
+    alignment), or the whole dim when dim <= want (a block equal to the
+    array dim is always legal, which also covers sub-lane test shapes).
+    128 multiples (not just powers of two) matter: intermediate sizes like
+    2816 (= 11*256) admit 1408-wide blocks, which keep the MXU fed where a
+    256 fallback would leave the kernel grid-bound."""
+    if dim <= want:
+        return dim
+    for b in range((want // 128) * 128, 127, -128):
+        if dim % b == 0:
+            return b
+    raise ValueError(f"dimension {dim} not divisible by any block <= {want}")
 
 
 def _interpret() -> bool:
@@ -103,6 +107,7 @@ def _gmm_fwd_impl(lhs, rhs, tile_experts, bm, bn, bk):
 
 def _tgmm_kernel(te_ref, lhs_ref, dout_ref, out_ref, acc_ref):
     m = pl.program_id(2)
+    nm = pl.num_programs(2)
     first_of_expert = jnp.logical_or(
         m == 0, te_ref[jnp.maximum(m, 1) - 1] != te_ref[m])
 
@@ -110,11 +115,20 @@ def _tgmm_kernel(te_ref, lhs_ref, dout_ref, out_ref, acc_ref):
     def _():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    acc_ref[...] += jnp.dot(lhs_ref[...].T, dout_ref[...],
-                            preferred_element_type=jnp.float32)
-    # Write-through every step: the last tile of the expert leaves the
-    # complete sum in the block before the revisit sequence ends.
-    out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+    # Contract the row (tile) dim of both operands directly — an explicit
+    # lhs.T would materialize a transpose in VMEM every grid step.
+    acc_ref[...] += jax.lax.dot_general(
+        lhs_ref[...], dout_ref[...], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    # Write the block only on the expert's LAST tile — a write-through on
+    # every step costs ~10x the block's worth of redundant HBM writes.
+    last_of_expert = jnp.logical_or(
+        m == nm - 1, te_ref[jnp.minimum(m + 1, nm - 1)] != te_ref[m])
+
+    @pl.when(last_of_expert)
+    def _():
+        out_ref[...] = acc_ref[...].astype(out_ref.dtype)
 
 
 def _tgmm_impl(lhs, dout, tile_experts, n_experts, bm, bkk, bn):
@@ -125,8 +139,12 @@ def _tgmm_impl(lhs, dout, tile_experts, n_experts, bm, bkk, bn):
     M, K = lhs.shape
     M2, N = dout.shape
     assert M == M2
-    bkk = _pick_block(K, bkk)
     bn = _pick_block(N, bn)
+    # The f32 accumulator + double-buffered output blocks dominate VMEM
+    # here (unlike gmm, whose accumulator is only [bm, bn]): cap the
+    # (bkk, bn) block at ~1M elements so acc + 2x out stays ~12 MB.
+    budget = max(128, (1_000_000 // bn) // 128 * 128)
+    bkk = _pick_block(K, min(bkk, budget))
     grid = (K // bkk, N // bn, M // bm)
     out = pl.pallas_call(
         _tgmm_kernel,
@@ -157,7 +175,7 @@ def _tgmm_impl(lhs, dout, tile_experts, n_experts, bm, bkk, bn):
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def gmm(lhs, rhs, tile_experts, bm: int = 128, bn: int = 512, bk: int = 512):
+def gmm(lhs, rhs, tile_experts, bm: int = 256, bn: int = 1408, bk: int = 1408):
     """Grouped matmul: row tile i of ``lhs`` is multiplied by
     ``rhs[tile_experts[i]]``.
 

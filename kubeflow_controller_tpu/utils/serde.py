@@ -103,6 +103,24 @@ def from_dict(cls: Type[T], d: Optional[Dict[str, Any]]) -> Optional[T]:
     return cls(**kwargs)
 
 
+def json_merge_patch(target: Any, patch: Any) -> Any:
+    """RFC 7386 JSON merge patch: dicts merge recursively, ``null`` deletes
+    a key, everything else replaces.  The semantics k8s applies for
+    ``application/merge-patch+json`` — the patch dialect the object-patch
+    surface speaks (ref: pkg/controller/control/service.go:50-53 uses the
+    strategic variant; for the resources here — no patchMergeKey lists on
+    the mutated paths — merge patch is behavior-identical)."""
+    if not isinstance(patch, dict):
+        return patch
+    out = dict(target) if isinstance(target, dict) else {}
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = json_merge_patch(out.get(k), v)
+    return out
+
+
 def deep_copy(obj: T) -> T:
     """Semantic equivalent of the generated ``DeepCopy`` methods.
 

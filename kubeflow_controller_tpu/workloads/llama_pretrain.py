@@ -47,6 +47,18 @@ def main(argv=None) -> int:
                         "to einsum under a >1-device mesh)")
     p.add_argument("--sp-attention", choices=["ring", "ulysses"], default="ring",
                    help="sequence-parallel attention schedule when --sp > 1")
+    p.add_argument("--remat-policy", default="",
+                   choices=["", "full", "dots", "ffn", "gateup", "gateup_attn",
+                            "moe"],
+                   help="rematerialization policy override (FLOPs/HBM dial; "
+                        "docs/PERF.md); empty = config default")
+    p.add_argument("--loss-chunks", type=int, default=0,
+                   help="chunked cross-entropy over N sequence chunks "
+                        "(0 = dense logits)")
+    p.add_argument("--attention", default="",
+                   choices=["", "auto", "flash", "xla"],
+                   help="attention implementation override; empty = config "
+                        "default (Pallas flash kernel on TPU at T >= 1024)")
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--profile-dir", default="",
                    help="write a jax.profiler trace of the training loop "
@@ -89,6 +101,12 @@ def main(argv=None) -> int:
     if args.experts:
         overrides.update(n_experts=args.experts, moe_top_k=args.top_k,
                          moe_dispatch=args.moe_dispatch)
+    if args.remat_policy:
+        overrides.update(remat=True, remat_policy=args.remat_policy)
+    if args.loss_chunks:
+        overrides["loss_chunks"] = args.loss_chunks
+    if args.attention:
+        overrides["attention"] = args.attention
     if overrides:
         import dataclasses
 

@@ -161,6 +161,32 @@ def test_patch_meta_adoption():
     assert got.metadata.owner_references[0].uid == "u"
 
 
+def test_object_patch_merge_semantics():
+    """Full-object RFC 7386 merge patch (the PatchService analog): nested
+    maps merge per-key, null deletes, scalars replace; immutable metadata
+    survives, the resourceVersion bumps, and watchers see MODIFIED."""
+    from kubeflow_controller_tpu.api.core import Service, ServiceSpec
+
+    c = Cluster()
+    svc = Service(metadata=ObjectMeta(name="s", namespace="ns",
+                                      labels={"a": "1", "b": "2"}),
+                  spec=ServiceSpec(selector={"job": "x", "idx": "0"}))
+    created = c.services.create(svc)
+    w = c.services.watch("ns")
+    patched = c.services.patch("ns", "s", {
+        "metadata": {"labels": {"b": None, "c": "3"}},
+        "spec": {"selector": {"idx": "1"}},
+    })
+    # Per-key merge: untouched keys survive, null deletes, new keys land.
+    assert patched.metadata.labels == {"a": "1", "c": "3"}
+    assert patched.spec.selector == {"job": "x", "idx": "1"}
+    assert patched.metadata.uid == created.metadata.uid
+    assert patched.metadata.resource_version != created.metadata.resource_version
+    ev = w.next(timeout=2.0)
+    assert ev.type == MODIFIED and ev.object.metadata.name == "s"
+    w.stop()
+
+
 # ---- fake kubelet: simulated ----
 
 def test_kubelet_worker_succeeds_ps_runs_forever():

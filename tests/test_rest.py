@@ -146,6 +146,27 @@ class TestRestCRUD:
         assert stored.metadata.labels["adopted"] == "true"
         assert stored.metadata.owner_references[0].controller is True
 
+    def test_object_patch_over_rest(self, server, rest):
+        """The PatchService analog over the wire: a spec-touching merge
+        patch mutates exactly the named fields server-side (ref:
+        pkg/controller/control/service.go:50-53)."""
+        from kubeflow_controller_tpu.api.core import Service, ServiceSpec
+
+        srv, _ = server
+        svc = Service(metadata=ObjectMeta(name="svc", namespace="default",
+                                          labels={"keep": "yes"}),
+                      spec=ServiceSpec(selector={"job": "x", "idx": "0"}))
+        rest.services.create(svc)
+        out = rest.services.patch("default", "svc", {
+            "spec": {"selector": {"idx": "7"}},
+            "metadata": {"labels": {"extra": "1"}},
+        })
+        assert out.spec.selector == {"job": "x", "idx": "7"}
+        assert out.metadata.labels == {"keep": "yes", "extra": "1"}
+        stored = srv.store.get("services", "default", "svc")
+        assert stored.spec.selector["idx"] == "7"
+        assert stored.metadata.labels == {"keep": "yes", "extra": "1"}
+
 
 class TestRestWatch:
     def test_watch_stream_add_modify_delete(self, rest):
