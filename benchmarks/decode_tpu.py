@@ -90,8 +90,13 @@ def _write_artifact(args, results) -> list:
         "bench": "llama_decode_single_chip",
         "model": (f"Llama (dim {args.dim}, L{args.layers}, H{args.heads}, "
                   f"inter {args.intermediate}), bf16, KV-cache greedy decode"),
-        "prompt_len": args.prompt_len,
-        "new_tokens": args.new_tokens,
+        "note": ("Decode threads the KV caches through the layer scan as "
+                 "CARRY (the xs/ys form copied both [L,B,S,kvH,D] caches "
+                 "every token step).  kv_block=0 = default reads: blocked "
+                 "length-masked when the cache spans > 1 block (the S=2048 "
+                 "rows), the dense single-block read at S=256.  "
+                 "kv_block=2048 forces the dense full-S read at S=2048 "
+                 "(the A/B); kv_quant = int8 rows with per-row f32 scales."),
         "results": results,
         "best_throughput": max(ok, key=lambda r: r["gen_tokens_per_s"]) if ok else None,
     }
@@ -123,18 +128,15 @@ def main() -> int:
     ]
     if args.sweep:
         grid = [
-            # Short-context points (S=256, single cache block -> dense
-            # read; comparable with the round-2 artifact).
+            # Short-context points (S=256 = ONE cache block, so these take
+            # the dense single-block read; comparable with round 2).
             dict(batch=1), dict(batch=8), dict(batch=32),
-            # Long-context A/B: S=2048 (8 blocks).  Length-masked blocked
-            # reads vs the dense full-S masked read the cache used before
-            # (kv_block = S forces the old behavior).
+            # Long-context A/B at S=2048 (8 blocks): default blocked
+            # length-masked reads vs the dense full-S masked read
+            # (kv_block = S forces dense), plus int8 KV on top of blocked.
             dict(batch=8, prompt=1024, new=1024),
+            dict(batch=8, prompt=1024, new=1024, quant=True),
             dict(batch=8, prompt=1024, new=1024, kv_block=2048),
-            dict(batch=32, prompt=1024, new=1024),
-            dict(batch=32, prompt=1024, new=1024, kv_block=2048),
-            # int8 KV: halves the cache stream on top of blocked reads.
-            dict(batch=32, prompt=1024, new=1024, quant=True),
         ]
         results = []
         for g in grid:

@@ -107,24 +107,28 @@ def _cache_attention_dense(q, kk, vv, mask, rules):
     return jnp.einsum("bhts,bshd->bthd", p, vv.astype(jnp.float32)).astype(q.dtype)
 
 
-def _cache_attention_blocked(q, kc, vc, start_pos, block, rules,
-                             k_scale=None, v_scale=None):
+def _cache_attention_blocked(q, kc_all, vc_all, layer, start_pos, block,
+                             rules, k_scale_all=None, v_scale_all=None):
     """Length-masked cache read: online-softmax attention over the cache in
     ``block``-sized chunks, looping only over ceil((start_pos+T)/block)
     blocks — HBM traffic per step follows the written prefix, not the
     static cache size.  GQA is handled by grouping query heads per kv head
     ([B,T,kvH,rep,D]) so the repeated cache never materializes.
 
-    q [B,T,H,D] (RoPE applied); kc/vc [B,S,kvH,D]; start_pos traced OK
-    (the fori_loop gets a dynamic trip count -> while_loop).
+    q [B,T,H,D] (RoPE applied); kc_all/vc_all are the FULL [L,B,S,kvH,D]
+    caches with ``layer`` the (traced) layer index — blocks slice straight
+    out of the 5-D carry so no per-layer [B,S,kvH,D] view ever
+    materializes.  start_pos traced OK (the fori_loop gets a dynamic trip
+    count -> while_loop).
 
-    With ``k_scale``/``v_scale`` ([B,S,kvH] f32) the cache is int8 and
-    only int8 rows stream from HBM; scales fold into the score matrix
-    (per k-position column) and the softmax weights (per v-position)."""
+    With ``k_scale_all``/``v_scale_all`` ([L,B,S,kvH] f32) the cache is
+    int8 and only int8 rows stream from HBM; scales fold into the score
+    matrix (per k-position column) and the softmax weights (per
+    v-position)."""
     B, T, H, D = q.shape
-    S, kvH = kc.shape[1], kc.shape[2]
+    S, kvH = kc_all.shape[2], kc_all.shape[3]
     rep = H // kvH
-    quant = k_scale is not None
+    quant = k_scale_all is not None
     qg = (q.astype(jnp.float32) * D ** -0.5).reshape(B, T, kvH, rep, D)
     q_pos = start_pos + jnp.arange(T)                        # [T]
     n_blocks = (start_pos + T + block - 1) // block          # traced
@@ -135,14 +139,17 @@ def _cache_attention_blocked(q, kc, vc, start_pos, block, rules,
 
     def body(i, carry):
         m, l, acc = carry
-        kb = jax.lax.dynamic_slice_in_dim(
-            kc, i * block, block, axis=1).astype(jnp.float32)
-        vb = jax.lax.dynamic_slice_in_dim(
-            vc, i * block, block, axis=1).astype(jnp.float32)
+        kb = jax.lax.dynamic_slice(
+            kc_all, (layer, 0, i * block, 0, 0),
+            (1, B, block, kvH, D))[0].astype(jnp.float32)
+        vb = jax.lax.dynamic_slice(
+            vc_all, (layer, 0, i * block, 0, 0),
+            (1, B, block, kvH, D))[0].astype(jnp.float32)
         s = jnp.einsum("btgrd,bsgd->btgrs", qg, kb)
         if quant:
-            ks = jax.lax.dynamic_slice_in_dim(
-                k_scale, i * block, block, axis=1)           # [B,block,kvH]
+            ks = jax.lax.dynamic_slice(
+                k_scale_all, (layer, 0, i * block, 0),
+                (1, B, block, kvH))[0]                       # [B,block,kvH]
             s = s * ks.transpose(0, 2, 1)[:, None, :, None, :]
         kv_pos = i * block + jnp.arange(block)               # [block]
         msk = kv_pos[None, :] <= q_pos[:, None]              # [T, block]
@@ -155,8 +162,9 @@ def _cache_attention_blocked(q, kc, vc, start_pos, block, rules,
         l = l * alpha + jnp.sum(p, axis=-1)
         pv = p
         if quant:
-            vs = jax.lax.dynamic_slice_in_dim(
-                v_scale, i * block, block, axis=1)
+            vs = jax.lax.dynamic_slice(
+                v_scale_all, (layer, 0, i * block, 0),
+                (1, B, block, kvH))[0]
             pv = p * vs.transpose(0, 2, 1)[:, None, :, None, :]
         acc = acc * alpha[..., None] + jnp.einsum("btgrs,bsgd->btgrd", pv, vb)
         return m_new, l, acc
@@ -204,48 +212,58 @@ def forward_with_cache(
     kv_pos = jnp.arange(S)[None, :]                 # [1, S]
     mask = (kv_pos <= q_pos)[None, None, :, :]      # [1,1,T,S]
 
-    kv_axes = CACHE_AXES[1:]  # per-layer view: no leading layers dim
     quant = "k_scale" in cache
+    # The caches ride the layer scan as CARRY (updated in place by a
+    # per-layer dynamic-update-slice), NOT as scanned xs -> stacked ys:
+    # the xs/ys form makes XLA re-stack — i.e. fully COPY — both caches
+    # once per decode step inside the token loop (measured: two
+    # [L,B,S,kvH,D] copies per token, ~4GB/step at B=8 S=2048), which
+    # dwarfs the attention reads the blocked path saves.
 
-    def layer(x, scanned):
+    def layer(carry, scanned):
         if quant:
-            lp, kc, vc, ksc, vsc = scanned          # kc/vc int8, scales f32
+            x, kc_all, vc_all, ksc_all, vsc_all = carry
         else:
-            lp, kc, vc = scanned                    # kc/vc: [B, S, kvH, D]
-            ksc = vsc = None
+            x, kc_all, vc_all = carry
+            ksc_all = vsc_all = None
+        lp, li = scanned                            # li: this layer's index
         h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
         q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dtype))
         k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dtype))
         v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dtype))
         q = with_logical_constraint(q, ("batch", None, "heads", "head_dim"), rules)
-        k = with_logical_constraint(k, kv_axes, rules)
-        v = with_logical_constraint(v, kv_axes, rules)
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
         if quant:
             kq, ks = _quantize_rows(k)
             vq, vs = _quantize_rows(v)
-            kc = jax.lax.dynamic_update_slice_in_dim(kc, kq, start_pos, axis=1)
-            vc = jax.lax.dynamic_update_slice_in_dim(vc, vq, start_pos, axis=1)
-            ksc = jax.lax.dynamic_update_slice_in_dim(ksc, ks, start_pos, axis=1)
-            vsc = jax.lax.dynamic_update_slice_in_dim(vsc, vs, start_pos, axis=1)
+            kc_all = jax.lax.dynamic_update_slice(
+                kc_all, kq[None], (li, 0, start_pos, 0, 0))
+            vc_all = jax.lax.dynamic_update_slice(
+                vc_all, vq[None], (li, 0, start_pos, 0, 0))
+            ksc_all = jax.lax.dynamic_update_slice(
+                ksc_all, ks[None], (li, 0, start_pos, 0))
+            vsc_all = jax.lax.dynamic_update_slice(
+                vsc_all, vs[None], (li, 0, start_pos, 0))
         else:
-            kc = jax.lax.dynamic_update_slice_in_dim(
-                kc, k.astype(kc.dtype), start_pos, axis=1)
-            vc = jax.lax.dynamic_update_slice_in_dim(
-                vc, v.astype(vc.dtype), start_pos, axis=1)
-        kc = with_logical_constraint(kc, kv_axes, rules)
-        vc = with_logical_constraint(vc, kv_axes, rules)
+            kc_all = jax.lax.dynamic_update_slice(
+                kc_all, k.astype(kc_all.dtype)[None], (li, 0, start_pos, 0, 0))
+            vc_all = jax.lax.dynamic_update_slice(
+                vc_all, v.astype(vc_all.dtype)[None], (li, 0, start_pos, 0, 0))
+        kc_all = with_logical_constraint(kc_all, CACHE_AXES, rules)
+        vc_all = with_logical_constraint(vc_all, CACHE_AXES, rules)
         if blocked:
-            attn = _cache_attention_blocked(q, kc, vc, start_pos, block, rules,
-                                            k_scale=ksc, v_scale=vsc)
+            attn = _cache_attention_blocked(
+                q, kc_all, vc_all, li, start_pos, block, rules,
+                k_scale_all=ksc_all, v_scale_all=vsc_all)
         else:
+            kk = jax.lax.dynamic_index_in_dim(kc_all, li, 0, keepdims=False)
+            vv = jax.lax.dynamic_index_in_dim(vc_all, li, 0, keepdims=False)
             if quant:
-                kk = kc.astype(jnp.float32) * ksc[..., None]
-                vv = vc.astype(jnp.float32) * vsc[..., None]
-                kk, vv = kk.astype(dtype), vv.astype(dtype)
-            else:
-                kk, vv = kc, vc
+                ksl = jax.lax.dynamic_index_in_dim(ksc_all, li, 0, keepdims=False)
+                vsl = jax.lax.dynamic_index_in_dim(vsc_all, li, 0, keepdims=False)
+                kk = (kk.astype(jnp.float32) * ksl[..., None]).astype(dtype)
+                vv = (vv.astype(jnp.float32) * vsl[..., None]).astype(dtype)
             if repeats > 1:
                 kk = jnp.repeat(kk, repeats, axis=2)
                 vv = jnp.repeat(vv, repeats, axis=2)
@@ -258,20 +276,20 @@ def forward_with_cache(
         x = x + ffn_block(h, lp, cfg, rules)
         x = with_logical_constraint(x, ("batch", None, None), rules)
         if quant:
-            return x, (kc, vc, ksc, vsc)
-        return x, (kc, vc)
+            return (x, kc_all, vc_all, ksc_all, vsc_all), None
+        return (x, kc_all, vc_all), None
 
+    l_idx = jnp.arange(cfg.n_layers)
     if quant:
-        x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
-            layer, x, (params["layers"], cache["k"], cache["v"],
-                       cache["k_scale"], cache["v_scale"])
-        )
+        (x, k_new, v_new, ks_new, vs_new), _ = jax.lax.scan(
+            layer,
+            (x, cache["k"], cache["v"], cache["k_scale"], cache["v_scale"]),
+            (params["layers"], l_idx))
         new_cache = {"k": k_new, "v": v_new,
                      "k_scale": ks_new, "v_scale": vs_new}
     else:
-        x, (k_new, v_new) = jax.lax.scan(
-            layer, x, (params["layers"], cache["k"], cache["v"])
-        )
+        (x, k_new, v_new), _ = jax.lax.scan(
+            layer, (x, cache["k"], cache["v"]), (params["layers"], l_idx))
         new_cache = {"k": k_new, "v": v_new}
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(dtype))
