@@ -137,6 +137,8 @@ def main() -> int:
             dict(batch=8, prompt=1024, new=1024),
             dict(batch=8, prompt=1024, new=1024, quant=True),
             dict(batch=8, prompt=1024, new=1024, kv_block=2048),
+            # Very long context: S=8192 (32 blocks) at B=1.
+            dict(batch=1, prompt=4096, new=4096),
         ]
         results = []
         for g in grid:

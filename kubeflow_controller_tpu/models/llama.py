@@ -380,11 +380,13 @@ def _maybe_remat(layer, cfg: LlamaConfig):
         # re-running the (flash) attention forward in the backward pass.
         "gateup_attn": policies.save_only_these_names(
             "ffn_gate", "ffn_up", "attn_proj"),
-        # MoE (grouped dispatch): save all three grouped-matmul outputs AND
-        # the dispatched activations, so the backward re-runs only the
-        # cheap routing index math — not the row gathers or any gmm.
+        # MoE: save the expert-FFN matmul outputs (both dispatch paths tag
+        # them inside expert_ffn / the grouped gmm chain) AND the
+        # dispatch-side intermediates (grouped: the dispatched rows;
+        # einsum: the dispatch/combine einsum outputs), so the backward
+        # re-runs only cheap routing math.
         "moe": policies.save_only_these_names(
-            "ffn_gate", "ffn_up", "ffn_down", "moe_x", "attn_proj"),
+            "ffn_gate", "ffn_up", "ffn_down", "moe_x", "moe_y", "attn_proj"),
     }
     if cfg.remat_policy not in named:
         raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}; "
@@ -408,12 +410,10 @@ def ffn_block(h: jax.Array, lp, cfg: LlamaConfig,
     # recompute the rest.  Only inserted when the policy consumes them: the
     # name_p primitive blocks XLA fusions, measured 3.5x slower under the
     # plain "full" policy on v5e (docs/PERF.md).
-    if cfg.remat_policy in ("ffn", "gateup", "gateup_attn", "moe"):
-        from jax.ad_checkpoint import checkpoint_name
-    else:
-        def checkpoint_name(x, _):
-            return x
+    from .moe import ckpt_marker
 
+    checkpoint_name = ckpt_marker(
+        cfg.remat_policy in ("ffn", "gateup", "gateup_attn", "moe"))
     gate = checkpoint_name(
         jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(dtype)), "ffn_gate")
     up = checkpoint_name(
@@ -459,9 +459,9 @@ def _decoder_layer_fn(cfg: LlamaConfig, angles, mesh, rules):
         attn = _attention(q, k, v, mesh, causal=True, rules=rules, cfg=cfg)
         proj = jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))
         if cfg.remat_policy in ("gateup_attn", "moe"):
-            from jax.ad_checkpoint import checkpoint_name
+            from .moe import ckpt_marker
 
-            proj = checkpoint_name(proj, "attn_proj")
+            proj = ckpt_marker(True)(proj, "attn_proj")
         x = x + proj
 
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)

@@ -21,6 +21,22 @@ import jax.numpy as jnp
 from ..parallel.sharding import DEFAULT_RULES, ShardingRules, with_logical_constraint
 
 
+def ckpt_marker(enabled: bool):
+    """``jax.ad_checkpoint.checkpoint_name`` when ``enabled``, else a
+    no-op shim — markers are only inserted when the active remat policy
+    consumes them (an unused name_p primitive blocks XLA fusions,
+    measured 3.5x slower under the plain "full" policy; docs/PERF.md)."""
+    if enabled:
+        from jax.ad_checkpoint import checkpoint_name
+
+        return checkpoint_name
+
+    def noop(v, _name):
+        return v
+
+    return noop
+
+
 def router_topk(
     logits: jax.Array, k: int
 ) -> Tuple[jax.Array, jax.Array]:
@@ -160,11 +176,15 @@ def moe_ffn_stats(
 
     def expert_ffn(xe):
         """xe [B, E, C, D] -> [B, E, C, D], expert dim sharded over ep."""
+        name = ckpt_marker(save_names)
         xe = with_logical_constraint(xe, ("batch", "expert", None, None), rules)
-        gate = jnp.einsum("becd,edf->becf", xe, w_gate.astype(dtype))
-        up = jnp.einsum("becd,edf->becf", xe, w_up.astype(dtype))
+        gate = name(jnp.einsum("becd,edf->becf", xe, w_gate.astype(dtype)),
+                    "ffn_gate")
+        up = name(jnp.einsum("becd,edf->becf", xe, w_up.astype(dtype)),
+                  "ffn_up")
         h = jax.nn.silu(gate) * up
-        ye = jnp.einsum("becf,efd->becd", h, w_down.astype(dtype))
+        ye = name(jnp.einsum("becf,efd->becd", h, w_down.astype(dtype)),
+                  "ffn_down")
         return with_logical_constraint(ye, ("batch", "expert", None, None), rules)
 
     if grouped:
@@ -200,15 +220,21 @@ def moe_ffn_stats(
         # distinct), so the k axis folds away BEFORE the one-hot: the
         # [B,T,k,E,C] intermediate of the textbook GShard formulation never
         # materializes (k-fold less one-hot traffic).
+        checkpoint_name = ckpt_marker(save_names)
         keep_e = jnp.sum(keep, axis=2)                          # [B,T,E] 0/1
         pos_e = jnp.sum(keep * pos, axis=2).astype(jnp.int32)   # [B,T,E]
         prob_e = jnp.einsum("btk,btke->bte", probs, keep)       # [B,T,E]
         pos_oh = jax.nn.one_hot(pos_e, C, dtype=jnp.float32)    # [B,T,E,C]
         disp = keep_e[..., None] * pos_oh
         combine = prob_e[..., None] * pos_oh
-        xe = jnp.einsum("btec,btd->becd", disp.astype(dtype), x)
+        # The dispatch/combine einsums are the einsum path's dominant cost
+        # (docs/PERF.md); marking their outputs lets the "moe" remat policy
+        # save them so the backward does not re-pay the dispatch tax.
+        xe = checkpoint_name(
+            jnp.einsum("btec,btd->becd", disp.astype(dtype), x), "moe_x")
         ye = expert_ffn(xe)
-        y = jnp.einsum("btec,becd->btd", combine.astype(dtype), ye)
+        y = checkpoint_name(
+            jnp.einsum("btec,becd->btd", combine.astype(dtype), ye), "moe_y")
     else:
         raise ValueError(f"unknown dispatch {dispatch!r}")
 
@@ -293,12 +319,7 @@ def _grouped_ffn(x, probs, idx, w_gate, w_up, w_down, block_m: int = 256,
     inv_pos = jnp.full((M,), n_slots, jnp.int32).at[dest].set(
         jnp.arange(n_slots, dtype=jnp.int32))
 
-    if save_names:
-        from jax.ad_checkpoint import checkpoint_name
-    else:
-        def checkpoint_name(v, _):
-            return v
-
+    checkpoint_name = ckpt_marker(save_names)
     x_pad = checkpoint_name(
         _dispatch_rows(h_flat, inv_src, slot_dest.reshape(n_tok, k)), "moe_x")
     gate = checkpoint_name(gmm(x_pad, w_gate, tile_experts, bm), "ffn_gate")

@@ -240,6 +240,29 @@ class TestDispatchModes:
             moe_ffn_stats(x, router, wg, wu, wd, dispatch="sort")
 
 
+class TestMoERematPolicy:
+    def test_moe_policy_grads_match_full_remat(self):
+        """remat_policy='moe' (saves the tagged expert-FFN matmuls and
+        dispatch intermediates) must produce the same gradients as plain
+        full remat — it changes what the backward recomputes, not the
+        math.  Locks in the moe_x/moe_y/ffn_* checkpoint_name markers."""
+        import dataclasses
+
+        from kubeflow_controller_tpu.models import llama_init, llama_loss
+
+        base = LlamaConfig.tiny(n_experts=4, moe_top_k=2, remat=True,
+                                remat_policy="full")
+        moe_pol = dataclasses.replace(base, remat_policy="moe")
+        params = llama_init(jax.random.PRNGKey(0), base)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                    base.vocab_size)
+        g_full = jax.grad(lambda p: llama_loss(p, tokens, base))(params)
+        g_moe = jax.grad(lambda p: llama_loss(p, tokens, moe_pol))(params)
+        for a, b in zip(jax.tree.leaves(g_full), jax.tree.leaves(g_moe)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-5)
+
+
 class TestGroupedDispatch:
     """The megablocks-style grouped path (ops/grouped_matmul.py) — dropless,
     so the oracle is moe_ffn_reference, not the capacity paths.  Off-TPU
