@@ -138,6 +138,31 @@ class TestKVCache:
         assert a.shape == (2, 8)
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+    def test_no_per_token_cache_copies_in_compiled_decode(self):
+        """Regression lock for the cache-as-scan-carry fix: threading the
+        KV caches through the layer scan as xs->ys made XLA COPY both
+        [L,B,S,kvH,D] caches once per generated token (~4GB/step at real
+        sizes).  The carry form must compile with at most the one-time
+        zero-init copies — none proportional to generated tokens."""
+        cfg, params = setup()
+        prompt = jnp.zeros((2, 8), jnp.int32)
+        fn = jax.jit(lambda p, t: generate(p, t, cfg, max_new_tokens=24,
+                                           kv_block=16))
+        txt = fn.lower(params, prompt).compile().as_text()
+        # Derive the cache-shape signature from init_cache itself so config
+        # drift cannot silently detach the grep from the real cache.
+        cache_shape = init_cache(cfg, 2, 32)["k"].shape  # 8+24 rounds to 32
+        shape_sig = ",".join(map(str, cache_shape))
+        flat = [ln.replace(" ", "") for ln in txt.splitlines()]
+        # Positive control: the cache shape must appear in the HLO at all —
+        # otherwise the copy-grep below would pass vacuously.
+        assert any(shape_sig in ln for ln in flat), shape_sig
+        copies = [ln for ln in flat if "copy(" in ln and shape_sig in ln]
+        # Zero-init copies (of broadcasts) are fine; copies of loop tuple
+        # elements are the per-token re-stacking this test forbids.
+        loop_copies = [ln for ln in copies if "broadcast" not in ln]
+        assert not loop_copies, "\n".join(ln[:120] for ln in loop_copies)
+
     def test_sampled_generate_shape_and_determinism(self):
         cfg, params = setup()
         prompt = jnp.zeros((2, 3), jnp.int32)
