@@ -148,8 +148,11 @@ def run_dist_mnist() -> dict:
         if pool is not None:
             import glob
 
+            # Pool log names are "{ns}_{pod}-{rid}.out" (warmpool.py), so
+            # match on the pod-name substring; the warmup job's pods are
+            # "bench-warmup-*" and stay excluded.
             for f in glob.glob(os.path.join(pool._tmpdir,
-                                            "bench-dist-mnist-*.out")):
+                                            "*bench-dist-mnist-*.out")):
                 for ln in open(f, errors="replace"):
                     if ln.startswith("Phase times:"):
                         phase_lines.append(ln.strip())
