@@ -290,7 +290,9 @@ class FakeAPIServer:
             # Every PATCH body is one dialect: RFC 7386 merge, applied
             # server-side (maps merge per-key, null deletes, lists replace)
             # — metadata-only bodies included, so the REST client's
-            # patch()/patch_meta() cannot diverge by code path.
+            # patch()/patch_meta() cannot diverge by code path.  The
+            # status-subresource strip lives in store.patch, shared with
+            # the in-process client.
             h._send(200, self._wire(
                 r.plural, store.patch(r.plural, ns, r.name, h._body())))
             return

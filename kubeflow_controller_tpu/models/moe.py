@@ -131,7 +131,8 @@ def moe_ffn_stats(
       Falls back to "einsum" (one warning) when it cannot run: under an
       active mesh (the sharded path needs the einsum formulation's
       constraints), or at shapes below the TPU tiling grain (D/F not
-      multiples of 128, or B*T*k not a multiple of 8).
+      multiples of 128, or B*T*k not a multiple of the dtype's sublane
+      tile — 8 for f32, 16 for bf16/f16).
     """
     import math
 
@@ -153,8 +154,12 @@ def moe_ffn_stats(
             why = "an active mesh (single-shard only)"
         elif D % 128 or F % 128:
             why = f"dims not multiples of 128 (D={D}, F={F})"
-        elif (B * T * top_k) % 8:
-            why = f"B*T*k = {B * T * top_k} not a multiple of 8"
+        elif (B * T * top_k) % (8 if dtype == jnp.float32 else 16):
+            # Mosaic's sublane tile is 8 rows for f32 but 16 for bf16/f16;
+            # the divisor must keep block_m at or above the dtype's tile.
+            grain = 8 if dtype == jnp.float32 else 16
+            why = (f"B*T*k = {B * T * top_k} not a multiple of {grain} "
+                   f"(sublane tile for {dtype})")
         if why:
             import warnings
 
@@ -290,7 +295,15 @@ def _grouped_ffn(x, probs, idx, w_gate, w_up, w_down, block_m: int = 256,
     bm = block_m
     while n_slots % bm:
         bm //= 2
-    assert bm >= 8, f"caller must guarantee 8 | B*T*k (got {n_slots})"
+    # Mosaic's native sublane tile is (8, 128) for f32 but (16, 128) for
+    # bf16/f16: an 8-row block with sub-32-bit inputs only compiles under
+    # interpret mode, so the floor (and the caller-side divisibility
+    # fallback in route_dropless) is 16 for narrow dtypes.
+    floor = 8 if x.dtype == jnp.float32 else 16
+    assert bm >= floor, (
+        f"caller must guarantee {floor} | B*T*k for {x.dtype} inputs "
+        f"(got {n_slots}); on-chip (sublane, lane) tiling is (16, 128) "
+        f"below 32-bit")
     h_flat = x.reshape(n_tok, D)
 
     slot_expert = idx.reshape(n_slots)

@@ -45,10 +45,13 @@ DEFAULT_RULES = ShardingRules(rules=(
     ("kv_heads", AXIS_TENSOR),
     ("head_dim", None),
     ("mlp", AXIS_TENSOR),
-    # vocab claims tp first (megatron vocab-parallel lm_head/table), and
-    # falls back to fsdp so the 0.5GB-scale table + optimizer moments stay
-    # ZeRO-sharded on tp=1 fsdp-only meshes.  On activations ("batch",...,
-    # "vocab") batch already holds fsdp, so logits stay tp-sharded only.
+    # vocab shards over tp AND fsdp jointly (logical_to_pspec hands a dim
+    # every still-free mesh axis in its tuple): the table's vocab dim is
+    # split over the tp*fsdp product, keeping the 0.5GB-scale table +
+    # optimizer moments ZeRO-sharded even on tp=1 fsdp-only meshes.  Later
+    # logical axes only drop mesh axes already taken, so on activations
+    # ("batch",...,"vocab") batch already holds fsdp and logits come out
+    # tp-sharded only.
     ("vocab", (AXIS_TENSOR, AXIS_FSDP)),
     ("expert", AXIS_EXPERT),
     ("layers", None),

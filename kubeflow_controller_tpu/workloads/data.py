@@ -31,21 +31,47 @@ from ..utils.rand import as_seed as _as_seed
 Seed = Union[int, jax.Array]
 
 
+def mnist_teacher_means() -> np.ndarray:
+    """The frozen [10, 784] class templates behind every synthetic-MNIST
+    variant: low-frequency patterns (7x7 upsampled 4x) — the same
+    separation statistics as white noise for linear models, but spatially
+    smooth so convolutional models (flax_mnist) can exploit locality too.
+    Host-side and tiny (31KB); both the numpy and the traced generators
+    consume it, so they sample the same mixture."""
+    mix = np.random.default_rng(_TEACHER_SEED)
+    coarse = mix.standard_normal((NUM_CLASSES, 7, 7), dtype=np.float32) * 0.12
+    return coarse.repeat(4, axis=1).repeat(4, axis=2).reshape(NUM_CLASSES, IMAGE_PIXELS)
+
+
 def synthetic_mnist(seed: Seed, n: int) -> Tuple[jax.Array, jax.Array]:
     """n examples of (x [n,784] f32, y [n] int32): a frozen 10-component
     Gaussian mixture (one cluster per digit class), with the component
     scale tuned so models top out around the reference's ~0.92 local-MNIST
     accuracy (ref: docs/get_started.md:29-38) rather than saturating."""
-    mix = np.random.default_rng(_TEACHER_SEED)
-    # Low-frequency class templates (7x7 upsampled 4x): same separation
-    # statistics as white patterns for linear models, but spatially smooth
-    # so convolutional models (flax_mnist) can exploit locality too.
-    coarse = mix.standard_normal((NUM_CLASSES, 7, 7), dtype=np.float32) * 0.12
-    means = coarse.repeat(4, axis=1).repeat(4, axis=2).reshape(NUM_CLASSES, IMAGE_PIXELS)
+    means = mnist_teacher_means()
     rng = np.random.default_rng(_as_seed(seed))
     y = rng.integers(0, NUM_CLASSES, size=n)
     x = means[y] + rng.standard_normal((n, IMAGE_PIXELS), dtype=np.float32)
     return jnp.asarray(x), jnp.asarray(y, dtype=jnp.int32)
+
+
+def synthetic_mnist_traced(seed: Seed, n: int, means: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Traceable twin of :func:`synthetic_mnist`: the same frozen mixture
+    (identical ``means`` templates, unit noise) generated INSIDE the
+    compiled program with two bulk threefry calls.  The dataset is a pure
+    function of ``(seed, n)`` — independent of batch layout or sharding —
+    so each shard of a distributed job regenerates the identical "dataset"
+    and slices out its columns, exactly like reading a shared file but with
+    no host generation, no host->device copy, and no global-array assembly
+    consensus.  (The reference stages feed_dict batches host-side — ref:
+    examples/workdir/mnist_replica.py:251-258 — because grpc PS training
+    has no on-device program to fold generation into.)
+    """
+    base = jax.random.PRNGKey(_as_seed(seed) & 0x7FFFFFFF)
+    kx, ky = jax.random.split(base)
+    y = jax.random.randint(ky, (n,), 0, NUM_CLASSES)
+    x = means[y] + jax.random.normal(kx, (n, IMAGE_PIXELS), jnp.float32)
+    return x, y.astype(jnp.int32)
 
 
 def synthetic_tokens(seed: Seed, n_seqs: int, seq_len: int, vocab: int) -> jax.Array:

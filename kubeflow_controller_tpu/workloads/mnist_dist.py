@@ -16,7 +16,15 @@ Roles:
   (mnist_replica.py:121-122) — the data plane it used to host now rides
   XLA collectives.
 - ``worker`` / TPU replica: joins via jax.distributed (runtime.initialize),
-  feeds its shard of every global batch, trains over the global mesh.
+  generates its shard of every global batch on device, trains over the
+  global mesh.
+
+The whole workload is ONE compiled program per worker (train_scan_dist):
+batch generation, the training scan with a single fused flat-gradient
+all-reduce per step, and the sharded eval — where the reference pays one
+grpc round-trip per variable per step plus host-side feed_dict staging
+(mnist_replica.py:251-264).  On a latency-bound transport the collective
+COUNT is the cost model, not the payload size (docs/PERF.md).
 """
 
 from __future__ import annotations
@@ -43,6 +51,9 @@ def main(argv=None) -> int:
     p.add_argument("--eval-size", type=int, default=2048)
     p.add_argument("--target-accuracy", type=float, default=0.0)
     p.add_argument("--platform", default=os.environ.get("WORKLOAD_PLATFORM", ""))
+    p.add_argument("--aot-cache", default=os.environ.get("WORKLOAD_AOT_CACHE", ""),
+                   help="directory for serialized-executable reuse across "
+                        "identical jobs (see trainer.train_scan_dist)")
     args = p.parse_args(argv)
 
     if args.job_name == "ps":
@@ -62,17 +73,13 @@ def main(argv=None) -> int:
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
     from ..models import mnist as m
     from ..parallel import AXIS_DATA, MeshSpec, build_mesh
     from . import data as d
     from .runtime import JobRuntime
-    from .trainer import (
-        batch_stack,
-        default_optimizer,
-        global_batches,
-        replicate_global,
-        train_scan,
-    )
+    from .trainer import default_optimizer, train_scan_dist
 
     t_start = time.time()
     rt = JobRuntime.from_env()
@@ -85,15 +92,6 @@ def main(argv=None) -> int:
     pc, proc = jax.process_count(), jax.process_index()
     mesh = build_mesh(MeshSpec(dp=-1, fsdp=1))
 
-    x, y = d.synthetic_mnist(jax.random.PRNGKey(1), args.train_size)
-    ex, ey = d.synthetic_mnist(jax.random.PRNGKey(2), args.eval_size)
-    t_data = time.time()
-    if pc > 1:
-        # Each process owns a static shard of the data and feeds its share
-        # of every global batch.
-        x = d.shard_for_process(x, proc, pc)
-        y = d.shard_for_process(y, proc, pc)
-
     params = m.mlp_init(jax.random.PRNGKey(0))  # same seed -> same init everywhere
     opt = default_optimizer(args.lr)
     opt_state = opt.init(params)
@@ -102,35 +100,58 @@ def main(argv=None) -> int:
     # (the reference's batch 100 over e.g. 8 devices -> 96 per step).
     dp = mesh.shape[AXIS_DATA]
     bs = max(dp, args.batch_size - args.batch_size % dp)
-    start = time.time()
-    with jax.set_mesh(mesh):
-        xb, yb = batch_stack(x, y, args.steps, bs // pc)
-        batches = global_batches(mesh, AXIS_DATA, (xb, yb), bs)
-        t_batches = time.time()
-        params, opt_state, loss = train_scan(
-            lambda p, b: m.mlp_loss(p, b[0], b[1]), opt, params, opt_state, batches
-        )
-        loss = float(loss)
-        elapsed = time.time() - start
-        t_train_done = time.time()
-    # Eval OUTSIDE the mesh: params are fully replicated, so each process
-    # holds them locally and the identical eval set needs no
-    # replicate_global consensus or in-mesh collectives at all.
-    host_params = jax.device_get(params)
-    acc = float(jax.jit(m.mlp_accuracy)(host_params, ex, ey))
-    t_eval = time.time()
+    local_bs = bs // dp
+    # Dataset = train_size samples revisited epoch-by-epoch, regenerated
+    # identically on every shard in-program (see synthetic_mnist_traced);
+    # each shard slices its columns of every batch.
+    spe = max(1, args.train_size // bs)  # steps per epoch
+    eval_local = max(1, args.eval_size // dp)
+    means = jnp.asarray(d.mnist_teacher_means())
+
+    def local_batches(i):
+        x, y = d.synthetic_mnist_traced(1, spe * bs, means)
+        x = x.reshape(spe, bs, m.IMAGE_PIXELS)
+        y = y.reshape(spe, bs)
+        return (jax.lax.dynamic_slice_in_dim(x, i * local_bs, local_bs, axis=1),
+                jax.lax.dynamic_slice_in_dim(y, i * local_bs, local_bs, axis=1))
+
+    def eval_counts(p, i):
+        ex, ey = d.synthetic_mnist_traced(2, dp * eval_local, means)
+        ex = jax.lax.dynamic_slice_in_dim(ex, i * eval_local, eval_local, axis=0)
+        ey = jax.lax.dynamic_slice_in_dim(ey, i * eval_local, eval_local, axis=0)
+        correct = jnp.sum(jnp.argmax(m.mlp_apply(p, ex), axis=-1) == ey)
+        return correct, jnp.asarray(eval_local, jnp.float32)
+
+    aot = ""
+    if args.aot_cache:
+        os.makedirs(args.aot_cache, exist_ok=True)
+        aot = os.path.join(
+            args.aot_cache,
+            f"mnist-dist-s{args.steps}-b{bs}-n{args.train_size}"
+            f"-e{args.eval_size}-dp{dp}-pc{pc}-p{proc}.aot")
+
+    t_init = time.time()
+    # The whole job — per-step batch generation, the 200-step scan with its
+    # single fused all-reduce, and the sharded eval — is ONE compiled
+    # program; `fit` below is one dispatch per worker.
+    params, opt_state, loss, acc = train_scan_dist(
+        lambda p, b: m.mlp_loss(p, b[0], b[1]), opt, params, opt_state,
+        args.steps, mesh, AXIS_DATA, local_batches, eval_counts,
+        aot_cache=aot,
+    )
+    loss, acc = float(loss), float(acc)
+    elapsed = time.time() - t_init
+    t_fit = time.time()
 
     print(f"Worker {proc}/{pc} on {jax.device_count()} devices "
           f"(mesh dp={dp})")
-    # Phase breakdown for the headline-bench profile (bench.py parses it):
-    # rendezvous = jax.distributed join, data = synthetic gen, batches =
-    # stack + global-array assembly (a cross-process consensus point),
-    # train = the scan (incl. compile-or-cache-load), eval = accuracy.
+    # Phase breakdown for the headline-bench profile (bench.py parses it).
+    # The phases partition total: rendezvous = jax.distributed join, init =
+    # host-side model/optimizer init + means, fit = the single compiled
+    # program (trace + cache-load + batch gen + train scan + eval).
     print(f"Phase times: rendezvous={t_rendezvous - t_start:.3f}s "
-          f"data={t_data - t_rendezvous:.3f}s "
-          f"batches={t_batches - start:.3f}s "
-          f"train={t_train_done - t_batches:.3f}s "
-          f"eval={t_eval - t_train_done:.3f}s "
+          f"init={t_init - t_rendezvous:.3f}s "
+          f"fit={t_fit - t_init:.3f}s "
           f"total={time.time() - t_start:.3f}s")
     print(f"Training elapsed time: {elapsed:f} s")
     print(f"Final loss: {loss:f}; eval accuracy: {acc:f}")
