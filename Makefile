@@ -4,12 +4,32 @@
 
 PY ?= python
 
-.PHONY: all test lint bench dryrun validate
+.PHONY: all test lint typecheck cov bench dryrun validate
 
 all: lint test
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# Coverage-gated test run (the goveralls analog, ref: .travis.yml:12-14).
+# Requires pytest-cov (CI installs it; locally falls back to plain tests).
+cov:
+	@if $(PY) -c "import pytest_cov" >/dev/null 2>&1; then \
+		$(PY) -m pytest tests/ -q --cov=kubeflow_controller_tpu \
+			--cov-report=term-missing:skip-covered --cov-fail-under=60; \
+	else \
+		echo "pytest-cov not installed; running plain tests"; \
+		$(PY) -m pytest tests/ -q; \
+	fi
+
+# Static type pass (the gometalinter-breadth analog, ref: config.json:4-16).
+# Requires mypy (CI installs it; locally a no-op with a notice).
+typecheck:
+	@if $(PY) -m mypy --version >/dev/null 2>&1; then \
+		$(PY) -m mypy kubeflow_controller_tpu; \
+	else \
+		echo "mypy not installed; skipping typecheck"; \
+	fi
 
 lint:
 	@if $(PY) -m ruff --version >/dev/null 2>&1; then \

@@ -28,3 +28,13 @@ def run_bench_subprocess(script_path: str, args_list) -> dict:
         if line.startswith("{"):
             return json.loads(line)
     return {"error": (out.stderr or "no output")[-400:].strip()}
+
+
+def save_artifact(path: str, obj) -> None:
+    """Atomic incremental artifact write (tmp + rename): sweeps call this
+    after EVERY row so a killed run keeps its finished rows, and a reader
+    never sees a half-written JSON."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(obj, fh, indent=1)
+    os.replace(tmp, path)

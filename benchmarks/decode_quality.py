@@ -1,0 +1,119 @@
+"""int8-KV decode quality certification on the 953M bench model.
+
+The perf rows in decode_tpu_v5e.json measure kv_quant=true speed; this
+measures what quantization does to the MODEL'S OUTPUTS at the same scale
+(the round-3 gap: quality was certified only on the tiny test model).
+
+Method: teacher-forced A/B in ONE scan — both caches (bf16 and int8)
+decode the same gold continuation step by step, and each step compares
+full logits: max |delta| and greedy-argmax agreement.  Teacher forcing
+keeps the two paths on the same prefix for all N steps, so agreement is
+per-position (free-running greedy would compound one early divergence
+into an uninformative suffix mismatch).
+
+    python benchmarks/decode_quality.py --out benchmarks/decode_tpu_v5e.json
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def run(batch: int, prompt_len: int, steps: int, dim: int, layers: int,
+        heads: int, intermediate: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_controller_tpu.models import LlamaConfig, llama_init
+    from kubeflow_controller_tpu.models.generate import (
+        forward_with_cache,
+        init_cache,
+    )
+
+    cfg = LlamaConfig(
+        vocab_size=32000, dim=dim, n_layers=layers, n_heads=heads,
+        n_kv_heads=heads, intermediate=intermediate,
+        max_seq_len=prompt_len + steps,
+        dtype="bfloat16", param_dtype="bfloat16", remat=False,
+    )
+    S = prompt_len + steps
+    params = jax.jit(lambda k: llama_init(k, cfg))(jax.random.PRNGKey(0))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size)
+    gold = jax.random.randint(
+        jax.random.PRNGKey(2), (steps, batch), 0, cfg.vocab_size)
+
+    @jax.jit
+    def ab(params, prompt, gold):
+        cache_a = init_cache(cfg, batch, S, quantize=False)
+        cache_b = init_cache(cfg, batch, S, quantize=True)
+        la, cache_a = forward_with_cache(params, prompt, cache_a, 0, cfg)
+        lb, cache_b = forward_with_cache(params, prompt, cache_b, 0, cfg)
+
+        def step(carry, tok_pos):
+            cache_a, cache_b = carry
+            tok, pos = tok_pos
+            la, cache_a = forward_with_cache(
+                params, tok[:, None], cache_a, pos, cfg)
+            lb, cache_b = forward_with_cache(
+                params, tok[:, None], cache_b, pos, cfg)
+            la = la[:, -1].astype(jnp.float32)
+            lb = lb[:, -1].astype(jnp.float32)
+            delta = jnp.max(jnp.abs(la - lb))
+            agree = jnp.sum(jnp.argmax(la, -1) == jnp.argmax(lb, -1))
+            return (cache_a, cache_b), (delta, agree)
+
+        _, (deltas, agrees) = jax.lax.scan(
+            step, (cache_a, cache_b),
+            (gold, prompt_len + jnp.arange(steps)))
+        # Prefill logits compared too (the S=prompt_len state).
+        pre_delta = jnp.max(jnp.abs(
+            la[:, -1].astype(jnp.float32) - lb[:, -1].astype(jnp.float32)))
+        return (jnp.maximum(jnp.max(deltas), pre_delta),
+                jnp.sum(agrees), jnp.mean(deltas))
+
+    max_delta, agree, mean_delta = ab(params, prompt, gold)
+    n = steps * batch
+    return {
+        "quality_check": "int8 KV vs bf16 KV, teacher-forced A/B",
+        "batch": batch, "prompt_len": prompt_len,
+        "decode_steps": steps, "cache_len": S,
+        "positions_compared": n,
+        "argmax_agreement": round(float(agree) / n, 6),
+        "max_logit_delta": round(float(max_delta), 5),
+        "mean_max_logit_delta_per_step": round(float(mean_delta), 5),
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--prompt-len", type=int, default=1024)
+    p.add_argument("--steps", type=int, default=1024)
+    p.add_argument("--dim", type=int, default=2048)
+    p.add_argument("--layers", type=int, default=16)
+    p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--intermediate", type=int, default=5632)
+    p.add_argument("--out", default="")
+    args = p.parse_args()
+
+    row = run(args.batch, args.prompt_len, args.steps, args.dim,
+              args.layers, args.heads, args.intermediate)
+    print(json.dumps(row), flush=True)
+    if args.out:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from _common import save_artifact
+
+        try:
+            doc = json.load(open(args.out))
+        except (FileNotFoundError, json.JSONDecodeError):
+            doc = {"bench": "llama_decode_single_chip"}
+        doc["int8_kv_quality"] = row
+        save_artifact(args.out, doc)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    sys.exit(main())

@@ -93,6 +93,8 @@ def run(batch: int, seq: int, steps: int, dim: int, layers: int, heads: int,
         dt = (time.time() - t0) / steps
 
     tflops = 6 * n_active * batch * seq / dt / 1e12
+    hw = hw_tflops_per_s(6 * n_active * batch * seq, batch, seq, layers,
+                         heads, dim // heads, policy, dt)
     return {
         "params_m": round(n_params / 1e6, 1),
         "active_params_m": round(n_active / 1e6, 1),
@@ -100,10 +102,31 @@ def run(batch: int, seq: int, steps: int, dim: int, layers: int, heads: int,
         "tokens_per_s": round(batch * seq / dt),
         "model_tflops": round(tflops, 1),
         "mfu_pct": round(100 * tflops / peak_tflops, 1),
+        "hw_tflops": round(hw, 1),
+        "hw_mfu_pct": round(100 * hw / peak_tflops, 1),
         "loss": round(loss_val, 3),
         "batch": batch, "seq": seq, "remat_policy": policy,
         "loss_chunks": loss_chunks, "experts": experts,
     }
+
+
+def hw_tflops_per_s(model_flops: float, batch: int, seq: int, layers: int,
+                    heads: int, head_dim: int, policy: str,
+                    dt: float) -> float:
+    """Hardware-FLOPs-inclusive throughput: 6ND model FLOPs plus the
+    attention FLOPs the chip actually executes, which 6ND ignores and
+    which dominate the 6ND-MFU slide at long T (docs/PERF.md).
+
+    Attention per layer, causal (~half the T^2 square): forward = 2
+    matmuls = 2*B*T^2*H*d FLOPs; backward ~2x forward (dQ/dK/dV); remat
+    policies that do not save attention outputs (everything except
+    gateup_attn and moe, which both save "attn_proj" —
+    models/llama.py:_maybe_remat) recompute the forward once more in the
+    backward.  Other recomputed ops are still NOT counted — this column
+    isolates the attention-FLOP accounting gap, not total executed work."""
+    attn_fwd = 2.0 * batch * seq * seq * heads * head_dim * layers
+    factor = 3.0 if policy in ("gateup_attn", "moe") else 4.0
+    return (model_flops + factor * attn_fwd) / dt / 1e12
 
 
 def run_subprocess(args_list) -> dict:

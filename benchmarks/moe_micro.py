@@ -10,28 +10,51 @@ import jax
 import jax.numpy as jnp
 
 
-def timeit(fn, *args, reps=160):
-    """Time `reps` executions inside ONE jitted lax.scan with a scalar
-    carry threaded into the input — per-call dispatch through the relayed
-    backend is a ~60-85 ms FIXED cost, so reps must be large enough to
-    amortize it below the noise (docs/PERF.md measurement caveats)."""
-    x0 = args[0]
-
+def _scan_time(fn, x0, rest, reps):
     @jax.jit
     def scanned(x0, rest):
         def body(x, _):
             y = fn(x, *rest)
             leaves = jax.tree.leaves(y)
             s = sum(jnp.sum(l).astype(jnp.float32) for l in leaves)
-            return x + (s * 0).astype(x.dtype), None
+            # Thread the output into the next iteration through a term XLA
+            # cannot fold away: ``s * 0`` is constant-folded under
+            # --xla_allow_excess_precision (the whole body then hoists out
+            # of the loop and the op measures as ~free); ``s * 1e-30`` is
+            # a runtime value, while numerically x + ~1e-27 rounds to x,
+            # so the measured op is unperturbed but never loop-invariant.
+            return x + (s * 1e-30).astype(x.dtype), None
 
         out, _ = jax.lax.scan(body, x0, None, length=reps)
         return jnp.sum(out.astype(jnp.float32))
 
-    float(scanned(x0, args[1:]))  # compile + complete
-    t0 = time.time()
-    float(scanned(x0, args[1:]))
-    return (time.time() - t0) / reps * 1e3
+    float(scanned(x0, rest))  # compile + complete
+    best = float("inf")
+    for _ in range(2):  # best-of-2: relay hiccups are one-sided noise
+        t0 = time.time()
+        float(scanned(x0, rest))
+        best = min(best, time.time() - t0)
+    return best
+
+
+def timeit(fn, *args, reps=160):
+    """Per-iteration time of ``fn`` with the FIXED cost removed by
+    two-point extrapolation: run the scan at ``reps`` and ``4*reps`` and
+    return ``(T(4N) - T(N)) / (3N)``.
+
+    A single scanned run still carries the relayed backend's ~60-85 ms
+    per-CALL overhead, which at N=160 is a ~0.5 ms/iter phantom floor —
+    large enough to dominate sub-millisecond ops and the reason round 3's
+    micro-decomposition overstated the gather and tgmm costs (docs/PERF.md
+    measurement caveats).  Differencing two runs cancels every
+    rep-independent cost (dispatch, relay round-trip, output transfer)
+    exactly; the 4x spread keeps the signal well above the relay's
+    per-call jitter (a 2x spread measured 0.00 ms on a 2.6 ms op), and
+    each point is best-of-2 because that jitter is one-sided."""
+    x0 = args[0]
+    t1 = _scan_time(fn, x0, args[1:], reps)
+    t2 = _scan_time(fn, x0, args[1:], 4 * reps)
+    return max(t2 - t1, 1e-9) / (3 * reps) * 1e3
 
 
 def main():
@@ -85,11 +108,11 @@ def main():
     x_pad = jax.block_until_ready(gather(x, inv_src))
     print(f"gather [{M}x{D}]: {timeit(gather, x, inv_src):.2f} ms")
 
-    f = jax.jit(lambda l, r: gmm(l, r, te, bm, a.bn, a.bk))
+    f = jax.jit(lambda l, r: gmm(l, r, te, None, bm, a.bn, a.bk))
     print(f"gmm up [{M}x{D}]@[{E}x{D}x{F}] bm={bm} bn={a.bn} bk={a.bk}: "
           f"{timeit(f, x_pad, wg):.2f} ms")
     h = jax.block_until_ready(f(x_pad, wg))
-    fd = jax.jit(lambda l, r: gmm(l, r, te, bm, a.bn, a.bk))
+    fd = jax.jit(lambda l, r: gmm(l, r, te, None, bm, a.bn, a.bk))
     print(f"gmm down [{M}x{F}]@[{E}x{F}x{D}]: {timeit(fd, h, wd):.2f} ms")
 
     flops = 2 * M * D * F
@@ -112,9 +135,9 @@ def main():
                      jax.random.normal(key, (D, 2 * F), jnp.bfloat16),
                      jax.random.normal(key, (2 * F, D), jnp.bfloat16))
 
-    def moe_f(x, mode):
+    def moe_f(x, mode, cf=1.25):
         return moe_ffn_stats(x, rw, wg, wu, wdn, top_k=a.topk,
-                             dispatch=mode)[0]
+                             capacity_factor=cf, dispatch=mode)[0]
 
     def dense_f(x):
         return jnp.einsum(
@@ -122,8 +145,13 @@ def main():
             jax.nn.silu(jnp.einsum("btd,df->btf", x, wg2))
             * jnp.einsum("btd,df->btf", x, wu2), wd2)
 
-    for name, fn in [("grouped", lambda x: moe_f(x, "grouped")),
-                     ("einsum", lambda x: moe_f(x, "einsum")),
+    # The grouped path is dropless and capacity-free; the einsum path's
+    # cost scales with capacity_factor (E*C = T*k*cf slots of dispatch AND
+    # expert compute) — sweep cf to locate the crossover.
+    for name, fn in [("grouped (dropless)", lambda x: moe_f(x, "grouped")),
+                     ("einsum cf=1.0", lambda x: moe_f(x, "einsum", 1.0)),
+                     ("einsum cf=1.25", lambda x: moe_f(x, "einsum", 1.25)),
+                     ("einsum cf=2.0", lambda x: moe_f(x, "einsum", 2.0)),
                      ("dense-iso", dense_f)]:
         fwd = timeit(fn, x3, reps=80)
         grad = timeit(

@@ -323,7 +323,15 @@ def generate(
     """prompt [B, T_p] -> [B, T_p + max_new_tokens].  Greedy when
     temperature == 0.  The decode loop is one jitted scan.  Under an active
     mesh (jax.set_mesh) with params sharded by llama_param_pspecs this runs
-    tp/dp-sharded decode; see the module docstring."""
+    tp/dp-sharded decode; see the module docstring.
+
+    ``kv_quant`` (int8 cache rows, per-row f32 scales) trades output
+    fidelity for ~7% speed and half the cache memory: certified on the
+    953M bench model at S=2048 as max logit delta 0.163 with 93.5%
+    greedy-argmax agreement vs the bf16 cache over 8192 teacher-forced
+    positions (random weights = near-zero top-2 margins, the flip-prone
+    worst case; benchmarks/decode_quality.py).  Validate against your
+    model's logit margins before enabling."""
     if max_new_tokens <= 0:
         return prompt
     if key is None:

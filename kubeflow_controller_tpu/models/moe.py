@@ -124,15 +124,20 @@ def moe_ffn_stats(
       group-aligned layout (sort-free: one-hot cumsum ranks) and run
       through grouped-matmul Pallas kernels (ops/grouped_matmul.py).
       DROPLESS: capacity does not apply (overflow_frac == 0); matches
-      :func:`moe_ffn_reference`.  Measured 13% slower than "einsum" at
-      the E8/top2 bench config (docs/PERF.md has the full decomposition
-      — the dW kernel and XLA's slow row-gathers, not the dispatch
-      design); prefer it when drops are unacceptable or E·C >> T·k.
-      Falls back to "einsum" (one warning) when it cannot run: under an
-      active mesh (the sharded path needs the einsum formulation's
-      constraints), or at shapes below the TPU tiling grain (D/F not
-      multiples of 128, or B*T*k not a multiple of the dtype's sublane
-      tile — 8 for f32, 16 for bf16/f16).
+      :func:`moe_ffn_reference`.  Under an active mesh it runs the
+      standard dropless-EP decomposition via a full-manual shard_map
+      (each ep shard groups its experts' slots locally; see
+      :func:`_grouped_ffn_sharded`).  Slower than "einsum" at the
+      E8/top2/cf=1.25 bench config (the einsum dispatch FLOPs are cheap
+      at E·C ~= T·k and run at full MXU efficiency — docs/PERF.md has
+      the honest decomposition); prefer grouped when drops are
+      unacceptable or capacity_factor would need to be large.
+      Falls back to "einsum" (one warning) when it cannot run: under
+      pipeline parallelism (the gpipe schedule is auto-SPMD and cannot
+      nest the manual Pallas region), or at shapes below the TPU tiling
+      grain (D / local-F not multiples of 128, local B*T*k not a
+      multiple of the dtype's sublane tile — 8 for f32, 16 for
+      bf16/f16 — or mesh-indivisible B/T/F/E).
     """
     import math
 
@@ -145,21 +150,83 @@ def moe_ffn_stats(
     probs, idx = router_topk(logits, top_k)           # [B,T,k]
 
     grouped = dispatch == "grouped"
+    grouped_mesh = None
     if grouped:
+        from ..parallel.mesh import (
+            AXIS_DATA,
+            AXIS_EXPERT,
+            AXIS_FSDP,
+            AXIS_PIPELINE,
+            AXIS_SEQUENCE,
+            AXIS_TENSOR,
+        )
         from ..parallel.sharding import _mesh_parallel_in_scope
 
         F = w_gate.shape[-1]
         why = ""
-        if _mesh_parallel_in_scope():
-            why = "an active mesh (single-shard only)"
-        elif D % 128 or F % 128:
-            why = f"dims not multiples of 128 (D={D}, F={F})"
-        elif (B * T * top_k) % (8 if dtype == jnp.float32 else 16):
+        mesh = jax.sharding.get_abstract_mesh()
+        parallel = _mesh_parallel_in_scope()
+        in_mesh = parallel and mesh is not None and mesh.axis_names
+        if parallel and not in_mesh:
+            # Legacy `with mesh:` contexts activate parallelism without an
+            # abstract mesh to shard_map over — tracing the single-shard
+            # Pallas call under auto-SPMD there would force replication,
+            # so keep the pre-round-4 fallback for that path.
+            why = ("an active legacy mesh context (use jax.set_mesh for "
+                   "the sharded grouped path)")
+        # Per-shard shapes the kernels would see under the mesh; the
+        # divisibility grain applies to the LOCAL slot count and F slice.
+        if why:
+            n_loc, f_loc = B * T * top_k, F
+        elif in_mesh:
+            shp = dict(mesh.shape)
+            if shp.get(AXIS_PIPELINE, 1) > 1:
+                # The gpipe schedule is auto-SPMD vmap over the stage axis;
+                # the full-manual Pallas region cannot nest inside it.
+                why = ("pipeline parallelism (pp > 1): the grouped kernels "
+                       "need a manual region, einsum is the pp formulation")
+            elif E % shp.get(AXIS_EXPERT, 1):
+                why = f"E={E} not divisible by ep={shp.get(AXIS_EXPERT, 1)}"
+            b_shard = shp.get(AXIS_DATA, 1) * shp.get(AXIS_FSDP, 1)
+            t_shard = shp.get(AXIS_SEQUENCE, 1)
+            tp = shp.get(AXIS_TENSOR, 1)
+            if not why and (B % b_shard or T % t_shard or F % tp):
+                why = (f"shapes not divisible by the mesh (B={B}/{b_shard}, "
+                       f"T={T}/{t_shard}, F={F}/{tp})")
+            n_loc = (B // max(1, b_shard)) * (T // max(1, t_shard)) * top_k
+            f_loc = F // max(1, tp)
+        else:
+            n_loc, f_loc = B * T * top_k, F
+        grain = 8 if dtype == jnp.float32 else 16
+        if why:
+            pass
+        elif D % 128 or f_loc % 128:
+            why = f"dims not multiples of 128 (D={D}, local F={f_loc})"
+        elif n_loc % grain:
             # Mosaic's sublane tile is 8 rows for f32 but 16 for bf16/f16;
             # the divisor must keep block_m at or above the dtype's tile.
-            grain = 8 if dtype == jnp.float32 else 16
-            why = (f"B*T*k = {B * T * top_k} not a multiple of {grain} "
+            why = (f"local B*T*k = {n_loc} not a multiple of {grain} "
                    f"(sublane tile for {dtype})")
+        if not why and in_mesh:
+            # The sharded path's compute-skip exists only on the single-k
+            # kernel; if the fused working set cannot fit VMEM at these
+            # dims (K ~> 11k at bm=256), fall back instead of tripping the
+            # gmm-level assert at trace time.
+            from ..ops.grouped_matmul import _single_k_blocks
+
+            e_l = max(1, E // max(1, dict(mesh.shape).get(AXIS_EXPERT, 1)))
+            bm_chk = 256
+            while n_loc % bm_chk:
+                bm_chk //= 2
+            m_worst = n_loc + (e_l + 1) * bm_chk
+            nbytes = jnp.dtype(dtype).itemsize
+            if (_single_k_blocks(m_worst, D, f_loc, bm_chk, 1408,
+                                 nbytes) is None
+                    or _single_k_blocks(m_worst, f_loc, D, bm_chk, 1408,
+                                        nbytes) is None):
+                why = (f"single-k kernel working set exceeds VMEM at D={D},"
+                       f" local F={f_loc} (the sharded compute-skip "
+                       "requires the single-k path)")
         if why:
             import warnings
 
@@ -167,6 +234,8 @@ def moe_ffn_stats(
                 f"moe dispatch='grouped' cannot run under {why}; falling "
                 "back to 'einsum'", stacklevel=2)
             grouped, dispatch = False, "einsum"
+        elif in_mesh:
+            grouped_mesh = mesh
 
     # One-hot expert assignment per routing slot: [B, T, k, E].
     assign = jax.nn.one_hot(idx, E, dtype=jnp.float32)
@@ -192,7 +261,11 @@ def moe_ffn_stats(
                   "ffn_down")
         return with_logical_constraint(ye, ("batch", "expert", None, None), rules)
 
-    if grouped:
+    if grouped and grouped_mesh is not None:
+        y = _grouped_ffn_sharded(x, probs, idx, w_gate.astype(dtype),
+                                 w_up.astype(dtype), w_down.astype(dtype),
+                                 grouped_mesh, rules, save_names=save_names)
+    elif grouped:
         y = _grouped_ffn(x, probs, idx, w_gate.astype(dtype),
                          w_up.astype(dtype), w_down.astype(dtype),
                          save_names=save_names)
@@ -260,6 +333,109 @@ def moe_ffn_stats(
     stats = {"aux_loss": aux_loss, "z_loss": z_loss,
              "overflow_frac": overflow_frac}
     return y, stats
+
+
+def _grouped_ffn_sharded(x, probs, idx, w_gate, w_up, w_down, mesh,
+                         rules: ShardingRules = DEFAULT_RULES,
+                         block_m: int = 256, save_names: bool = False):
+    """Dropless grouped dispatch under an active mesh.
+
+    The standard dropless-EP decomposition, adapted to this repo's mesh
+    layout: tokens are sharded over (dp, fsdp, sp) and REPLICATED over ep,
+    so no all-to-all token exchange is needed — each ep shard takes the
+    slots routed to ITS experts from its local tokens, groups them into a
+    local layout, and runs the grouped kernels on its expert slice.  The
+    per-shard layout is sized for the worst case (every local slot on one
+    shard: dropless means no slot may be dropped even under total routing
+    collapse), and the ``valid_tiles`` compute-skip in ops/grouped_matmul
+    keeps the forward and dx-backward cost proportional to the ACTUAL
+    local slots — under balanced routing each shard computes ~1/ep of
+    that work.  Known cost: the dW backward (tgmm) has no skip yet and
+    streams the worst-case rows (their operands are zeros, so it is
+    correct but pays ~ep x the necessary dW MXU time; a valid_tiles-aware
+    tgmm is the open follow-up).  The down-projection
+    contracts the tp-sharded F dim, so one psum over (ep, tp) at the end
+    assembles the output; non-local slots read zero-filled skipped tiles
+    and contribute nothing.
+
+    Runs full-manual (jax.shard_map over every mesh axis): Pallas kernels
+    cannot be auto-partitioned by XLA's SPMD pass.  This is also why the
+    pp>1 pipeline keeps the einsum dispatch: the gpipe schedule is an
+    auto-SPMD vmap over the stage axis, and a manual region cannot nest
+    inside it (moe_ffn_stats falls back with a warning there).
+    """
+    from jax.sharding import PartitionSpec
+    from ..parallel.mesh import AXIS_EXPERT, AXIS_TENSOR
+    from ..parallel.sharding import logical_to_pspec
+    from ..ops.grouped_matmul import gmm
+
+    E = w_gate.shape[0]
+    ep = mesh.shape.get(AXIS_EXPERT, 1)
+    E_l = E // ep
+    bm = block_m
+    psum_axes = tuple(a for a in (AXIS_EXPERT, AXIS_TENSOR)
+                      if a in mesh.axis_names)
+
+    def body(x, probs, idx, wg, wu, wd):
+        B, T, D = x.shape
+        k = idx.shape[-1]
+        n_tok = B * T
+        n_slots = n_tok * k
+        bm_l = bm
+        while n_slots % bm_l:
+            bm_l //= 2
+        e0 = jax.lax.axis_index(AXIS_EXPERT) * E_l
+        slot_g = idx.reshape(n_slots)
+        local = jnp.logical_and(slot_g >= e0, slot_g < e0 + E_l)
+        # Non-local slots land in a sentinel group AFTER the real groups;
+        # its tiles are compute-skipped and zero-filled.
+        slot_e = jnp.where(local, slot_g - e0, E_l)
+        onehot = jax.nn.one_hot(slot_e, E_l + 1, dtype=jnp.int32)
+        pos = jnp.cumsum(onehot, axis=0) - onehot
+        rank = jnp.take_along_axis(pos, slot_e[:, None], axis=1)[:, 0]
+        counts = jnp.sum(onehot, axis=0)
+        padded = ((counts + bm_l - 1) // bm_l) * bm_l
+        pad_off = jnp.cumsum(padded) - padded
+        M = n_slots + (E_l + 1) * bm_l
+        dest = (jnp.take(pad_off, slot_e) + rank).astype(jnp.int32)
+        ends = pad_off + padded
+        te = jnp.searchsorted(
+            ends, jnp.arange(M // bm_l) * bm_l, side="right").astype(jnp.int32)
+        te = jnp.minimum(te, E_l - 1)
+        # First tile of the sentinel group = count of REAL tiles.
+        valid_tiles = (jnp.take(ends, E_l - 1) // bm_l).astype(jnp.int32)[None]
+
+        h_flat = x.reshape(n_tok, D)
+        token_of_slot = (jnp.arange(n_slots, dtype=jnp.int32) // k)
+        inv_src = jnp.full((M,), n_tok, jnp.int32).at[dest].set(
+            jnp.where(local, token_of_slot, n_tok))
+        inv_pos = jnp.full((M,), n_slots, jnp.int32).at[dest].set(
+            jnp.arange(n_slots, dtype=jnp.int32))
+
+        name = ckpt_marker(save_names)
+        x_pad = name(_dispatch_rows(h_flat, inv_src,
+                                    dest.reshape(n_tok, k)), "moe_x")
+        # Separate gate/up gmms (not gmm_swiglu): the compute-skip is what
+        # makes the worst-case layout affordable, and only gmm carries it.
+        gate = name(gmm(x_pad, wg, te, valid_tiles, bm_l), "ffn_gate")
+        up = name(gmm(x_pad, wu, te, valid_tiles, bm_l), "ffn_up")
+        hh = jax.nn.silu(gate) * up
+        y_pad = name(gmm(hh, wd, te, valid_tiles, bm_l), "ffn_down")
+        y_slot = _combine_rows(y_pad, dest, inv_pos)          # [n_slots, D]
+        y = jnp.einsum("btk,btkd->btd", probs.astype(x.dtype),
+                       y_slot.reshape(B, T, k, D))
+        if psum_axes:
+            y = jax.lax.psum(y, psum_axes)
+        return y
+
+    act_spec = logical_to_pspec(("batch", "seq", None), rules)
+    wg_spec = PartitionSpec(AXIS_EXPERT, None, AXIS_TENSOR)
+    wd_spec = PartitionSpec(AXIS_EXPERT, AXIS_TENSOR, None)
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(act_spec, act_spec, act_spec, wg_spec, wg_spec, wd_spec),
+        out_specs=act_spec, check_vma=False,
+    )(x, probs.astype(x.dtype), idx, w_gate, w_up, w_down)
 
 
 def _grouped_ffn(x, probs, idx, w_gate, w_up, w_down, block_m: int = 256,
@@ -332,13 +508,17 @@ def _grouped_ffn(x, probs, idx, w_gate, w_up, w_down, block_m: int = 256,
     inv_pos = jnp.full((M,), n_slots, jnp.int32).at[dest].set(
         jnp.arange(n_slots, dtype=jnp.int32))
 
+    from ..ops.grouped_matmul import gmm_swiglu
+
     checkpoint_name = ckpt_marker(save_names)
     x_pad = checkpoint_name(
         _dispatch_rows(h_flat, inv_src, slot_dest.reshape(n_tok, k)), "moe_x")
-    gate = checkpoint_name(gmm(x_pad, w_gate, tile_experts, bm), "ffn_gate")
-    up = checkpoint_name(gmm(x_pad, w_up, tile_experts, bm), "ffn_up")
-    hh = jax.nn.silu(gate) * up
-    y_pad = checkpoint_name(gmm(hh, w_down, tile_experts, bm), "ffn_down")
+    # Fused gate+up+SwiGLU: one kernel reads x_pad once for both matmuls
+    # and applies silu(gate)*up in-register — the separate XLA elementwise
+    # pass over two [M, F] intermediates is gone.
+    hh = checkpoint_name(gmm_swiglu(x_pad, w_gate, w_up, tile_experts, bm),
+                         "ffn_up")
+    y_pad = checkpoint_name(gmm(hh, w_down, tile_experts, None, bm), "ffn_down")
     y_slot = _combine_rows(y_pad, slot_dest, inv_pos)     # [N, D]
     return jnp.einsum("btk,btkd->btd", probs.astype(x.dtype),
                       y_slot.reshape(B, T, k, D))

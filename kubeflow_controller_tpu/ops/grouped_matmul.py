@@ -73,11 +73,16 @@ def _gmm_kernel(te_ref, lhs_ref, rhs_ref, out_ref, acc_ref):
         out_ref[...] = acc_ref[...].astype(out_ref.dtype)
 
 
-def _gmm_fwd_impl(lhs, rhs, tile_experts, bm, bn, bk):
+def _gmm_fwd_impl(lhs, rhs, tile_experts, bm, bn, bk, valid_tiles=None):
     M, K = lhs.shape
     E, K2, N = rhs.shape
     assert K == K2, (K, K2)
     assert M % bm == 0 and tile_experts.shape == (M // bm,)
+    bn_single = _single_k_blocks(M, K, N, bm, bn, lhs.dtype.itemsize)
+    if bn_single is not None:
+        return _gmm_single_k(lhs, rhs, tile_experts, bm, bn_single,
+                             valid_tiles)
+    assert valid_tiles is None, "compute-skip requires the single-k path"
     bn = _pick_block(N, bn)
     bk = _pick_block(K, bk)
     grid = (M // bm, N // bn, K // bk)
@@ -99,6 +104,214 @@ def _gmm_fwd_impl(lhs, rhs, tile_experts, bm, bn, bk):
         ),
         interpret=_interpret(),
     )(tile_experts, lhs, rhs)
+
+
+# A dispatch-gather-fused gmm (per-row DMA from token positions) was built
+# and rejected in round 4: Mosaic requires HBM slices sublane-aligned
+# ("Slice shape along dimension 0 must be aligned to tiling (8)"), so
+# single-row DMAs from a [n_tok, K] operand do not compile on real TPUs —
+# and honest re-measurement showed the XLA row gather runs at ~270 GB/s
+# (0.13 ms at [17408, 1024] bf16), not the 50 GB/s round 3 reported from a
+# harness whose fixed relay cost inflated sub-ms ops (docs/PERF.md).
+
+
+def _gmm_single_k_kernel(te_ref, lhs_ref, rhs_ref, out_ref):
+    out_ref[...] = jnp.dot(lhs_ref[...], rhs_ref[0],
+                           preferred_element_type=jnp.float32
+                           ).astype(out_ref.dtype)
+
+
+def _gmm_single_k_skip_kernel(te_ref, nt_ref, lhs_ref, rhs_ref, out_ref, *,
+                              bm):
+    """Single-k kernel with a compute skip: tiles at or past nt_ref[0] write
+    zeros without touching the MXU — how a per-shard dropless layout sized
+    for the worst case (every slot local) stays cheap when routing is
+    balanced (the usual case)."""
+    i = pl.program_id(1)
+
+    @pl.when(i < nt_ref[0])
+    def _():
+        out_ref[...] = jnp.dot(lhs_ref[...], rhs_ref[0],
+                               preferred_element_type=jnp.float32
+                               ).astype(out_ref.dtype)
+
+    @pl.when(i >= nt_ref[0])
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+
+def _gmm_single_k(lhs, rhs, tile_experts, bm, bn, valid_tiles=None):
+    """Grid (j, i) with the row-tile dim INNERMOST: consecutive tiles of
+    one expert hit the same rhs block index, so the weight block stays
+    cached across the expert's whole run instead of being re-fetched per
+    tile — measured up to +22% over the (i, j, k) order (down-proj shape:
+    169 vs 138 TFLOP/s).  Only legal when K fits one block (no k loop, so
+    no accumulator carry between visits of the same out block)."""
+    M, K = lhs.shape
+    E, _, N = rhs.shape
+    grid = (N // bn, M // bm)
+    if valid_tiles is None:
+        return pl.pallas_call(
+            _gmm_single_k_kernel,
+            out_shape=jax.ShapeDtypeStruct((M, N), lhs.dtype),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=grid,
+                in_specs=[
+                    pl.BlockSpec((bm, K), lambda j, i, te: (i, 0)),
+                    pl.BlockSpec((1, K, bn), lambda j, i, te: (te[i], 0, j)),
+                ],
+                out_specs=pl.BlockSpec((bm, bn), lambda j, i, te: (i, j)),
+            ),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary", "arbitrary"),
+            ),
+            interpret=_interpret(),
+        )(tile_experts, lhs, rhs)
+    return pl.pallas_call(
+        functools.partial(_gmm_single_k_skip_kernel, bm=bm),
+        out_shape=jax.ShapeDtypeStruct((M, N), lhs.dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((bm, K), lambda j, i, te, nt: (i, 0)),
+                pl.BlockSpec((1, K, bn), lambda j, i, te, nt: (te[i], 0, j)),
+            ],
+            out_specs=pl.BlockSpec((bm, bn), lambda j, i, te, nt: (i, j)),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(tile_experts, valid_tiles, lhs, rhs)
+
+
+def _single_k_blocks(M, K, N, bm, bn, dtype_bytes=2):
+    """Pick a (usable, bn) pair for the single-k path: K must fit one
+    block, and the working set — lhs block, double-buffered rhs block,
+    double-buffered out block — must stay inside a conservative VMEM
+    budget (the bm=512/bn=1024 down-proj shape overflowed on v5e)."""
+    if M % bm:
+        return None
+    budget = 12 * 1024 * 1024
+    bn_pick = _pick_block(N, bn)
+    while bn_pick >= 128:
+        vmem = (bm * K + 2 * K * bn_pick + 2 * bm * bn_pick) * dtype_bytes
+        if vmem <= budget and N % bn_pick == 0:
+            return bn_pick
+        bn_pick -= 128
+    return None
+
+
+# ---------------------------------------------------------------------------
+# gmm2: fused gate+up+SwiGLU — h = silu(lhs@Wg[e]) * (lhs@Wu[e])
+# ---------------------------------------------------------------------------
+
+def _gmm2_kernel(te_ref, lhs_ref, rhsg_ref, rhsu_ref, h_ref, gate_ref, up_ref):
+    gate = jnp.dot(lhs_ref[...], rhsg_ref[0], preferred_element_type=jnp.float32)
+    up = jnp.dot(lhs_ref[...], rhsu_ref[0], preferred_element_type=jnp.float32)
+    h_ref[...] = (jax.nn.silu(gate) * up).astype(h_ref.dtype)
+    gate_ref[...] = gate.astype(gate_ref.dtype)
+    up_ref[...] = up.astype(up_ref.dtype)
+
+
+def _gmm2_impl(lhs, rhs_g, rhs_u, tile_experts, bm, bn):
+    """Returns (h, gate, up): the SwiGLU applied in-kernel, so the [M, N]
+    gate/up intermediates never make an extra XLA elementwise round-trip
+    (read gate + read up + write h is ~0.4 ms at bench shapes), and lhs is
+    read once for both matmuls.  gate/up are still written out — the
+    backward needs them (silu'), and writing from the kernel is the same
+    traffic the separate-gmm path paid anyway."""
+    M, K = lhs.shape
+    E, _, N = rhs_g.shape
+    assert rhs_u.shape == rhs_g.shape
+    grid = (N // bn, M // bm)
+    return pl.pallas_call(
+        _gmm2_kernel,
+        out_shape=(jax.ShapeDtypeStruct((M, N), lhs.dtype),
+                   jax.ShapeDtypeStruct((M, N), lhs.dtype),
+                   jax.ShapeDtypeStruct((M, N), lhs.dtype)),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((bm, K), lambda j, i, te: (i, 0)),
+                pl.BlockSpec((1, K, bn), lambda j, i, te: (te[i], 0, j)),
+                pl.BlockSpec((1, K, bn), lambda j, i, te: (te[i], 0, j)),
+            ],
+            out_specs=(pl.BlockSpec((bm, bn), lambda j, i, te: (i, j)),
+                       pl.BlockSpec((bm, bn), lambda j, i, te: (i, j)),
+                       pl.BlockSpec((bm, bn), lambda j, i, te: (i, j))),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(tile_experts, lhs, rhs_g, rhs_u)
+
+
+def _gmm2_blocks(M, K, N, bm, bn, dtype_bytes=2):
+    """VMEM-feasible bn for gmm2: lhs block + 2x double-buffered rhs
+    blocks + 3 double-buffered out blocks."""
+    if M % bm:
+        return None
+    budget = 12 * 1024 * 1024
+    bn_pick = _pick_block(N, bn)
+    while bn_pick >= 128:
+        vmem = (bm * K + 4 * K * bn_pick + 6 * bm * bn_pick) * dtype_bytes
+        if vmem <= budget and N % bn_pick == 0:
+            return bn_pick
+        bn_pick -= 128
+    return None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def gmm_swiglu(lhs, rhs_g, rhs_u, tile_experts, bm: int = 256,
+               bn: int = 1408):
+    """Fused grouped SwiGLU: ``silu(lhs @ rhs_g[e]) * (lhs @ rhs_u[e])``
+    per row tile.  Falls back to two gmm calls + XLA elementwise when the
+    fused working set does not fit VMEM."""
+    h, _ = _gmm_swiglu_fwd(lhs, rhs_g, rhs_u, tile_experts, bm, bn)
+    return h
+
+
+def _gmm_swiglu_fwd(lhs, rhs_g, rhs_u, tile_experts, bm, bn):
+    M, K = lhs.shape
+    N = rhs_g.shape[-1]
+    bn_pick = _gmm2_blocks(M, K, N, bm, bn, lhs.dtype.itemsize)
+    if bn_pick is None:
+        gate = _gmm_fwd_impl(lhs, rhs_g, tile_experts, bm, bn, bn)
+        up = _gmm_fwd_impl(lhs, rhs_u, tile_experts, bm, bn, bn)
+        h = (jax.nn.silu(gate.astype(jnp.float32)) *
+             up.astype(jnp.float32)).astype(lhs.dtype)
+    else:
+        h, gate, up = _gmm2_impl(lhs, rhs_g, rhs_u, tile_experts, bm, bn_pick)
+    return h, (lhs, rhs_g, rhs_u, tile_experts, gate, up)
+
+
+def _gmm_swiglu_bwd(bm, bn, res, dh):
+    lhs, rhs_g, rhs_u, tile_experts, gate, up = res
+    gate32 = gate.astype(jnp.float32)
+    up32 = up.astype(jnp.float32)
+    dh32 = dh.astype(jnp.float32)
+    sig = jax.nn.sigmoid(gate32)
+    silu = gate32 * sig
+    dgate = (dh32 * up32 * (sig + silu * (1 - sig))).astype(dh.dtype)
+    dup = (dh32 * silu).astype(dh.dtype)
+    dlhs = (_gmm_fwd_impl(dgate, rhs_g.transpose(0, 2, 1), tile_experts,
+                          bm, bn, bn)
+            + _gmm_fwd_impl(dup, rhs_u.transpose(0, 2, 1), tile_experts,
+                            bm, bn, bn)).astype(lhs.dtype)
+    drhs_g = _tgmm_impl(lhs, dgate, tile_experts, rhs_g.shape[0],
+                        bm, bn, bn).astype(rhs_g.dtype)
+    drhs_u = _tgmm_impl(lhs, dup, tile_experts, rhs_u.shape[0],
+                        bm, bn, bn).astype(rhs_u.dtype)
+    zeros_int = np.zeros(tile_experts.shape, dtype=jax.dtypes.float0)
+    return dlhs, drhs_g, drhs_u, zeros_int
+
+
+gmm_swiglu.defvjp(_gmm_swiglu_fwd, _gmm_swiglu_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -174,33 +387,46 @@ def _tgmm_impl(lhs, dout, tile_experts, n_experts, bm, bkk, bn):
 # Differentiable gmm
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def gmm(lhs, rhs, tile_experts, bm: int = 256, bn: int = 1408, bk: int = 1408):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def gmm(lhs, rhs, tile_experts, valid_tiles=None,
+        bm: int = 256, bn: int = 1408, bk: int = 1408):
     """Grouped matmul: row tile i of ``lhs`` is multiplied by
     ``rhs[tile_experts[i]]``.
 
     lhs [M, K] (M % bm == 0), rhs [E, K, N], tile_experts [M//bm] int32 in
     [0, E).  Rows must be grouped so each bm-row tile belongs to one
     expert (models/moe.py builds this layout).  Differentiable in lhs and
-    rhs; tile_experts is index data.
+    rhs; tile_experts is index data.  ``valid_tiles`` ([1] int32, optional)
+    caps the computed row tiles: tiles at or past it write zeros without
+    MXU work — for worst-case-sized per-shard dropless layouts (the
+    ep-sharded path) where most tiles are empty under balanced routing.
     """
-    return _gmm_fwd_impl(lhs, rhs, tile_experts, bm, bn, bk)
+    return _gmm_fwd_impl(lhs, rhs, tile_experts, bm, bn, bk, valid_tiles)
 
 
-def _gmm_fwd(lhs, rhs, tile_experts, bm, bn, bk):
-    return _gmm_fwd_impl(lhs, rhs, tile_experts, bm, bn, bk), (
-        lhs, rhs, tile_experts)
+def _gmm_fwd(lhs, rhs, tile_experts, valid_tiles, bm, bn, bk):
+    return _gmm_fwd_impl(lhs, rhs, tile_experts, bm, bn, bk, valid_tiles), (
+        lhs, rhs, tile_experts, valid_tiles)
 
 
 def _gmm_bwd(bm, bn, bk, res, dout):
-    lhs, rhs, tile_experts = res
+    lhs, rhs, tile_experts, valid_tiles = res
+    if valid_tiles is not None:
+        # Skipped tiles never touched the operands (their primal out is
+        # zero), so their cotangent must not leak into drhs — mask before
+        # the transpose matmul.  dlhs needs no mask: its own skip writes
+        # zeros for those tiles.
+        row_tile = jnp.arange(lhs.shape[0], dtype=jnp.int32) // bm
+        dout = jnp.where((row_tile < valid_tiles[0])[:, None], dout, 0)
     # dlhs: same grouped matmul against rhsᵀ (contract over N).
     dlhs = _gmm_fwd_impl(dout, rhs.transpose(0, 2, 1), tile_experts,
-                         bm, bn, bk)
+                         bm, bn, bk, valid_tiles)
     # drhs: per-expert lhsᵀ @ dout.
     drhs = _tgmm_impl(lhs, dout, tile_experts, rhs.shape[0], bm, bk, bn)
     zeros_int = np.zeros(tile_experts.shape, dtype=jax.dtypes.float0)
-    return dlhs.astype(lhs.dtype), drhs.astype(rhs.dtype), zeros_int
+    dvalid = (None if valid_tiles is None
+              else np.zeros(valid_tiles.shape, dtype=jax.dtypes.float0))
+    return dlhs.astype(lhs.dtype), drhs.astype(rhs.dtype), zeros_int, dvalid
 
 
 gmm.defvjp(_gmm_fwd, _gmm_bwd)

@@ -49,7 +49,10 @@ def _block_attend(q, k, v, m, l, o, *, q_start, kv_start, causal, scale):
 
 
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
-    """Body run per-device under shard_map; q/k/v are local shards."""
+    """Dense-inner body run per-device under shard_map; q/k/v are local
+    shards.  Materializes [B, H, t_local, t_local] f32 score blocks — fine
+    for short shards, OOM at t_local ~> 4k (the flash inner below is the
+    long-context path)."""
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
@@ -77,6 +80,160 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale: float
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # back to BTHD
 
 
+# ---------------------------------------------------------------------------
+# Flash inner: the long-context path.  Each rotating K/V block is folded
+# with the Pallas flash kernels (ops/attention.py) — the O(t_local^2)
+# score block never materializes — and per-block (out, lse) pairs merge by
+# running logsumexp.  The backward re-runs the ring with the blockwise
+# flash backward, accumulating dk/dv on accumulators that rotate WITH
+# their blocks (n rotations = full circle brings them home).
+# ---------------------------------------------------------------------------
+
+def _flash_block(qb, kb, vb, diag, scale, blocks, interpret):
+    """(out, lse) of q attending one K/V block.  ``diag`` True = the
+    causally-aligned diagonal block (triangular mask); False = a fully
+    visible past block."""
+    from ..ops.attention import _fwd
+
+    return _fwd(qb, kb, vb, causal=diag, scale=scale,
+                block_q=blocks[0], block_k=blocks[1], interpret=interpret)
+
+
+def _merge(o, lse, o_b, lse_b):
+    """Running logsumexp merge of normalized per-block outputs."""
+    lse_new = jnp.logaddexp(lse, lse_b)
+    w_old = jnp.exp(lse - lse_new)[:, :, :1]
+    w_new = jnp.exp(lse_b - lse_new)[:, :, :1]
+    return o * w_old + o_b.astype(jnp.float32) * w_new, lse_new
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash_bh(qb, kb, vb, axis_name, causal, scale, blocks, interpret):
+    out, _ = _ring_flash_fwd_impl(qb, kb, vb, axis_name, causal, scale,
+                                  blocks, interpret)
+    return out
+
+
+def _ring_flash_fwd_impl(qb, kb, vb, axis_name, causal, scale, blocks,
+                         interpret):
+    from ..ops.attention import LANES
+
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    bh, t, d = qb.shape
+    o = jnp.zeros((bh, t, d), jnp.float32)
+    lse = jnp.full((bh, t, LANES), NEG_INF, jnp.float32)
+    k_cur, v_cur = kb, vb
+    for s in range(n):
+        src = (idx - s) % n
+        if s == 0:
+            # Every device's step-0 block is its own: the causal diagonal.
+            o_b, lse_b = _flash_block(qb, k_cur, v_cur, causal, scale,
+                                      blocks, interpret)
+            o, lse = _merge(o, lse, o_b, lse_b)
+        else:
+            def visible(kc, vc):
+                o_b, lse_b = _flash_block(qb, kc, vc, False, scale,
+                                          blocks, interpret)
+                return o_b.astype(jnp.float32), lse_b
+
+            def hidden(kc, vc):
+                return (jnp.zeros((bh, t, d), jnp.float32),
+                        jnp.full((bh, t, LANES), NEG_INF, jnp.float32))
+
+            # Causal: block src is visible iff it is in the past
+            # (src < idx).  Non-causal rings see every block.
+            pred = (src < idx) if causal else jnp.bool_(True)
+            o_b, lse_b = lax.cond(pred, visible, hidden, k_cur, v_cur)
+            o, lse = _merge(o, lse, o_b, lse_b)
+        if s < n - 1:
+            k_cur = ring_permute(k_cur, axis_name)
+            v_cur = ring_permute(v_cur, axis_name)
+    return o.astype(qb.dtype), lse
+
+
+def _ring_flash_bh_fwd(qb, kb, vb, axis_name, causal, scale, blocks,
+                       interpret):
+    out, lse = _ring_flash_fwd_impl(qb, kb, vb, axis_name, causal, scale,
+                                    blocks, interpret)
+    return out, (qb, kb, vb, out, lse)
+
+
+def _ring_flash_bh_bwd(axis_name, causal, scale, blocks, interpret, res,
+                       dout):
+    from ..ops.attention import LANES, _bwd_calls
+
+    qb, kb, vb, out, lse = res
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    bh, t, d = qb.shape
+    delta = jnp.einsum("btd,btd->bt", dout.astype(jnp.float32),
+                       out.astype(jnp.float32))
+    delta = jnp.broadcast_to(delta[..., None], (bh, t, LANES))
+
+    dq = jnp.zeros((bh, t, d), jnp.float32)
+    dk_acc = jnp.zeros_like(kb, dtype=jnp.float32)
+    dv_acc = jnp.zeros_like(vb, dtype=jnp.float32)
+    k_cur, v_cur = kb, vb
+    for s in range(n):
+        src = (idx - s) % n
+        if s == 0:
+            dq_b, dk_b, dv_b = _bwd_calls(
+                qb, k_cur, v_cur, dout, lse, delta, causal=causal,
+                scale=scale, block_q=blocks[0], block_k=blocks[1],
+                interpret=interpret)
+            dq = dq + dq_b.astype(jnp.float32)
+            dk_acc = dk_acc + dk_b.astype(jnp.float32)
+            dv_acc = dv_acc + dv_b.astype(jnp.float32)
+        else:
+            def visible(args):
+                kc, vc, dka, dva = args
+                dq_b, dk_b, dv_b = _bwd_calls(
+                    qb, kc, vc, dout, lse, delta, causal=False,
+                    scale=scale, block_q=blocks[0], block_k=blocks[1],
+                    interpret=interpret)
+                return (dq_b.astype(jnp.float32),
+                        dka + dk_b.astype(jnp.float32),
+                        dva + dv_b.astype(jnp.float32))
+
+            def hidden(args):
+                _, _, dka, dva = args
+                return jnp.zeros((bh, t, d), jnp.float32), dka, dva
+
+            pred = (src < idx) if causal else jnp.bool_(True)
+            dq_b, dk_acc, dv_acc = lax.cond(
+                pred, visible, hidden, (k_cur, v_cur, dk_acc, dv_acc))
+            dq = dq + dq_b
+        # Rotate the blocks AND their gradient accumulators together —
+        # after the full circle of n rotations each dk/dv lands back on
+        # its home device.
+        k_cur = ring_permute(k_cur, axis_name)
+        v_cur = ring_permute(v_cur, axis_name)
+        dk_acc = ring_permute(dk_acc, axis_name)
+        dv_acc = ring_permute(dv_acc, axis_name)
+    return (dq.astype(qb.dtype), dk_acc.astype(kb.dtype),
+            dv_acc.astype(vb.dtype))
+
+
+_ring_flash_bh.defvjp(_ring_flash_bh_fwd, _ring_flash_bh_bwd)
+
+
+def _ring_flash_local(q, k, v, *, axis_name: str, causal: bool,
+                      scale: float, interpret: bool):
+    """Flash-inner body run per-device under shard_map ([B,T,H,D] shards)."""
+    b, t, h, d = q.shape
+    block = min(1024, t)
+    while t % block:
+        block //= 2
+
+    def to_bh(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
+
+    out = _ring_flash_bh(to_bh(q), to_bh(k), to_bh(v), axis_name, causal,
+                         scale, (block, block), interpret)
+    return jnp.transpose(out.reshape(b, h, t, d), (0, 2, 1, 3))
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -88,16 +245,32 @@ def ring_attention(
     axis_name: str = AXIS_SEQUENCE,
     batch_axes=(AXIS_DATA, AXIS_FSDP),
     head_axis: str = AXIS_TENSOR,
+    inner: str = "flash",
 ) -> jax.Array:
     """Exact attention with q/k/v of global shape [B, T, H, D], T sharded
-    over ``axis_name``.  Safe when the axis has size 1 (plain attention)."""
+    over ``axis_name``.  Safe when the axis has size 1 (plain attention).
+
+    ``inner`` selects the per-block math: "flash" (default) folds each
+    rotating K/V block with the Pallas flash kernels, so no O(t_local²)
+    score block ever materializes — the dense inner OOMs one v5e chip at
+    t_local=8192 (a 8GB f32 score temp; measured) while flash runs it in
+    ~12 ms, and the same wall caps the advertised T=32768/sp=4 manifest
+    at t_local=8192 per shard.  "dense" keeps the einsum inner (the
+    numerics oracle and the small-shard fallback)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     spec = P(batch_axes, axis_name, head_axis, None)
+    if inner == "flash":
+        interpret = jax.default_backend() != "tpu"
+        body = functools.partial(
+            _ring_flash_local, axis_name=axis_name, causal=causal,
+            scale=float(scale), interpret=interpret)
+    else:
+        body = functools.partial(
+            _ring_attention_local, axis_name=axis_name, causal=causal,
+            scale=scale)
     fn = shard_map(
-        functools.partial(
-            _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
-        ),
+        body,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

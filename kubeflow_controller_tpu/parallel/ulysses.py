@@ -70,12 +70,29 @@ def ulysses_attention(
     """Exact attention with q/k/v of global shape [B, T, H, D], T sharded
     over ``axis_name`` — same contract as ring_attention, different
     collective pattern.  ``inner`` is the full-sequence attention run on
-    each head slice (default: the f32 reference; pass a flash wrapper for
-    O(T) memory)."""
+    each head slice.  Default: the Pallas flash kernel whenever the
+    gathered sequence divides a block (O(T) memory — the dense reference
+    OOMs one chip at exactly the long contexts Ulysses exists for:
+    [B, H/n, T, T] f32 is 8GB at T=8192), else the f32 reference."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if inner is None:
-        inner = attention_reference
+        # Library default: flash whenever the gathered length divides a
+        # block.  models/llama.py passes its OWN inner with the richer
+        # cfg.attention policy ("xla" forces plain, "auto" gates on
+        # backend+length) — the model layer's policy intentionally
+        # overrides this default rather than duplicating it.
+        def inner(qg, kg, vg, *, causal, scale):
+            from ..ops.attention import flash_attention
+
+            t = qg.shape[1]
+            block = min(1024, t)
+            if t % block == 0:
+                return flash_attention(qg, kg, vg, causal=causal,
+                                       scale=scale, block_q=block,
+                                       block_k=block)
+            return attention_reference(qg, kg, vg, causal=causal,
+                                       scale=scale)
     spec = P(batch_axes, axis_name, head_axis, None)
     fn = shard_map(
         functools.partial(

@@ -43,8 +43,19 @@ def main(argv=None) -> int:
     p.add_argument("--moe-dispatch", choices=["einsum", "scatter", "grouped"],
                    default="einsum",
                    help="MoE routing implementation; 'grouped' = dropless "
-                        "grouped-matmul kernels (single-shard; falls back "
-                        "to einsum under a >1-device mesh)")
+                        "grouped-matmul kernels, sharded over ep/tp meshes "
+                        "(falls back to einsum under pp > 1)")
+    p.add_argument("--strict-moe-dispatch", action="store_true",
+                   help="fail instead of falling back when --moe-dispatch "
+                        "cannot run (installed as a warnings filter here — "
+                        "PYTHONWARNINGS is ignored by pods forked from the "
+                        "warm-start zygote, whose interpreter already "
+                        "initialized the warnings module)")
+    p.add_argument("--dim", type=int, default=0,
+                   help="model dim override for the tiny preset (0 = preset "
+                        "default); grouped dispatch needs dim % 128 == 0")
+    p.add_argument("--intermediate", type=int, default=0,
+                   help="FFN intermediate override for the tiny preset")
     p.add_argument("--sp-attention", choices=["ring", "ulysses"], default="ring",
                    help="sequence-parallel attention schedule when --sp > 1")
     p.add_argument("--remat-policy", default="",
@@ -92,9 +103,20 @@ def main(argv=None) -> int:
     rt = JobRuntime.from_env()
     rt.initialize()
 
-    cfg = LlamaConfig.llama2_7b() if args.preset == "llama2-7b" else LlamaConfig.tiny(
-        max_seq_len=args.seq_len
-    )
+    if args.strict_moe_dispatch:
+        import warnings
+
+        warnings.filterwarnings("error", message="moe dispatch")
+
+    tiny_overrides = {"max_seq_len": args.seq_len}
+    if args.dim:
+        tiny_overrides.update(dim=args.dim,
+                              n_heads=max(4, args.dim // 16),
+                              n_kv_heads=max(2, args.dim // 32))
+    if args.intermediate:
+        tiny_overrides["intermediate"] = args.intermediate
+    cfg = (LlamaConfig.llama2_7b() if args.preset == "llama2-7b"
+           else LlamaConfig.tiny(**tiny_overrides))
     overrides = {}
     if args.sp_attention != cfg.sp_attention:
         overrides["sp_attention"] = args.sp_attention

@@ -191,14 +191,24 @@ def test_pipeline_parallel_job_trains_and_resumes(rig, tmp_path):
 
 def test_moe_job_trains_with_expert_parallelism(rig, tmp_path):
     """An E=4 MoE TFJob is a real product path: experts shard over ep=4
-    inside the pod and the [L, E, ...] expert param tree checkpoints and
+    inside the pod with the DROPLESS grouped-kernel dispatch (the sharded
+    grouped path, models/moe.py:_grouped_ffn_sharded — not an einsum
+    fallback), and the [L, E, ...] expert param tree checkpoints and
     restores — the in-cluster analog of examples/jobs/llama-moe.yaml."""
     cluster, _, _ = rig
     model_dir = str(tmp_path / "moe-ck")
     job = mk_exec_job(
         "exec-moe", "llama_pretrain",
         "--steps", "2", "--batch-size", "4", "--seq-len", "64",
+        # dim/intermediate at the 128 grain the grouped kernels need (the
+        # tiny preset's dim=64 would silently fall back to einsum);
+        # --strict-moe-dispatch turns any fallback into a workload FAILURE
+        # so the product path cannot regress to a showpiece.  (An env
+        # PYTHONWARNINGS filter would NOT work: zygote-forked pods never
+        # re-initialize the warnings module.)
+        "--dim", "128", "--intermediate", "256",
         "--experts", "4", "--top-k", "2", "--ep", "4", "--fsdp", "2",
+        "--moe-dispatch", "grouped", "--strict-moe-dispatch",
         "--checkpoint-every", "1",
         typ=ReplicaType.TPU, model_dir=model_dir,
         env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
@@ -213,11 +223,38 @@ def test_moe_job_trains_with_expert_parallelism(rig, tmp_path):
     from kubeflow_controller_tpu.workloads.checkpoint import CheckpointManager
     from kubeflow_controller_tpu.workloads.trainer import default_optimizer
 
-    cfg = LlamaConfig.tiny(max_seq_len=64, n_experts=4, moe_top_k=2)
+    # Mirror the workload's tiny overrides (--dim 128 --intermediate 256
+    # implies heads dim//16, kv dim//32 — llama_pretrain.py).
+    cfg = LlamaConfig.tiny(max_seq_len=64, dim=128, n_heads=8, n_kv_heads=4,
+                           intermediate=256, n_experts=4, moe_top_k=2)
     params = llama_init(jax.random.PRNGKey(0), cfg)
     opt_state = default_optimizer(3e-4, weight_decay=0.1).init(params)
     _, _, step = CheckpointManager(model_dir).restore(params, opt_state)
     assert step == 2
+
+
+def test_sp_job_trains_with_sequence_parallelism(rig, tmp_path):
+    """A --sp 2 TFJob is a real product path: the sequence axis shards
+    over sp inside the pod (ring attention exchanging KV over the sp
+    ring), trains, and checkpoints — the in-cluster analog of
+    examples/jobs/llama-sp.yaml and the long-context axis PERF.md names
+    as the remaining T=8192 lever."""
+    cluster, _, _ = rig
+    model_dir = str(tmp_path / "sp-ck")
+    job = mk_exec_job(
+        "exec-sp", "llama_pretrain",
+        "--steps", "2", "--batch-size", "4", "--seq-len", "64",
+        "--sp", "2", "--fsdp", "4", "--sp-attention", "ring",
+        "--checkpoint-every", "1",
+        typ=ReplicaType.TPU, model_dir=model_dir,
+        env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+    )
+    cluster.tfjobs.create(job)
+    wait_phase(cluster, "exec-sp", TFJobPhase.SUCCEEDED, timeout=240.0)
+
+    from kubeflow_controller_tpu.workloads.checkpoint import CheckpointManager
+
+    assert CheckpointManager(model_dir).latest_step() == 2
 
 
 def test_slice_failure_resumes_from_checkpoint(rig, tmp_path):

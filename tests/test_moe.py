@@ -287,12 +287,12 @@ class TestGroupedDispatch:
         rhs = jax.random.normal(ks[1], (E, K, N), jnp.float32)
         te = jnp.sort(jax.random.randint(ks[2], (M // bm,), 0, E)).astype(jnp.int32)
         np.testing.assert_allclose(
-            np.asarray(gmm(lhs, rhs, te, bm, 128, 128)),
+            np.asarray(gmm(lhs, rhs, te, None, bm, 128, 128)),
             np.asarray(gmm_reference(lhs, rhs, te, bm)),
             atol=1e-4, rtol=1e-4)
 
         def l_k(l, r):
-            return jnp.sum(gmm(l, r, te, bm, 128, 128) ** 2)
+            return jnp.sum(gmm(l, r, te, None, bm, 128, 128) ** 2)
 
         def l_r(l, r):
             return jnp.sum(gmm_reference(l, r, te, bm) ** 2)
@@ -348,17 +348,105 @@ class TestGroupedDispatch:
         np.testing.assert_allclose(np.asarray(y), np.asarray(ye),
                                    atol=1e-6, rtol=1e-6)
 
-    def test_grouped_falls_back_under_mesh(self):
+    def test_grouped_runs_sharded_under_mesh(self):
+        """Dropless grouped dispatch under an active dp/fsdp/ep/tp mesh:
+        no fallback warning, matches the dense dropless oracle."""
+        import warnings
+
+        from kubeflow_controller_tpu.models.moe import (
+            moe_ffn_reference,
+            moe_ffn_stats,
+        )
+
+        router, wg, wu, wd = self._big_weights(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 128))
+        ref = moe_ffn_reference(x, router, wg, wu, wd, top_k=2)
+        mesh = build_mesh(MeshSpec(dp=1, fsdp=2, ep=2, tp=2))
+        with jax.set_mesh(mesh):
+            with warnings.catch_warnings():
+                warnings.simplefilter("error")  # any fallback = test failure
+                y, stats = jax.jit(
+                    lambda x: moe_ffn_stats(x, router, wg, wu, wd, top_k=2,
+                                            dispatch="grouped"))(x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   atol=1e-4, rtol=1e-4)
+        assert float(stats["overflow_frac"]) == 0.0
+
+    def test_grouped_sharded_grads_match_dense_oracle(self):
+        from kubeflow_controller_tpu.models.moe import (
+            moe_ffn_reference,
+            moe_ffn_stats,
+        )
+
+        router, wg, wu, wd = self._big_weights(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 128))
+
+        def loss_ref(w, x):
+            return jnp.sum(moe_ffn_reference(x, router, w, wu, wd,
+                                             top_k=2) ** 2)
+
+        def loss_grp(w, x):
+            return jnp.sum(moe_ffn_stats(x, router, w, wu, wd, top_k=2,
+                                         dispatch="grouped")[0] ** 2)
+
+        gw_ref, gx_ref = jax.grad(loss_ref, argnums=(0, 1))(wg, x)
+        mesh = build_mesh(MeshSpec(dp=1, fsdp=2, ep=2, tp=2))
+        with jax.set_mesh(mesh):
+            gw, gx = jax.jit(jax.grad(loss_grp, argnums=(0, 1)))(wg, x)
+        np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_ref),
+                                   atol=2e-4, rtol=2e-4)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_grouped_falls_back_under_pp(self):
         from kubeflow_controller_tpu.models.moe import moe_ffn_stats
 
         router, wg, wu, wd = self._big_weights(jax.random.PRNGKey(0))
         x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 128))
-        mesh = build_mesh(MeshSpec(ep=4, fsdp=2))
+        mesh = build_mesh(MeshSpec(pp=2, ep=2, fsdp=2))
         with jax.set_mesh(mesh):
-            with pytest.warns(UserWarning, match="single-shard"):
+            with pytest.warns(UserWarning, match="pipeline"):
                 y, _ = moe_ffn_stats(x, router, wg, wu, wd, top_k=2,
                                      dispatch="grouped")
             ref = moe_ffn_stats(x, router, wg, wu, wd, top_k=2,
                                 dispatch="einsum")[0]
         np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_gmm_valid_tiles_skip(self):
+        from kubeflow_controller_tpu.ops.grouped_matmul import (
+            gmm,
+            gmm_reference,
+        )
+
+        M, K, N, E, bm = 64, 128, 256, 4, 8
+        ks = jax.random.split(jax.random.PRNGKey(7), 3)
+        lhs = jax.random.normal(ks[0], (M, K), jnp.float32)
+        rhs = jax.random.normal(ks[1], (E, K, N), jnp.float32)
+        te = jnp.sort(jax.random.randint(ks[2], (M // bm,), 0, E)).astype(
+            jnp.int32)
+        valid = jnp.asarray([5], jnp.int32)
+        out = gmm(lhs, rhs, te, valid, bm, 128, 128)
+        ref = gmm_reference(lhs, rhs, te, bm)
+        np.testing.assert_allclose(np.asarray(out[: 5 * bm]),
+                                   np.asarray(ref[: 5 * bm]),
+                                   atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(out[5 * bm:]), 0.0)
+
+        # Gradients: cotangent on the skipped region must not leak into
+        # dlhs or drhs.
+        cot = jax.random.normal(ks[0], (M, N), jnp.float32)
+
+        def f(l, r):
+            return jnp.sum(gmm(l, r, te, valid, bm, 128, 128) * cot)
+
+        def f_ref(l, r):
+            mask = (jnp.arange(M) < 5 * bm)[:, None]
+            return jnp.sum(gmm_reference(l, r, te, bm) * (cot * mask))
+
+        gl, gr = jax.grad(f, argnums=(0, 1))(lhs, rhs)
+        gl_ref, gr_ref = jax.grad(f_ref, argnums=(0, 1))(lhs, rhs)
+        np.testing.assert_allclose(np.asarray(gl), np.asarray(gl_ref),
+                                   atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gr_ref),
                                    atol=1e-4, rtol=1e-4)
