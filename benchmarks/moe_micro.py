@@ -64,9 +64,9 @@ def main():
     p.add_argument("--inter", type=int, default=2816)
     p.add_argument("--experts", type=int, default=8)
     p.add_argument("--topk", type=int, default=2)
-    p.add_argument("--bm", type=int, default=128)
-    p.add_argument("--bn", type=int, default=512)
-    p.add_argument("--bk", type=int, default=512)
+    p.add_argument("--bm", type=int, default=256)
+    p.add_argument("--bn", type=int, default=1408)
+    p.add_argument("--bk", type=int, default=1408)
     a = p.parse_args()
 
     from kubeflow_controller_tpu.ops.grouped_matmul import gmm
