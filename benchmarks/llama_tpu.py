@@ -166,11 +166,12 @@ def sweep(steps: int, out_path: str, peak: float, shape: dict) -> int:
         # MoE A/B: iso-active dense bar, then capacity-einsum dispatch,
         # then the dropless grouped-matmul kernels (ops/grouped_matmul.py)
         # under the MoE-aware remat policy.
-        dict(batch=8, seq=1024, policy="gateup", shape=iso_dense),
+        dict(batch=8, seq=1024, policy="gateup", shape=iso_dense,
+             triple="iso-dense"),
         dict(batch=8, seq=1024, policy="gateup", shape=moe_shape,
-             experts=8, dispatch="einsum"),
+             experts=8, dispatch="einsum", triple="einsum"),
         dict(batch=8, seq=1024, policy="moe", shape=moe_shape,
-             experts=8, dispatch="grouped"),
+             experts=8, dispatch="grouped", triple="grouped"),
     ]
     results = []
     for g in grid:
@@ -192,7 +193,7 @@ def sweep(steps: int, out_path: str, peak: float, shape: dict) -> int:
         r.setdefault("seq", g["seq"])
         r.setdefault("remat_policy", g["policy"])
         r.setdefault("loss_chunks", g.get("chunks", 0))
-        for key in ("experts", "dispatch", "attention"):
+        for key in ("experts", "dispatch", "attention", "triple"):
             if g.get(key):
                 r.setdefault(key, g[key])
         if "shape" in g:
@@ -212,7 +213,16 @@ def _write_artifact(out_path: str, peak: float, shape: dict, results):
     best = max(ok, key=lambda r: r["model_tflops"]) if ok else None
     artifact = {
         "bench": "llama_tpu_single_chip",
-        "accounting": "6ND model FLOPs (no remat recompute counted)",
+        "accounting": (
+            "model_tflops/mfu_pct: 6ND model FLOPs (no remat recompute "
+            "counted).  hw_tflops/hw_mfu_pct: adds the EXECUTED attention "
+            "FLOPs (causal ~T^2/2; fwd + 2x bwd + 1x remat recompute "
+            "unless the policy saves attention) — see hw_tflops_per_s; "
+            "other recompute still uncounted"),
+        "moe_triple_note": (
+            "rows tagged 'triple' are the same-session MoE A/B set "
+            "(iso-active dense / capacity-einsum / dropless-grouped); "
+            "compare within the tag, not across sessions"),
         "peak_tflops_bf16": peak,
         "model": (f"Llama (dim {shape['dim']}, L{shape['layers']}, "
                   f"H{shape['heads']}, inter {shape['intermediate']}), "

@@ -14,7 +14,7 @@ XLA tiles them onto the MXU.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict
+from typing import Dict, Union
 
 import jax
 import jax.numpy as jnp
@@ -25,7 +25,9 @@ NUM_CLASSES = 10
 
 from ..utils.rand import as_seed
 
-Params = Dict[str, jax.Array]
+# Param leaves may be host numpy (cheap init) or jax Arrays — jax APIs
+# accept either and convert on first traced use.
+Params = Dict[str, Union[jax.Array, np.ndarray]]
 
 
 def softmax_init(key: jax.Array, dtype=jnp.float32) -> Params:
@@ -48,12 +50,17 @@ class MLPConfig:
     dtype: str = "float32"
 
 
-def mlp_init(key: jax.Array, cfg: MLPConfig = MLPConfig()) -> Params:
+def mlp_init(key: Union[int, jax.Array],
+             cfg: MLPConfig = MLPConfig()) -> Params:
     """Truncated-normal init scaled by 1/sqrt(fan_in), as the reference's
-    hidden layer does (mnist_replica.py:145-152).  Host-side numpy: a jit
-    of truncated_normal costs seconds on small-CPU hosts."""
+    hidden layer does (mnist_replica.py:145-152).  PURE numpy end to end
+    (accepts an int seed or a PRNGKey via as_seed): even one
+    ``jax.random.PRNGKey`` plus a couple of ``jnp.asarray`` calls cost
+    ~0.2s of tiny-jit compiles per process on a small host — real money
+    in a worker whose whole training run is ~1.5s.  jax converts the
+    numpy leaves on first use inside the compiled program instead."""
     rng = np.random.default_rng(as_seed(key))
-    dtype = jnp.dtype(cfg.dtype)
+    dtype = np.dtype(jnp.dtype(cfg.dtype).name)
 
     def trunc(shape, scale):
         a = rng.standard_normal(size=shape)
@@ -61,13 +68,13 @@ def mlp_init(key: jax.Array, cfg: MLPConfig = MLPConfig()) -> Params:
         while bad.any():  # rejection-resample the tails, like tf.truncated_normal
             a[bad] = rng.standard_normal(size=int(bad.sum()))
             bad = np.abs(a) > 2
-        return jnp.asarray((a * scale).astype(np.float32), dtype=dtype)
+        return (a * scale).astype(np.float32).astype(dtype)
 
     return {
         "w1": trunc((IMAGE_PIXELS, cfg.hidden), IMAGE_PIXELS ** -0.5),
-        "b1": jnp.zeros((cfg.hidden,), dtype=dtype),
+        "b1": np.zeros((cfg.hidden,), dtype=dtype),
         "w2": trunc((cfg.hidden, NUM_CLASSES), cfg.hidden ** -0.5),
-        "b2": jnp.zeros((NUM_CLASSES,), dtype=dtype),
+        "b2": np.zeros((NUM_CLASSES,), dtype=dtype),
     }
 
 

@@ -350,10 +350,8 @@ def _grouped_ffn_sharded(x, probs, idx, w_gate, w_up, w_down, mesh,
     collapse), and the ``valid_tiles`` compute-skip in ops/grouped_matmul
     keeps the forward and dx-backward cost proportional to the ACTUAL
     local slots — under balanced routing each shard computes ~1/ep of
-    that work.  Known cost: the dW backward (tgmm) has no skip yet and
-    streams the worst-case rows (their operands are zeros, so it is
-    correct but pays ~ep x the necessary dW MXU time; a valid_tiles-aware
-    tgmm is the open follow-up).  The down-projection
+    that work, forward AND backward (the dW tgmm skips past valid_tiles
+    too; ops/grouped_matmul.py:_tgmm_skip_kernel).  The down-projection
     contracts the tp-sharded F dim, so one psum over (ep, tp) at the end
     assembles the output; non-local slots read zero-filled skipped tiles
     and contribute nothing.

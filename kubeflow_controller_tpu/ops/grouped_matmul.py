@@ -344,11 +344,45 @@ def _tgmm_kernel(te_ref, lhs_ref, dout_ref, out_ref, acc_ref):
         out_ref[...] = acc_ref[...].astype(out_ref.dtype)
 
 
-def _tgmm_impl(lhs, dout, tile_experts, n_experts, bm, bkk, bn):
+def _tgmm_skip_kernel(te_ref, nt_ref, lhs_ref, dout_ref, out_ref, acc_ref):
+    """tgmm with the valid_tiles compute-skip: tiles at or past nt_ref[0]
+    contribute nothing and never touch the MXU (the sharded dropless
+    layout's worst-case tail).  The last REAL tile writes its expert's
+    block — past it the out block index stays clamped, so nothing else
+    writes."""
+    m = pl.program_id(2)
+    nm = pl.num_programs(2)
+    nt = nt_ref[0]
+    real = m < nt
+    first_of_expert = jnp.logical_or(
+        m == 0, te_ref[jnp.maximum(m, 1) - 1] != te_ref[m])
+
+    @pl.when(jnp.logical_and(real, first_of_expert))
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(real)
+    def _():
+        acc_ref[...] += jax.lax.dot_general(
+            lhs_ref[...], dout_ref[...], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    last_of_expert = jnp.logical_or(
+        jnp.logical_or(m == nm - 1, m == nt - 1),
+        te_ref[jnp.minimum(m + 1, nm - 1)] != te_ref[m])
+
+    @pl.when(jnp.logical_and(real, last_of_expert))
+    def _():
+        out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+
+
+def _tgmm_impl(lhs, dout, tile_experts, n_experts, bm, bkk, bn,
+               valid_tiles=None):
     """[E, K, N] with out[e] = lhsᵀ_e @ dout_e.  Row tiles of one expert
     are consecutive (group-aligned layout), and m is the innermost grid
     dim, so each output block's revisit run covers exactly its expert's
-    tiles."""
+    tiles.  ``valid_tiles`` skips the MXU work for tiles past it (see
+    _tgmm_skip_kernel)."""
     M, K = lhs.shape
     M2, N = dout.shape
     assert M == M2
@@ -359,27 +393,53 @@ def _tgmm_impl(lhs, dout, tile_experts, n_experts, bm, bkk, bn):
     budget = max(128, (1_000_000 // bn) // 128 * 128)
     bkk = _pick_block(K, min(bkk, budget))
     grid = (K // bkk, N // bn, M // bm)
+    # Variadic index maps serve both prefetch arities (te alone, or
+    # te + valid_tiles).
+    def lhs_map(k, n, m, te, *nt):
+        return (m, k)
+
+    def dout_map(k, n, m, te, *nt):
+        return (m, n)
+
+    def out_map(k, n, m, te, *nt):
+        return (te[m], k, n)
+
+    if valid_tiles is None:
+        kernel, n_prefetch = _tgmm_kernel, 1
+        scalars = (tile_experts,)
+    else:
+        kernel, n_prefetch = _tgmm_skip_kernel, 2
+        scalars = (tile_experts, valid_tiles)
+
     out = pl.pallas_call(
-        _tgmm_kernel,
+        kernel,
         out_shape=jax.ShapeDtypeStruct((n_experts, K, N), jnp.float32),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=n_prefetch,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((bm, bkk), lambda k, n, m, te: (m, k)),
-                pl.BlockSpec((bm, bn), lambda k, n, m, te: (m, n)),
+                pl.BlockSpec((bm, bkk), lhs_map),
+                pl.BlockSpec((bm, bn), dout_map),
             ],
-            out_specs=pl.BlockSpec(
-                (1, bkk, bn), lambda k, n, m, te: (te[m], k, n)),
+            out_specs=pl.BlockSpec((1, bkk, bn), out_map),
             scratch_shapes=[pltpu.VMEM((1, bkk, bn), jnp.float32)],
         ),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
         ),
         interpret=_interpret(),
-    )(tile_experts, lhs, dout)
-    # Experts with zero tiles are never visited; their blocks are garbage.
-    visited = jnp.zeros((n_experts,), jnp.bool_).at[tile_experts].set(True)
+    )(*scalars, lhs, dout)
+    # Experts with zero (real) tiles are never visited; their blocks are
+    # garbage.  Under valid_tiles, sentinel tiles clamp te to the last
+    # expert id, so visited must count REAL tiles only.
+    if valid_tiles is None:
+        visited = jnp.zeros((n_experts,), jnp.bool_).at[tile_experts].set(True)
+    else:
+        real_te = jnp.where(
+            jnp.arange(tile_experts.shape[0]) < valid_tiles[0],
+            tile_experts, n_experts)
+        visited = jnp.zeros((n_experts + 1,), jnp.bool_).at[real_te].set(
+            True)[:n_experts]
     return jnp.where(visited[:, None, None], out, 0.0)
 
 
@@ -411,18 +471,16 @@ def _gmm_fwd(lhs, rhs, tile_experts, valid_tiles, bm, bn, bk):
 
 def _gmm_bwd(bm, bn, bk, res, dout):
     lhs, rhs, tile_experts, valid_tiles = res
-    if valid_tiles is not None:
-        # Skipped tiles never touched the operands (their primal out is
-        # zero), so their cotangent must not leak into drhs — mask before
-        # the transpose matmul.  dlhs needs no mask: its own skip writes
-        # zeros for those tiles.
-        row_tile = jnp.arange(lhs.shape[0], dtype=jnp.int32) // bm
-        dout = jnp.where((row_tile < valid_tiles[0])[:, None], dout, 0)
+    # Skipped tiles never touched the operands (their primal out is zero),
+    # so their cotangent must not leak into either gradient: the dlhs gmm
+    # writes zeros for those tiles via its own skip, and the tgmm skip
+    # never accumulates their rows — no materialized mask pass needed.
     # dlhs: same grouped matmul against rhsᵀ (contract over N).
     dlhs = _gmm_fwd_impl(dout, rhs.transpose(0, 2, 1), tile_experts,
                          bm, bn, bk, valid_tiles)
     # drhs: per-expert lhsᵀ @ dout.
-    drhs = _tgmm_impl(lhs, dout, tile_experts, rhs.shape[0], bm, bk, bn)
+    drhs = _tgmm_impl(lhs, dout, tile_experts, rhs.shape[0], bm, bk, bn,
+                      valid_tiles)
     zeros_int = np.zeros(tile_experts.shape, dtype=jax.dtypes.float0)
     dvalid = (None if valid_tiles is None
               else np.zeros(valid_tiles.shape, dtype=jax.dtypes.float0))

@@ -55,7 +55,8 @@ def synthetic_mnist(seed: Seed, n: int) -> Tuple[jax.Array, jax.Array]:
     return jnp.asarray(x), jnp.asarray(y, dtype=jnp.int32)
 
 
-def synthetic_mnist_traced(seed: Seed, n: int, means: jax.Array) -> Tuple[jax.Array, jax.Array]:
+def synthetic_mnist_traced(seed: Seed, n: int,
+                           means) -> Tuple[jax.Array, jax.Array]:
     """Traceable twin of :func:`synthetic_mnist`: the same frozen mixture
     (identical ``means`` templates, unit noise) generated INSIDE the
     compiled program with two bulk threefry calls.  The dataset is a pure
@@ -67,6 +68,7 @@ def synthetic_mnist_traced(seed: Seed, n: int, means: jax.Array) -> Tuple[jax.Ar
     examples/workdir/mnist_replica.py:251-258 — because grpc PS training
     has no on-device program to fold generation into.)
     """
+    means = jnp.asarray(means)  # host templates become a traced constant
     base = jax.random.PRNGKey(_as_seed(seed) & 0x7FFFFFFF)
     kx, ky = jax.random.split(base)
     y = jax.random.randint(ky, (n,), 0, NUM_CLASSES)

@@ -79,7 +79,7 @@ def main(argv=None) -> int:
     from ..parallel import AXIS_DATA, MeshSpec, build_mesh
     from . import data as d
     from .runtime import JobRuntime
-    from .trainer import default_optimizer, train_scan_dist
+    from .trainer import default_optimizer, numpy_opt_state, train_scan_dist
 
     t_start = time.time()
     rt = JobRuntime.from_env()
@@ -92,9 +92,14 @@ def main(argv=None) -> int:
     pc, proc = jax.process_count(), jax.process_index()
     mesh = build_mesh(MeshSpec(dp=-1, fsdp=1))
 
-    params = m.mlp_init(jax.random.PRNGKey(0))  # same seed -> same init everywhere
+    # Int seed, not PRNGKey: as_seed(PRNGKey(0)) == 0, and building even
+    # one key costs a threefry jit compile this process never needs.
+    params = m.mlp_init(0)  # same seed -> same init everywhere
     opt = default_optimizer(args.lr)
-    opt_state = opt.init(params)
+    # Host-numpy optimizer state (identical to opt.init for the default
+    # chain — see trainer.numpy_opt_state): skips the init-time jit
+    # cascade that rivals this worker's whole training run.
+    opt_state = numpy_opt_state(opt, params)
 
     # Round the global batch down to a multiple of the data-parallel size
     # (the reference's batch 100 over e.g. 8 devices -> 96 per step).
@@ -106,7 +111,10 @@ def main(argv=None) -> int:
     # each shard slices its columns of every batch.
     spe = max(1, args.train_size // bs)  # steps per epoch
     eval_local = max(1, args.eval_size // dp)
-    means = jnp.asarray(d.mnist_teacher_means())
+    # Host numpy on purpose: the traced generator closes over it as a
+    # compile-time constant; an eager jnp.asarray would pay a device_put
+    # plus its tiny-jit before the program even starts.
+    means = d.mnist_teacher_means()
 
     def local_batches(i):
         x, y = d.synthetic_mnist_traced(1, spe * bs, means)

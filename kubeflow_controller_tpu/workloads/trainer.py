@@ -251,6 +251,24 @@ def replicate_global(mesh, *arrays):
     )
 
 
+def numpy_opt_state(opt: optax.GradientTransformation, params):
+    """``opt.init(params)`` built as HOST numpy zeros in the exact pytree
+    optax would return (``eval_shape`` traces without compiling).
+
+    Running the real init costs a cascade of tiny jit compiles that can
+    rival a short worker's whole training run on a small host.  VALID ONLY
+    for transforms whose init is all-zeros — true for
+    :func:`default_optimizer` (clip_by_global_norm = EmptyState, adam/adamw
+    = zeroed moments + count) and asserted by
+    tests/test_workloads.py so the two cannot drift apart silently.  A
+    transform that stores non-zero values in its state (e.g.
+    inject_hyperparams) must use ``opt.init`` directly."""
+    import numpy as np
+
+    return jax.tree_util.tree_map(
+        lambda s: np.zeros(s.shape, s.dtype), jax.eval_shape(opt.init, params))
+
+
 def default_optimizer(lr: float, *, clip: Optional[float] = 1.0,
                       weight_decay: float = 0.0) -> optax.GradientTransformation:
     chain = []

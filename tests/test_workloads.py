@@ -119,3 +119,27 @@ class TestTrainStep:
         for _ in range(20):
             params, state, loss = step(params, state, (x, y))
         assert float(loss) < float(l0)
+
+
+def test_numpy_opt_state_matches_optax_init():
+    """numpy_opt_state is valid only while default_optimizer's init is
+    all-zeros — lock the two together so a future transform with non-zero
+    init state cannot silently train from a wrong state."""
+    import numpy as np
+
+    from kubeflow_controller_tpu.models import mnist as m
+    from kubeflow_controller_tpu.workloads.trainer import (
+        default_optimizer,
+        numpy_opt_state,
+    )
+
+    params = m.mlp_init(0)
+    for kwargs in ({}, {"weight_decay": 0.1}, {"clip": None}):
+        opt = default_optimizer(1e-3, **kwargs)
+        fast = numpy_opt_state(opt, params)
+        real = opt.init(params)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)), fast, real)
+        assert (jax.tree_util.tree_structure(fast)
+                == jax.tree_util.tree_structure(real))
