@@ -253,13 +253,15 @@ def _attention(q, k, v, mesh: Optional[Mesh], causal: bool, rules: ShardingRules
                 # cfg.attention the way _flash_path does: "xla" forces the
                 # plain path, "flash" forces the kernel, "auto" gates on
                 # TPU + T >= 1024.
+                from ..parallel.ring import flash_block
+
                 t = qg.shape[1]
-                block = min(1024, t)
+                block = flash_block(t, qg.dtype)
                 use_flash = (cfg.attention == "flash"
                              or (cfg.attention == "auto"
                                  and jax.default_backend() == "tpu"
                                  and t >= 1024))
-                if use_flash and t % block == 0:
+                if use_flash and block:
                     from ..ops.attention import flash_attention
 
                     return flash_attention(qg, kg, vg, causal=causal,
@@ -301,10 +303,11 @@ def _flash_path(q, k, v, mesh: Optional[Mesh], causal: bool,
     import functools
 
     from ..ops.attention import flash_attention
+    from ..parallel.ring import flash_block
 
     t = q.shape[1]
-    block = min(1024, t)
-    if t % block:
+    block = flash_block(t, q.dtype)
+    if not block:
         return None
     if cfg.attention == "auto" and (
         t < 1024 or jax.default_backend() != "tpu"

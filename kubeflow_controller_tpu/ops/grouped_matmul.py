@@ -189,15 +189,17 @@ def _gmm_single_k(lhs, rhs, tile_experts, bm, bn, valid_tiles=None):
 
 def _single_k_blocks(M, K, N, bm, bn, dtype_bytes=2):
     """Pick a (usable, bn) pair for the single-k path: K must fit one
-    block, and the working set — lhs block, double-buffered rhs block,
-    double-buffered out block — must stay inside a conservative VMEM
-    budget (the bm=512/bn=1024 down-proj shape overflowed on v5e)."""
+    block, and the working set must stay inside a conservative VMEM
+    budget (the bm=512/bn=1024 down-proj shape overflowed on v5e).  Every
+    operand counts DOUBLE-buffered: the lhs block index varies with the
+    innermost grid dim (i), so the Pallas pipeline double-buffers it just
+    like rhs and out."""
     if M % bm:
         return None
     budget = 12 * 1024 * 1024
     bn_pick = _pick_block(N, bn)
     while bn_pick >= 128:
-        vmem = (bm * K + 2 * K * bn_pick + 2 * bm * bn_pick) * dtype_bytes
+        vmem = (2 * bm * K + 2 * K * bn_pick + 2 * bm * bn_pick) * dtype_bytes
         if vmem <= budget and N % bn_pick == 0:
             return bn_pick
         bn_pick -= 128
@@ -252,14 +254,15 @@ def _gmm2_impl(lhs, rhs_g, rhs_u, tile_experts, bm, bn):
 
 
 def _gmm2_blocks(M, K, N, bm, bn, dtype_bytes=2):
-    """VMEM-feasible bn for gmm2: lhs block + 2x double-buffered rhs
-    blocks + 3 double-buffered out blocks."""
+    """VMEM-feasible bn for gmm2: double-buffered lhs block (its index
+    varies with the innermost grid dim) + 2x double-buffered rhs blocks +
+    3 double-buffered out blocks."""
     if M % bm:
         return None
     budget = 12 * 1024 * 1024
     bn_pick = _pick_block(N, bn)
     while bn_pick >= 128:
-        vmem = (bm * K + 4 * K * bn_pick + 6 * bm * bn_pick) * dtype_bytes
+        vmem = (2 * bm * K + 4 * K * bn_pick + 6 * bm * bn_pick) * dtype_bytes
         if vmem <= budget and N % bn_pick == 0:
             return bn_pick
         bn_pick -= 128

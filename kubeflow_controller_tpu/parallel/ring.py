@@ -218,13 +218,31 @@ def _ring_flash_bh_bwd(axis_name, causal, scale, blocks, interpret, res,
 _ring_flash_bh.defvjp(_ring_flash_bh_fwd, _ring_flash_bh_bwd)
 
 
+def flash_block(t: int, dtype) -> int:
+    """Largest block <= 1024 that divides ``t`` AND respects Mosaic's
+    sublane tile (8 rows for f32, 16 for narrower dtypes).  Returns 0 when
+    no such block exists — callers must fall back to a dense inner there:
+    a sub-tile or non-tile-multiple block fails Mosaic compilation on real
+    TPUs even though it traces fine under interpret mode."""
+    tile = 8 if jnp.dtype(dtype).itemsize >= 4 else 16
+    block = min(1024, t)
+    while block >= tile and t % block:
+        block //= 2
+    if block < tile or block % tile:
+        return 0
+    return block
+
+
 def _ring_flash_local(q, k, v, *, axis_name: str, causal: bool,
                       scale: float, interpret: bool):
-    """Flash-inner body run per-device under shard_map ([B,T,H,D] shards)."""
+    """Flash-inner body run per-device under shard_map ([B,T,H,D] shards).
+    Sequence shards whose length admits no tile-aligned block take the
+    dense inner instead (same fallback discipline as ulysses/models)."""
     b, t, h, d = q.shape
-    block = min(1024, t)
-    while t % block:
-        block //= 2
+    block = flash_block(t, q.dtype)
+    if not block:
+        return _ring_attention_local(q, k, v, axis_name=axis_name,
+                                     causal=causal, scale=scale)
 
     def to_bh(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)

@@ -84,10 +84,14 @@ def ulysses_attention(
         # overrides this default rather than duplicating it.
         def inner(qg, kg, vg, *, causal, scale):
             from ..ops.attention import flash_attention
+            from .ring import flash_block
 
             t = qg.shape[1]
-            block = min(1024, t)
-            if t % block == 0:
+            # Tile-aligned block or bust: a block below (or not a multiple
+            # of) the dtype's sublane tile fails Mosaic compilation on real
+            # TPUs, so short/odd gathered lengths take the dense reference.
+            block = flash_block(t, qg.dtype)
+            if block:
                 return flash_attention(qg, kg, vg, causal=causal,
                                        scale=scale, block_q=block,
                                        block_k=block)

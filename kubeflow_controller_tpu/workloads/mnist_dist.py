@@ -133,10 +133,13 @@ def main(argv=None) -> int:
     aot = ""
     if args.aot_cache:
         os.makedirs(args.aot_cache, exist_ok=True)
+        # lr is baked into the compiled program as a constant (the optax
+        # chain closes over it), so it MUST be part of the key: two jobs
+        # differing only in --lr must not share an executable.
         aot = os.path.join(
             args.aot_cache,
             f"mnist-dist-s{args.steps}-b{bs}-n{args.train_size}"
-            f"-e{args.eval_size}-dp{dp}-pc{pc}-p{proc}.aot")
+            f"-e{args.eval_size}-lr{args.lr:g}-dp{dp}-pc{pc}-p{proc}.aot")
 
     t_init = time.time()
     # The whole job — per-step batch generation, the 200-step scan with its

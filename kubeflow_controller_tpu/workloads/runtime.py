@@ -86,12 +86,44 @@ class JobRuntime:
             return
         import jax
 
+        if self.process_id != 0:
+            # Wait for the coordinator's port to be LISTENING before the
+            # first gRPC connect: a connect attempt that lands even a few
+            # ms before the coordinator binds puts the channel into gRPC's
+            # ~1s initial reconnect backoff, and (because the coordinator
+            # blocks in its startup barrier waiting for this process) the
+            # whole gang then idles out that second.  Measured: rendezvous
+            # is bimodal 0.01s / ~1.07s depending on who wins the race; a
+            # 5ms TCP poll makes the fast mode deterministic.
+            self._wait_coordinator()
         jax.distributed.initialize(
             coordinator_address=self.coordinator,
             num_processes=self.num_processes,
             process_id=self.process_id,
         )
         self._initialized = True
+
+    def _wait_coordinator(self, timeout_s: float = 60.0,
+                          poll_s: float = 0.005) -> None:
+        """Poll the coordinator host:port until a TCP connect succeeds (the
+        service is bound) or ``timeout_s`` passes — then let the real gRPC
+        client connect first-try.  On timeout, fall through and let
+        jax.distributed.initialize surface its own (clearer) error."""
+        import socket
+        import time
+
+        host, _, port = self.coordinator.rpartition(":")
+        host = host.strip("[]")  # bracketed IPv6 ("[fd00::1]:8476")
+        if not host or not port.isdigit():
+            return
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                with socket.create_connection((host, int(port)),
+                                              timeout=poll_s + 0.1):
+                    return
+            except OSError:
+                time.sleep(poll_s)
 
     @property
     def is_chief(self) -> bool:

@@ -152,6 +152,39 @@ class TestRingAttention:
                                        atol=5e-5, rtol=5e-5)
 
 
+class TestFlashBlock:
+    def test_alignment_gating(self):
+        from kubeflow_controller_tpu.parallel.ring import flash_block
+
+        # f32 sublane tile is 8; bf16 is 16.
+        assert flash_block(1024, jnp.float32) == 1024
+        assert flash_block(8, jnp.float32) == 8
+        assert flash_block(8, jnp.bfloat16) == 0     # below bf16 tile
+        assert flash_block(24, jnp.bfloat16) == 0    # 24 % 16 != 0
+        assert flash_block(24, jnp.float32) == 24    # 24 % 8 == 0
+        assert flash_block(7, jnp.float32) == 0      # odd length
+        assert flash_block(2048, jnp.bfloat16) == 1024
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_unaligned_shard_falls_back_to_dense(self, causal):
+        """bf16 with t_local=8 (< the 16-row bf16 tile) must take the dense
+        inner and still match the oracle — the flash path would fail Mosaic
+        compilation on real TPUs at this shape."""
+        mesh = build_mesh(MeshSpec(fsdp=2, sp=4, tp=1))
+        key = jax.random.PRNGKey(7)
+        b, t, h, d = 2, 32, 2, 16
+        q, k, v = (
+            jax.random.normal(kk, (b, t, h, d)).astype(jnp.bfloat16)
+            for kk in jax.random.split(key, 3)
+        )
+        with jax.set_mesh(mesh):
+            out = ring_attention(q, k, v, mesh, causal=causal, inner="flash")
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=3e-2, rtol=3e-2)
+
+
 class TestUlyssesAttention:
     """All-to-all sequence parallelism vs the same oracle as ring."""
 

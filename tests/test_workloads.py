@@ -44,6 +44,29 @@ class TestJobRuntime:
         rt.initialize()  # no-op, must not try to reach a coordinator
         assert rt._initialized
 
+    def test_wait_coordinator_returns_once_port_bound(self):
+        # The pre-connect TCP poll (avoids the ~1s gRPC reconnect backoff
+        # when a worker dials before the coordinator binds) must return
+        # promptly once something is listening, and must not hang forever
+        # on a malformed address.
+        import socket
+        import time
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        rt = JobRuntime(coordinator=f"127.0.0.1:{port}", num_processes=2,
+                        process_id=1)
+        t0 = time.monotonic()
+        rt._wait_coordinator(timeout_s=5.0)
+        assert time.monotonic() - t0 < 2.0
+        srv.close()
+        # Malformed coordinator -> immediate no-op (initialize() will fail
+        # with jax's own clearer error).
+        JobRuntime(coordinator="nonsense", num_processes=2,
+                   process_id=1)._wait_coordinator(timeout_s=5.0)
+
 
 class TestSyntheticData:
     def test_mnist_deterministic_and_balanced(self):
