@@ -4,23 +4,38 @@
 
 PY ?= python
 
-.PHONY: all test lint typecheck cov bench dryrun validate
+.PHONY: all test test-fast lint typecheck cov cov-local bench dryrun validate
 
 all: lint test
 
+# Fast/slow split: `test-fast` (-m "not slow") is the quick signal — 214 of
+# 259 tests, minutes instead of ~15; the 45 @pytest.mark.slow tests are the
+# heavyweight model/kernel/e2e paths, covered by `test` and the
+# coverage-gated `cov` job in CI.
 test:
 	$(PY) -m pytest tests/ -q
 
-# Coverage-gated test run (the goveralls analog, ref: .travis.yml:12-14).
+test-fast:
+	$(PY) -m pytest tests/ -q -m "not slow"
+
+# Coverage-gated FULL test run (the goveralls analog, ref: .travis.yml:12-14).
 # Requires pytest-cov (CI installs it; locally falls back to plain tests).
+# Floor: measured package line coverage is 81.4% (tests/_linecov.py, full
+# suite, 2026-07-30); the gate is that floor minus a small margin.
 cov:
 	@if $(PY) -c "import pytest_cov" >/dev/null 2>&1; then \
 		$(PY) -m pytest tests/ -q --cov=kubeflow_controller_tpu \
-			--cov-report=term-missing:skip-covered --cov-fail-under=60; \
+			--cov-report=term-missing:skip-covered --cov-fail-under=75; \
 	else \
 		echo "pytest-cov not installed; running plain tests"; \
 		$(PY) -m pytest tests/ -q; \
 	fi
+
+# Zero-dependency local coverage (sys.monitoring) for images without
+# pytest-cov — same quantity the CI gate measures, so the floor can be
+# re-derived from a measurement: make cov-local
+cov-local:
+	$(PY) -m tests._linecov tests/ -q
 
 # Static type pass (the gometalinter-breadth analog, ref: config.json:4-16).
 # Requires mypy (CI installs it; locally a no-op with a notice).

@@ -140,6 +140,11 @@ class FakeAPIServer:
         self.kubelet = kubelet
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # Watch-stream generation: drop_watches() bumps it and every live
+        # stream closes at its next loop turn, forcing clients through
+        # their reconnect + re-list (reflector gap) path — a real API
+        # server does this on timeouts/rolling restarts.
+        self._watch_gen = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -225,6 +230,11 @@ class FakeAPIServer:
             self._httpd.server_close()
             self._httpd = None
 
+    def drop_watches(self) -> None:
+        """Close every active watch stream (clients must reconnect and
+        re-list).  Chaos/regression hook for the watch-gap path."""
+        self._watch_gen += 1
+
     # -- request handling ------------------------------------------------------
 
     def _wire(self, plural: str, obj: Any) -> dict:
@@ -307,6 +317,7 @@ class FakeAPIServer:
         """Chunked streaming of store watch events as JSON lines, until the
         client goes away."""
         w = self.store.watch(r.plural, r.namespace)
+        gen = self._watch_gen
         try:
             h.send_response(200)
             h.send_header("Content-Type", "application/json")
@@ -319,6 +330,8 @@ class FakeAPIServer:
 
             while True:
                 ev = w.next(timeout=0.5)
+                if self._watch_gen != gen:
+                    break  # drop_watches(): end the stream mid-flight
                 if ev is None:
                     if self._httpd is None:
                         break

@@ -122,6 +122,11 @@ class JobRuntime:
                 with socket.create_connection((host, int(port)),
                                               timeout=poll_s + 0.1):
                     return
+            except socket.gaierror:
+                # Name not resolvable yet (coordinator service DNS record
+                # still propagating): NXDOMAIN answers return near-instantly,
+                # so a 5ms loop would hammer the resolver — back off.
+                time.sleep(0.25)
             except OSError:
                 time.sleep(poll_s)
 

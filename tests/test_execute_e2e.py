@@ -9,6 +9,10 @@ import time
 
 import pytest
 
+# Whole-module: real subprocess workloads, each >5s — the quick CI job skips
+# these; the coverage-gated full job runs them.
+pytestmark = pytest.mark.slow
+
 from kubeflow_controller_tpu.api.core import Container, EnvVar, PodTemplateSpec
 from kubeflow_controller_tpu.api.meta import ObjectMeta
 from kubeflow_controller_tpu.api.tfjob import (

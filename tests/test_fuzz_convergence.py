@@ -40,14 +40,39 @@ from kubeflow_controller_tpu.controller import Controller
 from test_controller import mk_job, wait_for
 
 
-@pytest.mark.parametrize("seed", [0, 1, 2])
-def test_randomized_chaos_converges(seed):
+@pytest.mark.parametrize("transport,seed", [
+    ("memory", 0), ("memory", 1), ("memory", 2),
+    # The same chaos through the REAL transport: controller and chaos both
+    # speak HTTP to the API server (serialization, watch streams, optimistic
+    # concurrency over the wire), plus forced watch drops mid-chaos so the
+    # reflector's gap re-list path runs under concurrent writes.  Marked
+    # slow: a real HTTP server + 150s convergence deadlines don't belong in
+    # the quick job's budget; the full-coverage CI job runs them.
+    pytest.param("rest", 0, marks=pytest.mark.slow),
+    pytest.param("rest", 1, marks=pytest.mark.slow),
+])
+def test_randomized_chaos_converges(transport, seed):
     rng = random.Random(seed)
-    cluster = Cluster()
     inventory = TPUInventory(
         [TPUSlice(f"fz-slice-{i}", "v5e-8", num_hosts=2) for i in range(4)])
-    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=0.2),
-                          inventory=inventory)
+    srv = None
+    if transport == "rest":
+        from kubeflow_controller_tpu.cluster.apiserver import FakeAPIServer
+        from kubeflow_controller_tpu.cluster.rest import Kubeconfig, RestCluster
+        from kubeflow_controller_tpu.cluster.store import ObjectStore
+
+        store = ObjectStore()
+        substrate = Cluster(store=store)
+        # The kubelet is a node agent against the shared store; the
+        # controller AND the chaos loop go over HTTP.
+        kubelet = FakeKubelet(substrate, policy=PhasePolicy(run_s=0.2),
+                              inventory=inventory)
+        srv = FakeAPIServer(store)
+        cluster = RestCluster(Kubeconfig(server=srv.start()))
+    else:
+        cluster = Cluster()
+        kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=0.2),
+                              inventory=inventory)
     ctrl = Controller(cluster, inventory=inventory, resync_period_s=0.3)
     kubelet.start()
     ctrl.run(threadiness=2)
@@ -96,8 +121,16 @@ def test_randomized_chaos_converges(seed):
                             if spec.tf_replica_type == ReplicaType.WORKER:
                                 spec.replicas = rng.randint(1, 4)
                         cluster.tfjobs.update(j)
-                elif roll < 0.68:
+                elif roll < 0.64:
                     kubelet.fail_slice(rng.choice(list(inventory.slices)))
+                elif roll < 0.68:
+                    if srv is not None:
+                        # Force a watch gap: every informer stream closes
+                        # and must reconnect + re-list mid-chaos.
+                        srv.drop_watches()
+                    else:
+                        kubelet.fail_slice(
+                            rng.choice(list(inventory.slices)))
                 elif roll < 0.78 and live:
                     n = rng.choice(live)
                     cluster.tfjobs.delete("default", n)
@@ -192,3 +225,5 @@ def test_randomized_chaos_converges(seed):
     finally:
         ctrl.stop()
         kubelet.stop()
+        if srv is not None:
+            srv.stop()

@@ -144,6 +144,7 @@ class TestChunkedCE:
         chunked = llama_loss(params, tokens, cfg_c)
         np.testing.assert_allclose(float(dense), float(chunked), rtol=2e-5)
 
+    @pytest.mark.slow
     def test_grads_match_dense(self):
         cfg, cfg_c, params, tokens = self._setup()
         gd = jax.grad(lambda p: llama_loss(p, tokens, cfg))(params)

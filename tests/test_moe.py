@@ -23,6 +23,7 @@ def _weights(key, D=16, E=4, F=32):
 
 
 class TestMoEFFN:
+    @pytest.mark.slow
     def test_matches_dense_oracle_with_ample_capacity(self):
         """With capacity >= T*k no token drops, so the einsum dispatch must
         reproduce the dense computation exactly."""
@@ -134,6 +135,7 @@ class TestRouterAuxLosses:
         assert first > 1.8  # started collapsed
         assert last < 1.3, f"aux did not rebalance: {first} -> {last}"
 
+    @pytest.mark.slow
     def test_llama_loss_includes_aux_terms(self):
         cfg = LlamaConfig.tiny(n_experts=4, moe_top_k=2)
         params = llama_init(jax.random.PRNGKey(0), cfg)
@@ -241,6 +243,7 @@ class TestDispatchModes:
 
 
 class TestMoERematPolicy:
+    @pytest.mark.slow
     def test_moe_policy_grads_match_full_remat(self):
         """remat_policy='moe' (saves the tagged expert-FFN matmuls and
         dispatch intermediates) must produce the same gradients as plain
@@ -315,6 +318,7 @@ class TestGroupedDispatch:
                                    atol=2e-4, rtol=2e-4)
         assert float(stats["overflow_frac"]) == 0.0  # dropless by design
 
+    @pytest.mark.slow
     def test_grouped_grads_match_oracle(self):
         from kubeflow_controller_tpu.models.moe import moe_ffn_stats
 

@@ -82,6 +82,7 @@ class TestShardingRules:
         assert logical_to_pspec((None, "mlp")) == P(None, "tp")
 
 
+@pytest.mark.slow
 class TestRingAttention:
     @pytest.mark.parametrize("causal", [True, False])
     def test_matches_reference_sp4(self, causal):
@@ -166,6 +167,7 @@ class TestFlashBlock:
         assert flash_block(2048, jnp.bfloat16) == 1024
 
     @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.slow
     def test_unaligned_shard_falls_back_to_dense(self, causal):
         """bf16 with t_local=8 (< the 16-row bf16 tile) must take the dense
         inner and still match the oracle — the flash path would fail Mosaic
@@ -224,6 +226,7 @@ class TestUlyssesAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
     def test_grads_flow(self):
         from kubeflow_controller_tpu.parallel import ulysses_attention
 

@@ -128,6 +128,7 @@ class Test1F1B:
                                    atol=1e-5, rtol=1e-4)
 
 
+@pytest.mark.slow
 class TestLlamaPipeline:
     def test_pp2_matches_dense_forward(self):
         cfg = LlamaConfig.tiny(remat=False)  # 2 layers -> 1 per stage

@@ -228,6 +228,29 @@ class TestWatchGapRelist:
         finally:
             informer.stop()
 
+    def test_informer_relists_after_drop_watches(self, server):
+        """drop_watches() (server closes every stream, no restart) must put
+        the informer through the same gap re-list: a mutation racing the
+        reconnect window is recovered."""
+        from kubeflow_controller_tpu.controller.informer import SharedInformer
+
+        srv, url = server
+        rest = RestCluster(Kubeconfig(server=url))
+        informer = SharedInformer(rest.tfjobs, resync_period_s=0,
+                                  name="tfjobs")
+        informer.start()
+        try:
+            rest.tfjobs.create(mk_job("pre", (ReplicaType.LOCAL, 1)))
+            wait_for(lambda: informer.get("default", "pre") is not None)
+            srv.drop_watches()
+            # A write straight to the store right after the drop: it may
+            # land in the gap (stream closed, not yet re-listed) — the
+            # re-list must surface it either way.
+            srv.store.create("tfjobs", mk_job("mid", (ReplicaType.LOCAL, 1)))
+            wait_for(lambda: informer.get("default", "mid") is not None)
+        finally:
+            informer.stop()
+
 
 class TestAuth:
     def test_bearer_token_required(self):

@@ -3,12 +3,14 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from kubeflow_controller_tpu.models import vision as v
 from kubeflow_controller_tpu.workloads import data as d
 
 
 class TestShapes:
+    @pytest.mark.slow
     def test_cnn_forward(self):
         m = v.FlaxMNISTCNN()
         var = v.vision_init(m, jax.random.PRNGKey(0), (28, 28, 1))
@@ -16,6 +18,7 @@ class TestShapes:
         assert m.apply(var, x).shape == (4, 10)
         assert "batch_stats" not in var
 
+    @pytest.mark.slow
     def test_resnet18_forward_and_bn_state(self):
         m = v.resnet18(width=8)
         var = v.vision_init(m, jax.random.PRNGKey(0), (32, 32, 3))
@@ -25,6 +28,7 @@ class TestShapes:
         assert loss.shape == ()
         assert "batch_stats" in mut  # BN stats update in train mode
 
+    @pytest.mark.slow
     def test_resnet50_forward(self):
         m = v.resnet50(width=8)
         var = v.vision_init(m, jax.random.PRNGKey(0), (32, 32, 3))
