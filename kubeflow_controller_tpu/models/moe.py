@@ -132,12 +132,15 @@ def moe_ffn_stats(
       at E·C ~= T·k and run at full MXU efficiency — docs/PERF.md has
       the honest decomposition); prefer grouped when drops are
       unacceptable or capacity_factor would need to be large.
-      Falls back to "einsum" (one warning) when it cannot run: under
-      pipeline parallelism (the gpipe schedule is auto-SPMD and cannot
-      nest the manual Pallas region), or at shapes below the TPU tiling
-      grain (D / local-F not multiples of 128, local B*T*k not a
-      multiple of the dtype's sublane tile — 8 for f32, 16 for
-      bf16/f16 — or mesh-indivisible B/T/F/E).
+      Composes with pipeline parallelism: the pp schedules run their
+      stage bodies manual-over-pp (parallel/pipeline.py:_stage_map) and
+      this path nests inside them as a progressively-more-manual
+      shard_map over the remaining axes (requires jit when pp > 1 —
+      eager calls there fall back).  Falls back to "einsum" (one
+      warning) only at shapes below the TPU tiling grain (D / local-F
+      not multiples of 128, local B*T*k not a multiple of the dtype's
+      sublane tile — 8 for f32, 16 for bf16/f16 — or mesh-indivisible
+      B/T/F/E), or on an eager pp>1 call.
     """
     import math
 
@@ -180,11 +183,14 @@ def moe_ffn_stats(
             n_loc, f_loc = B * T * top_k, F
         elif in_mesh:
             shp = dict(mesh.shape)
-            if shp.get(AXIS_PIPELINE, 1) > 1:
-                # The gpipe schedule is auto-SPMD vmap over the stage axis;
-                # the full-manual Pallas region cannot nest inside it.
-                why = ("pipeline parallelism (pp > 1): the grouped kernels "
-                       "need a manual region, einsum is the pp formulation")
+            if (shp.get(AXIS_PIPELINE, 1) > 1
+                    and not isinstance(x, jax.core.Tracer)):
+                # pp>1 leaves pp out of the manual region's axis_names, and
+                # partial-manual shard_map has no eager impl in jax 0.9 —
+                # under jit (every real training path) this composes fine;
+                # an eager call degrades gracefully instead of raising.
+                why = ("an eager call under a pp>1 mesh (the partial-manual "
+                       "shard_map region requires jit)")
             elif E % shp.get(AXIS_EXPERT, 1):
                 why = f"E={E} not divisible by ep={shp.get(AXIS_EXPERT, 1)}"
             b_shard = shp.get(AXIS_DATA, 1) * shp.get(AXIS_FSDP, 1)
@@ -356,14 +362,17 @@ def _grouped_ffn_sharded(x, probs, idx, w_gate, w_up, w_down, mesh,
     assembles the output; non-local slots read zero-filled skipped tiles
     and contribute nothing.
 
-    Runs full-manual (jax.shard_map over every mesh axis): Pallas kernels
-    cannot be auto-partitioned by XLA's SPMD pass.  This is also why the
-    pp>1 pipeline keeps the einsum dispatch: the gpipe schedule is an
-    auto-SPMD vmap over the stage axis, and a manual region cannot nest
-    inside it (moe_ffn_stats falls back with a warning there).
+    Runs manual over every mesh axis EXCEPT pp (Pallas kernels cannot be
+    auto-partitioned by XLA's SPMD pass, so the axes the kernels see must
+    be manual).  pp stays out of ``axis_names``: under pipeline
+    parallelism the gpipe/1F1B schedules are themselves a shard_map manual
+    over pp only (parallel/pipeline.py:_stage_map), and this region nests
+    inside a stage body as a progressively-more-manual shard_map — that
+    composition is what lets dropless grouped MoE run under pp×ep without
+    falling back to einsum (round-4 VERDICT item 6).
     """
     from jax.sharding import PartitionSpec
-    from ..parallel.mesh import AXIS_EXPERT, AXIS_TENSOR
+    from ..parallel.mesh import AXIS_EXPERT, AXIS_PIPELINE, AXIS_TENSOR
     from ..parallel.sharding import logical_to_pspec
     from ..ops.grouped_matmul import gmm
 
@@ -374,7 +383,7 @@ def _grouped_ffn_sharded(x, probs, idx, w_gate, w_up, w_down, mesh,
     psum_axes = tuple(a for a in (AXIS_EXPERT, AXIS_TENSOR)
                       if a in mesh.axis_names)
 
-    def body(x, probs, idx, wg, wu, wd):
+    def body(eids, x, probs, idx, wg, wu, wd):
         B, T, D = x.shape
         k = idx.shape[-1]
         n_tok = B * T
@@ -382,7 +391,12 @@ def _grouped_ffn_sharded(x, probs, idx, w_gate, w_up, w_down, mesh,
         bm_l = bm
         while n_slots % bm_l:
             bm_l //= 2
-        e0 = jax.lax.axis_index(AXIS_EXPERT) * E_l
+        # This shard's ep index comes from the ep-sharded iota input, NOT
+        # jax.lax.axis_index: inside a nested partial-manual region (the
+        # pipeline composition) axis_index lowers to an sdy
+        # manual_computation over the REMAINING axes, which conflicts with
+        # the parent region's pp binding ("axis already bound", jax 0.9).
+        e0 = eids[0] * E_l
         slot_g = idx.reshape(n_slots)
         local = jnp.logical_and(slot_g >= e0, slot_g < e0 + E_l)
         # Non-local slots land in a sentinel group AFTER the real groups;
@@ -429,11 +443,24 @@ def _grouped_ffn_sharded(x, probs, idx, w_gate, w_up, w_down, mesh,
     act_spec = logical_to_pspec(("batch", "seq", None), rules)
     wg_spec = PartitionSpec(AXIS_EXPERT, None, AXIS_TENSOR)
     wd_spec = PartitionSpec(AXIS_EXPERT, AXIS_TENSOR, None)
+    # mesh=None: bind the CONTEXT mesh, so the region composes inside an
+    # already-manual-over-pp pipeline stage.  axis_names excludes pp only
+    # when a pp axis is actually present and > 1 (manual-outside under a
+    # pipeline, or replicated under a bare pp mesh): partial-manual
+    # shard_map requires jit in jax 0.9 (its eager impl builds full-mesh
+    # specs internally), so non-pp meshes keep the full-manual form and
+    # stay eager-callable.
+    names = set(mesh.axis_names)
+    if mesh.shape.get(AXIS_PIPELINE, 1) > 1:
+        names -= {AXIS_PIPELINE}
+    eids = jnp.arange(max(ep, 1), dtype=jnp.int32)
     return jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(act_spec, act_spec, act_spec, wg_spec, wg_spec, wd_spec),
+        body, mesh=None,
+        axis_names=names,
+        in_specs=(PartitionSpec(AXIS_EXPERT), act_spec, act_spec, act_spec,
+                  wg_spec, wg_spec, wd_spec),
         out_specs=act_spec, check_vma=False,
-    )(x, probs.astype(x.dtype), idx, w_gate, w_up, w_down)
+    )(eids, x, probs.astype(x.dtype), idx, w_gate, w_up, w_down)
 
 
 def _grouped_ffn(x, probs, idx, w_gate, w_up, w_down, block_m: int = 256,

@@ -49,6 +49,50 @@ def _constrain_pp(x, axis_name: str):
     return jax.lax.with_sharding_constraint(x, spec)
 
 
+def _pp_active(mesh, axis_name: str) -> bool:
+    return (mesh is not None and axis_name in getattr(mesh, "shape", {})
+            and mesh.shape[axis_name] > 1)
+
+
+def _stage_map(f, mesh, axis_name: str, manual: bool):
+    """Map ``f`` over the leading stage axis of every argument.
+
+    ``manual=False``: plain ``jax.vmap`` — XLA's SPMD pass shards the
+    stage axis from the ``_constrain_pp`` annotations (the original GPipe
+    formulation; fine for pure-XLA stage bodies).
+
+    ``manual=True`` (pp > 1): a ``jax.shard_map`` manual over ONLY the pp
+    axis; each pp device runs the body once on its local [1, ...] stage
+    slice, every other mesh axis stays auto inside.  This is what lets a
+    stage body contain its OWN nested manual regions — the dropless
+    grouped-MoE Pallas kernels shard_map over (ep, tp, dp, ...) inside a
+    stage — which the vmap formulation cannot: a vmapped Pallas call's
+    stage axis cannot be auto-partitioned by SPMD, so XLA would fall back
+    to full rematerialization (replicate-and-reslice) over pp.
+    """
+    if not manual:
+        return jax.vmap(f)
+
+    def mapped(*args):
+        def body(*locs):
+            out = f(*[jax.tree.map(lambda a: a[0], la) for la in locs])
+            return jax.tree.map(lambda a: jnp.asarray(a)[None], out)
+
+        # Prefer the CONTEXT mesh (mesh=None) so the region composes when
+        # something outer is already manual; fall back to the passed mesh
+        # when no jax.set_mesh context is active (direct library calls).
+        ctx = jax.sharding.get_abstract_mesh()
+        use_mesh = None if (ctx is not None and ctx.axis_names) else mesh
+        return jax.shard_map(
+            body, mesh=use_mesh,
+            axis_names={axis_name},
+            in_specs=tuple(P(axis_name) for _ in args),
+            out_specs=P(axis_name), check_vma=False,
+        )(*args)
+
+    return mapped
+
+
 def gpipe(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
     stage_params: Any,
@@ -87,7 +131,7 @@ def gpipe(
     # computes with) only its own stage's weights — the memory point of
     # pipeline parallelism.
     stage_params = jax.tree.map(lambda a: _constrain_pp(a, axis_name), stage_params)
-    vstage = jax.vmap(stage_fn)
+    vstage = _stage_map(stage_fn, mesh, axis_name, _pp_active(mesh, axis_name))
     zero = jnp.zeros_like(microbatches[0])
     # act[s] = activation currently entering stage s.
     act0 = _constrain_pp(jnp.broadcast_to(zero, (S, *zero.shape)), axis_name)
@@ -234,7 +278,8 @@ def pipeline_1f1b(
                 gx * scale)
 
     stage_params = jax.tree.map(lambda a: _constrain_pp(a, axis_name), stage_params)
-    vstage = jax.vmap(run_stage)
+    manual = _pp_active(mesh, axis_name)
+    vstage = _stage_map(run_stage, mesh, axis_name, manual)
 
     def bwd_one(p, x, g):
         """Re-runs the stage forward and pulls the cotangent back — per-stage
@@ -245,7 +290,7 @@ def pipeline_1f1b(
         _, vjp = jax.vjp(run_stage, p, x)
         return vjp((g, jnp.float32(1)))
 
-    vbwd = jax.vmap(bwd_one)
+    vbwd = _stage_map(bwd_one, mesh, axis_name, manual)
 
     zero = jnp.zeros_like(microbatches[0])
     R = 2 * S - 1  # ring depth: stage s reads back 2(S-1-s) <= 2S-2 steps

@@ -402,18 +402,31 @@ class TestGroupedDispatch:
         np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref),
                                    atol=2e-4, rtol=2e-4)
 
-    def test_grouped_falls_back_under_pp(self):
+    def test_grouped_runs_under_pp_mesh(self):
+        """Round-5: grouped no longer falls back under a pp>1 mesh — its
+        manual region excludes pp from axis_names (tokens/weights are
+        simply replicated over pp here; under a real pipeline the region
+        nests inside the stage body's manual-over-pp shard_map, covered by
+        test_pipeline + the dryrun)."""
+        import warnings
+
         from kubeflow_controller_tpu.models.moe import moe_ffn_stats
 
         router, wg, wu, wd = self._big_weights(jax.random.PRNGKey(0))
         x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 128))
         mesh = build_mesh(MeshSpec(pp=2, ep=2, fsdp=2))
         with jax.set_mesh(mesh):
-            with pytest.warns(UserWarning, match="pipeline"):
-                y, _ = moe_ffn_stats(x, router, wg, wu, wd, top_k=2,
-                                     dispatch="grouped")
-            ref = moe_ffn_stats(x, router, wg, wu, wd, top_k=2,
-                                dispatch="einsum")[0]
+            with warnings.catch_warnings():
+                warnings.simplefilter("error")  # any fallback warning fails
+                # jit required: partial-manual shard_map (pp left auto) has
+                # no eager impl in jax 0.9.
+                y, stats = jax.jit(
+                    lambda x: moe_ffn_stats(x, router, wg, wu, wd, top_k=2,
+                                            dispatch="grouped"))(x)
+        # Dropless: the oracle is moe_ffn_reference (einsum would differ on
+        # exactly the ~3% of tokens its capacity limit drops).
+        ref = moe_ffn_reference(x, router, wg, wu, wd, top_k=2)
+        assert float(stats["overflow_frac"]) == 0.0  # dropless
         np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
                                    atol=1e-4, rtol=1e-4)
 
