@@ -1,0 +1,135 @@
+"""Session chip calibration: what this tunneled chip actually sustains.
+
+Round-5 finding: every earlier artifact computed MFU against the nominal
+v5e bf16 peak (197 TFLOP/s) — but direct wall-clock (1000-iteration scans,
+relay cost amortized to <1%) shows the chip sustaining ~257-271 TFLOP/s on
+a bf16 SwiGLU-FFN matmul chain, which is physically impossible on a v5e.
+The hardware behind the relay is therefore NOT a v5e (signature does not
+cleanly match v4/v5p/v6e either; HBM triad measures ~543 GB/s).  MFU
+against a nominal peak is meaningless here; this artifact records the
+MEASURED session ceilings, and llama_tpu.py defaults its peak to the
+measured FFN-chain ceiling so "mfu_pct" means "fraction of what this chip
+demonstrably sustains on dense matmul chains" — a conservative (upper
+bound) denominator.
+
+All timings are direct wall-clock over long scans (NOT two-point
+extrapolation): the quantity of interest is a sustained-rate lower bound,
+and at 300-1000 reps the relay's fixed per-call cost is <1% of total.
+
+    python benchmarks/chip_calib.py --out benchmarks/chip_calib.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _wall(fn, x, reps):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def scanned(x):
+        def body(c, _):
+            s = jnp.sum(fn(c).astype(jnp.float32))
+            return c + (s * 1e-30).astype(c.dtype), None
+
+        out, _ = jax.lax.scan(body, x, None, length=reps)
+        return jnp.sum(out.astype(jnp.float32))
+
+    float(scanned(x))  # compile + complete
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.time()
+        float(scanned(x))
+        best = min(best, time.time() - t0)
+    return best / reps
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", default="benchmarks/chip_calib.json")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(0)
+    doc = {"bench": "chip_calib",
+           "method": ("direct wall-clock over 300-1000-rep scans; relay "
+                      "fixed cost amortized <1%; best-of-2"),
+           "rows": {}}
+
+    # bf16 FFN chain (the MoE bench's iso-active dense shape): the highest
+    # sustained bf16 rate observed on this chip — the session ceiling.
+    D, F2 = 1024, 5632
+    x = jax.random.normal(key, (8192, D), jnp.bfloat16)
+    wg = jax.random.normal(key, (D, F2), jnp.bfloat16)
+    wu = jax.random.normal(key, (D, F2), jnp.bfloat16)
+    wd = jax.random.normal(key, (F2, D), jnp.bfloat16)
+    dt = _wall(lambda c: (jax.nn.silu(c @ wg) * (c @ wu)) @ wd, x, 600)
+    gf = 2 * 8192 * D * F2 * 3 / 1e9
+    doc["rows"]["ffn_chain_bf16"] = {
+        "shape": "[8192,1024] x3 matmuls inter 5632",
+        "ms": round(dt * 1e3, 4), "tflops": round(gf / dt / 1e3, 1)}
+
+    # Square bf16 matmul.
+    a = jax.random.normal(key, (8192, 8192), jnp.bfloat16)
+    b = jax.random.normal(key, (8192, 8192), jnp.bfloat16)
+    dt = _wall(lambda c: c @ b, a, 200)
+    doc["rows"]["mm8k_bf16"] = {
+        "shape": "[8192,8192]@[8192,8192]",
+        "ms": round(dt * 1e3, 4),
+        "tflops": round(2 * 8192 ** 3 / dt / 1e12, 1)}
+
+    # HBM triad (read 2, write 1).
+    t1 = jax.random.normal(key, (64, 1024, 1024), jnp.float32)
+    t2 = jax.random.normal(key, (64, 1024, 1024), jnp.float32)
+
+    # t2 must be an ARGUMENT: a closed-over 256MB constant gets embedded
+    # in the remote-compile payload and the relay rejects it (HTTP 413).
+    @jax.jit
+    def triad(a, t2):
+        def body(c, _):
+            return c * 1.0001 + t2, None
+
+        out, _ = jax.lax.scan(body, a, None, length=300)
+        return jnp.sum(out)
+
+    float(triad(t1, t2))
+    dt = float("inf")
+    for _ in range(2):  # best-of-2: relay hiccups are one-sided
+        t0 = time.time()
+        float(triad(t1, t2))
+        dt = min(dt, (time.time() - t0) / 300)
+    doc["rows"]["hbm_triad_f32"] = {
+        "gb_per_iter": round(3 * t1.size * 4 / 1e9, 3),
+        "ms": round(dt * 1e3, 4),
+        "gb_s": round(3 * t1.size * 4 / 1e9 / dt)}
+
+    doc["nominal_peaks_for_reference"] = {
+        "v5e": {"bf16_tflops": 197, "hbm_gb_s": 819},
+        "v4": {"bf16_tflops": 275, "hbm_gb_s": 1228},
+        "v5p": {"bf16_tflops": 459, "hbm_gb_s": 2765},
+        "v6e": {"bf16_tflops": 918, "hbm_gb_s": 1638},
+    }
+    doc["conclusion"] = (
+        "sustained bf16 >= ffn_chain rate rules out v5e (197); no nominal "
+        "chip matches both compute and bandwidth signatures through the "
+        "relay.  Use ffn_chain_bf16.tflops as the session MFU denominator.")
+    print(json.dumps(doc["rows"]))
+    if args.out:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from _common import save_artifact
+
+        save_artifact(args.out, doc)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    sys.exit(main())

@@ -21,7 +21,7 @@ import sys
 
 
 def run(batch: int, prompt_len: int, steps: int, dim: int, layers: int,
-        heads: int, intermediate: int) -> dict:
+        heads: int, intermediate: int, ckpt: str = "") -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -39,10 +39,28 @@ def run(batch: int, prompt_len: int, steps: int, dim: int, layers: int,
     )
     S = prompt_len + steps
     params = jax.jit(lambda k: llama_init(k, cfg))(jax.random.PRNGKey(0))
-    prompt = jax.random.randint(
-        jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size)
-    gold = jax.random.randint(
-        jax.random.PRNGKey(2), (steps, batch), 0, cfg.vocab_size)
+    if ckpt:
+        # Trained weights (train_for_quality.py) + IN-DISTRIBUTION prompts
+        # and gold continuations from the same frozen bigram chain the
+        # model was trained on: the A/B then measures flip rates at the
+        # sharp margins a trained LM actually has, not the near-zero
+        # margins of random init.
+        import numpy as np
+
+        from train_for_quality import unflatten_like
+        from kubeflow_controller_tpu.workloads import data as d
+
+        loaded = dict(np.load(ckpt))
+        params = unflatten_like(params, loaded)
+        seqs = d.synthetic_tokens(77, batch, prompt_len + steps,
+                                  cfg.vocab_size)
+        prompt = seqs[:, :prompt_len]
+        gold = seqs[:, prompt_len:].T                         # [steps, B]
+    else:
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size)
+        gold = jax.random.randint(
+            jax.random.PRNGKey(2), (steps, batch), 0, cfg.vocab_size)
 
     @jax.jit
     def ab(params, prompt, gold):
@@ -75,8 +93,17 @@ def run(batch: int, prompt_len: int, steps: int, dim: int, layers: int,
 
     max_delta, agree, mean_delta = ab(params, prompt, gold)
     n = steps * batch
+    note = {}
+    if ckpt:
+        note["position_note"] = (
+            "keep prompt_len+steps <= the checkpoint's training "
+            "max_seq_len (train_for_quality.py default 1024): positions "
+            "beyond it would measure RoPE extrapolation the model never "
+            "saw, not trained-margin flip rates")
     return {
         "quality_check": "int8 KV vs bf16 KV, teacher-forced A/B",
+        "trained": bool(ckpt),
+        **note,
         "batch": batch, "prompt_len": prompt_len,
         "decode_steps": steps, "cache_len": S,
         "positions_compared": n,
@@ -95,11 +122,14 @@ def main() -> int:
     p.add_argument("--layers", type=int, default=16)
     p.add_argument("--heads", type=int, default=16)
     p.add_argument("--intermediate", type=int, default=5632)
+    p.add_argument("--ckpt", default="",
+                   help="npz from train_for_quality.py: trained weights + "
+                        "in-distribution prompts (sets trained=true)")
     p.add_argument("--out", default="")
     args = p.parse_args()
 
     row = run(args.batch, args.prompt_len, args.steps, args.dim,
-              args.layers, args.heads, args.intermediate)
+              args.layers, args.heads, args.intermediate, ckpt=args.ckpt)
     print(json.dumps(row), flush=True)
     if args.out:
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -109,11 +139,13 @@ def main() -> int:
             doc = json.load(open(args.out))
         except (FileNotFoundError, json.JSONDecodeError):
             doc = {"bench": "llama_decode_single_chip"}
-        doc["int8_kv_quality"] = row
+        key = ("int8_kv_quality_trained" if args.ckpt else "int8_kv_quality")
+        doc[key] = row
         save_artifact(args.out, doc)
     return 0
 
 
 if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     sys.exit(main())

@@ -47,29 +47,56 @@ def run(batch: int, prompt_len: int, new_tokens: int, dim: int, layers: int,
     kb = kv_block or None
     gen = jax.jit(lambda p, t: generate(p, t, cfg, max_new_tokens=new_tokens,
                                         kv_block=kb, kv_quant=kv_quant))
+    # Prefill-only control (same code path, one sampled token): its best
+    # wall time splits the end-to-end number into prefill vs decode-scan,
+    # so the per-token rate no longer silently carries the B-scaled
+    # prefill cost (round-4 VERDICT item 4).
+    pre = jax.jit(lambda p, t: generate(p, t, cfg, max_new_tokens=1,
+                                        kv_block=kb, kv_quant=kv_quant))
     # block_until_ready is NOT a trustworthy barrier through the tunneled
     # backend (async futures complete "instantly"); a host VALUE read is
     # (docs/PERF.md "Measurement caveats").
     out = gen(params, prompt)
     int(out.sum())  # compile + complete
+    int(pre(params, prompt).sum())
     best = float("inf")
+    pre_best = float("inf")
     for _ in range(3):
         t0 = time.time()
         out = gen(params, prompt)
         int(out.sum())  # host read = completion barrier
         best = min(best, time.time() - t0)
+        t0 = time.time()
+        int(pre(params, prompt).sum())
+        pre_best = min(pre_best, time.time() - t0)
     total_new = batch * new_tokens
-    # Rough split: prefill processes B*prompt_len tokens in parallel; the
-    # decode scan dominates wall time at these sizes, so report end-to-end
-    # figures plus the per-token rate over the whole call.
+    decode_s = max(best - pre_best, 1e-9)
+    decode_ms_tok = decode_s / max(new_tokens - 1, 1) * 1e3
+    # Roofline accounting: every decode step streams all params once plus
+    # the written KV prefix per sequence (avg over the decode window).
+    # eff_gb_s = that traffic / measured per-token time — compare against
+    # the chip's HBM bandwidth to see how close to the memory roofline the
+    # decode scan runs (weights bf16 = 2 bytes; int8 cache = 1 byte + f32
+    # scale per row, i.e. /head positions).
+    weights_gb = n_params * 2 / 1e9
+    avg_len = prompt_len + new_tokens / 2
+    kv_bytes_row = (1 + 4 / (dim // heads)) if kv_quant else 2
+    kv_gb = (2 * layers * batch * avg_len * dim * kv_bytes_row) / 1e9
     return {
         "params_m": round(n_params / 1e6, 1),
         "batch": batch,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
         "total_s": round(best, 3),
+        "prefill_s": round(pre_best, 3),
+        "prefill_tokens_per_s": round(batch * prompt_len / pre_best),
+        "decode_ms_per_token_per_seq": round(decode_ms_tok, 2),
         "ms_per_token_per_seq": round(best / new_tokens * 1e3, 2),
         "gen_tokens_per_s": round(total_new / best),
+        "decode_tokens_per_s": round(batch * (new_tokens - 1) / decode_s),
+        "weights_gb": round(weights_gb, 3),
+        "kv_read_gb_avg": round(kv_gb, 3),
+        "eff_gb_s": round((weights_gb + kv_gb) / (decode_ms_tok / 1e3)),
         "kv_block": kv_block,
         "kv_quant": kv_quant,
         "check_shape": list(out.shape),
@@ -96,7 +123,15 @@ def _write_artifact(args, results) -> list:
                  "length-masked when the cache spans > 1 block (the S=2048 "
                  "rows), the dense single-block read at S=256.  "
                  "kv_block=2048 forces the dense full-S read at S=2048 "
-                 "(the A/B); kv_quant = int8 rows with per-row f32 scales."),
+                 "(the A/B); kv_quant = int8 rows with per-row f32 scales.  "
+                 "prefill_s is a same-config max_new_tokens=1 control; "
+                 "decode_ms_per_token_per_seq excludes it.  Per-token cost "
+                 "GROWS with batch because decode streams weights once per "
+                 "step but the KV prefix once PER SEQUENCE: traffic/token = "
+                 "weights_gb + kv_read_gb_avg (B-proportional), and "
+                 "eff_gb_s shows how close that streaming runs to the "
+                 "chip's HBM roofline — the round-4 'unexplained' B=32 "
+                 "slowdown is this accounting."),
         "results": results,
         "best_throughput": max(ok, key=lambda r: r["gen_tokens_per_s"]) if ok else None,
     }

@@ -1,0 +1,181 @@
+"""Grouped-matmul kernel tuning sweep on the real chip (round-5 VERDICT
+item 1): block-shape sweep for the single-k gmm at the bench shapes, a
+same-shape dense-Pallas control (E=1, no grouping, no padding) and an XLA
+dense matmul to isolate (a) grouped-dispatch overhead from (b) Pallas-vs-XLA
+kernel overhead, plus full grouped-FFN fwd+grad points per block_m.
+
+Also starts with a CALIBRATION point: big dense XLA matmuls with known
+FLOPs, to pin the chip's actually-achievable TFLOP/s this session (the
+v5e bf16 peak is 197; a dense control reading above that means the chip is
+not a v5e or the harness is broken — see tpu-relay measurement caveats in
+docs/PERF.md).
+
+    python benchmarks/gmm_tune.py --sweep --out benchmarks/gmm_tune_v5e.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _mk_te(M, bm, E, key):
+    """Balanced group-aligned tile->expert map: tiles evenly split over E
+    experts in order (the layout _grouped_ffn produces under balanced
+    routing)."""
+    import jax.numpy as jnp
+
+    n_tiles = M // bm
+    return (jnp.arange(n_tiles, dtype=jnp.int32) * E // n_tiles).astype(
+        jnp.int32)
+
+
+def point(kind: str, a) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from moe_micro import timeit
+
+    key = jax.random.PRNGKey(0)
+    D, F, E, k = a.dim, a.inter, a.experts, a.topk
+    n_slots = a.bt * k
+    out: dict = {"kind": kind}
+
+    if kind == "calib":
+        # Known-FLOPs dense matmuls -> this session's achievable TFLOP/s.
+        for name, (m, kk, n) in {
+            "mm_8k": (8192, 8192, 8192),
+            "mm_bench_up": (18432, 1024, 2816),
+            "mm_bench_down": (18432, 2816, 1024),
+        }.items():
+            x = jax.random.normal(key, (m, kk), jnp.bfloat16)
+            w = jax.random.normal(key, (kk, n), jnp.bfloat16)
+            ms = timeit(lambda x: x @ w, x, reps=160)
+            out[name] = {"ms": round(ms, 4),
+                         "tflops": round(2 * m * kk * n / ms / 1e9, 1)}
+        return out
+
+    if kind in ("gmm", "gmm_dense_ctl", "gmm_par", "gmm_pa", "gmm_multik"):
+        # Single gmm forward at a bench shape.  gmm_dense_ctl: E=1 and no
+        # padding — the same kernel minus every grouping effect.
+        import kubeflow_controller_tpu.ops.grouped_matmul as gm
+        from kubeflow_controller_tpu.ops.grouped_matmul import (
+            _single_k_blocks,
+            gmm,
+        )
+
+        # Schedule experiments: gmm_par/"gmm_pa" flip the single-k grid
+        # semantics; gmm_multik forces the k-looped accumulator kernel.
+        if kind == "gmm_par":
+            gm._SINGLE_K_SEMANTICS = ("parallel", "parallel")
+        elif kind == "gmm_pa":
+            gm._SINGLE_K_SEMANTICS = ("parallel", "arbitrary")
+        elif kind == "gmm_multik":
+            gm._single_k_blocks = lambda *args, **kw: None
+
+        K, N = (D, F) if a.shape == "up" else (F, D)
+        E_eff = 1 if kind == "gmm_dense_ctl" else E
+        M = n_slots if kind == "gmm_dense_ctl" else n_slots + E * a.bm
+        lhs = jax.random.normal(key, (M, K), jnp.bfloat16)
+        rhs = jax.random.normal(key, (E_eff, K, N), jnp.bfloat16)
+        te = (jnp.zeros((M // a.bm,), jnp.int32) if E_eff == 1
+              else _mk_te(M, a.bm, E, key))
+        ms = timeit(lambda l: gmm(l, rhs, te, None, a.bm, a.bn, a.bn),
+                    lhs, reps=320)
+        flops = 2 * M * K * N
+        out.update(shape=a.shape, bm=a.bm, bn=a.bn,
+                   bn_eff=_single_k_blocks(M, K, N, a.bm, a.bn, 2), M=M,
+                   ms=round(ms, 4), tflops=round(flops / ms / 1e9, 1))
+        return out
+
+    if kind == "ffn":
+        # Full grouped FFN (fwd and fwd+grad) at block_m, through the real
+        # moe path (single-shard _grouped_ffn + gmm_swiglu fusion).
+        from kubeflow_controller_tpu.models.moe import moe_ffn_stats
+
+        B, T = 8, a.bt // 8
+        x = jax.random.normal(key, (B, T, D), jnp.bfloat16)
+        rw = jax.random.normal(key, (D, E), jnp.bfloat16) * 0.1
+        wg = jax.random.normal(key, (E, D, F), jnp.bfloat16)
+        wu = jax.random.normal(key, (E, D, F), jnp.bfloat16)
+        wd = jax.random.normal(key, (E, F, D), jnp.bfloat16)
+
+        import kubeflow_controller_tpu.models.moe as moe_mod
+
+        def f(x):
+            return moe_ffn_stats(x, rw, wg, wu, wd, top_k=k,
+                                 dispatch="grouped",
+                                 block_m=a.bm)[0]
+
+        fwd = timeit(f, x, reps=120)
+        grad = timeit(
+            lambda x: jax.grad(lambda z: jnp.sum(f(z).astype(jnp.float32)))(x),
+            x, reps=80)
+        out.update(bm=a.bm, fwd_ms=round(fwd, 3), grad_ms=round(grad, 3),
+                   step_ms=round(fwd + grad, 3))
+        return out
+
+    raise SystemExit(f"unknown kind {kind}")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--kind", default="")
+    p.add_argument("--shape", default="up", choices=["up", "down"])
+    p.add_argument("--bt", type=int, default=8192)
+    p.add_argument("--dim", type=int, default=1024)
+    p.add_argument("--inter", type=int, default=2816)
+    p.add_argument("--experts", type=int, default=8)
+    p.add_argument("--topk", type=int, default=2)
+    p.add_argument("--bm", type=int, default=256)
+    p.add_argument("--bn", type=int, default=1408)
+    p.add_argument("--sweep", action="store_true")
+    p.add_argument("--out", default="benchmarks/gmm_tune_v5e.json")
+    a = p.parse_args()
+
+    if not a.sweep:
+        print(json.dumps(point(a.kind, a)))
+        return 0
+
+    from _common import run_bench_subprocess, save_artifact
+
+    here = os.path.abspath(__file__)
+    doc = {"bench": "gmm_tune",
+           "config": {"bt": a.bt, "dim": a.dim, "inter": a.inter,
+                      "experts": a.experts, "topk": a.topk,
+                      "dtype": "bfloat16"},
+           "method": ("two-point scan extrapolation per point "
+                      "(moe_micro.timeit); each point its own subprocess "
+                      "with a shared XLA compile cache"),
+           "rows": []}
+
+    def run(kind, **kw):
+        args = ["--kind", kind]
+        for key, v in kw.items():
+            args += [f"--{key}", v]
+        r = run_bench_subprocess(here, args)
+        r.setdefault("kind", kind)
+        r.update({k: v for k, v in kw.items() if k not in r})
+        doc["rows"].append(r)
+        print(json.dumps(r), flush=True)
+        save_artifact(a.out, doc)
+
+    run("calib")
+    for shape in ("up", "down"):
+        run("gmm_dense_ctl", shape=shape, bm=256, bn=1408)
+        for bm in (128, 256, 512):
+            # bn requests clamp to the largest VMEM-feasible 128-multiple
+            # divisor (bn_eff in the row); 256 probes the narrow end.
+            for bn in (256, 1408):
+                run("gmm", shape=shape, bm=bm, bn=bn)
+    for bm in (128, 256, 512):
+        run("ffn", bm=bm)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    sys.exit(main())

@@ -9,8 +9,12 @@ completion of the whole chain; per-call overhead amortizes across K.
 
 FLOP accounting is 6*N*D (params x tokens, fwd+bwd, no remat recompute
 counted) — the standard "model FLOPs" so numbers compare across
-frameworks.  ``mfu_pct`` divides by the chip's bf16 peak (v5e: 197
-TFLOP/s).  With full remat the hardware additionally executes ~1 extra
+frameworks.  ``mfu_pct`` divides by the MEASURED session compute ceiling
+(benchmarks/chip_calib.py: the sustained bf16 SwiGLU-FFN-chain rate,
+262.1 TFLOP/s this session) — NOT a nominal datasheet peak: the chip
+behind the relay sustains well above the v5e's 197 TFLOP/s bf16 peak, so
+the "v5e" label is wrong and MFU against 197 was inflated (round-5
+finding; chip_calib.json records the evidence).  With full remat the hardware additionally executes ~1 extra
 forward (~8ND total); the named policies ("ffn"/"gateup",
 models/llama.py:_maybe_remat) save the FLOPs-dominant matmuls and cut
 that recompute where "dots" OOMs.
@@ -30,7 +34,22 @@ import os
 import sys
 import time
 
-V5E_BF16_PEAK_TFLOPS = 197.0
+# MFU denominator: the MEASURED session ceiling, NOT the v5e datasheet
+# 197 (the tunneled chip sustains ~262 TFLOP/s bf16 on the FFN matmul
+# chain, which a real v5e cannot).  Chip speed drifts between sessions,
+# so the checked-in chip_calib.json (re-runnable via
+# `python benchmarks/chip_calib.py`) is read at startup when present;
+# the constant is only the last-measured fallback.
+MEASURED_BF16_CEILING_TFLOPS = 262.1
+
+
+def _session_peak() -> float:
+    try:
+        calib = json.load(open(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "chip_calib.json")))
+        return float(calib["rows"]["ffn_chain_bf16"]["tflops"])
+    except Exception:
+        return MEASURED_BF16_CEILING_TFLOPS
 
 
 def run(batch: int, seq: int, steps: int, dim: int, layers: int, heads: int,
@@ -224,6 +243,11 @@ def _write_artifact(out_path: str, peak: float, shape: dict, results):
             "(iso-active dense / capacity-einsum / dropless-grouped); "
             "compare within the tag, not across sessions"),
         "peak_tflops_bf16": peak,
+        "peak_basis": (
+            "measured session ceiling (chip_calib.py ffn_chain_bf16), not "
+            "a datasheet peak: the relay chip sustains ~262 TFLOP/s bf16, "
+            "impossible on a nominal v5e (197) — earlier rounds' MFU "
+            "against 197 was inflated"),
         "model": (f"Llama (dim {shape['dim']}, L{shape['layers']}, "
                   f"H{shape['heads']}, inter {shape['intermediate']}), "
                   "adafactor, bf16"),
@@ -233,6 +257,58 @@ def _write_artifact(out_path: str, peak: float, shape: dict, results):
     with open(out_path, "w") as f:
         json.dump(artifact, f, indent=1)
     return best
+
+
+def triple_only(steps: int, out_path: str, peak: float) -> int:
+    """Re-measure ONLY the same-session MoE A/B triple and merge it into
+    the existing artifact; every retained row's mfu_pct/hw_mfu_pct is
+    rescaled to the CURRENT peak basis (mfu is derived arithmetic —
+    model_tflops/ms are the measurements and stay as recorded; see
+    peak_basis in the artifact header)."""
+    moe_shape = dict(dim=1024, layers=8, heads=16, intermediate=2816)
+    iso_dense = dict(dim=1024, layers=8, heads=16, intermediate=5632)
+    grid = [
+        dict(batch=8, seq=1024, policy="gateup", shape=iso_dense,
+             triple="iso-dense"),
+        dict(batch=8, seq=1024, policy="gateup", shape=moe_shape,
+             experts=8, dispatch="einsum", triple="einsum"),
+        dict(batch=8, seq=1024, policy="moe", shape=moe_shape,
+             experts=8, dispatch="grouped", triple="grouped"),
+    ]
+    try:
+        doc = json.load(open(out_path))
+    except (FileNotFoundError, json.JSONDecodeError):
+        doc = {}
+    kept = [r for r in doc.get("results", []) if not r.get("triple")]
+    for r in kept:
+        if "model_tflops" in r:
+            r["mfu_pct"] = round(100 * r["model_tflops"] / peak, 1)
+            if "hw_tflops" in r:
+                r["hw_mfu_pct"] = round(100 * r["hw_tflops"] / peak, 1)
+    results = kept
+    shape = dict(dim=2048, layers=16, heads=16, intermediate=5632)
+    for g in grid:
+        s = g["shape"]
+        r = run_subprocess([
+            "--batch", g["batch"], "--seq", g["seq"], "--steps", steps,
+            "--remat-policy", g["policy"],
+            "--experts", g.get("experts", 0),
+            "--moe-dispatch", g.get("dispatch", "einsum"),
+            "--peak-tflops", peak, "--dim", s["dim"],
+            "--layers", s["layers"], "--heads", s["heads"],
+            "--intermediate", s["intermediate"],
+        ])
+        r.setdefault("batch", g["batch"])
+        r.setdefault("seq", g["seq"])
+        r.setdefault("remat_policy", g["policy"])
+        for key in ("experts", "dispatch", "triple"):
+            if g.get(key):
+                r.setdefault(key, g[key])
+        r["shape"] = s
+        results.append(r)
+        print(json.dumps(r), flush=True)
+        _write_artifact(out_path, peak, shape, results)
+    return 0
 
 
 def main() -> int:
@@ -255,11 +331,16 @@ def main() -> int:
                    choices=["einsum", "scatter", "grouped"])
     p.add_argument("--attention", default="auto",
                    choices=["auto", "flash", "xla"])
-    p.add_argument("--peak-tflops", type=float, default=V5E_BF16_PEAK_TFLOPS)
+    p.add_argument("--peak-tflops", type=float, default=_session_peak())
     p.add_argument("--sweep", action="store_true",
                    help="run the config grid and write the JSON artifact")
+    p.add_argument("--triple", action="store_true",
+                   help="re-measure only the MoE A/B triple and merge "
+                        "(rescales retained rows' mfu to the current peak)")
     p.add_argument("--out", default="benchmarks/llama_tpu_v5e.json")
     args = p.parse_args()
+    if args.triple:
+        return triple_only(args.steps, args.out, args.peak_tflops)
     if args.sweep:
         return sweep(args.steps, args.out, args.peak_tflops,
                      dict(dim=args.dim, layers=args.layers, heads=args.heads,

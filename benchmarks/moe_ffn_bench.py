@@ -42,11 +42,11 @@ def main():
                      jax.random.normal(key, (D, 2 * F), jnp.bfloat16),
                      jax.random.normal(key, (2 * F, D), jnp.bfloat16))
 
-    def moe_f(x, mode, cf):
+    def moe_f(x, wg, wu, wd, mode, cf):
         return moe_ffn_stats(x, rw, wg, wu, wd, top_k=a.topk,
                              capacity_factor=cf, dispatch=mode)[0]
 
-    def dense_f(x):
+    def dense_f(x, wg2, wu2, wd2):
         return jnp.einsum(
             "btf,fd->btd",
             jax.nn.silu(jnp.einsum("btd,df->btf", x, wg2))
@@ -63,7 +63,13 @@ def main():
         "note": ("grouped is DROPLESS (capacity-free): its cost is flat in "
                  "capacity_factor while the einsum path's dispatch AND "
                  "expert compute scale with E*C = T*k*cf — the crossover "
-                 "is the honest selection rule between the two"),
+                 "is the honest selection rule between the two.  grad is "
+                 "w.r.t. x AND every FFN weight with a data-dependent "
+                 "cotangent (loss = sum(y^2)): round 4's sum(y) + x-only "
+                 "grad let XLA collapse the ones-cotangent matmuls and DCE "
+                 "the weight grads on the einsum/dense paths while the "
+                 "grouped custom-VJP (opaque to XLA) paid its full tgmm "
+                 "weight-grad cost — biased AGAINST grouped both ways."),
         "rows": [],
     }
 
@@ -73,20 +79,37 @@ def main():
 
             save_artifact(a.out, doc)
 
-    cases = [("grouped dropless", lambda x: moe_f(x, "grouped", 1.0)),
-             ("einsum cf=1.0", lambda x: moe_f(x, "einsum", 1.0)),
-             ("einsum cf=1.25", lambda x: moe_f(x, "einsum", 1.25)),
-             ("einsum cf=2.0", lambda x: moe_f(x, "einsum", 2.0)),
-             ("dense iso-active control", dense_f)]
-    for name, fn in cases:
+    # One source of truth per case: (name, raw_fn(x, *weights), weights).
+    cases = [
+        ("grouped dropless",
+         lambda x, *w: moe_f(x, *w, "grouped", 1.0), (wg, wu, wd)),
+        ("einsum cf=1.0",
+         lambda x, *w: moe_f(x, *w, "einsum", 1.0), (wg, wu, wd)),
+        ("einsum cf=1.25",
+         lambda x, *w: moe_f(x, *w, "einsum", 1.25), (wg, wu, wd)),
+        ("einsum cf=2.0",
+         lambda x, *w: moe_f(x, *w, "einsum", 2.0), (wg, wu, wd)),
+        ("dense iso-active control", dense_f, (wg2, wu2, wd2)),
+    ]
+    for name, raw, weights in cases:
+        def fn(x, raw=raw, weights=weights):
+            return raw(x, *weights)
+
+        def grad_fn(x, raw=raw, weights=weights):
+            # Training-shaped backward: data-dependent cotangent (sum y^2)
+            # and grads for x AND the weights, so neither algebraic
+            # cotangent collapse nor weight-grad DCE skews the A/B.
+            def loss(x, *w):
+                return jnp.sum(raw(x, *w).astype(jnp.float32) ** 2)
+
+            return jax.grad(loss, argnums=tuple(range(1 + len(weights))))(
+                x, *weights)
+
         try:
             fwd_runs, grad_runs = [], []
             for _ in range(a.repeats):
-                fwd_runs.append(round(timeit(fn, x, reps=80), 3))
-                grad_runs.append(round(timeit(
-                    lambda x: jax.grad(
-                        lambda z: jnp.sum(fn(z).astype(jnp.float32)))(x),
-                    x, reps=80), 3))
+                fwd_runs.append(round(timeit(fn, x, reps=120), 3))
+                grad_runs.append(round(timeit(grad_fn, x, reps=80), 3))
             row = {"name": name, "fwd_ms": min(fwd_runs),
                    "grad_ms": min(grad_runs),
                    "step_ms": round(min(fwd_runs) + min(grad_runs), 3),

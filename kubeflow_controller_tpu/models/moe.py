@@ -80,6 +80,7 @@ def moe_ffn_stats(
     rules: ShardingRules = DEFAULT_RULES,
     dispatch: str = "einsum",
     save_names: bool = False,
+    block_m: int = 256,
 ):
     """x [B, T, D]; router_w [D, E]; w_gate/w_up [E, D, F]; w_down [E, F, D].
 
@@ -221,7 +222,7 @@ def moe_ffn_stats(
             from ..ops.grouped_matmul import _single_k_blocks
 
             e_l = max(1, E // max(1, dict(mesh.shape).get(AXIS_EXPERT, 1)))
-            bm_chk = 256
+            bm_chk = block_m  # mirror the bm the sharded path will use
             while n_loc % bm_chk:
                 bm_chk //= 2
             m_worst = n_loc + (e_l + 1) * bm_chk
@@ -270,11 +271,12 @@ def moe_ffn_stats(
     if grouped and grouped_mesh is not None:
         y = _grouped_ffn_sharded(x, probs, idx, w_gate.astype(dtype),
                                  w_up.astype(dtype), w_down.astype(dtype),
-                                 grouped_mesh, rules, save_names=save_names)
+                                 grouped_mesh, rules, block_m=block_m,
+                                 save_names=save_names)
     elif grouped:
         y = _grouped_ffn(x, probs, idx, w_gate.astype(dtype),
                          w_up.astype(dtype), w_down.astype(dtype),
-                         save_names=save_names)
+                         block_m=block_m, save_names=save_names)
     elif dispatch == "scatter":
         S = T * top_k
         # Per routing slot: its expert, its buffer position, kept or not.

@@ -57,6 +57,16 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# Grid-dimension semantics for the single-k kernels.  Neither dim carries
+# an accumulation across revisits (each (j, i) writes its own out block
+# exactly once), so "parallel" is semantically legal for both; the default
+# keeps "arbitrary" (sequential) because the i-order is what makes
+# consecutive same-expert tiles reuse the cached weight block (+22%
+# measured, round 4).  benchmarks/gmm_tune.py overrides this to measure
+# the alternative schedules.
+_SINGLE_K_SEMANTICS = ("arbitrary", "arbitrary")
+
+
 # ---------------------------------------------------------------------------
 # gmm: out[i*bm:(i+1)*bm] = lhs[i*bm:(i+1)*bm] @ rhs[tile_experts[i]]
 # ---------------------------------------------------------------------------
@@ -164,7 +174,7 @@ def _gmm_single_k(lhs, rhs, tile_experts, bm, bn, valid_tiles=None):
                 out_specs=pl.BlockSpec((bm, bn), lambda j, i, te: (i, j)),
             ),
             compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("arbitrary", "arbitrary"),
+                dimension_semantics=_SINGLE_K_SEMANTICS,
             ),
             interpret=_interpret(),
         )(tile_experts, lhs, rhs)
@@ -181,7 +191,7 @@ def _gmm_single_k(lhs, rhs, tile_experts, bm, bn, valid_tiles=None):
             out_specs=pl.BlockSpec((bm, bn), lambda j, i, te, nt: (i, j)),
         ),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary"),
+            dimension_semantics=_SINGLE_K_SEMANTICS,
         ),
         interpret=_interpret(),
     )(tile_experts, valid_tiles, lhs, rhs)
@@ -414,9 +424,13 @@ def _tgmm_impl(lhs, dout, tile_experts, n_experts, bm, bkk, bn,
         kernel, n_prefetch = _tgmm_skip_kernel, 2
         scalars = (tile_experts, valid_tiles)
 
+    # Output in the operand dtype, not f32: the f32 accumulator lives in
+    # VMEM scratch and the final write casts — an f32 [E,K,N] output paid
+    # an extra 46MB of writes plus a 92MB f32 mask pass at the bench shape
+    # (~0.2 ms per tgmm, 3 tgmms per MoE step).
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((n_experts, K, N), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((n_experts, K, N), lhs.dtype),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=n_prefetch,
             grid=grid,

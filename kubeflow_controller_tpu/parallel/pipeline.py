@@ -74,6 +74,14 @@ def _stage_map(f, mesh, axis_name: str, manual: bool):
         return jax.vmap(f)
 
     def mapped(*args):
+        if not any(isinstance(leaf, jax.core.Tracer)
+                   for leaf in jax.tree.leaves(args)):
+            # Eager call: partial-manual shard_map has no eager impl in
+            # jax 0.9 — keep the old vmap formulation (which worked
+            # eagerly) instead of crashing; nested manual MoE regions are
+            # a jit-only feature either way.
+            return jax.vmap(f)(*args)
+
         def body(*locs):
             out = f(*[jax.tree.map(lambda a: a[0], la) for la in locs])
             return jax.tree.map(lambda a: jnp.asarray(a)[None], out)
