@@ -16,7 +16,7 @@ import time
 
 
 def bench_one(impl: str, b: int, t: int, h: int, d: int, steps: int,
-              causal: bool = True) -> dict:
+              causal: bool = True, bbq: int = 0, bbk: int = 0) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -31,7 +31,9 @@ def bench_one(impl: str, b: int, t: int, h: int, d: int, steps: int,
     v = jax.random.normal(ks[2], shape, dtype=jnp.bfloat16)
 
     if impl == "flash":
-        fn = lambda q, k, v: flash_attention(q, k, v, causal=causal)
+        fn = lambda q, k, v: flash_attention(
+            q, k, v, causal=causal,
+            bwd_block_q=bbq or None, bwd_block_k=bbk or None)
     else:
         fn = lambda q, k, v: attention_reference(q, k, v, causal=causal)
 
@@ -60,11 +62,14 @@ def bench_one(impl: str, b: int, t: int, h: int, d: int, steps: int,
     # fwd+bwd attention FLOPs: fwd 4*B*H*T^2*D (QK^T + PV), bwd ~2.5x fwd.
     causal_factor = 0.5 if causal else 1.0
     flops = 3.5 * 4 * b * h * t * t * d * causal_factor
-    return {
+    row = {
         "impl": impl, "b": b, "t": t, "h": h, "d": d,
         "ms": round(dt * 1e3, 2),
         "tflops": round(flops / dt / 1e12, 1),
     }
+    if bbq or bbk:
+        row["bwd_blocks"] = [bbq or 1024, bbk or 1024]
+    return row
 
 
 def main() -> int:
@@ -83,13 +88,43 @@ def main() -> int:
     p.add_argument("--out", default="",
                    help="write the sweep's JSON artifact here (e.g. "
                         "benchmarks/attn_tpu_v5e.json)")
+    p.add_argument("--bwd-block-q", type=int, default=0)
+    p.add_argument("--bwd-block-k", type=int, default=0)
+    p.add_argument("--bwd-sweep", action="store_true",
+                   help="sweep BACKWARD block shapes at the longest T "
+                        "(round-5 VERDICT item 8: the flash bwd dominates "
+                        "long-T step time) and record the winner")
     args = p.parse_args()
     if args.impl:
         # Single point, in-process (the subprocess worker of the sweep).
         t = args.seqs[0]
         r = bench_one(args.impl, max(1, args.tokens // t), t,
-                      args.heads, args.head_dim, args.steps)
+                      args.heads, args.head_dim, args.steps,
+                      bbq=args.bwd_block_q, bbk=args.bwd_block_k)
         print(json.dumps(r))
+        return 0
+    if args.bwd_sweep:
+        from benchmarks._common import run_bench_subprocess, save_artifact
+
+        t = max(args.seqs)
+        rows = []
+        for bbq, bbk in ((1024, 1024), (512, 1024), (1024, 512),
+                         (512, 512), (256, 1024)):
+            r = run_bench_subprocess(os.path.abspath(__file__), [
+                "--impl", "flash", "--seqs", t, "--tokens", args.tokens,
+                "--heads", args.heads, "--head-dim", args.head_dim,
+                "--steps", args.steps,
+                "--bwd-block-q", bbq, "--bwd-block-k", bbk])
+            r.setdefault("bwd_blocks", [bbq, bbk])
+            rows.append(r)
+            print(json.dumps(r), flush=True)
+            if args.out:
+                try:
+                    doc = json.load(open(args.out))
+                except (FileNotFoundError, json.JSONDecodeError):
+                    doc = {"bench": "flash_vs_xla_attention_fwd_bwd"}
+                doc["bwd_block_sweep_t%d" % t] = rows
+                save_artifact(args.out, doc)
         return 0
     # Sweep: one subprocess per point — a failing config (e.g. XLA attention
     # at T=8192, which cannot compile on one chip: that asymmetry IS the

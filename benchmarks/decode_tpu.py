@@ -111,9 +111,19 @@ def run_subprocess(args_list) -> dict:
 
 def _write_artifact(args, results) -> list:
     """Incremental write after every row: points cost minutes of relay
-    compile each, so an interrupted sweep must keep what it measured."""
+    compile each, so an interrupted sweep must keep what it measured.
+    Preserves non-sweep keys other tools merge into the artifact (the
+    int8_kv_quality rows from decode_quality.py)."""
     ok = [r for r in results if "gen_tokens_per_s" in r]
+    try:
+        prev = json.load(open(args.out))
+    except (FileNotFoundError, json.JSONDecodeError):
+        prev = {}
+    extra = {k: v for k, v in prev.items()
+             if k not in ("bench", "model", "note", "results",
+                          "best_throughput")}
     artifact = {
+        **extra,
         "bench": "llama_decode_single_chip",
         "model": (f"Llama (dim {args.dim}, L{args.layers}, H{args.heads}, "
                   f"inter {args.intermediate}), bf16, KV-cache greedy decode"),
@@ -135,8 +145,9 @@ def _write_artifact(args, results) -> list:
         "results": results,
         "best_throughput": max(ok, key=lambda r: r["gen_tokens_per_s"]) if ok else None,
     }
-    with open(args.out, "w") as f:
-        json.dump(artifact, f, indent=1)
+    from benchmarks._common import save_artifact
+
+    save_artifact(args.out, artifact)  # atomic: never a half-written file
     return ok
 
 

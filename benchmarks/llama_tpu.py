@@ -226,7 +226,8 @@ def sweep(steps: int, out_path: str, peak: float, shape: dict) -> int:
     return 0 if best else 1
 
 
-def _write_artifact(out_path: str, peak: float, shape: dict, results):
+def _write_artifact(out_path: str, peak: float, shape: dict, results,
+                    model_str: str = ""):
     """Writes the artifact; returns the current best row (or None)."""
     ok = [r for r in results if "model_tflops" in r]
     best = max(ok, key=lambda r: r["model_tflops"]) if ok else None
@@ -248,9 +249,10 @@ def _write_artifact(out_path: str, peak: float, shape: dict, results):
             "a datasheet peak: the relay chip sustains ~262 TFLOP/s bf16, "
             "impossible on a nominal v5e (197) — earlier rounds' MFU "
             "against 197 was inflated"),
-        "model": (f"Llama (dim {shape['dim']}, L{shape['layers']}, "
-                  f"H{shape['heads']}, inter {shape['intermediate']}), "
-                  "adafactor, bf16"),
+        "model": model_str or (
+            f"Llama (dim {shape['dim']}, L{shape['layers']}, "
+            f"H{shape['heads']}, inter {shape['intermediate']}), "
+            "adafactor, bf16"),
         "best": best,
         "results": results,
     }
@@ -280,6 +282,9 @@ def triple_only(steps: int, out_path: str, peak: float) -> int:
     except (FileNotFoundError, json.JSONDecodeError):
         doc = {}
     kept = [r for r in doc.get("results", []) if not r.get("triple")]
+    # The artifact header's model string describes the SWEEP shape, which
+    # --triple does not re-measure: preserve it rather than re-deriving.
+    kept_model = doc.get("model")
     for r in kept:
         if "model_tflops" in r:
             r["mfu_pct"] = round(100 * r["model_tflops"] / peak, 1)
@@ -307,7 +312,8 @@ def triple_only(steps: int, out_path: str, peak: float) -> int:
         r["shape"] = s
         results.append(r)
         print(json.dumps(r), flush=True)
-        _write_artifact(out_path, peak, shape, results)
+        _write_artifact(out_path, peak, shape, results,
+                        model_str=kept_model)
     return 0
 
 

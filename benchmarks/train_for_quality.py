@@ -13,7 +13,11 @@ deterministic successor): next-token loss drops far below log(vocab) within
 then
     python benchmarks/decode_quality.py --ckpt /tmp/quality_238m.npz \
         --dim 1024 --layers 8 --intermediate 5632 \
+        --prompt-len 512 --steps 512 \
         --out benchmarks/decode_tpu_v5e.json
+
+(prompt_len + steps must stay <= the training --seq, 1024 by default —
+positions past it would measure RoPE extrapolation, not trained margins.)
 """
 
 from __future__ import annotations
@@ -33,9 +37,19 @@ def flatten_params(params):
 
 def unflatten_like(template, flat: dict):
     import jax
+    import jax.numpy as jnp
 
-    leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
-    vals = [flat[jax.tree_util.keystr(path)] for path, _ in leaves]
+    import ml_dtypes
+    import numpy as np
+
+    def load(arr, leaf):
+        if arr.dtype == np.dtype("V2"):  # legacy npz of raw bf16 bytes
+            arr = arr.view(ml_dtypes.bfloat16)
+        return jnp.asarray(arr).astype(leaf.dtype)
+
+    leaves, _ = jax.tree_util.tree_flatten_with_path(template)
+    vals = [load(flat[jax.tree_util.keystr(path)], leaf)
+            for path, leaf in leaves]
     return jax.tree_util.tree_unflatten(
         jax.tree_util.tree_structure(template), vals)
 
@@ -107,7 +121,9 @@ def main() -> int:
                               "elapsed_s": round(time.time() - t0, 1)}),
                   flush=True)
 
-    np.savez(a.ckpt, **{k: np.asarray(v)
+    # Save as f32: npz round-trips ml_dtypes.bfloat16 poorly (jit rejects
+    # the loaded arrays); unflatten_like casts back to the template dtype.
+    np.savez(a.ckpt, **{k: np.asarray(v, dtype=np.float32)
                         for k, v in flatten_params(params).items()})
     print(json.dumps({
         "trained": True, "params_m": round(n_params / 1e6, 1),

@@ -308,9 +308,14 @@ def _flash_bh_bwd(causal, scale, blocks, interpret, res, dout):
     delta = jnp.einsum(
         "btd,btd->bt", dout.astype(jnp.float32), out.astype(jnp.float32))
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
+    # blocks may carry independent backward block sizes (bq, bk, bbq, bbk):
+    # at long T the backward's causal-diagonal waste shrinks with finer
+    # blocks while the forward's optimum stays at 1024 (benchmarks/
+    # attn_tpu.py --bwd-sweep measures the trade).
+    bbq, bbk = (blocks[2], blocks[3]) if len(blocks) == 4 else blocks[:2]
     dq, dk, dv = _bwd_calls(
         qb, kb, vb, dout, lse, delta, causal=causal, scale=scale,
-        block_q=blocks[0], block_k=blocks[1], interpret=interpret)
+        block_q=bbq, block_k=bbk, interpret=interpret)
     return dq, dk, dv
 
 
@@ -326,13 +331,18 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: int = 1024,
     block_k: int = 1024,
+    bwd_block_q: Optional[int] = None,
+    bwd_block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """q/k/v: [batch, seq, heads, head_dim] -> same shape.  Differentiable.
 
     Default 1024-blocks measured fastest on v5e across T=1024..8192 (the
     finer-blocked variants pay more grid/pipeline overhead than they save
-    in VMEM pressure at d=128).
+    in VMEM pressure at d=128).  ``bwd_block_q``/``bwd_block_k`` override
+    the BACKWARD kernels' blocks independently (default: same as forward):
+    at long T the causal diagonal wastes a half-block per row, so finer
+    backward blocks trade grid overhead for less masked compute.
 
     Requires seq divisible by the block sizes (clamped to seq).  Runs the
     Pallas kernels on TPU, the interpreter elsewhere.
@@ -344,13 +354,17 @@ def flash_attention(
     b, t, h, d = q.shape
     block_q = min(block_q, t)
     block_k = min(block_k, t)
-    if t % block_q or t % block_k:
-        raise ValueError(f"seq len {t} not divisible by blocks ({block_q},{block_k})")
+    bbq = min(bwd_block_q or block_q, t)
+    bbk = min(bwd_block_k or block_k, t)
+    if t % block_q or t % block_k or t % bbq or t % bbk:
+        raise ValueError(
+            f"seq len {t} not divisible by blocks "
+            f"({block_q},{block_k},{bbq},{bbk})")
 
     # [B, T, H, D] -> [B*H, T, D]: one grid row per (batch, head).
     def to_bh(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
 
     out = _flash_bh(to_bh(q), to_bh(k), to_bh(v), causal, float(scale),
-                    (block_q, block_k), interpret)
+                    (block_q, block_k, bbq, bbk), interpret)
     return jnp.transpose(out.reshape(b, h, t, d), (0, 2, 1, 3))

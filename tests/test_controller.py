@@ -190,6 +190,26 @@ def test_multislice_tpu_job_full_lifecycle(rig):
     assert all(not s.bound_gang for s in inventory.slices.values())
 
 
+def test_tpu_job_pending_until_capacity_returns(rig):
+    """A TPU job created while EVERY slice is quarantined must stay
+    Pending (a real cluster out of capacity — not a controller wedge),
+    then bind and complete when a slice heals, with no new API event:
+    the level-triggered resync is what must notice.  Deterministic form
+    of the fuzz flake where chaos quarantined all slices (round 5)."""
+    cluster, ctrl, _, inventory = rig
+    for s in inventory.slices.values():
+        s.healthy = False
+    cluster.tfjobs.create(mk_job("starved", (ReplicaType.TPU, 2)))
+    time.sleep(1.5)  # several resync periods
+    assert phase_of(cluster, "starved") not in (TFJobPhase.SUCCEEDED,
+                                                TFJobPhase.FAILED)
+    for s in inventory.slices.values():
+        s.healthy = True
+    wait_for(lambda: phase_of(cluster, "starved") == TFJobPhase.SUCCEEDED,
+             timeout=20.0)
+    assert all(not s.bound_gang for s in inventory.slices.values())
+
+
 def test_finalizer_guards_deletion_cleanup(rig):
     """Deletion is finalizer-gated: the job lingers with deletionTimestamp
     until the controller releases the gang and deletes children explicitly,

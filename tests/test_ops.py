@@ -57,6 +57,26 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-5, rtol=5e-5)
 
+    @pytest.mark.parametrize("bbq,bbk", [(16, 32), (32, 16), (16, 16)])
+    def test_bwd_blocks_independent_of_fwd(self, bbq, bbk):
+        """Backward block sizes decoupled from the forward's (round 5:
+        attn_tpu.py --bwd-sweep tunes them separately) must not change
+        gradients."""
+        q, k, v = _qkv(jax.random.PRNGKey(5), 2, 64, 2, 16)
+
+        def loss(q, k, v, **kw):
+            out = flash_attention(q, k, v, causal=True, block_q=32,
+                                  block_k=32, **kw)
+            return jnp.sum(jnp.sin(out))
+
+        g0 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        g1 = jax.grad(
+            lambda q, k, v: loss(q, k, v, bwd_block_q=bbq, bwd_block_k=bbk),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g0):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-5)
+
     def test_grad_under_jit_and_remat(self):
         """Composes with jax.checkpoint the way the model uses it."""
         q, k, v = _qkv(jax.random.PRNGKey(4), 1, 64, 2, 16)
