@@ -187,7 +187,30 @@ def test_randomized_chaos_converges(transport, seed):
         # Generous deadline: chaos interleavings are wall-clock
         # dependent and a loaded CI host starves the controller's
         # threads long before the engine is actually wedged.
-        wait_for(all_terminal, timeout=150.0)
+        try:
+            wait_for(all_terminal, timeout=150.0)
+        except AssertionError:
+            # Diagnostics: WHICH job is non-terminal and why — a timeout
+            # here is rare and load-dependent, so the failure must carry
+            # the state needed to debug it post-hoc.
+            state = []
+            for n in survivors:
+                try:
+                    j = cluster.tfjobs.get("default", n)
+                    state.append(
+                        f"{n}: phase={j.status.phase} "
+                        f"reason={j.status.reason!r} "
+                        f"replicas={[(str(rs.tf_replica_type), rs.replicas) for rs in j.spec.tf_replica_specs]}")
+                except Exception as e:
+                    state.append(f"{n}: GET failed: {e!r}")
+            slices = {k: (s.healthy, s.bound_gang)
+                      for k, s in inventory.slices.items()}
+            pods = [(p.metadata.name, p.status.phase)
+                    for p in cluster.pods.list("default")]
+            raise AssertionError(
+                "convergence timeout; non-terminal state:\n  "
+                + "\n  ".join(state)
+                + f"\nslices(healthy,bound)={slices}\npods={pods}")
 
         def deleted_gone():
             for n in deleted:
