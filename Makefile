@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast lint typecheck cov cov-local bench dryrun validate
+.PHONY: all test test-fast lint typecheck cov cov-local bench dryrun validate metrics-smoke
 
 all: lint test
 
@@ -59,6 +59,13 @@ validate:
 
 bench:
 	$(PY) bench.py
+
+# Observability smoke: boot the in-process cluster, run one job to
+# Succeeded, scrape GET /metrics over HTTP, and fail on any malformed
+# Prometheus exposition line or missing headline family
+# (docs/OBSERVABILITY.md has the metric catalogue).
+metrics-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m kubeflow_controller_tpu.obs.smoke
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

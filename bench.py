@@ -23,6 +23,7 @@ cannot be shared by concurrent worker processes.
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -34,7 +35,7 @@ sys.path.insert(0, REPO)
 BASELINE_S = 9.536664  # ref: docs/get_started.md:63 "Training elapsed time"
 
 
-def run_dist_mnist() -> dict:
+def run_dist_mnist(trace_dir: str = "") -> dict:
     from kubeflow_controller_tpu.api.core import Container, PodTemplateSpec
     from kubeflow_controller_tpu.api.meta import ObjectMeta
     from kubeflow_controller_tpu.api.tfjob import (
@@ -79,6 +80,10 @@ def run_dist_mnist() -> dict:
         c.env.append(EnvVar(name="JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
                             value="0.1"))
         c.env.append(EnvVar(name="WORKLOAD_AOT_CACHE", value=cache_dir))
+        if trace_dir:
+            # Workers dump their obs spans (rendezvous/init/fit) here; the
+            # bench merges them with the controller's spans at the end.
+            c.env.append(EnvVar(name="KCTPU_TRACE_DIR", value=trace_dir))
         t.spec.containers.append(c)
         t.spec.restart_policy = "OnFailure"
         return TFReplicaSpec(
@@ -107,23 +112,6 @@ def run_dist_mnist() -> dict:
     kubelet.start()
     ctrl.run(threadiness=2)
     kubelet.wait_warm()  # cluster warm-up (image-pull analog) precedes the job
-    phase_lines: list = []
-
-    def collect_phases(name: str) -> None:
-        # Worker-side phase breakdown (rendezvous/init/fit/total) from the
-        # warm-pool pod logs — shows where non-training wall time goes.
-        # Collected BEFORE the job is deleted (deletion reaps the logs);
-        # pool log names are "{ns}_{pod}-{rid}.out" (warmpool.py).
-        pool = getattr(kubelet, "_pool", None)
-        if pool is None:
-            return
-        import glob
-
-        for f in sorted(glob.glob(os.path.join(pool._tmpdir,
-                                               f"*{name}-*.out"))):
-            for ln in open(f, errors="replace"):
-                if ln.startswith("Phase times:"):
-                    phase_lines.append(f"{name}: {ln.strip()}")
 
     def run_job(name: str, deadline_s: float) -> float:
         """Create a judged-config job, wait for Succeeded, return elapsed;
@@ -143,8 +131,6 @@ def run_dist_mnist() -> dict:
             if phase != TFJobPhase.SUCCEEDED:
                 reason = j.status.reason if j is not None else "?"
                 raise RuntimeError(f"bench job {name} ended {phase}: {reason}")
-            if name.startswith("bench-dist-mnist"):
-                collect_phases(name)
         finally:
             # Always remove the job — a hung/failed warmup must not leave
             # pods occupying the slice while measured runs execute.
@@ -184,11 +170,56 @@ def run_dist_mnist() -> dict:
         shutil.rmtree(cache_dir, ignore_errors=True)
 
     return {"elapsed_s": elapsed, "runs": runs, "metrics": snap,
-            "warmup_ok": warmup_ok, "phases": phase_lines}
+            "warmup_ok": warmup_ok,
+            "phases": worker_phase_lines(trace_dir)}
 
 
-def main() -> int:
-    result = run_dist_mnist()
+def worker_phase_lines(trace_dir: str) -> list:
+    """Per-worker rendezvous/init/fit breakdown, read back from the span
+    dumps the workload processes wrote to ``trace_dir`` (replaces the old
+    pod-log "Phase times:" parsing)."""
+    if not trace_dir:
+        return []
+    from kubeflow_controller_tpu.obs import merge_trace_dir
+
+    phases = ("workload/rendezvous", "workload/init", "workload/fit")
+    by_pid: dict = {}
+    for ev in merge_trace_dir(trace_dir)["traceEvents"]:
+        if ev.get("name") in phases:
+            by_pid.setdefault(ev["pid"], {})[ev["name"]] = ev
+    lines = []
+    for pid in sorted(by_pid):
+        evs = by_pid[pid]
+        parts = [f"{n.split('/', 1)[1]}={evs[n]['dur'] / 1e6:.3f}s"
+                 for n in phases if n in evs]
+        lines.append(f"worker pid {pid}: " + " ".join(parts))
+    return lines
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="dist-mnist headline benchmark")
+    p.add_argument("--trace-out", default="", metavar="PATH",
+                   help="write a merged Chrome trace (controller reconcile "
+                        "spans + every worker's rendezvous/init/fit spans) "
+                        "to PATH, alongside the JSON result")
+    args = p.parse_args(argv)
+
+    import shutil
+    import tempfile
+
+    trace_dir = tempfile.mkdtemp(prefix="bench-trace-")
+    try:
+        result = run_dist_mnist(trace_dir)
+        if args.trace_out:
+            from kubeflow_controller_tpu.obs import TRACER, merge_trace_dir
+
+            doc = merge_trace_dir(trace_dir, tracer=TRACER)
+            with open(args.trace_out, "w") as fh:
+                json.dump(doc, fh)
+            print(f"trace: {len(doc['traceEvents'])} spans -> "
+                  f"{args.trace_out}", file=sys.stderr)
+    finally:
+        shutil.rmtree(trace_dir, ignore_errors=True)
     elapsed = result["elapsed_s"]
     print(json.dumps({
         "metric": "dist_mnist_tfjob_wallclock_to_succeeded",

@@ -297,6 +297,52 @@ def cmd_delete(args) -> int:
     return 0
 
 
+def cmd_metrics(args) -> int:
+    """Prometheus-text metrics: scraped from the API server's GET /metrics
+    in REST mode, or the local process registry otherwise (useful mostly
+    right after an in-process `run` in the same interpreter)."""
+    if args.kubeconfig or args.master:
+        cluster = _rest_cluster_or_die(args, probe=False)
+        if cluster is None:
+            return 2
+        try:
+            sys.stdout.write(cluster.metrics_text())
+        except APIError as e:
+            print(f"error talking to API server: {e}", file=sys.stderr)
+            return 2
+        return 0
+    from ..obs import REGISTRY
+
+    sys.stdout.write(REGISTRY.render())
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Chrome trace dump (load in chrome://tracing or ui.perfetto.dev):
+    the API server's span buffer in REST mode, the local tracer otherwise."""
+    if args.kubeconfig or args.master:
+        cluster = _rest_cluster_or_die(args, probe=False)
+        if cluster is None:
+            return 2
+        try:
+            doc = cluster.trace_events()
+        except APIError as e:
+            print(f"error talking to API server: {e}", file=sys.stderr)
+            return 2
+    else:
+        from ..obs import TRACER
+
+        doc = TRACER.chrome_trace()
+    out = json.dumps(doc)
+    if args.dump and args.dump != "-":
+        with open(args.dump, "w") as fh:
+            fh.write(out)
+        print(f"wrote {len(doc.get('traceEvents', []))} spans to {args.dump}")
+    else:
+        sys.stdout.write(out + "\n")
+    return 0
+
+
 def cmd_run(args) -> int:
     logging.basicConfig(
         level=logging.DEBUG if args.v >= 4 else logging.INFO,
@@ -309,6 +355,15 @@ def cmd_run(args) -> int:
         return 2
 
     stop = setup_signal_handler()
+    trace_dir = ""
+    if args.trace_out:
+        # Executed pods inherit this via the kubelet's env merge and dump
+        # their spans here; merged with the controller's own spans at exit.
+        import os
+        import tempfile
+
+        trace_dir = tempfile.mkdtemp(prefix="kctpu-trace-")
+        os.environ["KCTPU_TRACE_DIR"] = trace_dir
     kubelet = None
     if use_rest:
         # Real-cluster mode: BuildConfigFromFlags parity
@@ -354,6 +409,13 @@ def cmd_run(args) -> int:
         ctrl.stop()
         if kubelet is not None:
             kubelet.stop()
+        if args.trace_out:
+            from ..obs import TRACER, merge_trace_dir
+
+            doc = merge_trace_dir(trace_dir, tracer=TRACER)
+            with open(args.trace_out, "w") as fh:
+                json.dump(doc, fh)
+            print(f"trace: {len(doc['traceEvents'])} spans -> {args.trace_out}")
 
     rc = 0
     try:
@@ -428,6 +490,14 @@ def build_parser() -> argparse.ArgumentParser:
     de.add_argument("name")
     de.add_argument("-n", "--namespace", default="default")
 
+    sub.add_parser("metrics", help="print Prometheus-text metrics "
+                                   "(REST mode scrapes the server's /metrics)")
+
+    tr = sub.add_parser("trace", help="dump recorded spans as Chrome trace "
+                                      "JSON (REST mode reads /debug/traces)")
+    tr.add_argument("--dump", default="-", metavar="PATH",
+                    help="output file (default: stdout)")
+
     r = sub.add_parser("run", help="run the controller")
     r.add_argument("--in-memory", action="store_true",
                    help="run against the in-memory cluster substrate")
@@ -438,6 +508,9 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--until-done", action="store_true",
                    help="exit once every applied job reaches a terminal phase")
     r.add_argument("--events", action="store_true", help="print per-job events at exit")
+    r.add_argument("--trace-out", default="", metavar="PATH",
+                   help="write a merged Chrome trace (controller + executed "
+                        "pods) to PATH at exit")
     r.add_argument("--threadiness", type=int, default=2, help="sync workers (ref: 2)")
     r.add_argument("--resync-period", type=float, default=30.0, help="informer resync (ref: 30s)")
     r.add_argument("--sim-run-seconds", type=float, default=0.05,
@@ -478,6 +551,10 @@ def _main(argv=None) -> int:
         return cmd_logs(args)
     if args.cmd == "delete":
         return cmd_delete(args)
+    if args.cmd == "metrics":
+        return cmd_metrics(args)
+    if args.cmd == "trace":
+        return cmd_trace(args)
     if args.cmd == "run":
         return cmd_run(args)
     build_parser().print_help()

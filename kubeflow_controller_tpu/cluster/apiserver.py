@@ -131,13 +131,20 @@ class FakeAPIServer:
     """ThreadingHTTPServer over an ObjectStore; start() returns the URL."""
 
     def __init__(self, store: Optional[ObjectStore] = None, token: str = "",
-                 port: int = 0, kubelet=None):
+                 port: int = 0, kubelet=None, registry=None, tracer=None):
         self.store = store or ObjectStore()
         self.token = token
         self.port = port  # 0 = ephemeral
         # Optional node agent: enables the pod log subresource (the real
         # API server proxies /pods/{name}/log to the kubelet the same way).
         self.kubelet = kubelet
+        # Observability surface: GET /metrics renders this registry in
+        # Prometheus text exposition; GET /debug/traces dumps this tracer
+        # as Chrome trace JSON.  Defaults (None) bind the process-global
+        # obs registry/tracer, so in-process clusters expose controller +
+        # workqueue + lifecycle + trainer series with zero wiring.
+        self.registry = registry
+        self.tracer = tracer
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         # Watch-stream generation: drop_watches() bumps it and every live
@@ -186,6 +193,19 @@ class FakeAPIServer:
                 if self._deny():
                     return
                 u = urlparse(self.path)
+                if u.path == "/metrics" and method == "GET":
+                    data = outer.render_metrics().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                if u.path == "/debug/traces" and method == "GET":
+                    self._send(200, outer.trace_dump())
+                    return
                 try:
                     r = _route(u.path, u.query)
                 except APIError as e:
@@ -234,6 +254,24 @@ class FakeAPIServer:
         """Close every active watch stream (clients must reconnect and
         re-list).  Chaos/regression hook for the watch-gap path."""
         self._watch_gen += 1
+
+    # -- observability surface -------------------------------------------------
+
+    def render_metrics(self) -> str:
+        """Prometheus text exposition of the bound (default: global) registry."""
+        if self.registry is not None:
+            return self.registry.render()
+        from ..obs.metrics import REGISTRY
+
+        return REGISTRY.render()
+
+    def trace_dump(self) -> dict:
+        """Chrome trace JSON of the bound (default: global) tracer."""
+        if self.tracer is not None:
+            return self.tracer.chrome_trace()
+        from ..obs.trace import TRACER
+
+        return TRACER.chrome_trace()
 
     # -- request handling ------------------------------------------------------
 

@@ -527,6 +527,23 @@ class RestCluster:
         self.services = RestServiceClient(self.transport)
         self.events = RestEventClient(self.transport)
 
+    # -- observability surface (non-k8s paths on the same server) -----------
+
+    def metrics_text(self) -> str:
+        """GET /metrics — raw Prometheus text exposition (what a scraper
+        sees; served by the in-process API server's obs registry)."""
+        resp = self.transport._request("GET", "/metrics", stream=True)
+        try:
+            with resp:
+                return resp.read().decode(errors="replace")
+        except (OSError, http.client.HTTPException) as e:
+            raise APIError(f"reading /metrics: {e!r}") from None
+
+    def trace_events(self) -> dict:
+        """GET /debug/traces — the server process's span ring buffer as a
+        Chrome trace_event JSON document."""
+        return self.transport._request("GET", "/debug/traces")
+
     @staticmethod
     def from_flags(kubeconfig: str, master: str = "") -> "RestCluster":
         """BuildConfigFromFlags parity (ref: cmd/controller/main.go:47-60)."""

@@ -48,6 +48,7 @@ from ..api.tfjob import (
 from ..cluster.client import Cluster
 from ..cluster.store import Conflict, NotFound
 from ..cluster.tpu import TPUInventory
+from ..obs import trace
 from ..planner import plan_job
 from ..planner.materialize import gang_name, make_pod, make_service
 from ..planner.types import Action
@@ -89,6 +90,9 @@ class Controller:
         self.queue = RateLimitingQueue(name="tfJobs")
         self.expectations = ControllerExpectations()
         self.metrics = ReconcileMetrics()
+        # Prometheus surface: reconcile latency quantiles + op counters land
+        # on the process-global registry (served at GET /metrics).
+        self.metrics.register()
 
         self.tfjob_informer = SharedInformer(cluster.tfjobs, resync_period_s, "tfjobs")
         self.pod_informer = SharedInformer(cluster.pods, resync_period_s, "pods")
@@ -230,7 +234,14 @@ class Controller:
     # ----------------------------------------------------------------- sync
 
     def sync_handler(self, key: str) -> None:
-        """ref: syncTFJob at controller.go:264-357."""
+        """ref: syncTFJob at controller.go:264-357.  The whole sync runs
+        under a trace span; gather/manage/update_status nest inside it, so
+        a slow reconcile decomposes in the dump instead of being one
+        opaque latency sample."""
+        with trace.span("sync", key=key):
+            self._sync(key)
+
+    def _sync(self, key: str) -> None:
         ns, name = split_key(key)
         job = self.tfjob_informer.get(ns, name)
         if job is None:
@@ -253,7 +264,8 @@ class Controller:
         # BEFORE validation: a job whose spec went invalid after creation
         # must still be deletable, or it lingers forever.
         if deleting:
-            self._finalize_job(key, job)
+            with trace.span("sync/finalize", key=key):
+                self._finalize_job(key, job)
             return
 
         try:
@@ -344,6 +356,10 @@ class Controller:
         """Claim pods/services once at job scope, then partition by replica
         type (ref: controller.go:299-320 — but see api.labels.job_selector
         for why we claim once instead of per type)."""
+        with trace.span("sync/gather", job=job.metadata.name):
+            return self._gather_inner(job)
+
+    def _gather_inner(self, job: TFJob):
         selector = job_selector(job.metadata.name, job.spec.runtime_id)
         pods = self.helper.get_pods_for_tfjob(job, selector)
         services = self.helper.get_services_for_tfjob(job, selector)
@@ -361,7 +377,13 @@ class Controller:
 
     def _manage(self, key, job, pods_by_type, services_by_type) -> None:
         """Execute the plan (ref: manageTFJob at controller.go:359-445)."""
+        with trace.span("sync/manage", key=key) as sp:
+            self._manage_inner(key, job, pods_by_type, services_by_type, sp)
+
+    def _manage_inner(self, key, job, pods_by_type, services_by_type, sp) -> None:
         plan = plan_job(job, pods_by_type, services_by_type)
+        sp.args["creations"] = plan.creations
+        sp.args["deletions"] = plan.deletions
         if plan.empty:
             return
         self.expectations.expect(key, plan.creations, plan.deletions)
@@ -397,6 +419,10 @@ class Controller:
     def _update_status(self, job: TFJob, new_status) -> None:
         """Status write with conflict retry (the reference's bare Update with
         no retry is its known weakness, controller.go:643-649)."""
+        with trace.span("sync/update_status", job=job.metadata.name):
+            self._update_status_inner(job, new_status)
+
+    def _update_status_inner(self, job: TFJob, new_status) -> None:
         for attempt in range(MAX_STATUS_RETRIES):
             try:
                 fresh = self.cluster.tfjobs.get(job.metadata.namespace, job.metadata.name)

@@ -2,13 +2,16 @@
 
 The reference has no metrics at all (SURVEY.md §5: glog only); the driver's
 target metric includes reconcile p50 (BASELINE.json), so sync latency is
-recorded here and exposed via percentiles.
+recorded here and exposed via percentiles — and, via :meth:`register`,
+as a Prometheus summary + counters on the obs registry (``GET /metrics``).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, List
+from typing import Dict, List, Optional
+
+from ..obs import metrics as obs_metrics
 
 
 class ReconcileMetrics:
@@ -16,6 +19,7 @@ class ReconcileMetrics:
         self._lock = threading.Lock()
         self._samples: List[float] = []
         self._max = max_samples
+        self._sum = 0.0  # cumulative, survives sample-window truncation
         self.syncs = 0
         self.sync_errors = 0
         self.creates = 0
@@ -27,6 +31,7 @@ class ReconcileMetrics:
             self.syncs += 1
             if error:
                 self.sync_errors += 1
+            self._sum += duration_s
             self._samples.append(duration_s)
             if len(self._samples) > self._max:
                 self._samples = self._samples[-self._max :]
@@ -65,3 +70,52 @@ class ReconcileMetrics:
             "reconcile_p99_s": self.p99,
             "samples": n,
         }
+
+    # -- Prometheus exposition ----------------------------------------------
+
+    def register(self, registry: Optional[obs_metrics.Registry] = None,
+                 key: str = "reconcile") -> None:
+        """Expose this instance on the obs registry as a scrape-time
+        collector: a quantile summary (percentiles over the sample window)
+        plus cumulative counters.  Keyed, so the latest controller instance
+        in a process owns the families."""
+        reg = registry or obs_metrics.REGISTRY
+        reg.register_collector(key, self._families)
+
+    def _families(self) -> List[obs_metrics.Family]:
+        with self._lock:
+            samples = sorted(self._samples)
+            total = self._sum
+            syncs_n = self.syncs
+            counters = [
+                ("kctpu_controller_syncs_total", "Reconcile syncs executed",
+                 self.syncs),
+                ("kctpu_controller_sync_errors_total", "Reconcile syncs that raised",
+                 self.sync_errors),
+                ("kctpu_controller_creates_total", "Child pod/service creates",
+                 self.creates),
+                ("kctpu_controller_deletes_total", "Child pod/service deletes",
+                 self.deletes),
+                ("kctpu_controller_status_updates_total", "TFJob status writes",
+                 self.status_updates),
+            ]
+
+        def q(p: float) -> float:
+            if not samples:
+                return 0.0
+            return samples[min(len(samples) - 1, int(p * len(samples)))]
+
+        summary = obs_metrics.Family(
+            "kctpu_reconcile_duration_seconds", "summary",
+            "Reconcile sync latency (quantiles over the sample window)",
+            [obs_metrics.Sample("", {"quantile": "0.5"}, q(0.5)),
+             obs_metrics.Sample("", {"quantile": "0.9"}, q(0.9)),
+             obs_metrics.Sample("", {"quantile": "0.99"}, q(0.99)),
+             obs_metrics.Sample("_sum", {}, total),
+             obs_metrics.Sample("_count", {}, syncs_n)])
+        fams = [summary]
+        for name, help_text, value in counters:
+            fams.append(obs_metrics.Family(
+                name, "counter", help_text,
+                [obs_metrics.Sample("", {}, float(value))]))
+        return fams

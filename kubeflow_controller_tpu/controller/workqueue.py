@@ -11,7 +11,11 @@ pkg/controller/controller.go:132, 639):
   exponential backoff (base*2^failures up to a cap — the
   ItemExponentialFailureRateLimiter); ``forget`` resets the failure count
   on success (ref: controller.go:236-258 Forget-on-success / requeue-on-error);
-- **shutdown**: ``shut_down`` drains waiters; ``get`` raises ShutDown.
+- **shutdown**: ``shut_down`` drains waiters; ``get`` raises ShutDown;
+- **instrumentation** (client-go's workqueue metrics provider, which the
+  reference never wired): depth gauge, adds/retries/requeues counters, and
+  a queue-wait histogram (add→get latency), all labeled by queue name in
+  the process-global obs registry.
 """
 
 from __future__ import annotations
@@ -20,6 +24,35 @@ import heapq
 import threading
 import time
 from typing import Dict, List, Optional, Set
+
+from ..obs import metrics as obs_metrics
+
+
+class _QueueMetrics:
+    """Per-queue handles into the (shared, get-or-create) instruments."""
+
+    def __init__(self, name: str, registry: Optional[obs_metrics.Registry] = None):
+        reg = registry or obs_metrics.REGISTRY
+        self.depth = reg.gauge(
+            "kctpu_workqueue_depth",
+            "Items currently queued (not yet handed to a worker)",
+            labelnames=("name",)).labels(name=name)
+        self.adds = reg.counter(
+            "kctpu_workqueue_adds_total",
+            "Items accepted into the queue (dedup-collapsed adds excluded)",
+            labelnames=("name",)).labels(name=name)
+        self.retries = reg.counter(
+            "kctpu_workqueue_retries_total",
+            "Rate-limited re-adds after sync errors",
+            labelnames=("name",)).labels(name=name)
+        self.requeues = reg.counter(
+            "kctpu_workqueue_requeues_total",
+            "Items re-queued by done() because they went dirty mid-processing",
+            labelnames=("name",)).labels(name=name)
+        self.queue_wait = reg.histogram(
+            "kctpu_workqueue_queue_duration_seconds",
+            "Seconds an item waited in the queue before a worker took it",
+            labelnames=("name",)).labels(name=name)
 
 
 class ShutDown(Exception):
@@ -50,13 +83,17 @@ class ItemExponentialFailureRateLimiter:
 
 class RateLimitingQueue:
     def __init__(self, rate_limiter: Optional[ItemExponentialFailureRateLimiter] = None,
-                 name: str = "tfJobs"):
+                 name: str = "tfJobs",
+                 registry: Optional[obs_metrics.Registry] = None):
         self.name = name
         self._limiter = rate_limiter or ItemExponentialFailureRateLimiter()
+        self._metrics = _QueueMetrics(name, registry)
         self._cond = threading.Condition()
         self._queue: List[str] = []
         self._dirty: Set[str] = set()
         self._processing: Set[str] = set()
+        # Enqueue wall-clock per queued item, for the queue-wait histogram.
+        self._enqueued_at: Dict[str, float] = {}
         # (ready_time, seq, item) min-heap for delayed adds.
         self._waiting: List[tuple] = []
         self._seq = 0
@@ -73,9 +110,12 @@ class RateLimitingQueue:
             if self._shutting_down or item in self._dirty:
                 return
             self._dirty.add(item)
+            self._metrics.adds.inc()
             if item in self._processing:
                 return  # re-queued by done()
             self._queue.append(item)
+            self._enqueued_at.setdefault(item, time.time())
+            self._metrics.depth.set(len(self._queue))
             self._cond.notify()
 
     def get(self, timeout: Optional[float] = None) -> Optional[str]:
@@ -93,6 +133,10 @@ class RateLimitingQueue:
             item = self._queue.pop(0)
             self._processing.add(item)
             self._dirty.discard(item)
+            t_add = self._enqueued_at.pop(item, None)
+            self._metrics.depth.set(len(self._queue))
+            if t_add is not None:
+                self._metrics.queue_wait.observe(max(0.0, time.time() - t_add))
             return item
 
     def done(self, item: str) -> None:
@@ -100,11 +144,15 @@ class RateLimitingQueue:
             self._processing.discard(item)
             if item in self._dirty:
                 self._queue.append(item)
+                self._enqueued_at.setdefault(item, time.time())
+                self._metrics.depth.set(len(self._queue))
+                self._metrics.requeues.inc()
                 self._cond.notify()
 
     # -- rate limiting -------------------------------------------------------
 
     def add_rate_limited(self, item: str) -> None:
+        self._metrics.retries.inc()
         self.add_after(item, self._limiter.when(item))
 
     def add_after(self, item: str, delay: float) -> None:
@@ -134,8 +182,11 @@ class RateLimitingQueue:
                     _, _, item = heapq.heappop(self._waiting)
                     if item not in self._dirty and not self._shutting_down:
                         self._dirty.add(item)
+                        self._metrics.adds.inc()
                         if item not in self._processing:
                             self._queue.append(item)
+                            self._enqueued_at.setdefault(item, time.time())
+                            self._metrics.depth.set(len(self._queue))
                             self._cond.notify()
                 wait = 0.05
                 if self._waiting:

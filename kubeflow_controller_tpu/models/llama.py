@@ -227,6 +227,26 @@ def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
 
 
+_FLASH_FALLBACK_WARNED: set = set()
+
+
+def _warn_flash_fallback(t: int, dtype) -> None:
+    """One-time (per shape/dtype) warning when an explicit
+    ``attention="flash"`` request silently degrades to the dense XLA path
+    because ``flash_block() == 0`` (sequence not tile-aligned) — matching
+    the MoE grouped-dispatch fallback-warning discipline (ADVICE round 5)."""
+    key = (int(t), str(dtype))
+    if key in _FLASH_FALLBACK_WARNED:
+        return
+    _FLASH_FALLBACK_WARNED.add(key)
+    import warnings
+
+    warnings.warn(
+        f"attention='flash' requested but no legal flash tile exists for "
+        f"T={t} dtype={dtype} (flash_block()==0); falling back to the dense "
+        f"XLA attention path", stacklevel=3)
+
+
 def _attention(q, k, v, mesh: Optional[Mesh], causal: bool, rules: ShardingRules,
                cfg: Optional[LlamaConfig] = None):
     """Sequence-parallel attention (ring or Ulysses per cfg.sp_attention)
@@ -261,6 +281,8 @@ def _attention(q, k, v, mesh: Optional[Mesh], causal: bool, rules: ShardingRules
                              or (cfg.attention == "auto"
                                  and jax.default_backend() == "tpu"
                                  and t >= 1024))
+                if cfg.attention == "flash" and not block:
+                    _warn_flash_fallback(t, qg.dtype)
                 if use_flash and block:
                     from ..ops.attention import flash_attention
 
@@ -308,6 +330,8 @@ def _flash_path(q, k, v, mesh: Optional[Mesh], causal: bool,
     t = q.shape[1]
     block = flash_block(t, q.dtype)
     if not block:
+        if cfg.attention == "flash":
+            _warn_flash_fallback(t, q.dtype)
         return None
     if cfg.attention == "auto" and (
         t < 1024 or jax.default_backend() != "tpu"

@@ -205,8 +205,20 @@ def moe_ffn_stats(
         else:
             n_loc, f_loc = B * T * top_k, F
         grain = 8 if dtype == jnp.float32 else 16
+        # block_m drives halving loops (bm_chk below, bm_l in
+        # _grouped_ffn_sharded) that assume a power of two: a value like 300
+        # halves through odd/sub-tile sizes (300->75->...) and produces
+        # Pallas grids that fail Mosaic compilation instead of taking this
+        # fallback.  Round down to a power of two before the divisibility
+        # checks; a value below the dtype's sublane tile cannot form a
+        # legal tile at all, so it falls back to einsum (ADVICE round 5).
+        if block_m > 0:
+            block_m = 1 << (block_m.bit_length() - 1)
         if why:
             pass
+        elif block_m < grain:
+            why = (f"block_m={block_m} below the {grain}-row sublane tile "
+                   f"for {dtype} (must be a power of two >= the tile)")
         elif D % 128 or f_loc % 128:
             why = f"dims not multiples of 128 (D={D}, local F={f_loc})"
         elif n_loc % grain:

@@ -1,0 +1,38 @@
+"""Cross-cutting observability: span tracing + Prometheus-style metrics.
+
+The reference controller's only observability channels are glog lines, k8s
+Events, and ``TFJob.Status`` (SURVEY.md §5).  This package is the
+measurement substrate the ROADMAP's perf work reports against:
+
+- :mod:`.trace` — a lightweight thread-safe span tracer (ring-buffered,
+  queryable by tests, dumpable as Chrome ``trace_event`` JSON) wired
+  through the reconcile loop and the workload launch path;
+- :mod:`.metrics` — counters/gauges/histograms plus a registry that
+  renders everything in Prometheus text exposition format (served as
+  ``GET /metrics`` by the in-process API server);
+- :mod:`.lifecycle` — per-job phase-transition histograms
+  (Pending→Running→Succeeded), fed by the status updater.
+
+Everything is stdlib-only and safe to import from any layer (no imports
+back into controller/cluster/workloads).
+"""
+
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    REGISTRY,
+    validate_exposition,
+)
+from .trace import (  # noqa: F401
+    Span,
+    Tracer,
+    TRACER,
+    TRACE_DIR_ENV,
+    dump_to_env_dir,
+    load_trace_events,
+    merge_trace_dir,
+    span,
+)
+from .lifecycle import JobLifecycle, job_lifecycle  # noqa: F401

@@ -1,0 +1,94 @@
+"""Per-job lifecycle histograms: how long jobs spend in each phase.
+
+The status updater (updater/status.py) reports every phase transition it
+computes here; the tracker remembers when each job entered its current
+phase and, on transition, observes the dwell time into
+``kctpu_job_phase_transition_seconds{from_phase,to_phase}``.  The
+"Pending" clock starts at the job's ``creationTimestamp`` when known, so
+Pending→Running measures the real schedule+start latency, not just the
+interval between two syncs.
+
+Keyed on job UID and deduplicated against the *stored* phase: the
+controller recomputes status every sync (often with a stale informer
+view), so the same transition may be computed repeatedly before the write
+lands — only the first observation counts.  Terminal jobs drop their
+entry; the table is additionally capacity-bounded so a controller that
+churns jobs forever cannot grow it without bound.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from .metrics import REGISTRY, Registry
+
+TERMINAL_PHASES = ("Succeeded", "Failed")
+
+# Job lifetimes span ms (simulated pods) to hours (real training):
+# wider-than-default top end.
+_PHASE_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                  10.0, 30.0, 60.0, 120.0, 300.0, 600.0, 1800.0, 3600.0)
+
+
+class JobLifecycle:
+    def __init__(self, registry: Optional[Registry] = None,
+                 max_jobs: int = 4096):
+        reg = registry or REGISTRY
+        self._hist = reg.histogram(
+            "kctpu_job_phase_transition_seconds",
+            "Seconds a TFJob spent in from_phase before entering to_phase",
+            labelnames=("from_phase", "to_phase"), buckets=_PHASE_BUCKETS)
+        self._transitions = reg.counter(
+            "kctpu_job_phase_transitions_total",
+            "TFJob phase transitions observed by the status updater",
+            labelnames=("from_phase", "to_phase"))
+        self._lock = threading.Lock()
+        self._max = max_jobs
+        # uid -> (current phase, entered-at wall clock)
+        self._since: Dict[str, Tuple[str, float]] = {}
+
+    def observe(self, uid: str, prev_phase: str, new_phase: str,
+                now: Optional[float] = None,
+                created: Optional[float] = None) -> None:
+        """Report that ``uid`` was computed to move prev_phase→new_phase."""
+        if not uid or new_phase == prev_phase:
+            return
+        t = now if now is not None else time.time()
+        with self._lock:
+            phase, since = self._since.get(uid, (None, None))
+            if phase is None:
+                # First sighting: treat creation as the start of the initial
+                # phase ("None"/"Pending" both mean "not yet running").
+                phase = prev_phase
+                since = created if created is not None else t
+            if phase == new_phase:
+                return  # recomputed transition (stale informer view)
+            dwell = max(0.0, t - since)
+            if new_phase in TERMINAL_PHASES:
+                self._since.pop(uid, None)
+            else:
+                if uid not in self._since and len(self._since) >= self._max:
+                    # Bounded: evict the oldest entry (insertion order).
+                    self._since.pop(next(iter(self._since)))
+                self._since[uid] = (new_phase, t)
+        self._hist.labels(from_phase=phase, to_phase=new_phase).observe(dwell)
+        self._transitions.labels(from_phase=phase, to_phase=new_phase).inc()
+
+    def tracked(self) -> int:
+        with self._lock:
+            return len(self._since)
+
+
+_DEFAULT: Optional[JobLifecycle] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def job_lifecycle() -> JobLifecycle:
+    """The process-global tracker (bound to the global registry)."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = JobLifecycle()
+        return _DEFAULT

@@ -1,0 +1,514 @@
+"""Prometheus-style instruments and text exposition.
+
+In-process analog of the client_golang registry the reference never had
+(SURVEY.md §5: glog only).  Three instrument kinds — Counter, Gauge,
+Histogram — register themselves in a :class:`Registry` whose ``render()``
+emits the Prometheus text exposition format (version 0.0.4): ``# HELP`` /
+``# TYPE`` headers, escaped label values, cumulative ``_bucket``/``_sum``/
+``_count`` histogram series.  Subsystems that keep their own state (e.g.
+controller.metrics.ReconcileMetrics) plug in as *collectors* — callables
+returning :class:`Family` objects at scrape time.
+
+Get-or-create semantics: asking a registry for an existing metric name
+returns the existing instrument (type/labels must match), so components
+that are constructed repeatedly in one process (controllers in tests,
+multiple workqueues) share series instead of colliding.
+
+``validate_exposition`` is a strict line-level checker used by the
+``make metrics-smoke`` target and the test suite.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# Latency-shaped default buckets: 1ms .. 60s, the range reconcile syncs and
+# queue waits actually land in (BASELINE reconcile p50 ~1.2ms; rendezvous
+# stalls were ~1s).
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def escape_label_value(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def escape_help(h: str) -> str:
+    return str(h).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(v: float) -> str:
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(float(v))
+
+
+@dataclass
+class Sample:
+    """One exposition line: ``name+suffix{labels} value``."""
+
+    suffix: str
+    labels: Dict[str, str]
+    value: float
+
+
+@dataclass
+class Family:
+    """One metric family: the unit of HELP/TYPE plus its samples."""
+
+    name: str
+    typ: str  # counter | gauge | histogram | summary | untyped
+    help: str
+    samples: List[Sample] = field(default_factory=list)
+
+    def render(self) -> str:
+        out = [f"# HELP {self.name} {escape_help(self.help)}",
+               f"# TYPE {self.name} {self.typ}"]
+        for s in self.samples:
+            label_str = ""
+            if s.labels:
+                inner = ",".join(
+                    f'{k}="{escape_label_value(v)}"' for k, v in s.labels.items())
+                label_str = "{" + inner + "}"
+            out.append(f"{self.name}{s.suffix}{label_str} {_fmt(s.value)}")
+        return "\n".join(out)
+
+
+class _Instrument:
+    typ = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        for ln in labelnames:
+            if not _LABEL_RE.match(ln) or ln.startswith("__"):
+                raise ValueError(f"invalid label name {ln!r} on {name}")
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+
+    def _key(self, labelvalues: Sequence[str], kv: Dict[str, str]) -> Tuple[str, ...]:
+        if kv:
+            if labelvalues:
+                raise ValueError("pass label values positionally or by name, not both")
+            if set(kv) != set(self.labelnames):
+                raise ValueError(
+                    f"{self.name}: labels {sorted(kv)} != declared {list(self.labelnames)}")
+            labelvalues = [kv[ln] for ln in self.labelnames]
+        if len(labelvalues) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: got {len(labelvalues)} label values for "
+                f"{len(self.labelnames)} labels {list(self.labelnames)}")
+        return tuple(str(v) for v in labelvalues)
+
+    def _labels_dict(self, key: Tuple[str, ...]) -> Dict[str, str]:
+        return dict(zip(self.labelnames, key))
+
+    def collect(self) -> Family:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+
+class _BoundCounter:
+    def __init__(self, parent: "Counter", key: Tuple[str, ...]):
+        self._parent = parent
+        self._key = key
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._parent._inc(self._key, amount)
+
+    @property
+    def value(self) -> float:
+        with self._parent._lock:
+            return self._parent._values.get(self._key, 0.0)
+
+
+class Counter(_Instrument):
+    """Monotonically increasing value; negative increments raise."""
+
+    typ = "counter"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
+        super().__init__(name, help, labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+        if not self.labelnames:
+            self._values[()] = 0.0
+
+    def labels(self, *labelvalues, **kv) -> _BoundCounter:
+        return _BoundCounter(self, self._key(labelvalues, kv))
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._inc(self._key((), {}), amount)
+
+    def _inc(self, key: Tuple[str, ...], amount: float) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease (inc {amount})")
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._values.get((), 0.0)
+
+    def collect(self) -> Family:
+        with self._lock:
+            items = sorted(self._values.items())
+        return Family(self.name, self.typ, self.help, [
+            Sample("", self._labels_dict(k), v) for k, v in items])
+
+
+class _BoundGauge:
+    def __init__(self, parent: "Gauge", key: Tuple[str, ...]):
+        self._parent = parent
+        self._key = key
+
+    def set(self, v: float) -> None:
+        self._parent._set(self._key, v)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._parent._add(self._key, amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._parent._add(self._key, -amount)
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        self._parent._set_fn(self._key, fn)
+
+    @property
+    def value(self) -> float:
+        with self._parent._lock:
+            fn = self._parent._fns.get(self._key)
+            if fn is not None:
+                return float(fn())
+            return self._parent._values.get(self._key, 0.0)
+
+
+class Gauge(_Instrument):
+    """Settable value; optionally backed by a callback sampled at scrape."""
+
+    typ = "gauge"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
+        super().__init__(name, help, labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._fns: Dict[Tuple[str, ...], Callable[[], float]] = {}
+        if not self.labelnames:
+            self._values[()] = 0.0
+
+    def labels(self, *labelvalues, **kv) -> _BoundGauge:
+        return _BoundGauge(self, self._key(labelvalues, kv))
+
+    def set(self, v: float) -> None:
+        self._set((), v)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._add((), amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._add((), -amount)
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        self._set_fn((), fn)
+
+    def _set(self, key: Tuple[str, ...], v: float) -> None:
+        with self._lock:
+            self._values[key] = float(v)
+
+    def _add(self, key: Tuple[str, ...], amount: float) -> None:
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def _set_fn(self, key: Tuple[str, ...], fn: Callable[[], float]) -> None:
+        with self._lock:
+            self._fns[key] = fn
+            self._values.setdefault(key, 0.0)
+
+    @property
+    def value(self) -> float:
+        return _BoundGauge(self, ()).value
+
+    def collect(self) -> Family:
+        with self._lock:
+            keys = sorted(set(self._values) | set(self._fns))
+            fns = dict(self._fns)
+            values = dict(self._values)
+        samples = []
+        for k in keys:
+            fn = fns.get(k)
+            try:
+                v = float(fn()) if fn is not None else values.get(k, 0.0)
+            except Exception:
+                v = values.get(k, 0.0)  # a dead callback must not break scrape
+            samples.append(Sample("", self._labels_dict(k), v))
+        return Family(self.name, self.typ, self.help, samples)
+
+
+class _HistState:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * n_buckets  # per-bucket (non-cumulative) counts
+        self.sum = 0.0
+        self.count = 0
+
+
+class _BoundHistogram:
+    def __init__(self, parent: "Histogram", key: Tuple[str, ...]):
+        self._parent = parent
+        self._key = key
+
+    def observe(self, v: float) -> None:
+        self._parent._observe(self._key, v)
+
+    @property
+    def count(self) -> int:
+        with self._parent._lock:
+            st = self._parent._states.get(self._key)
+            return st.count if st else 0
+
+    @property
+    def sum(self) -> float:
+        with self._parent._lock:
+            st = self._parent._states.get(self._key)
+            return st.sum if st else 0.0
+
+
+class Histogram(_Instrument):
+    """Cumulative-bucket histogram (``le`` upper bounds, +Inf implicit)."""
+
+    typ = "histogram"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = (),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames)
+        bs = sorted(float(b) for b in buckets)
+        if not bs:
+            raise ValueError(f"{name}: need at least one bucket")
+        if math.isinf(bs[-1]):
+            bs = bs[:-1]  # +Inf is implicit
+        self.buckets = tuple(bs)
+        self._states: Dict[Tuple[str, ...], _HistState] = {}
+        if not self.labelnames:
+            self._states[()] = _HistState(len(self.buckets) + 1)
+
+    def labels(self, *labelvalues, **kv) -> _BoundHistogram:
+        return _BoundHistogram(self, self._key(labelvalues, kv))
+
+    def observe(self, v: float) -> None:
+        self._observe(self._key((), {}), v)
+
+    def _observe(self, key: Tuple[str, ...], v: float) -> None:
+        v = float(v)
+        i = len(self.buckets)  # +Inf slot
+        for j, b in enumerate(self.buckets):
+            if v <= b:
+                i = j
+                break
+        with self._lock:
+            st = self._states.get(key)
+            if st is None:
+                st = self._states[key] = _HistState(len(self.buckets) + 1)
+            st.counts[i] += 1
+            st.sum += v
+            st.count += 1
+
+    @property
+    def count(self) -> int:
+        return _BoundHistogram(self, ()).count
+
+    @property
+    def sum(self) -> float:
+        return _BoundHistogram(self, ()).sum
+
+    def collect(self) -> Family:
+        with self._lock:
+            snap = {k: (list(st.counts), st.sum, st.count)
+                    for k, st in sorted(self._states.items())}
+        samples = []
+        for k, (counts, total, count) in snap.items():
+            base = self._labels_dict(k)
+            acc = 0
+            for b, c in zip(self.buckets, counts):
+                acc += c
+                samples.append(Sample("_bucket", {**base, "le": _fmt(b)}, acc))
+            samples.append(Sample("_bucket", {**base, "le": "+Inf"}, count))
+            samples.append(Sample("_sum", base, total))
+            samples.append(Sample("_count", base, count))
+        return Family(self.name, self.typ, self.help, samples)
+
+
+class Registry:
+    """Named instruments + pluggable collectors, rendered as one page."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Instrument] = {}
+        self._collectors: Dict[str, Callable[[], Iterable[Family]]] = {}
+
+    # -- get-or-create instruments -------------------------------------------
+
+    def _get_or_create(self, cls, name: str, help: str,
+                       labelnames: Sequence[str], **kw):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls) or existing.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name} already registered as "
+                        f"{type(existing).__name__}{existing.labelnames}")
+                return existing
+            m = cls(name, help, labelnames, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str,
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str,
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str, labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    # -- collectors ----------------------------------------------------------
+
+    def register_collector(self, key: str,
+                           fn: Callable[[], Iterable[Family]]) -> None:
+        """Register (or replace — same key) a scrape-time family producer.
+        Keyed replacement keeps repeatedly-constructed components (a new
+        Controller per test) from stacking duplicate families."""
+        with self._lock:
+            self._collectors[key] = fn
+
+    def unregister_collector(self, key: str) -> None:
+        with self._lock:
+            self._collectors.pop(key, None)
+
+    # -- exposition ----------------------------------------------------------
+
+    def families(self) -> List[Family]:
+        with self._lock:
+            metrics = list(self._metrics.values())
+            collectors = list(self._collectors.values())
+        fams = [m.collect() for m in metrics]
+        for fn in collectors:
+            try:
+                fams.extend(fn())
+            except Exception:
+                continue  # one broken collector must not break the scrape
+        return sorted(fams, key=lambda f: f.name)
+
+    def render(self) -> str:
+        return "\n".join(f.render() for f in self.families()) + "\n"
+
+
+#: Process-global default registry — what ``GET /metrics`` serves.
+REGISTRY = Registry()
+
+
+# ---------------------------------------------------------------------------
+# Exposition validation (make metrics-smoke / tests)
+# ---------------------------------------------------------------------------
+
+_COMMENT_RE = re.compile(r"^# (HELP|TYPE) ([a-zA-Z_:][a-zA-Z0-9_:]*)( (.*))?$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r" (?P<value>[^ ]+)"
+    r"( (?P<ts>-?[0-9]+))?$")
+_LABEL_PAIR_RE = re.compile(
+    r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\\\|\\"|\\n)*"$')
+_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+_SUFFIXES = ("_bucket", "_sum", "_count", "_total")
+
+
+def _base_name(sample_name: str, typed: Dict[str, str]) -> str:
+    if sample_name in typed:
+        return sample_name
+    for suf in _SUFFIXES:
+        if sample_name.endswith(suf) and sample_name[: -len(suf)] in typed:
+            return sample_name[: -len(suf)]
+    return sample_name
+
+
+def validate_exposition(text: str) -> List[str]:
+    """Line-level structural validation of Prometheus text exposition.
+    Returns a list of problems (empty == valid)."""
+    problems: List[str] = []
+    typed: Dict[str, str] = {}
+    seen_series: set = set()
+    for i, line in enumerate(text.split("\n"), 1):
+        if line == "":
+            continue
+        if line.startswith("#"):
+            m = _COMMENT_RE.match(line)
+            if m is None:
+                if line.startswith(("# HELP", "# TYPE")):
+                    problems.append(f"line {i}: malformed comment: {line!r}")
+                continue  # plain comments are legal
+            if m.group(1) == "TYPE":
+                typ = (m.group(4) or "").strip()
+                if typ not in _TYPES:
+                    problems.append(f"line {i}: unknown TYPE {typ!r}")
+                if m.group(2) in typed:
+                    problems.append(f"line {i}: duplicate TYPE for {m.group(2)}")
+                typed[m.group(2)] = typ
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            problems.append(f"line {i}: unparseable sample: {line!r}")
+            continue
+        raw_labels = m.group("labels")
+        labels = {}
+        if raw_labels:
+            # Split on commas outside quotes.
+            parts, depth, cur = [], False, ""
+            prev = ""
+            for ch in raw_labels:
+                if ch == '"' and prev != "\\":
+                    depth = not depth
+                if ch == "," and not depth:
+                    parts.append(cur)
+                    cur = ""
+                else:
+                    cur += ch
+                prev = ch
+            if cur:
+                parts.append(cur)
+            for p in parts:
+                if not _LABEL_PAIR_RE.match(p.strip()):
+                    problems.append(f"line {i}: malformed label pair {p!r}")
+                    continue
+                k, v = p.strip().split("=", 1)
+                labels[k] = v
+        val = m.group("value")
+        if val not in ("+Inf", "-Inf", "NaN"):
+            try:
+                float(val)
+            except ValueError:
+                problems.append(f"line {i}: bad value {val!r}")
+        base = _base_name(m.group("name"), typed)
+        if base not in typed and m.group("name") not in typed:
+            problems.append(f"line {i}: sample {m.group('name')} has no TYPE")
+        series = (m.group("name"), tuple(sorted(labels.items())))
+        if series in seen_series:
+            problems.append(f"line {i}: duplicate series {series[0]}{dict(labels)}")
+        seen_series.add(series)
+        typ = typed.get(base)
+        if typ == "histogram" and m.group("name") == base + "_bucket" and "le" not in labels:
+            problems.append(f"line {i}: histogram bucket without le label")
+    return problems

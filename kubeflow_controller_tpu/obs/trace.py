@@ -1,0 +1,201 @@
+"""Lightweight span tracer with Chrome ``trace_event`` export.
+
+Answers the question round 5 spent a whole cycle bisecting by hand
+(BASELINE.md's ~1s rendezvous stall): *where* does a slow reconcile or a
+bimodal job start spend its time?  Spans are recorded into a thread-safe
+ring buffer (old spans fall off; tracing never grows unbounded), are
+queryable by tests (:meth:`Tracer.spans`), and dump as Chrome
+``chrome://tracing`` / Perfetto-loadable JSON.
+
+Cross-process collection: workload processes (pods) dump their spans to
+``$KCTPU_TRACE_DIR/trace-<pid>-<nonce>.json`` — explicitly via
+:func:`dump_to_env_dir` at the end of a workload's ``main`` (the warm-pool
+zygote exits children through ``os._exit``, which skips ``atexit``), with
+an ``atexit`` fallback for plainly-spawned processes.  ``bench.py`` and
+``kctpu run --trace-out`` merge those files with the controller process's
+own spans into one timeline (wall-clock timestamps align processes).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+TRACE_DIR_ENV = "KCTPU_TRACE_DIR"
+
+
+@dataclass
+class Span:
+    """One completed (or in-flight, inside ``with``) span."""
+
+    name: str
+    ts: float = 0.0            # wall-clock start, seconds since epoch
+    dur: float = 0.0           # seconds (perf_counter delta)
+    pid: int = 0
+    tid: int = 0
+    parent: str = ""           # enclosing span's name ("" at top level)
+    args: Dict[str, Any] = field(default_factory=dict)
+
+    def to_event(self) -> Dict[str, Any]:
+        """Chrome trace_event "complete" (ph=X) event, microseconds."""
+        ev = {
+            "name": self.name,
+            "ph": "X",
+            "ts": self.ts * 1e6,
+            "dur": self.dur * 1e6,
+            "pid": self.pid,
+            "tid": self.tid,
+            "cat": self.name.split("/", 1)[0],
+        }
+        args = dict(self.args)
+        if self.parent:
+            args["parent"] = self.parent
+        if args:
+            ev["args"] = args
+        return ev
+
+
+class Tracer:
+    def __init__(self, capacity: int = 8192):
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=capacity)
+        self._local = threading.local()
+
+    @property
+    def capacity(self) -> int:
+        return self._spans.maxlen
+
+    def _stack(self) -> List[str]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    @contextmanager
+    def span(self, name: str, **args) -> Iterator[Span]:
+        """Record a span around the ``with`` body.  Yields the Span object;
+        its ``dur`` is final after the block exits, and extra attributes can
+        be added to ``span.args`` from inside the block."""
+        stack = self._stack()
+        sp = Span(name=name, ts=time.time(), pid=os.getpid(),
+                  tid=threading.get_ident(),
+                  parent=stack[-1] if stack else "", args=args)
+        stack.append(name)
+        t0 = time.perf_counter()
+        try:
+            yield sp
+        finally:
+            sp.dur = time.perf_counter() - t0
+            stack.pop()
+            with self._lock:
+                self._spans.append(sp)
+
+    # -- queries -------------------------------------------------------------
+
+    def spans(self, prefix: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            out = list(self._spans)
+        if prefix is not None:
+            out = [s for s in out if s.name.startswith(prefix)]
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    # -- export --------------------------------------------------------------
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        return {
+            "traceEvents": [s.to_event() for s in self.spans()],
+            "displayTimeUnit": "ms",
+        }
+
+    def dump(self, path: str) -> None:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(self.chrome_trace(), fh)
+        os.replace(tmp, path)
+
+
+#: Process-global default tracer.
+TRACER = Tracer()
+
+
+@contextmanager
+def span(name: str, **args) -> Iterator[Span]:
+    """``with obs.span("sync/gather", key=key): ...`` on the global tracer."""
+    with TRACER.span(name, **args) as sp:
+        yield sp
+
+
+# ---------------------------------------------------------------------------
+# Cross-process dump/merge
+# ---------------------------------------------------------------------------
+
+def dump_to_env_dir(tracer: Optional[Tracer] = None) -> Optional[str]:
+    """Dump the tracer to ``$KCTPU_TRACE_DIR`` (unique file per process);
+    no-op (returns None) when the env var is unset or nothing was traced."""
+    # `is None`, not `or`: an empty Tracer is falsy (len 0) but still the
+    # caller's tracer — `or` would silently dump the global one instead.
+    t = TRACER if tracer is None else tracer
+    d = os.environ.get(TRACE_DIR_ENV, "")
+    if not d or len(t) == 0:
+        return None
+    try:
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"trace-{os.getpid()}-{uuid.uuid4().hex[:8]}.json")
+        t.dump(path)
+        return path
+    except OSError:
+        return None  # tracing must never fail the workload
+
+
+def load_trace_events(path: str) -> List[Dict[str, Any]]:
+    """Read one Chrome trace JSON file's event list ([] on any damage)."""
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return []
+    if isinstance(doc, list):  # bare-array Chrome trace flavor
+        return [e for e in doc if isinstance(e, dict)]
+    evs = doc.get("traceEvents") if isinstance(doc, dict) else None
+    return [e for e in evs if isinstance(e, dict)] if isinstance(evs, list) else []
+
+
+def merge_trace_dir(trace_dir: str,
+                    tracer: Optional[Tracer] = None) -> Dict[str, Any]:
+    """One Chrome trace document from every per-process dump in
+    ``trace_dir`` plus (optionally) a live tracer's spans."""
+    events: List[Dict[str, Any]] = []
+    if trace_dir and os.path.isdir(trace_dir):
+        for name in sorted(os.listdir(trace_dir)):
+            if name.startswith("trace-") and name.endswith(".json"):
+                events.extend(load_trace_events(os.path.join(trace_dir, name)))
+    if tracer is not None:
+        events.extend(s.to_event() for s in tracer.spans())
+    events.sort(key=lambda e: e.get("ts", 0))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _atexit_dump() -> None:  # pragma: no cover - exercised in subprocesses
+    try:
+        dump_to_env_dir()
+    except Exception:
+        pass
+
+
+atexit.register(_atexit_dump)

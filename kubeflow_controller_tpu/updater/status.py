@@ -202,6 +202,19 @@ def compute_status(
         phase = prev_phase
     status.phase = phase
 
+    # Lifecycle telemetry: report the computed transition to the obs
+    # tracker (dedup'd there — the controller recomputes status every sync,
+    # often from a stale informer view, and only the first observation of a
+    # transition may count).  Pure-function contract preserved: this is a
+    # side channel, the returned status is unchanged.
+    if phase != prev_phase:
+        from ..obs import job_lifecycle
+
+        job_lifecycle().observe(
+            job.metadata.uid or f"{job.metadata.namespace}/{job.metadata.name}",
+            prev_phase.value, phase.value, now=now,
+            created=job.metadata.creation_timestamp)
+
     # -- conditions (populating types.go:154-161) --
     # The READY message carries the structured health report (checker/
     # health.py) so `describe` and the status surface tell one story.

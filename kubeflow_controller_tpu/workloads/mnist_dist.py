@@ -76,96 +76,103 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
 
     from ..models import mnist as m
+    from ..obs import trace as obs_trace
     from ..parallel import AXIS_DATA, MeshSpec, build_mesh
     from . import data as d
     from .runtime import JobRuntime
     from .trainer import default_optimizer, numpy_opt_state, train_scan_dist
 
+    # Launch-path phases as obs spans (the single source of truth for the
+    # phase breakdown: the "Phase times:" line below and bench.py's
+    # --trace-out dump both come from these).
     t_start = time.time()
-    rt = JobRuntime.from_env()
-    rt.merge_tf_args(args.job_name, args.task_index, args.worker_hosts)
-    rt.initialize()
-    t_rendezvous = time.time()
+    with obs_trace.span("workload/rendezvous",
+                        task_index=args.task_index) as sp_rdv:
+        rt = JobRuntime.from_env()
+        rt.merge_tf_args(args.job_name, args.task_index, args.worker_hosts)
+        rt.initialize()
 
     # One global mesh over every process's devices: classic Worker gangs and
     # TPU slices land on the same code path.
     pc, proc = jax.process_count(), jax.process_index()
-    mesh = build_mesh(MeshSpec(dp=-1, fsdp=1))
+    with obs_trace.span("workload/init", process=proc) as sp_init:
+        mesh = build_mesh(MeshSpec(dp=-1, fsdp=1))
 
-    # Int seed, not PRNGKey: as_seed(PRNGKey(0)) == 0, and building even
-    # one key costs a threefry jit compile this process never needs.
-    params = m.mlp_init(0)  # same seed -> same init everywhere
-    opt = default_optimizer(args.lr)
-    # Host-numpy optimizer state (identical to opt.init for the default
-    # chain — see trainer.numpy_opt_state): skips the init-time jit
-    # cascade that rivals this worker's whole training run.
-    opt_state = numpy_opt_state(opt, params)
+        # Int seed, not PRNGKey: as_seed(PRNGKey(0)) == 0, and building even
+        # one key costs a threefry jit compile this process never needs.
+        params = m.mlp_init(0)  # same seed -> same init everywhere
+        opt = default_optimizer(args.lr)
+        # Host-numpy optimizer state (identical to opt.init for the default
+        # chain — see trainer.numpy_opt_state): skips the init-time jit
+        # cascade that rivals this worker's whole training run.
+        opt_state = numpy_opt_state(opt, params)
 
-    # Round the global batch down to a multiple of the data-parallel size
-    # (the reference's batch 100 over e.g. 8 devices -> 96 per step).
-    dp = mesh.shape[AXIS_DATA]
-    bs = max(dp, args.batch_size - args.batch_size % dp)
-    local_bs = bs // dp
-    # Dataset = train_size samples revisited epoch-by-epoch, regenerated
-    # identically on every shard in-program (see synthetic_mnist_traced);
-    # each shard slices its columns of every batch.
-    spe = max(1, args.train_size // bs)  # steps per epoch
-    eval_local = max(1, args.eval_size // dp)
-    # Host numpy on purpose: the traced generator closes over it as a
-    # compile-time constant; an eager jnp.asarray would pay a device_put
-    # plus its tiny-jit before the program even starts.
-    means = d.mnist_teacher_means()
+        # Round the global batch down to a multiple of the data-parallel size
+        # (the reference's batch 100 over e.g. 8 devices -> 96 per step).
+        dp = mesh.shape[AXIS_DATA]
+        bs = max(dp, args.batch_size - args.batch_size % dp)
+        local_bs = bs // dp
+        # Dataset = train_size samples revisited epoch-by-epoch, regenerated
+        # identically on every shard in-program (see synthetic_mnist_traced);
+        # each shard slices its columns of every batch.
+        spe = max(1, args.train_size // bs)  # steps per epoch
+        eval_local = max(1, args.eval_size // dp)
+        # Host numpy on purpose: the traced generator closes over it as a
+        # compile-time constant; an eager jnp.asarray would pay a device_put
+        # plus its tiny-jit before the program even starts.
+        means = d.mnist_teacher_means()
 
-    def local_batches(i):
-        x, y = d.synthetic_mnist_traced(1, spe * bs, means)
-        x = x.reshape(spe, bs, m.IMAGE_PIXELS)
-        y = y.reshape(spe, bs)
-        return (jax.lax.dynamic_slice_in_dim(x, i * local_bs, local_bs, axis=1),
-                jax.lax.dynamic_slice_in_dim(y, i * local_bs, local_bs, axis=1))
+        def local_batches(i):
+            x, y = d.synthetic_mnist_traced(1, spe * bs, means)
+            x = x.reshape(spe, bs, m.IMAGE_PIXELS)
+            y = y.reshape(spe, bs)
+            return (jax.lax.dynamic_slice_in_dim(x, i * local_bs, local_bs, axis=1),
+                    jax.lax.dynamic_slice_in_dim(y, i * local_bs, local_bs, axis=1))
 
-    def eval_counts(p, i):
-        ex, ey = d.synthetic_mnist_traced(2, dp * eval_local, means)
-        ex = jax.lax.dynamic_slice_in_dim(ex, i * eval_local, eval_local, axis=0)
-        ey = jax.lax.dynamic_slice_in_dim(ey, i * eval_local, eval_local, axis=0)
-        correct = jnp.sum(jnp.argmax(m.mlp_apply(p, ex), axis=-1) == ey)
-        return correct, jnp.asarray(eval_local, jnp.float32)
+        def eval_counts(p, i):
+            ex, ey = d.synthetic_mnist_traced(2, dp * eval_local, means)
+            ex = jax.lax.dynamic_slice_in_dim(ex, i * eval_local, eval_local, axis=0)
+            ey = jax.lax.dynamic_slice_in_dim(ey, i * eval_local, eval_local, axis=0)
+            correct = jnp.sum(jnp.argmax(m.mlp_apply(p, ex), axis=-1) == ey)
+            return correct, jnp.asarray(eval_local, jnp.float32)
 
-    aot = ""
-    if args.aot_cache:
-        os.makedirs(args.aot_cache, exist_ok=True)
-        # lr is baked into the compiled program as a constant (the optax
-        # chain closes over it), so it MUST be part of the key: two jobs
-        # differing only in --lr must not share an executable.
-        aot = os.path.join(
-            args.aot_cache,
-            f"mnist-dist-s{args.steps}-b{bs}-n{args.train_size}"
-            f"-e{args.eval_size}-lr{args.lr:g}-dp{dp}-pc{pc}-p{proc}.aot")
+        aot = ""
+        if args.aot_cache:
+            os.makedirs(args.aot_cache, exist_ok=True)
+            # lr is baked into the compiled program as a constant (the optax
+            # chain closes over it), so it MUST be part of the key: two jobs
+            # differing only in --lr must not share an executable.
+            aot = os.path.join(
+                args.aot_cache,
+                f"mnist-dist-s{args.steps}-b{bs}-n{args.train_size}"
+                f"-e{args.eval_size}-lr{args.lr:g}-dp{dp}-pc{pc}-p{proc}.aot")
 
-    t_init = time.time()
     # The whole job — per-step batch generation, the 200-step scan with its
     # single fused all-reduce, and the sharded eval — is ONE compiled
     # program; `fit` below is one dispatch per worker.
-    params, opt_state, loss, acc = train_scan_dist(
-        lambda p, b: m.mlp_loss(p, b[0], b[1]), opt, params, opt_state,
-        args.steps, mesh, AXIS_DATA, local_batches, eval_counts,
-        aot_cache=aot,
-    )
-    loss, acc = float(loss), float(acc)
-    elapsed = time.time() - t_init
-    t_fit = time.time()
+    with obs_trace.span("workload/fit", process=proc, steps=args.steps) as sp_fit:
+        params, opt_state, loss, acc = train_scan_dist(
+            lambda p, b: m.mlp_loss(p, b[0], b[1]), opt, params, opt_state,
+            args.steps, mesh, AXIS_DATA, local_batches, eval_counts,
+            aot_cache=aot, examples_per_step=bs,
+        )
+        loss, acc = float(loss), float(acc)
+    elapsed = sp_fit.dur
 
     print(f"Worker {proc}/{pc} on {jax.device_count()} devices "
           f"(mesh dp={dp})")
-    # Phase breakdown for the headline-bench profile (bench.py parses it).
+    # Phase breakdown (bench.py reads the same spans from the trace dump).
     # The phases partition total: rendezvous = jax.distributed join, init =
     # host-side model/optimizer init + means, fit = the single compiled
     # program (trace + cache-load + batch gen + train scan + eval).
-    print(f"Phase times: rendezvous={t_rendezvous - t_start:.3f}s "
-          f"init={t_init - t_rendezvous:.3f}s "
-          f"fit={t_fit - t_init:.3f}s "
+    print(f"Phase times: rendezvous={sp_rdv.dur:.3f}s "
+          f"init={sp_init.dur:.3f}s "
+          f"fit={sp_fit.dur:.3f}s "
           f"total={time.time() - t_start:.3f}s")
     print(f"Training elapsed time: {elapsed:f} s")
     print(f"Final loss: {loss:f}; eval accuracy: {acc:f}")
+    # Explicit span dump: warm-forked pods exit via os._exit (no atexit).
+    obs_trace.dump_to_env_dir()
     if rt.model_dir:
         from .checkpoint import CheckpointManager
 

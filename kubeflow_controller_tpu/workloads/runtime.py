@@ -80,11 +80,17 @@ class JobRuntime:
     def initialize(self) -> None:
         """Join the job's jax.distributed cluster when it has more than one
         process.  Single-process jobs (and the one-chip CI environment)
-        skip straight to local devices — same code path either way."""
+        skip straight to local devices — same code path either way.
+
+        The join is traced (obs spans "runtime/wait_coordinator" and
+        "runtime/distributed_initialize"): the round-5 rendezvous stall was
+        bisected by hand exactly because this path had no timing."""
         if self._initialized or self.num_processes <= 1:
             self._initialized = True
             return
         import jax
+
+        from ..obs.trace import span
 
         if self.process_id != 0:
             # Wait for the coordinator's port to be LISTENING before the
@@ -95,12 +101,18 @@ class JobRuntime:
             # whole gang then idles out that second.  Measured: rendezvous
             # is bimodal 0.01s / ~1.07s depending on who wins the race; a
             # 5ms TCP poll makes the fast mode deterministic.
-            self._wait_coordinator()
-        jax.distributed.initialize(
-            coordinator_address=self.coordinator,
-            num_processes=self.num_processes,
-            process_id=self.process_id,
-        )
+            with span("runtime/wait_coordinator",
+                      coordinator=self.coordinator,
+                      process=self.process_id):
+                self._wait_coordinator()
+        with span("runtime/distributed_initialize",
+                  process=self.process_id,
+                  num_processes=self.num_processes):
+            jax.distributed.initialize(
+                coordinator_address=self.coordinator,
+                num_processes=self.num_processes,
+                process_id=self.process_id,
+            )
         self._initialized = True
 
     def _wait_coordinator(self, timeout_s: float = 60.0,

@@ -2,10 +2,45 @@
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Optional, Tuple
 
 import jax
 import optax
+
+
+def record_step_telemetry(steps: int, duration_s: float,
+                          examples_per_step: int = 0,
+                          registry=None) -> None:
+    """Publish a training run's step-time/throughput on the obs registry.
+
+    The scan-based trainers execute the whole run as ONE compiled program,
+    so per-step timing does not exist host-side; what is recorded is the
+    run's mean step time (one histogram observation per run) plus
+    cumulative step/example counters and an examples-per-second gauge —
+    the numbers future perf PRs cite from ``GET /metrics``."""
+    from ..obs.metrics import REGISTRY
+
+    reg = registry or REGISTRY
+    if steps <= 0 or duration_s < 0:
+        return
+    reg.histogram(
+        "kctpu_trainer_step_duration_seconds",
+        "Mean per-step train time of a completed run (one observation per run)",
+    ).observe(duration_s / steps)
+    reg.histogram(
+        "kctpu_trainer_fit_duration_seconds",
+        "Whole-run compiled-train-program wall time",
+    ).observe(duration_s)
+    reg.counter("kctpu_trainer_steps_total",
+                "Training steps completed").inc(steps)
+    if examples_per_step > 0:
+        reg.counter("kctpu_trainer_examples_total",
+                    "Training examples consumed").inc(steps * examples_per_step)
+        if duration_s > 0:
+            reg.gauge("kctpu_trainer_examples_per_second",
+                      "Throughput of the most recent completed run").set(
+                steps * examples_per_step / duration_s)
 
 
 def make_train_step(
@@ -82,6 +117,7 @@ def train_scan_dist(
     local_batches_fn: Callable[[jax.Array], Any],
     eval_counts_fn: Optional[Callable[[Any, jax.Array], Tuple[jax.Array, jax.Array]]] = None,
     aot_cache: Optional[str] = None,
+    examples_per_step: int = 0,
 ):
     """Distributed data-parallel training as ONE compiled program with ONE
     collective per step.
@@ -173,6 +209,21 @@ def train_scan_dist(
         jax.shard_map(inner, mesh=mesh, in_specs=(P(), P()), out_specs=P()),
         donate_argnums=(0, 1),
     )
+
+    def _timed(run: Callable[[], Any], cache: str) -> Any:
+        # One span + one telemetry record for the whole compiled run (the
+        # scan is one dispatch; block_until_ready so the measured time is
+        # execution, not dispatch — callers consume the outputs right away).
+        from ..obs.trace import span as obs_span
+
+        with obs_span("trainer/fit", steps=steps,
+                      aot_cache=cache) as sp:
+            out = jax.block_until_ready(run())
+            sp.args["process"] = jax.process_index()
+        record_step_telemetry(steps, sp.dur if sp.dur else 0.0,
+                              examples_per_step)
+        return out
+
     if aot_cache:
         import os
         import pickle
@@ -186,8 +237,8 @@ def train_scan_dist(
             try:
                 with open(aot_cache, "rb") as fh:
                     payload, in_tree, out_tree = pickle.load(fh)
-                return deserialize_and_load(payload, in_tree, out_tree)(
-                    params, opt_state)
+                loaded = deserialize_and_load(payload, in_tree, out_tree)
+                return _timed(lambda: loaded(params, opt_state), "hit")
             except Exception:
                 pass  # stale/corrupt entry: recompile below
         compiled = fit.trace(params, opt_state).lower().compile()
@@ -198,8 +249,8 @@ def train_scan_dist(
             os.replace(tmp, aot_cache)
         except Exception:
             pass  # cache write is best-effort
-        return compiled(params, opt_state)
-    return fit(params, opt_state)
+        return _timed(lambda: compiled(params, opt_state), "miss")
+    return _timed(lambda: fit(params, opt_state), "off")
 
 
 def batch_stack(x: jax.Array, y: jax.Array, steps: int, batch_size: int):

@@ -126,6 +126,42 @@ class TestLlama:
         jax.tree.map(check, params, pspecs)
 
 
+class TestFlashFallbackWarning:
+    def test_explicit_flash_warns_once_when_no_legal_tile(self):
+        """ADVICE round 5: an explicit attention="flash" request that
+        silently degrades to the dense XLA path (flash_block()==0, e.g.
+        T=12 f32 not a multiple of the 8-row sublane tile) must say so —
+        once per shape/dtype, matching the MoE fallback discipline."""
+        import warnings
+
+        from kubeflow_controller_tpu.models import llama as llama_mod
+        from kubeflow_controller_tpu.parallel.ring import flash_block
+
+        t = 12
+        assert flash_block(t, jnp.float32) == 0  # the degraded shape
+        cfg = LlamaConfig(attention="flash")
+        q = jnp.zeros((1, t, 2, 8), jnp.float32)
+        llama_mod._FLASH_FALLBACK_WARNED.clear()
+        with pytest.warns(UserWarning, match="dense"):
+            out = llama_mod._flash_path(q, q, q, None, True, None, cfg)
+        assert out is None  # fell back
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # second call: silent
+            assert llama_mod._flash_path(q, q, q, None, True, None, cfg) is None
+
+    def test_auto_mode_stays_silent(self):
+        import warnings
+
+        from kubeflow_controller_tpu.models import llama as llama_mod
+
+        cfg = LlamaConfig(attention="auto")
+        q = jnp.zeros((1, 12, 2, 8), jnp.float32)
+        llama_mod._FLASH_FALLBACK_WARNED.clear()
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            assert llama_mod._flash_path(q, q, q, None, True, None, cfg) is None
+
+
 class TestChunkedCE:
     """cfg.loss_chunks: the loss without the [B,T,vocab] logits tensor."""
 

@@ -339,6 +339,38 @@ class TestGroupedDispatch:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-3, rtol=5e-3)
 
+    def test_block_m_below_sublane_tile_falls_back(self):
+        """ADVICE round 5: block_m smaller than the dtype's sublane tile
+        (8 rows for f32) cannot form a legal Mosaic tile — the eligibility
+        gate must route to the einsum fallback, not crash the kernel."""
+        from kubeflow_controller_tpu.models.moe import moe_ffn_stats
+
+        router, wg, wu, wd = self._big_weights(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 128))
+        with pytest.warns(UserWarning, match="falling back to 'einsum'"):
+            y, _ = moe_ffn_stats(x, router, wg, wu, wd, top_k=2,
+                                 dispatch="grouped", block_m=4)
+        ye, _ = moe_ffn_stats(x, router, wg, wu, wd, top_k=2,
+                              dispatch="einsum")
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ye),
+                                   atol=1e-6, rtol=1e-6)
+
+    def test_block_m_non_power_of_two_rounds_down(self):
+        """ADVICE round 5: a non-power-of-two block_m (300) used to halve
+        through odd/sub-tile sizes (300->75->...) and fail Mosaic; it now
+        rounds down to a power of two (256) and the grouped path still
+        matches the dropless oracle."""
+        from kubeflow_controller_tpu.models.moe import moe_ffn_stats
+
+        router, wg, wu, wd = self._big_weights(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 128))
+        y, stats = moe_ffn_stats(x, router, wg, wu, wd, top_k=2,
+                                 dispatch="grouped", block_m=300)
+        ref = moe_ffn_reference(x, router, wg, wu, wd, top_k=2)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+        assert float(stats["overflow_frac"]) == 0.0
+
     def test_grouped_falls_back_below_tile_grain(self):
         from kubeflow_controller_tpu.models.moe import moe_ffn_stats
 

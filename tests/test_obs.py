@@ -1,0 +1,485 @@
+"""Observability layer tests: span tracer (nesting, thread-safety, ring
+buffer, Chrome dumps), Prometheus instruments + text exposition (escaping,
+counter monotonicity, histogram cumulativity), workqueue instrumentation
+under concurrent workers, and the e2e /metrics surface of a completed
+distributed job."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubeflow_controller_tpu.obs import (
+    REGISTRY,
+    Registry,
+    TRACER,
+    Tracer,
+    dump_to_env_dir,
+    load_trace_events,
+    merge_trace_dir,
+    validate_exposition,
+)
+from kubeflow_controller_tpu.obs.lifecycle import JobLifecycle
+
+
+# ---------------------------------------------------------------------------
+# Tracer
+# ---------------------------------------------------------------------------
+
+class TestTracer:
+    def test_span_records_duration_and_args(self):
+        t = Tracer()
+        with t.span("work/unit", key="a/b") as sp:
+            time.sleep(0.01)
+        assert sp.dur >= 0.01
+        assert sp.args == {"key": "a/b"}
+        spans = t.spans()
+        assert len(spans) == 1 and spans[0].name == "work/unit"
+
+    def test_nesting_records_parent(self):
+        t = Tracer()
+        with t.span("outer"):
+            with t.span("inner"):
+                pass
+            with t.span("inner2"):
+                pass
+        with t.span("top"):
+            pass
+        by_name = {s.name: s for s in t.spans()}
+        assert by_name["inner"].parent == "outer"
+        assert by_name["inner2"].parent == "outer"
+        assert by_name["outer"].parent == ""
+        assert by_name["top"].parent == ""
+
+    def test_prefix_query(self):
+        t = Tracer()
+        with t.span("sync/gather"):
+            pass
+        with t.span("workload/fit"):
+            pass
+        assert [s.name for s in t.spans("sync")] == ["sync/gather"]
+
+    def test_ring_buffer_drops_oldest(self):
+        t = Tracer(capacity=10)
+        for i in range(25):
+            with t.span(f"s{i}"):
+                pass
+        names = [s.name for s in t.spans()]
+        assert names == [f"s{i}" for i in range(15, 25)]
+
+    def test_thread_safety(self):
+        t = Tracer(capacity=10_000)
+        errors = []
+
+        def worker(wid):
+            try:
+                for i in range(100):
+                    with t.span(f"w{wid}/outer", i=i):
+                        with t.span(f"w{wid}/inner"):
+                            pass
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(w,)) for w in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errors
+        assert len(t) == 8 * 100 * 2
+        # Nesting is per-thread: every inner span's parent is ITS thread's
+        # outer span, never another thread's.
+        for s in t.spans():
+            if s.name.endswith("/inner"):
+                assert s.parent == s.name.replace("/inner", "/outer")
+
+    def test_chrome_trace_shape(self, tmp_path):
+        t = Tracer()
+        with t.span("phase/x", worker=1):
+            pass
+        doc = t.chrome_trace()
+        assert doc["displayTimeUnit"] == "ms"
+        (ev,) = doc["traceEvents"]
+        assert ev["ph"] == "X" and ev["name"] == "phase/x"
+        assert ev["dur"] >= 0 and ev["ts"] > 0
+        assert ev["cat"] == "phase" and ev["args"]["worker"] == 1
+        path = str(tmp_path / "trace.json")
+        t.dump(path)
+        assert len(load_trace_events(path)) == 1
+        json.load(open(path))  # chrome-loadable JSON
+
+    def test_env_dir_dump_and_merge(self, tmp_path, monkeypatch):
+        d = str(tmp_path / "dumps")
+        monkeypatch.setenv("KCTPU_TRACE_DIR", d)
+        t = Tracer()
+        assert dump_to_env_dir(t) is None  # nothing traced: no file
+        with t.span("a"):
+            pass
+        p = dump_to_env_dir(t)
+        assert p is not None and p.startswith(d)
+        t2 = Tracer()
+        with t2.span("b"):
+            pass
+        doc = merge_trace_dir(d, tracer=t2)
+        assert sorted(e["name"] for e in doc["traceEvents"]) == ["a", "b"]
+
+    def test_env_dir_unset_is_noop(self, monkeypatch):
+        monkeypatch.delenv("KCTPU_TRACE_DIR", raising=False)
+        t = Tracer()
+        with t.span("a"):
+            pass
+        assert dump_to_env_dir(t) is None
+
+
+# ---------------------------------------------------------------------------
+# Instruments + exposition
+# ---------------------------------------------------------------------------
+
+class TestMetrics:
+    def test_counter_monotonicity(self):
+        reg = Registry()
+        c = reg.counter("t_total", "help")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        lc = reg.counter("tl_total", "help", labelnames=("k",))
+        lc.labels(k="a").inc()
+        with pytest.raises(ValueError):
+            lc.labels(k="a").inc(-0.5)
+
+    def test_get_or_create_and_mismatch(self):
+        reg = Registry()
+        a = reg.counter("same_total", "h", labelnames=("x",))
+        b = reg.counter("same_total", "h", labelnames=("x",))
+        assert a is b
+        with pytest.raises(ValueError):
+            reg.gauge("same_total", "h")  # type mismatch
+        with pytest.raises(ValueError):
+            reg.counter("same_total", "h", labelnames=("y",))  # label mismatch
+        with pytest.raises(ValueError):
+            reg.counter("bad name", "h")
+        with pytest.raises(ValueError):
+            reg.counter("ok_total", "h", labelnames=("0bad",))
+
+    def test_gauge_set_and_callback(self):
+        reg = Registry()
+        g = reg.gauge("g", "h")
+        g.set(4)
+        g.dec()
+        assert g.value == 3
+        depth = reg.gauge("d", "h", labelnames=("name",))
+        depth.labels(name="q").set_function(lambda: 7)
+        text = reg.render()
+        assert 'd{name="q"} 7.0' in text
+
+    def test_histogram_cumulative_buckets(self):
+        reg = Registry()
+        h = reg.histogram("lat", "h", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        text = reg.render()
+        assert 'lat_bucket{le="0.1"} 1' in text
+        assert 'lat_bucket{le="1.0"} 3' in text
+        assert 'lat_bucket{le="10.0"} 4' in text
+        assert 'lat_bucket{le="+Inf"} 5' in text
+        assert "lat_count 5" in text
+        assert h.sum == pytest.approx(56.05)
+
+    def test_label_escaping_round_trips_validation(self):
+        reg = Registry()
+        c = reg.counter("esc_total", "back\\slash and\nnewline",
+                        labelnames=("v",))
+        c.labels(v='quote " back \\ newline \n end').inc()
+        text = reg.render()
+        assert '\\"' in text and "\\\\" in text and "\\n" in text
+        assert validate_exposition(text) == []
+
+    def test_render_is_valid_exposition(self):
+        reg = Registry()
+        reg.counter("a_total", "h").inc()
+        reg.gauge("b", "h").set(1.5)
+        reg.histogram("c", "h", labelnames=("q",)).labels(q="x").observe(0.2)
+        problems = validate_exposition(reg.render())
+        assert problems == []
+
+    def test_validator_catches_garbage(self):
+        bad = "# TYPE x counter\nx{oops 1\nno_type_metric 2\nx NaNaN\n"
+        problems = validate_exposition(bad)
+        assert any("unparseable" in p or "malformed" in p for p in problems)
+        assert any("no TYPE" in p for p in problems)
+
+    def test_validator_catches_duplicate_series(self):
+        bad = "# TYPE x counter\nx 1\nx 2\n"
+        assert any("duplicate series" in p for p in validate_exposition(bad))
+
+    def test_collector_keyed_replacement(self):
+        from kubeflow_controller_tpu.obs.metrics import Family, Sample
+
+        reg = Registry()
+        reg.register_collector("k", lambda: [
+            Family("one", "gauge", "h", [Sample("", {}, 1.0)])])
+        reg.register_collector("k", lambda: [
+            Family("two", "gauge", "h", [Sample("", {}, 2.0)])])
+        text = reg.render()
+        assert "two 2.0" in text and "one" not in text
+
+
+# ---------------------------------------------------------------------------
+# Reconcile metrics + lifecycle on a registry
+# ---------------------------------------------------------------------------
+
+class TestCollectors:
+    def test_reconcile_metrics_summary(self):
+        from kubeflow_controller_tpu.controller.metrics import ReconcileMetrics
+
+        reg = Registry()
+        m = ReconcileMetrics()
+        m.register(reg)
+        for v in (0.001, 0.002, 0.003):
+            m.record_sync(v)
+        m.record_sync(0.5, error=True)
+        text = reg.render()
+        assert validate_exposition(text) == []
+        assert 'kctpu_reconcile_duration_seconds{quantile="0.5"}' in text
+        assert "kctpu_reconcile_duration_seconds_count 4" in text
+        assert "kctpu_controller_sync_errors_total 1.0" in text
+
+    def test_lifecycle_dedups_and_measures(self):
+        reg = Registry()
+        lc = JobLifecycle(registry=reg)
+        t0 = 1000.0
+        lc.observe("uid1", "None", "Pending", now=t0 + 1, created=t0)
+        lc.observe("uid1", "Pending", "Running", now=t0 + 3)
+        # Stale recompute of the same transition: must not double-count.
+        lc.observe("uid1", "Pending", "Running", now=t0 + 4)
+        lc.observe("uid1", "Running", "Succeeded", now=t0 + 10)
+        h = reg.histogram("kctpu_job_phase_transition_seconds", "",
+                          labelnames=("from_phase", "to_phase"))
+        pend = h.labels(from_phase="None", to_phase="Pending")
+        run = h.labels(from_phase="Pending", to_phase="Running")
+        done = h.labels(from_phase="Running", to_phase="Succeeded")
+        assert pend.count == 1 and pend.sum == pytest.approx(1.0)
+        assert run.count == 1 and run.sum == pytest.approx(2.0)
+        assert done.count == 1 and done.sum == pytest.approx(7.0)
+        assert lc.tracked() == 0  # terminal jobs drop their entry
+
+    def test_lifecycle_bounded(self):
+        reg = Registry()
+        lc = JobLifecycle(registry=reg, max_jobs=5)
+        for i in range(20):
+            lc.observe(f"u{i}", "None", "Running", now=float(i))
+        assert lc.tracked() <= 5
+
+    def test_trainer_telemetry(self):
+        from kubeflow_controller_tpu.workloads.trainer import record_step_telemetry
+
+        reg = Registry()
+        record_step_telemetry(200, 2.0, examples_per_step=96, registry=reg)
+        assert reg.counter("kctpu_trainer_steps_total", "").value == 200
+        assert reg.counter("kctpu_trainer_examples_total", "").value == 200 * 96
+        assert reg.gauge("kctpu_trainer_examples_per_second", "").value == \
+            pytest.approx(200 * 96 / 2.0)
+        assert reg.histogram("kctpu_trainer_step_duration_seconds", "").count == 1
+        record_step_telemetry(0, 1.0, registry=reg)  # no-op, no division
+        assert validate_exposition(reg.render()) == []
+
+
+# ---------------------------------------------------------------------------
+# Workqueue instrumentation
+# ---------------------------------------------------------------------------
+
+class TestWorkqueueMetrics:
+    def _handles(self, reg, name):
+        depth = reg.gauge("kctpu_workqueue_depth", "", ("name",)).labels(name=name)
+        adds = reg.counter("kctpu_workqueue_adds_total", "", ("name",)).labels(name=name)
+        wait = reg.histogram("kctpu_workqueue_queue_duration_seconds", "",
+                             ("name",)).labels(name=name)
+        retries = reg.counter("kctpu_workqueue_retries_total", "", ("name",)).labels(name=name)
+        requeues = reg.counter("kctpu_workqueue_requeues_total", "",
+                               ("name",)).labels(name=name)
+        return depth, adds, wait, retries, requeues
+
+    def test_depth_and_queue_wait(self):
+        from kubeflow_controller_tpu.controller.workqueue import RateLimitingQueue
+
+        reg = Registry()
+        q = RateLimitingQueue(name="t1", registry=reg)
+        depth, adds, wait, _, _ = self._handles(reg, "t1")
+        q.add("a")
+        q.add("b")
+        q.add("a")  # dedup-collapsed: not a new add
+        assert depth.value == 2 and adds.value == 2
+        got = q.get(timeout=1)
+        assert got is not None
+        assert depth.value == 1
+        assert wait.count == 1 and wait.sum >= 0
+        q.done(got)
+        q.get(timeout=1)
+        assert depth.value == 0
+        q.shut_down()
+
+    def test_requeue_and_retry_counters(self):
+        from kubeflow_controller_tpu.controller.workqueue import RateLimitingQueue
+
+        reg = Registry()
+        q = RateLimitingQueue(name="t2", registry=reg)
+        _, adds, _, retries, requeues = self._handles(reg, "t2")
+        q.add("a")
+        item = q.get(timeout=1)
+        q.add("a")       # dirty while processing
+        q.done(item)     # -> requeued
+        assert requeues.value == 1
+        q.get(timeout=1)
+        q.done("a")
+        q.add_rate_limited("a")
+        assert retries.value == 1
+        # The delayed add eventually lands and counts as an add.
+        deadline = time.time() + 5
+        while time.time() < deadline and adds.value < 3:
+            time.sleep(0.01)
+        assert adds.value == 3
+        q.shut_down()
+
+    def test_concurrent_workers_drain_cleanly(self):
+        from kubeflow_controller_tpu.controller.workqueue import (
+            RateLimitingQueue,
+            ShutDown,
+        )
+
+        reg = Registry()
+        q = RateLimitingQueue(name="t3", registry=reg)
+        depth, adds, wait, _, _ = self._handles(reg, "t3")
+        N = 200
+        processed = []
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                try:
+                    item = q.get(timeout=5)
+                except ShutDown:
+                    return
+                if item is None:
+                    return
+                with lock:
+                    processed.append(item)
+                q.done(item)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for i in range(N):
+            q.add(f"ns/job-{i}")
+        deadline = time.time() + 10
+        while time.time() < deadline and len(processed) < N:
+            time.sleep(0.01)
+        q.shut_down()
+        for t in threads:
+            t.join(timeout=5)
+        assert sorted(set(processed)) == sorted(f"ns/job-{i}" for i in range(N))
+        assert adds.value == N
+        assert wait.count == len(processed)
+        assert depth.value == 0
+
+
+# ---------------------------------------------------------------------------
+# e2e: completed distributed job -> /metrics over HTTP
+# ---------------------------------------------------------------------------
+
+def _mk_job(name, *types_and_replicas):
+    from kubeflow_controller_tpu.api.core import Container, PodTemplateSpec
+    from kubeflow_controller_tpu.api.meta import ObjectMeta
+    from kubeflow_controller_tpu.api.tfjob import TFJob, TFReplicaSpec
+
+    job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+    for typ, n in types_and_replicas:
+        t = PodTemplateSpec()
+        t.spec.containers.append(Container(name="tensorflow", image="img"))
+        t.spec.restart_policy = "OnFailure"
+        job.spec.tf_replica_specs.append(
+            TFReplicaSpec(replicas=n, tf_replica_type=typ, template=t))
+    return job
+
+
+class TestMetricsEndpointE2E:
+    def test_completed_dist_job_exposes_lifecycle_and_reconcile(self):
+        from kubeflow_controller_tpu.api.tfjob import ReplicaType, TFJobPhase
+        from kubeflow_controller_tpu.cluster import Cluster, FakeKubelet, PhasePolicy
+        from kubeflow_controller_tpu.cluster.apiserver import FakeAPIServer
+        from kubeflow_controller_tpu.controller import Controller
+
+        cluster = Cluster()
+        server = FakeAPIServer(cluster.store)
+        url = server.start()
+        kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=0.05))
+        ctrl = Controller(cluster, resync_period_s=1.0)
+        kubelet.start()
+        ctrl.run(threadiness=2)
+        try:
+            cluster.tfjobs.create(_mk_job(
+                "obs-dist", (ReplicaType.PS, 1), (ReplicaType.WORKER, 2)))
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if (cluster.tfjobs.get("default", "obs-dist").status.phase
+                        == TFJobPhase.SUCCEEDED):
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("job never reached Succeeded")
+            with urllib.request.urlopen(f"{url}/metrics", timeout=10) as resp:
+                assert "text/plain" in resp.headers.get("Content-Type", "")
+                text = resp.read().decode()
+        finally:
+            ctrl.stop()
+            kubelet.stop()
+            server.stop()
+
+        assert validate_exposition(text) == []
+
+        def sample_value(prefix):
+            for line in text.splitlines():
+                if line.startswith(prefix):
+                    return float(line.rsplit(" ", 1)[1])
+            raise AssertionError(f"no sample {prefix!r} in /metrics")
+
+        # Non-zero phase-transition histograms for the completed job.
+        assert sample_value(
+            'kctpu_job_phase_transition_seconds_count'
+            '{from_phase="Pending",to_phase="Running"}') >= 1
+        assert sample_value(
+            'kctpu_job_phase_transition_seconds_count'
+            '{from_phase="Running",to_phase="Succeeded"}') >= 1
+        # Reconcile latency percentiles + counters.
+        assert sample_value('kctpu_reconcile_duration_seconds{quantile="0.5"}') >= 0
+        assert sample_value("kctpu_controller_syncs_total") >= 1
+        # Workqueue instrumentation.
+        assert sample_value('kctpu_workqueue_adds_total{name="tfJobs"}') >= 1
+        assert sample_value(
+            'kctpu_workqueue_queue_duration_seconds_count{name="tfJobs"}') >= 1
+        # Reconcile spans landed on the global tracer (sync + nested gather).
+        assert TRACER.spans("sync/gather")
+        assert any(s.parent == "sync" for s in TRACER.spans("sync/gather"))
+
+    def test_debug_traces_endpoint(self):
+        from kubeflow_controller_tpu.cluster.apiserver import FakeAPIServer
+
+        t = Tracer()
+        with t.span("sync", key="default/x"):
+            pass
+        server = FakeAPIServer(tracer=t)
+        url = server.start()
+        try:
+            with urllib.request.urlopen(f"{url}/debug/traces", timeout=10) as resp:
+                doc = json.load(resp)
+        finally:
+            server.stop()
+        assert [e["name"] for e in doc["traceEvents"]] == ["sync"]
+
+    def test_global_registry_render_always_valid(self):
+        # Whatever previous tests left on the global registry must render
+        # as valid exposition (this is what GET /metrics serves).
+        assert validate_exposition(REGISTRY.render()) == []
