@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast lint typecheck cov cov-local bench dryrun validate metrics-smoke
+.PHONY: all test test-fast lint typecheck cov cov-local bench dryrun validate metrics-smoke scale-smoke
 
 all: lint test
 
@@ -66,6 +66,19 @@ bench:
 # (docs/OBSERVABILITY.md has the metric catalogue).
 metrics-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m kubeflow_controller_tpu.obs.smoke
+
+# Scale smoke: boot the in-memory cluster, drive 10 concurrent simulated
+# TFJobs to Succeeded via bench.py --scale, fail on regression past a
+# generous wall-clock gate (post-index runs finish in <1s; 30s flags an
+# order-of-magnitude regression, not scheduler noise) or malformed JSON.
+scale-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --scale 10 --max-seconds 30 \
+		> /tmp/kctpu_scale_smoke.json
+	@$(PY) -c "import json; d = json.load(open('/tmp/kctpu_scale_smoke.json')); \
+		assert {'metric', 'value', 'unit', 'details'} <= set(d), d; \
+		print('scale-smoke ok:', d['value'], d['unit'], \
+		      '| syncs/sec', d['details']['syncs_per_sec'], \
+		      '| index hit rate', d['details']['index_hit_rate'])"
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -1,4 +1,16 @@
-"""Headline benchmark: dist-mnist TFJob wall-clock-to-Succeeded.
+"""Benchmarks: dist-mnist headline + multi-job controller scale.
+
+Two modes:
+
+- default: the headline dist-mnist TFJob wall-clock-to-Succeeded (below);
+- ``--scale N``: controller **throughput** at N concurrent TFJobs —
+  orchestration-bound simulated jobs (FakeKubelet + PhasePolicy, no real
+  training), reporting time-to-all-Succeeded, syncs/sec, reconcile
+  p50/p99, and the gather index hit rate.  This is the many-jobs axis the
+  headline bench (1 job, real training) cannot see: every reconcile used
+  to pay two full-namespace LISTs, making an all-jobs pass O(J²·R).
+
+Headline: dist-mnist TFJob wall-clock-to-Succeeded.
 
 The driver's target metric (BASELINE.json): time from TFJob creation to
 ``status.phase == Succeeded`` for the distributed MNIST job.  Config here
@@ -174,6 +186,126 @@ def run_dist_mnist(trace_dir: str = "") -> dict:
             "phases": worker_phase_lines(trace_dir)}
 
 
+def run_scale(n_jobs: int, deadline_s: float = 0.0,
+              settle_s: float = 2.5) -> dict:
+    """N concurrent orchestration-bound TFJobs (1 PS + 2 workers each,
+    simulated pod phases) from creation to all-Succeeded.  Uses only the
+    public controller surface so the same file measures older commits;
+    index-hit-rate fields degrade to 0 where the counters don't exist."""
+    from kubeflow_controller_tpu.api.core import Container, PodTemplateSpec
+    from kubeflow_controller_tpu.api.meta import ObjectMeta
+    from kubeflow_controller_tpu.api.tfjob import (
+        ReplicaType,
+        TFJob,
+        TFJobPhase,
+        TFReplicaSpec,
+    )
+    from kubeflow_controller_tpu.cluster import Cluster, FakeKubelet, PhasePolicy
+    from kubeflow_controller_tpu.controller import Controller
+
+    def mk_sim_job(name: str) -> TFJob:
+        job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+        for typ, n in ((ReplicaType.PS, 1), (ReplicaType.WORKER, 2)):
+            t = PodTemplateSpec()
+            t.spec.containers.append(Container(name="tensorflow", image="img"))
+            t.spec.restart_policy = "OnFailure"
+            job.spec.tf_replica_specs.append(
+                TFReplicaSpec(replicas=n, tf_replica_type=typ, template=t))
+        return job
+
+    cluster = Cluster()
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=0.05))
+    ctrl = Controller(cluster, resync_period_s=1.0)
+    kubelet.start()
+    ctrl.run(threadiness=2)
+    if not deadline_s:
+        deadline_s = max(120.0, 5.0 * n_jobs)
+    names = [f"scale-{i:04d}" for i in range(n_jobs)]
+    try:
+        t0 = time.time()
+        for n in names:
+            cluster.tfjobs.create(mk_sim_job(n))
+        pending = set(names)
+        failed = []
+        while pending and time.time() < t0 + deadline_s:
+            for j in cluster.tfjobs.list("default"):
+                if j.metadata.name not in pending:
+                    continue
+                if j.status.phase == TFJobPhase.SUCCEEDED:
+                    pending.discard(j.metadata.name)
+                elif j.status.phase == TFJobPhase.FAILED:
+                    pending.discard(j.metadata.name)
+                    failed.append(j.metadata.name)
+            if pending:
+                time.sleep(0.05)
+        elapsed = time.time() - t0
+        # Steady-state probe: every job terminal, nothing should be doing
+        # full-namespace LISTs anymore — resyncs of settled jobs are
+        # skipped, and any sync that does run reads the indices.
+        snap_settle0 = ctrl.metrics.snapshot()
+        time.sleep(settle_s)
+        snap = ctrl.metrics.snapshot()
+    finally:
+        ctrl.stop()
+        kubelet.stop()
+    return {
+        "elapsed_s": elapsed,
+        "jobs": n_jobs,
+        "timed_out": sorted(pending),
+        "failed": failed,
+        "metrics": snap,
+        "settle_syncs": snap["syncs"] - snap_settle0["syncs"],
+        "settle_full_lists": (snap.get("gather_full_lists", 0)
+                              - snap_settle0.get("gather_full_lists", 0)),
+        "settle_s": settle_s,
+    }
+
+
+def scale_main(args) -> int:
+    result = run_scale(args.scale, deadline_s=args.deadline)
+    m = result["metrics"]
+    elapsed = result["elapsed_s"]
+    gathers = m.get("gather_indexed", 0) + m.get("gather_full_lists", 0)
+    print(json.dumps({
+        "metric": f"scale_{result['jobs']}_tfjobs_time_to_all_succeeded",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "details": {
+            "jobs": result["jobs"],
+            "timed_out": result["timed_out"],
+            "failed": result["failed"],
+            "syncs": m["syncs"],
+            "sync_errors": m["sync_errors"],
+            "syncs_per_sec": round(m["syncs"] / elapsed, 1) if elapsed else 0.0,
+            "reconcile_p50_ms": round(m["reconcile_p50_s"] * 1e3, 3),
+            "reconcile_p99_ms": round(m["reconcile_p99_s"] * 1e3, 3),
+            "creates": m["creates"],
+            "deletes": m["deletes"],
+            "status_updates": m["status_updates"],
+            "gather_indexed": m.get("gather_indexed", 0),
+            "gather_full_lists": m.get("gather_full_lists", 0),
+            "index_hit_rate": (round(m.get("gather_indexed", 0) / gathers, 4)
+                               if gathers else None),
+            "settle_syncs": result["settle_syncs"],
+            "settle_full_lists": result["settle_full_lists"],
+            "settle_window_s": result["settle_s"],
+            "workload": ("N x (1xPS + 2xWorker) simulated pods "
+                         "(PhasePolicy run_s=0.05, no real training): "
+                         "pure orchestration throughput"),
+        },
+    }))
+    ok = not result["timed_out"] and not result["failed"]
+    if not ok:
+        print(f"scale bench: {len(result['timed_out'])} timed out, "
+              f"{len(result['failed'])} failed", file=sys.stderr)
+        return 1
+    if args.max_seconds and elapsed > args.max_seconds:
+        print(f"scale bench regression: {elapsed:.3f}s > "
+              f"--max-seconds {args.max_seconds}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def worker_phase_lines(trace_dir: str) -> list:
     """Per-worker rendezvous/init/fit breakdown, read back from the span
     dumps the workload processes wrote to ``trace_dir`` (replaces the old
@@ -197,12 +329,25 @@ def worker_phase_lines(trace_dir: str) -> list:
 
 
 def main(argv=None) -> int:
-    p = argparse.ArgumentParser(description="dist-mnist headline benchmark")
+    p = argparse.ArgumentParser(
+        description="dist-mnist headline benchmark / --scale throughput benchmark")
     p.add_argument("--trace-out", default="", metavar="PATH",
                    help="write a merged Chrome trace (controller reconcile "
                         "spans + every worker's rendezvous/init/fit spans) "
                         "to PATH, alongside the JSON result")
+    p.add_argument("--scale", type=int, default=0, metavar="N",
+                   help="run the multi-job scale benchmark with N concurrent "
+                        "simulated TFJobs instead of the headline bench")
+    p.add_argument("--deadline", type=float, default=0.0, metavar="S",
+                   help="scale mode: give up after S seconds "
+                        "(default max(120, 5*N))")
+    p.add_argument("--max-seconds", type=float, default=0.0, metavar="S",
+                   help="scale mode: exit nonzero when time-to-all-Succeeded "
+                        "exceeds S (the `make scale-smoke` regression gate)")
     args = p.parse_args(argv)
+
+    if args.scale:
+        return scale_main(args)
 
     import shutil
     import tempfile

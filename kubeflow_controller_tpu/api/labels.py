@@ -54,3 +54,23 @@ def job_selector(job_name: str, runtime_id: str) -> dict:
         LABEL_RUNTIME_ID: runtime_id,
         LABEL_JOB_NAME: job_name,
     }
+
+
+def job_selector_index_key(job_name: str, runtime_id: str) -> str:
+    """Composite informer-index key equivalent to :func:`job_selector`
+    (exact-match semantics make the two interchangeable: an object is in
+    this index bucket iff it matches the 3-label job selector)."""
+    return f"{job_name}\x00{runtime_id}"
+
+
+def job_selector_index_keys(labels: dict) -> list:
+    """Indexer function for the job-selector index: the bucket keys an
+    object's labels place it in (zero or one)."""
+    if (
+        labels.get(LABEL_DOMAIN) == "true"
+        and labels.get(LABEL_JOB_NAME)
+        and labels.get(LABEL_RUNTIME_ID)
+    ):
+        return [job_selector_index_key(labels[LABEL_JOB_NAME],
+                                       labels[LABEL_RUNTIME_ID])]
+    return []

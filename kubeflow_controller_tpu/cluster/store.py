@@ -10,7 +10,8 @@ Reproduces the behaviors the reference's controller correctness depends on
 - ``generateName`` materialization (base + 5 random alphanumerics, ref:
   vendor/k8s.io/kubernetes/pkg/api/v1/generate.go:48-72);
 - watch streams that deliver ADDED/MODIFIED/DELETED in write order, each
-  carrying a deep copy (watchers can never mutate the store);
+  carrying one deep copy shared read-only by all watchers (watchers can
+  never mutate the store; see ``_notify``);
 - deletionTimestamp + cascading garbage collection of controller-owned
   objects (net-new: the reference's delete handlers are stubs,
   pkg/controller/controller.go:522-524, 601-603).
@@ -113,9 +114,20 @@ class ObjectStore:
         return self._objects.setdefault(kind, {})
 
     def _notify(self, kind: str, ev_type: str, obj: Any) -> None:
+        # Single-serialization fan-out: ONE deep copy per event, shared by
+        # every watcher's queue (the apiserver analog: one encode, N
+        # streams).  Per-watcher copies made this O(watchers × object size)
+        # under the global lock — with 4+ watchers per kind (controller
+        # informer, kubelet, REST streams) the dominant write-path cost.
+        # The shared copy still can't mutate the store; watch consumers
+        # treat event objects as read-only (informers hand out copies on
+        # the mutating read paths).
+        shared: Any = None
         for w in self._watchers.get(kind, []):
             if w.namespace is None or w.namespace == obj.metadata.namespace:
-                w.queue.put(WatchEvent(ev_type, serde.deep_copy(obj)))
+                if shared is None:
+                    shared = serde.deep_copy(obj)
+                w.queue.put(WatchEvent(ev_type, shared))
 
     def _remove_watcher(self, w: Watcher) -> None:
         with self._lock:

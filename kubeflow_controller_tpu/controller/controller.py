@@ -40,6 +40,7 @@ from ..api.tfjob import (
     KIND,
     ReplicaType,
     TFJob,
+    TFJobPhase,
     ValidationError,
     is_tpu_job,
     replica_spec_for,
@@ -57,7 +58,7 @@ from ..utils import serde
 from ..utils.names import generate_runtime_id
 from .events import EventRecorder, TYPE_WARNING
 from .expectations import ControllerExpectations
-from .helper import Helper
+from .helper import Helper, register_gather_indexers
 from .informer import SharedInformer
 from .metrics import ReconcileMetrics
 from .workqueue import RateLimitingQueue, ShutDown
@@ -86,7 +87,6 @@ class Controller:
         self._owns_recorder = recorder is None
         self.recorder = recorder or EventRecorder(
             sink=getattr(cluster, "events", None))
-        self.helper = Helper(cluster, self.recorder)
         self.queue = RateLimitingQueue(name="tfJobs")
         self.expectations = ControllerExpectations()
         self.metrics = ReconcileMetrics()
@@ -97,11 +97,19 @@ class Controller:
         self.tfjob_informer = SharedInformer(cluster.tfjobs, resync_period_s, "tfjobs")
         self.pod_informer = SharedInformer(cluster.pods, resync_period_s, "pods")
         self.service_informer = SharedInformer(cluster.services, resync_period_s, "services")
+        # Owner-UID + job-selector indices: what makes a steady-state gather
+        # O(own children) instead of a full-namespace LIST (helper.py).
+        register_gather_indexers(self.pod_informer)
+        register_gather_indexers(self.service_informer)
+        self.helper = Helper(cluster, self.recorder,
+                             pod_informer=self.pod_informer,
+                             service_informer=self.service_informer,
+                             metrics=self.metrics)
 
         # TFJob events all funnel into the queue (ref: controller.go:138-153).
         self.tfjob_informer.add_event_handler(
             on_add=self._enqueue,
-            on_update=lambda old, new: self._enqueue(new),
+            on_update=self._on_tfjob_update,
             on_delete=self._on_tfjob_delete,
         )
         # Pod/Service feedback edges (ref: controller.go:447-599 + the
@@ -177,6 +185,21 @@ class Controller:
 
     def _enqueue(self, job: TFJob) -> None:
         self.queue.add(key_of(job.metadata))
+
+    def _on_tfjob_update(self, old: TFJob, new: TFJob) -> None:
+        """Enqueue on real edges; on same-RV resyncs (the level-triggered
+        backstop, ref: controller.go:480-484) skip jobs that are settled:
+        terminal phase, not deleting, expectations satisfied.  A Succeeded
+        job would otherwise be re-gathered every resync period forever —
+        pure churn that scales with completed-job count."""
+        if (
+            old.metadata.resource_version == new.metadata.resource_version
+            and new.status.phase in (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED)
+            and new.metadata.deletion_timestamp is None
+            and self.expectations.satisfied_expectations(key_of(new.metadata))
+        ):
+            return
+        self._enqueue(new)
 
     def _on_tfjob_delete(self, job: TFJob) -> None:
         key = key_of(job.metadata)
@@ -292,7 +315,9 @@ class Controller:
         if not job.spec.runtime_id and not deleting:
             job.spec.runtime_id = generate_runtime_id()
             try:
-                self.cluster.tfjobs.update(job)
+                # Keep the returned object: its bumped resourceVersion is
+                # what the status CAS fast path below writes against.
+                job = self.cluster.tfjobs.update(job)
             except Conflict:
                 self.queue.add(key)  # re-read on next pass
                 return
@@ -332,13 +357,13 @@ class Controller:
             for pod in self.cluster.pods.list(ns, selector=selector):
                 try:
                     self.cluster.pods.delete(ns, pod.metadata.name)
-                    self.metrics.deletes += 1
+                    self.metrics.inc_deletes()
                 except NotFound:
                     pass
             for svc in self.cluster.services.list(ns, selector=selector):
                 try:
                     self.cluster.services.delete(ns, svc.metadata.name)
-                    self.metrics.deletes += 1
+                    self.metrics.inc_deletes()
                 except NotFound:
                     pass
         if FINALIZER in job.metadata.finalizers:
@@ -392,19 +417,19 @@ class Controller:
             try:
                 if ev.action == Action.ADD_SERVICE:
                     self.helper.create_service(job, make_service(job, spec, ev.index))
-                    self.metrics.creates += 1
+                    self.metrics.inc_creates()
                 elif ev.action == Action.ADD_POD:
                     self.helper.create_pod(job, make_pod(job, spec, ev.index))
-                    self.metrics.creates += 1
+                    self.metrics.inc_creates()
                 elif ev.action == Action.DELETE_POD:
                     if self.helper.delete_pod(job, job.metadata.namespace, ev.name):
-                        self.metrics.deletes += 1
+                        self.metrics.inc_deletes()
                     else:
                         # Already gone: no DELETED event will arrive.
                         self.expectations.lower_expectations(key, del_delta=1)
                 elif ev.action == Action.DELETE_SERVICE:
                     if self.helper.delete_service(job, job.metadata.namespace, ev.name):
-                        self.metrics.deletes += 1
+                        self.metrics.inc_deletes()
                     else:
                         self.expectations.lower_expectations(key, del_delta=1)
             except Exception:
@@ -423,6 +448,20 @@ class Controller:
             self._update_status_inner(job, new_status)
 
     def _update_status_inner(self, job: TFJob, new_status) -> None:
+        # Fast path: CAS with the resourceVersion already in hand.  The sync
+        # just read (or wrote) this job, so in steady state the RV is
+        # current and the write lands first try — no GET round-trip.  Only
+        # a genuinely concurrent writer sends us to the GET+retry loop.
+        if job.metadata.resource_version:
+            job.status = new_status
+            try:
+                self.cluster.tfjobs.update_status(job)
+                self.metrics.inc_status_updates()
+                return
+            except NotFound:
+                return
+            except Conflict:
+                pass
         for attempt in range(MAX_STATUS_RETRIES):
             try:
                 fresh = self.cluster.tfjobs.get(job.metadata.namespace, job.metadata.name)
@@ -431,7 +470,7 @@ class Controller:
             fresh.status = new_status
             try:
                 self.cluster.tfjobs.update_status(fresh)
-                self.metrics.status_updates += 1
+                self.metrics.inc_status_updates()
                 return
             except Conflict:
                 continue

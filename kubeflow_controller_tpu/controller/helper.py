@@ -8,18 +8,29 @@ helper.go:45-51):
   (ref: control/util.go:25-42), refuse empty labels (ref: control/
   service.go:67-69), create through the client, and emit
   SuccessfulCreate/FailedCreate events (ref: control/service.go:72-84);
-- ``get_pods_for_tfjob`` / ``get_services_for_tfjob``: list by the 4-label
-  selector (ref: helper.go:118-125), then adopt/release through the
-  :class:`RefManager` with a live-read ``can_adopt`` gate re-checking the
-  job's UID (ref: helper.go:137-148).
+- ``get_pods_for_tfjob`` / ``get_services_for_tfjob``: gather candidates,
+  then adopt/release through the :class:`RefManager` with a live-read
+  ``can_adopt`` gate re-checking the job's UID (ref: helper.go:137-148).
+
+Gathering reads the **informer indices** when the controller plumbed its
+pod/service informers in (owner-UID index ∪ job-selector index — the
+client-go pattern of serving steady-state syncs from the local cache), so a
+sync of one job is O(own children), not O(namespace).  The reference instead
+full-LISTs the namespace every sync so adoption can see orphans
+("It is a hack", helper.go:131-136); that live LIST is kept, but only as the
+fallback for the one transition that must run against fresh state: when the
+selector index shows an unowned candidate that may need adoption.  Release
+(owned but selector-mismatched) stays on the cached path — the server-side
+``patch_meta`` it issues is safe against stale candidates by construction.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from ..api.core import Pod, Service
 from ..api.meta import set_controller_ref, validate_controller_ref, get_controller_of
+from ..api.labels import job_selector_index_keys
 from ..api.tfjob import API_VERSION, KIND, TFJob
 from ..cluster.client import Cluster
 from ..cluster.store import NotFound
@@ -32,13 +43,36 @@ from .events import (
     TYPE_NORMAL,
     TYPE_WARNING,
 )
-from .refmanager import RefManager
+from .refmanager import RefManager, has_adoption_candidates
+
+# Index names registered on the pod/service informers (Controller.__init__).
+OWNER_UID_INDEX = "owner_uid"
+JOB_SELECTOR_INDEX = "job_selector"
+
+
+def owner_uid_index_keys(obj) -> List[str]:
+    """Indexer fn: the UID of the controlling owner, if any."""
+    ref = get_controller_of(obj.metadata)
+    return [ref.uid] if ref is not None and ref.uid else []
+
+
+def register_gather_indexers(informer) -> None:
+    """Install the two indices the indexed gather path reads."""
+    informer.add_indexer(OWNER_UID_INDEX, owner_uid_index_keys)
+    informer.add_indexer(JOB_SELECTOR_INDEX,
+                         lambda o: job_selector_index_keys(o.metadata.labels))
 
 
 class Helper:
-    def __init__(self, cluster: Cluster, recorder: EventRecorder):
+    def __init__(self, cluster: Cluster, recorder: EventRecorder,
+                 pod_informer=None, service_informer=None, metrics=None):
         self.cluster = cluster
         self.recorder = recorder
+        # Optional indexed caches (plumbed by the Controller); without them
+        # every gather degrades to the reference's live full-LIST behavior.
+        self.pod_informer = pod_informer
+        self.service_informer = service_informer
+        self.metrics = metrics
 
     # -- writes --------------------------------------------------------------
 
@@ -123,11 +157,46 @@ class Helper:
 
         return can_adopt
 
+    def _cached_candidates(self, informer, job: TFJob,
+                           selector: Dict[str, str]) -> Optional[List]:
+        """Claim candidates from the informer indices: everything we own
+        (owner-UID index — includes release candidates whose labels no
+        longer match) ∪ everything matching the job selector (selector
+        index — includes adoptable orphans).  None when no synced informer
+        is available and the caller must live-LIST."""
+        if informer is None or not informer.has_synced:
+            return None
+        ns = job.metadata.namespace
+        owned = informer.by_index(OWNER_UID_INDEX, job.metadata.uid)
+        labeled = []
+        keys = job_selector_index_keys(selector)
+        for key in keys:
+            labeled.extend(informer.by_index(JOB_SELECTOR_INDEX, key))
+        seen: Dict[tuple, object] = {}
+        for obj in owned + labeled:
+            if obj.metadata.namespace == ns:
+                seen[(ns, obj.metadata.name)] = obj
+        return list(seen.values())
+
+    def _gather_candidates(self, informer, client, job: TFJob,
+                           selector: Dict[str, str]) -> List:
+        cached = self._cached_candidates(informer, job, selector)
+        if cached is not None and not has_adoption_candidates(cached, selector):
+            if self.metrics is not None:
+                self.metrics.inc_gather_indexed()
+            # Candidates are shared cache references; claim() mutates on
+            # adopt and callers partition/inspect them — copy first.
+            return [serde.deep_copy(o) for o in cached]
+        # Adoption pending (or no usable cache): list everything in the
+        # namespace live, then claim — the reference always does this ("It
+        # is a hack", helper.go:131-136) so adoption runs on fresh state.
+        if self.metrics is not None:
+            self.metrics.inc_gather_full_lists()
+        return client.list(job.metadata.namespace)
+
     def get_pods_for_tfjob(self, job: TFJob, selector: Dict[str, str]) -> List[Pod]:
-        # List everything in the namespace, then claim — the reference does
-        # the same ("It is a hack", helper.go:131-136) so adoption can see
-        # orphans whose labels do not match the selector yet.
-        pods = self.cluster.pods.list(job.metadata.namespace)
+        pods = self._gather_candidates(self.pod_informer, self.cluster.pods,
+                                       job, selector)
         mgr = RefManager(
             self.cluster.pods, job.metadata, KIND, API_VERSION,
             selector, self._can_adopt_fn(job),
@@ -135,7 +204,8 @@ class Helper:
         return mgr.claim(pods)
 
     def get_services_for_tfjob(self, job: TFJob, selector: Dict[str, str]) -> List[Service]:
-        services = self.cluster.services.list(job.metadata.namespace)
+        services = self._gather_candidates(self.service_informer,
+                                           self.cluster.services, job, selector)
         mgr = RefManager(
             self.cluster.services, job.metadata, KIND, API_VERSION,
             selector, self._can_adopt_fn(job),

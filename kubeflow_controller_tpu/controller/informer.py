@@ -1,4 +1,4 @@
-"""Shared informer: local cache + event handlers over a watch stream.
+"""Shared informer: local cache + indices + event handlers over a watch stream.
 
 Semantic re-implementation of the client-go SharedIndexInformer machinery the
 controller wires in its constructor (ref: pkg/controller/controller.go:98-165;
@@ -10,7 +10,13 @@ factories built with 30s resync at cmd/controller/main.go:62-63):
 - a periodic **resync** re-fires update handlers for every cached object with
   old == new — the level-triggering backstop that re-drives reconciliation
   even if an edge was missed (update handlers can detect a resync by equal
-  resourceVersions, as the reference does at controller.go:480-484).
+  resourceVersions, as the reference does at controller.go:480-484);
+- **indexers** (the cache.Indexers analog): ``add_indexer(name, fn)``
+  registers a key function mapping an object to index keys; ``by_index``
+  answers membership queries in O(bucket) instead of O(cache).  Indices are
+  maintained under the cache lock on every mutation path (watch events,
+  initial list, gap re-list), so a reader can never observe an object in the
+  cache but missing from its index buckets.
 
 Handlers run on the informer thread in event order — the same serialization
 guarantee client-go provides a single event handler.
@@ -20,7 +26,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional, Set
 
 from ..api.meta import key_of
 from ..cluster.store import ADDED, DELETED, MODIFIED, Watcher
@@ -33,6 +39,12 @@ class SharedInformer:
         self.name = name or getattr(client, "kind", "objects")
         self._lock = threading.RLock()
         self._cache: Dict[str, Any] = {}
+        # index name -> index key -> set of cache keys; plus the reverse map
+        # (cache key -> index name -> keys) so removal never recomputes keys
+        # against a mutated object.
+        self._indexers: Dict[str, Callable[[Any], List[str]]] = {}
+        self._indices: Dict[str, Dict[str, Set[str]]] = {}
+        self._obj_index_keys: Dict[str, Dict[str, List[str]]] = {}
         self._add_handlers: list[Callable[[Any], None]] = []
         self._update_handlers: list[Callable[[Any, Any], None]] = []
         self._delete_handlers: list[Callable[[Any], None]] = []
@@ -57,6 +69,21 @@ class SharedInformer:
         if on_delete:
             self._delete_handlers.append(on_delete)
 
+    def add_indexer(self, name: str, fn: Callable[[Any], List[str]]) -> None:
+        """Register an index (ref: cache.Indexers).  ``fn`` maps an object to
+        zero or more index keys.  Registering after objects are cached
+        back-fills the index from the current cache."""
+        with self._lock:
+            if name in self._indexers:
+                raise ValueError(f"indexer {name!r} already registered")
+            self._indexers[name] = fn
+            self._indices[name] = {}
+            for k, obj in self._cache.items():
+                keys = self._index_keys_for(name, fn, obj)
+                self._obj_index_keys.setdefault(k, {})[name] = keys
+                for ik in keys:
+                    self._indices[name].setdefault(ik, set()).add(k)
+
     # -- cache reads (the "lister") -----------------------------------------
 
     def get(self, namespace: str, name: str) -> Optional[Any]:
@@ -66,6 +93,14 @@ class SharedInformer:
     def list(self) -> list:
         with self._lock:
             return list(self._cache.values())
+
+    def by_index(self, name: str, index_key: str) -> list:
+        """Cached objects whose indexer emitted ``index_key``
+        (ref: Indexer.ByIndex).  Objects are shared cache references, like
+        :meth:`list` — callers must deep-copy before mutating."""
+        with self._lock:
+            keys = self._indices[name].get(index_key, ())
+            return [self._cache[k] for k in keys if k in self._cache]
 
     @property
     def has_synced(self) -> bool:
@@ -82,8 +117,7 @@ class SharedInformer:
         self._watcher = self._client.watch()
         for obj in self._client.list():
             k = key_of(obj.metadata)
-            with self._lock:
-                self._cache[k] = obj
+            self._cache_set(k, obj)
             self._dispatch_add(obj)
         self._synced.set()
         self._thread = threading.Thread(target=self._watch_loop, name=f"informer-{self.name}", daemon=True)
@@ -100,6 +134,49 @@ class SharedInformer:
             self._watcher.stop()
 
     # -- internals -----------------------------------------------------------
+
+    @staticmethod
+    def _index_keys_for(name: str, fn: Callable[[Any], List[str]], obj: Any) -> List[str]:
+        try:
+            return list(fn(obj))
+        except Exception:  # noqa: BLE001 — a broken indexer must not kill the watch loop
+            return []
+
+    def _cache_set(self, k: str, obj: Any) -> None:
+        """Insert/replace a cache entry and rebuild its index postings, one
+        critical section so index readers never see a half-applied update."""
+        with self._lock:
+            old_keys = self._obj_index_keys.pop(k, {})
+            for name, keys in old_keys.items():
+                idx = self._indices[name]
+                for ik in keys:
+                    bucket = idx.get(ik)
+                    if bucket is not None:
+                        bucket.discard(k)
+                        if not bucket:
+                            del idx[ik]
+            self._cache[k] = obj
+            if self._indexers:
+                new_keys: Dict[str, List[str]] = {}
+                for name, fn in self._indexers.items():
+                    keys = self._index_keys_for(name, fn, obj)
+                    new_keys[name] = keys
+                    for ik in keys:
+                        self._indices[name].setdefault(ik, set()).add(k)
+                self._obj_index_keys[k] = new_keys
+
+    def _cache_pop(self, k: str) -> Optional[Any]:
+        with self._lock:
+            obj = self._cache.pop(k, None)
+            for name, keys in self._obj_index_keys.pop(k, {}).items():
+                idx = self._indices[name]
+                for ik in keys:
+                    bucket = idx.get(ik)
+                    if bucket is not None:
+                        bucket.discard(k)
+                        if not bucket:
+                            del idx[ik]
+            return obj
 
     def _watch_loop(self) -> None:
         # Transports that can drop events (REST watch reconnect) expose a
@@ -127,7 +204,7 @@ class SharedInformer:
             if ev.type == ADDED:
                 with self._lock:
                     known = k in self._cache
-                    self._cache[k] = ev.object
+                    self._cache_set(k, ev.object)
                 if known:
                     # Already delivered by the initial list: treat as update.
                     self._dispatch_update(ev.object, ev.object)
@@ -136,11 +213,10 @@ class SharedInformer:
             elif ev.type == MODIFIED:
                 with self._lock:
                     old = self._cache.get(k, ev.object)
-                    self._cache[k] = ev.object
+                    self._cache_set(k, ev.object)
                 self._dispatch_update(old, ev.object)
             elif ev.type == DELETED:
-                with self._lock:
-                    self._cache.pop(k, None)
+                self._cache_pop(k)
                 self._dispatch_delete(ev.object)
 
     def _relist(self) -> None:
@@ -155,14 +231,13 @@ class SharedInformer:
         for k, obj in fresh.items():
             with self._lock:
                 old = self._cache.get(k)
-                self._cache[k] = obj
+                self._cache_set(k, obj)
             if old is None:
                 self._dispatch_add(obj)
             else:
                 self._dispatch_update(old, obj)
         for k in stale_keys:
-            with self._lock:
-                gone = self._cache.pop(k, None)
+            gone = self._cache_pop(k)
             if gone is not None:
                 self._dispatch_delete(gone)
 

@@ -25,6 +25,11 @@ class ReconcileMetrics:
         self.creates = 0
         self.deletes = 0
         self.status_updates = 0
+        # Gather-path split: syncs served from the informer indices vs.
+        # full-namespace live LISTs (the adoption fallback).  The ratio is
+        # the index hit rate — at steady state full_lists must be flat.
+        self.gather_indexed = 0
+        self.gather_full_lists = 0
 
     def record_sync(self, duration_s: float, error: bool = False) -> None:
         with self._lock:
@@ -35,6 +40,28 @@ class ReconcileMetrics:
             self._samples.append(duration_s)
             if len(self._samples) > self._max:
                 self._samples = self._samples[-self._max :]
+
+    # Counter increments from concurrent sync workers MUST go through these
+    # (bare ``+= 1`` on the attributes is a lost-update race).
+    def inc_creates(self, n: int = 1) -> None:
+        with self._lock:
+            self.creates += n
+
+    def inc_deletes(self, n: int = 1) -> None:
+        with self._lock:
+            self.deletes += n
+
+    def inc_status_updates(self, n: int = 1) -> None:
+        with self._lock:
+            self.status_updates += n
+
+    def inc_gather_indexed(self, n: int = 1) -> None:
+        with self._lock:
+            self.gather_indexed += n
+
+    def inc_gather_full_lists(self, n: int = 1) -> None:
+        with self._lock:
+            self.gather_full_lists += n
 
     def percentile(self, q: float) -> float:
         with self._lock:
@@ -65,6 +92,8 @@ class ReconcileMetrics:
             "creates": self.creates,
             "deletes": self.deletes,
             "status_updates": self.status_updates,
+            "gather_indexed": self.gather_indexed,
+            "gather_full_lists": self.gather_full_lists,
             "reconcile_p50_s": self.p50,
             "reconcile_p90_s": self.p90,
             "reconcile_p99_s": self.p99,
@@ -98,6 +127,12 @@ class ReconcileMetrics:
                  self.deletes),
                 ("kctpu_controller_status_updates_total", "TFJob status writes",
                  self.status_updates),
+                ("kctpu_gather_indexed_total",
+                 "Child gathers served from the informer indices",
+                 self.gather_indexed),
+                ("kctpu_gather_full_lists_total",
+                 "Child gathers that fell back to a full-namespace live LIST",
+                 self.gather_full_lists),
             ]
 
         def q(p: float) -> float:

@@ -27,6 +27,23 @@ from ..api.meta import ObjectMeta, OwnerReference, get_controller_of, matches_se
 from ..cluster.store import APIError, NotFound
 
 
+def has_adoption_candidates(objects, selector: Dict[str, str]) -> bool:
+    """True when a claim pass over ``objects`` could ADOPT something: an
+    orphan (no controller ownerRef), not being deleted, matching the
+    selector.  The indexed gather fast path (helper.py) uses this to decide
+    whether cached reads suffice or a live full LIST is required — adoption
+    is the one transition that must run against fresh state, exactly like
+    the reference's everything-listing hack (ref: helper.go:131-136)."""
+    for obj in objects:
+        if (
+            get_controller_of(obj.metadata) is None
+            and obj.metadata.deletion_timestamp is None
+            and matches_selector(obj.metadata.labels, selector)
+        ):
+            return True
+    return False
+
+
 class RefManager:
     def __init__(
         self,

@@ -20,6 +20,8 @@ REQUIRED_FAMILIES = (
     "kctpu_workqueue_depth",
     "kctpu_workqueue_queue_duration_seconds",
     "kctpu_job_phase_transition_seconds",
+    "kctpu_gather_indexed_total",
+    "kctpu_gather_full_lists_total",
 )
 
 

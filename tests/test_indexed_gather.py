@@ -1,0 +1,413 @@
+"""Indexed reconcile hot path: informer indices, the indexed gather in
+Helper (with its live full-LIST adoption fallback), the status CAS fast
+path, locked metrics counters, and the terminal-resync skip.
+
+The load-bearing contract (ISSUE 2): a steady-state sync of a job with no
+orphans performs ZERO full-namespace LISTs — `kctpu_gather_full_lists_total`
+stays flat across the sync — while RefManager adopt/release semantics are
+preserved bit-for-bit (orphans are still adopted, via the fallback).
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import Container, Pod, PodTemplateSpec
+from kubeflow_controller_tpu.api.labels import (
+    LABEL_DOMAIN,
+    LABEL_JOB_NAME,
+    LABEL_JOB_TYPE,
+    LABEL_RUNTIME_ID,
+    job_selector,
+    job_selector_index_key,
+    job_selector_index_keys,
+)
+from kubeflow_controller_tpu.api.meta import ObjectMeta, key_of
+from kubeflow_controller_tpu.api.tfjob import (
+    ReplicaType,
+    TFJob,
+    TFJobPhase,
+    TFReplicaSpec,
+)
+from kubeflow_controller_tpu.cluster import Cluster, FakeKubelet, PhasePolicy
+from kubeflow_controller_tpu.controller import Controller, ReconcileMetrics, SharedInformer
+from kubeflow_controller_tpu.controller.helper import (
+    JOB_SELECTOR_INDEX,
+    OWNER_UID_INDEX,
+    register_gather_indexers,
+)
+
+
+def wait_for(fn, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+def mk_pod(name, ns="ns", labels=None):
+    p = Pod(metadata=ObjectMeta(name=name, namespace=ns, labels=labels or {}))
+    return p
+
+
+def mk_job(name, *types_and_replicas):
+    job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+    for typ, n in types_and_replicas:
+        t = PodTemplateSpec()
+        t.spec.containers.append(Container(name="tensorflow", image="img"))
+        t.spec.restart_policy = "OnFailure"
+        job.spec.tf_replica_specs.append(
+            TFReplicaSpec(replicas=n, tf_replica_type=typ, template=t))
+    return job
+
+
+# ---- informer indices ----
+
+
+def test_by_index_maintained_across_add_update_delete():
+    c = Cluster()
+    inf = SharedInformer(c.pods, resync_period_s=0, name="pods")
+    inf.add_indexer("by_app", lambda o: [o.metadata.labels["app"]]
+                    if "app" in o.metadata.labels else [])
+    inf.start()
+    try:
+        c.pods.create(mk_pod("a", labels={"app": "x"}))
+        c.pods.create(mk_pod("b", labels={"app": "x"}))
+        c.pods.create(mk_pod("c", labels={"app": "y"}))
+        wait_for(lambda: len(inf.by_index("by_app", "x")) == 2)
+        assert {p.metadata.name for p in inf.by_index("by_app", "y")} == {"c"}
+        # Relabel: the object must move buckets, not duplicate.
+        c.pods.patch_meta("ns", "b", lambda m: m.labels.update({"app": "y"}))
+        wait_for(lambda: len(inf.by_index("by_app", "y")) == 2)
+        assert {p.metadata.name for p in inf.by_index("by_app", "x")} == {"a"}
+        c.pods.delete("ns", "c")
+        wait_for(lambda: {p.metadata.name for p in inf.by_index("by_app", "y")}
+                 == {"b"})
+        # Unknown key: empty, not KeyError.
+        assert inf.by_index("by_app", "nope") == []
+    finally:
+        inf.stop()
+
+
+def test_indexer_registered_late_backfills_from_cache():
+    c = Cluster()
+    c.pods.create(mk_pod("pre", labels={"app": "x"}))
+    inf = SharedInformer(c.pods, resync_period_s=0, name="pods")
+    inf.start()
+    try:
+        inf.add_indexer("by_app", lambda o: [o.metadata.labels.get("app", "")])
+        assert {p.metadata.name for p in inf.by_index("by_app", "x")} == {"pre"}
+    finally:
+        inf.stop()
+
+
+def test_index_consistent_under_concurrent_mutation():
+    """Hammer the store from several writer threads while the informer
+    applies events; at quiescence every index bucket must exactly match a
+    ground-truth scan of the cache."""
+    c = Cluster()
+    inf = SharedInformer(c.pods, resync_period_s=0, name="pods")
+    inf.add_indexer("by_app", lambda o: [o.metadata.labels["app"]]
+                    if "app" in o.metadata.labels else [])
+    inf.start()
+    apps = ("red", "green", "blue")
+
+    def writer(wid):
+        for i in range(30):
+            name = f"w{wid}-p{i}"
+            c.pods.create(mk_pod(name, labels={"app": apps[i % 3]}))
+            if i % 3 == 0:
+                c.pods.patch_meta(
+                    "ns", name,
+                    lambda m: m.labels.update({"app": apps[(i + 1) % 3]}))
+            if i % 5 == 0:
+                c.pods.delete("ns", name)
+
+    threads = [threading.Thread(target=writer, args=(w,)) for w in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    try:
+        # Quiesce: cache caught up with the store.
+        expected = {key_of(p.metadata) for p in c.pods.list()}
+        wait_for(lambda: {key_of(p.metadata) for p in inf.list()} == expected)
+        for app in apps:
+            truth = {key_of(p.metadata) for p in inf.list()
+                     if p.metadata.labels.get("app") == app}
+            got = {key_of(p.metadata) for p in inf.by_index("by_app", app)}
+            assert got == truth, f"index diverged for {app}"
+    finally:
+        inf.stop()
+
+
+class _GappyWatcher:
+    """Watcher wrapper that can swallow events (a watch gap) and then
+    report it via the ``gaps`` counter, as the REST transport does."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.gaps = 0
+        self.dropping = False
+
+    def next(self, timeout=None):
+        ev = self._inner.next(timeout)
+        if self.dropping:
+            return None  # event lost in the gap
+        return ev
+
+    def stop(self):
+        self._inner.stop()
+
+
+class _GappyClient:
+    def __init__(self, client):
+        self._client = client
+        self.kind = client.kind
+        self.watcher = None
+
+    def list(self, *a, **kw):
+        return self._client.list(*a, **kw)
+
+    def watch(self, *a, **kw):
+        self.watcher = _GappyWatcher(self._client.watch(*a, **kw))
+        return self.watcher
+
+
+def test_index_consistent_across_watch_gap_relist():
+    c = Cluster()
+    gappy = _GappyClient(c.pods)
+    inf = SharedInformer(gappy, resync_period_s=0, name="pods")
+    inf.add_indexer("by_app", lambda o: [o.metadata.labels.get("app", "")])
+    c.pods.create(mk_pod("survivor", labels={"app": "x"}))
+    c.pods.create(mk_pod("doomed", labels={"app": "x"}))
+    inf.start()
+    try:
+        wait_for(lambda: len(inf.by_index("by_app", "x")) == 2)
+        # Open the gap: everything in it is lost to the watch stream.
+        gappy.watcher.dropping = True
+        c.pods.delete("ns", "doomed")
+        c.pods.create(mk_pod("newcomer", labels={"app": "x"}))
+        c.pods.patch_meta("ns", "survivor",
+                          lambda m: m.labels.update({"app": "y"}))
+        time.sleep(0.1)
+        gappy.watcher.dropping = False
+        gappy.watcher.gaps += 1  # reconnect signal -> informer re-lists
+        wait_for(lambda: {p.metadata.name
+                          for p in inf.by_index("by_app", "x")} == {"newcomer"})
+        assert {p.metadata.name for p in inf.by_index("by_app", "y")} == {"survivor"}
+        assert inf.get("ns", "doomed") is None
+    finally:
+        inf.stop()
+
+
+def test_job_selector_index_keys_roundtrip():
+    labels = job_selector("jobx", "rt123")
+    assert job_selector_index_keys(labels) == [job_selector_index_key("jobx", "rt123")]
+    assert job_selector_index_keys({LABEL_DOMAIN: "true"}) == []
+    # The 4-label per-type selector lands in the same (job-level) bucket.
+    labels4 = dict(labels, **{LABEL_JOB_TYPE: "PS"})
+    assert job_selector_index_keys(labels4) == job_selector_index_keys(labels)
+
+
+# ---- the indexed gather through a live controller ----
+
+
+@pytest.fixture
+def rig():
+    cluster = Cluster()
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=0.05))
+    ctrl = Controller(cluster, resync_period_s=0.5)
+    kubelet.start()
+    ctrl.run(threadiness=2)
+    yield cluster, ctrl, kubelet
+    ctrl.stop()
+    kubelet.stop()
+
+
+def test_steady_state_sync_zero_full_lists(rig):
+    """THE acceptance gate: a sync of a settled job with no orphans reads
+    only the informer indices — kctpu_gather_full_lists_total is unchanged
+    across the sync."""
+    cluster, ctrl, _ = rig
+    cluster.tfjobs.create(mk_job("steady", (ReplicaType.PS, 2)))  # runs forever
+    wait_for(lambda: len(cluster.pods.list("default")) == 2)
+    wait_for(lambda: cluster.tfjobs.get("default", "steady").status.phase
+             == TFJobPhase.RUNNING)
+    # Let in-flight syncs drain, then drive one more sync by hand.
+    time.sleep(0.3)
+    before = ctrl.metrics.snapshot()
+    ctrl.queue.add("default/steady")
+    wait_for(lambda: ctrl.metrics.snapshot()["syncs"] > before["syncs"])
+    after = ctrl.metrics.snapshot()
+    assert after["gather_full_lists"] == before["gather_full_lists"]
+    assert after["gather_indexed"] > before["gather_indexed"]
+    assert after["sync_errors"] == before["sync_errors"]
+
+
+def test_orphan_adopted_via_label_index_fallback(rig):
+    """An orphan only reachable through the selector index still gets
+    adopted — the indexed path detects it and falls back to the live full
+    LIST so adoption runs on fresh state."""
+    cluster, ctrl, _ = rig
+    cluster.tfjobs.create(mk_job("adopt", (ReplicaType.PS, 1)))
+    wait_for(lambda: len(cluster.pods.list("default")) == 1)
+    job = cluster.tfjobs.get("default", "adopt")
+    full_before = ctrl.metrics.snapshot()["gather_full_lists"]
+    # Orphan matching the job selector; a replica type outside the spec so
+    # the planner never schedules it for deletion.
+    orphan = mk_pod("stray", ns="default", labels={
+        LABEL_DOMAIN: "true",
+        LABEL_JOB_NAME: "adopt",
+        LABEL_RUNTIME_ID: job.spec.runtime_id,
+        LABEL_JOB_TYPE: "Worker",
+    })
+    cluster.pods.create(orphan)
+    # The resync backstop re-queues the (non-terminal) job; adoption stamps
+    # our controller ownerRef on the stray pod.
+    wait_for(lambda: any(
+        r.uid == job.metadata.uid and r.controller
+        for r in cluster.pods.get("default", "stray").metadata.owner_references
+    ))
+    assert ctrl.metrics.snapshot()["gather_full_lists"] > full_before
+    # With the orphan claimed, gathers return to the indexed path.
+    settled = ctrl.metrics.snapshot()
+    ctrl.queue.add("default/adopt")
+    wait_for(lambda: ctrl.metrics.snapshot()["syncs"] > settled["syncs"])
+    assert (ctrl.metrics.snapshot()["gather_full_lists"]
+            == settled["gather_full_lists"])
+
+
+def test_release_happens_on_cached_path(rig):
+    """Owned-but-selector-mismatched children are released without a full
+    LIST (release is found via the owner-UID index)."""
+    cluster, ctrl, _ = rig
+    cluster.tfjobs.create(mk_job("rel", (ReplicaType.PS, 1)))
+    wait_for(lambda: len(cluster.pods.list("default")) == 1)
+    job = cluster.tfjobs.get("default", "rel")
+    pod_name = cluster.pods.list("default")[0].metadata.name
+    full_before = ctrl.metrics.snapshot()["gather_full_lists"]
+    # Break the selector match: the pod stays owned but mismatched.
+    cluster.pods.patch_meta("default", pod_name,
+                            lambda m: m.labels.pop(LABEL_RUNTIME_ID))
+    wait_for(lambda: cluster.pods.get("default", pod_name)
+             .metadata.owner_references == [])
+    assert ctrl.metrics.snapshot()["gather_full_lists"] == full_before
+    # The controller replaces the released replica.
+    wait_for(lambda: any(
+        p.metadata.name != pod_name
+        and p.metadata.labels.get(LABEL_RUNTIME_ID) == job.spec.runtime_id
+        for p in cluster.pods.list("default")))
+
+
+# ---- status CAS fast path ----
+
+
+def test_status_update_cas_skips_get():
+    cluster = Cluster()
+    ctrl = Controller(cluster, resync_period_s=0)  # never run()
+    try:
+        job = cluster.tfjobs.create(mk_job("cas", (ReplicaType.PS, 1)))
+        gets = []
+        orig_get = cluster.tfjobs.get
+        cluster.tfjobs.get = lambda ns, n: (gets.append(n), orig_get(ns, n))[1]
+        new_status = cluster.tfjobs.get("default", "cas").status
+        gets.clear()
+        new_status.phase = TFJobPhase.RUNNING
+        # Fresh RV in hand: the CAS lands with zero GETs.
+        ctrl._update_status_inner(orig_get("default", "cas"), new_status)
+        assert gets == []
+        assert orig_get("default", "cas").status.phase == TFJobPhase.RUNNING
+        assert ctrl.metrics.status_updates == 1
+        # Stale RV: falls back to the GET+retry loop, still lands.
+        stale = orig_get("default", "cas")
+        bump = orig_get("default", "cas")
+        cluster.tfjobs.update_status(bump)  # bump RV so `stale` conflicts
+        new_status.phase = TFJobPhase.SUCCEEDED
+        gets.clear()
+        ctrl._update_status_inner(stale, new_status)
+        assert gets == ["cas"]  # exactly one fallback GET
+        assert orig_get("default", "cas").status.phase == TFJobPhase.SUCCEEDED
+        assert ctrl.metrics.status_updates == 2
+    finally:
+        ctrl.stop()
+
+
+# ---- satellite: locked counters ----
+
+
+def test_reconcile_metrics_counters_thread_safe():
+    m = ReconcileMetrics()
+
+    def hammer():
+        for _ in range(2000):
+            m.inc_creates()
+            m.inc_deletes()
+            m.inc_status_updates()
+            m.inc_gather_indexed()
+            m.inc_gather_full_lists()
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = m.snapshot()
+    assert snap["creates"] == 16000
+    assert snap["deletes"] == 16000
+    assert snap["status_updates"] == 16000
+    assert snap["gather_indexed"] == 16000
+    assert snap["gather_full_lists"] == 16000
+
+
+# ---- satellite: terminal jobs skip the resync churn ----
+
+
+def test_terminal_job_resync_not_enqueued():
+    cluster = Cluster()
+    ctrl = Controller(cluster, resync_period_s=0)  # handlers wired, not run
+    try:
+        job = mk_job("done", (ReplicaType.WORKER, 1))
+        job.metadata.resource_version = "7"
+        job.status.phase = TFJobPhase.SUCCEEDED
+        # Same-RV resync of a settled terminal job: dropped.
+        ctrl._on_tfjob_update(job, job)
+        assert ctrl.queue.get(timeout=0.05) is None
+        # Real edge (RV changed): enqueued even when terminal.
+        import copy
+        newer = copy.deepcopy(job)
+        newer.metadata.resource_version = "8"
+        ctrl._on_tfjob_update(job, newer)
+        assert ctrl.queue.get(timeout=1.0) == "default/done"
+        ctrl.queue.done("default/done")
+        # Same-RV resync of a NON-terminal job: still the level-trigger.
+        job.status.phase = TFJobPhase.RUNNING
+        ctrl._on_tfjob_update(job, job)
+        assert ctrl.queue.get(timeout=1.0) == "default/done"
+        ctrl.queue.done("default/done")
+        # Terminal but deleting: resync must still drive finalization.
+        job.status.phase = TFJobPhase.FAILED
+        job.metadata.deletion_timestamp = time.time()
+        ctrl._on_tfjob_update(job, job)
+        assert ctrl.queue.get(timeout=1.0) == "default/done"
+        ctrl.queue.done("default/done")
+    finally:
+        ctrl.stop()
+
+
+def test_terminal_job_stops_syncing_after_recycle(rig):
+    """End-to-end: once a job is Succeeded and recycled, resyncs stop
+    producing syncs for it — the sync count goes flat."""
+    cluster, ctrl, _ = rig
+    cluster.tfjobs.create(mk_job("flat", (ReplicaType.WORKER, 1)))
+    wait_for(lambda: cluster.tfjobs.get("default", "flat").status.phase
+             == TFJobPhase.SUCCEEDED)
+    wait_for(lambda: cluster.services.list("default") == [])  # recycled
+    time.sleep(0.6)  # drain the recycle tail (resync period is 0.5s)
+    s0 = ctrl.metrics.snapshot()["syncs"]
+    time.sleep(1.2)  # > 2 resync periods
+    assert ctrl.metrics.snapshot()["syncs"] == s0
