@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast lint typecheck cov cov-local bench dryrun validate metrics-smoke scale-smoke
+.PHONY: all test test-fast lint typecheck cov cov-local bench dryrun validate metrics-smoke scale-smoke stall-smoke
 
 all: lint test
 
@@ -66,6 +66,12 @@ bench:
 # (docs/OBSERVABILITY.md has the metric catalogue).
 metrics-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m kubeflow_controller_tpu.obs.smoke
+
+# Stall smoke: simulated training run, heartbeats killed mid-flight; fails
+# unless Warning TrainingStalled fires and kctpu_job_stalled=1 appears on
+# GET /metrics within the stall deadline — then the reverse on resume.
+stall-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m kubeflow_controller_tpu.obs.stall_smoke
 
 # Scale smoke: boot the in-memory cluster, drive 10 concurrent simulated
 # TFJobs to Succeeded via bench.py --scale, fail on regression past a

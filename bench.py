@@ -187,11 +187,16 @@ def run_dist_mnist(trace_dir: str = "") -> dict:
 
 
 def run_scale(n_jobs: int, deadline_s: float = 0.0,
-              settle_s: float = 2.5) -> dict:
+              settle_s: float = 2.5, heartbeat_s: float = 0.0) -> dict:
     """N concurrent orchestration-bound TFJobs (1 PS + 2 workers each,
     simulated pod phases) from creation to all-Succeeded.  Uses only the
     public controller surface so the same file measures older commits;
-    index-hit-rate fields degrade to 0 where the counters don't exist."""
+    index-hit-rate fields degrade to 0 where the counters don't exist.
+
+    ``heartbeat_s`` > 0 turns on simulated training heartbeats at that
+    interval (the progress plane): each beat is a pod-status write that
+    re-enqueues the owner, so comparing runs with/without beats measures
+    the heartbeat overhead on the reconcile path (docs/PERF.md)."""
     from kubeflow_controller_tpu.api.core import Container, PodTemplateSpec
     from kubeflow_controller_tpu.api.meta import ObjectMeta
     from kubeflow_controller_tpu.api.tfjob import (
@@ -214,7 +219,8 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
         return job
 
     cluster = Cluster()
-    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=0.05))
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=0.05,
+                                                      heartbeat_s=heartbeat_s))
     ctrl = Controller(cluster, resync_period_s=1.0)
     kubelet.start()
     ctrl.run(threadiness=2)
@@ -262,7 +268,8 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
 
 
 def scale_main(args) -> int:
-    result = run_scale(args.scale, deadline_s=args.deadline)
+    result = run_scale(args.scale, deadline_s=args.deadline,
+                       heartbeat_s=args.heartbeat_s)
     m = result["metrics"]
     elapsed = result["elapsed_s"]
     gathers = m.get("gather_indexed", 0) + m.get("gather_full_lists", 0)
@@ -289,6 +296,7 @@ def scale_main(args) -> int:
             "settle_syncs": result["settle_syncs"],
             "settle_full_lists": result["settle_full_lists"],
             "settle_window_s": result["settle_s"],
+            "heartbeat_s": args.heartbeat_s,
             "workload": ("N x (1xPS + 2xWorker) simulated pods "
                          "(PhasePolicy run_s=0.05, no real training): "
                          "pure orchestration throughput"),
@@ -344,6 +352,10 @@ def main(argv=None) -> int:
     p.add_argument("--max-seconds", type=float, default=0.0, metavar="S",
                    help="scale mode: exit nonzero when time-to-all-Succeeded "
                         "exceeds S (the `make scale-smoke` regression gate)")
+    p.add_argument("--heartbeat-s", type=float, default=0.0, metavar="S",
+                   help="scale mode: simulated training heartbeats every S "
+                        "seconds (0 = off); compare against a 0 run to "
+                        "measure progress-plane overhead")
     args = p.parse_args(argv)
 
     if args.scale:

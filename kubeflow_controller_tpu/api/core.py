@@ -84,12 +84,35 @@ class PodSpec:
 
 
 @dataclass
+class PodProgress:
+    """Training-plane heartbeat published by the workload process.
+
+    The control plane stops at pod phase; once a pod is Running the only
+    signal that the job is actually advancing is this beat — step counter,
+    throughput, loss, and the coarse launch phase (rendezvous/init/fit).
+    Written via the pod ``progress`` subresource (last-write-wins, like
+    kubelet status) or the kubelet's file-drop ingestion; read by the
+    controller's status rollup and stall detector."""
+
+    step: int = 0
+    examples_per_sec: float = 0.0
+    loss: float = 0.0
+    # Coarse workload phase: "rendezvous" | "init" | "fit" | free-form.
+    phase: str = ""
+    # Wall-clock of the beat (stamped server-side when the reporter left
+    # it 0, so clock-skewed workloads cannot fake liveness).
+    timestamp: float = 0.0
+
+
+@dataclass
 class PodStatus:
     phase: str = PHASE_PENDING
     reason: str = ""
     message: str = ""
     pod_ip: str = ""
     host_ip: str = ""
+    # Training-plane heartbeat (None until the workload reports one).
+    progress: Optional[PodProgress] = None
 
 
 @dataclass

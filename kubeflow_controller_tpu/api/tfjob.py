@@ -224,13 +224,54 @@ class TFReplicaStatus:
 
 
 @dataclass
+class ReplicaProgress:
+    """One replica's latest heartbeat as seen by the controller."""
+
+    type: ReplicaType = ReplicaType.WORKER
+    index: int = 0
+    step: int = 0
+    examples_per_sec: float = 0.0
+    loss: float = 0.0
+    phase: str = ""
+    last_heartbeat: float = 0.0
+    stalled: bool = False
+
+
+@dataclass
+class JobProgress:
+    """Job-level training progress, aggregated from per-pod heartbeats.
+
+    Net-new vs the reference (whose status surface stops at pod phase —
+    the gap PAPERS.md's TF-Replicator/Podracer telemetry argues against):
+    ``step`` is the MIN step across reporting replicas (the job advances
+    only as fast as its slowest member under synchronous collectives),
+    ``straggler_lag`` is max-min, and ``stalled_replicas`` names members
+    whose heartbeat/step froze past the controller's stall deadline."""
+
+    step: int = 0           # min step across reporting replicas
+    max_step: int = 0
+    straggler_lag: int = 0  # max_step - step
+    examples_per_sec: float = 0.0  # sum across reporting replicas
+    loss: float = 0.0       # mean across reporting replicas
+    reporting: int = 0      # replicas that have ever sent a beat
+    stalled_replicas: List[str] = field(default_factory=list)  # "Worker-1"
+    last_heartbeat: float = 0.0  # newest beat across replicas
+    replicas: List[ReplicaProgress] = field(default_factory=list)
+
+    @property
+    def stalled(self) -> bool:
+        return bool(self.stalled_replicas)
+
+
+@dataclass
 class TFJobStatus:
-    """ref: types.go:92-101."""
+    """ref: types.go:92-101 (+ net-new training-plane ``progress``)."""
 
     phase: TFJobPhase = TFJobPhase.NONE
     reason: str = ""
     conditions: List[TFJobCondition] = field(default_factory=list)
     tf_replica_statuses: List[TFReplicaStatus] = field(default_factory=list)
+    progress: Optional[JobProgress] = None
 
 
 @dataclass

@@ -2,4 +2,10 @@
 into the real health tracker SURVEY.md §7 step 5 calls for)."""
 
 from ..api.tfjob import is_local_job, is_tpu_job  # noqa: F401
-from .health import JobHealth, ReplicaHealth, check_health  # noqa: F401
+from .health import (  # noqa: F401
+    JobHealth,
+    ReplicaHealth,
+    StallPolicy,
+    StallTracker,
+    check_health,
+)

@@ -11,8 +11,10 @@ Health section (cli/main.py:_describe_health).
 from __future__ import annotations
 
 import enum
+import threading
+import time
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Optional, Tuple
 
 from ..api.core import (
     PHASE_FAILED,
@@ -23,7 +25,7 @@ from ..api.core import (
     is_pod_active,
 )
 from ..api.tfjob import ReplicaType, TFJob
-from ..planner.materialize import pods_by_index
+from ..planner.materialize import pod_index, pods_by_index
 from ..planner.plan import desired_replicas
 
 
@@ -43,6 +45,9 @@ class ReplicaHealth:
     succeeded: int = 0
     failed: int = 0
     missing_indices: List[int] = field(default_factory=list)
+    # Indices whose training-plane heartbeat/step froze past the stall
+    # deadline (only populated when check_health is given a StallTracker).
+    stalled_indices: List[int] = field(default_factory=list)
     health: Health = Health.DEGRADED
 
 
@@ -62,7 +67,97 @@ class JobHealth:
         return Health.HEALTHY
 
 
-def check_health(job: TFJob, pods_by_type: Dict[ReplicaType, List[Pod]]) -> JobHealth:
+# ---------------------------------------------------------------------------
+# Training-plane stall detection
+# ---------------------------------------------------------------------------
+
+@dataclass
+class StallPolicy:
+    """Deadlines for declaring a Running replica's training stalled.
+
+    Two independent signals (TF-Replicator/Podracer treat both as primary
+    health — PAPERS.md): the *heartbeat* deadline fires when beats stop
+    arriving at all (process hung/partitioned); the *step* deadline fires
+    when beats keep arriving but the step counter freezes (rendezvous
+    wedge, straggler stuck in a collective).  Either set to 0 disables
+    that check."""
+
+    heartbeat_deadline_s: float = 30.0
+    step_deadline_s: float = 120.0
+    # How often the controller re-enqueues progressing jobs so stalls are
+    # noticed even though a stalled pod, by definition, generates no watch
+    # events.  0 = derive from the deadlines.
+    check_interval_s: float = 0.0
+    # Drop per-pod step history not observed for this long (replaced pods
+    # leave entries behind; generateName makes their keys unique forever).
+    prune_after_s: float = 1800.0
+
+    def effective_check_interval(self) -> float:
+        if self.check_interval_s > 0:
+            return self.check_interval_s
+        deadlines = [d for d in (self.heartbeat_deadline_s,
+                                 self.step_deadline_s) if d > 0]
+        if not deadlines:
+            return 30.0
+        return max(0.05, min(deadlines) / 2.0)
+
+
+class StallTracker:
+    """Per-pod step-advancement memory + the stall verdict.
+
+    Heartbeat staleness is stateless (``now - beat.timestamp``), but "the
+    step counter stopped advancing" needs history: the tracker remembers,
+    per pod, the last step seen and when it last *changed*.  Thread-safe —
+    multiple sync workers observe concurrently."""
+
+    def __init__(self, policy: Optional[StallPolicy] = None):
+        self.policy = policy or StallPolicy()
+        self._lock = threading.Lock()
+        # pod key -> (last step, wall clock when the step last advanced,
+        #             wall clock of the last observation — for pruning)
+        self._steps: Dict[str, Tuple[int, float, float]] = {}
+
+    def observe(self, key: str, progress, now: Optional[float] = None) -> bool:
+        """Record one observation of a Running pod's progress; returns True
+        when the pod is stalled under the policy."""
+        t = now if now is not None else time.time()
+        pol = self.policy
+        stalled = False
+        if (pol.heartbeat_deadline_s > 0
+                and t - progress.timestamp > pol.heartbeat_deadline_s):
+            stalled = True
+        with self._lock:
+            last_step, advanced_at, _ = self._steps.get(key, (None, 0.0, 0.0))
+            if last_step is None or progress.step != last_step:
+                # First sighting, or the counter moved (a DECREASE is an
+                # in-place workload restart — progress reset, not a stall).
+                # The advancement clock is the beat's own time.
+                advanced_at = progress.timestamp or t
+            self._steps[key] = (progress.step, advanced_at, t)
+            if len(self._steps) % 256 == 0:
+                self._prune_locked(t)
+        if (not stalled and pol.step_deadline_s > 0
+                and t - advanced_at > pol.step_deadline_s):
+            stalled = True
+        return stalled
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._steps.pop(key, None)
+
+    def _prune_locked(self, now: float) -> None:
+        cutoff = now - self.policy.prune_after_s
+        for k in [k for k, (_, _, seen) in self._steps.items() if seen < cutoff]:
+            del self._steps[k]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._steps)
+
+
+def check_health(job: TFJob, pods_by_type: Dict[ReplicaType, List[Pod]],
+                 now: Optional[float] = None,
+                 tracker: Optional[StallTracker] = None) -> JobHealth:
     out = JobHealth()
     for spec in job.spec.tf_replica_specs:
         typ = spec.tf_replica_type
@@ -70,6 +165,17 @@ def check_health(job: TFJob, pods_by_type: Dict[ReplicaType, List[Pod]]) -> JobH
         pods = pods_by_type.get(typ, [])
         rh = ReplicaHealth(type=typ, desired=desired)
         by_idx = pods_by_index(pods)
+        if tracker is not None:
+            for p in pods:
+                if (p.status.phase == PHASE_RUNNING
+                        and p.status.progress is not None
+                        and tracker.observe(
+                            f"{p.metadata.namespace}/{p.metadata.name}",
+                            p.status.progress, now=now)):
+                    idx = pod_index(p)
+                    if idx is not None:
+                        rh.stalled_indices.append(idx)
+            rh.stalled_indices.sort()
         for p in pods:
             if p.status.phase == PHASE_RUNNING:
                 rh.running += 1
@@ -93,9 +199,11 @@ def check_health(job: TFJob, pods_by_type: Dict[ReplicaType, List[Pod]]) -> JobH
             rh.health = Health.FAILED
         elif typ != ReplicaType.PS and desired > 0 and succeeded_indices == desired:
             rh.health = Health.COMPLETE
-        elif rh.missing_indices or rh.failed:
+        elif rh.missing_indices or rh.failed or rh.stalled_indices:
             # A TPU gang with any missing member is degraded as a whole —
-            # the slice is one failure domain.
+            # the slice is one failure domain.  A stalled member degrades
+            # the gang the same way: synchronous collectives advance at
+            # the pace of the slowest process.
             rh.health = Health.DEGRADED
         else:
             rh.health = Health.HEALTHY

@@ -153,6 +153,33 @@ def _rest_cluster_or_die(args, probe: bool = True):
         return None
 
 
+def _age(seconds: float) -> str:
+    """kubectl-style compact age: 5s / 2m10s / 1h2m / 3d."""
+    s = max(0, int(seconds))
+    if s < 60:
+        return f"{s}s"
+    m, s = divmod(s, 60)
+    if m < 60:
+        return f"{m}m{s}s" if s else f"{m}m"
+    h, m = divmod(m, 60)
+    if h < 24:
+        return f"{h}h{m}m" if m else f"{h}h"
+    d, h = divmod(h, 24)
+    return f"{d}d{h}h" if h else f"{d}d"
+
+
+def _progress_cells(j) -> tuple:
+    """(STEP, RATE) cells for a job row: job-level step (min across
+    replicas) and summed examples/sec; '-' before any heartbeat."""
+    p = j.status.progress
+    if p is None:
+        return "-", "-"
+    step = str(p.step) if p.step == p.max_step else f"{p.step}..{p.max_step}"
+    if p.stalled:
+        step += "!"
+    return step, f"{p.examples_per_sec:g}"
+
+
 def cmd_get(args) -> int:
     """kubectl-get analog: one line per TFJob (REST mode only)."""
     cluster = _rest_cluster_or_die(args, probe=False)
@@ -166,7 +193,8 @@ def cmd_get(args) -> int:
     if not jobs:
         print("No resources found.")
         return 0
-    print(f"{'NAMESPACE':<12} {'NAME':<32} {'PHASE':<12} REPLICAS")
+    print(f"{'NAMESPACE':<12} {'NAME':<32} {'PHASE':<12} {'STEP':<10} "
+          f"{'RATE':<10} REPLICAS")
     for j in jobs:
         kinds = ",".join(
             f"{s.tf_replica_type.value}x{s.replicas}" for s in j.spec.tf_replica_specs
@@ -175,8 +203,9 @@ def cmd_get(args) -> int:
         # in this state until a running controller processes its finalizer).
         phase = ("Terminating" if j.metadata.deletion_timestamp is not None
                  else j.status.phase.value)
+        step, rate = _progress_cells(j)
         print(f"{j.metadata.namespace:<12} {j.metadata.name:<32} "
-              f"{phase:<12} {kinds}")
+              f"{phase:<12} {step:<10} {rate:<10} {kinds}")
     return 0
 
 
@@ -210,16 +239,44 @@ def cmd_describe(args) -> int:
         for pn in rs.pod_names:
             print(f"           pod {pn}")
     _describe_health(cluster, j, ns)
+    _describe_progress(j)
     try:
         events = [e for e in cluster.events.list(ns)
                   if e.involved_object.name == args.name]
     except APIError:
         events = []  # server lost mid-describe: show what we have
     if events:
+        now = time.time()
         print("Events:")
-        for e in sorted(events, key=lambda e: e.first_timestamp):
-            print(f"  {e.type:<8} {e.reason:<18} x{e.count}  {e.message}")
+        # Newest activity last (kubectl ordering); AGE is last-seen
+        # relative time, so a count-aggregated repeating event reads as
+        # current, not as old as its first sighting.
+        for e in sorted(events, key=lambda e: e.last_timestamp or e.first_timestamp):
+            age = _age(now - (e.last_timestamp or e.first_timestamp))
+            print(f"  {age:>6}  {e.type:<8} {e.reason:<18} x{e.count}  {e.message}")
     return 0
+
+
+def _describe_progress(j) -> None:
+    """Training-plane progress: the job rollup plus one line per reporting
+    replica (step, throughput, loss, workload phase, heartbeat age)."""
+    p = j.status.progress
+    if p is None:
+        return
+    now = time.time()
+    stalled = f"  STALLED {p.stalled_replicas}" if p.stalled else ""
+    print(f"Progress:  step={p.step}"
+          + (f" (max {p.max_step}, lag {p.straggler_lag})"
+             if p.straggler_lag else "")
+          + f" rate={p.examples_per_sec:g} ex/s loss={p.loss:g}"
+          + f" reporting={p.reporting}{stalled}")
+    for r in p.replicas:
+        beat = (_age(now - r.last_heartbeat) + " ago"
+                if r.last_heartbeat else "never")
+        mark = "  STALLED" if r.stalled else ""
+        print(f"  {r.type.value}-{r.index}: step={r.step} "
+              f"rate={r.examples_per_sec:g} loss={r.loss:g} "
+              f"phase={r.phase or '-'} beat {beat}{mark}")
 
 
 def _describe_health(cluster, job, ns: str) -> None:
@@ -266,7 +323,8 @@ def cmd_logs(args) -> int:
         return 2
     ns = args.namespace or "default"
     try:
-        sys.stdout.write(cluster.pods.read_log(ns, args.name))
+        sys.stdout.write(cluster.pods.read_log(ns, args.name,
+                                               tail_lines=args.tail))
     except NotFound as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
@@ -274,6 +332,53 @@ def cmd_logs(args) -> int:
         print(f"error talking to API server: {e}", file=sys.stderr)
         return 2
     return 0
+
+
+def cmd_top(args) -> int:
+    """kubectl-top analog for TFJobs: live training-plane progress, one
+    row per job — step, throughput, straggler lag, stall state, heartbeat
+    age.  ``-w`` re-renders every N seconds until interrupted."""
+    cluster = _rest_cluster_or_die(args, probe=False)
+    if cluster is None:
+        return 2
+    while True:
+        try:
+            jobs = cluster.tfjobs.list(args.namespace or None)
+        except APIError as e:
+            print(f"error talking to API server: {e}", file=sys.stderr)
+            return 2
+        now = time.time()
+        print(f"{'NAMESPACE':<12} {'NAME':<32} {'PHASE':<10} {'STEP':<10} "
+              f"{'RATE':<10} {'LOSS':<10} {'LAG':<6} {'STALLED':<20} BEAT")
+        # Stalled jobs surface first (the rows an operator is looking for),
+        # then the busiest.
+        def sort_key(j):
+            p = j.status.progress
+            return (0 if (p and p.stalled) else 1,
+                    -(p.examples_per_sec if p else 0.0),
+                    j.metadata.namespace, j.metadata.name)
+        for j in sorted(jobs, key=sort_key):
+            p = j.status.progress
+            if p is None:
+                step = rate = loss = lag = beat = "-"
+                stalled = "-"
+            else:
+                step, rate = _progress_cells(j)
+                loss = f"{p.loss:g}"
+                lag = str(p.straggler_lag)
+                stalled = ",".join(p.stalled_replicas) or "no"
+                beat = (_age(now - p.last_heartbeat) if p.last_heartbeat
+                        else "never")
+            print(f"{j.metadata.namespace:<12} {j.metadata.name:<32} "
+                  f"{j.status.phase.value:<10} {step:<10} {rate:<10} "
+                  f"{loss:<10} {lag:<6} {stalled:<20} {beat}")
+        if not args.watch:
+            return 0
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+        print()
 
 
 def cmd_delete(args) -> int:
@@ -485,6 +590,16 @@ def build_parser() -> argparse.ArgumentParser:
                                      "(REST mode: pass -master)")
     lg.add_argument("name")
     lg.add_argument("-n", "--namespace", default="default")
+    lg.add_argument("--tail", type=int, default=0, metavar="N",
+                    help="only the last N lines (kubelet tail-reads files "
+                         "instead of shipping whole logs)")
+
+    tp = sub.add_parser("top", help="live training-plane progress per TFJob "
+                                    "(REST mode: pass -master)")
+    tp.add_argument("-n", "--namespace", default="",
+                    help="namespace filter (default: all)")
+    tp.add_argument("-w", "--watch", type=float, default=0.0, metavar="S",
+                    help="re-render every S seconds until interrupted")
 
     de = sub.add_parser("delete", help="delete a TFJob (REST mode: pass -master)")
     de.add_argument("name")
@@ -549,6 +664,8 @@ def _main(argv=None) -> int:
         return cmd_describe(args)
     if args.cmd == "logs":
         return cmd_logs(args)
+    if args.cmd == "top":
+        return cmd_top(args)
     if args.cmd == "delete":
         return cmd_delete(args)
     if args.cmd == "metrics":

@@ -92,13 +92,15 @@ class _Route:
 
     def __init__(self, plural: str, namespace: Optional[str],
                  name: Optional[str], subresource: Optional[str],
-                 watch: bool, selector: Optional[Dict[str, str]]):
+                 watch: bool, selector: Optional[Dict[str, str]],
+                 tail_lines: int = 0):
         self.plural = plural
         self.namespace = namespace
         self.name = name
         self.subresource = subresource
         self.watch = watch
         self.selector = selector
+        self.tail_lines = tail_lines
 
 
 def _route(path: str, query: str) -> Optional[_Route]:
@@ -121,9 +123,14 @@ def _route(path: str, query: str) -> Optional[_Route]:
             return None
         name = parts[1] if len(parts) > 1 else None
         sub = parts[2] if len(parts) > 2 else None
+        raw_tail = (q.get("tailLines") or ["0"])[0]
+        try:
+            tail = max(0, int(raw_tail))
+        except ValueError:
+            raise Invalid(f"invalid tailLines {raw_tail!r}")
         return _Route(plural, ns, name, sub,
                       (q.get("watch") or ["false"])[0] == "true",
-                      _parse_selector(q))
+                      _parse_selector(q), tail_lines=tail)
     return None
 
 
@@ -310,11 +317,18 @@ class FakeAPIServer:
             raise NotFound(f"{method} not supported on collection")
 
         ns = r.namespace or "default"
+        if method == "PUT" and r.plural == "pods" and r.subresource == "progress":
+            from ..api.core import PodProgress
+
+            progress = serde.from_dict(PodProgress, h._body())
+            h._send(200, self._wire(
+                r.plural, store.update_progress(r.plural, ns, r.name, progress)))
+            return
         if method == "GET" and r.plural == "pods" and r.subresource == "log":
             if self.kubelet is None:
                 raise NotFound("no kubelet attached: pod logs unavailable")
             store.get(r.plural, ns, r.name)  # 404 for unknown pods
-            data = self.kubelet.logs(ns, r.name)
+            data = self.kubelet.logs(ns, r.name, tail_lines=r.tail_lines)
             h.send_response(200)
             h.send_header("Content-Type", "text/plain")
             h.send_header("Content-Length", str(len(data)))

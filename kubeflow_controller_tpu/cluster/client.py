@@ -69,6 +69,11 @@ class PodClient(_TypedClient):
     def mark_deleting(self, namespace: str, name: str) -> Pod:
         return self._store.mark_deleting(self.kind, namespace, name)
 
+    def update_progress(self, namespace: str, name: str, progress) -> Pod:
+        """Write the pod's training-plane heartbeat (progress subresource:
+        last-write-wins, only ``.status.progress`` is applied)."""
+        return self._store.update_progress(self.kind, namespace, name, progress)
+
 
 class ServiceClient(_TypedClient):
     kind = SERVICES

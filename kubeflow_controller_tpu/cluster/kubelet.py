@@ -52,6 +52,12 @@ class PhasePolicy:
     run_forever_types: tuple = ("PS",)
     # Pod names to fail once (fault injection for recovery tests).
     fail_once: Set[str] = field(default_factory=set)
+    # Simulated training-plane heartbeat interval: > 0 makes simulated
+    # (non-PS) pods publish advancing PodProgress beats while Running —
+    # the progress-plane analog of the phase clock.  0 = silent (default:
+    # simulated pods predate the progress plane and most tests don't
+    # want the extra status churn).
+    heartbeat_s: float = 0.0
 
     def outcome(self, pod: Pod) -> Optional[str]:
         if pod.metadata.name in self.fail_once:
@@ -100,6 +106,15 @@ class FakeKubelet:
 
         self._log_dir = tempfile.mkdtemp(prefix="kubelet-logs-")
         self._log_paths: Dict[str, list] = {}
+        # Progress file-drop directory (workloads/progress.py contract):
+        # executed pods inherit it via env and drop heartbeat JSON here;
+        # the main loop ingests drops into the pod progress subresource.
+        self._progress_dir = tempfile.mkdtemp(prefix="kubelet-progress-")
+        self._ingested_mtimes: Dict[str, float] = {}
+        # Heartbeat kill switch (stall injection for tests/smoke): while
+        # True, simulated beats stop publishing and file drops stop being
+        # ingested — exactly what a hung training process looks like.
+        self._hb_suspended = False
         self._stop = threading.Event()
         self._main: Optional[threading.Thread] = None
 
@@ -148,21 +163,93 @@ class FakeKubelet:
         if self._pool is not None:
             self._pool.stop()
         shutil.rmtree(self._log_dir, ignore_errors=True)
+        shutil.rmtree(self._progress_dir, ignore_errors=True)
 
-    def logs(self, namespace: str, name: str) -> bytes:
+    def logs(self, namespace: str, name: str, tail_lines: int = 0) -> bytes:
         """An executed pod's output — per run (across restarts) stdout then
         stderr, runs in chronological order; the kubectl-logs analog.  The
         two streams are separate files (stderr must stay unpolluted for
         failure reasons), so unlike a real container runtime they are NOT
-        interleaved within a run.  Empty for simulated pods."""
-        out = b""
-        for path in self._log_paths.get(f"{namespace}/{name}", []):
+        interleaved within a run.  Empty for simulated pods.
+
+        ``tail_lines`` > 0 (the k8s ``tailLines`` param) returns only the
+        last N lines, tail-reading files newest-first in bounded chunks
+        (:meth:`_file_tail`) instead of shipping whole logs."""
+        paths = self._log_paths.get(f"{namespace}/{name}", [])
+        if tail_lines <= 0:
+            out = b""
+            for path in paths:
+                try:
+                    with open(path, "rb") as f:
+                        out += f.read()
+                except OSError:
+                    pass
+            return out
+        collected: list = []
+        for path in reversed(paths):
+            need = tail_lines - len(collected)
+            if need <= 0:
+                break
+            chunk = self._file_tail(path, limit=max(4096, need * 256))
             try:
-                with open(path, "rb") as f:
-                    out += f.read()
+                size = os.path.getsize(path)
             except OSError:
-                pass
-        return out
+                size = len(chunk)
+            lines = chunk.splitlines(keepends=True)
+            if len(chunk) < size and lines:
+                lines = lines[1:]  # first line may be torn mid-file
+            collected = lines[-need:] + collected
+        return b"".join(collected)
+
+    # -- progress plane ------------------------------------------------------
+
+    def suspend_heartbeats(self) -> None:
+        """Stall injection: simulated beats stop publishing and executed
+        pods' file drops stop being ingested — from the controller's view,
+        training froze (the `make stall-smoke` hook)."""
+        self._hb_suspended = True
+
+    def resume_heartbeats(self) -> None:
+        self._hb_suspended = False
+
+    def _ingest_progress(self) -> None:
+        """Apply new heartbeat file-drops to the pod progress subresource.
+        mtime-deduplicated: each drop is re-applied only when the workload
+        rewrote it (the reporter rewrites on every beat, so mtime IS the
+        beat clock)."""
+        from ..api.core import PodProgress
+        from ..utils import serde
+        from .store import APIError
+
+        try:
+            names = os.listdir(self._progress_dir)
+        except OSError:
+            return
+        for fn in names:
+            if not fn.endswith(".json") or "__" not in fn:
+                continue
+            path = os.path.join(self._progress_dir, fn)
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                continue
+            if self._ingested_mtimes.get(fn) == mtime:
+                continue
+            try:
+                import json
+
+                with open(path) as fh:
+                    body = json.load(fh)
+                progress = serde.from_dict(PodProgress, body)
+            except (OSError, ValueError, TypeError):
+                continue  # torn write: the next beat re-drops
+            self._ingested_mtimes[fn] = mtime
+            progress.timestamp = mtime  # beat time, even if ingestion lagged
+            ns, _, pod_name = fn[: -len(".json")].partition("__")
+            try:
+                self.cluster.pods.update_progress(ns, pod_name, progress)
+            except APIError:
+                pass  # pod gone: the drop is cleaned with the pod
 
     def _new_log_file(self, key: str, suffix: str):
         """Create (and register) the next log file for a pod key."""
@@ -197,6 +284,18 @@ class FakeKubelet:
             except OSError:
                 pass
 
+    def _drop_progress(self, pod: Pod) -> None:
+        """Remove a deleted pod's heartbeat drop + dedup entry, so a
+        recreated same-name pod never inherits its predecessor's beat."""
+        from ..workloads.progress import drop_filename
+
+        fn = drop_filename(pod.metadata.namespace, pod.metadata.name)
+        self._ingested_mtimes.pop(fn, None)
+        try:
+            os.unlink(os.path.join(self._progress_dir, fn))
+        except OSError:
+            pass
+
     def _run(self) -> None:
         last_reap = time.monotonic()
         while not self._stop.is_set():
@@ -211,6 +310,8 @@ class FakeKubelet:
                     and p.metadata.deletion_timestamp is None
                 }
                 self.inventory.release_idle_gangs(live)
+            if not self._hb_suspended:
+                self._ingest_progress()
             ev = self._watcher.next(timeout=0.2)
             if ev is None:
                 continue
@@ -225,6 +326,7 @@ class FakeKubelet:
                 if warm is not None and self._pool is not None:
                     self._pool.kill(warm)
                 self._drop_logs(key)
+                self._drop_progress(ev.object)
 
     @staticmethod
     def _key(pod: Pod) -> str:
@@ -330,12 +432,45 @@ class FakeKubelet:
         outcome = self.policy.outcome(pod)
         if outcome is None:
             return  # runs forever (PS)
-        time.sleep(self.policy.run_s)
+        hb = self.policy.heartbeat_s
+        if hb > 0:
+            # "Training": publish an advancing step every heartbeat tick
+            # for the whole simulated run (suspend_heartbeats silences the
+            # publishing, not the clock — a stall, not a pause).
+            deadline = time.monotonic() + self.policy.run_s
+            step = 0
+            while not self._stop.is_set():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                time.sleep(min(hb, remaining))
+                if self._gone(ns, name):
+                    return
+                step += 1
+                if not self._hb_suspended:
+                    self._publish_sim_beat(ns, name, step, hb)
+        else:
+            time.sleep(self.policy.run_s)
         if self._key(pod) in self._injected_failures:
             self._injected_failures.discard(self._key(pod))
             return  # fail_slice already marked the pod Failed
         if not self._gone(ns, name):
             self.set_phase(ns, name, outcome)
+
+    def _publish_sim_beat(self, ns: str, name: str, step: int,
+                          interval_s: float) -> None:
+        from ..api.core import PodProgress
+        from .store import APIError
+
+        try:
+            self.cluster.pods.update_progress(ns, name, PodProgress(
+                step=step,
+                examples_per_sec=round(100.0 / interval_s, 3),
+                loss=round(1.0 / step, 4),
+                phase="fit",
+            ))
+        except APIError:
+            pass  # pod deleted mid-beat
 
     def _resolve_coordinator(self, env: Dict[str, str]) -> None:
         """Fake cluster DNS for the jax.distributed coordinator.
@@ -369,6 +504,23 @@ class FakeKubelet:
                 self._svc_ports[host] = port
         env[ENV_COORDINATOR] = f"127.0.0.1:{port}"
 
+    def _wire_progress_env(self, pod: Pod, env: Dict[str, str]) -> None:
+        """Downward-API analog for the heartbeat contract: tell the
+        workload process who it is and where beats go (the kubelet's
+        file-drop dir, ingested by the main loop).  A template-provided
+        transport (e.g. a REST URL for two-process runs) wins."""
+        from ..workloads.progress import (
+            ENV_POD_NAME,
+            ENV_POD_NAMESPACE,
+            ENV_PROGRESS_DIR,
+            ENV_PROGRESS_URL,
+        )
+
+        env[ENV_POD_NAMESPACE] = pod.metadata.namespace or "default"
+        env[ENV_POD_NAME] = pod.metadata.name
+        if not env.get(ENV_PROGRESS_URL):
+            env.setdefault(ENV_PROGRESS_DIR, self._progress_dir)
+
     def _execute(self, pod: Pod) -> None:
         from .warmpool import python_module_argv
 
@@ -378,6 +530,7 @@ class FakeKubelet:
         env = dict(os.environ)
         env.update({e.name: e.value for e in c.env})
         self._resolve_coordinator(env)
+        self._wire_progress_env(pod, env)
         if self.warm_start:
             argv = python_module_argv(cmd)
             if argv is not None:

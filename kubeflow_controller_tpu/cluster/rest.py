@@ -472,11 +472,25 @@ class RestPodClient(_RestTypedClient):
     def list_pods(self, namespace: Optional[str] = None) -> List[Pod]:
         return self.list(namespace)
 
-    def read_log(self, namespace: str, name: str) -> str:
+    def update_progress(self, namespace: str, name: str, progress) -> Pod:
+        """PUT .../pods/{name}/progress — the training-plane heartbeat
+        subresource (last-write-wins server-side; only ``.status.progress``
+        is applied)."""
+        out = self._t._request(
+            "PUT", self._item(namespace, name) + "/progress",
+            body=serde.to_dict(progress))
+        return self._from_wire(out)
+
+    def read_log(self, namespace: str, name: str, tail_lines: int = 0) -> str:
         """GET .../pods/{name}/log — combined stdout+stderr, kubectl-logs
-        style (served by the API server's attached node agent)."""
+        style (served by the API server's attached node agent).
+        ``tail_lines`` > 0 maps to the k8s ``tailLines`` param: the kubelet
+        serves only the last N lines, tail-reading files instead of
+        shipping whole logs."""
+        params = {"tailLines": str(tail_lines)} if tail_lines > 0 else None
         resp = self._t._request(
-            "GET", self._item(namespace, name) + "/log", stream=True)
+            "GET", self._item(namespace, name) + "/log", params=params,
+            stream=True)
         try:
             with resp:
                 return resp.read().decode(errors="replace")

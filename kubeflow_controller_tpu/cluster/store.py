@@ -282,6 +282,25 @@ class ObjectStore:
             self._notify(kind, MODIFIED, existing)
             return serde.deep_copy(existing)
 
+    def update_progress(self, kind: str, namespace: str, name: str,
+                        progress: Any) -> Any:
+        """Progress-subresource update: only ``.status.progress`` is applied,
+        last-write-wins (the workload is the sole writer for its own pod,
+        like the kubelet for phase — no resourceVersion ping-pong on a
+        periodic heartbeat).  The server stamps the beat time when the
+        reporter left it 0, so liveness cannot be faked by a skewed clock."""
+        with self._lock:
+            existing = self._collection(kind).get((namespace, name))
+            if existing is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            progress = serde.deep_copy(progress)
+            if not getattr(progress, "timestamp", 0.0):
+                progress.timestamp = time.time()
+            existing.status.progress = progress
+            existing.metadata.resource_version = self._next_rv()
+            self._notify(kind, MODIFIED, existing)
+            return serde.deep_copy(existing)
+
     def delete(self, kind: str, namespace: str, name: str, cascade: bool = True) -> None:
         """Delete an object.  With finalizers present this is GRACEFUL, as
         on a real API server: deletionTimestamp is stamped and the object

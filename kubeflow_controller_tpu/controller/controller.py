@@ -46,17 +46,25 @@ from ..api.tfjob import (
     replica_spec_for,
     validate_tfjob,
 )
+from ..checker import StallPolicy, StallTracker
 from ..cluster.client import Cluster
 from ..cluster.store import Conflict, NotFound
 from ..cluster.tpu import TPUInventory
 from ..obs import trace
+from ..obs.metrics import REGISTRY
 from ..planner import plan_job
 from ..planner.materialize import gang_name, make_pod, make_service
 from ..planner.types import Action
 from ..updater import compute_status, should_update
 from ..utils import serde
 from ..utils.names import generate_runtime_id
-from .events import EventRecorder, TYPE_WARNING
+from .events import (
+    EventRecorder,
+    REASON_TRAINING_RESUMED,
+    REASON_TRAINING_STALLED,
+    TYPE_NORMAL,
+    TYPE_WARNING,
+)
 from .expectations import ControllerExpectations
 from .helper import Helper, register_gather_indexers
 from .informer import SharedInformer
@@ -78,9 +86,38 @@ class Controller:
         inventory: Optional[TPUInventory] = None,
         resync_period_s: float = 30.0,
         recorder: Optional[EventRecorder] = None,
+        stall_policy: Optional[StallPolicy] = None,
     ):
         self.cluster = cluster
         self.inventory = inventory
+        # Training-plane stall detection: per-pod step-advancement memory
+        # + the deadlines that turn a silent heartbeat into Degraded
+        # health, a TrainingStalled event, and kctpu_job_stalled=1.
+        self.stall_policy = stall_policy or StallPolicy()
+        self.stall_tracker = StallTracker(self.stall_policy)
+        # Per-job stalled-replica set from the LAST sync, for edge-triggered
+        # TrainingStalled/TrainingResumed events (the condition itself is
+        # level-triggered in status).
+        self._stalled: Dict[str, frozenset] = {}
+        self._stalled_lock = threading.Lock()
+        # Job-level progress gauges (namespace+job labels; series removed
+        # on job deletion — see _drop_progress_series).
+        self._g_step = REGISTRY.gauge(
+            "kctpu_job_step",
+            "Job-level training step (min across reporting replicas)",
+            ("namespace", "tfjob"))
+        self._g_rate = REGISTRY.gauge(
+            "kctpu_job_examples_per_sec",
+            "Job-level training throughput (sum across reporting replicas)",
+            ("namespace", "tfjob"))
+        self._g_stalled = REGISTRY.gauge(
+            "kctpu_job_stalled",
+            "1 when any replica's training heartbeat/step is stalled",
+            ("namespace", "tfjob"))
+        self._g_lag = REGISTRY.gauge(
+            "kctpu_job_straggler_lag_steps",
+            "Straggler lag: max step minus min step across replicas",
+            ("namespace", "tfjob"))
         # Default recorder writes real Event API objects (kubectl-describe
         # visibility) in addition to the in-memory/log stream.  We only own
         # (and thus close) a recorder we created.
@@ -143,7 +180,26 @@ class Controller:
             t = threading.Thread(target=self._worker, name=f"tfjob-worker-{i}", daemon=True)
             self._workers.append(t)
             t.start()
+        # Stall timer: a stalled pod, by definition, generates no watch
+        # events, so progressing jobs are re-enqueued on a clock — the
+        # level-triggered backstop that lets the stall deadline actually
+        # fire (resync would too, but 30 s is far too coarse for training
+        # liveness).
+        t = threading.Thread(target=self._stall_loop, name="stall-timer",
+                             daemon=True)
+        self._workers.append(t)
+        t.start()
         logger.info("started %d workers", threadiness)
+
+    def _stall_loop(self) -> None:
+        interval = self.stall_policy.effective_check_interval()
+        while not self._stop.wait(interval):
+            for job in self.tfjob_informer.list():
+                if job.status.phase in (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED):
+                    continue
+                if job.status.progress is None:
+                    continue  # never reported: nothing to watch for silence
+                self.queue.add(key_of(job.metadata))
 
     def stop(self) -> None:
         self._stop.set()
@@ -204,6 +260,7 @@ class Controller:
     def _on_tfjob_delete(self, job: TFJob) -> None:
         key = key_of(job.metadata)
         self.expectations.delete_expectations(key)
+        self._drop_progress_series(key, job)
         if self.inventory is not None and is_tpu_job(job):
             self.inventory.release_gang(gang_name(job))
         self.queue.add(key)  # final sync performs cleanup if needed
@@ -332,8 +389,13 @@ class Controller:
         if needs_sync and not deleting:
             self._manage(key, job, pods_by_type, services_by_type)
 
-        # Status rollup runs every sync, whether or not we acted.
-        new_status = compute_status(job, pods_by_type)
+        # Status rollup runs every sync, whether or not we acted.  The
+        # stall tracker rides along: Running pods' heartbeats/steps are
+        # checked against the deadlines and surface as Degraded health +
+        # stalled progress in the computed status.
+        new_status = compute_status(job, pods_by_type,
+                                    tracker=self.stall_tracker)
+        self._publish_progress(key, job, new_status)
         if should_update(job.status, new_status):
             self._update_status(job, new_status)
 
@@ -345,11 +407,68 @@ class Controller:
         ):
             self.inventory.release_gang(gang_name(job))
 
+    def _publish_progress(self, key: str, job: TFJob, status) -> None:
+        """Training-plane outputs of a sync: the per-job progress gauges on
+        /metrics, and edge-triggered TrainingStalled/TrainingResumed events
+        when the stalled-replica set changes."""
+        ns, name = job.metadata.namespace, job.metadata.name
+        progress = status.progress
+        if progress is None:
+            return
+        self._g_step.labels(ns, name).set(progress.step)
+        self._g_rate.labels(ns, name).set(progress.examples_per_sec)
+        self._g_lag.labels(ns, name).set(progress.straggler_lag)
+        self._g_stalled.labels(ns, name).set(1.0 if progress.stalled else 0.0)
+
+        now_stalled = frozenset(progress.stalled_replicas)
+        with self._stalled_lock:
+            before = self._stalled.get(key, frozenset())
+            if now_stalled == before:
+                return
+            self._stalled[key] = now_stalled
+        newly = sorted(now_stalled - before)
+        recovered = sorted(before - now_stalled)
+        if newly:
+            by_name = {f"{r.type.value}-{r.index}": r for r in progress.replicas}
+            details = []
+            for rn in newly:
+                r = by_name.get(rn)
+                if r is not None and r.last_heartbeat:
+                    age = max(0.0, time.time() - r.last_heartbeat)
+                    details.append(f"{rn} (step {r.step}, "
+                                   f"last heartbeat {age:.1f}s ago)")
+                else:
+                    details.append(rn)
+            self.recorder.event(
+                job, TYPE_WARNING, REASON_TRAINING_STALLED,
+                f"training stalled on replica {', '.join(details)}")
+        if recovered:
+            self.recorder.event(
+                job, TYPE_NORMAL, REASON_TRAINING_RESUMED,
+                f"training resumed on replica {', '.join(recovered)} "
+                f"(step {progress.step})")
+
+    def _drop_progress_series(self, key: str, job: TFJob) -> None:
+        """Per-job gauge series + stall bookkeeping die with the job."""
+        from .helper import OWNER_UID_INDEX
+
+        ns, name = job.metadata.namespace, job.metadata.name
+        for g in (self._g_step, self._g_rate, self._g_lag, self._g_stalled):
+            g.remove(ns, name)
+        with self._stalled_lock:
+            self._stalled.pop(key, None)
+        if job.metadata.uid:
+            for p in self.pod_informer.by_index(OWNER_UID_INDEX,
+                                                job.metadata.uid):
+                self.stall_tracker.forget(
+                    f"{p.metadata.namespace}/{p.metadata.name}")
+
     def _finalize_job(self, key: str, job: TFJob) -> None:
         """Cleanup under our finalizer: release the TPU gang, delete child
         pods/services explicitly, then drop the finalizer — the API server
         finalizes (removes) the job once the list empties."""
         ns, name = job.metadata.namespace, job.metadata.name
+        self._drop_progress_series(key, job)
         if self.inventory is not None and is_tpu_job(job):
             self.inventory.release_gang(gang_name(job))
         if job.spec.runtime_id:  # no children can exist before stamping

@@ -23,6 +23,9 @@ REASON_SUCCESSFUL_CREATE = "SuccessfulCreate"
 REASON_FAILED_CREATE = "FailedCreate"
 REASON_SUCCESSFUL_DELETE = "SuccessfulDelete"
 REASON_FAILED_DELETE = "FailedDelete"
+# Training-plane reasons (net-new: the progress plane's stall detector).
+REASON_TRAINING_STALLED = "TrainingStalled"
+REASON_TRAINING_RESUMED = "TrainingResumed"
 
 TYPE_NORMAL = "Normal"
 TYPE_WARNING = "Warning"
@@ -35,8 +38,16 @@ class Event:
     type: str
     reason: str
     message: str
+    # Last-seen time; bumped on every aggregated repeat.
     timestamp: float = field(default_factory=time.time)
+    # When this (object, reason, message) was FIRST recorded; the CLI's
+    # event ages come from the last-seen clock, the ordering from this one.
+    first_timestamp: float = 0.0
     count: int = 1
+
+    def __post_init__(self):
+        if not self.first_timestamp:
+            self.first_timestamp = self.timestamp
 
 
 class EventRecorder:
@@ -52,6 +63,11 @@ class EventRecorder:
         self.component = component
         self._lock = threading.Lock()
         self._events: List[Event] = []
+        # In-memory aggregation index: (object_key, reason, message) -> its
+        # live Event.  Keyed, not last-element-only: interleaved events from
+        # different jobs must not defeat dedup (a 20-job controller emits
+        # SuccessfulCreate streams that interleave constantly).
+        self._agg: dict = {}
         self._max = max_events
         self._sink = sink
         # Sink writes happen on ONE background flusher thread (the k8s
@@ -73,17 +89,27 @@ class EventRecorder:
         kind = getattr(obj, "kind", type(obj).__name__)
         aggregated = False
         with self._lock:
-            # Aggregate identical consecutive events (broadcaster behavior).
-            if self._events:
-                last = self._events[-1]
-                if (last.object_key, last.reason, last.message) == (key, reason, message):
-                    last.count += 1
-                    last.timestamp = time.time()
-                    aggregated = True
-            if not aggregated:
-                self._events.append(Event(kind, key, event_type, reason, message))
+            # Aggregate against the most recent event for the SAME
+            # (object, reason, message) — broadcaster behavior, keyed so
+            # interleavings across jobs cannot defeat it.  first_timestamp
+            # keeps the original sighting; timestamp tracks the latest.
+            agg_key = (key, reason, message)
+            live = self._agg.get(agg_key)
+            if live is not None:
+                live.count += 1
+                live.timestamp = time.time()
+                aggregated = True
+            else:
+                ev = Event(kind, key, event_type, reason, message)
+                self._events.append(ev)
+                self._agg[agg_key] = ev
                 if len(self._events) > self._max:
+                    dropped = self._events[: len(self._events) - self._max]
                     self._events = self._events[-self._max :]
+                    for d in dropped:
+                        k = (d.object_key, d.reason, d.message)
+                        if self._agg.get(k) is d:
+                            del self._agg[k]
         if not aggregated:
             log = logger.info if event_type == TYPE_NORMAL else logger.warning
             log("event component=%s kind=%s object=%s reason=%s: %s",

@@ -233,6 +233,16 @@ class Gauge(_Instrument):
             self._fns[key] = fn
             self._values.setdefault(key, 0.0)
 
+    def remove(self, *labelvalues, **kv) -> None:
+        """Drop one labeled series (no-op if absent).  Object-scoped gauges
+        (e.g. per-job progress) call this when the object is deleted, so
+        the exposition page doesn't accumulate one dead series per job
+        ever run."""
+        key = self._key(labelvalues, kv)
+        with self._lock:
+            self._values.pop(key, None)
+            self._fns.pop(key, None)
+
     @property
     def value(self) -> float:
         return _BoundGauge(self, ()).value

@@ -22,6 +22,11 @@ REQUIRED_FAMILIES = (
     "kctpu_job_phase_transition_seconds",
     "kctpu_gather_indexed_total",
     "kctpu_gather_full_lists_total",
+    # Progress plane (simulated heartbeats feed these during the run).
+    "kctpu_job_step",
+    "kctpu_job_examples_per_sec",
+    "kctpu_job_stalled",
+    "kctpu_job_straggler_lag_steps",
 )
 
 
@@ -37,7 +42,10 @@ def main() -> int:
     cluster = Cluster()
     server = FakeAPIServer(cluster.store)
     url = server.start()
-    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=0.05))
+    # heartbeat_s > 0: simulated workers publish PodProgress beats, so the
+    # scrape must show the progress-plane gauges populated by the sync.
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=0.2,
+                                                      heartbeat_s=0.02))
     ctrl = Controller(cluster, resync_period_s=1.0)
     kubelet.start()
     ctrl.run(threadiness=2)

@@ -1,0 +1,113 @@
+"""`make stall-smoke`: kill heartbeats in a simulated run, assert the
+stall pipeline fires end-to-end, then assert it recovers.
+
+Boots the in-process cluster with simulated training heartbeats
+(``PhasePolicy.heartbeat_s``), runs a 2-worker job, then:
+
+1. suspends the kubelet's heartbeats (what a hung training process looks
+   like from the control plane) and asserts, within the stall deadline,
+   a ``Warning TrainingStalled`` event and ``kctpu_job_stalled=1`` on the
+   HTTP ``GET /metrics`` page;
+2. resumes heartbeats and asserts ``Normal TrainingResumed`` and
+   ``kctpu_job_stalled=0``.
+
+Exit 0 = the progress plane detects and clears stalls for real.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+import time
+import urllib.request
+
+
+def _scrape_stalled(url: str, ns: str, name: str) -> float:
+    with urllib.request.urlopen(f"{url}/metrics", timeout=10) as resp:
+        text = resp.read().decode()
+    pat = re.compile(
+        rf'^kctpu_job_stalled\{{namespace="{ns}",tfjob="{name}"\}} (\S+)$',
+        re.M)
+    m = pat.search(text)
+    return float(m.group(1)) if m else -1.0
+
+
+def main() -> int:
+    from ..api.core import Container, PodTemplateSpec
+    from ..api.meta import ObjectMeta
+    from ..api.tfjob import ReplicaType, TFJob, TFReplicaSpec
+    from ..checker import StallPolicy
+    from ..cluster import Cluster, FakeKubelet, PhasePolicy
+    from ..cluster.apiserver import FakeAPIServer
+    from ..controller import Controller
+
+    cluster = Cluster()
+    server = FakeAPIServer(cluster.store)
+    url = server.start()
+    # Long-running simulated workers beating every 50 ms; heartbeat silence
+    # past 0.4 s is a stall.  Step-deadline off: frozen heartbeats are the
+    # injected failure mode here.
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=120.0,
+                                                      heartbeat_s=0.05))
+    ctrl = Controller(cluster, resync_period_s=5.0,
+                      stall_policy=StallPolicy(heartbeat_deadline_s=0.4,
+                                               step_deadline_s=0.0,
+                                               check_interval_s=0.1))
+    kubelet.start()
+    ctrl.run(threadiness=2)
+    rc = 1
+    try:
+        job = TFJob(metadata=ObjectMeta(name="stall-smoke", namespace="default"))
+        t = PodTemplateSpec()
+        t.spec.containers.append(Container(name="tensorflow", image="img"))
+        t.spec.restart_policy = "OnFailure"
+        job.spec.tf_replica_specs.append(
+            TFReplicaSpec(replicas=2, tf_replica_type=ReplicaType.WORKER,
+                          template=t))
+        cluster.tfjobs.create(job)
+
+        def wait_for(cond, what: str, timeout: float = 20.0) -> bool:
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if cond():
+                    return True
+                time.sleep(0.05)
+            print(f"stall-smoke: timed out waiting for {what}", file=sys.stderr)
+            return False
+
+        def job_progress():
+            p = cluster.tfjobs.get("default", "stall-smoke").status.progress
+            return p is not None and p.step > 0
+
+        def has_event(reason: str) -> bool:
+            return any(e.reason == reason
+                       for e in ctrl.recorder.events_for("default", "stall-smoke"))
+
+        if not wait_for(job_progress, "heartbeats to reach job status"):
+            return 1
+        kubelet.suspend_heartbeats()
+        if not wait_for(lambda: has_event("TrainingStalled"),
+                        "Warning TrainingStalled event"):
+            return 1
+        if not wait_for(lambda: _scrape_stalled(url, "default", "stall-smoke") == 1.0,
+                        "kctpu_job_stalled=1 on /metrics"):
+            return 1
+        kubelet.resume_heartbeats()
+        if not wait_for(lambda: has_event("TrainingResumed"),
+                        "Normal TrainingResumed event"):
+            return 1
+        if not wait_for(lambda: _scrape_stalled(url, "default", "stall-smoke") == 0.0,
+                        "kctpu_job_stalled=0 on /metrics"):
+            return 1
+        print("stall-smoke: stall detected and cleared "
+              "(TrainingStalled -> TrainingResumed, gauge 1 -> 0)")
+        rc = 0
+    finally:
+        ctrl.stop()
+        kubelet.stop()
+        server.stop()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,6 +36,8 @@ from ..api.core import (
 )
 from ..api.tfjob import (
     ChiefSpec,
+    JobProgress,
+    ReplicaProgress,
     ReplicaType,
     TFJob,
     TFJobCondition,
@@ -45,7 +47,7 @@ from ..api.tfjob import (
     TFReplicaState,
     TFReplicaStatus,
 )
-from ..planner.materialize import pods_by_index
+from ..planner.materialize import pod_index, pods_by_index
 from ..planner.plan import desired_replicas
 from ..utils import serde
 
@@ -107,10 +109,62 @@ def _find_chief(job: TFJob) -> Optional[ChiefSpec]:
     return None
 
 
+def compute_progress(
+    job: TFJob,
+    pods_by_type: Dict[ReplicaType, List[Pod]],
+    stalled_by_type: Optional[Dict[ReplicaType, List[int]]] = None,
+) -> Optional[JobProgress]:
+    """Aggregate per-pod heartbeats into job-level progress.
+
+    ``step`` is the MIN across reporting replicas — under synchronous
+    collectives the job advances only as fast as its slowest member — and
+    ``straggler_lag`` (max-min) is the health signal the READY condition
+    carries.  Returns None when no pod has ever reported (the pre-progress
+    status shape, so legacy jobs serialize unchanged)."""
+    stalled_by_type = stalled_by_type or {}
+    replicas: List[ReplicaProgress] = []
+    for spec in job.spec.tf_replica_specs:
+        typ = spec.tf_replica_type
+        stalled_idx = set(stalled_by_type.get(typ, ()))
+        for p in pods_by_type.get(typ, []):
+            pr = p.status.progress
+            if pr is None:
+                continue
+            idx = pod_index(p)
+            replicas.append(ReplicaProgress(
+                type=typ,
+                index=idx if idx is not None else -1,
+                step=pr.step,
+                examples_per_sec=pr.examples_per_sec,
+                loss=pr.loss,
+                phase=pr.phase,
+                last_heartbeat=pr.timestamp,
+                stalled=idx in stalled_idx,
+            ))
+    if not replicas:
+        return None
+    replicas.sort(key=lambda r: (r.type.value, r.index))
+    steps = [r.step for r in replicas]
+    losses = [r.loss for r in replicas if r.loss]
+    return JobProgress(
+        step=min(steps),
+        max_step=max(steps),
+        straggler_lag=max(steps) - min(steps),
+        examples_per_sec=round(sum(r.examples_per_sec for r in replicas), 3),
+        loss=round(sum(losses) / len(losses), 6) if losses else 0.0,
+        reporting=len(replicas),
+        stalled_replicas=[f"{r.type.value}-{r.index}"
+                          for r in replicas if r.stalled],
+        last_heartbeat=max(r.last_heartbeat for r in replicas),
+        replicas=replicas,
+    )
+
+
 def compute_status(
     job: TFJob,
     pods_by_type: Dict[ReplicaType, List[Pod]],
     now: Optional[float] = None,
+    tracker=None,
 ) -> TFJobStatus:
     status = serde.deep_copy(job.status)
     prev_phase = status.phase
@@ -220,17 +274,32 @@ def compute_status(
     # health.py) so `describe` and the status surface tell one story.
     from ..checker import check_health
 
-    health = check_health(job, pods_by_type)
+    health = check_health(job, pods_by_type, now=now, tracker=tracker)
     health_msg = "; ".join(
         f"{t.value}={rh.health.value} {rh.running}/{rh.desired} running"
         + (f", missing {rh.missing_indices}" if rh.missing_indices else "")
+        + (f", stalled {rh.stalled_indices}" if rh.stalled_indices else "")
         for t, rh in health.replicas.items()
     )
+
+    # -- training-plane progress rollup (net-new; PAPERS.md telemetry) --
+    status.progress = compute_progress(
+        job, pods_by_type,
+        {t: rh.stalled_indices for t, rh in health.replicas.items()})
+    if status.progress is not None and status.progress.straggler_lag > 0:
+        health_msg += (f"; straggler lag={status.progress.straggler_lag} steps "
+                       f"(step {status.progress.step}.."
+                       f"{status.progress.max_step})")
+
     terminal = phase in (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED)
+    any_stalled = any(rh.stalled_indices for rh in health.replicas.values())
     set_condition(status, TFJobConditionType.SCHEDULED, scheduled,
                   reason="AllReplicasScheduled" if scheduled else "WaitingForReplicas", now=now)
-    set_condition(status, TFJobConditionType.READY, ready and not terminal,
-                  reason="AllReplicasReady" if ready else "ReplicasNotReady",
+    set_condition(status, TFJobConditionType.READY,
+                  ready and not terminal and not any_stalled,
+                  reason=("TrainingStalled" if any_stalled
+                          else "AllReplicasReady" if ready
+                          else "ReplicasNotReady"),
                   message=health_msg, now=now)
     set_condition(status, TFJobConditionType.RECOVERING, recovering,
                   reason="ReplacingFailedReplicas" if recovering else "", now=now)

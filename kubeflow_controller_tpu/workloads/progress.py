@@ -1,0 +1,164 @@
+"""Workload-side heartbeat publisher — the training-plane half of the
+progress contract.
+
+The controller's view of a Running pod is phase-only; this module is how
+the training process reports that it is actually advancing.  Heartbeats
+``{step, examples_per_sec, loss, phase}`` flow over one of two transports,
+chosen from the environment the node agent injects:
+
+- **REST** (``KCTPU_PROGRESS_URL``): PUT to the pod's ``progress``
+  subresource on the API server — the path real deployments use.
+- **File-drop** (``KCTPU_PROGRESS_DIR``): an atomic JSON drop per pod,
+  ingested by the fake kubelet's loop — the path for executed pods in
+  in-memory runs where the subprocess has no API server address.
+
+Both are best-effort: a heartbeat must NEVER fail or slow training (the
+loss of a beat is exactly the signal the stall detector exists to notice).
+The scan-based trainers execute whole runs as one compiled program, so
+host-side per-step beats don't exist; :meth:`ProgressReporter.keepalive`
+re-publishes the last beat on a background thread to keep the liveness
+timestamp fresh while the device program runs opaque.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+# Env contract injected by the node agent (cluster/kubelet.py) — the
+# downward-API analog: who am I, and where do beats go.
+ENV_POD_NAMESPACE = "KCTPU_POD_NAMESPACE"
+ENV_POD_NAME = "KCTPU_POD_NAME"
+ENV_PROGRESS_DIR = "KCTPU_PROGRESS_DIR"
+ENV_PROGRESS_URL = "KCTPU_PROGRESS_URL"
+
+
+def drop_filename(namespace: str, name: str) -> str:
+    """The file-drop name for a pod (flat dir, '/' is not filename-safe)."""
+    return f"{namespace}__{name}.json"
+
+
+@dataclass
+class ProgressReporter:
+    """Publishes heartbeats for ONE pod; fields merge across beats so a
+    phase-only beat keeps the last reported step/rate/loss."""
+
+    namespace: str = ""
+    name: str = ""
+    url: str = ""       # API server base URL (REST transport)
+    drop_dir: str = ""  # file-drop directory (fallback transport)
+    _last: Dict[str, float] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+    _keepalive: Optional[threading.Thread] = None
+    _stop: Optional[threading.Event] = None
+
+    @staticmethod
+    def from_env(env: Optional[Dict[str, str]] = None) -> "ProgressReporter":
+        e = os.environ if env is None else env
+        return ProgressReporter(
+            namespace=e.get(ENV_POD_NAMESPACE, "default") or "default",
+            name=e.get(ENV_POD_NAME, ""),
+            url=e.get(ENV_PROGRESS_URL, "").rstrip("/"),
+            drop_dir=e.get(ENV_PROGRESS_DIR, ""),
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.name and (self.url or self.drop_dir))
+
+    def beat(self, step: Optional[int] = None,
+             examples_per_sec: Optional[float] = None,
+             loss: Optional[float] = None,
+             phase: Optional[str] = None) -> None:
+        """Publish one heartbeat; None fields carry the previous value.
+        The beat time is stamped server-side (store.update_progress), so
+        ``timestamp`` stays 0 on the wire."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if step is not None:
+                self._last["step"] = int(step)
+            if examples_per_sec is not None:
+                self._last["examplesPerSec"] = float(examples_per_sec)
+            if loss is not None:
+                self._last["loss"] = float(loss)
+            if phase is not None:
+                self._last["phase"] = phase
+            body = dict(self._last)
+        self._publish(body)
+
+    def _publish(self, body: Dict) -> None:
+        try:
+            if self.url:
+                self._publish_rest(body)
+            elif self.drop_dir:
+                self._publish_drop(body)
+        except Exception:  # noqa: BLE001 — beats never break training
+            pass
+
+    def _publish_rest(self, body: Dict) -> None:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{self.url}/api/v1/namespaces/{self.namespace}/pods/"
+            f"{self.name}/progress",
+            data=json.dumps(body).encode(), method="PUT",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5.0):
+            pass
+
+    def _publish_drop(self, body: Dict) -> None:
+        # Atomic tmp+rename so the ingesting kubelet never reads a torn
+        # write; mtime is the liveness signal, so rewrite even when the
+        # payload is unchanged.
+        path = os.path.join(self.drop_dir,
+                            drop_filename(self.namespace, self.name))
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(body, fh)
+        os.replace(tmp, path)
+
+    # -- keepalive ----------------------------------------------------------
+
+    def start_keepalive(self, interval_s: float = 2.0) -> None:
+        """Re-publish the last beat every ``interval_s`` on a daemon thread:
+        liveness for the opaque compiled-run window (the scan trainers are
+        one dispatch — no host code runs between steps)."""
+        if not self.enabled or self._keepalive is not None:
+            return
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.wait(interval_s):
+                with self._lock:
+                    body = dict(self._last)
+                self._publish(body)
+
+        self._keepalive = threading.Thread(
+            target=loop, name="progress-keepalive", daemon=True)
+        self._keepalive.start()
+
+    def stop_keepalive(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+        if self._keepalive is not None:
+            self._keepalive.join(timeout=5.0)
+        self._keepalive = None
+        self._stop = None
+
+
+_REPORTER: Optional[ProgressReporter] = None
+_REPORTER_LOCK = threading.Lock()
+
+
+def reporter() -> ProgressReporter:
+    """The process-global reporter, built from the env once (a pod process
+    reports for exactly one pod)."""
+    global _REPORTER
+    with _REPORTER_LOCK:
+        if _REPORTER is None:
+            _REPORTER = ProgressReporter.from_env()
+        return _REPORTER

@@ -91,7 +91,12 @@ class JobRuntime:
         import jax
 
         from ..obs.trace import span
+        from .progress import reporter
 
+        # First heartbeat of the pod's life: the controller learns the
+        # process is alive and in rendezvous — the exact window whose
+        # silent stalls had to be bisected by hand in round 5.
+        reporter().beat(phase="rendezvous")
         if self.process_id != 0:
             # Wait for the coordinator's port to be LISTENING before the
             # first gRPC connect: a connect attempt that lands even a few
@@ -114,6 +119,7 @@ class JobRuntime:
                 process_id=self.process_id,
             )
         self._initialized = True
+        reporter().beat(phase="init")  # rendezvous done, host-side setup next
 
     def _wait_coordinator(self, timeout_s: float = 60.0,
                           poll_s: float = 0.005) -> None:

@@ -215,13 +215,31 @@ def train_scan_dist(
         # scan is one dispatch; block_until_ready so the measured time is
         # execution, not dispatch — callers consume the outputs right away).
         from ..obs.trace import span as obs_span
+        from .progress import reporter
 
-        with obs_span("trainer/fit", steps=steps,
-                      aot_cache=cache) as sp:
-            out = jax.block_until_ready(run())
-            sp.args["process"] = jax.process_index()
-        record_step_telemetry(steps, sp.dur if sp.dur else 0.0,
-                              examples_per_step)
+        # Heartbeats for the opaque compiled-run window: the scan is one
+        # dispatch, so a keepalive thread re-publishes liveness until the
+        # program returns — then the final beat carries the real step
+        # count, throughput, and loss.
+        rep = reporter()
+        rep.beat(phase="fit")
+        rep.start_keepalive()
+        try:
+            with obs_span("trainer/fit", steps=steps,
+                          aot_cache=cache) as sp:
+                out = jax.block_until_ready(run())
+                sp.args["process"] = jax.process_index()
+        finally:
+            rep.stop_keepalive()
+        dur = sp.dur if sp.dur else 0.0
+        record_step_telemetry(steps, dur, examples_per_step)
+        try:
+            final_loss = float(out[2])
+        except (TypeError, IndexError, ValueError):
+            final_loss = None
+        rep.beat(step=steps, loss=final_loss, phase="fit",
+                 examples_per_sec=(steps * examples_per_step / dur
+                                   if dur > 0 and examples_per_step else None))
         return out
 
     if aot_cache:

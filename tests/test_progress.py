@@ -1,0 +1,588 @@
+"""Progress-plane tests: heartbeat subresource, workload reporter +
+kubelet ingestion, stall/straggler detection, job-level rollup, the CLI
+surface, and the end-to-end stall demo the acceptance criteria name."""
+
+import json
+import os
+import time
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import (
+    Container,
+    PHASE_RUNNING,
+    Pod,
+    PodProgress,
+    PodTemplateSpec,
+)
+from kubeflow_controller_tpu.api.labels import LABEL_INDEX
+from kubeflow_controller_tpu.api.meta import ObjectMeta
+from kubeflow_controller_tpu.api.tfjob import (
+    ReplicaType,
+    TFJob,
+    TFJobConditionType,
+    TFJobPhase,
+    TFReplicaSpec,
+)
+from kubeflow_controller_tpu.checker import StallPolicy, StallTracker, check_health
+from kubeflow_controller_tpu.cluster import Cluster, FakeKubelet, PhasePolicy
+from kubeflow_controller_tpu.cluster.apiserver import FakeAPIServer
+from kubeflow_controller_tpu.cluster.rest import Kubeconfig, RestCluster
+from kubeflow_controller_tpu.cluster.store import NotFound
+from kubeflow_controller_tpu.controller import Controller
+from kubeflow_controller_tpu.controller.events import EventRecorder
+from kubeflow_controller_tpu.obs.metrics import REGISTRY
+from kubeflow_controller_tpu.updater.status import compute_progress, compute_status
+from kubeflow_controller_tpu.workloads.progress import (
+    ENV_POD_NAME,
+    ENV_POD_NAMESPACE,
+    ENV_PROGRESS_DIR,
+    ProgressReporter,
+    drop_filename,
+)
+
+
+def mk_template(restart="OnFailure"):
+    t = PodTemplateSpec()
+    t.spec.containers.append(Container(name="tensorflow", image="img"))
+    t.spec.restart_policy = restart
+    return t
+
+
+def mk_job(name, *types_and_replicas):
+    job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+    for typ, n in types_and_replicas:
+        job.spec.tf_replica_specs.append(
+            TFReplicaSpec(replicas=n, tf_replica_type=typ, template=mk_template()))
+    return job
+
+
+def wait_for(fn, timeout=15.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+# ---------------------------------------------------------------------------
+# The progress subresource (store + HTTP + REST client)
+# ---------------------------------------------------------------------------
+
+class TestProgressSubresource:
+    def test_store_update_progress_stamps_and_notifies(self):
+        cluster = Cluster()
+        pod = Pod(metadata=ObjectMeta(name="p0", namespace="default"))
+        cluster.pods.create(pod)
+        w = cluster.pods.watch()
+        before_rv = cluster.pods.get("default", "p0").metadata.resource_version
+        cluster.pods.update_progress(
+            "default", "p0", PodProgress(step=7, examples_per_sec=12.5))
+        got = cluster.pods.get("default", "p0")
+        assert got.status.progress.step == 7
+        assert got.status.progress.timestamp > 0  # server-stamped
+        assert got.metadata.resource_version != before_rv
+        ev = w.next(timeout=2.0)
+        assert ev is not None and ev.type == "MODIFIED"
+        w.stop()
+
+    def test_store_progress_unknown_pod_404(self):
+        cluster = Cluster()
+        with pytest.raises(NotFound):
+            cluster.pods.update_progress("default", "ghost", PodProgress(step=1))
+
+    def test_rest_update_progress_roundtrip(self):
+        srv = FakeAPIServer()
+        url = srv.start()
+        try:
+            rest = RestCluster(Kubeconfig(server=url))
+            rest.pods.create(Pod(metadata=ObjectMeta(name="p0", namespace="default")))
+            out = rest.pods.update_progress(
+                "default", "p0",
+                PodProgress(step=42, examples_per_sec=5.0, loss=0.25, phase="fit"))
+            assert out.status.progress.step == 42
+            assert out.status.progress.phase == "fit"
+            assert out.status.progress.timestamp > 0
+            # Last-write-wins: a second beat replaces, no Conflict dance.
+            out = rest.pods.update_progress("default", "p0", PodProgress(step=43))
+            assert out.status.progress.step == 43
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Workload reporter (file-drop) + kubelet ingestion
+# ---------------------------------------------------------------------------
+
+class TestReporterAndIngestion:
+    def test_file_drop_merges_fields(self, tmp_path):
+        rep = ProgressReporter(namespace="default", name="p0",
+                               drop_dir=str(tmp_path))
+        rep.beat(step=5, examples_per_sec=100.0)
+        rep.beat(phase="fit")  # step/rate must carry over
+        body = json.loads((tmp_path / drop_filename("default", "p0")).read_text())
+        assert body == {"step": 5, "examplesPerSec": 100.0, "phase": "fit"}
+
+    def test_disabled_reporter_is_inert(self, tmp_path):
+        rep = ProgressReporter.from_env(env={})  # no name/transport
+        assert not rep.enabled
+        rep.beat(step=1)  # must not raise
+        rep = ProgressReporter.from_env(env={
+            ENV_POD_NAMESPACE: "ns1", ENV_POD_NAME: "p1",
+            ENV_PROGRESS_DIR: str(tmp_path)})
+        assert rep.enabled and rep.namespace == "ns1"
+
+    def test_executed_pod_env_contract_roundtrip(self):
+        """The whole file-drop path with a REAL subprocess: the kubelet
+        injects KCTPU_POD_* / KCTPU_PROGRESS_DIR into the executed pod,
+        the workload-side reporter reads them from its env and drops a
+        beat, the kubelet ingests it into the progress subresource."""
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        cluster = Cluster()
+        kubelet = FakeKubelet(cluster, execute=True, warm_start=False)
+        pod = Pod(metadata=ObjectMeta(name="beater", namespace="default"))
+        pod.spec.restart_policy = "Never"
+        pod.spec.containers.append(Container(
+            name="c", image="img",
+            command=[sys.executable, "-c",
+                     "from kubeflow_controller_tpu.workloads.progress import "
+                     "ProgressReporter; "
+                     "ProgressReporter.from_env().beat(step=9, phase='fit')"],
+            working_dir=repo))
+        kubelet.start()
+        try:
+            cluster.pods.create(pod)
+            wait_for(lambda: (
+                cluster.pods.get("default", "beater").status.progress
+                is not None))
+            pr = cluster.pods.get("default", "beater").status.progress
+            assert (pr.step, pr.phase) == (9, "fit")
+        finally:
+            kubelet.stop()
+
+    def test_kubelet_ingests_drops_into_subresource(self):
+        cluster = Cluster()
+        kubelet = FakeKubelet(cluster)
+        cluster.pods.create(Pod(metadata=ObjectMeta(name="p0", namespace="default")))
+        kubelet.start()
+        try:
+            rep = ProgressReporter(namespace="default", name="p0",
+                                   drop_dir=kubelet._progress_dir)
+            rep.beat(step=3, loss=0.5, phase="fit")
+            wait_for(lambda: (
+                cluster.pods.get("default", "p0").status.progress is not None))
+            pr = cluster.pods.get("default", "p0").status.progress
+            assert (pr.step, pr.loss, pr.phase) == (3, 0.5, "fit")
+            assert pr.timestamp > 0
+            # A rewritten drop (same file, new mtime) re-ingests.
+            time.sleep(0.02)  # mtime granularity
+            rep.beat(step=4)
+            wait_for(lambda: (
+                cluster.pods.get("default", "p0").status.progress.step == 4))
+        finally:
+            kubelet.stop()
+
+
+# ---------------------------------------------------------------------------
+# Stall detection (checker)
+# ---------------------------------------------------------------------------
+
+class TestStallTracker:
+    def test_heartbeat_deadline(self):
+        tr = StallTracker(StallPolicy(heartbeat_deadline_s=10, step_deadline_s=0))
+        t0 = 1000.0
+        assert not tr.observe("k", PodProgress(step=1, timestamp=t0), now=t0 + 5)
+        assert tr.observe("k", PodProgress(step=1, timestamp=t0), now=t0 + 11)
+        # Fresh beat clears it.
+        assert not tr.observe("k", PodProgress(step=1, timestamp=t0 + 11),
+                              now=t0 + 12)
+
+    def test_step_deadline_needs_history(self):
+        tr = StallTracker(StallPolicy(heartbeat_deadline_s=0, step_deadline_s=10))
+        t0 = 1000.0
+        # Heartbeats keep arriving but the counter is frozen.
+        assert not tr.observe("k", PodProgress(step=5, timestamp=t0), now=t0)
+        assert not tr.observe("k", PodProgress(step=5, timestamp=t0 + 5), now=t0 + 5)
+        assert tr.observe("k", PodProgress(step=5, timestamp=t0 + 11), now=t0 + 11)
+        # Advancement resets the clock...
+        assert not tr.observe("k", PodProgress(step=6, timestamp=t0 + 12), now=t0 + 12)
+        # ...and a DECREASE (in-place workload restart) does too.
+        assert not tr.observe("k", PodProgress(step=0, timestamp=t0 + 23), now=t0 + 23)
+
+    def test_forget_drops_history(self):
+        tr = StallTracker(StallPolicy())
+        tr.observe("k", PodProgress(step=1, timestamp=1.0), now=1.0)
+        assert len(tr) == 1
+        tr.forget("k")
+        assert len(tr) == 0
+
+
+def _running_pod(name, idx, step, beat_at):
+    p = Pod(metadata=ObjectMeta(name=name, namespace="default",
+                                labels={LABEL_INDEX: str(idx)}))
+    p.status.phase = PHASE_RUNNING
+    p.status.progress = PodProgress(step=step, examples_per_sec=10.0,
+                                    loss=1.0 / max(step, 1), timestamp=beat_at)
+    return p
+
+
+class TestHealthAndRollup:
+    def test_stalled_replica_degrades_health(self):
+        job = mk_job("j", (ReplicaType.WORKER, 2))
+        now = 1000.0
+        pods = {ReplicaType.WORKER: [
+            _running_pod("j-w-0", 0, 10, now - 60),  # silent for a minute
+            _running_pod("j-w-1", 1, 12, now - 1),
+        ]}
+        tr = StallTracker(StallPolicy(heartbeat_deadline_s=30, step_deadline_s=0))
+        health = check_health(job, pods, now=now, tracker=tr)
+        rh = health.replicas[ReplicaType.WORKER]
+        assert rh.stalled_indices == [0]
+        assert rh.health.value == "Degraded"
+        # Without a tracker the same pods are Healthy (legacy behavior).
+        health = check_health(job, pods)
+        assert health.replicas[ReplicaType.WORKER].health.value == "Healthy"
+
+    def test_compute_progress_min_max_lag(self):
+        job = mk_job("j", (ReplicaType.WORKER, 2))
+        pods = {ReplicaType.WORKER: [
+            _running_pod("j-w-0", 0, 10, 100.0),
+            _running_pod("j-w-1", 1, 14, 101.0),
+        ]}
+        p = compute_progress(job, pods, {ReplicaType.WORKER: [0]})
+        assert (p.step, p.max_step, p.straggler_lag) == (10, 14, 4)
+        assert p.examples_per_sec == pytest.approx(20.0)
+        assert p.reporting == 2
+        assert p.stalled_replicas == ["Worker-0"]
+        assert p.stalled
+        assert p.last_heartbeat == 101.0
+        assert [r.index for r in p.replicas] == [0, 1]
+
+    def test_compute_progress_none_without_beats(self):
+        job = mk_job("j", (ReplicaType.WORKER, 1))
+        pod = Pod(metadata=ObjectMeta(name="p", namespace="default",
+                                      labels={LABEL_INDEX: "0"}))
+        pod.status.phase = PHASE_RUNNING
+        assert compute_progress(job, {ReplicaType.WORKER: [pod]}) is None
+
+    def test_status_ready_message_names_stalled_index_and_lag(self):
+        job = mk_job("j", (ReplicaType.WORKER, 2))
+        now = 1000.0
+        pods = {ReplicaType.WORKER: [
+            _running_pod("j-w-0", 0, 10, now - 60),
+            _running_pod("j-w-1", 1, 14, now - 1),
+        ]}
+        tr = StallTracker(StallPolicy(heartbeat_deadline_s=30, step_deadline_s=0))
+        status = compute_status(job, pods, now=now, tracker=tr)
+        ready = next(c for c in status.conditions
+                     if c.type == TFJobConditionType.READY)
+        assert ready.status == "False"
+        assert ready.reason == "TrainingStalled"
+        assert "stalled [0]" in ready.message
+        assert "straggler lag=4 steps" in ready.message
+        assert status.progress.stalled_replicas == ["Worker-0"]
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: the acceptance demo
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def rig():
+    """Cluster + controller with sub-second stall deadlines + kubelet whose
+    simulated workers run long (the test beats pods manually for full
+    control over who stalls)."""
+    cluster = Cluster()
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=60.0))
+    ctrl = Controller(cluster, resync_period_s=5.0,
+                      stall_policy=StallPolicy(heartbeat_deadline_s=0.4,
+                                               step_deadline_s=0.0,
+                                               check_interval_s=0.1))
+    kubelet.start()
+    ctrl.run(threadiness=2)
+    yield cluster, ctrl, kubelet
+    ctrl.stop()
+    kubelet.stop()
+
+
+class TestStallEndToEnd:
+    def _pods_by_index(self, cluster):
+        return {p.metadata.labels[LABEL_INDEX]: p
+                for p in cluster.pods.list("default")}
+
+    def _beat(self, cluster, pod, step):
+        cluster.pods.update_progress(
+            "default", pod.metadata.name,
+            PodProgress(step=step, examples_per_sec=50.0,
+                        loss=1.0 / step, phase="fit"))
+
+    def test_stall_detect_and_resume(self, rig):
+        cluster, ctrl, kubelet = rig
+        cluster.tfjobs.create(mk_job("demo", (ReplicaType.WORKER, 2)))
+        wait_for(lambda: len(cluster.pods.list("default")) == 2)
+        pods = self._pods_by_index(cluster)
+
+        # Healthy steady state: both replicas beat, job step advances
+        # monotonically, nothing is stalled.
+        seen_steps = []
+        for step in (1, 2, 3):
+            for p in pods.values():
+                self._beat(cluster, p, step)
+            wait_for(lambda s=step: (
+                (cluster.tfjobs.get("default", "demo").status.progress or
+                 None) is not None
+                and cluster.tfjobs.get("default", "demo").status.progress.step == s))
+            seen_steps.append(
+                cluster.tfjobs.get("default", "demo").status.progress.step)
+        assert seen_steps == sorted(seen_steps)  # monotone advance
+        assert REGISTRY.gauge(
+            "kctpu_job_step", "", ("namespace", "tfjob")).labels(
+                "default", "demo").value == 3
+
+        # Replica 0 goes silent; replica 1 keeps beating (and advancing).
+        stall_start = time.time()
+        for step in range(4, 30):
+            self._beat(cluster, pods["1"], step)
+            events = ctrl.recorder.events_for("default", "demo")
+            if any(e.reason == "TrainingStalled" for e in events):
+                break
+            time.sleep(0.1)
+        events = wait_for(lambda: [
+            e for e in ctrl.recorder.events_for("default", "demo")
+            if e.reason == "TrainingStalled"])
+        # Within (generously) 10x the deadline.
+        assert time.time() - stall_start < 4.0
+        assert events[0].type == "Warning"
+        assert "Worker-0" in events[0].message
+
+        job = cluster.tfjobs.get("default", "demo")
+        ready = next(c for c in job.status.conditions
+                     if c.type == TFJobConditionType.READY)
+        assert ready.status == "False"
+        assert "stalled [0]" in ready.message  # names the replica index
+        assert job.status.progress.stalled_replicas == ["Worker-0"]
+        assert job.status.progress.straggler_lag > 0
+        g = REGISTRY.gauge("kctpu_job_stalled", "", ("namespace", "tfjob"))
+        assert g.labels("default", "demo").value == 1.0
+        # Degraded health from the same inputs `kctpu describe` renders.
+        health = check_health(
+            job, {ReplicaType.WORKER: list(cluster.pods.list("default"))},
+            tracker=ctrl.stall_tracker)
+        assert health.overall.value == "Degraded"
+
+        # Heartbeats return: TrainingResumed, gauge drops to 0, READY heals.
+        def resumed():
+            self._beat(cluster, pods["0"], 40)
+            self._beat(cluster, pods["1"], 40)
+            return any(e.reason == "TrainingResumed"
+                       for e in ctrl.recorder.events_for("default", "demo"))
+        wait_for(resumed)
+        wait_for(lambda: g.labels("default", "demo").value == 0.0)
+        job = cluster.tfjobs.get("default", "demo")
+        assert job.status.progress.stalled_replicas == []
+        ready = next(c for c in job.status.conditions
+                     if c.type == TFJobConditionType.READY)
+        assert ready.status == "True"
+
+        # Deletion removes the per-job gauge series (no dead series leak).
+        cluster.tfjobs.delete("default", "demo")
+        wait_for(lambda: not cluster.pods.list("default"))
+        wait_for(lambda: "demo" not in REGISTRY.render().split(
+            "kctpu_job_stalled", 1)[-1].split("# HELP")[0])
+
+    def test_simulated_heartbeats_drive_progress(self, rig):
+        """PhasePolicy.heartbeat_s: the kubelet's simulated beats alone
+        populate job progress (what metrics-smoke and the scale bench use)."""
+        cluster, ctrl, kubelet = rig
+        kubelet.policy.run_s = 2.0
+        kubelet.policy.heartbeat_s = 0.05
+        cluster.tfjobs.create(mk_job("sim", (ReplicaType.WORKER, 1)))
+        wait_for(lambda: (
+            cluster.tfjobs.get("default", "sim").status.progress is not None
+            and cluster.tfjobs.get("default", "sim").status.progress.step >= 2))
+        p = cluster.tfjobs.get("default", "sim").status.progress
+        assert p.examples_per_sec > 0
+        assert not p.stalled
+
+
+# ---------------------------------------------------------------------------
+# Satellites: event aggregation, sink recreate, log tail
+# ---------------------------------------------------------------------------
+
+class _Obj:
+    def __init__(self, ns, name, uid="u1"):
+        self.kind = "TFJob"
+        self.metadata = ObjectMeta(name=name, namespace=ns, uid=uid)
+
+
+class TestEventAggregation:
+    def test_interleaved_events_still_dedup(self):
+        rec = EventRecorder()
+        a, b = _Obj("default", "job-a"), _Obj("default", "job-b")
+        for _ in range(3):  # a,b,a,b,a,b — the interleaving that broke dedup
+            rec.event(a, "Normal", "SuccessfulCreate", "created pod x")
+            rec.event(b, "Normal", "SuccessfulCreate", "created pod x")
+        events = rec.all_events()
+        assert len(events) == 2  # one aggregate per (object, reason, message)
+        assert sorted(e.object_key for e in events) == [
+            "default/job-a", "default/job-b"]
+        assert all(e.count == 3 for e in events)
+
+    def test_first_timestamp_kept_last_bumped(self):
+        rec = EventRecorder()
+        a = _Obj("default", "job-a")
+        rec.event(a, "Normal", "R", "m")
+        first = rec.all_events()[0]
+        t_first = first.first_timestamp
+        time.sleep(0.02)
+        rec.event(a, "Normal", "R", "m")
+        ev = rec.all_events()[0]
+        assert ev.count == 2
+        assert ev.first_timestamp == t_first
+        assert ev.timestamp > ev.first_timestamp
+
+    def test_distinct_messages_do_not_aggregate(self):
+        rec = EventRecorder()
+        a = _Obj("default", "job-a")
+        rec.event(a, "Normal", "R", "m1")
+        rec.event(a, "Normal", "R", "m2")
+        assert [e.count for e in rec.all_events()] == [1, 1]
+
+    def test_sink_recreates_deleted_event_object(self):
+        """The _write_sink NotFound branch: a GC'd Event API object is
+        recreated on the next aggregated emission instead of being lost."""
+        cluster = Cluster()
+        rec = EventRecorder(sink=cluster.events)
+        a = _Obj("default", "job-a")
+        rec.event(a, "Normal", "R", "m")
+        ev = wait_for(lambda: cluster.events.list("default"))[0]
+        assert ev.count == 1
+        cluster.events.delete("default", ev.metadata.name)  # "TTL expiry"
+        rec.event(a, "Normal", "R", "m")
+        recreated = wait_for(lambda: cluster.events.list("default"))[0]
+        assert recreated.metadata.name != ev.metadata.name
+        assert recreated.count == 1  # fresh object, not a resurrected count
+        rec.close()
+
+
+class TestLogTail:
+    def _kubelet_with_logs(self, lines_per_file):
+        cluster = Cluster()
+        kubelet = FakeKubelet(cluster)
+        for i, n in enumerate(lines_per_file):
+            f, _ = kubelet._new_log_file("default/p0", f"f{i}")
+            f.write(b"".join(f"file{i} line{j}\n".encode() for j in range(n)))
+            f.close()
+        return cluster, kubelet
+
+    def test_tail_within_last_file(self):
+        _, kubelet = self._kubelet_with_logs([5, 5])
+        out = kubelet.logs("default", "p0", tail_lines=2).decode()
+        assert out == "file1 line3\nfile1 line4\n"
+
+    def test_tail_spans_files_and_caps_at_total(self):
+        _, kubelet = self._kubelet_with_logs([2, 3])
+        out = kubelet.logs("default", "p0", tail_lines=4).decode()
+        assert out == ("file0 line1\nfile1 line0\nfile1 line1\nfile1 line2\n")
+        assert kubelet.logs("default", "p0", tail_lines=100).decode().count(
+            "\n") == 5
+        # tail=0 keeps the full-read behavior.
+        assert kubelet.logs("default", "p0").decode().count("\n") == 5
+
+    def test_rest_tail_param_plumbs_to_kubelet(self):
+        cluster, kubelet = self._kubelet_with_logs([5])
+        cluster.pods.create(Pod(metadata=ObjectMeta(name="p0",
+                                                    namespace="default")))
+        srv = FakeAPIServer(cluster.store, kubelet=kubelet)
+        url = srv.start()
+        try:
+            rest = RestCluster(Kubeconfig(server=url))
+            out = rest.pods.read_log("default", "p0", tail_lines=2)
+            assert out == "file0 line3\nfile0 line4\n"
+            assert rest.pods.read_log("default", "p0").count("\n") == 5
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+class TestCLIProgress:
+    @pytest.fixture
+    def served_job(self):
+        from kubeflow_controller_tpu.api.tfjob import (
+            JobProgress,
+            ReplicaProgress,
+        )
+
+        cluster = Cluster()
+        srv = FakeAPIServer(cluster.store)
+        url = srv.start()
+        job = mk_job("trainer", (ReplicaType.WORKER, 2))
+        cluster.tfjobs.create(job)
+        j = cluster.tfjobs.get("default", "trainer")
+        j.status.phase = TFJobPhase.RUNNING
+        j.status.progress = JobProgress(
+            step=10, max_step=14, straggler_lag=4, examples_per_sec=123.5,
+            loss=0.25, reporting=2, stalled_replicas=["Worker-0"],
+            last_heartbeat=time.time() - 5,
+            replicas=[
+                ReplicaProgress(type=ReplicaType.WORKER, index=0, step=10,
+                                examples_per_sec=60.0, loss=0.3, phase="fit",
+                                last_heartbeat=time.time() - 65, stalled=True),
+                ReplicaProgress(type=ReplicaType.WORKER, index=1, step=14,
+                                examples_per_sec=63.5, loss=0.2, phase="fit",
+                                last_heartbeat=time.time() - 5),
+            ])
+        cluster.tfjobs.update_status(j)
+        yield url
+        srv.stop()
+
+    def test_get_shows_step_and_rate(self, served_job, capsys):
+        from kubeflow_controller_tpu.cli.main import main
+
+        assert main(["-master", served_job, "get"]) == 0
+        out = capsys.readouterr().out
+        assert "STEP" in out and "RATE" in out
+        assert "10..14!" in out  # min..max, ! = stalled
+        assert "123.5" in out
+
+    def test_top_lists_progress(self, served_job, capsys):
+        from kubeflow_controller_tpu.cli.main import main
+
+        assert main(["-master", served_job, "top"]) == 0
+        out = capsys.readouterr().out
+        assert "STALLED" in out and "Worker-0" in out
+        assert "LAG" in out
+        lines = [ln for ln in out.splitlines() if "trainer" in ln]
+        assert lines and "123.5" in lines[0]
+
+    def test_describe_progress_section_and_event_age(self, served_job, capsys):
+        from kubeflow_controller_tpu.cli.main import main
+        from kubeflow_controller_tpu.api.core import EventObject, ObjectReference
+
+        # Plant an Event object with a last-seen 90 s ago.
+        rest = RestCluster(Kubeconfig(server=served_job))
+        ev = EventObject()
+        ev.metadata.generate_name = "trainer."
+        ev.metadata.namespace = "default"
+        ev.involved_object = ObjectReference(kind="TFJob", namespace="default",
+                                             name="trainer")
+        ev.reason = "SuccessfulCreate"
+        ev.message = "created pod trainer-worker-0"
+        ev.first_timestamp = time.time() - 300
+        ev.last_timestamp = time.time() - 90
+        rest.events.create(ev)
+
+        assert main(["-master", served_job, "describe", "trainer"]) == 0
+        out = capsys.readouterr().out
+        assert "Progress:  step=10 (max 14, lag 4)" in out
+        assert "STALLED ['Worker-0']" in out
+        assert "Worker-1: step=14" in out
+        assert "beat 1m5s ago" in out  # per-replica heartbeat age
+        assert "1m30s" in out          # event age = last-seen, not first
