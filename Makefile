@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast lint typecheck cov cov-local bench dryrun validate metrics-smoke scale-smoke stall-smoke
+.PHONY: all test test-fast lint typecheck cov cov-local bench dryrun validate metrics-smoke scale-smoke stall-smoke widejob-smoke
 
 all: lint test
 
@@ -85,6 +85,20 @@ scale-smoke:
 		print('scale-smoke ok:', d['value'], d['unit'], \
 		      '| syncs/sec', d['details']['syncs_per_sec'], \
 		      '| index hit rate', d['details']['index_hit_rate'])"
+
+# Wide-job smoke: ONE TFJob with 64 Worker replicas over the pooled REST
+# transport + slow-start batched manage, 5 ms injected RTT (loopback hides
+# the fan-out; see docs/PERF.md "Wide-job fan-out").  Parallel runs land
+# in <1s here; the 20s gate flags an order-of-magnitude regression (e.g.
+# the write path going serial again), not scheduler noise.
+widejob-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --replicas 64 --rtt-ms 5 \
+		--max-seconds 20 > /tmp/kctpu_widejob_smoke.json
+	@$(PY) -c "import json; d = json.load(open('/tmp/kctpu_widejob_smoke.json')); \
+		assert {'metric', 'value', 'unit', 'details'} <= set(d), d; \
+		print('widejob-smoke ok:', d['value'], d['unit'], \
+		      '| all running', d['details']['all_running_s'], 's', \
+		      '| create p99', d['details']['create_latency_p99_ms'], 'ms')"
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -1,14 +1,23 @@
-"""Benchmarks: dist-mnist headline + multi-job controller scale.
+"""Benchmarks: dist-mnist headline + multi-job scale + wide-job fan-out.
 
-Two modes:
+Three modes:
 
 - default: the headline dist-mnist TFJob wall-clock-to-Succeeded (below);
 - ``--scale N``: controller **throughput** at N concurrent TFJobs —
   orchestration-bound simulated jobs (FakeKubelet + PhasePolicy, no real
   training), reporting time-to-all-Succeeded, syncs/sec, reconcile
-  p50/p99, and the gather index hit rate.  This is the many-jobs axis the
-  headline bench (1 job, real training) cannot see: every reconcile used
-  to pay two full-namespace LISTs, making an all-jobs pass O(J²·R).
+  p50/p99, create-latency p50/p99, and the gather index hit rate.  This is
+  the many-jobs axis the headline bench (1 job, real training) cannot
+  see: every reconcile used to pay two full-namespace LISTs, making an
+  all-jobs pass O(J²·R).
+- ``--replicas N``: **wide-job fan-out** — ONE TFJob with N workers, the
+  controller talking to the in-process HTTP API server over the pooled
+  REST transport (the exact write path ``-kubeconfig`` selects), so every
+  child create is a real HTTP round-trip.  Reports time-to-all-pods-
+  created, time-to-all-Running, and create-latency p50/p99.
+  ``--manage-workers 1`` is the serial baseline (one blocking call per
+  child, 2×N sequential round-trips); the default runs the slow-start
+  batched parallel path (controller/slowstart.py).
 
 Headline: dist-mnist TFJob wall-clock-to-Succeeded.
 
@@ -267,6 +276,136 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
     }
 
 
+def run_widejob(replicas: int, manage_workers: int,
+                deadline_s: float = 0.0, run_s: float = 1.0,
+                rtt_s: float = 0.0) -> dict:
+    """One wide TFJob (N workers, simulated pods) with the controller on
+    the REST transport against the in-process HTTP API server, so child
+    creates pay real TCP round-trips (the pooled keep-alive transport and
+    the slow-start batches are exactly what this measures).
+
+    Reported clocks, all from TFJob creation:
+    - ``pods_created_s``: every desired pod object exists (the write-side
+      fan-out the slow-start batches parallelize);
+    - ``all_running_s``: every worker reached Running (or beyond);
+    - create-latency p50/p99 from the controller's per-call samples.
+
+    ``rtt_s`` > 0 injects that much latency into EVERY API request
+    (FakeAPIServer latency_s): loopback to an in-process server has ~zero
+    RTT, so the fan-out's effect on time-to-all-pods-created only shows
+    honestly with the round-trip cost a remote API server actually has —
+    serial manage pays 2×replicas of it back-to-back."""
+    from kubeflow_controller_tpu.api.core import Container, PodTemplateSpec
+    from kubeflow_controller_tpu.api.meta import ObjectMeta
+    from kubeflow_controller_tpu.api.tfjob import (
+        ReplicaType,
+        TFJob,
+        TFReplicaSpec,
+    )
+    from kubeflow_controller_tpu.cluster import Cluster, FakeKubelet, PhasePolicy
+    from kubeflow_controller_tpu.cluster.apiserver import FakeAPIServer
+    from kubeflow_controller_tpu.cluster.rest import Kubeconfig, RestCluster
+    from kubeflow_controller_tpu.controller import Controller
+
+    cluster = Cluster()
+    server = FakeAPIServer(cluster.store, latency_s=rtt_s)
+    url = server.start()
+    # Pool sized to the manage fan-out: parallel creates must not
+    # serialize on TCP setup (the point of the keep-alive pool).
+    rest = RestCluster(Kubeconfig(server=url),
+                       pool_size=max(manage_workers, 2))
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=run_s))
+    ctrl = Controller(rest, resync_period_s=5.0,
+                      manage_workers=manage_workers)
+    kubelet.start()
+    ctrl.run(threadiness=2)
+
+    job = TFJob(metadata=ObjectMeta(name="wide", namespace="default"))
+    t = PodTemplateSpec()
+    t.spec.containers.append(Container(name="tensorflow", image="img"))
+    t.spec.restart_policy = "OnFailure"
+    job.spec.tf_replica_specs.append(
+        TFReplicaSpec(replicas=replicas, tf_replica_type=ReplicaType.WORKER,
+                      template=t))
+    if not deadline_s:
+        deadline_s = max(60.0, 0.5 * replicas)
+
+    pods_created_s = all_running_s = None
+    try:
+        t0 = time.time()
+        rest.tfjobs.create(job)
+        deadline = t0 + deadline_s
+        # Phase 1: all pod objects exist (the pure write fan-out).
+        while time.time() < deadline:
+            pods = cluster.pods.list("default")
+            if len(pods) >= replicas:
+                pods_created_s = time.time() - t0
+                break
+            time.sleep(0.002)
+        # Phase 2: every worker reached Running (Succeeded counts — a fast
+        # pod may already be done by the time the last one starts).
+        while pods_created_s is not None and time.time() < deadline:
+            phases = [p.status.phase for p in cluster.pods.list("default")]
+            if (len(phases) >= replicas
+                    and all(ph in ("Running", "Succeeded") for ph in phases)):
+                all_running_s = time.time() - t0
+                break
+            time.sleep(0.002)
+        snap = ctrl.metrics.snapshot()
+    finally:
+        ctrl.stop()
+        kubelet.stop()
+        rest.close()
+        server.stop()
+    return {
+        "replicas": replicas,
+        "manage_workers": manage_workers,
+        "rtt_s": rtt_s,
+        "pods_created_s": pods_created_s,
+        "all_running_s": all_running_s,
+        "metrics": snap,
+    }
+
+
+def widejob_main(args) -> int:
+    result = run_widejob(args.replicas, args.manage_workers,
+                         deadline_s=args.deadline,
+                         rtt_s=args.rtt_ms / 1e3)
+    m = result["metrics"]
+    created = result["pods_created_s"]
+    print(json.dumps({
+        "metric": f"widejob_{args.replicas}_replicas_time_to_all_pods_created",
+        "value": round(created, 3) if created is not None else None,
+        "unit": "s",
+        "details": {
+            "replicas": args.replicas,
+            "manage_workers": args.manage_workers,
+            "rtt_ms": args.rtt_ms,
+            "all_running_s": (round(result["all_running_s"], 3)
+                              if result["all_running_s"] is not None else None),
+            "creates": m["creates"],
+            "sync_errors": m["sync_errors"],
+            "create_latency_p50_ms": round(m["create_latency_p50_s"] * 1e3, 3),
+            "create_latency_p99_ms": round(m["create_latency_p99_s"] * 1e3, 3),
+            "reconcile_p50_ms": round(m["reconcile_p50_s"] * 1e3, 3),
+            "reconcile_p99_ms": round(m["reconcile_p99_s"] * 1e3, 3),
+            "workload": (f"1 TFJob x {args.replicas} Worker replicas, "
+                         "simulated pods, controller on the pooled REST "
+                         "transport against the in-process HTTP API server"),
+        },
+    }))
+    if created is None or result["all_running_s"] is None:
+        print(f"widejob bench: job never reached "
+              f"{'all-pods-created' if created is None else 'all-Running'} "
+              f"within the deadline", file=sys.stderr)
+        return 1
+    if args.max_seconds and created > args.max_seconds:
+        print(f"widejob bench regression: {created:.3f}s > "
+              f"--max-seconds {args.max_seconds}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def scale_main(args) -> int:
     result = run_scale(args.scale, deadline_s=args.deadline,
                        heartbeat_s=args.heartbeat_s)
@@ -286,6 +425,10 @@ def scale_main(args) -> int:
             "syncs_per_sec": round(m["syncs"] / elapsed, 1) if elapsed else 0.0,
             "reconcile_p50_ms": round(m["reconcile_p50_s"] * 1e3, 3),
             "reconcile_p99_ms": round(m["reconcile_p99_s"] * 1e3, 3),
+            "create_latency_p50_ms": round(
+                m.get("create_latency_p50_s", 0.0) * 1e3, 3),
+            "create_latency_p99_ms": round(
+                m.get("create_latency_p99_s", 0.0) * 1e3, 3),
             "creates": m["creates"],
             "deletes": m["deletes"],
             "status_updates": m["status_updates"],
@@ -346,12 +489,23 @@ def main(argv=None) -> int:
     p.add_argument("--scale", type=int, default=0, metavar="N",
                    help="run the multi-job scale benchmark with N concurrent "
                         "simulated TFJobs instead of the headline bench")
+    p.add_argument("--replicas", type=int, default=0, metavar="N",
+                   help="run the wide-job fan-out benchmark: ONE TFJob with "
+                        "N Worker replicas, controller on the pooled REST "
+                        "transport (time-to-all-pods-created / all-Running)")
+    p.add_argument("--manage-workers", type=int, default=8, metavar="W",
+                   help="replicas mode: controller manage fan-out "
+                        "(1 = serial plan execution, the baseline)")
+    p.add_argument("--rtt-ms", type=float, default=0.0, metavar="MS",
+                   help="replicas mode: inject MS of latency into every API "
+                        "request (simulates a remote API server; loopback "
+                        "has ~zero RTT and hides the fan-out win)")
     p.add_argument("--deadline", type=float, default=0.0, metavar="S",
-                   help="scale mode: give up after S seconds "
-                        "(default max(120, 5*N))")
+                   help="scale/replicas mode: give up after S seconds")
     p.add_argument("--max-seconds", type=float, default=0.0, metavar="S",
-                   help="scale mode: exit nonzero when time-to-all-Succeeded "
-                        "exceeds S (the `make scale-smoke` regression gate)")
+                   help="scale/replicas mode: exit nonzero when the headline "
+                        "clock exceeds S (the `make *-smoke` regression "
+                        "gates)")
     p.add_argument("--heartbeat-s", type=float, default=0.0, metavar="S",
                    help="scale mode: simulated training heartbeats every S "
                         "seconds (0 = off); compare against a 0 run to "
@@ -360,6 +514,8 @@ def main(argv=None) -> int:
 
     if args.scale:
         return scale_main(args)
+    if args.replicas:
+        return widejob_main(args)
 
     import shutil
     import tempfile

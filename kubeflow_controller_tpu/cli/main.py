@@ -481,7 +481,9 @@ def cmd_run(args) -> int:
     else:
         cluster = Cluster()
         inventory, kubelet = _build_substrate(args, cluster)
-    ctrl = Controller(cluster, inventory=inventory, resync_period_s=args.resync_period)
+    ctrl = Controller(cluster, inventory=inventory,
+                      resync_period_s=args.resync_period,
+                      manage_workers=args.manage_workers)
     if kubelet is not None:
         kubelet.start()
     ctrl.run(threadiness=args.threadiness)
@@ -627,6 +629,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write a merged Chrome trace (controller + executed "
                         "pods) to PATH at exit")
     r.add_argument("--threadiness", type=int, default=2, help="sync workers (ref: 2)")
+    r.add_argument("--manage-workers", type=int, default=8,
+                   help="max concurrent child create/delete calls per "
+                        "controller (slow-start batched; 1 = serial plan "
+                        "execution)")
     r.add_argument("--resync-period", type=float, default=30.0, help="informer resync (ref: 30s)")
     r.add_argument("--sim-run-seconds", type=float, default=0.05,
                    help="simulated pod run time when not using --execute")

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple, Type
 from urllib.parse import parse_qs, urlparse
@@ -138,10 +139,17 @@ class FakeAPIServer:
     """ThreadingHTTPServer over an ObjectStore; start() returns the URL."""
 
     def __init__(self, store: Optional[ObjectStore] = None, token: str = "",
-                 port: int = 0, kubelet=None, registry=None, tracer=None):
+                 port: int = 0, kubelet=None, registry=None, tracer=None,
+                 latency_s: float = 0.0):
         self.store = store or ObjectStore()
         self.token = token
         self.port = port  # 0 = ephemeral
+        # Injected per-request latency (seconds) on every API route —
+        # loopback has none, a real API server has plenty (network RTT,
+        # TLS, admission).  The wide-job bench uses this to measure the
+        # RTT-dominated regime where serial plan execution pays
+        # 2×replicas sequential round-trips (`bench.py --replicas --rtt-ms`).
+        self.latency_s = latency_s
         # Optional node agent: enables the pod log subresource (the real
         # API server proxies /pods/{name}/log to the kubelet the same way).
         self.kubelet = kubelet
@@ -167,6 +175,18 @@ class FakeAPIServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # Keep-alive idle deadline.  The pooled REST transport holds
+            # persistent connections (urllib used to send Connection: close
+            # per request, so this never mattered); without a timeout every
+            # idle pooled socket would pin one server thread forever — and
+            # outlive stop().  A timed-out connection closes server-side;
+            # the client pool reconnects transparently on next checkout.
+            timeout = 30
+            # Response headers and bodies go out as separate writes; with
+            # Nagle on, keep-alive round-trips eat 40 ms delayed-ACK
+            # stalls (the client side sets TCP_NODELAY for the same
+            # reason — see cluster/rest.py ConnectionPool.dial).
+            disable_nagle_algorithm = True
 
             def log_message(self, *args):  # quiet
                 pass
@@ -199,6 +219,10 @@ class FakeAPIServer:
                 self._raw_body = self.rfile.read(n) if n else b""
                 if self._deny():
                     return
+                if outer.latency_s > 0:
+                    # time.sleep releases the GIL: concurrent requests pay
+                    # the simulated RTT concurrently, as real wires do.
+                    time.sleep(outer.latency_s)
                 u = urlparse(self.path)
                 if u.path == "/metrics" and method == "GET":
                     data = outer.render_metrics().encode()
@@ -367,7 +391,12 @@ class FakeAPIServer:
 
     def _stream_watch(self, h, r: _Route) -> None:
         """Chunked streaming of store watch events as JSON lines, until the
-        client goes away."""
+        client goes away.  Every exit path closes the connection: the
+        stream ends without a terminating chunk, so a keep-alive client
+        would otherwise block forever waiting for data that never comes
+        (urllib's per-request Connection: close used to mask this; the
+        pooled transport keeps sockets open)."""
+        h.close_connection = True
         w = self.store.watch(r.plural, r.namespace)
         gen = self._watch_gen
         try:

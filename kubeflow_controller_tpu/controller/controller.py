@@ -31,6 +31,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
 from ..api.core import Pod, Service
@@ -69,6 +70,7 @@ from .expectations import ControllerExpectations
 from .helper import Helper, register_gather_indexers
 from .informer import SharedInformer
 from .metrics import ReconcileMetrics
+from .slowstart import ManageError, slow_start_batch
 from .workqueue import RateLimitingQueue, ShutDown
 
 logger = logging.getLogger("kubeflow_controller_tpu.controller")
@@ -87,9 +89,24 @@ class Controller:
         resync_period_s: float = 30.0,
         recorder: Optional[EventRecorder] = None,
         stall_policy: Optional[StallPolicy] = None,
+        manage_workers: int = 8,
     ):
         self.cluster = cluster
         self.inventory = inventory
+        # Plan-execution fan-out: ``manage_workers`` bounds the threads that
+        # issue child create/delete calls concurrently (the slow-start
+        # batches in _manage_inner).  <=1 selects the serial path — the
+        # baseline `bench.py --replicas N --manage-workers 1` measures
+        # against.  The pool is lazy (most tests never manage wide plans)
+        # and shared across sync workers, so total write concurrency per
+        # controller is bounded regardless of threadiness.
+        self.manage_workers = manage_workers
+        self._manage_pool: Optional[ThreadPoolExecutor] = None
+        self._manage_pool_lock = threading.Lock()
+        self._h_batch = REGISTRY.histogram(
+            "kctpu_manage_batch_size",
+            "Plan events dispatched per slow-start batch",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
         # Training-plane stall detection: per-pod step-advancement memory
         # + the deadlines that turn a silent heartbeat into Degraded
         # health, a TrainingStalled event, and kctpu_job_stalled=1.
@@ -206,6 +223,10 @@ class Controller:
         self.queue.shut_down()
         for inf in (self.tfjob_informer, self.pod_informer, self.service_informer):
             inf.stop()
+        with self._manage_pool_lock:
+            pool, self._manage_pool = self._manage_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
         if self._owns_recorder:
             self.recorder.close()  # drain pending Event API writes
 
@@ -525,40 +546,116 @@ class Controller:
             self._manage_inner(key, job, pods_by_type, services_by_type, sp)
 
     def _manage_inner(self, key, job, pods_by_type, services_by_type, sp) -> None:
+        """Execute the plan through slow-start batches (client-go's
+        ``slowStartBatch``; see slowstart.py).  Three ordered phases keep
+        the serial invariants — deletes land before the creates that reuse
+        their indices/names, services before the pods whose cluster specs
+        name them — while each phase fans out on the bounded manage pool.
+
+        Error semantics (the write-side contract):
+
+        - every event in a launched batch is attempted; a failed event
+          lowers its own expectation (its watch event will never arrive,
+          ref: controller.go:381-383) and the rest of the batch drains;
+        - the first failing batch stops NEW batches and later phases;
+          skipped events' expectations are lowered here so the next sync
+          re-plans exactly the missing children instead of waiting out the
+          expectations TTL;
+        - all errors are aggregated into one ManageError so the sync
+          requeues with backoff, instead of the historical abort-on-first
+          that silently dropped the remaining replicas' events."""
         plan = plan_job(job, pods_by_type, services_by_type)
         sp.args["creations"] = plan.creations
         sp.args["deletions"] = plan.deletions
         if plan.empty:
             return
         self.expectations.expect(key, plan.creations, plan.deletions)
-        for ev in plan.events:
-            spec = replica_spec_for(job, ev.replica_type)
-            try:
-                if ev.action == Action.ADD_SERVICE:
-                    self.helper.create_service(job, make_service(job, spec, ev.index))
-                    self.metrics.inc_creates()
-                elif ev.action == Action.ADD_POD:
-                    self.helper.create_pod(job, make_pod(job, spec, ev.index))
-                    self.metrics.inc_creates()
-                elif ev.action == Action.DELETE_POD:
-                    if self.helper.delete_pod(job, job.metadata.namespace, ev.name):
-                        self.metrics.inc_deletes()
-                    else:
-                        # Already gone: no DELETED event will arrive.
-                        self.expectations.lower_expectations(key, del_delta=1)
-                elif ev.action == Action.DELETE_SERVICE:
-                    if self.helper.delete_service(job, job.metadata.namespace, ev.name):
-                        self.metrics.inc_deletes()
-                    else:
-                        self.expectations.lower_expectations(key, del_delta=1)
-            except Exception:
-                # The watch event will never arrive; decrement so the TTL
-                # does not block the next sync (ref: controller.go:381-383).
-                if ev.action in (Action.ADD_POD, Action.ADD_SERVICE):
-                    self.expectations.lower_expectations(key, add_delta=1)
+
+        adds = (Action.ADD_POD, Action.ADD_SERVICE)
+        phases = (
+            [ev for ev in plan.events if ev.action not in adds],     # deletes
+            [ev for ev in plan.events if ev.action == Action.ADD_SERVICE],
+            [ev for ev in plan.events if ev.action == Action.ADD_POD],
+        )
+        executor = self._manage_executor()
+
+        def batch_cm(n: int):
+            self._h_batch.observe(n)
+            return trace.span("sync/manage/batch", key=key, n=n)
+
+        errors: List[BaseException] = []
+        attempted = skipped_adds = skipped_dels = 0
+        for evs in phases:
+            if errors:
+                # A failed earlier phase: creates that would collide with
+                # an undeleted name, or follow a failed sibling, are not
+                # launched — but their expectations must not dangle.
+                skipped_adds += sum(1 for ev in evs if ev.action in adds)
+                skipped_dels += sum(1 for ev in evs if ev.action not in adds)
+                continue
+            done, errs, skipped = slow_start_batch(
+                evs, lambda ev: self._execute_event(key, job, ev),
+                executor=executor, batch_cm=batch_cm)
+            attempted += done + len(errs)
+            errors.extend(errs)
+            skipped_adds += sum(1 for ev in skipped if ev.action in adds)
+            skipped_dels += sum(1 for ev in skipped if ev.action not in adds)
+
+        if errors:
+            if skipped_adds:
+                self.expectations.lower_expectations(
+                    key, add_delta=skipped_adds)
+            if skipped_dels:
+                self.expectations.lower_expectations(
+                    key, del_delta=skipped_dels)
+            raise ManageError(errors, attempted=attempted,
+                              skipped=skipped_adds + skipped_dels)
+
+    def _execute_event(self, key: str, job: TFJob, ev) -> None:
+        """One plan event -> one cluster write.  Runs on manage-pool threads
+        on the parallel path: everything it touches is thread-safe (Helper
+        deep-copies templates, EventRecorder and ReconcileMetrics lock,
+        ControllerExpectations locks, the job object is this sync's private
+        deep copy and is only read)."""
+        spec = replica_spec_for(job, ev.replica_type)
+        try:
+            if ev.action == Action.ADD_SERVICE:
+                self.helper.create_service(job, make_service(job, spec, ev.index))
+                self.metrics.inc_creates()
+            elif ev.action == Action.ADD_POD:
+                self.helper.create_pod(job, make_pod(job, spec, ev.index))
+                self.metrics.inc_creates()
+            elif ev.action == Action.DELETE_POD:
+                if self.helper.delete_pod(job, job.metadata.namespace, ev.name):
+                    self.metrics.inc_deletes()
+                else:
+                    # Already gone: no DELETED event will arrive.
+                    self.expectations.lower_expectations(key, del_delta=1)
+            elif ev.action == Action.DELETE_SERVICE:
+                if self.helper.delete_service(job, job.metadata.namespace, ev.name):
+                    self.metrics.inc_deletes()
                 else:
                     self.expectations.lower_expectations(key, del_delta=1)
-                raise
+        except Exception:
+            # The watch event will never arrive; decrement so the TTL
+            # does not block the next sync (ref: controller.go:381-383).
+            if ev.action in (Action.ADD_POD, Action.ADD_SERVICE):
+                self.expectations.lower_expectations(key, add_delta=1)
+            else:
+                self.expectations.lower_expectations(key, del_delta=1)
+            raise
+
+    def _manage_executor(self) -> Optional[ThreadPoolExecutor]:
+        """The shared bounded manage pool; None selects the serial path."""
+        if self.manage_workers <= 1:
+            return None
+        if self._manage_pool is None:
+            with self._manage_pool_lock:
+                if self._manage_pool is None and not self._stop.is_set():
+                    self._manage_pool = ThreadPoolExecutor(
+                        max_workers=self.manage_workers,
+                        thread_name_prefix="manage-worker")
+        return self._manage_pool
 
     def _update_status(self, job: TFJob, new_status) -> None:
         """Status write with conflict retry (the reference's bare Update with

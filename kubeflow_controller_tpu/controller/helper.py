@@ -26,6 +26,7 @@ selector index shows an unowned candidate that may need adoption.  Release
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional
 
 from ..api.core import Pod, Service
@@ -34,6 +35,7 @@ from ..api.labels import job_selector_index_keys
 from ..api.tfjob import API_VERSION, KIND, TFJob
 from ..cluster.client import Cluster
 from ..cluster.store import NotFound
+from ..obs.metrics import REGISTRY
 from ..utils import serde
 from .events import (
     EventRecorder,
@@ -73,6 +75,19 @@ class Helper:
         self.pod_informer = pod_informer
         self.service_informer = service_informer
         self.metrics = metrics
+        # Per-create API latency: the quantity the wide-job bench gates on
+        # (a serial manage pays 2×replicas of these back-to-back; the
+        # slow-start batches overlap them).  One histogram for pods and
+        # services — the label split wasn't worth the cardinality.
+        self._h_create_latency = REGISTRY.histogram(
+            "kctpu_create_latency_seconds",
+            "Child create API call latency (pods and services)")
+
+    def _observe_create(self, t0: float) -> None:
+        dur = time.monotonic() - t0
+        self._h_create_latency.observe(dur)
+        if self.metrics is not None:
+            self.metrics.record_create_latency(dur)
 
     # -- writes --------------------------------------------------------------
 
@@ -83,8 +98,10 @@ class Helper:
             raise ValueError("pod template has no labels; refusing to create")
         set_controller_ref(pod.metadata, job.metadata, API_VERSION, KIND)
         validate_controller_ref(get_controller_of(pod.metadata))
+        t0 = time.monotonic()
         try:
             created = self.cluster.pods.create(pod)
+            self._observe_create(t0)
         except Exception as e:
             self.recorder.event(job, TYPE_WARNING, REASON_FAILED_CREATE,
                                 f"Error creating pod: {e}")
@@ -100,8 +117,10 @@ class Helper:
             raise ValueError("service template has no labels; refusing to create")
         set_controller_ref(service.metadata, job.metadata, API_VERSION, KIND)
         validate_controller_ref(get_controller_of(service.metadata))
+        t0 = time.monotonic()
         try:
             created = self.cluster.services.create(service)
+            self._observe_create(t0)
         except Exception as e:
             self.recorder.event(job, TYPE_WARNING, REASON_FAILED_CREATE,
                                 f"Error creating service: {e}")

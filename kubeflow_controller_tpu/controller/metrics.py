@@ -30,6 +30,10 @@ class ReconcileMetrics:
         # the index hit rate — at steady state full_lists must be flat.
         self.gather_indexed = 0
         self.gather_full_lists = 0
+        # Per-create API latency samples (pods+services), fed by the
+        # Helper: the wide-job and multi-job benches share this one
+        # latency vocabulary (create_latency_p50/p99 in snapshots).
+        self._create_samples: List[float] = []
 
     def record_sync(self, duration_s: float, error: bool = False) -> None:
         with self._lock:
@@ -62,6 +66,20 @@ class ReconcileMetrics:
     def inc_gather_full_lists(self, n: int = 1) -> None:
         with self._lock:
             self.gather_full_lists += n
+
+    def record_create_latency(self, duration_s: float) -> None:
+        with self._lock:
+            self._create_samples.append(duration_s)
+            if len(self._create_samples) > self._max:
+                self._create_samples = self._create_samples[-self._max :]
+
+    def create_latency_percentile(self, q: float) -> float:
+        with self._lock:
+            if not self._create_samples:
+                return 0.0
+            s = sorted(self._create_samples)
+            idx = min(len(s) - 1, int(q / 100.0 * len(s)))
+            return s[idx]
 
     def percentile(self, q: float) -> float:
         with self._lock:
@@ -97,6 +115,8 @@ class ReconcileMetrics:
             "reconcile_p50_s": self.p50,
             "reconcile_p90_s": self.p90,
             "reconcile_p99_s": self.p99,
+            "create_latency_p50_s": self.create_latency_percentile(50),
+            "create_latency_p99_s": self.create_latency_percentile(99),
             "samples": n,
         }
 

@@ -35,19 +35,38 @@ def _json_key(f: dataclasses.Field) -> str:
     return f.metadata.get("json", camel(f.name))
 
 
+# Per-dataclass field specs: (field_name, wire_key, resolved_type).  With
+# ``from __future__ import annotations`` every annotation is a string, so an
+# uncached ``get_type_hints`` re-evals the whole module namespace per call —
+# measured at ~44% of a REST create round-trip before caching (the wire path
+# deserializes every object it touches).  Plain-dict write is atomic under
+# the GIL; a rare duplicate compute is harmless.
+_SPEC_CACHE: Dict[type, Any] = {}
+
+
+def _spec_of(cls: type):
+    spec = _SPEC_CACHE.get(cls)
+    if spec is None:
+        hints = get_type_hints(cls)
+        spec = tuple((f.name, _json_key(f), hints[f.name])
+                     for f in dataclasses.fields(cls))
+        _SPEC_CACHE[cls] = spec
+    return spec
+
+
 def to_dict(obj: Any) -> Any:
     """Recursively serialize a dataclass tree to plain JSON-able types."""
     if obj is None:
         return None
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         out: Dict[str, Any] = {}
-        for f in dataclasses.fields(obj):
-            v = getattr(obj, f.name)
+        for name, key, _ in _spec_of(type(obj)):
+            v = getattr(obj, name)
             # omitempty: drop None, empty strings, and empty collections
             # (ints stay even at 0 — replicas: 0 is meaningful).
             if v is None or v == "" or (isinstance(v, (list, dict, tuple)) and not v):
                 continue
-            out[_json_key(f)] = to_dict(v)
+            out[key] = to_dict(v)
         return out
     if isinstance(obj, enum.Enum):
         return obj.value
@@ -94,12 +113,10 @@ def from_dict(cls: Type[T], d: Optional[Dict[str, Any]]) -> Optional[T]:
     """
     if d is None:
         return None
-    hints = get_type_hints(cls)
     kwargs: Dict[str, Any] = {}
-    for f in dataclasses.fields(cls):
-        key = _json_key(f)
+    for name, key, tp in _spec_of(cls):
         if key in d:
-            kwargs[f.name] = _coerce(hints[f.name], d[key])
+            kwargs[name] = _coerce(tp, d[key])
     return cls(**kwargs)
 
 

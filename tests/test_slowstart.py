@@ -1,0 +1,513 @@
+"""Write-path fan-out tests: slow-start batched plan execution
+(controller/slowstart.py wired through Controller._manage_inner), the
+expectation accounting that keeps a mid-batch failure consistent, and the
+pooled keep-alive REST transport underneath it (cluster/rest.py).
+
+The load-bearing contract (ISSUE 4 acceptance): a create that fails mid-
+batch must leave ``ControllerExpectations`` exact — failed and skipped
+events lower their own expectations, so the NEXT sync re-plans exactly the
+missing children instead of waiting out the 5-minute TTL or double-creating
+the survivors."""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import Container, Pod, PodTemplateSpec
+from kubeflow_controller_tpu.api.meta import ObjectMeta
+from kubeflow_controller_tpu.api.tfjob import (
+    ReplicaType,
+    TFJob,
+    TFJobPhase,
+    TFReplicaSpec,
+)
+from kubeflow_controller_tpu.cluster import Cluster, FakeKubelet, PhasePolicy
+from kubeflow_controller_tpu.cluster.apiserver import FakeAPIServer
+from kubeflow_controller_tpu.cluster.rest import (
+    ConnectionPool,
+    Kubeconfig,
+    RestCluster,
+)
+from kubeflow_controller_tpu.cluster.store import APIError
+from kubeflow_controller_tpu.controller import Controller
+from kubeflow_controller_tpu.controller.expectations import ControllerExpectations
+from kubeflow_controller_tpu.controller.slowstart import (
+    ManageError,
+    slow_start_batch,
+)
+
+
+def mk_job(name, *types_and_replicas):
+    job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+    for typ, n in types_and_replicas:
+        t = PodTemplateSpec()
+        t.spec.containers.append(Container(name="tensorflow", image="img"))
+        t.spec.restart_policy = "OnFailure"
+        job.spec.tf_replica_specs.append(
+            TFReplicaSpec(replicas=n, tf_replica_type=typ, template=t))
+    return job
+
+
+def wait_for(fn, timeout=15.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+# ---------------------------------------------------------------------------
+# slow_start_batch: the unit
+
+
+class TestSlowStartBatch:
+    def test_batches_grow_exponentially(self):
+        sizes = []
+        done, errors, skipped = slow_start_batch(
+            list(range(13)), lambda i: None,
+            batch_cm=lambda n: sizes.append(n) or _null())
+        assert (done, errors, skipped) == (13, [], [])
+        # 1, 2, 4, 8 — the last batch clamps to what remains.
+        assert sizes == [1, 2, 4, 6]
+
+    def test_serial_inline_preserves_order(self):
+        calls = []
+        done, errors, skipped = slow_start_batch(
+            list(range(9)), calls.append, executor=None)
+        assert (done, errors, skipped) == (9, [], [])
+        assert calls == list(range(9))
+
+    def test_first_failure_skips_the_tail(self):
+        """A persistently failing call costs O(log n) attempts, not n: the
+        1-item probe batch fails and nothing else launches."""
+        attempts = []
+
+        def fail(i):
+            attempts.append(i)
+            raise RuntimeError(f"boom {i}")
+
+        done, errors, skipped = slow_start_batch(list(range(16)), fail)
+        assert done == 0
+        assert len(errors) == 1
+        assert attempts == [0]
+        assert skipped == list(range(1, 16))
+
+    def test_failing_batch_drains_in_flight(self):
+        """Items already dispatched in the failing batch complete (their
+        side effects are real); only NEW batches stop."""
+        attempted = []
+        lock = threading.Lock()
+
+        def fn(i):
+            with lock:
+                attempted.append(i)
+            if i == 4:
+                raise RuntimeError("boom")
+
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            done, errors, skipped = slow_start_batch(
+                list(range(15)), fn, executor=pool)
+        # Batches 1, 2, 4 launched; item 4 (in the 4-wide batch) failed but
+        # items 3, 5, 6 of that batch still ran; the 8-wide tail never did.
+        assert sorted(attempted) == list(range(7))
+        assert done == 6
+        assert len(errors) == 1
+        assert skipped == list(range(7, 15))
+
+    def test_every_error_in_the_batch_is_kept(self):
+        def fn(i):
+            if i in (3, 5):
+                raise RuntimeError(f"boom {i}")
+
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            done, errors, skipped = slow_start_batch(
+                list(range(7)), fn, executor=pool)
+        assert done == 5  # 0; 1,2; 4,6 succeed, 3,5 fail
+        assert sorted(str(e) for e in errors) == ["boom 3", "boom 5"]
+        assert skipped == []
+
+    def test_wide_batch_actually_runs_concurrently(self):
+        """The 4-wide batch must overlap on the pool — a gate that only
+        opens when all 4 calls are inside fn proves it (a serialized
+        executor would deadlock and trip the barrier timeout)."""
+        barrier = threading.Barrier(4, timeout=5.0)
+
+        def fn(i):
+            if i >= 3:  # the four members of the third batch
+                barrier.wait()
+
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            done, errors, skipped = slow_start_batch(
+                list(range(7)), fn, executor=pool)
+        assert (done, errors, skipped) == (7, [], [])
+
+    def test_manage_error_message_counts(self):
+        err = ManageError([RuntimeError("a"), RuntimeError("b")],
+                          attempted=5, skipped=3)
+        assert "2/5 plan events failed" in str(err)
+        assert "(3 skipped)" in str(err)
+        assert len(err.errors) == 2
+
+
+class _null:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# ControllerExpectations under concurrent raise/lower (manage workers +
+# watch handlers hit it from many threads at once)
+
+
+class TestExpectationsConcurrency:
+    def _hammer(self, fn_a, fn_b, rounds=200, threads=4):
+        workers = []
+        for fn in (fn_a, fn_b):
+            for _ in range(threads):
+                workers.append(threading.Thread(
+                    target=lambda f=fn: [f() for _ in range(rounds)]))
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+
+    def test_no_lost_or_double_counted_adds(self):
+        exp = ControllerExpectations()
+        exp.expect("default/j", adds=8 * 200, dels=0)
+        # Half the decrements arrive as watch observations, half as failed-
+        # create lowers — exactly the parallel manage path's mix.
+        self._hammer(lambda: exp.creation_observed("default/j"),
+                     lambda: exp.lower_expectations("default/j", add_delta=1))
+        e = exp._store["default/j"]
+        assert e.adds == 0  # exact: not negative, not positive
+        assert exp.satisfied_expectations("default/j")
+
+    def test_no_lost_or_double_counted_dels(self):
+        exp = ControllerExpectations()
+        exp.expect("default/j", adds=0, dels=8 * 200)
+        self._hammer(lambda: exp.deletion_observed("default/j"),
+                     lambda: exp.lower_expectations("default/j", del_delta=1))
+        assert exp._store["default/j"].dels == 0
+        assert exp.satisfied_expectations("default/j")
+
+    def test_unsatisfied_until_every_delta_lands(self):
+        exp = ControllerExpectations()
+        exp.expect("default/j", adds=3, dels=0)
+        exp.creation_observed("default/j")
+        exp.creation_observed("default/j")
+        assert not exp.satisfied_expectations("default/j")
+        exp.lower_expectations("default/j", add_delta=1)
+        assert exp.satisfied_expectations("default/j")
+
+
+# ---------------------------------------------------------------------------
+# Mid-batch create failure: expectations stay consistent, the next sync
+# re-plans exactly the missing children (ISSUE 4 acceptance criterion),
+# and surviving events for other replicas are still attempted (satellite:
+# the old _manage_inner raised on the first failure and dropped the rest).
+
+
+class FlakyPods:
+    """Wraps the pod client: create fails ``fail_times`` times for the pod
+    whose generateName starts with ``prefix``; every attempt is logged by
+    its replica identity (generateName, stable across retries — the final
+    object name gets a random suffix per attempt)."""
+
+    def __init__(self, pods, prefix, fail_times):
+        self._pods = pods
+        self._prefix = prefix
+        self._left = fail_times
+        self.lock = threading.Lock()
+        self.attempts = []
+
+    def create(self, pod):
+        ident = pod.metadata.generate_name or pod.metadata.name
+        with self.lock:
+            self.attempts.append(ident)
+            if ident.startswith(self._prefix) and self._left > 0:
+                self._left -= 1
+                raise APIError("injected create failure")
+        return self._pods.create(pod)
+
+    def __getattr__(self, attr):  # delegate list/get/delete/watch/...
+        return getattr(self._pods, attr)
+
+
+@pytest.mark.parametrize("manage_workers", [1, 4])
+def test_mid_batch_create_failure_replans_exactly_missing(manage_workers):
+    cluster = Cluster()
+    flaky = FlakyPods(cluster.pods, prefix="wide-worker-1-", fail_times=1)
+    cluster.pods = flaky
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=0.2))
+    ctrl = Controller(cluster, resync_period_s=0.5,
+                      manage_workers=manage_workers)
+    kubelet.start()
+    ctrl.run(threadiness=2)
+    try:
+        cluster.tfjobs.create(mk_job("wide", (ReplicaType.WORKER, 4)))
+        wait_for(lambda: len(cluster.pods.list("default")) == 4)
+        wait_for(lambda: phase(cluster, "wide") in
+                 (TFJobPhase.RUNNING, TFJobPhase.SUCCEEDED))
+        with flaky.lock:
+            attempts = list(flaky.attempts)
+    finally:
+        ctrl.stop()
+        kubelet.stop()
+
+    by_name = {n: attempts.count(n) for n in set(attempts)}
+    # The failed child was re-planned (original + exactly one retry)...
+    assert by_name.pop("wide-worker-1-") == 2
+    # ...and ONLY it: every other child was created exactly once — the
+    # failing sync still attempted its batch siblings (no abort-on-first),
+    # and the re-plan did not double-create survivors (expectations were
+    # lowered for the failed event, so the next sync saw exact state).
+    assert by_name == {f"wide-worker-{i}-": 1 for i in (0, 2, 3)}
+    # The retry happened in well under the 5-minute expectations TTL —
+    # i.e. the failed event's expectation was lowered, not leaked.
+    assert ctrl.metrics.snapshot()["sync_errors"] >= 1
+
+
+def phase(cluster, name):
+    return cluster.tfjobs.get("default", name).status.phase
+
+
+def test_persistent_failure_skips_tail_then_converges():
+    """A wide plan whose probe batch keeps failing wastes O(log n) calls
+    per sync (not n), and still converges once the fault clears."""
+    cluster = Cluster()
+    flaky = FlakyPods(cluster.pods, prefix="wide-worker-0-", fail_times=2)
+    cluster.pods = flaky
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=0.2))
+    ctrl = Controller(cluster, resync_period_s=0.3, manage_workers=4)
+    kubelet.start()
+    ctrl.run(threadiness=2)
+    try:
+        cluster.tfjobs.create(mk_job("wide", (ReplicaType.WORKER, 8)))
+        wait_for(lambda: len(cluster.pods.list("default")) == 8)
+        with flaky.lock:
+            attempts = list(flaky.attempts)
+    finally:
+        ctrl.stop()
+        kubelet.stop()
+    # Every child created exactly once, except the faulty one: 2 failures
+    # + the success.  No child was created twice.
+    by_name = {n: attempts.count(n) for n in set(attempts)}
+    assert by_name.pop("wide-worker-0-") == 3
+    assert set(by_name.values()) == {1}
+
+
+# ---------------------------------------------------------------------------
+# Pooled keep-alive REST transport
+
+
+@pytest.fixture
+def server():
+    srv = FakeAPIServer()
+    url = srv.start()
+    yield srv, url
+    srv.stop()
+
+
+@pytest.fixture
+def rest(server):
+    _, url = server
+    c = RestCluster(Kubeconfig(server=url))
+    yield c
+    c.close()
+
+
+class TestConnectionPool:
+    def test_sequential_requests_reuse_one_connection(self, rest):
+        pool = rest.transport.pool
+        d0, r0 = pool._c_dials.value, pool._c_reuses.value
+        for _ in range(5):
+            rest.pods.list("default")
+        assert pool._c_dials.value - d0 == 1
+        assert pool._c_reuses.value - r0 == 4
+        assert pool.idle_count == 1
+
+    def test_stale_pooled_socket_reconnects_transparently(self, rest):
+        rest.pods.list("default")  # park one keep-alive connection
+        pool = rest.transport.pool
+        assert pool.idle_count == 1
+        # Kill the idle socket under the pool (a server idle-timeout does
+        # exactly this); the next request must notice and redial, not fail.
+        pool._idle[0].sock.close()
+        assert rest.pods.list("default") == []
+
+    def test_pool_bounds_idle_connections(self, server):
+        _, url = server
+        c = RestCluster(Kubeconfig(server=url), pool_size=2)
+        try:
+            results = []
+
+            def hit():
+                results.append(c.pods.list("default"))
+
+            threads = [threading.Thread(target=hit) for _ in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(results) == 6
+            assert c.transport.pool.idle_count <= 2
+        finally:
+            c.close()
+
+    def test_concurrent_creates_all_land(self, server):
+        """The write path the slow-start batches drive: parallel creates
+        through one pooled transport, server must tolerate them all."""
+        srv, url = server
+        c = RestCluster(Kubeconfig(server=url), pool_size=8)
+        try:
+            errs = []
+
+            def create(i):
+                p = Pod()
+                p.metadata.namespace = "default"
+                p.metadata.name = f"p{i}"
+                try:
+                    c.pods.create(p)
+                except Exception as e:  # noqa: BLE001 - recorded for assert
+                    errs.append(e)
+
+            threads = [threading.Thread(target=create, args=(i,))
+                       for i in range(16)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert errs == []
+            assert len(srv.store.list("pods", "default")) == 16
+            # A cold burst may dial per-thread (maxsize bounds idle
+            # retention, not burst width) — but the NEXT round must ride
+            # the retained keep-alive connections, not dial again.
+            dials_after_burst = c.transport.pool._c_dials.value
+            for i in range(16, 24):
+                p = Pod()
+                p.metadata.namespace = "default"
+                p.metadata.name = f"p{i}"
+                c.pods.create(p)
+            assert c.transport.pool._c_dials.value == dials_after_burst
+        finally:
+            c.close()
+
+
+class _BrokenOnce:
+    """Stands in for a fresh connection whose request dies transiently."""
+
+    sock = object()
+
+    def request(self, *a, **k):
+        raise ConnectionResetError("transient")
+
+    def close(self):
+        pass
+
+
+class _FlakyCheckoutPool:
+    """First checkout hands back a connection that fails its request (as a
+    FRESH dial, reused=False — the case the safe-verb retry exists for);
+    later checkouts delegate to the real pool."""
+
+    def __init__(self, real):
+        self._real = real
+        self._tripped = False
+
+    def checkout(self, timeout=None):
+        if not self._tripped:
+            self._tripped = True
+            return _BrokenOnce(), False
+        return self._real.checkout(timeout)
+
+    def __getattr__(self, attr):  # dial/checkin/discard/close/...
+        return getattr(self._real, attr)
+
+
+class TestSafeVerbRetry:
+    def test_get_retries_once_on_transient_error(self, rest):
+        rest.transport.pool = _FlakyCheckoutPool(rest.transport.pool)
+        assert rest.pods.list("default") == []  # retried, not raised
+
+    def test_post_does_not_retry_on_fresh_socket(self, server):
+        srv, url = server
+        c = RestCluster(Kubeconfig(server=url))
+        try:
+            c.transport.pool = _FlakyCheckoutPool(c.transport.pool)
+            p = Pod()
+            p.metadata.namespace = "default"
+            p.metadata.name = "once"
+            with pytest.raises(APIError):
+                c.pods.create(p)
+            # The request was NOT replayed: nothing reached the store.
+            assert srv.store.list("pods", "default") == []
+        finally:
+            c.close()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end over HTTP: controller with parallel manage on the pooled
+# transport (the exact stack `bench.py --replicas` measures)
+
+
+def test_wide_job_over_rest_with_parallel_manage():
+    cluster = Cluster()
+    srv = FakeAPIServer(cluster.store)
+    url = srv.start()
+    rest = RestCluster(Kubeconfig(server=url), pool_size=4)
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=0.1))
+    ctrl = Controller(rest, resync_period_s=1.0, manage_workers=4)
+    kubelet.start()
+    ctrl.run(threadiness=2)
+    try:
+        rest.tfjobs.create(mk_job("wide", (ReplicaType.WORKER, 8)))
+        wait_for(lambda: len(cluster.pods.list("default")) == 8, timeout=30.0)
+        wait_for(lambda: rest.tfjobs.get("default", "wide").status.phase
+                 == TFJobPhase.SUCCEEDED, timeout=30.0)
+        snap = ctrl.metrics.snapshot()
+        assert snap["creates"] >= 16  # 8 pods + 8 services
+        assert snap["sync_errors"] == 0
+        assert snap["create_latency_p99_s"] > 0.0
+    finally:
+        ctrl.stop()
+        kubelet.stop()
+        rest.close()
+        srv.stop()
+
+
+def test_batch_size_histogram_observed():
+    """kctpu_manage_batch_size records the slow-start ramp."""
+    from kubeflow_controller_tpu.obs.metrics import REGISTRY
+
+    cluster = Cluster()
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=0.1))
+    ctrl = Controller(cluster, resync_period_s=1.0, manage_workers=4)
+    h = REGISTRY.histogram(
+        "kctpu_manage_batch_size",
+        "Plan events dispatched per slow-start batch",
+        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
+    before = h.count
+    kubelet.start()
+    ctrl.run(threadiness=2)
+    try:
+        cluster.tfjobs.create(mk_job("wide", (ReplicaType.WORKER, 4)))
+        wait_for(lambda: len(cluster.pods.list("default")) == 4)
+    finally:
+        ctrl.stop()
+        kubelet.stop()
+    # 4 services + 4 pods in slow-start batches (1,2,1 / 1,2,1 at minimum).
+    assert h.count > before
+
+
+def test_pool_close_idempotent_and_checkout_after_close_dials():
+    pool = ConnectionPool("http://127.0.0.1:1")  # never actually connected
+    pool.close()
+    pool.close()
+    assert pool.idle_count == 0
