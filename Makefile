@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast lint typecheck cov cov-local bench dryrun validate metrics-smoke scale-smoke stall-smoke widejob-smoke
+.PHONY: all test test-fast lint typecheck cov cov-local bench dryrun validate metrics-smoke scale-smoke stall-smoke widejob-smoke churn-smoke
 
 all: lint test
 
@@ -99,6 +99,22 @@ widejob-smoke:
 		print('widejob-smoke ok:', d['value'], d['unit'], \
 		      '| all running', d['details']['all_running_s'], 's', \
 		      '| create p99', d['details']['create_latency_p99_ms'], 'ms')"
+
+# Churn smoke: 6 simulated jobs over the REST transport while the server
+# forcibly drops every watch stream 3x mid-run.  With warm RVs every
+# reconnect must RESUME (server-side replay from the watch cache): the
+# gate asserts ZERO full re-lists and >=1 successful resume — a relist
+# means the resumable watch plane regressed to reconnect-storm re-listing
+# (docs/PERF.md "Watch-plane churn").  Bounded: ~5-10s wall-clock.
+churn-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --churn 6 --drops 3 --max-relists 0 \
+		--min-resumes 1 > /tmp/kctpu_churn_smoke.json
+	@$(PY) -c "import json; d = json.load(open('/tmp/kctpu_churn_smoke.json')); \
+		assert {'metric', 'value', 'unit', 'details'} <= set(d), d; \
+		print('churn-smoke ok: relists', d['value'], \
+		      '| resumes', d['details']['watch_resumes'], \
+		      '| replayed', d['details']['watch_replayed_events'], \
+		      '| storm p99', d['details']['storm_reconcile_p99_ms'], 'ms')"
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -1,6 +1,7 @@
-"""Benchmarks: dist-mnist headline + multi-job scale + wide-job fan-out.
+"""Benchmarks: dist-mnist headline + multi-job scale + wide-job fan-out +
+watch-plane churn.
 
-Three modes:
+Four modes:
 
 - default: the headline dist-mnist TFJob wall-clock-to-Succeeded (below);
 - ``--scale N``: controller **throughput** at N concurrent TFJobs —
@@ -18,6 +19,14 @@ Three modes:
   ``--manage-workers 1`` is the serial baseline (one blocking call per
   child, 2×N sequential round-trips); the default runs the slow-start
   batched parallel path (controller/slowstart.py).
+- ``--churn N``: **watch-plane churn** — N simulated jobs with the
+  controller on the REST transport while the API server forcibly drops
+  every watch stream ``--drops`` times mid-run.  Reports full re-list
+  count, LIST bytes served during the storm, RV-resume and replayed-event
+  counts, and reconcile p50/p99.  ``--no-resume`` is the pre-resumption
+  baseline (every reconnect is a gap: one full re-list per informer per
+  drop); the default resumes from the last-seen resourceVersion against
+  the server watch cache, so warm-RV reconnects re-list nothing.
 
 Headline: dist-mnist TFJob wall-clock-to-Succeeded.
 
@@ -367,6 +376,190 @@ def run_widejob(replicas: int, manage_workers: int,
     }
 
 
+def run_churn(n_jobs: int, drops: int = 4, drop_interval_s: float = 0.4,
+              run_s: float = 2.5, heartbeat_s: float = 0.05,
+              resume: bool = True, deadline_s: float = 0.0) -> dict:
+    """Watch-plane churn: N simulated TFJobs (1 PS + 2 workers each) with
+    the controller on the pooled REST transport, while the in-process API
+    server forcibly drops EVERY watch stream ``drops`` times mid-run.
+
+    What's measured is how the read plane recovers from the drops:
+
+    - resumable (default): each informer's watcher reconnects with its
+      last-seen resourceVersion; the server replays the missed events from
+      its watch cache — zero full re-lists, O(gap) bytes;
+    - ``resume=False`` baseline: every reconnect is a gap, so every drop
+      costs one full namespace LIST + diff per informer — O(cluster)
+      bytes and O(cluster) handler dispatches each, the reconnect-storm
+      amplification this bench exists to show.
+
+    Pod heartbeats (``heartbeat_s``) keep watch traffic flowing through
+    the storm so the drops have events to lose; every job reaching
+    Succeeded afterwards is the convergence proof that nothing stayed
+    lost either way."""
+    from kubeflow_controller_tpu.api.core import Container, PodTemplateSpec
+    from kubeflow_controller_tpu.api.meta import ObjectMeta
+    from kubeflow_controller_tpu.api.tfjob import (
+        ReplicaType,
+        TFJob,
+        TFJobPhase,
+        TFReplicaSpec,
+    )
+    from kubeflow_controller_tpu.cluster import Cluster, FakeKubelet, PhasePolicy
+    from kubeflow_controller_tpu.cluster.apiserver import FakeAPIServer
+    from kubeflow_controller_tpu.cluster.rest import Kubeconfig, RestCluster
+    from kubeflow_controller_tpu.controller import Controller
+    from kubeflow_controller_tpu.obs.metrics import REGISTRY
+
+    def mk_sim_job(name: str) -> TFJob:
+        job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+        for typ, n in ((ReplicaType.PS, 1), (ReplicaType.WORKER, 2)):
+            t = PodTemplateSpec()
+            t.spec.containers.append(Container(name="tensorflow", image="img"))
+            t.spec.restart_policy = "OnFailure"
+            job.spec.tf_replica_specs.append(
+                TFReplicaSpec(replicas=n, tf_replica_type=typ, template=t))
+        return job
+
+    watch_counters = {
+        "relists": "kctpu_watch_relists_total",
+        "resumes": "kctpu_watch_resumes_total",
+        "replayed": "kctpu_watch_replayed_events_total",
+        "list_bytes": "kctpu_apiserver_list_bytes_total",
+    }
+
+    def counter_values() -> dict:
+        # Get-or-create returns the live instrument; every family here is
+        # created by the components under test before the first snapshot.
+        return {k: REGISTRY.counter(n, "").value
+                for k, n in watch_counters.items()}
+
+    cluster = Cluster()
+    # Fast bookmark cadence so even idle streams hold a fresh resume point
+    # well inside the drop interval.
+    server = FakeAPIServer(cluster.store, bookmark_interval_s=0.25)
+    url = server.start()
+    rest = RestCluster(Kubeconfig(server=url), watch_resume=resume)
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=run_s,
+                                                      heartbeat_s=heartbeat_s))
+    ctrl = Controller(rest, resync_period_s=5.0)
+    kubelet.start()
+    ctrl.run(threadiness=2)
+    if not deadline_s:
+        deadline_s = max(60.0, run_s + 5.0 * n_jobs + drops * drop_interval_s)
+    names = [f"churn-{i:03d}" for i in range(n_jobs)]
+    try:
+        t0 = time.time()
+        for n in names:
+            rest.tfjobs.create(mk_sim_job(n))
+        # Let the fleet reach a busy steady state (every pod object exists)
+        # before the storm: the drops should hit live watch traffic, not
+        # the create burst's cold start.
+        while (len(cluster.pods.list("default")) < 3 * n_jobs
+               and time.time() < t0 + deadline_s):
+            time.sleep(0.02)
+        base = counter_values()
+        storm_sample0 = ctrl.metrics.sample_count()
+        storm_t0 = time.time()
+        for _ in range(drops):
+            time.sleep(drop_interval_s)
+            server.drop_watches()
+        storm_s = time.time() - storm_t0
+        pending = set(names)
+        failed = []
+        while pending and time.time() < t0 + deadline_s:
+            for j in cluster.tfjobs.list("default"):
+                if j.metadata.name not in pending:
+                    continue
+                if j.status.phase == TFJobPhase.SUCCEEDED:
+                    pending.discard(j.metadata.name)
+                elif j.status.phase == TFJobPhase.FAILED:
+                    pending.discard(j.metadata.name)
+                    failed.append(j.metadata.name)
+            if pending:
+                time.sleep(0.05)
+        elapsed = time.time() - t0
+        # Settle so straggling reconnects/re-lists land in the deltas.
+        time.sleep(1.0)
+        storm = {k: v - base[k] for k, v in counter_values().items()}
+        snap = ctrl.metrics.snapshot()
+        # Reconcile latency over the storm + recovery window only (the
+        # create burst before the first drop would otherwise dominate p99).
+        storm_p50 = ctrl.metrics.percentile_since(50, storm_sample0)
+        storm_p99 = ctrl.metrics.percentile_since(99, storm_sample0)
+    finally:
+        ctrl.stop()
+        kubelet.stop()
+        rest.close()
+        server.stop()
+    return {
+        "jobs": n_jobs,
+        "drops": drops,
+        "resume": resume,
+        "elapsed_s": elapsed,
+        "storm_s": storm_s,
+        "timed_out": sorted(pending),
+        "failed": failed,
+        "watch_relists": int(storm["relists"]),
+        "watch_resumes": int(storm["resumes"]),
+        "watch_replayed_events": int(storm["replayed"]),
+        "relist_bytes": int(storm["list_bytes"]),
+        "storm_reconcile_p50_s": storm_p50,
+        "storm_reconcile_p99_s": storm_p99,
+        "metrics": snap,
+    }
+
+
+def churn_main(args) -> int:
+    result = run_churn(args.churn, drops=args.drops,
+                       drop_interval_s=args.drop_interval,
+                       resume=not args.no_resume,
+                       deadline_s=args.deadline)
+    m = result["metrics"]
+    print(json.dumps({
+        "metric": (f"churn_{result['jobs']}_tfjobs_{result['drops']}"
+                   f"_drops_full_relists"),
+        "value": result["watch_relists"],
+        "unit": "relists",
+        "details": {
+            "jobs": result["jobs"],
+            "drops": result["drops"],
+            "resume": result["resume"],
+            "elapsed_s": round(result["elapsed_s"], 3),
+            "storm_s": round(result["storm_s"], 3),
+            "timed_out": result["timed_out"],
+            "failed": result["failed"],
+            "watch_resumes": result["watch_resumes"],
+            "watch_replayed_events": result["watch_replayed_events"],
+            "relist_bytes": result["relist_bytes"],
+            "syncs": m["syncs"],
+            "sync_errors": m["sync_errors"],
+            "reconcile_p50_ms": round(m["reconcile_p50_s"] * 1e3, 3),
+            "reconcile_p99_ms": round(m["reconcile_p99_s"] * 1e3, 3),
+            "storm_reconcile_p50_ms": round(
+                result["storm_reconcile_p50_s"] * 1e3, 3),
+            "storm_reconcile_p99_ms": round(
+                result["storm_reconcile_p99_s"] * 1e3, 3),
+            "workload": ("N x (1xPS + 2xWorker) simulated pods over the "
+                         "REST transport; every watch stream force-dropped "
+                         f"{result['drops']}x mid-run (watch-plane churn)"),
+        },
+    }))
+    if result["timed_out"] or result["failed"]:
+        print(f"churn bench: {len(result['timed_out'])} timed out, "
+              f"{len(result['failed'])} failed", file=sys.stderr)
+        return 1
+    if args.max_relists >= 0 and result["watch_relists"] > args.max_relists:
+        print(f"churn bench regression: {result['watch_relists']} full "
+              f"re-lists > --max-relists {args.max_relists}", file=sys.stderr)
+        return 1
+    if args.min_resumes > 0 and result["watch_resumes"] < args.min_resumes:
+        print(f"churn bench regression: {result['watch_resumes']} RV "
+              f"resumes < --min-resumes {args.min_resumes}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def widejob_main(args) -> int:
     result = run_widejob(args.replicas, args.manage_workers,
                          deadline_s=args.deadline,
@@ -496,6 +689,26 @@ def main(argv=None) -> int:
     p.add_argument("--manage-workers", type=int, default=8, metavar="W",
                    help="replicas mode: controller manage fan-out "
                         "(1 = serial plan execution, the baseline)")
+    p.add_argument("--churn", type=int, default=0, metavar="N",
+                   help="run the watch-plane churn benchmark: N simulated "
+                        "TFJobs over the REST transport with every watch "
+                        "stream forcibly dropped mid-run (reports full "
+                        "re-lists vs RV resumes)")
+    p.add_argument("--drops", type=int, default=4, metavar="K",
+                   help="churn mode: how many times the server drops every "
+                        "watch stream")
+    p.add_argument("--drop-interval", type=float, default=0.4, metavar="S",
+                   help="churn mode: seconds between forced drops")
+    p.add_argument("--no-resume", action="store_true",
+                   help="churn mode: disable RV resume on watch reconnect "
+                        "(the re-list-per-drop baseline)")
+    p.add_argument("--max-relists", type=int, default=-1, metavar="N",
+                   help="churn mode: exit nonzero when more than N full "
+                        "re-lists happen (-1 = no gate; `make churn-smoke` "
+                        "uses 0)")
+    p.add_argument("--min-resumes", type=int, default=0, metavar="N",
+                   help="churn mode: exit nonzero when fewer than N watch "
+                        "reconnects resume from a resourceVersion")
     p.add_argument("--rtt-ms", type=float, default=0.0, metavar="MS",
                    help="replicas mode: inject MS of latency into every API "
                         "request (simulates a remote API server; loopback "
@@ -516,6 +729,8 @@ def main(argv=None) -> int:
         return scale_main(args)
     if args.replicas:
         return widejob_main(args)
+    if args.churn:
+        return churn_main(args)
 
     import shutil
     import tempfile

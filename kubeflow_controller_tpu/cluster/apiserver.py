@@ -24,15 +24,18 @@ from urllib.parse import parse_qs, urlparse
 
 from ..api.core import EventObject, Pod, Service
 from ..api.tfjob import TFJob
+from ..obs.metrics import REGISTRY
 from ..utils import serde
 from .rest import CORE_API, TFJOB_API, TFJOB_GROUP, TFJOB_VERSION
 from .store import (
+    BOOKMARK,
     AlreadyExists,
     APIError,
     Conflict,
     Invalid,
     NotFound,
     ObjectStore,
+    TooOldResourceVersion,
 )
 
 _KINDS: Dict[str, Tuple[Type, str, str]] = {
@@ -77,6 +80,10 @@ def _status(code: int, reason: str, message: str) -> Tuple[int, dict]:
 
 
 def _error_status(e: APIError) -> Tuple[int, dict]:
+    if isinstance(e, TooOldResourceVersion):
+        # 410 Gone, reason Expired — what the real apiserver returns for a
+        # watch resourceVersion older than its watch cache.
+        return _status(410, "Expired", str(e))
     if isinstance(e, NotFound):
         return _status(404, "NotFound", str(e))
     if isinstance(e, AlreadyExists):
@@ -94,7 +101,7 @@ class _Route:
     def __init__(self, plural: str, namespace: Optional[str],
                  name: Optional[str], subresource: Optional[str],
                  watch: bool, selector: Optional[Dict[str, str]],
-                 tail_lines: int = 0):
+                 tail_lines: int = 0, resource_version: Optional[str] = None):
         self.plural = plural
         self.namespace = namespace
         self.name = name
@@ -102,6 +109,7 @@ class _Route:
         self.watch = watch
         self.selector = selector
         self.tail_lines = tail_lines
+        self.resource_version = resource_version
 
 
 def _route(path: str, query: str) -> Optional[_Route]:
@@ -131,7 +139,8 @@ def _route(path: str, query: str) -> Optional[_Route]:
             raise Invalid(f"invalid tailLines {raw_tail!r}")
         return _Route(plural, ns, name, sub,
                       (q.get("watch") or ["false"])[0] == "true",
-                      _parse_selector(q), tail_lines=tail)
+                      _parse_selector(q), tail_lines=tail,
+                      resource_version=(q.get("resourceVersion") or [None])[0])
     return None
 
 
@@ -140,7 +149,7 @@ class FakeAPIServer:
 
     def __init__(self, store: Optional[ObjectStore] = None, token: str = "",
                  port: int = 0, kubelet=None, registry=None, tracer=None,
-                 latency_s: float = 0.0):
+                 latency_s: float = 0.0, bookmark_interval_s: float = 5.0):
         self.store = store or ObjectStore()
         self.token = token
         self.port = port  # 0 = ephemeral
@@ -160,12 +169,24 @@ class FakeAPIServer:
         # workqueue + lifecycle + trainer series with zero wiring.
         self.registry = registry
         self.tracer = tracer
+        # Periodic BOOKMARK cadence on idle watch streams: the RV
+        # checkpoint that keeps a quiet (or namespace-filtered) client's
+        # resume point fresh enough to survive a drop without a re-list.
+        # ≤ 0 disables periodic bookmarks (the initial one is always sent).
+        self.bookmark_interval_s = bookmark_interval_s
+        # Bytes served by collection LISTs — what a reconnect storm of
+        # re-listing informers costs in reply traffic (bench.py --churn
+        # reports the delta across a storm).
+        self._c_list_bytes = REGISTRY.counter(
+            "kctpu_apiserver_list_bytes_total",
+            "Response-body bytes served by collection LIST requests")
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         # Watch-stream generation: drop_watches() bumps it and every live
         # stream closes at its next loop turn, forcing clients through
-        # their reconnect + re-list (reflector gap) path — a real API
-        # server does this on timeouts/rolling restarts.
+        # their reconnect path — a real API server does this on timeouts/
+        # rolling restarts.  Clients holding a fresh RV resume; only a
+        # 410-too-old resume degrades to the re-list (reflector gap) path.
         self._watch_gen = 0
 
     # -- lifecycle -----------------------------------------------------------
@@ -200,13 +221,14 @@ class FakeAPIServer:
                 self._send(*_status(401, "Unauthorized", "bad token"))
                 return True
 
-            def _send(self, code: int, body: Any) -> None:
+            def _send(self, code: int, body: Any) -> int:
                 data = json.dumps(body).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+                return len(data)
 
             def _body(self) -> dict:
                 return json.loads(self._raw_body or b"{}")
@@ -324,12 +346,15 @@ class FakeAPIServer:
                 self._stream_watch(h, r)
                 return
             if method == "GET":
-                items = store.list(r.plural, r.namespace, r.selector)
+                items, rv = store.list_with_rv(r.plural, r.namespace, r.selector)
                 _, api_version, kind = _KINDS[r.plural]
-                h._send(200, {
+                self._c_list_bytes.inc(h._send(200, {
                     "apiVersion": api_version, "kind": kind + "List",
+                    # ListMeta.resourceVersion: the watch resume point this
+                    # snapshot is current through.
+                    "metadata": {"resourceVersion": rv},
                     "items": [self._wire(r.plural, o) for o in items],
-                })
+                }))
                 return
             if method == "POST":
                 obj = self._parse(r.plural, h._body())
@@ -391,14 +416,22 @@ class FakeAPIServer:
 
     def _stream_watch(self, h, r: _Route) -> None:
         """Chunked streaming of store watch events as JSON lines, until the
-        client goes away.  Every exit path closes the connection: the
+        client goes away.  ``?resourceVersion=`` resumes: buffered events
+        after it replay first (store watch-cache; a too-old RV raised 410
+        before we got here).  An initial BOOKMARK — and periodic ones while
+        idle — carry the collection RV so every client always holds a fresh
+        resume point; bookmarks travel through the watcher queue (enqueued
+        under the store lock), so they can never overtake an event they
+        claim to supersede.  Every exit path closes the connection: the
         stream ends without a terminating chunk, so a keep-alive client
         would otherwise block forever waiting for data that never comes
         (urllib's per-request Connection: close used to mask this; the
         pooled transport keeps sockets open)."""
         h.close_connection = True
-        w = self.store.watch(r.plural, r.namespace)
+        w = self.store.watch(r.plural, r.namespace,
+                             since_rv=r.resource_version, bookmark=True)
         gen = self._watch_gen
+        last_bookmark = time.monotonic()
         try:
             h.send_response(200)
             h.send_header("Content-Type", "application/json")
@@ -416,7 +449,21 @@ class FakeAPIServer:
                 if ev is None:
                     if self._httpd is None:
                         break
+                    if (self.bookmark_interval_s > 0
+                            and time.monotonic() - last_bookmark
+                            >= self.bookmark_interval_s):
+                        last_bookmark = time.monotonic()
+                        self.store.request_bookmark(w)  # arrives via the queue
+                        continue
                     chunk(b"\n")  # keepalive; also detects dead clients
+                    continue
+                if ev.type == BOOKMARK:
+                    last_bookmark = time.monotonic()
+                    chunk(json.dumps({
+                        "type": BOOKMARK,
+                        "object": {"metadata": {"resourceVersion":
+                                   ev.object.metadata.resource_version}},
+                    }).encode() + b"\n")
                     continue
                 line = json.dumps({
                     "type": ev.type,

@@ -36,14 +36,22 @@ class _TypedClient:
     def list(self, namespace: Optional[str] = None, selector: Optional[Dict[str, str]] = None):
         return self._store.list(self.kind, namespace, selector)
 
+    def list_with_rv(self, namespace: Optional[str] = None,
+                     selector: Optional[Dict[str, str]] = None):
+        """-> (items, collection resourceVersion): every LIST is a watch
+        resume point (ListMeta.resourceVersion semantics)."""
+        return self._store.list_with_rv(self.kind, namespace, selector)
+
     def update(self, obj):
         return self._store.update(self.kind, obj)
 
     def delete(self, namespace: str, name: str):
         return self._store.delete(self.kind, namespace, name)
 
-    def watch(self, namespace: Optional[str] = None) -> Watcher:
-        return self._store.watch(self.kind, namespace)
+    def watch(self, namespace: Optional[str] = None,
+              resource_version: Optional[str] = None) -> Watcher:
+        return self._store.watch(self.kind, namespace,
+                                 since_rv=resource_version or None)
 
     def patch_meta(self, namespace: str, name: str, fn):
         return self._store.patch_meta(self.kind, namespace, name, fn)

@@ -53,11 +53,13 @@ from .store import (
     ADDED,
     AlreadyExists,
     APIError,
+    BOOKMARK,
     Conflict,
     DELETED,
     Invalid,
     MODIFIED,
     NotFound,
+    TooOldResourceVersion,
     WatchEvent,
 )
 
@@ -156,6 +158,10 @@ def _status_error(code: int, body: bytes) -> APIError:
         if reason == "AlreadyExists":
             return AlreadyExists(message)
         return Conflict(message)
+    if code == 410:
+        # Gone/Expired: the watch resume RV fell out of the server's
+        # watch cache — the caller must re-list.
+        return TooOldResourceVersion(message or "resourceVersion too old")
     if code in (400, 422):
         return Invalid(message)
     return APIError(f"HTTP {code}: {message}")
@@ -305,9 +311,13 @@ class _StreamResponse:
 
 class RestTransport:
     def __init__(self, config: Kubeconfig, timeout: float = 30.0,
-                 pool_size: int = 8):
+                 pool_size: int = 8, watch_resume: bool = True):
         self.config = config
         self.timeout = timeout
+        # Whether watch streams reconnect with their last-seen RV
+        # (RestWatcher resume) or gap on every drop.  False is the
+        # pre-resumption baseline (bench.py --churn --no-resume).
+        self.watch_resume = watch_resume
         self._ssl: Optional[ssl.SSLContext] = None
         if config.server.startswith("https"):
             ctx = ssl.create_default_context(
@@ -432,15 +442,36 @@ def _normalize_meta(obj: dict) -> dict:
 
 class RestWatcher:
     """Watch stream over HTTP chunked JSON lines; same interface as
-    store.Watcher (next/stop)."""
+    store.Watcher (next/stop).
+
+    Resumable: the last resourceVersion this stream fully observed (from
+    event objects and BOOKMARK checkpoints) is carried into every
+    reconnect as ``?resourceVersion=``, so the server replays exactly the
+    missed events and nothing is lost — no gap, no re-list.  Only when the
+    server answers 410 Gone (the RV fell out of its watch cache) does the
+    watcher reconnect live and bump ``gaps``, sending cache consumers
+    through their re-list fallback.  ``resume=False`` restores the
+    pre-resumption behavior (every reconnect is a gap) — the churn bench's
+    baseline."""
 
     def __init__(self, transport: RestTransport, path: str,
                  params: Dict[str, str], cls: Type,
-                 connect_grace: float = 5.0):
+                 connect_grace: float = 5.0,
+                 resource_version: Optional[str] = None,
+                 resume: bool = True):
         self._transport = transport
         self._path = path
         self._params = params
         self._cls = cls
+        self._resume = resume
+        #: Last RV this stream is complete through; what reconnects resume
+        #: from.  Seeded from a LIST's collection RV when given, refreshed
+        #: by every event and bookmark.
+        self.resource_version: Optional[str] = resource_version or None
+        self._c_resumes = REGISTRY.counter(
+            "kctpu_watch_resumes_total",
+            "Watch reconnects that resumed from a resourceVersion "
+            "(missed events replayed server-side; no re-list)")
         # How long pre-connect failures are retried before they become
         # fatal: long enough to tolerate a concurrently-starting server
         # even under parallel-test/CI load (process spawn can take seconds
@@ -478,12 +509,22 @@ class RestWatcher:
         ever_connected = False
         grace_deadline = time.monotonic() + self._connect_grace
         while not self._stopped.is_set():
+            resume_rv = self.resource_version if self._resume else None
+            params = dict(self._params)
+            if resume_rv:
+                params["resourceVersion"] = resume_rv
             try:
                 self._resp = self._transport._request(
-                    "GET", self._path, params=self._params, stream=True,
+                    "GET", self._path, params=params, stream=True,
                     timeout=3600.0)
                 if ever_connected:
-                    self.gaps += 1  # after reconnect, so a re-list now is safe
+                    if resume_rv:
+                        # Resumed: the server replays everything after
+                        # resume_rv, so nothing was lost in the gap — cache
+                        # consumers need no re-list.
+                        self._c_resumes.inc()
+                    else:
+                        self.gaps += 1  # after reconnect, so a re-list now is safe
                 ever_connected = True
                 self._connected.set()
                 self._first_attempt.set()
@@ -494,10 +535,30 @@ class RestWatcher:
                     if not raw:
                         continue
                     ev = json.loads(raw)
+                    if ev.get("type") == BOOKMARK:
+                        rv = ((ev.get("object") or {}).get("metadata") or
+                              {}).get("resourceVersion")
+                        if rv:
+                            self.resource_version = rv
+                        continue
                     if ev.get("type") not in (ADDED, MODIFIED, DELETED):
                         continue
                     obj = serde.from_dict(self._cls, _normalize_meta(ev["object"]))
+                    if obj.metadata.resource_version:
+                        self.resource_version = obj.metadata.resource_version
                     self.queue.put(WatchEvent(ev["type"], obj))
+            except TooOldResourceVersion:
+                # 410 Gone: the resume RV fell out of the server's watch
+                # cache.  Drop it and reconnect live; that NEXT successful
+                # connect has resume_rv=None and bumps `gaps` (only then is
+                # it safe for the consumer to re-list — the new stream must
+                # already be established or the re-list itself could race a
+                # second loss).  This is the strictly-fallback re-list path.
+                if self._stopped.is_set():
+                    return
+                self.resource_version = None
+                self._connected.clear()
+                continue
             except AttributeError:
                 # http.client raises AttributeError when stop() closes the
                 # response out from under a blocked chunked read; any OTHER
@@ -590,11 +651,18 @@ class _RestTypedClient:
 
     def list(self, namespace: Optional[str] = None,
              selector: Optional[Dict[str, str]] = None):
+        return self.list_with_rv(namespace, selector)[0]
+
+    def list_with_rv(self, namespace: Optional[str] = None,
+                     selector: Optional[Dict[str, str]] = None):
+        """-> (items, collection resourceVersion): the server's
+        ListMeta.resourceVersion, a resume point for ``watch()``."""
         params = {}
         if selector:
             params["labelSelector"] = ",".join(f"{k}={v}" for k, v in selector.items())
         out = self._t._request("GET", self._collection(namespace), params=params or None)
-        return [self._from_wire(item) for item in out.get("items", [])]
+        rv = ((out.get("metadata") or {}).get("resourceVersion") or "")
+        return [self._from_wire(item) for item in out.get("items", [])], rv
 
     def update(self, obj):
         out = self._t._request(
@@ -605,9 +673,12 @@ class _RestTypedClient:
     def delete(self, namespace: str, name: str):
         self._t._request("DELETE", self._item(namespace, name))
 
-    def watch(self, namespace: Optional[str] = None) -> RestWatcher:
+    def watch(self, namespace: Optional[str] = None,
+              resource_version: Optional[str] = None) -> RestWatcher:
         return RestWatcher(self._t, self._collection(namespace),
-                           {"watch": "true"}, self.cls)
+                           {"watch": "true"}, self.cls,
+                           resource_version=resource_version,
+                           resume=self._t.watch_resume)
 
     def patch(self, namespace: str, name: str, body: dict):
         """Arbitrary object patch as an RFC 7386 merge patch — the
@@ -734,9 +805,11 @@ class RestCluster:
     selects in the CLI.  No ``.store``: there is no in-process substrate,
     the API server is authoritative."""
 
-    def __init__(self, config: Kubeconfig, pool_size: int = 8):
+    def __init__(self, config: Kubeconfig, pool_size: int = 8,
+                 watch_resume: bool = True):
         self.config = config
-        self.transport = RestTransport(config, pool_size=pool_size)
+        self.transport = RestTransport(config, pool_size=pool_size,
+                                       watch_resume=watch_resume)
         self.tfjobs = RestTFJobClient(self.transport)
         self.pods = RestPodClient(self.transport)
         self.services = RestServiceClient(self.transport)

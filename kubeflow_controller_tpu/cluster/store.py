@@ -12,6 +12,14 @@ Reproduces the behaviors the reference's controller correctness depends on
 - watch streams that deliver ADDED/MODIFIED/DELETED in write order, each
   carrying one deep copy shared read-only by all watchers (watchers can
   never mutate the store; see ``_notify``);
+- a bounded per-kind **watch cache** of recent ``(rv, event)`` pairs (the
+  kube-apiserver watch cache): ``watch(kind, since_rv=...)`` replays the
+  buffered events after ``since_rv`` before going live, so a client that
+  lost its stream resumes from its last-seen resourceVersion instead of
+  re-listing the collection; a resume point older than the buffer raises
+  :class:`TooOldResourceVersion` (HTTP 410 Gone over REST), and
+  ``list_with_rv`` hands out the collection RV so every LIST is a resume
+  point;
 - deletionTimestamp + cascading garbage collection of controller-owned
   objects (net-new: the reference's delete handlers are stubs,
   pkg/controller/controller.go:522-524, 601-603).
@@ -22,13 +30,15 @@ Everything is guarded by one RLock; watch queues are unbounded
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 import time
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..api.meta import ObjectMeta, get_controller_of, matches_selector
+from ..obs.metrics import REGISTRY
 from ..utils import serde
 from ..utils.names import generate_name
 
@@ -53,16 +63,35 @@ class Invalid(APIError):
     pass
 
 
-# Watch event types (ref: watch.Added/Modified/Deleted in apimachinery).
+class TooOldResourceVersion(APIError):
+    """The requested resume resourceVersion has fallen out of the bounded
+    watch cache (HTTP 410 Gone over REST): the client must re-list."""
+
+
+# Watch event types (ref: watch.Added/Modified/Deleted in apimachinery;
+# BOOKMARK per watch.Bookmark — an RV checkpoint carrying no object change).
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+BOOKMARK = "BOOKMARK"
 
 
 @dataclass
 class WatchEvent:
     type: str
     object: Any  # deep copy of the stored object
+
+
+@dataclass
+class Bookmark:
+    """BOOKMARK event payload: only ``metadata.resource_version`` is
+    meaningful — the RV through which the carrying stream is complete."""
+
+    metadata: ObjectMeta
+
+
+def _bookmark_event(rv: str) -> WatchEvent:
+    return WatchEvent(BOOKMARK, Bookmark(metadata=ObjectMeta(resource_version=rv)))
 
 
 class Watcher:
@@ -93,12 +122,27 @@ class ObjectStore:
     """The in-memory API server. Collections are keyed by plural kind
     ("tfjobs", "pods", "services"); objects by (namespace, name)."""
 
-    def __init__(self):
+    def __init__(self, watch_cache_size: int = 1024):
         self._lock = threading.RLock()
         self._objects: Dict[str, Dict[tuple, Any]] = {}
         self._watchers: Dict[str, List[Watcher]] = {}
         self._rv = 0
         self._uid = 0
+        # Per-kind ring buffer of recent (rv, event) pairs — the
+        # kube-apiserver watch cache.  A watch(since_rv=...) replays from
+        # here; _evicted_rv records the newest rv ever evicted per kind, so
+        # a resume point older than the buffer is detected exactly (410).
+        self._watch_cache_size = watch_cache_size
+        self._watch_cache: Dict[str, "collections.deque[Tuple[int, WatchEvent]]"] = {}
+        self._evicted_rv: Dict[str, int] = {}
+        self._c_replayed = REGISTRY.counter(
+            "kctpu_watch_replayed_events_total",
+            "Watch events replayed from the server watch cache on "
+            "RV-resumed watch connects")
+        self._g_cache_depth = REGISTRY.gauge(
+            "kctpu_watch_cache_depth",
+            "Buffered (rv, event) pairs in the per-kind server watch cache",
+            ("kind",))
 
     # -- internals -----------------------------------------------------------
 
@@ -115,19 +159,29 @@ class ObjectStore:
 
     def _notify(self, kind: str, ev_type: str, obj: Any) -> None:
         # Single-serialization fan-out: ONE deep copy per event, shared by
-        # every watcher's queue (the apiserver analog: one encode, N
-        # streams).  Per-watcher copies made this O(watchers × object size)
-        # under the global lock — with 4+ watchers per kind (controller
-        # informer, kubelet, REST streams) the dominant write-path cost.
-        # The shared copy still can't mutate the store; watch consumers
-        # treat event objects as read-only (informers hand out copies on
-        # the mutating read paths).
-        shared: Any = None
+        # every watcher's queue AND the per-kind watch cache (the apiserver
+        # analog: one encode, N streams).  Per-watcher copies made this
+        # O(watchers × object size) under the global lock — with 4+
+        # watchers per kind (controller informer, kubelet, REST streams)
+        # the dominant write-path cost.  The shared copy still can't mutate
+        # the store; watch consumers treat event objects as read-only
+        # (informers hand out copies on the mutating read paths).  The copy
+        # is made even with zero live watchers: a disconnected client's
+        # resume depends on exactly the events it wasn't there to see.
+        shared = serde.deep_copy(obj)
+        ev = WatchEvent(ev_type, shared)
+        buf = self._watch_cache.get(kind)
+        if buf is None:
+            buf = self._watch_cache[kind] = collections.deque()
+        buf.append((int(shared.metadata.resource_version), ev))
+        if len(buf) > self._watch_cache_size:
+            evicted_rv, _ = buf.popleft()
+            if evicted_rv > self._evicted_rv.get(kind, 0):
+                self._evicted_rv[kind] = evicted_rv
+        self._g_cache_depth.labels(kind).set(len(buf))
         for w in self._watchers.get(kind, []):
             if w.namespace is None or w.namespace == obj.metadata.namespace:
-                if shared is None:
-                    shared = serde.deep_copy(obj)
-                w.queue.put(WatchEvent(ev_type, shared))
+                w.queue.put(ev)
 
     def _remove_watcher(self, w: Watcher) -> None:
         with self._lock:
@@ -189,6 +243,19 @@ class ObjectStore:
                     continue
                 out.append(serde.deep_copy(obj))
             return out
+
+    def list_with_rv(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        selector: Optional[Dict[str, str]] = None,
+    ) -> Tuple[List[Any], str]:
+        """list() plus the collection resourceVersion (ListMeta.resourceVersion
+        on a real API server): the resume point a client hands back as
+        ``watch(since_rv=...)`` so a stream can start exactly where the
+        LIST's snapshot ends — no gap, no re-list."""
+        with self._lock:
+            return self.list(kind, namespace, selector), str(self._rv)
 
     def update(self, kind: str, obj: Any) -> Any:
         with self._lock:
@@ -321,6 +388,10 @@ class ObjectStore:
                 return
             self._collection(kind).pop((namespace, name))
             obj.metadata.deletion_timestamp = time.time()
+            # Deletes bump the RV too (as the real apiserver does): the
+            # DELETED event needs its own slot in the watch cache, or a
+            # client resuming from the create's RV would never replay it.
+            obj.metadata.resource_version = self._next_rv()
             self._notify(kind, DELETED, obj)
             if cascade:
                 self._cascade_delete(obj.metadata.uid, namespace)
@@ -332,6 +403,7 @@ class ObjectStore:
         if obj is None or obj.metadata.deletion_timestamp is None or obj.metadata.finalizers:
             return False
         self._collection(kind).pop(key)
+        obj.metadata.resource_version = self._next_rv()  # see delete()
         self._notify(kind, DELETED, obj)
         self._cascade_delete(obj.metadata.uid, key[0])
         return True
@@ -360,8 +432,51 @@ class ObjectStore:
                 self._notify(kind, MODIFIED, obj)
             return serde.deep_copy(obj)
 
-    def watch(self, kind: str, namespace: Optional[str] = None) -> Watcher:
+    def watch(self, kind: str, namespace: Optional[str] = None,
+              since_rv: Optional[str] = None,
+              bookmark: bool = False) -> Watcher:
+        """Open a watch stream.  ``since_rv`` resumes from a resourceVersion:
+        every buffered event after it is replayed into the stream (exactly
+        once, in write order, namespace-filtered) ahead of live events.
+        Raises :class:`TooOldResourceVersion` when events after ``since_rv``
+        have been evicted from the bounded watch cache — the client's only
+        correct recovery then is a full re-list (410 Gone over REST).
+        ``bookmark=True`` enqueues an initial BOOKMARK event carrying the
+        current collection RV, so even a stream that never receives an
+        event holds a fresh resume point.  Registration and replay happen
+        in one critical section: no live write can interleave into (or
+        duplicate) the replayed prefix."""
         with self._lock:
+            if since_rv is not None:
+                since = int(since_rv)
+                if since < self._evicted_rv.get(kind, 0):
+                    raise TooOldResourceVersion(
+                        f"{kind}: resourceVersion {since} is too old "
+                        f"(watch cache begins after "
+                        f"{self._evicted_rv.get(kind, 0)})")
             w = Watcher(self, kind, namespace)
+            if since_rv is not None:
+                replayed = 0
+                for rv, ev in self._watch_cache.get(kind, ()):
+                    if rv <= since:
+                        continue
+                    if namespace is not None and ev.object.metadata.namespace != namespace:
+                        continue
+                    w.queue.put(ev)
+                    replayed += 1
+                if replayed:
+                    self._c_replayed.inc(replayed)
             self._watchers.setdefault(kind, []).append(w)
+            if bookmark:
+                w.queue.put(_bookmark_event(str(self._rv)))
             return w
+
+    def request_bookmark(self, w: Watcher) -> None:
+        """Enqueue a BOOKMARK carrying the current collection RV into
+        ``w``'s stream (the apiserver's periodic watch bookmarks: they keep
+        an idle or namespace-filtered stream's resume point fresh).  Under
+        the store lock, every write with rv ≤ the stamped RV has already
+        enqueued its event ahead of the bookmark — so resuming from a
+        bookmark RV can never skip an earlier event."""
+        with self._lock:
+            w.queue.put(_bookmark_event(str(self._rv)))

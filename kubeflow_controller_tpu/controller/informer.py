@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, List, Optional, Set
 
 from ..api.meta import key_of
 from ..cluster.store import ADDED, DELETED, MODIFIED, Watcher
+from ..obs.metrics import REGISTRY
 
 
 class SharedInformer:
@@ -53,6 +54,14 @@ class SharedInformer:
         self._watcher: Optional[Watcher] = None
         self._thread: Optional[threading.Thread] = None
         self._resync_thread: Optional[threading.Thread] = None
+        # Full list+diff fallbacks: with an RV-resumable transport these
+        # fire ONLY on a genuine 410-too-old gap — a climbing counter under
+        # watch churn means resume points are going stale (watch cache too
+        # small, or bookmarks not flowing).  `make churn-smoke` gates on 0.
+        self._c_relists = REGISTRY.counter(
+            "kctpu_watch_relists_total",
+            "Informer full list+diff fallbacks after a non-resumable "
+            "watch gap")
 
     # -- registration --------------------------------------------------------
 
@@ -179,10 +188,13 @@ class SharedInformer:
             return obj
 
     def _watch_loop(self) -> None:
-        # Transports that can drop events (REST watch reconnect) expose a
-        # `gaps` counter; a bump means the stream was re-established and
-        # anything in between is lost — re-list and diff, as client-go
-        # reflectors do.  The in-memory watcher never gaps (no attribute).
+        # Transports that can drop events expose a `gaps` counter; a bump
+        # means the stream was re-established WITHOUT a resume — anything
+        # in between is lost, so re-list and diff, as client-go reflectors
+        # do.  An RV-resumable transport (RestWatcher) replays missed
+        # events on reconnect and only bumps `gaps` on a genuine
+        # 410-too-old, keeping the full re-list strictly as the fallback.
+        # The in-memory watcher never gaps (no attribute).
         seen_gaps = getattr(self._watcher, "gaps", 0)
         while not self._stop.is_set():
             gaps = getattr(self._watcher, "gaps", 0)
@@ -200,6 +212,8 @@ class SharedInformer:
             ev = self._watcher.next(timeout=0.2)
             if ev is None:
                 continue
+            if ev.type not in (ADDED, MODIFIED, DELETED):
+                continue  # BOOKMARK etc.: transport checkpoints, no cache effect
             k = key_of(ev.object.metadata)
             if ev.type == ADDED:
                 with self._lock:
@@ -226,6 +240,7 @@ class SharedInformer:
             fresh = {key_of(o.metadata): o for o in self._client.list()}
         except Exception:  # noqa: BLE001 — server still flapping; next gap retries
             return
+        self._c_relists.inc()
         with self._lock:
             stale_keys = set(self._cache) - set(fresh)
         for k, obj in fresh.items():
@@ -245,8 +260,26 @@ class SharedInformer:
         while not self._stop.is_set():
             if self._stop.wait(self._resync_s):
                 return
-            for obj in self.list():
-                self._dispatch_update(obj, obj)
+            objs = self.list()
+            if not objs:
+                continue
+            # Spread the dispatches across (half of) the resync window
+            # instead of one synchronous burst: at N cached objects the
+            # periodic enqueue spike becomes one dispatch per gap —
+            # client-go jitters resync timing for the same reason.  Each
+            # object is re-read from the cache at its turn (and skipped if
+            # deleted meanwhile), so late dispatches see current state.
+            gap = (self._resync_s * 0.5) / len(objs)
+            for obj in objs:
+                if self._stop.is_set():
+                    return
+                with self._lock:
+                    cur = self._cache.get(key_of(obj.metadata))
+                if cur is None:
+                    continue  # deleted while spreading
+                self._dispatch_update(cur, cur)
+                if self._stop.wait(gap):
+                    return
 
     def _dispatch_add(self, obj) -> None:
         for h in self._add_handlers:

@@ -89,6 +89,21 @@ class ReconcileMetrics:
             idx = min(len(s) - 1, int(q / 100.0 * len(s)))
             return s[idx]
 
+    # Windowed latency: benches that want "p99 during the storm" snapshot
+    # sample_count() at the window start and read percentile_since(q, n).
+    # Valid while the sample buffer hasn't truncated past the snapshot
+    # (max_samples is 100k; bench windows are thousands).
+    def sample_count(self) -> int:
+        with self._lock:
+            return len(self._samples)
+
+    def percentile_since(self, q: float, start: int) -> float:
+        with self._lock:
+            s = sorted(self._samples[start:])
+        if not s:
+            return 0.0
+        return s[min(len(s) - 1, int(q / 100.0 * len(s)))]
+
     @property
     def p50(self) -> float:
         return self.percentile(50)

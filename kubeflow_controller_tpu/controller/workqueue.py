@@ -20,10 +20,11 @@ pkg/controller/controller.go:132, 639):
 
 from __future__ import annotations
 
+import collections
 import heapq
 import threading
 import time
-from typing import Dict, List, Optional, Set
+from typing import Deque, Dict, List, Optional, Set
 
 from ..obs import metrics as obs_metrics
 
@@ -88,8 +89,18 @@ class RateLimitingQueue:
         self.name = name
         self._limiter = rate_limiter or ItemExponentialFailureRateLimiter()
         self._metrics = _QueueMetrics(name, registry)
-        self._cond = threading.Condition()
-        self._queue: List[str] = []
+        # One lock, two wait-sets: workers blocked in get() wait on _cond;
+        # the delay thread waits on _delay_cond until the earliest deadline
+        # or an add_after() notify.  Separate conditions so a notify can
+        # never be eaten by the wrong waiter (a single shared condition
+        # with notify(1) could wake a get() waiter instead of the delay
+        # loop and lose the wakeup).
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._delay_cond = threading.Condition(self._lock)
+        # FIFO of ready items: deque, so the get() hot path is O(1)
+        # popleft instead of list.pop(0)'s O(depth) shift per item.
+        self._queue: Deque[str] = collections.deque()
         self._dirty: Set[str] = set()
         self._processing: Set[str] = set()
         # Enqueue wall-clock per queued item, for the queue-wait histogram.
@@ -130,7 +141,7 @@ class RateLimitingQueue:
                 if remaining is not None and remaining <= 0:
                     return None
                 self._cond.wait(timeout=remaining)
-            item = self._queue.pop(0)
+            item = self._queue.popleft()
             self._processing.add(item)
             self._dirty.discard(item)
             t_add = self._enqueued_at.pop(item, None)
@@ -164,7 +175,9 @@ class RateLimitingQueue:
                 return
             self._seq += 1
             heapq.heappush(self._waiting, (time.time() + delay, self._seq, item))
-            self._cond.notify()
+            # Wake the delay thread: the new deadline may be earlier than
+            # the one it is currently sleeping toward.
+            self._delay_cond.notify()
 
     def forget(self, item: str) -> None:
         self._limiter.forget(item)
@@ -173,25 +186,29 @@ class RateLimitingQueue:
         return self._limiter.num_requeues(item)
 
     def _delay_loop(self) -> None:
-        while True:
-            with self._cond:
-                if self._shutting_down and not self._waiting:
-                    return
+        # Event-driven, not polled: sleeps on the condition until the
+        # earliest deadline (or an add_after/shutdown notify).  The old
+        # 50 ms poll woke 20×/s on an idle queue and added up to 50 ms of
+        # latency to every delayed re-add; now a re-add fires at its
+        # deadline and an empty _waiting set costs zero wakeups.
+        with self._delay_cond:
+            while not self._shutting_down:
                 now = time.time()
                 while self._waiting and self._waiting[0][0] <= now:
                     _, _, item = heapq.heappop(self._waiting)
-                    if item not in self._dirty and not self._shutting_down:
-                        self._dirty.add(item)
-                        self._metrics.adds.inc()
-                        if item not in self._processing:
-                            self._queue.append(item)
-                            self._enqueued_at.setdefault(item, time.time())
-                            self._metrics.depth.set(len(self._queue))
-                            self._cond.notify()
-                wait = 0.05
+                    if item in self._dirty:
+                        continue  # dedup: already queued (or pending requeue)
+                    self._dirty.add(item)
+                    self._metrics.adds.inc()
+                    if item not in self._processing:
+                        self._queue.append(item)
+                        self._enqueued_at.setdefault(item, time.time())
+                        self._metrics.depth.set(len(self._queue))
+                        self._cond.notify()
+                timeout = None
                 if self._waiting:
-                    wait = min(wait, max(0.0, self._waiting[0][0] - now))
-            time.sleep(wait if wait > 0 else 0.001)
+                    timeout = max(0.0, self._waiting[0][0] - now)
+                self._delay_cond.wait(timeout=timeout)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -199,6 +216,7 @@ class RateLimitingQueue:
         with self._cond:
             self._shutting_down = True
             self._cond.notify_all()
+            self._delay_cond.notify_all()
 
     def __len__(self) -> int:
         with self._cond:
