@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast lint typecheck cov cov-local bench dryrun validate metrics-smoke scale-smoke stall-smoke widejob-smoke churn-smoke
+.PHONY: all test test-fast lint typecheck cov cov-local bench dryrun validate metrics-smoke scale-smoke stall-smoke widejob-smoke churn-smoke store-smoke
 
 all: lint test
 
@@ -115,6 +115,32 @@ churn-smoke:
 		      '| resumes', d['details']['watch_resumes'], \
 		      '| replayed', d['details']['watch_replayed_events'], \
 		      '| storm p99', d['details']['storm_reconcile_p99_ms'], 'ms')"
+
+# Store-contention smoke: the scale bench + direct 4-kind store stress,
+# once on the per-kind sharded store and once on the --no-shard
+# global-lock baseline (the pre-shard store: one lock, reads deep-copied
+# under it).  Gates (measured: ~1.9x syncs/sec, ~4-7x store ops/sec,
+# sharded lock-wait p99 <=1 ms vs 50-100 ms — docs/PERF.md "Store
+# contention"): sharded must beat baseline on syncs/sec (>=1.3x) and on
+# direct store throughput (>=2x), and keep its worst-shard lock-wait p99
+# under 25 ms.  ~20 s wall-clock.
+store-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --scale 60 --store-contention \
+		--max-lock-wait-p99-ms 25 > /tmp/kctpu_store_smoke_sharded.json
+	JAX_PLATFORMS=cpu $(PY) bench.py --scale 60 --store-contention \
+		--no-shard > /tmp/kctpu_store_smoke_global.json
+	@$(PY) -c "import json; \
+		s = json.load(open('/tmp/kctpu_store_smoke_sharded.json')); \
+		g = json.load(open('/tmp/kctpu_store_smoke_global.json')); \
+		ratio = s['value'] / max(g['value'], 1e-9); \
+		stress = s['details']['stress_ops_per_sec'] / \
+			max(g['details']['stress_ops_per_sec'], 1e-9); \
+		assert ratio >= 1.3, f'sharded syncs/sec only {ratio:.2f}x baseline'; \
+		assert stress >= 2.0, f'sharded store ops/sec only {stress:.2f}x baseline'; \
+		print('store-smoke ok:', s['value'], 'vs', g['value'], 'syncs/sec', \
+		      f'({ratio:.2f}x)', '| stress', f'{stress:.2f}x', \
+		      '| lock-wait p99', s['details']['lock_wait']['p99_ms'], 'ms', \
+		      'vs', g['details']['lock_wait']['p99_ms'], 'ms')"
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -27,6 +27,13 @@ Four modes:
   baseline (every reconnect is a gap: one full re-list per informer per
   drop); the default resumes from the last-seen resourceVersion against
   the server watch cache, so warm-RV reconnects re-list nothing.
+- ``--scale N --store-contention``: **store contention** — the scale
+  bench with syncs/sec as the headline plus per-shard lock-wait p50/p99
+  from the store's timed acquisitions, followed by a direct store-stress
+  phase (4 kinds × writer+reader threads + live watchers on one
+  ObjectStore).  ``--no-shard`` runs the global-lock,
+  copy-under-the-lock baseline store (the pre-shard world);
+  ``make store-smoke`` compares the two and gates the ratio.
 
 Headline: dist-mnist TFJob wall-clock-to-Succeeded.
 
@@ -205,7 +212,8 @@ def run_dist_mnist(trace_dir: str = "") -> dict:
 
 
 def run_scale(n_jobs: int, deadline_s: float = 0.0,
-              settle_s: float = 2.5, heartbeat_s: float = 0.0) -> dict:
+              settle_s: float = 2.5, heartbeat_s: float = 0.0,
+              store_sharded: bool = True) -> dict:
     """N concurrent orchestration-bound TFJobs (1 PS + 2 workers each,
     simulated pod phases) from creation to all-Succeeded.  Uses only the
     public controller surface so the same file measures older commits;
@@ -214,7 +222,11 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
     ``heartbeat_s`` > 0 turns on simulated training heartbeats at that
     interval (the progress plane): each beat is a pod-status write that
     re-enqueues the owner, so comparing runs with/without beats measures
-    the heartbeat overhead on the reconcile path (docs/PERF.md)."""
+    the heartbeat overhead on the reconcile path (docs/PERF.md).
+
+    ``store_sharded=False`` runs on the global-lock, copy-under-the-lock
+    baseline store (``bench.py --scale N --no-shard``) — what the
+    store-contention comparison measures against."""
     from kubeflow_controller_tpu.api.core import Container, PodTemplateSpec
     from kubeflow_controller_tpu.api.meta import ObjectMeta
     from kubeflow_controller_tpu.api.tfjob import (
@@ -224,6 +236,7 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
         TFReplicaSpec,
     )
     from kubeflow_controller_tpu.cluster import Cluster, FakeKubelet, PhasePolicy
+    from kubeflow_controller_tpu.cluster.store import ObjectStore
     from kubeflow_controller_tpu.controller import Controller
 
     def mk_sim_job(name: str) -> TFJob:
@@ -236,7 +249,7 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
                 TFReplicaSpec(replicas=n, tf_replica_type=typ, template=t))
         return job
 
-    cluster = Cluster()
+    cluster = Cluster(store=ObjectStore(sharded=store_sharded))
     kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=0.05,
                                                       heartbeat_s=heartbeat_s))
     ctrl = Controller(cluster, resync_period_s=1.0)
@@ -269,6 +282,7 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
         snap_settle0 = ctrl.metrics.snapshot()
         time.sleep(settle_s)
         snap = ctrl.metrics.snapshot()
+        lock_stats = cluster.store.lock_wait_stats()
     finally:
         ctrl.stop()
         kubelet.stop()
@@ -278,10 +292,90 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
         "timed_out": sorted(pending),
         "failed": failed,
         "metrics": snap,
+        "store_sharded": store_sharded,
+        "lock_wait": lock_stats,
         "settle_syncs": snap["syncs"] - snap_settle0["syncs"],
         "settle_full_lists": (snap.get("gather_full_lists", 0)
                               - snap_settle0.get("gather_full_lists", 0)),
         "settle_s": settle_s,
+    }
+
+
+def run_store_stress(sharded: bool, duration_s: float = 2.0,
+                     n_objects: int = 150) -> dict:
+    """Direct store stress: per-kind writer + reader threads plus a live
+    watcher on each of four kinds, hammering ONE ObjectStore concurrently
+    for ``duration_s``.  This isolates exactly what the shard rebuild
+    changed — lock scope and copy placement — from the controller
+    machinery around it: on the global-lock baseline every op of every
+    kind serializes (and deep-copies) on one lock; sharded, cross-kind
+    ops share nothing and reads copy outside the lock.
+
+    Reports aggregate ops/sec and the store's lock-wait stats."""
+    import threading
+
+    from kubeflow_controller_tpu.api.core import Pod
+    from kubeflow_controller_tpu.api.meta import ObjectMeta
+    from kubeflow_controller_tpu.cluster.store import ObjectStore
+
+    kinds = ("tfjobs", "pods", "services", "events")
+    store = ObjectStore(sharded=sharded)
+    for kind in kinds:
+        for i in range(n_objects):
+            store.create(kind, Pod(metadata=ObjectMeta(
+                name=f"{kind}-{i:04d}", namespace="default")))
+
+    stop = threading.Event()
+    ops = [0] * (2 * len(kinds))
+    watchers = [store.watch(k) for k in kinds]
+
+    def drainer(w):
+        while not stop.is_set():
+            w.next(timeout=0.1)
+
+    def writer(kind: str, slot: int):
+        i = 0
+        while not stop.is_set():
+            obj = store.get(kind, "default", f"{kind}-{i % n_objects:04d}")
+            obj.status.phase = "Running"
+            store.update(kind, obj)
+            ops[slot] += 2
+            i += 1
+
+    def reader(kind: str, slot: int):
+        i = 0
+        while not stop.is_set():
+            if i % 10 == 0:
+                store.list(kind, "default")
+            else:
+                store.get(kind, "default", f"{kind}-{i % n_objects:04d}")
+            ops[slot] += 1
+            i += 1
+
+    threads = [threading.Thread(target=drainer, args=(w,), daemon=True)
+               for w in watchers]
+    for j, kind in enumerate(kinds):
+        threads.append(threading.Thread(
+            target=writer, args=(kind, 2 * j), daemon=True))
+        threads.append(threading.Thread(
+            target=reader, args=(kind, 2 * j + 1), daemon=True))
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+    elapsed = time.time() - t0
+    for w in watchers:
+        w.stop()
+    return {
+        "sharded": sharded,
+        "threads": 2 * len(kinds),
+        "elapsed_s": elapsed,
+        "ops": sum(ops),
+        "ops_per_sec": sum(ops) / elapsed if elapsed else 0.0,
+        "lock_wait": store.lock_wait_stats(),
     }
 
 
@@ -599,9 +693,89 @@ def widejob_main(args) -> int:
     return 0
 
 
+def _lock_wait_rollup(lock_wait: dict) -> dict:
+    """Flatten per-kind lock-wait stats into the worst-shard headline the
+    BENCH JSON reports (per-kind detail rides alongside)."""
+    if not lock_wait:
+        return {"p50_ms": 0.0, "p99_ms": 0.0, "max_ms": 0.0,
+                "contended": 0, "acquires": 0}
+    return {
+        "p50_ms": round(max(s["p50_s"] for s in lock_wait.values()) * 1e3, 4),
+        "p99_ms": round(max(s["p99_s"] for s in lock_wait.values()) * 1e3, 4),
+        "max_ms": round(max(s["wait_max_s"] for s in lock_wait.values()) * 1e3, 3),
+        "contended": int(sum(s["contended"] for s in lock_wait.values())),
+        "acquires": int(sum(s["acquires"] for s in lock_wait.values())),
+    }
+
+
+def store_contention_main(args) -> int:
+    """--scale N --store-contention: the scale bench on the chosen store
+    (sharded by default, --no-shard for the global-lock baseline) plus the
+    direct store-stress phase, reporting syncs/sec and lock-wait p50/p99.
+    `make store-smoke` runs this twice and gates the sharded/baseline
+    ratio."""
+    sharded = not args.no_shard
+    result = run_scale(args.scale, deadline_s=args.deadline,
+                       heartbeat_s=args.heartbeat_s, store_sharded=sharded)
+    stress = run_store_stress(sharded)
+    m = result["metrics"]
+    elapsed = result["elapsed_s"]
+    scale_waits = _lock_wait_rollup(result["lock_wait"])
+    stress_waits = _lock_wait_rollup(stress["lock_wait"])
+    print(json.dumps({
+        "metric": (f"store_contention_scale_{result['jobs']}_tfjobs_"
+                   f"{'sharded' if sharded else 'global_lock'}"),
+        "value": round(m["syncs"] / elapsed, 1) if elapsed else 0.0,
+        "unit": "syncs/sec",
+        "details": {
+            "jobs": result["jobs"],
+            "sharded": sharded,
+            "elapsed_s": round(elapsed, 3),
+            "timed_out": result["timed_out"],
+            "failed": result["failed"],
+            "syncs": m["syncs"],
+            "sync_errors": m["sync_errors"],
+            "reconcile_p50_ms": round(m["reconcile_p50_s"] * 1e3, 3),
+            "reconcile_p99_ms": round(m["reconcile_p99_s"] * 1e3, 3),
+            "lock_wait": scale_waits,
+            "lock_wait_by_kind": {
+                k: {"acquires": int(s["acquires"]),
+                    "contended": int(s["contended"]),
+                    "p50_ms": round(s["p50_s"] * 1e3, 4),
+                    "p99_ms": round(s["p99_s"] * 1e3, 4)}
+                for k, s in sorted(result["lock_wait"].items())},
+            "stress_ops_per_sec": round(stress["ops_per_sec"], 1),
+            "stress_threads": stress["threads"],
+            "stress_lock_wait": stress_waits,
+            "workload": ("scale bench (N x 1xPS+2xWorker simulated) + "
+                         "direct 4-kind reader/writer/watcher store stress "
+                         "on the "
+                         + ("per-kind sharded store"
+                            if sharded else
+                            "global-lock copy-under-the-lock baseline")),
+        },
+    }))
+    if result["timed_out"] or result["failed"]:
+        print(f"store-contention bench: {len(result['timed_out'])} timed "
+              f"out, {len(result['failed'])} failed", file=sys.stderr)
+        return 1
+    if args.max_seconds and elapsed > args.max_seconds:
+        print(f"store-contention bench regression: {elapsed:.3f}s > "
+              f"--max-seconds {args.max_seconds}", file=sys.stderr)
+        return 1
+    if args.max_lock_wait_p99_ms >= 0 and (
+            scale_waits["p99_ms"] > args.max_lock_wait_p99_ms):
+        print(f"store-contention regression: lock-wait p99 "
+              f"{scale_waits['p99_ms']}ms > --max-lock-wait-p99-ms "
+              f"{args.max_lock_wait_p99_ms}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def scale_main(args) -> int:
     result = run_scale(args.scale, deadline_s=args.deadline,
-                       heartbeat_s=args.heartbeat_s)
+                       heartbeat_s=args.heartbeat_s,
+                       store_sharded=not args.no_shard)
     m = result["metrics"]
     elapsed = result["elapsed_s"]
     gathers = m.get("gather_indexed", 0) + m.get("gather_full_lists", 0)
@@ -723,8 +897,23 @@ def main(argv=None) -> int:
                    help="scale mode: simulated training heartbeats every S "
                         "seconds (0 = off); compare against a 0 run to "
                         "measure progress-plane overhead")
+    p.add_argument("--store-contention", action="store_true",
+                   help="scale mode: report store lock-wait p50/p99 and run "
+                        "the direct 4-kind store stress phase (syncs/sec as "
+                        "the headline; `make store-smoke` compares against "
+                        "--no-shard)")
+    p.add_argument("--no-shard", action="store_true",
+                   help="scale mode: run on the global-lock, "
+                        "copy-under-the-lock baseline ObjectStore "
+                        "(sharded=False) — the pre-shard store")
+    p.add_argument("--max-lock-wait-p99-ms", type=float, default=-1.0,
+                   metavar="MS",
+                   help="store-contention mode: exit nonzero when the worst "
+                        "shard's lock-wait p99 exceeds MS (-1 = no gate)")
     args = p.parse_args(argv)
 
+    if args.scale and args.store_contention:
+        return store_contention_main(args)
     if args.scale:
         return scale_main(args)
     if args.replicas:

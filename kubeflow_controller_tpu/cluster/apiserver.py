@@ -289,7 +289,13 @@ class FakeAPIServer:
             def do_PATCH(self):
                 self._dispatch("PATCH")
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        class Server(ThreadingHTTPServer):
+            # Deep accept backlog: a reconnect storm of watchers (or a
+            # wide-job create burst dialing fresh pool sockets) must queue
+            # in the kernel, not get RSTs past the default backlog of 5.
+            request_queue_size = 128
+
+        self._httpd = Server(("127.0.0.1", self.port), Handler)
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="fake-apiserver", daemon=True)
@@ -346,7 +352,13 @@ class FakeAPIServer:
                 self._stream_watch(h, r)
                 return
             if method == "GET":
-                items, rv = store.list_with_rv(r.plural, r.namespace, r.selector)
+                # Snapshot LIST: immutable stored references, serialized
+                # outside any store lock and never copied — handler threads
+                # listing different kinds share no lock at all, so parallel
+                # LISTs never queue on each other (true handler-level read
+                # concurrency).
+                items, rv = store.list_snapshot_with_rv(
+                    r.plural, r.namespace, r.selector)
                 _, api_version, kind = _KINDS[r.plural]
                 self._c_list_bytes.inc(h._send(200, {
                     "apiVersion": api_version, "kind": kind + "List",
@@ -376,7 +388,7 @@ class FakeAPIServer:
         if method == "GET" and r.plural == "pods" and r.subresource == "log":
             if self.kubelet is None:
                 raise NotFound("no kubelet attached: pod logs unavailable")
-            store.get(r.plural, ns, r.name)  # 404 for unknown pods
+            store.get_snapshot(r.plural, ns, r.name)  # 404 for unknown pods
             data = self.kubelet.logs(ns, r.name, tail_lines=r.tail_lines)
             h.send_response(200)
             h.send_header("Content-Type", "text/plain")
@@ -385,7 +397,10 @@ class FakeAPIServer:
             h.wfile.write(data)
             return
         if method == "GET":
-            h._send(200, self._wire(r.plural, store.get(r.plural, ns, r.name)))
+            # Snapshot read: serialize the immutable stored object directly,
+            # no deep copy (the encode loop never mutates it).
+            h._send(200, self._wire(
+                r.plural, store.get_snapshot(r.plural, ns, r.name)))
             return
         if method == "PUT" and r.subresource == "status":
             obj = self._parse(r.plural, h._body())
@@ -428,8 +443,14 @@ class FakeAPIServer:
         (urllib's per-request Connection: close used to mask this; the
         pooled transport keeps sockets open)."""
         h.close_connection = True
+        # auto_resume=False: if THIS stream's consumer is too slow and its
+        # bounded queue overflows, the store drops the watcher and we close
+        # the HTTP stream — the RV-resuming client reconnects and the watch
+        # cache replays the overflow window (kube-apiserver behavior for a
+        # watcher that can't keep up).
         w = self.store.watch(r.plural, r.namespace,
-                             since_rv=r.resource_version, bookmark=True)
+                             since_rv=r.resource_version, bookmark=True,
+                             auto_resume=False)
         gen = self._watch_gen
         last_bookmark = time.monotonic()
         try:
@@ -446,6 +467,8 @@ class FakeAPIServer:
                 ev = w.next(timeout=0.5)
                 if self._watch_gen != gen:
                     break  # drop_watches(): end the stream mid-flight
+                if w.dropped and ev is None:
+                    break  # queue overflow: close now; client resumes by RV
                 if ev is None:
                     if self._httpd is None:
                         break
@@ -465,10 +488,19 @@ class FakeAPIServer:
                                    ev.object.metadata.resource_version}},
                     }).encode() + b"\n")
                     continue
-                line = json.dumps({
-                    "type": ev.type,
-                    "object": self._wire(r.plural, ev.object),
-                }).encode() + b"\n"
+                # Encode once per EVENT, not per stream: the WatchEvent is
+                # shared by every watcher queue and the watch cache (one
+                # immutable snapshot), so the first stream to carry it pays
+                # the JSON encode and caches the wire line for all others —
+                # replays included.  The benign double-encode race under
+                # concurrent first-carries produces identical bytes.
+                line = ev.wire_line
+                if line is None:
+                    line = json.dumps({
+                        "type": ev.type,
+                        "object": self._wire(r.plural, ev.object),
+                    }).encode() + b"\n"
+                    ev.wire_line = line
                 chunk(line)
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass

@@ -298,7 +298,19 @@ class FakeKubelet:
 
     def _run(self) -> None:
         last_reap = time.monotonic()
+        # Watch-gap recovery: the (now bounded) in-process watcher resumes
+        # overflow drops transparently, but a 410-too-old resume is a real
+        # gap — `gaps` bumps and anything in between is lost.  Re-list and
+        # re-spawn (idempotent via self._threads); a pod DELETED during the
+        # gap needs no handling here, its driver thread sees NotFound on
+        # the next phase write and reaps itself.
+        seen_gaps = getattr(self._watcher, "gaps", 0)
         while not self._stop.is_set():
+            gaps = getattr(self._watcher, "gaps", 0)
+            if gaps != seen_gaps:
+                seen_gaps = gaps
+                for pod in self.cluster.pods.list():
+                    self._spawn(pod)
             # Node-side gang reaping: free slices whose gang has no live pod
             # left.  Required in two-process (REST) mode where the controller
             # holds no inventory handle; harmless redundancy otherwise.

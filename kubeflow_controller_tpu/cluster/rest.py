@@ -479,7 +479,15 @@ class RestWatcher:
         # short enough that a down server surfaces an error in ~5 s instead
         # of each informer eating a 10 s timeout serially (advisor round-2).
         self._connect_grace = connect_grace
-        self.queue: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        # Bounded, with BACKPRESSURE rather than drop: the reader thread's
+        # put blocks when the consumer lags, which stops the chunked read,
+        # fills the TCP window, and pushes the overflow decision to the
+        # server's bounded watcher queue — where dropping is safe, because
+        # this side resumes by RV and the server watch cache replays.
+        # Dropping locally would silently lose events ALREADY past
+        # ``resource_version``, which no resume could recover.
+        self.queue: "queue.Queue[Optional[WatchEvent]]" = queue.Queue(
+            maxsize=4096)
         self._stopped = threading.Event()
         self._connected = threading.Event()
         # Incremented each time a broken stream is RE-established: events in
@@ -546,7 +554,7 @@ class RestWatcher:
                     obj = serde.from_dict(self._cls, _normalize_meta(ev["object"]))
                     if obj.metadata.resource_version:
                         self.resource_version = obj.metadata.resource_version
-                    self.queue.put(WatchEvent(ev["type"], obj))
+                    self._put(WatchEvent(ev["type"], obj))
             except TooOldResourceVersion:
                 # 410 Gone: the resume RV fell out of the server's watch
                 # cache.  Drop it and reconnect live; that NEXT successful
@@ -586,6 +594,16 @@ class RestWatcher:
                 self._connected.clear()
                 time.sleep(0.2)  # reconnect, as client-go reflectors do
 
+    def _put(self, ev: Optional[WatchEvent]) -> None:
+        """Bounded put that stays interruptible: a stop() while the queue
+        is full must still unblock the reader thread."""
+        while not self._stopped.is_set():
+            try:
+                self.queue.put(ev, timeout=0.5)
+                return
+            except queue.Full:
+                continue
+
     def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
         try:
             return self.queue.get(timeout=timeout)
@@ -601,7 +619,10 @@ class RestWatcher:
                     resp.close()
                 except OSError:
                     pass
-            self.queue.put(None)
+            try:
+                self.queue.put_nowait(None)
+            except queue.Full:
+                pass  # consumer will drain to the closed-stream end anyway
 
 
 # ---------------------------------------------------------------------------

@@ -10,8 +10,8 @@ Reproduces the behaviors the reference's controller correctness depends on
 - ``generateName`` materialization (base + 5 random alphanumerics, ref:
   vendor/k8s.io/kubernetes/pkg/api/v1/generate.go:48-72);
 - watch streams that deliver ADDED/MODIFIED/DELETED in write order, each
-  carrying one deep copy shared read-only by all watchers (watchers can
-  never mutate the store; see ``_notify``);
+  carrying the immutable stored snapshot shared read-only by all watchers
+  (watchers can never mutate the store; see ``_notify``);
 - a bounded per-kind **watch cache** of recent ``(rv, event)`` pairs (the
   kube-apiserver watch cache): ``watch(kind, since_rv=...)`` replays the
   buffered events after ``since_rv`` before going live, so a client that
@@ -24,21 +24,52 @@ Reproduces the behaviors the reference's controller correctness depends on
   objects (net-new: the reference's delete handlers are stubs,
   pkg/controller/controller.go:522-524, 601-603).
 
-Everything is guarded by one RLock; watch queues are unbounded
-``queue.Queue`` so writers never block on slow watchers.
+Concurrency model (the PR-6 shard rebuild — kube-apiserver watch cache /
+Maple-style control-plane partitioning, PAPERS.md):
+
+- **Per-kind shards.**  Each kind owns its own lock, collection map, watch
+  cache ring, and watcher list, so readers and writers of one kind never
+  contend with another kind's.  resourceVersions and uids come from one
+  process-wide atomic counter, so cross-kind ordering (and every PR-5
+  resume invariant) is preserved: within a kind, RV order == write order
+  (the shard lock serializes same-kind writers); across kinds RVs are
+  globally unique and monotonic.
+- **Write-time snapshots, copy-outside-the-lock reads.**  Every write
+  swaps a freshly-copied object into the collection and NEVER mutates a
+  stored object in place — stored objects are immutable snapshots.  Reads
+  therefore grab references under the shard lock and deep-copy after
+  releasing it (or skip the copy entirely on the wire path:
+  ``get_snapshot`` / ``list_snapshot_with_rv`` hand out read-only
+  references for serialization).  ``_notify`` shares the stored snapshot
+  itself with every watcher and the watch cache — zero copies on the
+  fan-out, and the API server caches ONE wire encoding per event.
+- **Bounded watcher queues.**  A slow consumer overflows into a
+  dropped-stream close: in-process watchers transparently re-subscribe
+  from their last delivered RV (exactly-once replay; a 410-too-old bumps
+  ``gaps`` so cache consumers re-list), while API-server streams
+  (``auto_resume=False``) close so the RV-resuming REST client reconnects
+  through the PR-5 replay path.
+- ``ObjectStore(sharded=False)`` is the pre-shard baseline — one global
+  lock shared by every shard, reads copied *inside* the lock with the
+  ``copy.deepcopy`` copier — kept so ``bench.py --store-contention
+  --no-shard`` measures exactly what this rebuild removed.
+
+Lock-wait time is measured per shard on every acquisition
+(``kctpu_store_lock_wait_seconds``; :meth:`ObjectStore.lock_wait_stats`).
 """
 
 from __future__ import annotations
 
+import bisect
 import collections
 import queue
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..api.meta import ObjectMeta, get_controller_of, matches_selector
-from ..obs.metrics import REGISTRY
+from ..obs.metrics import REGISTRY, Family, Sample, bucket_quantile
 from ..utils import serde
 from ..utils.names import generate_name
 
@@ -79,7 +110,11 @@ BOOKMARK = "BOOKMARK"
 @dataclass
 class WatchEvent:
     type: str
-    object: Any  # deep copy of the stored object
+    object: Any  # the immutable stored snapshot — shared, treat as read-only
+    # One wire encoding per event, computed lazily by the API server and
+    # shared by every stream that carries this event (replay included):
+    # the "encode once, N watchers" half of the snapshot fan-out.
+    wire_line: Optional[bytes] = field(default=None, repr=False, compare=False)
 
 
 @dataclass
@@ -94,22 +129,109 @@ def _bookmark_event(rv: str) -> WatchEvent:
     return WatchEvent(BOOKMARK, Bookmark(metadata=ObjectMeta(resource_version=rv)))
 
 
-class Watcher:
-    """One watch stream: an unbounded queue of :class:`WatchEvent`."""
+# Lock-wait histogram bucket upper bounds (seconds).  Uncontended acquires
+# land in the first bucket; the tail is sized for GIL-preemption convoys
+# (a holder descheduled mid-critical-section parks waiters for multiple
+# 5 ms GIL quanta).
+LOCK_WAIT_BUCKETS = (1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3,
+                     1e-2, 5e-2, 0.1, 0.5, 1.0)
 
-    def __init__(self, store: "ObjectStore", kind: str, namespace: Optional[str]):
+
+class _Shard:
+    """One kind's slice of the store: lock + collection + watch plane +
+    lock-wait accounting.  Used as a context manager: ``with shard:`` is a
+    *timed* acquisition — contended waits are bucketed per shard (mutated
+    only while the lock is held, so no extra synchronization)."""
+
+    __slots__ = ("kind", "lock", "objects", "watchers", "watch_cache",
+                 "evicted_rv", "wait_counts", "wait_sum", "wait_max",
+                 "contended", "overflows")
+
+    def __init__(self, kind: str, lock: "threading.RLock"):
+        self.kind = kind
+        self.lock = lock
+        self.objects: Dict[tuple, Any] = {}
+        self.watchers: List["Watcher"] = []
+        self.watch_cache: "collections.deque[Tuple[int, WatchEvent]]" = (
+            collections.deque())
+        # Newest rv ever evicted from the ring: resume points at or below
+        # it are detected exactly as 410-too-old.
+        self.evicted_rv = 0
+        self.wait_counts = [0] * (len(LOCK_WAIT_BUCKETS) + 1)
+        self.wait_sum = 0.0
+        self.wait_max = 0.0
+        self.contended = 0
+        self.overflows = 0
+
+    def __enter__(self) -> "_Shard":
+        if self.lock.acquire(blocking=False):
+            self.wait_counts[0] += 1
+            return self
+        t0 = time.perf_counter()
+        self.lock.acquire()
+        waited = time.perf_counter() - t0
+        self.contended += 1
+        self.wait_sum += waited
+        if waited > self.wait_max:
+            self.wait_max = waited
+        self.wait_counts[bisect.bisect_left(LOCK_WAIT_BUCKETS, waited)] += 1
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.lock.release()
+
+
+class Watcher:
+    """One watch stream: a **bounded** queue of :class:`WatchEvent`.
+
+    A slow consumer that lets the queue hit ``max_queue`` is dropped by
+    the write path (writers never block on watchers): buffered events
+    still drain in order, then the stream ends.  With ``auto_resume``
+    (in-process consumers) the next :meth:`next` transparently
+    re-subscribes from the last delivered RV — the watch cache replays the
+    overflow window exactly once; only a 410-too-old bumps :attr:`gaps`,
+    sending cache consumers through their re-list fallback.  API-server
+    streams pass ``auto_resume=False`` and surface :attr:`dropped` so the
+    HTTP stream closes and the remote client drives its own RV resume."""
+
+    def __init__(self, store: "ObjectStore", kind: str, namespace: Optional[str],
+                 max_queue: int = 0, auto_resume: bool = True):
         self._store = store
         self.kind = kind
         self.namespace = namespace
+        self.max_queue = max_queue  # 0 = unbounded
+        self.auto_resume = auto_resume
         self.queue: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        #: Reconnects that could NOT resume (events lost): consumers
+        #: holding a cache must full re-list, as after a REST 410.
+        self.gaps = 0
+        self._last_rv = 0  # newest RV the consumer has fully observed
+        self._dropped = False
         self._stopped = False
+
+    @property
+    def dropped(self) -> bool:
+        """True once the write path evicted this watcher for overflowing
+        its queue (buffered events still drain)."""
+        return self._dropped
 
     def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
         """Blocking pop; None on stop or timeout."""
         try:
-            return self.queue.get(timeout=timeout)
+            ev = self.queue.get(timeout=timeout)
         except queue.Empty:
             return None
+        if ev is None:
+            # End-of-stream sentinel: a stop() is final; an overflow drop
+            # re-subscribes in place when auto_resume is on.
+            if self._dropped and not self._stopped and self.auto_resume:
+                self._store._resubscribe(self)
+                return self.next(timeout=0)
+            return None
+        rv = ev.object.metadata.resource_version
+        if rv:
+            self._last_rv = int(rv)
+        return ev
 
     def stop(self) -> None:
         if not self._stopped:
@@ -120,21 +242,31 @@ class Watcher:
 
 class ObjectStore:
     """The in-memory API server. Collections are keyed by plural kind
-    ("tfjobs", "pods", "services"); objects by (namespace, name)."""
+    ("tfjobs", "pods", "services"); objects by (namespace, name).
 
-    def __init__(self, watch_cache_size: int = 1024):
-        self._lock = threading.RLock()
-        self._objects: Dict[str, Dict[tuple, Any]] = {}
-        self._watchers: Dict[str, List[Watcher]] = {}
+    ``sharded=False`` is the global-lock, copy-under-the-lock baseline
+    (the pre-shard store) for ``bench.py --store-contention --no-shard``.
+    """
+
+    def __init__(self, watch_cache_size: int = 1024, sharded: bool = True,
+                 watch_queue_size: int = 8192):
+        self._sharded = sharded
+        # With snapshot reads off (baseline), every read copies inside the
+        # lock with the slow copier — the exact pre-PR-6 cost profile.
+        self._snapshot = sharded
+        self._copy = serde.deep_copy if sharded else serde.slow_deep_copy
+        self._shards: Dict[str, _Shard] = {}
+        self._shards_guard = threading.Lock()
+        # Baseline mode: one RLock shared by every shard.
+        self._global_lock = None if sharded else threading.RLock()
+        # Process-wide RV/uid counter: one tiny lock, never held while any
+        # shard lock is being acquired (shard -> meta is the only nesting
+        # order, so shards cannot deadlock through it).
+        self._meta_lock = threading.Lock()
         self._rv = 0
         self._uid = 0
-        # Per-kind ring buffer of recent (rv, event) pairs — the
-        # kube-apiserver watch cache.  A watch(since_rv=...) replays from
-        # here; _evicted_rv records the newest rv ever evicted per kind, so
-        # a resume point older than the buffer is detected exactly (410).
         self._watch_cache_size = watch_cache_size
-        self._watch_cache: Dict[str, "collections.deque[Tuple[int, WatchEvent]]"] = {}
-        self._evicted_rv: Dict[str, int] = {}
+        self._watch_queue_size = watch_queue_size
         self._c_replayed = REGISTRY.counter(
             "kctpu_watch_replayed_events_total",
             "Watch events replayed from the server watch cache on "
@@ -143,90 +275,189 @@ class ObjectStore:
             "kctpu_watch_cache_depth",
             "Buffered (rv, event) pairs in the per-kind server watch cache",
             ("kind",))
+        # Shard-local families (lock-wait histogram, shard depth, watch
+        # queue depth/overflows) render at scrape time from the shard
+        # counters — zero hot-path cost beyond the ints themselves.
+        REGISTRY.register_collector("store", self._collect_families)
 
     # -- internals -----------------------------------------------------------
 
+    def _shard(self, kind: str) -> _Shard:
+        sh = self._shards.get(kind)
+        if sh is None:
+            with self._shards_guard:
+                sh = self._shards.get(kind)
+                if sh is None:
+                    sh = _Shard(kind, self._global_lock or threading.RLock())
+                    # Scrape-time depth callback: updating the gauge from
+                    # _notify would re-serialize every shard's writers on
+                    # the one instrument lock — the exact cross-kind
+                    # convoy the shards exist to remove.
+                    self._g_cache_depth.labels(kind).set_function(
+                        lambda sh=sh: len(sh.watch_cache))
+                    self._shards[kind] = sh
+        return sh
+
+    @property
+    def _watch_cache(self) -> Dict[str, "collections.deque[Tuple[int, WatchEvent]]"]:
+        """Compat view (tests/debugging): kind -> watch-cache ring."""
+        return {k: sh.watch_cache for k, sh in self._shards.items()}
+
     def _next_rv(self) -> str:
-        self._rv += 1
-        return str(self._rv)
+        with self._meta_lock:
+            self._rv += 1
+            return str(self._rv)
 
     def _next_uid(self) -> str:
-        self._uid += 1
-        return f"uid-{self._uid}"
+        with self._meta_lock:
+            self._uid += 1
+            return f"uid-{self._uid}"
 
-    def _collection(self, kind: str) -> Dict[tuple, Any]:
-        return self._objects.setdefault(kind, {})
-
-    def _notify(self, kind: str, ev_type: str, obj: Any) -> None:
-        # Single-serialization fan-out: ONE deep copy per event, shared by
-        # every watcher's queue AND the per-kind watch cache (the apiserver
-        # analog: one encode, N streams).  Per-watcher copies made this
-        # O(watchers × object size) under the global lock — with 4+
-        # watchers per kind (controller informer, kubelet, REST streams)
-        # the dominant write-path cost.  The shared copy still can't mutate
-        # the store; watch consumers treat event objects as read-only
-        # (informers hand out copies on the mutating read paths).  The copy
-        # is made even with zero live watchers: a disconnected client's
-        # resume depends on exactly the events it wasn't there to see.
-        shared = serde.deep_copy(obj)
-        ev = WatchEvent(ev_type, shared)
-        buf = self._watch_cache.get(kind)
-        if buf is None:
-            buf = self._watch_cache[kind] = collections.deque()
-        buf.append((int(shared.metadata.resource_version), ev))
+    def _notify(self, sh: _Shard, ev_type: str, obj: Any) -> None:
+        # Zero-copy fan-out: the stored object IS an immutable snapshot
+        # (every write swaps in a fresh copy), so the event shares it with
+        # every watcher's queue AND the per-kind watch cache — the
+        # apiserver analog: one object, one (lazily cached) encode, N
+        # streams.  Watch consumers treat event objects as read-only
+        # (informers hand out copies on the mutating read paths).  The
+        # cache entry is appended even with zero live watchers: a
+        # disconnected client's resume depends on exactly the events it
+        # wasn't there to see.  Caller holds the shard lock.
+        if not self._snapshot:
+            obj = serde.slow_deep_copy(obj)  # baseline: per-event copy
+        ev = WatchEvent(ev_type, obj)
+        buf = sh.watch_cache
+        buf.append((int(obj.metadata.resource_version), ev))
         if len(buf) > self._watch_cache_size:
             evicted_rv, _ = buf.popleft()
-            if evicted_rv > self._evicted_rv.get(kind, 0):
-                self._evicted_rv[kind] = evicted_rv
-        self._g_cache_depth.labels(kind).set(len(buf))
-        for w in self._watchers.get(kind, []):
-            if w.namespace is None or w.namespace == obj.metadata.namespace:
-                w.queue.put(ev)
+            if evicted_rv > sh.evicted_rv:
+                sh.evicted_rv = evicted_rv
+        dropped = None
+        for w in sh.watchers:
+            if w.namespace is not None and w.namespace != obj.metadata.namespace:
+                continue
+            if w.max_queue and w.queue.qsize() >= w.max_queue:
+                # Slow consumer: drop the stream instead of blocking the
+                # writer or growing without bound.  The sentinel lands
+                # AFTER the buffered prefix, so everything already queued
+                # still drains in order; the overflow window replays from
+                # the watch cache on reconnect.
+                w._dropped = True
+                sh.overflows += 1
+                w.queue.put(None)
+                dropped = (dropped or []) + [w]
+                continue
+            w.queue.put(ev)
+        if dropped:
+            sh.watchers = [w for w in sh.watchers if w not in dropped]
 
     def _remove_watcher(self, w: Watcher) -> None:
-        with self._lock:
-            lst = self._watchers.get(w.kind, [])
-            if w in lst:
-                lst.remove(w)
+        sh = self._shard(w.kind)
+        with sh:
+            if w in sh.watchers:
+                sh.watchers.remove(w)
+
+    def _resubscribe(self, w: Watcher) -> None:
+        """Re-attach an overflow-dropped in-process watcher: replay every
+        buffered event after its last delivered RV (exactly once, in
+        order), or bump ``gaps`` when the window was evicted (the
+        in-process 410), then go live."""
+        sh = self._shard(w.kind)
+        with sh:
+            if w._stopped:
+                return
+            if w._last_rv < sh.evicted_rv:
+                w.gaps += 1  # events lost for good: consumer must re-list
+            else:
+                replayed = 0
+                for rv, ev in sh.watch_cache:
+                    if rv <= w._last_rv:
+                        continue
+                    if (w.namespace is not None
+                            and ev.object.metadata.namespace != w.namespace):
+                        continue
+                    w.queue.put(ev)
+                    replayed += 1
+                if replayed:
+                    self._c_replayed.inc(replayed)
+            w._dropped = False
+            sh.watchers.append(w)
 
     # -- API surface ---------------------------------------------------------
 
     def create(self, kind: str, obj: Any) -> Any:
-        with self._lock:
-            meta: ObjectMeta = obj.metadata
-            obj = serde.deep_copy(obj)
-            meta = obj.metadata
+        # The incoming object is copied BEFORE the lock (the store must
+        # own its snapshot; the caller keeps mutating theirs), stamped and
+        # inserted under it, and the caller-owned return copy is made
+        # after release.
+        obj = self._copy(obj)
+        meta: ObjectMeta = obj.metadata
+        sh = self._shard(kind)
+        with sh:
             if not meta.name:
                 if not meta.generate_name:
                     raise Invalid("either name or generateName is required")
                 # Retry on (unlikely) suffix collision, as the apiserver does.
                 for _ in range(8):
                     candidate = generate_name(meta.generate_name)
-                    if (meta.namespace, candidate) not in self._collection(kind):
+                    if (meta.namespace, candidate) not in sh.objects:
                         meta.name = candidate
                         break
                 else:
                     raise AlreadyExists(f"could not generate unique name for {meta.generate_name}")
             key = (meta.namespace, meta.name)
-            if key in self._collection(kind):
+            if key in sh.objects:
                 raise AlreadyExists(f"{kind} {key} already exists")
             meta.uid = self._next_uid()
             meta.resource_version = self._next_rv()
             meta.creation_timestamp = time.time()
-            self._collection(kind)[key] = obj
-            self._notify(kind, ADDED, obj)
-            return serde.deep_copy(obj)
+            sh.objects[key] = obj
+            self._notify(sh, ADDED, obj)
+            if not self._snapshot:
+                return serde.slow_deep_copy(obj)
+        return self._copy(obj)
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
         """A quorum/live read — this is what the adoption path's
         ``canAdoptFunc`` uses to re-check UIDs (ref: pkg/controller/
         helper.go:137-146, RecheckDeletionTimestamp at
         controller_ref_manager.go:373-385)."""
-        with self._lock:
-            obj = self._collection(kind).get((namespace, name))
+        sh = self._shard(kind)
+        with sh:
+            obj = sh.objects.get((namespace, name))
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
-            return serde.deep_copy(obj)
+            if not self._snapshot:
+                return serde.slow_deep_copy(obj)
+        # Snapshot mode: the stored object can never mutate — copy it for
+        # the caller AFTER releasing the shard lock.
+        return self._copy(obj)
+
+    def get_snapshot(self, kind: str, namespace: str, name: str) -> Any:
+        """The wire-serialization read: returns the immutable stored
+        snapshot itself, **no copy** — the caller (the API server's encode
+        path) must treat it as read-only.  Falls back to the copying
+        :meth:`get` in baseline mode."""
+        if not self._snapshot:
+            return self.get(kind, namespace, name)
+        sh = self._shard(kind)
+        with sh:
+            obj = sh.objects.get((namespace, name))
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            return obj
+
+    def _select(self, sh: _Shard, namespace: Optional[str],
+                selector: Optional[Dict[str, str]]) -> List[Any]:
+        """Matching stored references; caller holds the shard lock."""
+        out = []
+        for (ns, _), obj in sh.objects.items():
+            if namespace is not None and ns != namespace:
+                continue
+            if selector is not None and not matches_selector(obj.metadata.labels, selector):
+                continue
+            out.append(obj)
+        return out
 
     def list(
         self,
@@ -234,15 +465,7 @@ class ObjectStore:
         namespace: Optional[str] = None,
         selector: Optional[Dict[str, str]] = None,
     ) -> List[Any]:
-        with self._lock:
-            out = []
-            for (ns, _), obj in self._collection(kind).items():
-                if namespace is not None and ns != namespace:
-                    continue
-                if selector is not None and not matches_selector(obj.metadata.labels, selector):
-                    continue
-                out.append(serde.deep_copy(obj))
-            return out
+        return self.list_with_rv(kind, namespace, selector)[0]
 
     def list_with_rv(
         self,
@@ -253,15 +476,45 @@ class ObjectStore:
         """list() plus the collection resourceVersion (ListMeta.resourceVersion
         on a real API server): the resume point a client hands back as
         ``watch(since_rv=...)`` so a stream can start exactly where the
-        LIST's snapshot ends — no gap, no re-list."""
-        with self._lock:
-            return self.list(kind, namespace, selector), str(self._rv)
+        LIST's snapshot ends — no gap, no re-list.
+
+        Snapshot and RV come from ONE shard-lock acquisition: no same-kind
+        write can interleave between them, so the RV can never drift ahead
+        of (or behind) the snapshot.  Writes to OTHER kinds may bump the
+        global counter concurrently — harmless: they hold no events of
+        this kind, so resuming this kind from the returned RV still replays
+        exactly what the snapshot is missing."""
+        sh = self._shard(kind)
+        with sh:
+            refs = self._select(sh, namespace, selector)
+            rv = str(self._rv)
+            if not self._snapshot:
+                return [serde.slow_deep_copy(o) for o in refs], rv
+        return [self._copy(o) for o in refs], rv
+
+    def list_snapshot_with_rv(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        selector: Optional[Dict[str, str]] = None,
+    ) -> Tuple[List[Any], str]:
+        """The wire-serialization LIST: immutable stored snapshots,
+        **no copies** — read-only for the caller's encode loop.  Same
+        single-acquisition RV contract as :meth:`list_with_rv`."""
+        if not self._snapshot:
+            return self.list_with_rv(kind, namespace, selector)
+        sh = self._shard(kind)
+        with sh:
+            return self._select(sh, namespace, selector), str(self._rv)
 
     def update(self, kind: str, obj: Any) -> Any:
-        with self._lock:
-            meta: ObjectMeta = obj.metadata
-            key = (meta.namespace, meta.name)
-            existing = self._collection(kind).get(key)
+        obj = self._copy(obj)
+        meta: ObjectMeta = obj.metadata
+        key = (meta.namespace, meta.name)
+        sh = self._shard(kind)
+        finalized = None
+        with sh:
+            existing = sh.objects.get(key)
             if existing is None:
                 raise NotFound(f"{kind} {key} not found")
             if meta.resource_version and meta.resource_version != existing.metadata.resource_version:
@@ -269,43 +522,57 @@ class ObjectStore:
                     f"{kind} {key}: resourceVersion {meta.resource_version} "
                     f"!= {existing.metadata.resource_version}"
                 )
-            obj = serde.deep_copy(obj)
             # uid, creation and deletion timestamps are immutable via update.
             obj.metadata.uid = existing.metadata.uid
             obj.metadata.creation_timestamp = existing.metadata.creation_timestamp
             obj.metadata.deletion_timestamp = existing.metadata.deletion_timestamp
             obj.metadata.resource_version = self._next_rv()
-            self._collection(kind)[key] = obj
-            self._notify(kind, MODIFIED, obj)
-            out = serde.deep_copy(obj)
-            self._maybe_finalize(kind, key)
-            return out
+            sh.objects[key] = obj
+            self._notify(sh, MODIFIED, obj)
+            finalized = self._maybe_finalize(sh, key)
+            if not self._snapshot:
+                out = serde.slow_deep_copy(obj)
+            else:
+                out = None
+        self._finish_finalize(finalized, key[0])
+        return out if out is not None else self._copy(obj)
 
     def patch_meta(self, kind: str, namespace: str, name: str,
                    fn: Callable[[ObjectMeta], None]) -> Any:
         """Server-side metadata patch (the adoption/release path: owner-ref
         merge patches, ref: pkg/controller/ref/service.go:126-164).  ``fn``
-        mutates the live metadata under the lock, so it cannot race other
-        writers; resourceVersion is bumped and watchers notified."""
-        with self._lock:
-            obj = self._collection(kind).get((namespace, name))
-            if obj is None:
+        mutates a write-time copy under the shard lock, so it cannot race
+        other writers of this kind (and must not call back into other
+        kinds); resourceVersion is bumped and watchers notified."""
+        sh = self._shard(kind)
+        finalized = None
+        with sh:
+            existing = sh.objects.get((namespace, name))
+            if existing is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
+            obj = self._copy(existing)  # copy-on-write: snapshots are immutable
             fn(obj.metadata)
             obj.metadata.resource_version = self._next_rv()
-            self._notify(kind, MODIFIED, obj)
-            out = serde.deep_copy(obj)
-            self._maybe_finalize(kind, (namespace, name))
-            return out
+            sh.objects[(namespace, name)] = obj
+            self._notify(sh, MODIFIED, obj)
+            finalized = self._maybe_finalize(sh, (namespace, name))
+            if not self._snapshot:
+                out = serde.slow_deep_copy(obj)
+            else:
+                out = None
+        self._finish_finalize(finalized, namespace)
+        return out if out is not None else self._copy(obj)
 
     def patch(self, kind: str, namespace: str, name: str, body: Dict) -> Any:
         """Full-object JSON merge patch (RFC 7386) — the PatchService analog
         (ref: pkg/controller/control/service.go:50-53), generalized to every
-        kind.  Server-side under the lock, so it cannot race other writers;
-        immutable metadata (uid, name/namespace, timestamps) is preserved,
-        resourceVersion bumps, watchers see MODIFIED."""
-        with self._lock:
-            existing = self._collection(kind).get((namespace, name))
+        kind.  Server-side under the shard lock, so it cannot race other
+        writers; immutable metadata (uid, name/namespace, timestamps) is
+        preserved, resourceVersion bumps, watchers see MODIFIED."""
+        sh = self._shard(kind)
+        finalized = None
+        with sh:
+            existing = sh.objects.get((namespace, name))
             if existing is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
             # Status is a subresource: the real API server drops 'status'
@@ -323,20 +590,26 @@ class ObjectStore:
             obj.metadata.creation_timestamp = existing.metadata.creation_timestamp
             obj.metadata.deletion_timestamp = existing.metadata.deletion_timestamp
             obj.metadata.resource_version = self._next_rv()
-            self._collection(kind)[(namespace, name)] = obj
-            self._notify(kind, MODIFIED, obj)
-            out = serde.deep_copy(obj)
-            self._maybe_finalize(kind, (namespace, name))
-            return out
+            sh.objects[(namespace, name)] = obj
+            self._notify(sh, MODIFIED, obj)
+            finalized = self._maybe_finalize(sh, (namespace, name))
+            if not self._snapshot:
+                out = serde.slow_deep_copy(obj)
+            else:
+                out = None
+        self._finish_finalize(finalized, namespace)
+        return out if out is not None else self._copy(obj)
 
     def update_status(self, kind: str, obj: Any) -> Any:
         """Status-subresource style update: only .status is applied.  A
         stale resourceVersion raises Conflict (as the real subresource does);
         an empty resourceVersion means last-write-wins."""
-        with self._lock:
-            meta: ObjectMeta = obj.metadata
-            key = (meta.namespace, meta.name)
-            existing = self._collection(kind).get(key)
+        status = self._copy(obj.status)  # caller's object: copy pre-lock
+        meta: ObjectMeta = obj.metadata
+        key = (meta.namespace, meta.name)
+        sh = self._shard(kind)
+        with sh:
+            existing = sh.objects.get(key)
             if existing is None:
                 raise NotFound(f"{kind} {key} not found")
             if meta.resource_version and meta.resource_version != existing.metadata.resource_version:
@@ -344,10 +617,14 @@ class ObjectStore:
                     f"{kind} {key}: status resourceVersion {meta.resource_version} "
                     f"!= {existing.metadata.resource_version}"
                 )
-            existing.status = serde.deep_copy(obj.status)
-            existing.metadata.resource_version = self._next_rv()
-            self._notify(kind, MODIFIED, existing)
-            return serde.deep_copy(existing)
+            new = self._copy(existing)  # copy-on-write swap
+            new.status = status
+            new.metadata.resource_version = self._next_rv()
+            sh.objects[key] = new
+            self._notify(sh, MODIFIED, new)
+            if not self._snapshot:
+                return serde.slow_deep_copy(new)
+        return self._copy(new)
 
     def update_progress(self, kind: str, namespace: str, name: str,
                         progress: Any) -> Any:
@@ -356,17 +633,22 @@ class ObjectStore:
         like the kubelet for phase — no resourceVersion ping-pong on a
         periodic heartbeat).  The server stamps the beat time when the
         reporter left it 0, so liveness cannot be faked by a skewed clock."""
-        with self._lock:
-            existing = self._collection(kind).get((namespace, name))
+        progress = self._copy(progress)
+        if not getattr(progress, "timestamp", 0.0):
+            progress.timestamp = time.time()
+        sh = self._shard(kind)
+        with sh:
+            existing = sh.objects.get((namespace, name))
             if existing is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
-            progress = serde.deep_copy(progress)
-            if not getattr(progress, "timestamp", 0.0):
-                progress.timestamp = time.time()
-            existing.status.progress = progress
-            existing.metadata.resource_version = self._next_rv()
-            self._notify(kind, MODIFIED, existing)
-            return serde.deep_copy(existing)
+            new = self._copy(existing)
+            new.status.progress = progress
+            new.metadata.resource_version = self._next_rv()
+            sh.objects[(namespace, name)] = new
+            self._notify(sh, MODIFIED, new)
+            if not self._snapshot:
+                return serde.slow_deep_copy(new)
+        return self._copy(new)
 
     def delete(self, kind: str, namespace: str, name: str, cascade: bool = True) -> None:
         """Delete an object.  With finalizers present this is GRACEFUL, as
@@ -376,65 +658,103 @@ class ObjectStore:
         finalizers: immediate delete + (optionally) cascading GC of
         controller-owned objects — the capability the reference left as a
         stub."""
-        with self._lock:
-            obj = self._collection(kind).get((namespace, name))
+        sh = self._shard(kind)
+        removed = None
+        with sh:
+            obj = sh.objects.get((namespace, name))
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
             if obj.metadata.finalizers:
                 if obj.metadata.deletion_timestamp is None:
-                    obj.metadata.deletion_timestamp = time.time()
-                    obj.metadata.resource_version = self._next_rv()
-                    self._notify(kind, MODIFIED, obj)
+                    new = self._copy(obj)
+                    new.metadata.deletion_timestamp = time.time()
+                    new.metadata.resource_version = self._next_rv()
+                    sh.objects[(namespace, name)] = new
+                    self._notify(sh, MODIFIED, new)
                 return
-            self._collection(kind).pop((namespace, name))
-            obj.metadata.deletion_timestamp = time.time()
+            sh.objects.pop((namespace, name))
+            # The stored snapshot may still be referenced by readers:
+            # stamp the delete on a copy, never in place.
+            removed = self._copy(obj)
+            removed.metadata.deletion_timestamp = time.time()
             # Deletes bump the RV too (as the real apiserver does): the
             # DELETED event needs its own slot in the watch cache, or a
             # client resuming from the create's RV would never replay it.
-            obj.metadata.resource_version = self._next_rv()
-            self._notify(kind, DELETED, obj)
-            if cascade:
-                self._cascade_delete(obj.metadata.uid, namespace)
+            removed.metadata.resource_version = self._next_rv()
+            self._notify(sh, DELETED, removed)
+        if cascade and removed is not None:
+            self._cascade_delete(removed.metadata.uid, namespace)
 
-    def _maybe_finalize(self, kind: str, key: tuple) -> bool:
+    def _maybe_finalize(self, sh: _Shard, key: tuple) -> Optional[Any]:
         """Remove an object whose deletion was blocked on finalizers once
-        the last finalizer is gone (k8s finalization semantics)."""
-        obj = self._collection(kind).get(key)
+        the last finalizer is gone (k8s finalization semantics).  Runs
+        under the caller's shard lock; returns the finalized snapshot so
+        the caller cascades AFTER releasing the lock (cascading holds at
+        most one shard lock at a time — the no-deadlock invariant)."""
+        obj = sh.objects.get(key)
         if obj is None or obj.metadata.deletion_timestamp is None or obj.metadata.finalizers:
-            return False
-        self._collection(kind).pop(key)
-        obj.metadata.resource_version = self._next_rv()  # see delete()
-        self._notify(kind, DELETED, obj)
-        self._cascade_delete(obj.metadata.uid, key[0])
-        return True
+            return None
+        sh.objects.pop(key)
+        removed = self._copy(obj)
+        removed.metadata.resource_version = self._next_rv()  # see delete()
+        self._notify(sh, DELETED, removed)
+        return removed
+
+    def _finish_finalize(self, finalized: Optional[Any], namespace: str) -> None:
+        if finalized is not None:
+            self._cascade_delete(finalized.metadata.uid, namespace)
 
     def _cascade_delete(self, owner_uid: str, namespace: str) -> None:
-        for kind in list(self._objects):
-            for (ns, name), child in list(self._collection(kind).items()):
-                if ns != namespace:
-                    continue
-                ref = get_controller_of(child.metadata)
-                if ref is not None and ref.uid == owner_uid:
-                    self.delete(kind, ns, name, cascade=True)
+        # Runs with NO shard lock held: each kind's victims are collected
+        # under that kind's lock, then deleted through the public path
+        # (which re-acquires per child) — shard locks never nest, so
+        # cross-kind cascades cannot deadlock.  A child created for a
+        # just-deleted owner after its shard was scanned is picked up by
+        # the controller's next sync, as with the async GC on a real
+        # cluster.
+        with self._shards_guard:
+            kinds = list(self._shards)
+        for kind in kinds:
+            sh = self._shard(kind)
+            with sh:
+                victims = [
+                    name for (ns, name), child in sh.objects.items()
+                    if ns == namespace
+                    and (ref := get_controller_of(child.metadata)) is not None
+                    and ref.uid == owner_uid
+                ]
+            for name in victims:
+                try:
+                    self.delete(kind, namespace, name, cascade=True)
+                except NotFound:
+                    pass  # lost a race with a concurrent deleter: already gone
 
     def mark_deleting(self, kind: str, namespace: str, name: str) -> Any:
         """Set deletionTimestamp without removing (graceful-deletion state,
         which FilterActivePods treats as inactive).  Deliberately does NOT
         finalize an object with no finalizers: the node agent owns the final
         delete, as a kubelet does for a terminating pod."""
-        with self._lock:
-            obj = self._collection(kind).get((namespace, name))
+        sh = self._shard(kind)
+        with sh:
+            obj = sh.objects.get((namespace, name))
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
             if obj.metadata.deletion_timestamp is None:
-                obj.metadata.deletion_timestamp = time.time()
-                obj.metadata.resource_version = self._next_rv()
-                self._notify(kind, MODIFIED, obj)
-            return serde.deep_copy(obj)
+                new = self._copy(obj)
+                new.metadata.deletion_timestamp = time.time()
+                new.metadata.resource_version = self._next_rv()
+                sh.objects[(namespace, name)] = new
+                self._notify(sh, MODIFIED, new)
+                obj = new
+            if not self._snapshot:
+                return serde.slow_deep_copy(obj)
+        return self._copy(obj)
 
     def watch(self, kind: str, namespace: Optional[str] = None,
               since_rv: Optional[str] = None,
-              bookmark: bool = False) -> Watcher:
+              bookmark: bool = False,
+              max_queue: Optional[int] = None,
+              auto_resume: bool = True) -> Watcher:
         """Open a watch stream.  ``since_rv`` resumes from a resourceVersion:
         every buffered event after it is replayed into the stream (exactly
         once, in write order, namespace-filtered) ahead of live events.
@@ -445,19 +765,27 @@ class ObjectStore:
         current collection RV, so even a stream that never receives an
         event holds a fresh resume point.  Registration and replay happen
         in one critical section: no live write can interleave into (or
-        duplicate) the replayed prefix."""
-        with self._lock:
+        duplicate) the replayed prefix.
+
+        ``max_queue`` bounds the stream's queue (None = the store default;
+        0 = unbounded); ``auto_resume`` picks the overflow recovery — see
+        :class:`Watcher`."""
+        sh = self._shard(kind)
+        with sh:
             if since_rv is not None:
                 since = int(since_rv)
-                if since < self._evicted_rv.get(kind, 0):
+                if since < sh.evicted_rv:
                     raise TooOldResourceVersion(
                         f"{kind}: resourceVersion {since} is too old "
-                        f"(watch cache begins after "
-                        f"{self._evicted_rv.get(kind, 0)})")
-            w = Watcher(self, kind, namespace)
+                        f"(watch cache begins after {sh.evicted_rv})")
+            w = Watcher(self, kind, namespace,
+                        max_queue=(self._watch_queue_size if max_queue is None
+                                   else max_queue),
+                        auto_resume=auto_resume)
             if since_rv is not None:
+                w._last_rv = since
                 replayed = 0
-                for rv, ev in self._watch_cache.get(kind, ()):
+                for rv, ev in sh.watch_cache:
                     if rv <= since:
                         continue
                     if namespace is not None and ev.object.metadata.namespace != namespace:
@@ -466,7 +794,7 @@ class ObjectStore:
                     replayed += 1
                 if replayed:
                     self._c_replayed.inc(replayed)
-            self._watchers.setdefault(kind, []).append(w)
+            sh.watchers.append(w)
             if bookmark:
                 w.queue.put(_bookmark_event(str(self._rv)))
             return w
@@ -475,8 +803,72 @@ class ObjectStore:
         """Enqueue a BOOKMARK carrying the current collection RV into
         ``w``'s stream (the apiserver's periodic watch bookmarks: they keep
         an idle or namespace-filtered stream's resume point fresh).  Under
-        the store lock, every write with rv ≤ the stamped RV has already
-        enqueued its event ahead of the bookmark — so resuming from a
-        bookmark RV can never skip an earlier event."""
-        with self._lock:
-            w.queue.put(_bookmark_event(str(self._rv)))
+        the shard lock, every same-kind write with rv ≤ the stamped RV has
+        already enqueued its event ahead of the bookmark — so resuming from
+        a bookmark RV can never skip an earlier event."""
+        with self._shard(w.kind):
+            if not w._dropped and not w._stopped:
+                w.queue.put(_bookmark_event(str(self._rv)))
+
+    # -- observability --------------------------------------------------------
+
+    def lock_wait_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-kind shard-lock wait statistics since construction:
+        ``{kind: {acquires, contended, overflows, wait_sum_s, wait_max_s,
+        p50_s, p99_s}}``.  Percentiles are conservative bucket upper
+        bounds (``bucket_quantile``)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for kind, sh in list(self._shards.items()):
+            counts = list(sh.wait_counts)
+            total = sum(counts)
+            out[kind] = {
+                "acquires": total,
+                "contended": sh.contended,
+                "overflows": sh.overflows,
+                "wait_sum_s": sh.wait_sum,
+                "wait_max_s": sh.wait_max,
+                "p50_s": bucket_quantile(LOCK_WAIT_BUCKETS, counts, 0.50),
+                "p99_s": bucket_quantile(LOCK_WAIT_BUCKETS, counts, 0.99),
+            }
+        return out
+
+    def _collect_families(self) -> List[Family]:
+        """Scrape-time store families: per-shard lock-wait histogram,
+        object depth, and watch-queue depth/overflow — rendered from the
+        shard-local counters so the hot path never touches an instrument
+        lock shared across shards."""
+        wait_fam = Family(
+            "kctpu_store_lock_wait_seconds", "histogram",
+            "Time spent waiting to acquire a store shard lock, per kind")
+        depth_fam = Family(
+            "kctpu_store_shard_depth", "gauge",
+            "Objects held per store shard (kind)")
+        qdepth_fam = Family(
+            "kctpu_watch_queue_depth", "gauge",
+            "Deepest live watcher queue per kind")
+        overflow_fam = Family(
+            "kctpu_watch_queue_overflows_total", "counter",
+            "Watch streams dropped because a slow consumer overflowed its "
+            "bounded queue (recovered via RV-resume replay)")
+        contended_fam = Family(
+            "kctpu_store_lock_contended_total", "counter",
+            "Store shard-lock acquisitions that had to wait")
+        for kind, sh in sorted(self._shards.items()):
+            base = {"kind": kind}
+            counts = list(sh.wait_counts)
+            acc = 0
+            for ub, c in zip(LOCK_WAIT_BUCKETS, counts):
+                acc += c
+                wait_fam.samples.append(
+                    Sample("_bucket", {**base, "le": repr(float(ub))}, acc))
+            total = sum(counts)
+            wait_fam.samples.append(Sample("_bucket", {**base, "le": "+Inf"}, total))
+            wait_fam.samples.append(Sample("_sum", base, sh.wait_sum))
+            wait_fam.samples.append(Sample("_count", base, total))
+            depth_fam.samples.append(Sample("", base, len(sh.objects)))
+            with sh.lock:
+                depth = max((w.queue.qsize() for w in sh.watchers), default=0)
+            qdepth_fam.samples.append(Sample("", base, depth))
+            overflow_fam.samples.append(Sample("", base, sh.overflows))
+            contended_fam.samples.append(Sample("", base, sh.contended))
+        return [wait_fam, depth_fam, qdepth_fam, overflow_fam, contended_fam]

@@ -194,7 +194,9 @@ class SharedInformer:
         # do.  An RV-resumable transport (RestWatcher) replays missed
         # events on reconnect and only bumps `gaps` on a genuine
         # 410-too-old, keeping the full re-list strictly as the fallback.
-        # The in-memory watcher never gaps (no attribute).
+        # The in-memory watcher resumes its own (bounded-queue) overflow
+        # drops transparently and bumps `gaps` only when the overflow
+        # window outran the watch cache — the in-process 410.
         seen_gaps = getattr(self._watcher, "gaps", 0)
         while not self._stop.is_set():
             gaps = getattr(self._watcher, "gaps", 0)

@@ -426,6 +426,25 @@ class Registry:
         return "\n".join(f.render() for f in self.families()) + "\n"
 
 
+def bucket_quantile(uppers: Sequence[float], counts: Sequence[int],
+                    q: float) -> float:
+    """Conservative quantile estimate from per-bucket (non-cumulative)
+    counts: the upper bound of the bucket the ``q``-th sample falls in
+    (``uppers[-1]`` doubled for the +Inf overflow slot).  ``counts`` has
+    ``len(uppers) + 1`` entries, the last being the overflow bucket.
+    Returns 0.0 with no samples."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    acc = 0.0
+    for i, c in enumerate(counts):
+        acc += c
+        if acc >= rank:
+            return float(uppers[i]) if i < len(uppers) else float(uppers[-1]) * 2
+    return float(uppers[-1]) * 2
+
+
 #: Process-global default registry — what ``GET /metrics`` serves.
 REGISTRY = Registry()
 

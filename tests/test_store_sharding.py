@@ -1,0 +1,527 @@
+"""Sharded store (PR 6): per-kind lock shards, write-time snapshots with
+copy-outside-the-lock reads, bounded watcher queues with overflow-resume,
+single-acquisition list_with_rv, the serde fast copier, and the
+FakeAPIServer's handler-level read concurrency.
+
+The invariants under test are the ones the shard rebuild must NOT change:
+everything in tests/test_watch_resume.py (replay exactly-once, per-kind
+ordering, 410 semantics) plus the new ones it adds — cross-kind
+independence, snapshot isolation, and zero-loss overflow recovery.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import Container, Pod, PodTemplateSpec
+from kubeflow_controller_tpu.api.meta import ObjectMeta
+from kubeflow_controller_tpu.api.tfjob import ReplicaType, TFJob, TFReplicaSpec
+from kubeflow_controller_tpu.cluster.apiserver import FakeAPIServer
+from kubeflow_controller_tpu.cluster.rest import Kubeconfig, RestCluster
+from kubeflow_controller_tpu.cluster.store import ADDED, ObjectStore
+from kubeflow_controller_tpu.obs.metrics import (
+    REGISTRY,
+    bucket_quantile,
+    validate_exposition,
+)
+from kubeflow_controller_tpu.utils import serde
+
+
+def mk_pod(name, ns="default", labels=None):
+    pod = Pod(metadata=ObjectMeta(name=name, namespace=ns))
+    pod.metadata.labels = labels or {}
+    return pod
+
+
+def mk_job(name):
+    job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+    t = PodTemplateSpec()
+    t.spec.containers.append(Container(name="tensorflow", image="img"))
+    t.spec.restart_policy = "OnFailure"
+    job.spec.tf_replica_specs.append(
+        TFReplicaSpec(replicas=2, tf_replica_type=ReplicaType.WORKER,
+                      template=t))
+    return job
+
+
+def wait_for(fn, timeout=15.0, interval=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+# ---------------------------------------------------------------------------
+# Shard independence
+# ---------------------------------------------------------------------------
+
+
+class TestShardIndependence:
+    def test_cross_kind_writers_never_block_each_other(self):
+        """A writer stalled inside one kind's critical section (patch_meta
+        holds the shard lock through its callback) must not delay writes
+        to another kind — the per-kind-locks contract, asserted on the
+        clock."""
+        s = ObjectStore()
+        s.create("pods", mk_pod("p"))
+        entered = threading.Event()
+
+        def slow_patch(meta):
+            entered.set()
+            time.sleep(0.5)
+            meta.labels["patched"] = "yes"
+
+        t = threading.Thread(
+            target=lambda: s.patch_meta("pods", "default", "p", slow_patch),
+            daemon=True)
+        t.start()
+        assert entered.wait(5.0)
+        t0 = time.perf_counter()
+        s.create("services", mk_pod("svc"))
+        s.get("services", "default", "svc")
+        s.list("services", "default")
+        elapsed = time.perf_counter() - t0
+        t.join(timeout=5.0)
+        assert elapsed < 0.25, (
+            f"cross-kind ops took {elapsed:.3f}s while pods shard was held")
+        # Sanity: the slow patch did land.
+        assert s.get("pods", "default", "p").metadata.labels["patched"] == "yes"
+
+    def test_global_lock_baseline_does_serialize(self):
+        """sharded=False is the pre-shard baseline: the same cross-kind
+        write DOES wait for the stalled shard (one lock for everything) —
+        the contrast store-smoke measures."""
+        s = ObjectStore(sharded=False)
+        s.create("pods", mk_pod("p"))
+        entered = threading.Event()
+
+        def slow_patch(meta):
+            entered.set()
+            time.sleep(0.4)
+
+        t = threading.Thread(
+            target=lambda: s.patch_meta("pods", "default", "p", slow_patch),
+            daemon=True)
+        t.start()
+        assert entered.wait(5.0)
+        t0 = time.perf_counter()
+        s.create("services", mk_pod("svc"))
+        elapsed = time.perf_counter() - t0
+        t.join(timeout=5.0)
+        assert elapsed > 0.2, "baseline store should have serialized"
+
+    def test_rv_still_globally_monotonic_across_kinds(self):
+        s = ObjectStore()
+        rvs = []
+        for i in range(5):
+            rvs.append(int(s.create("pods", mk_pod(f"p{i}"))
+                           .metadata.resource_version))
+            rvs.append(int(s.create("services", mk_pod(f"s{i}"))
+                           .metadata.resource_version))
+        assert rvs == sorted(rvs) and len(set(rvs)) == len(rvs)
+
+    def test_per_kind_replay_ordering_under_concurrent_cross_kind_writes(self):
+        """Writers hammering two kinds concurrently: each kind's replay is
+        exactly its own writes after the resume point, in per-kind write
+        order — cross-kind interleaving never leaks into a shard's ring."""
+        s = ObjectStore()
+        s.create("pods", mk_pod("seed-pod"))
+        s.create("services", mk_pod("seed-svc"))
+        _, since_pods = s.list_with_rv("pods")
+        _, since_svcs = s.list_with_rv("services")
+        written = {"pods": [], "services": []}
+        barrier = threading.Barrier(2)
+
+        def writer(kind):
+            barrier.wait()
+            for i in range(40):
+                out = s.create(kind, mk_pod(f"{kind}-{i:03d}"))
+                written[kind].append(int(out.metadata.resource_version))
+
+        threads = [threading.Thread(target=writer, args=(k,))
+                   for k in ("pods", "services")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+
+        for kind, since in (("pods", since_pods), ("services", since_svcs)):
+            w = s.watch(kind, since_rv=since)
+            try:
+                got = []
+                while len(got) < 40:
+                    ev = w.next(timeout=2.0)
+                    assert ev is not None, f"{kind}: replay ended early"
+                    got.append(int(ev.object.metadata.resource_version))
+                assert got == written[kind], f"{kind}: replay != write order"
+            finally:
+                w.stop()
+
+
+# ---------------------------------------------------------------------------
+# Snapshot isolation
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotIsolation:
+    def test_mutating_read_results_never_leaks_into_store(self):
+        s = ObjectStore()
+        s.create("pods", mk_pod("p", labels={"a": "1"}))
+        got = s.get("pods", "default", "p")
+        got.metadata.labels["evil"] = "yes"
+        got.status.phase = "Hacked"
+        listed = s.list("pods", "default")[0]
+        listed.metadata.labels["evil2"] = "yes"
+        fresh = s.get("pods", "default", "p")
+        assert "evil" not in fresh.metadata.labels
+        assert "evil2" not in fresh.metadata.labels
+        assert fresh.status.phase != "Hacked"
+
+    def test_mutating_caller_object_after_write_never_leaks(self):
+        """Write-time copy: the store snapshots on create/update, so the
+        caller keeping (and mutating) its handle cannot corrupt the store
+        OR any watch event already fanned out."""
+        s = ObjectStore()
+        w = s.watch("pods")
+        try:
+            p = mk_pod("p")
+            s.create("pods", p)
+            p.metadata.labels["late"] = "mutation"
+            ev = w.next(timeout=2.0)
+            assert ev is not None and ev.type == ADDED
+            assert "late" not in ev.object.metadata.labels
+            assert "late" not in s.get("pods", "default", "p").metadata.labels
+        finally:
+            w.stop()
+
+    def test_snapshot_reads_share_the_stored_object(self):
+        """get_snapshot/list_snapshot_with_rv are the zero-copy wire reads:
+        repeated calls hand back the SAME immutable snapshot (no copy),
+        while get() copies every time."""
+        s = ObjectStore()
+        s.create("pods", mk_pod("p"))
+        assert (s.get_snapshot("pods", "default", "p")
+                is s.get_snapshot("pods", "default", "p"))
+        assert s.get("pods", "default", "p") is not s.get("pods", "default", "p")
+        snap_items, _ = s.list_snapshot_with_rv("pods", "default")
+        assert snap_items[0] is s.get_snapshot("pods", "default", "p")
+        # A write swaps in a NEW snapshot; the old reference stays frozen.
+        old = s.get_snapshot("pods", "default", "p")
+        upd = s.get("pods", "default", "p")
+        upd.status.phase = "Running"
+        s.update("pods", upd)
+        assert old.status.phase != "Running"
+        assert s.get_snapshot("pods", "default", "p") is not old
+
+    def test_subresource_writes_are_copy_on_write(self):
+        """update_status/patch_meta/mark_deleting must never mutate the
+        stored snapshot in place — a reader holding the old reference sees
+        the old world forever."""
+        s = ObjectStore()
+        s.create("pods", mk_pod("p"))
+        before = s.get_snapshot("pods", "default", "p")
+        rv_before = before.metadata.resource_version
+        upd = s.get("pods", "default", "p")
+        upd.status.phase = "Running"
+        s.update_status("pods", upd)
+        s.patch_meta("pods", "default", "p",
+                     lambda m: m.labels.update({"x": "y"}))
+        s.mark_deleting("pods", "default", "p")
+        assert before.metadata.resource_version == rv_before
+        assert before.status.phase != "Running"
+        assert "x" not in before.metadata.labels
+        assert before.metadata.deletion_timestamp is None
+
+
+# ---------------------------------------------------------------------------
+# list_with_rv: snapshot + RV under one acquisition
+# ---------------------------------------------------------------------------
+
+
+def test_list_with_rv_never_drifts_from_snapshot_under_concurrent_writes():
+    """The RV must be a resume point for EXACTLY the returned snapshot:
+    names(snapshot) + names(replay after rv) == everything ever written,
+    with no overlap — for every interleaving a concurrent writer can
+    produce.  (The old implementation re-entered the lock via nested
+    list(), letting writes slip between snapshot and RV.)"""
+    s = ObjectStore()
+    stop = threading.Event()
+    written = []
+    n_max = 600  # stay inside the 1024-event watch cache so replays can't 410
+
+    def writer():
+        for i in range(n_max):
+            if stop.is_set():
+                return
+            s.create("pods", mk_pod(f"w{i:04d}"))
+            written.append(f"w{i:04d}")
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        snapshots = []
+        for _ in range(20):
+            snapshots.append(s.list_with_rv("pods"))
+            time.sleep(0.002)
+    finally:
+        stop.set()
+        t.join(timeout=10.0)
+
+    all_written = set(written)
+    for items, rv in snapshots:
+        names = {p.metadata.name for p in items}
+        assert all(int(p.metadata.resource_version) <= int(rv) for p in items)
+        w = s.watch("pods", since_rv=rv)
+        try:
+            replayed = set()
+            while True:
+                ev = w.next(timeout=0.05)
+                if ev is None:
+                    break
+                replayed.add(ev.object.metadata.name)
+        finally:
+            w.stop()
+        # Replay is verified after the writer stopped, so snapshot + replay
+        # must partition everything ever written: overlap means the RV ran
+        # ahead of the snapshot; a hole means a write slipped between them.
+        assert names.isdisjoint(replayed), "RV replays events already listed"
+        assert names | replayed == all_written, \
+            "a write fell between the snapshot and its RV"
+
+
+# ---------------------------------------------------------------------------
+# Bounded watcher queues: overflow -> dropped stream -> resume, zero loss
+# ---------------------------------------------------------------------------
+
+
+class TestBoundedWatchQueues:
+    def test_overflow_auto_resume_zero_loss_in_order(self):
+        """A slow in-process consumer overflows its bounded queue: the
+        store drops the stream, the next next() re-subscribes from the
+        last delivered RV and the watch cache replays the window — every
+        event arrives exactly once, in order, with no gap."""
+        s = ObjectStore()
+        w = s.watch("pods", max_queue=8)
+        n = 100
+        for i in range(n):
+            s.create("pods", mk_pod(f"p{i:03d}"))
+        got = []
+        while len(got) < n:
+            ev = w.next(timeout=2.0)
+            if ev is None:
+                break
+            got.append(ev.object.metadata.name)
+        w.stop()
+        assert got == [f"p{i:03d}" for i in range(n)]
+        assert w.gaps == 0
+        stats = s.lock_wait_stats()["pods"]
+        assert stats["overflows"] >= 1, "the bound never tripped"
+
+    def test_overflow_past_watch_cache_becomes_gap(self):
+        """If the overflow window outruns the bounded watch cache the
+        resume is impossible (the in-process 410): `gaps` bumps so cache
+        consumers know to re-list, then the stream is live again."""
+        s = ObjectStore(watch_cache_size=4)
+        w = s.watch("pods", max_queue=2)
+        for i in range(30):
+            s.create("pods", mk_pod(f"p{i:03d}"))
+        seen = 0
+        while w.next(timeout=0.2) is not None:
+            seen += 1
+        assert w.gaps >= 1
+        assert seen < 30, "everything arrived despite an evicted window?"
+        # Live again after the gap.
+        s.create("pods", mk_pod("after-gap"))
+        ev = wait_for(lambda: w.next(timeout=0.5))
+        assert ev.object.metadata.name == "after-gap"
+        w.stop()
+
+    def test_overflow_closes_non_resuming_stream_for_client_driven_resume(self):
+        """auto_resume=False (what the API server's stream handler uses):
+        overflow drains the buffered prefix then ends the stream with
+        `dropped` set; a NEW watch from the consumer's last RV replays the
+        rest — the server half of the REST reconnect contract."""
+        s = ObjectStore()
+        w = s.watch("pods", max_queue=5, auto_resume=False)
+        n = 40
+        for i in range(n):
+            s.create("pods", mk_pod(f"p{i:03d}"))
+        first, last_rv = [], 0
+        while True:
+            ev = w.next(timeout=0.5)
+            if ev is None:
+                break
+            first.append(ev.object.metadata.name)
+            last_rv = int(ev.object.metadata.resource_version)
+        assert w.dropped
+        assert 0 < len(first) < n
+        w.stop()
+        w2 = s.watch("pods", since_rv=str(last_rv))
+        rest = []
+        while len(first) + len(rest) < n:
+            ev = w2.next(timeout=2.0)
+            assert ev is not None, "replay ended before recovering the window"
+            rest.append(ev.object.metadata.name)
+        w2.stop()
+        assert first + rest == [f"p{i:03d}" for i in range(n)]
+
+    @pytest.mark.slow
+    def test_rest_e2e_server_overflow_reconnects_with_zero_loss(self):
+        """Full wire e2e: a slow REST consumer backpressures TCP until the
+        SERVER-side bounded watcher queue overflows; the server closes the
+        stream, the RV-resuming client reconnects, the watch cache replays
+        — every event exactly once, no informer-visible gap."""
+        store = ObjectStore(watch_queue_size=8)
+        server = FakeAPIServer(store)
+        url = server.start()
+        rest = RestCluster(Kubeconfig(server=url))
+        w = rest.pods.watch("default")
+        # Choke the client: its local queue now backpressures after 2
+        # events, stalling the chunked read so TCP fills server-side.
+        w.queue.maxsize = 2
+        n, blob = 150, "x" * 40_000  # big events defeat socket buffering
+        try:
+            for i in range(n):
+                store.create("pods", mk_pod(f"p{i:03d}",
+                                            labels={"blob": blob}))
+            wait_for(lambda: store.lock_wait_stats()["pods"]["overflows"] >= 1,
+                     timeout=30.0)
+            got = []
+            while len(got) < n:
+                ev = w.next(timeout=10.0)
+                assert ev is not None, (
+                    f"stream dried up at {len(got)}/{n} events")
+                got.append(ev.object.metadata.name)
+            assert got == [f"p{i:03d}" for i in range(n)]
+            assert w.gaps == 0, "resume degraded to a gap"
+        finally:
+            w.stop()
+            rest.close()
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# FakeAPIServer: handler-level read concurrency
+# ---------------------------------------------------------------------------
+
+
+def test_apiserver_parallel_lists_of_different_kinds_do_not_queue():
+    """A LIST of one kind stalled behind that kind's shard (writer holding
+    the lock) must not delay a LIST of another kind over HTTP — the
+    handler threads share no store lock."""
+    store = ObjectStore()
+    server = FakeAPIServer(store)
+    url = server.start()
+    rest = RestCluster(Kubeconfig(server=url))
+    try:
+        store.create("tfjobs", mk_job("j"))
+        for i in range(5):
+            store.create("pods", mk_pod(f"p{i}"))
+        entered = threading.Event()
+
+        def slow_patch(meta):
+            entered.set()
+            time.sleep(0.6)
+
+        t = threading.Thread(
+            target=lambda: store.patch_meta("tfjobs", "default", "j",
+                                            slow_patch),
+            daemon=True)
+        t.start()
+        assert entered.wait(5.0)
+        t0 = time.perf_counter()
+        pods = rest.pods.list("default")
+        elapsed = time.perf_counter() - t0
+        t.join(timeout=5.0)
+        assert len(pods) == 5
+        assert elapsed < 0.4, (
+            f"pods LIST waited {elapsed:.3f}s behind the tfjobs shard")
+    finally:
+        rest.close()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Serde fast path
+# ---------------------------------------------------------------------------
+
+
+class TestSerdeFastPath:
+    def _rich_job(self):
+        job = mk_job("rich")
+        job.metadata.labels = {"a": "1", "b": "2"}
+        job.metadata.annotations = {"note": "x" * 100}
+        job.spec.tf_replica_specs[0].template.spec.containers[0].command = [
+            "python", "-m", "train"]
+        return job
+
+    def test_fast_copy_matches_deepcopy(self):
+        job = self._rich_job()
+        fast = serde.deep_copy(job)
+        slow = serde.slow_deep_copy(job)
+        assert serde.to_dict(fast) == serde.to_dict(slow) == serde.to_dict(job)
+
+    def test_fast_copy_isolates_every_level(self):
+        job = self._rich_job()
+        cp = serde.deep_copy(job)
+        cp.metadata.labels["a"] = "mutated"
+        cp.spec.tf_replica_specs[0].replicas = 99
+        cp.spec.tf_replica_specs[0].template.spec.containers[0].command.append(
+            "--extra")
+        assert job.metadata.labels["a"] == "1"
+        assert job.spec.tf_replica_specs[0].replicas == 2
+        assert (job.spec.tf_replica_specs[0].template.spec.containers[0]
+                .command == ["python", "-m", "train"])
+
+    def test_fast_copy_preserves_enum_identity(self):
+        job = self._rich_job()
+        cp = serde.deep_copy(job)
+        assert cp.spec.tf_replica_specs[0].tf_replica_type is ReplicaType.WORKER
+
+    def test_str_enum_still_serializes_to_value(self):
+        # The to_dict scalar fast path must not catch str-subclassing enums.
+        d = serde.to_dict(self._rich_job())
+        assert d["spec"]["tfReplicaSpecs"][0]["tfReplicaType"] == "Worker"
+
+
+# ---------------------------------------------------------------------------
+# Lock-wait instrumentation
+# ---------------------------------------------------------------------------
+
+
+class TestLockWaitMetrics:
+    def test_lock_wait_stats_shape_and_counts(self):
+        s = ObjectStore()
+        for i in range(10):
+            s.create("pods", mk_pod(f"p{i}"))
+        s.list("pods")
+        stats = s.lock_wait_stats()
+        assert "pods" in stats
+        st = stats["pods"]
+        assert st["acquires"] >= 11
+        for key in ("contended", "overflows", "wait_sum_s", "wait_max_s",
+                    "p50_s", "p99_s"):
+            assert key in st
+
+    def test_store_families_render_and_validate(self):
+        s = ObjectStore()
+        s.create("pods", mk_pod("p"))
+        s.create("services", mk_pod("svc"))
+        text = REGISTRY.render()
+        assert validate_exposition(text) == [], validate_exposition(text)[:5]
+        assert "kctpu_store_lock_wait_seconds_bucket" in text
+        assert 'kctpu_store_shard_depth{kind="pods"}' in text
+        assert "kctpu_watch_queue_depth" in text
+        assert "kctpu_watch_queue_overflows_total" in text
+
+    def test_bucket_quantile(self):
+        uppers = (0.001, 0.01, 0.1)
+        assert bucket_quantile(uppers, [0, 0, 0, 0], 0.5) == 0.0
+        assert bucket_quantile(uppers, [10, 0, 0, 0], 0.99) == 0.001
+        assert bucket_quantile(uppers, [50, 49, 0, 1], 0.5) == 0.001
+        assert bucket_quantile(uppers, [50, 49, 0, 1], 0.99) == 0.01
+        assert bucket_quantile(uppers, [0, 0, 0, 5], 0.5) == 0.2  # +Inf slot
