@@ -27,6 +27,14 @@ Four modes:
   baseline (every reconnect is a gap: one full re-list per informer per
   drop); the default resumes from the last-seen resourceVersion against
   the server watch cache, so warm-RV reconnects re-list nothing.
+- ``--contend N``: **slice contention** — N TPU gang jobs competing for
+  ``--slices`` fake slices through the gang scheduler (priority queue +
+  preemption + backfill + warm readmission).  Reports time-to-first-step
+  p50/p99 per priority class, aggregate slice utilization, preemption and
+  backfill counts, and the warm-vs-cold readmission delta.  ``--no-sched``
+  is the first-come, no-preemption baseline (the bare gang inventory);
+  ``make sched-smoke`` gates high-priority TTFS p99 vs uncontended,
+  utilization, and zero starved gangs.
 - ``--scale N --store-contention``: **store contention** — the scale
   bench with syncs/sec as the headline plus per-shard lock-wait p50/p99
   from the store's timed acquisitions, followed by a direct store-stress
@@ -604,6 +612,316 @@ def run_churn(n_jobs: int, drops: int = 4, drop_interval_s: float = 0.4,
     }
 
 
+def _pct(values, q):
+    if not values:
+        return 0.0
+    vs = sorted(values)
+    return vs[min(len(vs) - 1, int(round(q / 100.0 * (len(vs) - 1))))]
+
+
+def run_contend(n_jobs: int, n_slices: int = 4, sched: bool = True,
+                preemption: bool = True, run_s: float = 0.5,
+                heartbeat_s: float = 0.05, cold_s: float = 0.3,
+                warm_s: float = 0.03, deadline_s: float = 0.0) -> dict:
+    """Slice contention: N gang jobs competing for M TPU slices.
+
+    Each job is one TPU replica spec (v5e-8, 2 hosts = a 2-pod gang on one
+    slice; job index 1 is a 2-slice multislice gang so backfill has a wide
+    head to work around).  Priority classes are assigned high / default /
+    low (roughly 1:4:3) and the HIGH jobs are created LAST — under the
+    first-come baseline they wait out the whole queue; under the scheduler
+    they jump it (and preempt running lower-priority gangs).
+
+    Simulated pods carry the capacity plane's startup model: a gang's
+    first admission pays ``cold_s`` of interpreter-import + rendezvous
+    (the cost docs/PERF.md measured at ~1.1s for real pods); a preempted
+    gang's readmission pays only ``warm_s`` (zygote fork + warm
+    rendezvous).  Heartbeats make time-to-first-step observable.
+
+    Reported: time-to-first-step p50/p99 per class (from job creation),
+    aggregate slice utilization over the storm window, preemption /
+    backfill / admission counts, warm-vs-cold start counts, and a
+    dedicated readmission probe (cold first-admission TTFS vs
+    warm-readmission TTFS after a forced preemption).
+
+    ``sched=False`` is the FIFO-no-preemption baseline (the bare
+    inventory's first-come gang admission)."""
+    from kubeflow_controller_tpu.api.core import Container, PodTemplateSpec
+    from kubeflow_controller_tpu.api.meta import ObjectMeta
+    from kubeflow_controller_tpu.api.tfjob import (
+        ReplicaType,
+        TFJob,
+        TFJobPhase,
+        TFReplicaSpec,
+        TPUSpec,
+    )
+    from kubeflow_controller_tpu.cluster import (
+        Cluster,
+        FakeKubelet,
+        PhasePolicy,
+        TPUInventory,
+        TPUSlice,
+    )
+    from kubeflow_controller_tpu.controller import Controller
+    from kubeflow_controller_tpu.obs.metrics import REGISTRY
+
+    def mk_tpu_job(name: str, cls: str, num_slices: int = 1) -> TFJob:
+        job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+        job.spec.priority_class_name = cls
+        t = PodTemplateSpec()
+        t.spec.containers.append(Container(name="tensorflow", image="img"))
+        t.spec.restart_policy = "OnFailure"
+        job.spec.tf_replica_specs = [TFReplicaSpec(
+            replicas=2 * num_slices, tf_replica_type=ReplicaType.TPU,
+            template=t,
+            tpu=TPUSpec(accelerator_type="v5e-8", num_hosts=2,
+                        num_slices=num_slices))]
+        return job
+
+    sched_counters = {
+        "preemptions": ("kctpu_sched_preemptions_total", ("priority_class",)),
+        "backfills": ("kctpu_sched_backfills_total", ()),
+        "admissions": ("kctpu_sched_admissions_total", ("priority_class",)),
+        "warm_cold": ("kctpu_pod_starts_total", ("mode",)),
+    }
+
+    def counter_totals() -> dict:
+        out = {}
+        for key, (name, labels) in sched_counters.items():
+            c = REGISTRY.counter(name, "", labels)
+            with c._lock:
+                out[key] = dict(c._values)
+        return out
+
+    def delta(after: dict, before: dict) -> dict:
+        out = {}
+        for key in after:
+            out[key] = {"/".join(k) or "total": v - before[key].get(k, 0.0)
+                        for k, v in after[key].items()
+                        if v - before[key].get(k, 0.0)}
+        return out
+
+    cluster = Cluster()
+    inv = TPUInventory([TPUSlice(f"slice-{i}", "v5e-8", num_hosts=2)
+                        for i in range(n_slices)])
+    inventory = inv
+    if sched:
+        from kubeflow_controller_tpu.scheduler import GangScheduler, SchedulerPolicy
+
+        inventory = GangScheduler(inv, SchedulerPolicy(preemption=preemption))
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(
+        run_s=run_s, heartbeat_s=heartbeat_s,
+        cold_start_s=cold_s, warm_start_s=warm_s), inventory=inventory)
+    ctrl = Controller(cluster, inventory=inventory, resync_period_s=1.0)
+    kubelet.start()
+    ctrl.run(threadiness=2)
+
+    def wait_deleted(name: str, timeout: float = 30.0):
+        end = time.time() + timeout
+        while time.time() < end:
+            try:
+                cluster.tfjobs.get("default", name)
+                time.sleep(0.02)
+            except Exception:
+                return
+
+    def ttfs_of(name: str, timeout: float, after_step_reset: bool = False,
+                t0: float = 0.0) -> float:
+        """Seconds from ``t0`` (default: now) until the job's progress shows
+        step >= 1; 0.0 on timeout."""
+        start = t0 or time.time()
+        end = time.time() + timeout
+        seen_reset = not after_step_reset
+        while time.time() < end:
+            j = cluster.tfjobs.get("default", name)
+            p = j.status.progress
+            if not seen_reset:
+                if p is None or p.step == 0:
+                    seen_reset = True
+            elif p is not None and p.step >= 1:
+                return time.time() - start
+            time.sleep(0.005)
+        return 0.0
+
+    classes = {}
+    try:
+        # --- uncontended probe: one job alone on an idle inventory -------
+        t0 = time.time()
+        cluster.tfjobs.create(mk_tpu_job("probe-uncontended", "high"))
+        uncontended_ttfs = ttfs_of("probe-uncontended", 30.0, t0=t0)
+        end = time.time() + 30
+        while time.time() < end:
+            if (cluster.tfjobs.get("default", "probe-uncontended").status.phase
+                    == TFJobPhase.SUCCEEDED):
+                break
+            time.sleep(0.02)
+        cluster.tfjobs.delete("default", "probe-uncontended")
+        wait_deleted("probe-uncontended")
+
+        # --- the storm: N jobs, high-priority ones created LAST ----------
+        names = []
+        for i in range(n_jobs):
+            cls = ("high" if i % 8 == 0
+                   else "default" if i % 2 else "low")
+            name = f"contend-{cls[0]}{i:03d}"
+            classes[name] = cls
+            names.append((name, cls, 2 if (i == 1 and n_slices >= 2) else 1))
+        names.sort(key=lambda x: x[1] == "high")  # high last
+        base = counter_totals()
+        busy0 = inv.busy_seconds()
+        t0 = time.time()
+        for name, cls, width in names:
+            cluster.tfjobs.create(mk_tpu_job(name, cls, num_slices=width))
+        if not deadline_s:
+            deadline_s = max(60.0, 4.0 * n_jobs * (run_s + cold_s) / n_slices + 30.0)
+
+        ttfs: dict = {}
+        done: dict = {}
+        failed = []
+        pending = {n for n, _, _ in names}
+        while pending and time.time() < t0 + deadline_s:
+            for j in cluster.tfjobs.list("default"):
+                name = j.metadata.name
+                if name not in classes:
+                    continue
+                p = j.status.progress
+                if name not in ttfs and p is not None and p.step >= 1:
+                    ttfs[name] = time.time() - t0
+                if name in pending and j.status.phase in (
+                        TFJobPhase.SUCCEEDED, TFJobPhase.FAILED):
+                    pending.discard(name)
+                    done[name] = time.time() - t0
+                    if j.status.phase == TFJobPhase.FAILED:
+                        failed.append(name)
+            if pending:
+                time.sleep(0.01)
+        elapsed = max(done.values()) if done else time.time() - t0
+        busy1 = inv.busy_seconds()
+        utilization = ((busy1 - busy0) / (n_slices * elapsed)) if elapsed else 0.0
+        counters = delta(counter_totals(), base)
+        preempted_jobs = {
+            e.object_key.split("/", 1)[1]
+            for e in ctrl.recorder.all_events()
+            if e.reason == "GangPreempted" and
+            e.object_key.split("/", 1)[1] in classes}
+
+        by_class: dict = {}
+        for name, t in ttfs.items():
+            by_class.setdefault(classes[name], []).append(t)
+
+        # --- readmission probe: forced preempt, then warm readmit --------
+        cold_admit_ttfs = warm_readmit_ttfs = 0.0
+        if sched and preemption:
+            for n, _, _ in names:
+                cluster.tfjobs.delete("default", n)
+            for n, _, _ in names:
+                wait_deleted(n)
+            t0 = time.time()
+            cluster.tfjobs.create(mk_tpu_job("probe-victim", "low"))
+            cold_admit_ttfs = ttfs_of("probe-victim", 30.0, t0=t0)
+            # A slice-wide high gang forces the victim off the machine.
+            cluster.tfjobs.create(
+                mk_tpu_job("probe-preemptor", "high", num_slices=n_slices))
+            end = time.time() + 60
+            while time.time() < end:
+                if (cluster.tfjobs.get("default", "probe-preemptor").status.phase
+                        == TFJobPhase.SUCCEEDED):
+                    break
+                time.sleep(0.01)
+            # Slices just freed: the victim readmits from the warm pool.
+            warm_readmit_ttfs = ttfs_of("probe-victim", 30.0,
+                                        after_step_reset=False)
+    finally:
+        ctrl.stop()
+        kubelet.stop()
+
+    return {
+        "jobs": n_jobs,
+        "slices": n_slices,
+        "sched": sched,
+        "preemption": preemption,
+        "elapsed_s": elapsed,
+        "uncontended_ttfs_s": uncontended_ttfs,
+        "ttfs_by_class": {
+            cls: {"n": len(v), "p50_s": _pct(v, 50), "p99_s": _pct(v, 99)}
+            for cls, v in sorted(by_class.items())},
+        "utilization": utilization,
+        "counters": counters,
+        "preempted_jobs": sorted(preempted_jobs),
+        "cold_admit_ttfs_s": cold_admit_ttfs,
+        "warm_readmit_ttfs_s": warm_readmit_ttfs,
+        "starved": sorted(pending),
+        "failed": failed,
+    }
+
+
+def contend_main(args) -> int:
+    result = run_contend(args.contend, n_slices=args.slices,
+                         sched=not args.no_sched,
+                         preemption=not args.no_preemption,
+                         deadline_s=args.deadline)
+    high = result["ttfs_by_class"].get("high", {"p50_s": 0.0, "p99_s": 0.0})
+    uncontended = result["uncontended_ttfs_s"]
+    ratio = (high["p99_s"] / uncontended) if uncontended else 0.0
+    print(json.dumps({
+        "metric": (f"contend_{result['jobs']}_jobs_{result['slices']}"
+                   f"_slices_high_ttfs_p99"),
+        "value": round(high["p99_s"], 3),
+        "unit": "s",
+        "details": {
+            "jobs": result["jobs"],
+            "slices": result["slices"],
+            "sched": result["sched"],
+            "preemption": result["preemption"],
+            "elapsed_s": round(result["elapsed_s"], 3),
+            "uncontended_ttfs_s": round(uncontended, 3),
+            "high_ttfs_ratio_vs_uncontended": round(ratio, 2),
+            "ttfs_by_class": {
+                cls: {"n": d["n"], "p50_s": round(d["p50_s"], 3),
+                      "p99_s": round(d["p99_s"], 3)}
+                for cls, d in result["ttfs_by_class"].items()},
+            "utilization": round(result["utilization"], 3),
+            "counters": result["counters"],
+            "preempted_jobs": result["preempted_jobs"],
+            "cold_admit_ttfs_s": round(result["cold_admit_ttfs_s"], 3),
+            "warm_readmit_ttfs_s": round(result["warm_readmit_ttfs_s"], 3),
+            "starved": result["starved"],
+            "failed": result["failed"],
+            "workload": ("N x 2-pod v5e-8 TPU gangs (one 2-slice wide gang) "
+                         "competing for M slices; simulated pods with "
+                         "cold/warm start model; high-priority jobs "
+                         "submitted last"),
+        },
+    }))
+    rc = 0
+    if result["starved"] or result["failed"]:
+        print(f"contend bench: {len(result['starved'])} starved, "
+              f"{len(result['failed'])} failed gangs", file=sys.stderr)
+        rc = 1
+    if args.max_ttfs_ratio > 0 and result["sched"]:
+        if not uncontended or ratio > args.max_ttfs_ratio:
+            print(f"contend bench regression: high-priority TTFS p99 "
+                  f"{high['p99_s']:.3f}s is {ratio:.2f}x uncontended "
+                  f"({uncontended:.3f}s) > --max-ttfs-ratio "
+                  f"{args.max_ttfs_ratio}", file=sys.stderr)
+            rc = 1
+    if args.min_utilization > 0 and result["utilization"] < args.min_utilization:
+        print(f"contend bench regression: slice utilization "
+              f"{result['utilization']:.3f} < --min-utilization "
+              f"{args.min_utilization}", file=sys.stderr)
+        rc = 1
+    if (result["sched"] and result["preemption"]
+            and result["warm_readmit_ttfs_s"]
+            and result["cold_admit_ttfs_s"]
+            and result["warm_readmit_ttfs_s"] >= result["cold_admit_ttfs_s"]):
+        print(f"contend bench regression: warm readmission TTFS "
+              f"{result['warm_readmit_ttfs_s']:.3f}s not below cold "
+              f"admission {result['cold_admit_ttfs_s']:.3f}s",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def churn_main(args) -> int:
     result = run_churn(args.churn, drops=args.drops,
                        drop_interval_s=args.drop_interval,
@@ -863,6 +1181,25 @@ def main(argv=None) -> int:
     p.add_argument("--manage-workers", type=int, default=8, metavar="W",
                    help="replicas mode: controller manage fan-out "
                         "(1 = serial plan execution, the baseline)")
+    p.add_argument("--contend", type=int, default=0, metavar="N",
+                   help="run the slice-contention benchmark: N TPU gang "
+                        "jobs competing for --slices slices (time-to-first-"
+                        "step p50/p99 per priority class, utilization, "
+                        "preemption counts, warm-vs-cold readmission)")
+    p.add_argument("--slices", type=int, default=4, metavar="M",
+                   help="contend mode: TPU slices in the inventory")
+    p.add_argument("--no-sched", action="store_true",
+                   help="contend mode: first-come gang admission baseline "
+                        "(no priority queue / preemption / backfill)")
+    p.add_argument("--no-preemption", action="store_true",
+                   help="contend mode: priority queue without eviction")
+    p.add_argument("--max-ttfs-ratio", type=float, default=0.0, metavar="R",
+                   help="contend mode: exit nonzero when high-priority TTFS "
+                        "p99 exceeds R x the uncontended TTFS (the `make "
+                        "sched-smoke` gate)")
+    p.add_argument("--min-utilization", type=float, default=0.0, metavar="U",
+                   help="contend mode: exit nonzero when aggregate slice "
+                        "utilization over the storm window is below U")
     p.add_argument("--churn", type=int, default=0, metavar="N",
                    help="run the watch-plane churn benchmark: N simulated "
                         "TFJobs over the REST transport with every watch "
@@ -920,6 +1257,8 @@ def main(argv=None) -> int:
         return widejob_main(args)
     if args.churn:
         return churn_main(args)
+    if args.contend:
+        return contend_main(args)
 
     import shutil
     import tempfile

@@ -28,6 +28,9 @@ ANNOTATION_ACCELERATOR = f"{DOMAIN}/accelerator-type"
 # belongs to (pods are placed per-slice; DCN connects slices).
 ANNOTATION_NUM_SLICES = f"{DOMAIN}/num-slices"
 ANNOTATION_SLICE_INDEX = f"{DOMAIN}/slice-index"
+# Scheduling priority class (spec.priorityClassName, stamped per pod so the
+# gang scheduler reads it at admission time): "low" | "default" | "high".
+ANNOTATION_PRIORITY_CLASS = f"{DOMAIN}/priority-class"
 
 
 def selector_for(job_name: str, replica_type: str, runtime_id: str) -> dict:

@@ -198,6 +198,11 @@ class TFJobSpec:
     model_dir: str = ""
     log_dir: str = ""
     export_dir: str = ""
+    # Net-new (capacity plane): scheduling priority class for the job's
+    # gang — "low" | "default" | "high" ("" = default).  Higher classes are
+    # admitted first under slice contention and may preempt strictly lower
+    # ones (scheduler/).
+    priority_class_name: str = ""
     tf_replica_specs: List[TFReplicaSpec] = field(default_factory=list)
 
 
@@ -316,6 +321,10 @@ def validate_tfjob(job: TFJob) -> None:
     gn = job.metadata.generate_name
     if gn and not re.match(r"^[a-z0-9]([-a-z0-9]*)?$", gn):
         raise ValidationError(f"metadata.generateName {gn!r} is not a DNS-1123 prefix")
+    if job.spec.priority_class_name not in ("", "low", "default", "high"):
+        raise ValidationError(
+            f"unknown priorityClassName {job.spec.priority_class_name!r} "
+            "(want low | default | high)")
     specs = job.spec.tf_replica_specs
     if not specs:
         raise ValidationError("spec.tfReplicaSpecs must be non-empty")

@@ -93,12 +93,19 @@ def cmd_validate(args) -> int:
 
 def _build_substrate(args, cluster):
     """The fake-cluster node side shared by `serve` and `run --in-memory`:
-    TPU inventory from the flags + a kubelet driving the given cluster."""
+    TPU inventory from the flags, wrapped in the gang scheduler (priority
+    queue + preemption + backfill; `--no-sched` keeps the first-come
+    baseline), + a kubelet driving the given cluster."""
     slices = [
         TPUSlice(f"slice-{i}", args.tpu_slice_type, num_hosts=args.tpu_slice_hosts)
         for i in range(args.tpu_slices)
     ]
     inventory = TPUInventory(slices)
+    if not getattr(args, "no_sched", False):
+        from ..scheduler import GangScheduler, SchedulerPolicy
+
+        inventory = GangScheduler(inventory, SchedulerPolicy(
+            preemption=not getattr(args, "no_preemption", False)))
     kubelet = FakeKubelet(
         cluster,
         policy=PhasePolicy(run_s=args.sim_run_seconds),
@@ -193,8 +200,8 @@ def cmd_get(args) -> int:
     if not jobs:
         print("No resources found.")
         return 0
-    print(f"{'NAMESPACE':<12} {'NAME':<32} {'PHASE':<12} {'STEP':<10} "
-          f"{'RATE':<10} REPLICAS")
+    print(f"{'NAMESPACE':<12} {'NAME':<32} {'PHASE':<12} {'REASON':<28} "
+          f"{'STEP':<10} {'RATE':<10} REPLICAS")
     for j in jobs:
         kinds = ",".join(
             f"{s.tf_replica_type.value}x{s.replicas}" for s in j.spec.tf_replica_specs
@@ -203,9 +210,15 @@ def cmd_get(args) -> int:
         # in this state until a running controller processes its finalizer).
         phase = ("Terminating" if j.metadata.deletion_timestamp is not None
                  else j.status.phase.value)
+        # Why a Pending job is pending: queue position under slice
+        # contention ("GangQueued: position 2/5 ..."), else any status
+        # reason, compacted to the column.
+        reason = (j.status.reason or "-").replace("GangQueued: ", "queued: ")
+        if len(reason) > 27:
+            reason = reason[:26] + "…"
         step, rate = _progress_cells(j)
         print(f"{j.metadata.namespace:<12} {j.metadata.name:<32} "
-              f"{phase:<12} {step:<10} {rate:<10} {kinds}")
+              f"{phase:<12} {reason:<28} {step:<10} {rate:<10} {kinds}")
     return 0
 
 
@@ -231,8 +244,11 @@ def cmd_describe(args) -> int:
     print(f"RuntimeID: {j.spec.runtime_id}")
     print(f"Phase:     {j.status.phase.value}"
           + (f"  ({j.status.reason})" if j.status.reason else ""))
+    if j.status.reason.startswith("GangQueued"):
+        print(f"Queue:     {j.status.reason}")
     for c in j.status.conditions:
-        print(f"Condition: {c.type.value}={c.status} {c.reason}")
+        msg = f"  {c.message}" if c.reason in ("GangQueued", "GangPreempted") and c.message else ""
+        print(f"Condition: {c.type.value}={c.status} {c.reason}{msg}")
     for rs in j.status.tf_replica_statuses:
         hist = {k.value: v for k, v in rs.tf_replicas_states.items()}
         print(f"Replicas:  {rs.type.value}: state={rs.state.value} {hist}")
@@ -574,6 +590,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--tpu-slices", type=int, default=1)
     s.add_argument("--tpu-slice-type", default="v5e-8")
     s.add_argument("--tpu-slice-hosts", type=int, default=2)
+    s.add_argument("--no-sched", action="store_true",
+                   help="first-come gang admission (no priority queue/"
+                        "preemption/backfill) — the scheduler baseline")
+    s.add_argument("--no-preemption", action="store_true",
+                   help="keep the priority queue but never evict running gangs")
     s.add_argument("-v", type=int, default=0)
 
     v = sub.add_parser("validate", help="validate TFJob manifests")
@@ -639,6 +660,11 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--tpu-slices", type=int, default=1, help="fake TPU slices in inventory")
     r.add_argument("--tpu-slice-type", default="v5e-8")
     r.add_argument("--tpu-slice-hosts", type=int, default=2)
+    r.add_argument("--no-sched", action="store_true",
+                   help="first-come gang admission (no priority queue/"
+                        "preemption/backfill) — the scheduler baseline")
+    r.add_argument("--no-preemption", action="store_true",
+                   help="keep the priority queue but never evict running gangs")
     r.add_argument("-v", type=int, default=0, help="log verbosity (glog parity)")
     return p
 

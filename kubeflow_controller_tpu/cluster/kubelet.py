@@ -52,6 +52,15 @@ class PhasePolicy:
     run_forever_types: tuple = ("PS",)
     # Pod names to fail once (fault injection for recovery tests).
     fail_once: Set[str] = field(default_factory=set)
+    # Simulated startup cost for TPU gang pods (the interpreter-import +
+    # rendezvous analog the warm-pool zygote amortizes for executed pods):
+    # the FIRST admission of a gang on this node pays ``cold_start_s``
+    # extra Pending time; a READMISSION (preempted gang coming back) pays
+    # only ``warm_start_s`` — its processes fork from the still-warm pool
+    # and rejoin a known rendezvous.  Both 0 by default (no change for
+    # tests that predate the capacity plane).
+    cold_start_s: float = 0.0
+    warm_start_s: float = 0.0
     # Simulated training-plane heartbeat interval: > 0 makes simulated
     # (non-PS) pods publish advancing PodProgress beats while Running —
     # the progress-plane analog of the phase clock.  0 = silent (default:
@@ -96,10 +105,26 @@ class FakeKubelet:
         self._svc_ports: Dict[str, int] = {}
         self._svc_lock = threading.Lock()
         self._warm: Dict[str, object] = {}
-        # Pod keys whose failure was injected (fail_slice): the drive loop
-        # must not restart them in place — the slice is gone; replacement
-        # is the controller's job.
+        # Pod keys whose failure was injected (fail_slice / preemption):
+        # the drive loop must not restart them in place — the slice is
+        # gone; replacement is the controller's job.
         self._injected_failures: Set[str] = set()
+        # Gangs that have run on this node before: their readmission is
+        # warm (see PhasePolicy.cold_start_s/warm_start_s).
+        self._warm_gangs: Set[str] = set()
+        # Warm/cold pod-start telemetry (the warm-readmission evidence the
+        # contention bench reports).
+        from ..obs.metrics import REGISTRY
+
+        self._c_starts = REGISTRY.counter(
+            "kctpu_pod_starts_total",
+            "Pod process starts by mode (warm = forked from the zygote / "
+            "warm gang readmission; cold = fresh interpreter)", ("mode",))
+        # A scheduler-shaped inventory (GangScheduler) needs us as the
+        # eviction executor: preempted pods' processes are killed and the
+        # pods marked Failed here, exactly like a slice failure.
+        if inventory is not None and hasattr(inventory, "set_evictor"):
+            inventory.set_evictor(self._evict_pods)
         # Pod log files (kubectl-logs analog): key -> list of file paths in
         # chronological order (one per restart / warm spawn).
         import tempfile
@@ -384,19 +409,30 @@ class FakeKubelet:
 
     def _drive(self, pod: Pod) -> None:
         ns, name = pod.metadata.namespace, pod.metadata.name
-        # TPU pods wait in Pending for gang admission.
+        key = self._key(pod)
+        # TPU pods wait in Pending for gang admission.  With a scheduler
+        # as the inventory, the wait is queue-ordered and the queue state
+        # is published as the pod's Pending reason (so the controller and
+        # CLI can surface "why is this job not running" in any process).
         if self.inventory is not None and pod_requests_tpu(pod):
-            while not self._stop.is_set():
-                if self.inventory.offer(pod):
-                    break
-                time.sleep(0.005)
-                if self._gone(ns, name):
-                    return
-            if self._stop.is_set():
+            if not self._gate_tpu_pod(pod):
+                return
+            if key in self._injected_failures:
+                # Preempted / slice-failed between admission and start:
+                # the phase is already Failed, never run.
+                self._injected_failures.discard(key)
+                return
+            started = getattr(self.inventory, "pod_started", None)
+            if started is not None:
+                started(pod)  # releases the gang's coordinator-first hold
+            if not self._start_delay(pod):
                 return
         if self.policy.pending_s:
             time.sleep(self.policy.pending_s)
         if self._gone(ns, name):
+            return
+        if key in self._injected_failures:
+            self._injected_failures.discard(key)
             return
         self.set_phase(ns, name, PHASE_RUNNING)
         if self.execute and pod.spec.containers and (
@@ -405,6 +441,78 @@ class FakeKubelet:
             self._execute(pod)
         else:
             self._simulate(pod)
+
+    def _gate_tpu_pod(self, pod: Pod) -> bool:
+        """Poll the inventory/scheduler until the pod's gang is admitted.
+        Returns False when the pod went away (or we are stopping).  While
+        queued, the scheduler's queue position is mirrored into the
+        Pending pod's status.reason (rate-limited to changes)."""
+        from ..api.labels import ANNOTATION_GANG_NAME
+
+        ns, name = pod.metadata.namespace, pod.metadata.name
+        gang = pod.metadata.annotations.get(ANNOTATION_GANG_NAME, "")
+        queue_info = getattr(self.inventory, "queue_info", None)
+        last_reason = ""
+        ticks = 0
+        while not self._stop.is_set():
+            if self.inventory.offer(pod):
+                return True
+            ticks += 1
+            if queue_info is not None and gang and ticks % 10 == 1:
+                reason = queue_info(gang)
+                if reason and reason != last_reason:
+                    last_reason = reason
+                    self.set_phase(ns, name, PHASE_PENDING, reason=reason)
+            time.sleep(0.005)
+            if self._gone(ns, name):
+                return False
+        return False
+
+    def _start_delay(self, pod: Pod) -> bool:
+        """Simulated warm/cold start cost for admitted TPU gang pods (the
+        zygote/import analog; executed pods pay their real costs instead).
+        Returns False when the pod vanished mid-delay."""
+        from ..api.labels import ANNOTATION_GANG_NAME
+
+        if self.execute and pod.spec.containers and (
+            pod.spec.containers[0].command or pod.spec.containers[0].args
+        ):
+            return True  # real process: real costs, counted at spawn time
+        ns, name = pod.metadata.namespace, pod.metadata.name
+        gang = pod.metadata.annotations.get(ANNOTATION_GANG_NAME, "") or self._key(pod)
+        warm = gang in self._warm_gangs
+        self._c_starts.labels("warm" if warm else "cold").inc()
+        delay = self.policy.warm_start_s if warm else self.policy.cold_start_s
+        deadline = time.monotonic() + delay
+        while delay > 0 and not self._stop.is_set():
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            time.sleep(min(0.02, remaining))
+            if self._gone(ns, name) or self._key(pod) in self._injected_failures:
+                return False
+        self._warm_gangs.add(gang)
+        return not self._stop.is_set()
+
+    def _evict_pods(self, pod_keys, reason: str) -> None:
+        """Preemption executor (registered with the gang scheduler): kill
+        the victim gang's processes and mark its pods Failed with a reason
+        naming the preemptor — the same flow a slice failure takes, so the
+        controller's whole-gang replacement handles readmission."""
+        keys = set(pod_keys)
+        for pod in self.cluster.pods.list():
+            key = self._key(pod)
+            if key not in keys:
+                continue
+            self._injected_failures.add(key)
+            proc = self._procs.get(key)
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+            warm = self._warm.get(key)
+            if warm is not None and self._pool is not None:
+                self._pool.kill(warm)
+            self.set_phase(pod.metadata.namespace, pod.metadata.name,
+                           PHASE_FAILED, reason=reason)
 
     def fail_slice(self, slice_name: str, reason: str = "SliceFailed") -> list:
         """Inject a whole-slice failure — the TPU failure domain (SURVEY §5):
@@ -577,6 +685,7 @@ class FakeKubelet:
                 except OSError as e:
                     self.set_phase(ns, name, PHASE_FAILED, reason=f"StartError: {e}")
                     return
+                self._c_starts.labels("cold").inc()
                 self._procs[self._key(pod)] = proc
                 proc.wait()
             finally:
@@ -614,6 +723,7 @@ class FakeKubelet:
                 except OSError as e:
                     self.set_phase(ns, name, PHASE_FAILED, reason=f"StartError: {e}")
                     return
+                self._c_starts.labels("warm").inc()
                 self._warm[key] = proc
                 # Register the pool's files as this pod's logs.
                 self._log_paths.setdefault(key, []).extend(

@@ -10,6 +10,7 @@ the reference fakes its cluster (SURVEY.md §4).
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -33,6 +34,9 @@ class TPUSlice:
     # False once the slice has failed: never admits another gang (the fake
     # analog of a cordoned node pool).
     healthy: bool = True
+    # Wall-clock of the current binding (0 = free); feeds the utilization
+    # accounting the contention bench and kctpu_slice_utilization read.
+    bound_at: float = 0.0
 
 
 @dataclass
@@ -70,10 +74,22 @@ class TPUInventory:
         # Gangs seen idle by the last release_idle_gangs scan (two-scan
         # confirmation guards the snapshot race — see release_idle_gangs).
         self._idle_candidates: set = set()
+        # Bumped on every bind/release/failure: the cheap "capacity may
+        # have changed" signal the gang scheduler polls instead of
+        # re-running its admission pass on every offer.
+        self._version = 0
+        # Accumulated slice-busy seconds of COMPLETED bindings; in-flight
+        # bindings are added at read time (busy_seconds).
+        self._busy_s = 0.0
+
+    @property
+    def version(self) -> int:
+        return self._version
 
     def add_slice(self, s: TPUSlice) -> None:
         with self._lock:
             self.slices[s.name] = s
+            self._version += 1
 
     def offer(self, pod: Pod) -> bool:
         """Offer a TPU pod for scheduling.  Returns True iff the pod's gang is
@@ -100,10 +116,82 @@ class TPUInventory:
             found = self._find_free_slices(accel, gang.num_slices)
             if found is None:
                 return False  # complete but no capacity: hold (no partial admission)
-            for sl in found:
-                sl.bound_gang = gang_name
-            gang.slice_names = [sl.name for sl in found]
+            self._bind_locked(gang, found)
             return True
+
+    def _bind_locked(self, gang: _Gang, found: List[TPUSlice]) -> None:
+        now = time.time()
+        for sl in found:
+            sl.bound_gang = gang.name
+            sl.bound_at = now
+        gang.slice_names = [sl.name for sl in found]
+        self._version += 1
+
+    def _unbind_locked(self, sl: TPUSlice) -> None:
+        if sl.bound_at:
+            self._busy_s += max(0.0, time.time() - sl.bound_at)
+        sl.bound_gang = ""
+        sl.bound_at = 0.0
+        self._version += 1
+
+    # -- scheduler front door ------------------------------------------------
+
+    def bind_gang(self, gang_name: str, accelerator_type: str,
+                  n_slices: int = 1, size: int = 0,
+                  pods: Optional[Dict[str, Pod]] = None) -> Optional[List[str]]:
+        """Atomically bind ``n_slices`` free healthy slices to the gang, or
+        None if fewer exist — the admission primitive the gang scheduler
+        drives (``offer`` keeps the first-come baseline semantics around
+        it).  ``pods`` seeds the gang's member map so ``fail_slice`` /
+        ``release_idle_gangs`` keep working for scheduler-bound gangs."""
+        with self._lock:
+            found = self._find_free_slices(accelerator_type, n_slices)
+            if found is None:
+                return None
+            gang = self._gangs.setdefault(
+                gang_name,
+                _Gang(gang_name, size or (len(pods) if pods else 1),
+                      accelerator_type, num_slices=n_slices))
+            if pods:
+                gang.pods.update(pods)
+            self._bind_locked(gang, found)
+            return list(gang.slice_names)
+
+    def has_free_slice(self, accelerator_type: str = "") -> bool:
+        with self._lock:
+            return self._find_free_slices(accelerator_type, 1) is not None
+
+    def free_slice_count(self, accelerator_type: str = "") -> int:
+        with self._lock:
+            return sum(
+                1 for s in self.slices.values()
+                if not s.bound_gang and s.healthy
+                and (not accelerator_type or s.accelerator_type == accelerator_type)
+            )
+
+    def gang_on_slice(self, slice_name: str) -> str:
+        with self._lock:
+            sl = self.slices.get(slice_name)
+            return sl.bound_gang if sl else ""
+
+    def busy_seconds(self) -> float:
+        """Total slice-busy seconds across all slices ever bound — completed
+        bindings plus the in-flight ones.  The contention bench differences
+        two readings to compute utilization over a window."""
+        now = time.time()
+        with self._lock:
+            return self._busy_s + sum(
+                max(0.0, now - s.bound_at)
+                for s in self.slices.values() if s.bound_gang and s.bound_at)
+
+    def utilization_now(self) -> float:
+        """Instantaneous bound fraction of healthy slices (the
+        kctpu_slice_utilization gauge callback)."""
+        with self._lock:
+            healthy = [s for s in self.slices.values() if s.healthy]
+            if not healthy:
+                return 0.0
+            return sum(1 for s in healthy if s.bound_gang) / len(healthy)
 
     def _find_free_slices(self, accelerator_type: str,
                           n: int) -> Optional[List[TPUSlice]]:
@@ -135,7 +223,7 @@ class TPUInventory:
             g = self._gangs.pop(gang_name, None)
             for name in (g.slice_names if g else []):
                 if name in self.slices:
-                    self.slices[name].bound_gang = ""
+                    self._unbind_locked(self.slices[name])
 
     def release_idle_gangs(self, active_pod_keys) -> List[str]:
         """Release every gang none of whose member pods is still active —
@@ -179,10 +267,15 @@ class TPUInventory:
             if sl is None:
                 return []
             sl.healthy = False
+            self._version += 1
             if not sl.bound_gang:
                 return []
             g = self._gangs.pop(sl.bound_gang, None)
             for name in (g.slice_names if g else [sl.name]):
                 if name in self.slices:
-                    self.slices[name].bound_gang = ""
+                    self._unbind_locked(self.slices[name])
             return list(g.pods.keys()) if g else []
+
+
+# The name the capacity-plane docs/ISSUE use; same class.
+TPUSliceInventory = TPUInventory

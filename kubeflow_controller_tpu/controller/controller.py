@@ -61,6 +61,9 @@ from ..utils import serde
 from ..utils.names import generate_runtime_id
 from .events import (
     EventRecorder,
+    REASON_GANG_ADMITTED,
+    REASON_GANG_PREEMPTED,
+    REASON_GANG_QUEUED,
     REASON_TRAINING_RESUMED,
     REASON_TRAINING_STALLED,
     TYPE_NORMAL,
@@ -117,6 +120,10 @@ class Controller:
         # level-triggered in status).
         self._stalled: Dict[str, frozenset] = {}
         self._stalled_lock = threading.Lock()
+        # Per-job gang scheduling state ("queued"/"admitted"/"preempted")
+        # from the LAST sync, for edge-triggered GangQueued/GangAdmitted/
+        # GangPreempted events (shares the stalled lock — same cadence).
+        self._gang_state: Dict[str, str] = {}
         # Job-level progress gauges (namespace+job labels; series removed
         # on job deletion — see _drop_progress_series).
         self._g_step = REGISTRY.gauge(
@@ -417,6 +424,7 @@ class Controller:
         new_status = compute_status(job, pods_by_type,
                                     tracker=self.stall_tracker)
         self._publish_progress(key, job, new_status)
+        self._publish_gang_state(key, job, pods_by_type)
         if should_update(job.status, new_status):
             self._update_status(job, new_status)
 
@@ -469,6 +477,52 @@ class Controller:
                 f"training resumed on replica {', '.join(recovered)} "
                 f"(step {progress.step})")
 
+    def _publish_gang_state(self, key: str, job: TFJob, pods_by_type) -> None:
+        """Capacity-plane audit events, edge-triggered on the gang's
+        scheduling state as observed through pod status (works in any
+        deployment shape — the scheduler publishes queue state as the
+        Pending pods' reason, preemption as the Failed pods' reason):
+
+        - ``Normal GangQueued`` with the queue position and why,
+        - ``Normal GangAdmitted`` once the gang is on slices and running,
+        - ``Warning GangPreempted`` naming the preemptor."""
+        from ..api.core import PHASE_FAILED, PHASE_PENDING, PHASE_RUNNING
+
+        if not is_tpu_job(job):
+            return
+        pods = pods_by_type.get(ReplicaType.TPU, [])
+        queue_msg = next(
+            (p.status.reason for p in pods
+             if p.status.phase == PHASE_PENDING
+             and (p.status.reason or "").startswith("GangQueued")), "")
+        preempt_msg = next(
+            (p.status.reason for p in pods
+             if p.status.phase == PHASE_FAILED
+             and (p.status.reason or "").startswith("Preempted")), "")
+        running = sum(1 for p in pods if p.status.phase == PHASE_RUNNING)
+        if preempt_msg:
+            state = "preempted"
+        elif queue_msg:
+            state = "queued"
+        elif running and running == len(pods) and pods:
+            state = "admitted"
+        else:
+            return  # indeterminate: keep the last edge
+        with self._stalled_lock:
+            if self._gang_state.get(key) == state:
+                return
+            self._gang_state[key] = state
+        if state == "queued":
+            self.recorder.event(job, TYPE_NORMAL, REASON_GANG_QUEUED, queue_msg)
+        elif state == "admitted":
+            self.recorder.event(
+                job, TYPE_NORMAL, REASON_GANG_ADMITTED,
+                f"gang {gang_name(job)} admitted: {running} pods running "
+                f"on slices {self.inventory.gang_slices(gang_name(job)) if self.inventory else '?'}")
+        else:
+            self.recorder.event(job, TYPE_WARNING, REASON_GANG_PREEMPTED,
+                                preempt_msg)
+
     def _drop_progress_series(self, key: str, job: TFJob) -> None:
         """Per-job gauge series + stall bookkeeping die with the job."""
         from .helper import OWNER_UID_INDEX
@@ -478,6 +532,7 @@ class Controller:
             g.remove(ns, name)
         with self._stalled_lock:
             self._stalled.pop(key, None)
+            self._gang_state.pop(key, None)
         if job.metadata.uid:
             for p in self.pod_informer.by_index(OWNER_UID_INDEX,
                                                 job.metadata.uid):

@@ -26,6 +26,10 @@ REASON_FAILED_DELETE = "FailedDelete"
 # Training-plane reasons (net-new: the progress plane's stall detector).
 REASON_TRAINING_STALLED = "TrainingStalled"
 REASON_TRAINING_RESUMED = "TrainingResumed"
+# Capacity-plane reasons (net-new: the slice-contention gang scheduler).
+REASON_GANG_QUEUED = "GangQueued"
+REASON_GANG_ADMITTED = "GangAdmitted"
+REASON_GANG_PREEMPTED = "GangPreempted"
 
 TYPE_NORMAL = "Normal"
 TYPE_WARNING = "Warning"

@@ -33,6 +33,7 @@ from ..api.labels import (
     ANNOTATION_GANG_NAME,
     ANNOTATION_GANG_SIZE,
     ANNOTATION_NUM_SLICES,
+    ANNOTATION_PRIORITY_CLASS,
     ANNOTATION_SLICE_INDEX,
     LABEL_INDEX,
     selector_for,
@@ -264,6 +265,7 @@ def _wire_tpu_pod(job: TFJob, spec: TFReplicaSpec, pod: Pod, index: int) -> None
         ANNOTATION_ACCELERATOR: tpu.accelerator_type,
         ANNOTATION_NUM_SLICES: str(tpu.num_slices),
         ANNOTATION_SLICE_INDEX: str(slice_idx),
+        ANNOTATION_PRIORITY_CLASS: job.spec.priority_class_name or "default",
     }
     if pod.spec.restart_policy == "Always":
         # A slice process that dies must fail the pod so the whole gang is

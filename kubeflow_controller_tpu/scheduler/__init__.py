@@ -1,0 +1,22 @@
+"""The capacity plane: priority gang queue, preemption + backfill, and
+warm-pool readmission over the TPU slice inventory (ROADMAP open item
+"Scheduler + capacity plane").
+
+``GangScheduler`` speaks the same protocol as ``TPUSliceInventory`` and
+wraps one; pass it wherever an inventory goes (FakeKubelet, Controller).
+A bare inventory is the FIFO-no-preemption baseline.
+"""
+
+from .queue import (  # noqa: F401
+    DEFAULT_CLASS,
+    GangEntry,
+    PRIORITY_CLASSES,
+    normalize_class,
+    priority_for,
+)
+from .scheduler import (  # noqa: F401
+    GangScheduler,
+    REASON_PREEMPTED_PREFIX,
+    REASON_QUEUED_PREFIX,
+    SchedulerPolicy,
+)

@@ -1,0 +1,70 @@
+"""Priority classes and the gang-queue entry model.
+
+The queue orders *whole gangs*, never pods: TF-Replicator and the TPU
+linear-algebra model both assume whole-slice co-scheduling (PAPERS.md), so a
+partially placed gang only wastes chips.  Ordering is priority class first
+(k8s PriorityClass semantics, collapsed to three well-known names), then
+FIFO by the gang's *fairness clock* — the wall-clock of its FIRST enqueue,
+preserved across preemption and readmission so an evicted gang rejoins at
+the head of its class instead of paying the queue again.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+#: Well-known priority classes.  "" on the job spec means "default".
+PRIORITY_CLASSES = {"low": 10, "default": 50, "high": 100}
+DEFAULT_CLASS = "default"
+
+
+def normalize_class(name: str) -> str:
+    return name if name in PRIORITY_CLASSES else DEFAULT_CLASS
+
+
+def priority_for(name: str) -> int:
+    return PRIORITY_CLASSES[normalize_class(name)]
+
+
+@dataclass
+class GangEntry:
+    """One gang's scheduling state, keyed by its gang name (job + runtime
+    id — stable across pod replacement, which is what lets the fairness
+    clock survive preemption)."""
+
+    name: str
+    size: int
+    accelerator_type: str = ""
+    num_slices: int = 1
+    priority_class: str = DEFAULT_CLASS
+    priority: int = PRIORITY_CLASSES[DEFAULT_CLASS]
+    # First-ever enqueue (the FIFO fairness clock; survives preemption).
+    fairness_at: float = field(default_factory=time.time)
+    # This round's enqueue (what the queue-wait histogram measures).
+    enqueued_at: float = 0.0
+    # True once all `size` member pods have been offered (gangs are
+    # admitted all-or-nothing; an incomplete gang is invisible to the
+    # admission pass).
+    queued: bool = False
+    admitted: bool = False
+    admitted_at: float = 0.0
+    # True once any member pod passed the admission gate (left Pending):
+    # an admitted-but-unstarted gang can be requeued silently, a started
+    # one must be evicted pod-by-pod.
+    started: bool = False
+    coordinator_started: bool = False
+    slice_names: List[str] = field(default_factory=list)
+    # "namespace/name" -> Pod, the members seen so far.
+    pods: Dict[str, object] = field(default_factory=dict)
+
+    def sort_key(self) -> Tuple[int, float, str]:
+        return (-self.priority, self.fairness_at, self.name)
+
+
+def sorted_waiting(entries) -> List[GangEntry]:
+    """Admission order over complete, not-yet-admitted gangs."""
+    return sorted(
+        (e for e in entries if e.queued and not e.admitted),
+        key=GangEntry.sort_key)

@@ -1,0 +1,380 @@
+"""The slice-contention scheduler: priority gang queue + preemption + backfill.
+
+``GangScheduler`` wraps a :class:`~..cluster.tpu.TPUSliceInventory` and
+implements the same protocol the inventory exposes (``offer`` /
+``release_gang`` / ``fail_slice`` / ``release_idle_gangs`` /
+``gang_slice(s)``), so it drops into the kubelet and controller wherever a
+bare inventory went — a bare inventory *is* the FIFO-no-preemption baseline
+(``bench.py --contend --no-sched``).  What the wrapper adds:
+
+- **priority gang queue** — complete gangs wait in (priority class desc,
+  fairness-clock FIFO) order; admission is all-or-nothing against the
+  inventory's free slices (``bind_gang``);
+- **preemption** — when the head gang of a class would otherwise wait,
+  strictly-lower-priority admitted gangs are evicted (lowest class first,
+  youngest first) until the head fits; evicted pods fail with a
+  ``Preempted: evicted by …`` reason, the controller gang-replaces them,
+  and the replacement re-enters the queue AT ITS ORIGINAL POSITION (the
+  fairness clock is keyed by gang name and survives eviction);
+- **backfill** — a smaller gang behind a blocked wide head may take free
+  slices the head cannot use yet, until the head has waited
+  ``starvation_s`` (then the queue drains for it: the no-starvation
+  guarantee ``make sched-smoke`` gates);
+- **coordinator-first start** — within an admitted gang, only the
+  process-0 pod passes the gate immediately; workers are released once the
+  coordinator reported started (or after ``coordinator_grace_s``), so they
+  never spend their first rendezvous attempts in gRPC reconnect backoff
+  against a coordinator that does not exist yet;
+- **mid-admission failure recovery** — a slice that dies between binding
+  and the first pod start returns the gang to the *head* of its class
+  (nothing to evict: the pods never left Pending) instead of leaking the
+  binding or sending the gang to the tail.
+
+Thread-safety: one scheduler lock guards the queue; inventory calls nest
+inside it (the inventory lock is a leaf — it never calls back out).
+Evictions are executed OUTSIDE the lock via the kubelet-registered evictor.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api.labels import (
+    ANNOTATION_ACCELERATOR,
+    ANNOTATION_GANG_NAME,
+    ANNOTATION_GANG_SIZE,
+    ANNOTATION_NUM_SLICES,
+    ANNOTATION_PRIORITY_CLASS,
+)
+from ..obs.metrics import REGISTRY
+from ..planner.materialize import pod_index
+from .queue import GangEntry, PRIORITY_CLASSES, normalize_class, priority_for, sorted_waiting
+
+# Pod failure-reason prefixes the updater/controller key off (the pod status
+# is the channel that carries queue state to a controller in another
+# process, exactly as pod phase already does).
+REASON_QUEUED_PREFIX = "GangQueued"
+REASON_PREEMPTED_PREFIX = "Preempted"
+
+
+@dataclass
+class SchedulerPolicy:
+    # Evict strictly-lower-priority gangs when a higher-priority gang would
+    # otherwise wait.
+    preemption: bool = True
+    # Let smaller gangs slot into slices a blocked wide head cannot use yet.
+    backfill: bool = True
+    # Once the head gang has waited this long, stop backfilling past it and
+    # drain the queue for it (the no-starvation guard).
+    starvation_s: float = 10.0
+    # How long a worker pod waits for its gang's coordinator to start
+    # before proceeding anyway (missing-coordinator deadlock guard).
+    coordinator_grace_s: float = 2.0
+
+
+class GangScheduler:
+    """Priority gang admission over a TPU slice inventory."""
+
+    def __init__(self, inventory, policy: Optional[SchedulerPolicy] = None):
+        self.inventory = inventory
+        self.policy = policy or SchedulerPolicy()
+        self._lock = threading.Lock()
+        self._gangs: Dict[str, GangEntry] = {}
+        # gang name -> first-ever enqueue time; survives entry deletion so
+        # a preempted-then-replaced gang keeps its queue position.
+        self._fairness: Dict[str, float] = {}
+        self._idle_candidates: set = set()
+        self._dirty = True
+        self._seen_version = -1
+        # Called OUTSIDE the lock with (pod_keys, reason) to fail a started
+        # victim gang's pods; registered by the kubelet.
+        self._evictor: Optional[Callable[[List[str], str], None]] = None
+
+        self._g_depth = REGISTRY.gauge(
+            "kctpu_sched_queue_depth",
+            "Complete gangs waiting for slice admission", ("priority_class",))
+        self._h_wait = REGISTRY.histogram(
+            "kctpu_sched_queue_wait_seconds",
+            "Queue wait from gang-complete to slice admission",
+            ("priority_class",))
+        self._c_admit = REGISTRY.counter(
+            "kctpu_sched_admissions_total",
+            "Gangs admitted onto slices", ("priority_class",))
+        self._c_preempt = REGISTRY.counter(
+            "kctpu_sched_preemptions_total",
+            "Gangs evicted by a higher-priority gang (victim's class)",
+            ("priority_class",))
+        self._c_backfill = REGISTRY.counter(
+            "kctpu_sched_backfills_total",
+            "Gangs admitted past a blocked wider head gang")
+        g_util = REGISTRY.gauge(
+            "kctpu_slice_utilization",
+            "Bound fraction of healthy TPU slices (scrape-time)")
+        g_util.set_function(inventory.utilization_now)
+
+    def set_evictor(self, fn: Callable[[List[str], str], None]) -> None:
+        self._evictor = fn
+
+    # ------------------------------------------------------------- admission
+
+    def offer(self, pod) -> bool:
+        """Offer a TPU pod; True iff the pod may leave Pending now.
+
+        Same contract as the inventory's first-come ``offer``, plus the
+        queue semantics above.  Pods poll this (the kubelet gate), so a
+        cheap no-op path matters: the admission pass only reruns when the
+        queue or the inventory changed."""
+        ann = pod.metadata.annotations
+        gang_name = ann.get(ANNOTATION_GANG_NAME, "")
+        accel = ann.get(ANNOTATION_ACCELERATOR, "")
+        if not gang_name:
+            # Non-gang TPU pod: baseline behavior (admit iff capacity).
+            return self.inventory.has_free_slice(accel)
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        now = time.time()
+        evictions: List[Tuple[List[str], str]] = []
+        with self._lock:
+            e = self._gangs.get(gang_name)
+            if e is None:
+                cls = normalize_class(ann.get(ANNOTATION_PRIORITY_CLASS, ""))
+                e = GangEntry(
+                    name=gang_name,
+                    size=int(ann.get(ANNOTATION_GANG_SIZE, "1")),
+                    accelerator_type=accel,
+                    num_slices=int(ann.get(ANNOTATION_NUM_SLICES, "1") or "1"),
+                    priority_class=cls,
+                    priority=priority_for(cls),
+                    fairness_at=self._fairness.setdefault(gang_name, now),
+                )
+                self._gangs[gang_name] = e
+            e.pods[key] = pod
+            if not e.admitted:
+                if len(e.pods) < e.size:
+                    return False  # incomplete: hold everything
+                if not e.queued:
+                    e.queued = True
+                    e.enqueued_at = now
+                    self._dirty = True
+                self._schedule_locked(now, evictions)
+            admitted = False
+            if e.admitted:
+                if (pod_index(pod) == 0 or e.coordinator_started
+                        or now - e.admitted_at >= self.policy.coordinator_grace_s):
+                    # Gate passage is recorded under the lock so a
+                    # concurrent preemption pass sees this gang as started
+                    # and evicts rather than silently requeues it.
+                    e.started = True
+                    admitted = True
+        self._run_evictions(evictions)
+        return admitted
+
+    def pod_started(self, pod) -> None:
+        """Kubelet callback once a gated pod proceeds; releases the
+        coordinator-first hold for the rest of the gang."""
+        gang_name = pod.metadata.annotations.get(ANNOTATION_GANG_NAME, "")
+        with self._lock:
+            e = self._gangs.get(gang_name)
+            if e is None:
+                return
+            e.started = True
+            if pod_index(pod) == 0:
+                e.coordinator_started = True
+
+    # ------------------------------------------------------- scheduling pass
+
+    def _schedule_locked(self, now: float,
+                         evictions: List[Tuple[List[str], str]]) -> None:
+        if not self._dirty and self.inventory.version == self._seen_version:
+            return
+        self._dirty = False
+        # blocked head per accelerator type: gangs behind it may only
+        # backfill; everything is re-derived each pass (queue sizes are
+        # small — gangs, not pods).
+        blocked: Dict[str, GangEntry] = {}
+        for e in sorted_waiting(self._gangs.values()):
+            head = blocked.get(e.accelerator_type)
+            if head is None:
+                if self._try_admit_locked(e, now):
+                    continue
+                if self.policy.preemption and self._preempt_for_locked(
+                        e, now, evictions):
+                    if self._try_admit_locked(e, now):
+                        continue
+                blocked[e.accelerator_type] = e
+                continue
+            if not self.policy.backfill:
+                continue
+            if now - head.enqueued_at >= self.policy.starvation_s:
+                continue  # head is starving: hold freed slices for it
+            self._try_admit_locked(e, now, backfill=True)
+        self._seen_version = self.inventory.version
+        self._update_depth_locked()
+
+    def _try_admit_locked(self, e: GangEntry, now: float,
+                          backfill: bool = False) -> bool:
+        slices = self.inventory.bind_gang(
+            e.name, e.accelerator_type, e.num_slices, size=e.size, pods=e.pods)
+        if slices is None:
+            return False
+        e.admitted = True
+        e.admitted_at = now
+        e.slice_names = slices
+        e.coordinator_started = False
+        self._h_wait.labels(e.priority_class).observe(
+            max(0.0, now - e.enqueued_at))
+        self._c_admit.labels(e.priority_class).inc()
+        if backfill:
+            self._c_backfill.inc()
+        return True
+
+    def _preempt_for_locked(self, e: GangEntry, now: float,
+                            evictions: List[Tuple[List[str], str]]) -> bool:
+        """Evict enough strictly-lower-priority admitted gangs for ``e`` to
+        fit: lowest class first, youngest first within a class."""
+        free = self.inventory.free_slice_count(e.accelerator_type)
+        need = e.num_slices
+        victims = sorted(
+            (v for v in self._gangs.values()
+             if v.admitted and v.priority < e.priority
+             and (not e.accelerator_type
+                  or v.accelerator_type in ("", e.accelerator_type))),
+            key=lambda v: (v.priority, -v.fairness_at))
+        picked: List[GangEntry] = []
+        gain = 0
+        for v in victims:
+            if free + gain >= need:
+                break
+            picked.append(v)
+            gain += len(v.slice_names) or v.num_slices
+        if free + gain < need:
+            return False  # even evicting everything eligible wouldn't fit
+        for v in picked:
+            self._preempt_locked(v, e, evictions)
+        return True
+
+    def _preempt_locked(self, v: GangEntry, preemptor: GangEntry,
+                        evictions: List[Tuple[List[str], str]]) -> None:
+        self.inventory.release_gang(v.name)
+        self._c_preempt.labels(v.priority_class).inc()
+        self._dirty = True
+        if not v.started:
+            # Pods never left Pending: silently return the gang to the
+            # head of its class (fairness clock untouched), nothing to kill.
+            v.admitted = False
+            v.admitted_at = 0.0
+            v.slice_names = []
+            v.coordinator_started = False
+            return
+        # Started gang: the slice processes must die; the controller
+        # replaces the whole gang and the replacement pods re-create this
+        # entry with the preserved fairness clock.
+        reason = (f"{REASON_PREEMPTED_PREFIX}: evicted by gang "
+                  f"{preemptor.name} (class {preemptor.priority_class})")
+        del self._gangs[v.name]
+        self._idle_candidates.discard(v.name)
+        evictions.append((list(v.pods), reason))
+
+    def _run_evictions(self, evictions: List[Tuple[List[str], str]]) -> None:
+        if not evictions or self._evictor is None:
+            return
+        for keys, reason in evictions:
+            self._evictor(keys, reason)
+
+    def _update_depth_locked(self) -> None:
+        depth = dict.fromkeys(PRIORITY_CLASSES, 0)
+        for e in self._gangs.values():
+            if e.queued and not e.admitted:
+                depth[e.priority_class] += 1
+        for cls, n in depth.items():
+            self._g_depth.labels(cls).set(n)
+
+    # ------------------------------------------------------- queue reporting
+
+    def queue_info(self, gang_name: str) -> str:
+        """Human-readable queue state for one gang — the kubelet publishes
+        this as the Pending pod's status.reason, which is how the state
+        reaches the controller/CLI in two-process mode."""
+        with self._lock:
+            e = self._gangs.get(gang_name)
+            if e is None:
+                return ""
+            if e.admitted:
+                if not e.started and not e.coordinator_started:
+                    return "GangAdmitted: waiting for coordinator start"
+                return ""
+            if not e.queued:
+                return ""
+            waiting = sorted_waiting(self._gangs.values())
+            pos = waiting.index(e) + 1
+            free = self.inventory.free_slice_count(e.accelerator_type)
+            return (f"{REASON_QUEUED_PREFIX}: position {pos}/{len(waiting)} "
+                    f"(class {e.priority_class}); needs {e.num_slices} x "
+                    f"{e.accelerator_type or 'any'} slice(s), {free} free")
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return sum(1 for e in self._gangs.values()
+                       if e.queued and not e.admitted)
+
+    # -------------------------------------------------- inventory delegation
+
+    def gang_slice(self, gang_name: str) -> str:
+        return self.inventory.gang_slice(gang_name)
+
+    def gang_slices(self, gang_name: str) -> List[str]:
+        return self.inventory.gang_slices(gang_name)
+
+    def release_gang(self, gang_name: str) -> None:
+        with self._lock:
+            self._gangs.pop(gang_name, None)
+            self._fairness.pop(gang_name, None)
+            self._idle_candidates.discard(gang_name)
+            self._dirty = True
+        self.inventory.release_gang(gang_name)
+
+    def release_idle_gangs(self, active_pod_keys) -> List[str]:
+        """Node-side backstop, extended to the queue: a QUEUED gang whose
+        member pods all vanished (job deleted while waiting) must leave the
+        queue, or it becomes a permanently-starving head that shuts down
+        backfill for everyone behind it.  Same two-scan confirmation as the
+        inventory's reaper."""
+        active = set(active_pod_keys)
+        with self._lock:
+            idle = {n for n, e in self._gangs.items()
+                    if e.pods and not (set(e.pods) & active)}
+            confirmed = idle & self._idle_candidates
+            self._idle_candidates = idle - confirmed
+            for n in confirmed:
+                self._gangs.pop(n, None)
+                self._fairness.pop(n, None)
+            if confirmed:
+                self._dirty = True
+        released = set(self.inventory.release_idle_gangs(active_pod_keys))
+        return sorted(released | confirmed)
+
+    def fail_slice(self, slice_name: str) -> List[str]:
+        """Slice failure with queue awareness.  Returns the pod keys the
+        kubelet must fail — EMPTY for a gang caught mid-admission (bound
+        but never started): its pods are still Pending in the gate, so the
+        gang silently returns to the head of its class instead of being
+        torn down and re-queued at the tail (the binding-leak regression
+        this method exists to prevent)."""
+        with self._lock:
+            bound = self.inventory.gang_on_slice(slice_name)
+            keys = self.inventory.fail_slice(slice_name)
+            self._dirty = True
+            e = self._gangs.get(bound) if bound else None
+            if e is None:
+                return keys
+            if e.admitted and not e.started:
+                e.admitted = False
+                e.admitted_at = 0.0
+                e.slice_names = []
+                e.coordinator_started = False
+                return []
+            del self._gangs[e.name]
+            self._idle_candidates.discard(e.name)
+            return keys

@@ -178,12 +178,27 @@ def compute_status(
     scheduled = True
     ready = True
 
+    # Capacity-plane state carried on pod status (the scheduler's channel
+    # to a controller in any process): a Pending TPU pod whose reason is
+    # "GangQueued: …" is waiting in the slice queue; a Failed pod whose
+    # reason is "Preempted: …" was evicted by a higher-priority gang.
+    gang_queue_msg = ""
+    gang_preempt_msg = ""
+
     for spec in job.spec.tf_replica_specs:
         typ = spec.tf_replica_type
         desired = desired_replicas(spec)
         pods = pods_by_type.get(typ, [])
         restart = spec.template.spec.restart_policy if spec.template else "OnFailure"
         replace_on_failure = restart in ("OnFailure", "Always")
+
+        if typ == ReplicaType.TPU:
+            for p in pods:
+                r = p.status.reason or ""
+                if p.status.phase == PHASE_PENDING and r.startswith("GangQueued"):
+                    gang_queue_msg = r
+                elif p.status.phase == PHASE_FAILED and r.startswith("Preempted"):
+                    gang_preempt_msg = r
 
         hist: Dict[TFReplicaState, int] = {}
         states: List[TFReplicaState] = []
@@ -293,8 +308,17 @@ def compute_status(
 
     terminal = phase in (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED)
     any_stalled = any(rh.stalled_indices for rh in health.replicas.values())
-    set_condition(status, TFJobConditionType.SCHEDULED, scheduled,
-                  reason="AllReplicasScheduled" if scheduled else "WaitingForReplicas", now=now)
+    # Queue state surfaces as the job's Pending reason + Scheduled=False
+    # (GangQueued) so `kctpu get` answers "why is this job not running".
+    if gang_queue_msg and not terminal:
+        status.reason = gang_queue_msg
+        set_condition(status, TFJobConditionType.SCHEDULED, False,
+                      reason="GangQueued", message=gang_queue_msg, now=now)
+    else:
+        if status.reason.startswith("GangQueued"):
+            status.reason = ""
+        set_condition(status, TFJobConditionType.SCHEDULED, scheduled,
+                      reason="AllReplicasScheduled" if scheduled else "WaitingForReplicas", now=now)
     set_condition(status, TFJobConditionType.READY,
                   ready and not terminal and not any_stalled,
                   reason=("TrainingStalled" if any_stalled
@@ -302,7 +326,9 @@ def compute_status(
                           else "ReplicasNotReady"),
                   message=health_msg, now=now)
     set_condition(status, TFJobConditionType.RECOVERING, recovering,
-                  reason="ReplacingFailedReplicas" if recovering else "", now=now)
+                  reason=("GangPreempted" if recovering and gang_preempt_msg
+                          else "ReplacingFailedReplicas" if recovering else ""),
+                  message=gang_preempt_msg if recovering else "", now=now)
     has_active = any(
         is_pod_active(p) for pods in pods_by_type.values() for p in pods
     )

@@ -1,0 +1,587 @@
+"""Capacity-plane tests: priority gang queue, preemption, backfill,
+mid-admission failure recovery, and warm-pool readmission."""
+
+import sys
+import threading
+import time
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import (
+    PHASE_FAILED,
+    PHASE_PENDING,
+    PHASE_SUCCEEDED,
+    Container,
+    Pod,
+    PodTemplateSpec,
+    ResourceRequirements,
+)
+from kubeflow_controller_tpu.api.labels import (
+    ANNOTATION_ACCELERATOR,
+    ANNOTATION_GANG_NAME,
+    ANNOTATION_GANG_SIZE,
+    ANNOTATION_NUM_SLICES,
+    ANNOTATION_PRIORITY_CLASS,
+    LABEL_INDEX,
+)
+from kubeflow_controller_tpu.api.meta import ObjectMeta
+from kubeflow_controller_tpu.api.tfjob import (
+    ReplicaType,
+    TFJob,
+    TFJobPhase,
+    TFReplicaSpec,
+    TPUSpec,
+    ValidationError,
+    validate_tfjob,
+)
+from kubeflow_controller_tpu.cluster import (
+    Cluster,
+    FakeKubelet,
+    PhasePolicy,
+    TPUInventory,
+    TPUSlice,
+)
+from kubeflow_controller_tpu.cluster.tpu import TPUSliceInventory
+from kubeflow_controller_tpu.controller import Controller
+from kubeflow_controller_tpu.scheduler import (
+    GangScheduler,
+    SchedulerPolicy,
+    priority_for,
+)
+
+
+def wait_for(fn, timeout=10.0, interval=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+def gang_pod(name, gang, size, index=0, accel="v5e-8", cls="default",
+             num_slices=1, ns="default"):
+    pod = Pod(metadata=ObjectMeta(name=name, namespace=ns))
+    pod.metadata.labels = {LABEL_INDEX: str(index)}
+    pod.metadata.annotations = {
+        ANNOTATION_GANG_NAME: gang,
+        ANNOTATION_GANG_SIZE: str(size),
+        ANNOTATION_ACCELERATOR: accel,
+        ANNOTATION_NUM_SLICES: str(num_slices),
+        ANNOTATION_PRIORITY_CLASS: cls,
+    }
+    c = Container(name="main")
+    c.resources = ResourceRequirements(requests={"google.com/tpu": "4"})
+    pod.spec.containers.append(c)
+    return pod
+
+
+def offer_gang(sched, gang, size, cls="default", num_slices=1, accel="v5e-8"):
+    """Offer all pods of a gang; returns the list of offer() results with
+    the coordinator (index 0) offered LAST so its result decides."""
+    out = []
+    for i in range(size - 1, -1, -1):
+        out.append(sched.offer(gang_pod(f"{gang}-p{i}", gang, size, index=i,
+                                        accel=accel, cls=cls,
+                                        num_slices=num_slices)))
+    return out
+
+
+def slices(n, accel="v5e-8"):
+    return [TPUSlice(f"slice-{i}", accel, num_hosts=2) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# Queue ordering
+# ---------------------------------------------------------------------------
+
+class TestPriorityQueue:
+    def test_priority_class_values(self):
+        assert priority_for("high") > priority_for("default") > priority_for("low")
+        assert priority_for("") == priority_for("default")
+        assert priority_for("weird") == priority_for("default")
+
+    def test_higher_class_admitted_before_older_lower(self):
+        sched = GangScheduler(TPUInventory(slices(1)))
+        # Occupy the slice with a started high gang (not preemptible by
+        # either waiter).
+        assert offer_gang(sched, "run", 1, cls="high")[-1]
+        # Low queues first, high second; on release the HIGH gang wins.
+        assert not any(offer_gang(sched, "low", 1, cls="low"))
+        assert not any(offer_gang(sched, "high", 1, cls="high"))
+        sched.release_gang("run")
+        assert sched.offer(gang_pod("high-p0", "high", 1, cls="high"))
+        assert not sched.offer(gang_pod("low-p0", "low", 1, cls="low"))
+
+    def test_fifo_within_class(self):
+        sched = GangScheduler(TPUInventory(slices(1)))
+        assert offer_gang(sched, "run", 1, cls="high")[-1]
+        assert not any(offer_gang(sched, "a", 1, cls="low"))
+        time.sleep(0.01)
+        assert not any(offer_gang(sched, "b", 1, cls="low"))
+        sched.release_gang("run")
+        assert sched.offer(gang_pod("a-p0", "a", 1, cls="low"))
+        assert not sched.offer(gang_pod("b-p0", "b", 1, cls="low"))
+
+    def test_incomplete_gang_never_queued(self):
+        sched = GangScheduler(TPUInventory(slices(1)))
+        assert not sched.offer(gang_pod("g-p1", "g", 2, index=1))
+        assert sched.queue_depth() == 0
+        assert sched.offer(gang_pod("g-p0", "g", 2, index=0))
+
+    def test_queue_info_reports_position_and_class(self):
+        sched = GangScheduler(TPUInventory(slices(1)))
+        assert offer_gang(sched, "run", 1, cls="high")[-1]
+        offer_gang(sched, "w1", 1, cls="high")
+        offer_gang(sched, "w2", 1, cls="low")
+        info = sched.queue_info("w2")
+        assert info.startswith("GangQueued")
+        assert "position 2/2" in info and "class low" in info
+        assert "GangQueued" in sched.queue_info("w1")
+        sched.pod_started(gang_pod("run-p0", "run", 1, index=0))
+        assert sched.queue_info("run") == ""  # admitted & started
+
+
+# ---------------------------------------------------------------------------
+# Preemption
+# ---------------------------------------------------------------------------
+
+class TestPreemption:
+    def test_high_preempts_started_low(self):
+        sched = GangScheduler(TPUInventory(slices(1)))
+        evicted = []
+        sched.set_evictor(lambda keys, reason: evicted.append((sorted(keys), reason)))
+        assert offer_gang(sched, "low", 2, cls="low")[-1]
+        assert sched.offer(gang_pod("high-p0", "high", 1, cls="high"))
+        assert len(evicted) == 1
+        keys, reason = evicted[0]
+        assert keys == ["default/low-p0", "default/low-p1"]
+        assert "high" in reason and reason.startswith("Preempted")
+        assert sched.gang_slices("high") == ["slice-0"]
+
+    def test_no_preemption_within_same_class(self):
+        sched = GangScheduler(TPUInventory(slices(1)))
+        evicted = []
+        sched.set_evictor(lambda keys, reason: evicted.append(keys))
+        assert offer_gang(sched, "a", 1, cls="default")[-1]
+        assert not sched.offer(gang_pod("b-p0", "b", 1, cls="default"))
+        assert not evicted
+
+    def test_preemption_disabled_by_policy(self):
+        sched = GangScheduler(TPUInventory(slices(1)),
+                              SchedulerPolicy(preemption=False))
+        evicted = []
+        sched.set_evictor(lambda keys, reason: evicted.append(keys))
+        assert offer_gang(sched, "low", 1, cls="low")[-1]
+        assert not sched.offer(gang_pod("high-p0", "high", 1, cls="high"))
+        assert not evicted
+
+    def test_victims_lowest_class_youngest_first(self):
+        sched = GangScheduler(TPUInventory(slices(2)))
+        evicted = []
+        sched.set_evictor(lambda keys, reason: evicted.append(sorted(keys)))
+        assert offer_gang(sched, "old-low", 1, cls="low")[-1]
+        time.sleep(0.01)
+        assert offer_gang(sched, "young-low", 1, cls="low")[-1]
+        # High gang needs ONE slice: the YOUNGEST low gang goes.
+        assert sched.offer(gang_pod("h-p0", "h", 1, cls="high"))
+        assert evicted == [["default/young-low-p0"]]
+        assert sched.gang_slices("old-low")  # survivor untouched
+
+    def test_unstarted_victim_requeued_silently(self):
+        # A gang admitted but whose pods never left Pending is requeued at
+        # the head of its class instead of being torn down.
+        sched = GangScheduler(TPUInventory(slices(1)))
+        evicted = []
+        sched.set_evictor(lambda keys, reason: evicted.append(keys))
+        # Complete the low gang via its WORKER pods only: admitted, but the
+        # workers wait for the coordinator, so the gang never starts.
+        assert not sched.offer(gang_pod("low-p1", "low", 2, index=1, cls="low"))
+        assert not sched.offer(gang_pod("low-p2", "low", 2, index=2, cls="low"))
+        assert sched.gang_slices("low") == ["slice-0"]  # admitted, unstarted
+        assert not evicted
+        assert sched.offer(gang_pod("high-p0", "high", 1, cls="high"))
+        assert not evicted  # nothing was killed ...
+        assert "position 1/1" in sched.queue_info("low")  # ... just requeued
+        sched.release_gang("high")
+        assert sched.offer(gang_pod("low-p0", "low", 2, index=0, cls="low"))
+
+
+# ---------------------------------------------------------------------------
+# Backfill + starvation guard
+# ---------------------------------------------------------------------------
+
+class TestBackfill:
+    def test_small_gang_backfills_blocked_wide_head(self):
+        sched = GangScheduler(TPUInventory(slices(2)))
+        assert offer_gang(sched, "run", 1, cls="high")[-1]  # 1 of 2 busy
+        # Wide default gang needs 2 slices: blocked with 1 free.
+        assert not any(offer_gang(sched, "wide", 4, cls="default",
+                                  num_slices=2))
+        # A later small same-class gang takes the free slice the head
+        # cannot use yet.
+        assert offer_gang(sched, "small", 1, cls="default")[-1]
+        assert "position 1/1" in sched.queue_info("wide")
+
+    def test_starvation_guard_stops_backfill(self):
+        sched = GangScheduler(TPUInventory(slices(2)),
+                              SchedulerPolicy(starvation_s=0.05))
+        assert offer_gang(sched, "run", 1, cls="high")[-1]
+        assert not any(offer_gang(sched, "wide", 4, cls="default",
+                                  num_slices=2))
+        time.sleep(0.08)  # the head is now starving
+        assert not offer_gang(sched, "small", 1, cls="default")[-1]
+        # Head admitted as soon as capacity suffices.
+        sched.release_gang("run")
+        assert sched.offer(gang_pod("wide-p0", "wide", 4, index=0,
+                                    cls="default", num_slices=2))
+        assert sorted(sched.gang_slices("wide")) == ["slice-0", "slice-1"]
+
+    def test_backfill_disabled_by_policy(self):
+        sched = GangScheduler(TPUInventory(slices(2)),
+                              SchedulerPolicy(backfill=False))
+        assert offer_gang(sched, "run", 1, cls="high")[-1]
+        assert not any(offer_gang(sched, "wide", 4, cls="default",
+                                  num_slices=2))
+        assert not offer_gang(sched, "small", 1, cls="default")[-1]
+
+
+# ---------------------------------------------------------------------------
+# Coordinator-first start
+# ---------------------------------------------------------------------------
+
+class TestCoordinatorFirst:
+    def test_workers_wait_for_coordinator(self):
+        sched = GangScheduler(TPUInventory(slices(1)))
+        w = gang_pod("g-p1", "g", 2, index=1)
+        coord = gang_pod("g-p0", "g", 2, index=0)
+        assert not sched.offer(w)       # completes the gang -> admitted,
+        assert sched.offer(coord)       # but only the coordinator passes
+        assert not sched.offer(w)       # worker still held
+        sched.pod_started(coord)
+        assert sched.offer(w)           # released once the coordinator ran
+
+    def test_grace_timeout_releases_workers(self):
+        sched = GangScheduler(TPUInventory(slices(1)),
+                              SchedulerPolicy(coordinator_grace_s=0.05))
+        w = gang_pod("g-p1", "g", 2, index=1)
+        assert not sched.offer(w)
+        assert not sched.offer(gang_pod("g-p0x", "g", 2, index=1))
+        time.sleep(0.08)
+        assert sched.offer(w)  # missing coordinator must not deadlock
+
+
+# ---------------------------------------------------------------------------
+# Mid-admission slice failure (the satellite regression)
+# ---------------------------------------------------------------------------
+
+class TestSliceFailure:
+    def test_mid_admission_failure_returns_gang_to_head(self):
+        sched = GangScheduler(TPUInventory(slices(2)))
+        # Admit (but never start) gang A via its worker pods.
+        assert not sched.offer(gang_pod("a-p1", "a", 2, index=1, cls="default"))
+        assert not sched.offer(gang_pod("a-p2", "a", 2, index=2, cls="default"))
+        bound = sched.gang_slices("a")
+        assert len(bound) == 1
+        time.sleep(0.01)
+        # A second gang queues BEHIND a (other slice still free: admitted).
+        assert offer_gang(sched, "b", 1, cls="default")[-1]
+        # The bound slice dies mid-admission: nothing to kill, binding not
+        # leaked, gang back at the head of the queue.
+        assert sched.fail_slice(bound[0]) == []
+        assert sched.inventory.gang_on_slice(bound[0]) == ""
+        assert "position 1/1" in sched.queue_info("a")
+        # Capacity returns: A is first in line and re-binds the healthy
+        # slice (the failed one never admits again).
+        sched.release_gang("b")
+        assert sched.offer(gang_pod("a-p0", "a", 2, index=0, cls="default"))
+        assert sched.gang_slices("a") != bound
+
+    def test_started_gang_slice_failure_evicts(self):
+        sched = GangScheduler(TPUInventory(slices(1)))
+        assert offer_gang(sched, "g", 2, cls="default")[-1]
+        failed = sorted(sched.fail_slice("slice-0"))
+        assert failed == ["default/g-p0", "default/g-p1"]
+        assert sched.queue_info("g") == ""  # entry gone; replacement re-queues
+
+    def test_inventory_admission_vs_fail_slice_race(self):
+        """Regression: racing gang admission against fail_slice must never
+        leave a slice bound to a gang the inventory no longer tracks, or a
+        tracked gang bound to an unhealthy slice."""
+        for _ in range(30):
+            inv = TPUSliceInventory(slices(2))
+            stop = threading.Event()
+
+            def admitter():
+                i = 0
+                while not stop.is_set():
+                    g = f"g{i}"
+                    inv.bind_gang(g, "v5e-8", 1,
+                                  pods={f"default/{g}-p0": None})
+                    inv.release_gang(g)
+                    i += 1
+
+            def failer():
+                inv.fail_slice("slice-0")
+
+            t = threading.Thread(target=admitter, daemon=True)
+            t.start()
+            failer()
+            stop.set()
+            t.join(timeout=5)
+            with inv._lock:
+                for s in inv.slices.values():
+                    if s.bound_gang:
+                        assert s.bound_gang in inv._gangs
+                        assert s.healthy
+                for g in inv._gangs.values():
+                    for sn in g.slice_names:
+                        assert inv.slices[sn].bound_gang == g.name
+
+    def test_busy_accounting_and_utilization(self):
+        inv = TPUInventory(slices(2))
+        assert inv.utilization_now() == 0.0
+        b0 = inv.busy_seconds()
+        assert inv.bind_gang("g", "v5e-8", 1)
+        assert inv.utilization_now() == 0.5
+        time.sleep(0.05)
+        assert inv.busy_seconds() - b0 >= 0.04
+        inv.release_gang("g")
+        assert inv.utilization_now() == 0.0
+        settled = inv.busy_seconds()
+        time.sleep(0.03)
+        assert inv.busy_seconds() == settled  # released slices stop accruing
+
+
+# ---------------------------------------------------------------------------
+# Stale-queue reaping
+# ---------------------------------------------------------------------------
+
+def test_release_idle_gangs_prunes_dead_queue_entries():
+    sched = GangScheduler(TPUInventory(slices(1)))
+    assert offer_gang(sched, "run", 1, cls="high")[-1]
+    offer_gang(sched, "ghost", 1, cls="high")  # queued, then its job dies
+    assert sched.queue_depth() == 1
+    # Two-scan confirmation, like the inventory's reaper.
+    sched.release_idle_gangs({"default/run-p0"})
+    assert "ghost" in sched.release_idle_gangs({"default/run-p0"})
+    assert sched.queue_depth() == 0
+    # The running gang was never touched.
+    assert sched.gang_slices("run") == ["slice-0"]
+
+
+# ---------------------------------------------------------------------------
+# API + updater surface
+# ---------------------------------------------------------------------------
+
+def mk_tpu_job(name, cls="", num_slices=1, restart="OnFailure"):
+    job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+    job.spec.priority_class_name = cls
+    t = PodTemplateSpec()
+    t.spec.containers.append(Container(name="tensorflow", image="img"))
+    t.spec.restart_policy = restart
+    job.spec.tf_replica_specs = [TFReplicaSpec(
+        replicas=2 * num_slices, tf_replica_type=ReplicaType.TPU, template=t,
+        tpu=TPUSpec(accelerator_type="v5e-8", num_hosts=2,
+                    num_slices=num_slices))]
+    return job
+
+
+class TestAPISurface:
+    def test_priority_class_validation(self):
+        job = mk_tpu_job("j", cls="high")
+        validate_tfjob(job)
+        job.spec.priority_class_name = "urgent"
+        with pytest.raises(ValidationError):
+            validate_tfjob(job)
+
+    def test_materialize_stamps_priority_annotation(self):
+        from kubeflow_controller_tpu.planner.materialize import make_pod
+
+        job = mk_tpu_job("j", cls="high")
+        job.spec.runtime_id = "abc12"
+        pod = make_pod(job, job.spec.tf_replica_specs[0], 0)
+        assert pod.metadata.annotations[ANNOTATION_PRIORITY_CLASS] == "high"
+        job2 = mk_tpu_job("k")
+        job2.spec.runtime_id = "abc12"
+        pod2 = make_pod(job2, job2.spec.tf_replica_specs[0], 0)
+        assert pod2.metadata.annotations[ANNOTATION_PRIORITY_CLASS] == "default"
+
+    def test_updater_surfaces_queue_and_preemption(self):
+        from kubeflow_controller_tpu.api.tfjob import TFJobConditionType
+        from kubeflow_controller_tpu.updater import compute_status
+
+        job = mk_tpu_job("j", cls="low")
+        queued = []
+        for i in range(2):
+            p = gang_pod(f"j-tpu-{i}", "j-rid", 2, index=i)
+            p.status.phase = PHASE_PENDING
+            p.status.reason = "GangQueued: position 2/3 (class low); needs 1 x v5e-8 slice(s), 0 free"
+            queued.append(p)
+        st = compute_status(job, {ReplicaType.TPU: queued})
+        assert st.reason.startswith("GangQueued")
+        sched_cond = next(c for c in st.conditions
+                          if c.type == TFJobConditionType.SCHEDULED)
+        assert sched_cond.status == "False"
+        assert sched_cond.reason == "GangQueued"
+        assert "position 2/3" in sched_cond.message
+
+        preempted = []
+        for i in range(2):
+            p = gang_pod(f"j-tpu-{i}", "j-rid", 2, index=i)
+            p.status.phase = PHASE_FAILED
+            p.status.reason = "Preempted: evicted by gang other-xyz (class high)"
+            preempted.append(p)
+        st2 = compute_status(job, {ReplicaType.TPU: preempted})
+        rec = next(c for c in st2.conditions
+                   if c.type == TFJobConditionType.RECOVERING)
+        assert rec.status == "True"
+        assert rec.reason == "GangPreempted"
+        assert "other-xyz" in rec.message
+        # Queue reason cleared once no pod is queued anymore.
+        assert not st2.reason.startswith("GangQueued")
+
+
+# ---------------------------------------------------------------------------
+# End to end: preemption -> events/conditions -> warm readmission
+# ---------------------------------------------------------------------------
+
+class TestEndToEnd:
+    def _start(self, n_slices=1, policy=None, **kubelet_kw):
+        cluster = Cluster()
+        inv = TPUInventory(slices(n_slices))
+        sched = GangScheduler(inv, policy or SchedulerPolicy())
+        kubelet = FakeKubelet(cluster, policy=PhasePolicy(
+            run_s=0.5, heartbeat_s=0.04, cold_start_s=0.15,
+            warm_start_s=0.01), inventory=sched, **kubelet_kw)
+        ctrl = Controller(cluster, inventory=sched, resync_period_s=0.5)
+        kubelet.start()
+        ctrl.run(threadiness=2)
+        return cluster, sched, kubelet, ctrl
+
+    def test_preempt_readmit_warm_with_events(self):
+        from kubeflow_controller_tpu.obs.metrics import REGISTRY
+
+        starts = REGISTRY.counter("kctpu_pod_starts_total", "", ("mode",))
+        warm0 = starts.labels("warm").value
+        cluster, sched, kubelet, ctrl = self._start(n_slices=1)
+        try:
+            cluster.tfjobs.create(mk_tpu_job("victim", cls="low"))
+            wait_for(lambda: cluster.tfjobs.get("default", "victim")
+                     .status.phase == TFJobPhase.RUNNING)
+            gang = next(iter(kubelet._warm_gangs & {
+                g for g in kubelet._warm_gangs if g.startswith("victim")}),
+                None)
+            assert gang is not None  # cold start marked the gang warm
+            cluster.tfjobs.create(mk_tpu_job("preemptor", cls="high"))
+            # Victim preempted: Warning event names the preemptor, and the
+            # job re-queues (GangQueued) while the high job runs.
+            wait_for(lambda: any(
+                e.reason == "GangPreempted" and "preemptor" in e.message
+                for e in ctrl.recorder.events_for("default", "victim")))
+            wait_for(lambda: any(
+                e.reason == "GangQueued"
+                for e in ctrl.recorder.events_for("default", "victim")))
+            # Both jobs finish; the victim's readmission forked warm.
+            wait_for(lambda: cluster.tfjobs.get("default", "preemptor")
+                     .status.phase == TFJobPhase.SUCCEEDED, timeout=20)
+            wait_for(lambda: cluster.tfjobs.get("default", "victim")
+                     .status.phase == TFJobPhase.SUCCEEDED, timeout=20)
+            assert starts.labels("warm").value - warm0 >= 2
+            admitted = [e for e in ctrl.recorder.events_for("default", "preemptor")
+                        if e.reason == "GangAdmitted"]
+            assert admitted and "slice-0" in admitted[0].message
+        finally:
+            ctrl.stop()
+            kubelet.stop()
+
+    def test_queued_job_status_reason_and_describe_surface(self):
+        cluster, sched, kubelet, ctrl = self._start(n_slices=1)
+        try:
+            cluster.tfjobs.create(mk_tpu_job("first", cls="default"))
+            wait_for(lambda: cluster.tfjobs.get("default", "first")
+                     .status.phase == TFJobPhase.RUNNING)
+            cluster.tfjobs.create(mk_tpu_job("second", cls="default"))
+            j = wait_for(lambda: (
+                lambda x: x if x.status.reason.startswith("GangQueued") else None
+            )(cluster.tfjobs.get("default", "second")))
+            assert "position 1/1" in j.status.reason
+            wait_for(lambda: cluster.tfjobs.get("default", "second")
+                     .status.phase == TFJobPhase.SUCCEEDED, timeout=20)
+            # Reason cleared once admitted and run.
+            assert not (cluster.tfjobs.get("default", "second")
+                        .status.reason.startswith("GangQueued"))
+        finally:
+            ctrl.stop()
+            kubelet.stop()
+
+    def test_warm_start_delay_shrinks_on_readmission(self):
+        """The simulated rendezvous/import analog: a gang's first start
+        pays cold_start_s, its readmission only warm_start_s."""
+        cluster = Cluster()
+        inv = TPUInventory(slices(1))
+        sched = GangScheduler(inv)
+        kubelet = FakeKubelet(cluster, policy=PhasePolicy(
+            cold_start_s=0.2, warm_start_s=0.0), inventory=sched)
+        pod = gang_pod("g-p0", "g", 1, index=0)
+        cluster.pods.create(pod)
+        t0 = time.monotonic()
+        assert kubelet._start_delay(pod)
+        cold = time.monotonic() - t0
+        t0 = time.monotonic()
+        assert kubelet._start_delay(pod)
+        warm = time.monotonic() - t0
+        assert cold >= 0.19
+        assert warm < cold / 4
+
+
+@pytest.mark.slow
+def test_executed_readmission_reuses_warm_pool(monkeypatch):
+    """Kill/readmit an executed gang: both runs fork from the SAME zygote
+    (no cold Popen for pod processes — the warm pool survives preemption)."""
+    import kubeflow_controller_tpu.cluster.kubelet as kubelet_mod
+
+    cold_popens = []
+    real_popen = kubelet_mod.subprocess.Popen
+
+    def counting_popen(*a, **kw):
+        cold_popens.append(a)
+        return real_popen(*a, **kw)
+
+    monkeypatch.setattr(kubelet_mod.subprocess, "Popen", counting_popen)
+
+    cluster = Cluster()
+    inv = TPUInventory(slices(1))
+    sched = GangScheduler(inv)
+    kubelet = FakeKubelet(cluster, inventory=sched, execute=True,
+                          warm_start=True)
+    kubelet.start()
+    try:
+        def run_gang(gen):
+            names = []
+            for i in range(2):
+                pod = gang_pod(f"wg{gen}-p{i}", f"wg{gen}", 2, index=i)
+                pod.spec.containers[0].command = [sys.executable, "-m", "platform"]
+                cluster.pods.create(pod)
+                names.append(pod.metadata.name)
+            for n in names:
+                wait_for(lambda n=n: cluster.pods.get("default", n)
+                         .status.phase == PHASE_SUCCEEDED, timeout=90)
+            return names
+
+        run_gang(0)
+        zygote_pid = kubelet._pool._zygote.pid
+        spawned = kubelet._pool._next_id
+        assert spawned >= 2
+        # "Readmission": a second gang (the controller would recreate the
+        # pods after a preemption) forks from the SAME warm zygote.
+        run_gang(1)
+        assert kubelet._pool._zygote.pid == zygote_pid
+        assert kubelet._pool._next_id >= spawned + 2
+        # The only Popen allowed is the zygote itself (the warm pool);
+        # pod processes never cold-started.
+        pod_popens = [a for a in cold_popens if "zygote" not in str(a)]
+        assert not pod_popens
+    finally:
+        kubelet.stop()
