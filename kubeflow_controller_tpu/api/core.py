@@ -97,8 +97,14 @@ class PodProgress:
     step: int = 0
     examples_per_sec: float = 0.0
     loss: float = 0.0
-    # Coarse workload phase: "rendezvous" | "init" | "fit" | free-form.
+    # Coarse workload phase: "rendezvous" | "init" | "compile" | "fit" |
+    # free-form.  "compile" additionally tells the stall detector to hold
+    # the frozen-step deadline (checker.StallTracker): a long XLA compile
+    # beats with a frozen step counter on purpose.
     phase: str = ""
+    # Executable provenance ("cache-hit" | "compiled"), reported by the
+    # TTFS pipeline once the compile phase resolves.
+    compile_source: str = ""
     # Wall-clock of the beat (stamped server-side when the reporter left
     # it 0, so clock-skewed workloads cannot fake liveness).
     timestamp: float = 0.0

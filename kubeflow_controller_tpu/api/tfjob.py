@@ -198,6 +198,11 @@ class TFJobSpec:
     model_dir: str = ""
     log_dir: str = ""
     export_dir: str = ""
+    # Net-new (TTFS pipeline): persistent compile-cache dir for the job's
+    # replicas ("" = the node agent's shared default).  Injected as
+    # $KCTPU_COMPILE_CACHE next to the *Dir env, so pod replacement and
+    # warm readmission land on the already-populated cache.
+    compile_cache_dir: str = ""
     # Net-new (capacity plane): scheduling priority class for the job's
     # gang — "low" | "default" | "high" ("" = default).  Higher classes are
     # admitted first under slice contention and may preempt strictly lower
@@ -238,6 +243,9 @@ class ReplicaProgress:
     examples_per_sec: float = 0.0
     loss: float = 0.0
     phase: str = ""
+    # How this replica obtained its executable ("cache-hit" | "compiled"),
+    # once it reported — the warm-restart evidence on the status surface.
+    compile_source: str = ""
     last_heartbeat: float = 0.0
     stalled: bool = False
 

@@ -126,9 +126,17 @@ class StallTracker:
         if (pol.heartbeat_deadline_s > 0
                 and t - progress.timestamp > pol.heartbeat_deadline_s):
             stalled = True
+        # A replica reporting phase="compile" freezes its step counter ON
+        # PURPOSE (XLA is compiling; the reporter's keepalive keeps the
+        # heartbeat fresh): keep resetting the advancement clock so the
+        # frozen-step deadline neither fires mid-compile nor inherits the
+        # whole compile as "time since last advance" once training starts.
+        # The heartbeat deadline above still applies — a compile whose
+        # process died stops beating and is flagged like any other hang.
+        compiling = getattr(progress, "phase", "") == "compile"
         with self._lock:
             last_step, advanced_at, _ = self._steps.get(key, (None, 0.0, 0.0))
-            if last_step is None or progress.step != last_step:
+            if last_step is None or progress.step != last_step or compiling:
                 # First sighting, or the counter moved (a DECREASE is an
                 # in-place workload restart — progress reset, not a stall).
                 # The advancement clock is the beat's own time.

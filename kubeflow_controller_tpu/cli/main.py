@@ -27,6 +27,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import pathlib
 import sys
 import time
@@ -255,6 +256,7 @@ def cmd_describe(args) -> int:
         for pn in rs.pod_names:
             print(f"           pod {pn}")
     _describe_health(cluster, j, ns)
+    _describe_compile_cache(j)
     _describe_progress(j)
     try:
         events = [e for e in cluster.events.list(ns)
@@ -271,6 +273,35 @@ def cmd_describe(args) -> int:
             age = _age(now - (e.last_timestamp or e.first_timestamp))
             print(f"  {age:>6}  {e.type:<8} {e.reason:<18} x{e.count}  {e.message}")
     return 0
+
+
+def _describe_compile_cache(j) -> None:
+    """Compile-cache state: the spec-pinned dir (with an entry census when
+    it is statable from here — single-node fake clusters share the
+    filesystem) and each reporting replica's executable provenance."""
+    d = j.spec.compile_cache_dir
+    p = j.status.progress
+    sources = {}
+    if p is not None:
+        for r in p.replicas:
+            if r.compile_source:
+                sources[r.compile_source] = sources.get(r.compile_source, 0) + 1
+    if not d and not sources:
+        return
+    line = "CompileCache:"
+    if d:
+        line += f" {d}"
+        if os.path.isdir(d):
+            from ..workloads.compile_cache import cache_entries
+
+            n = cache_entries(d)
+            line += f" ({n['aot']} aot / {n['xla']} xla entries)"
+    else:
+        line += " (node default)"
+    if sources:
+        line += "  executables: " + ", ".join(
+            f"{k} x{v}" for k, v in sorted(sources.items()))
+    print(line)
 
 
 def _describe_progress(j) -> None:
@@ -290,9 +321,10 @@ def _describe_progress(j) -> None:
         beat = (_age(now - r.last_heartbeat) + " ago"
                 if r.last_heartbeat else "never")
         mark = "  STALLED" if r.stalled else ""
+        src = f" compile={r.compile_source}" if r.compile_source else ""
         print(f"  {r.type.value}-{r.index}: step={r.step} "
               f"rate={r.examples_per_sec:g} loss={r.loss:g} "
-              f"phase={r.phase or '-'} beat {beat}{mark}")
+              f"phase={r.phase or '-'}{src} beat {beat}{mark}")
 
 
 def _describe_health(cluster, job, ns: str) -> None:

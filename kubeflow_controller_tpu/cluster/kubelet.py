@@ -135,6 +135,18 @@ class FakeKubelet:
         # executed pods inherit it via env and drop heartbeat JSON here;
         # the main loop ingests drops into the pod progress subresource.
         self._progress_dir = tempfile.mkdtemp(prefix="kubelet-progress-")
+        # Node-shared compile cache (workloads/compile_cache.py): executed
+        # pods without a spec-pinned $KCTPU_COMPILE_CACHE share this dir,
+        # so a replacement pod, a repeat job, or a warm-readmitted gang
+        # forked from the zygote lands on the already-populated cache and
+        # skips trace+XLA on its way to the first step — the compile-side
+        # analog of the zygote's import amortization.  Lives as long as
+        # the node agent, exactly like a real node's on-disk cache.
+        self._compile_cache_dir = tempfile.mkdtemp(prefix="kubelet-jitcache-")
+        # Rendezvous readiness file-drops (workloads/runtime.py): the
+        # coordinator announces "about to bind" here so racing peers skip
+        # the TCP poll window.
+        self._rendezvous_dir = tempfile.mkdtemp(prefix="kubelet-rdv-")
         self._ingested_mtimes: Dict[str, float] = {}
         # Heartbeat kill switch (stall injection for tests/smoke): while
         # True, simulated beats stop publishing and file drops stop being
@@ -189,6 +201,8 @@ class FakeKubelet:
             self._pool.stop()
         shutil.rmtree(self._log_dir, ignore_errors=True)
         shutil.rmtree(self._progress_dir, ignore_errors=True)
+        shutil.rmtree(self._compile_cache_dir, ignore_errors=True)
+        shutil.rmtree(self._rendezvous_dir, ignore_errors=True)
 
     def logs(self, namespace: str, name: str, tail_lines: int = 0) -> bytes:
         """An executed pod's output — per run (across restarts) stdout then
@@ -641,6 +655,17 @@ class FakeKubelet:
         if not env.get(ENV_PROGRESS_URL):
             env.setdefault(ENV_PROGRESS_DIR, self._progress_dir)
 
+    def _wire_startup_env(self, env: Dict[str, str]) -> None:
+        """Time-to-first-step plumbing: the node-shared persistent compile
+        cache (a spec-pinned $KCTPU_COMPILE_CACHE — planner _dir_env —
+        wins; env.update ran before this) and the rendezvous readiness
+        drop dir."""
+        from ..planner.materialize import ENV_COMPILE_CACHE
+        from ..workloads.runtime import ENV_RENDEZVOUS_DIR
+
+        env.setdefault(ENV_COMPILE_CACHE, self._compile_cache_dir)
+        env.setdefault(ENV_RENDEZVOUS_DIR, self._rendezvous_dir)
+
     def _execute(self, pod: Pod) -> None:
         from .warmpool import python_module_argv
 
@@ -651,6 +676,7 @@ class FakeKubelet:
         env.update({e.name: e.value for e in c.env})
         self._resolve_coordinator(env)
         self._wire_progress_env(pod, env)
+        self._wire_startup_env(env)
         if self.warm_start:
             argv = python_module_argv(cmd)
             if argv is not None:

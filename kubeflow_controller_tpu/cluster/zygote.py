@@ -38,7 +38,10 @@ PREIMPORT = (
     "optax",
     "numpy",
     "kubeflow_controller_tpu.models",
+    "kubeflow_controller_tpu.workloads.compile_cache",
     "kubeflow_controller_tpu.workloads.data",
+    "kubeflow_controller_tpu.workloads.progress",
+    "kubeflow_controller_tpu.workloads.runtime",
     "kubeflow_controller_tpu.workloads.trainer",
     "kubeflow_controller_tpu.workloads.mnist_local",
     "kubeflow_controller_tpu.workloads.mnist_dist",

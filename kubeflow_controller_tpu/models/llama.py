@@ -344,9 +344,11 @@ def _flash_path(q, k, v, mesh: Optional[Mesh], causal: bool,
     from ..parallel.sharding import logical_to_pspec
 
     spec = logical_to_pspec(("batch", "seq", "heads", "head_dim"), rules)
-    sm = jax.shard_map(lambda a, b, c: fn(a, b, c), mesh=mesh,
-                       in_specs=(spec, spec, spec), out_specs=spec,
-                       check_vma=False)
+    from ..parallel.compat import shard_map as shard_map_compat
+
+    sm = shard_map_compat(lambda a, b, c: fn(a, b, c), mesh=mesh,
+                          in_specs=(spec, spec, spec), out_specs=spec,
+                          check_vma=False)
     return sm(q, k, v)
 
 

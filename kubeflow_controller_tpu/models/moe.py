@@ -168,7 +168,9 @@ def moe_ffn_stats(
 
         F = w_gate.shape[-1]
         why = ""
-        mesh = jax.sharding.get_abstract_mesh()
+        from ..parallel.compat import context_mesh
+
+        mesh = context_mesh()
         parallel = _mesh_parallel_in_scope()
         in_mesh = parallel and mesh is not None and mesh.axis_names
         if parallel and not in_mesh:
@@ -468,12 +470,15 @@ def _grouped_ffn_sharded(x, probs, idx, w_gate, w_up, w_down, mesh,
     if mesh.shape.get(AXIS_PIPELINE, 1) > 1:
         names -= {AXIS_PIPELINE}
     eids = jnp.arange(max(ep, 1), dtype=jnp.int32)
-    return jax.shard_map(
+    from ..parallel.compat import shard_map as shard_map_compat
+
+    return shard_map_compat(
         body, mesh=None,
         axis_names=names,
         in_specs=(PartitionSpec(AXIS_EXPERT), act_spec, act_spec, act_spec,
                   wg_spec, wg_spec, wd_spec),
         out_specs=act_spec, check_vma=False,
+        fallback_mesh=mesh,
     )(eids, x, probs.astype(x.dtype), idx, w_gate, w_up, w_down)
 
 

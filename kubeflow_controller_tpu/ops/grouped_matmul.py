@@ -37,6 +37,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# pallas renamed TPUCompilerParams -> CompilerParams (~0.6); either
+# spelling accepts the dimension_semantics/vmem_limit_bytes used here.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 
 def _pick_block(dim: int, want: int) -> int:
     """Largest multiple-of-128 block <= want that divides dim (Mosaic lane
@@ -109,7 +113,7 @@ def _gmm_fwd_impl(lhs, rhs, tile_experts, bm, bn, bk, valid_tiles=None):
             out_specs=pl.BlockSpec((bm, bn), lambda i, j, k, te: (i, j)),
             scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
@@ -173,7 +177,7 @@ def _gmm_single_k(lhs, rhs, tile_experts, bm, bn, valid_tiles=None):
                 ],
                 out_specs=pl.BlockSpec((bm, bn), lambda j, i, te: (i, j)),
             ),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 dimension_semantics=_SINGLE_K_SEMANTICS,
             ),
             interpret=_interpret(),
@@ -190,7 +194,7 @@ def _gmm_single_k(lhs, rhs, tile_experts, bm, bn, valid_tiles=None):
             ],
             out_specs=pl.BlockSpec((bm, bn), lambda j, i, te, nt: (i, j)),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=_SINGLE_K_SEMANTICS,
         ),
         interpret=_interpret(),
@@ -256,7 +260,7 @@ def _gmm2_impl(lhs, rhs_g, rhs_u, tile_experts, bm, bn):
                        pl.BlockSpec((bm, bn), lambda j, i, te: (i, j)),
                        pl.BlockSpec((bm, bn), lambda j, i, te: (i, j))),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=_interpret(),
@@ -441,7 +445,7 @@ def _tgmm_impl(lhs, dout, tile_experts, n_experts, bm, bkk, bn,
             out_specs=pl.BlockSpec((1, bkk, bn), out_map),
             scratch_shapes=[pltpu.VMEM((1, bkk, bn), jnp.float32)],
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
         ),
         interpret=_interpret(),

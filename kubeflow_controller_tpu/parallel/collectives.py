@@ -38,13 +38,15 @@ def axis_index(axis: str):
 
 
 def axis_size(axis: str):
-    return lax.axis_size(axis)
+    from .compat import axis_size as _axis_size
+
+    return _axis_size(axis)
 
 
 def ring_permute(x, axis: str, *, shift: int = 1):
     """Send x to the next device on a ring over ``axis`` (ppermute).  The
     building block of ring attention and ring all-reduce: N-1 neighbor hops
     keep every transfer on the nearest ICI link."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm=perm)

@@ -1,0 +1,118 @@
+"""jax API compatibility: one ``shard_map``/varying-cast surface across the
+0.4.x -> 0.7.x API break.
+
+The parallel/model/trainer stack is written against the modern surface
+(``jax.shard_map`` with ``axis_names=``/``check_vma=``, ``jax.lax.pcast``,
+context meshes via ``jax.set_mesh``).  CI images pin older jax releases
+where ``shard_map`` still lives in ``jax.experimental.shard_map`` (with
+``check_rep=``/``auto=`` in place of ``check_vma=``/``axis_names=``) and
+the varying/replicated cast ops don't exist at all.  Importing ``jax.shard_map``
+at module top level made EVERY model import fail on those images — this
+module is the single translation point, so call sites stay written in the
+modern idiom and degrade correctly:
+
+- ``check_vma=False`` maps to ``check_rep=False`` (both mean "no
+  replication/varying bookkeeping; collectives are the caller's problem").
+- ``axis_names={...}`` maps to ``auto=<mesh axes not named>``.
+- ``mesh=None`` (use the context mesh) falls back to ``fallback_mesh`` on
+  old jax, which has no mesh context manager.
+- :func:`pvary` casts replicated->varying where the VMA type system exists
+  and is the identity before it (under ``check_rep=False`` nothing tracks
+  replication, so the cast has nothing to do).
+"""
+
+from __future__ import annotations
+
+import jax
+
+_sm_modern = getattr(jax, "shard_map", None)
+if _sm_modern is None:  # pre-0.6 surface
+    from jax.experimental.shard_map import shard_map as _sm_legacy
+else:
+    _sm_legacy = None
+
+
+def shard_map(f, mesh=None, *, in_specs, out_specs, axis_names=None,
+              check_vma=None, fallback_mesh=None):
+    """``jax.shard_map`` in the modern keyword surface, runnable on both
+    API generations.  ``fallback_mesh`` is consulted only on old jax when
+    ``mesh is None`` (modern callers pass None to prefer an enclosing
+    ``jax.set_mesh`` context, which old jax does not have)."""
+    if _sm_modern is not None:
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        return _sm_modern(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+    m = mesh if mesh is not None else fallback_mesh
+    if m is None:
+        raise NotImplementedError(
+            "context-mesh shard_map (mesh=None) needs jax.set_mesh, which "
+            "this jax release predates; pass fallback_mesh=")
+    # Old shard_map's replication checker predates pvary/pcast, so bodies
+    # written for the VMA type system (explicit varying casts + manual
+    # psums) must run unchecked — check_rep=False is the old spelling of
+    # check_vma=False.
+    kw = {"check_rep": False}
+    if axis_names is not None:
+        auto = frozenset(m.axis_names) - set(axis_names)
+        if auto:
+            # Partial-manual regions (some axes left auto) ABORT the
+            # process on this jax's partitioner when traced under a mesh
+            # context — fail as a catchable Python error instead so test
+            # runs and fallback paths survive.
+            raise NotImplementedError(
+                "partial-manual shard_map (auto axes "
+                f"{sorted(auto)}) is not supported on jax "
+                f"{jax.__version__}; use a fully-manual region or a newer "
+                "jax")
+    return _sm_legacy(f, mesh=m, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def pvary(x, axis_name):
+    """Cast a replicated value to varying over ``axis_name`` (so grads of
+    its uses stay LOCAL instead of growing an automatic per-leaf psum in
+    the transpose).  Identity on jax releases without the VMA type system:
+    there ``check_rep=False`` already keeps grads local."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, axis_name, to="varying")
+    pv = getattr(jax.lax, "pvary", None)
+    if pv is not None:
+        return pv(x, axis_name)
+    return x
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` where it exists; the pre-API idiom (a psum of
+    a Python scalar, which the axis env folds to a static int) elsewhere."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def set_mesh(mesh):
+    """``jax.set_mesh`` where it exists; the legacy ``with mesh:`` resource
+    context elsewhere.  The legacy context has no abstract-mesh tracking,
+    but the library code here detects it through the physical-mesh scope
+    (parallel.sharding) and keeps a dense fallback for the paths that
+    genuinely need abstract-mesh semantics."""
+    sm = getattr(jax, "set_mesh", None)
+    if sm is not None:
+        return sm(mesh)
+    return mesh  # a Mesh is itself a context manager (legacy resource env)
+
+
+def context_mesh():
+    """The enclosing abstract mesh (``jax.set_mesh``) or None where the
+    concept (or the query API) does not exist."""
+    get = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get is None:
+        return None
+    try:
+        return get()
+    except Exception:  # pragma: no cover - defensive: query API in flux
+        return None

@@ -89,13 +89,16 @@ def _stage_map(f, mesh, axis_name: str, manual: bool):
         # Prefer the CONTEXT mesh (mesh=None) so the region composes when
         # something outer is already manual; fall back to the passed mesh
         # when no jax.set_mesh context is active (direct library calls).
-        ctx = jax.sharding.get_abstract_mesh()
+        from .compat import context_mesh, shard_map
+
+        ctx = context_mesh()
         use_mesh = None if (ctx is not None and ctx.axis_names) else mesh
-        return jax.shard_map(
+        return shard_map(
             body, mesh=use_mesh,
             axis_names={axis_name},
             in_specs=tuple(P(axis_name) for _ in args),
             out_specs=P(axis_name), check_vma=False,
+            fallback_mesh=mesh,
         )(*args)
 
     return mapped

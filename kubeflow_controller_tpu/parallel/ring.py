@@ -20,9 +20,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
 from .collectives import ring_permute
+from .compat import axis_size as compat_axis_size, shard_map
 from .mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQUENCE, AXIS_TENSOR
 
 NEG_INF = -1e30
@@ -53,7 +53,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale: float
     shards.  Materializes [B, H, t_local, t_local] f32 score blocks — fine
     for short shards, OOM at t_local ~> 4k (the flash inner below is the
     long-context path)."""
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
     q_start = idx * t_local
@@ -118,7 +118,7 @@ def _ring_flash_fwd_impl(qb, kb, vb, axis_name, causal, scale, blocks,
                          interpret):
     from ..ops.attention import LANES
 
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     bh, t, d = qb.shape
     o = jnp.zeros((bh, t, d), jnp.float32)
@@ -164,7 +164,7 @@ def _ring_flash_bh_bwd(axis_name, causal, scale, blocks, interpret, res,
     from ..ops.attention import LANES, _bwd_calls
 
     qb, kb, vb, out, lse = res
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     bh, t, d = qb.shape
     delta = jnp.einsum("btd,btd->bt", dout.astype(jnp.float32),

@@ -124,7 +124,9 @@ def _mesh_parallel_in_scope() -> bool:
     """True when an active mesh has an axis of size > 1 (actual SPMD).
     A size-1 mesh (e.g. single-chip runs under jax.set_mesh) behaves like
     single-device for kernel-path selection."""
-    mesh = jax.sharding.get_abstract_mesh()
+    from .compat import context_mesh
+
+    mesh = context_mesh()
     if mesh is not None and mesh.axis_names:
         return any(mesh.shape[a] > 1 for a in mesh.axis_names)
     try:  # legacy physical-mesh context (private API, best effort)
@@ -139,7 +141,9 @@ def _mesh_parallel_in_scope() -> bool:
 def _mesh_axes_in_scope() -> bool:
     """True when a named mesh is active via either jax.set_mesh (abstract
     mesh) or the legacy ``with mesh:`` context manager."""
-    mesh = jax.sharding.get_abstract_mesh()
+    from .compat import context_mesh
+
+    mesh = context_mesh()
     if mesh is not None and mesh.axis_names:
         return True
     try:  # legacy physical-mesh context (private API, best effort)

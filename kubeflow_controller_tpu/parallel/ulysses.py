@@ -29,8 +29,8 @@ from typing import Callable, Optional
 import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
+from .compat import axis_size as compat_axis_size, shard_map
 from .mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQUENCE, AXIS_TENSOR
 from .ring import attention_reference
 
@@ -38,7 +38,7 @@ from .ring import attention_reference
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float,
                    inner: Callable):
     """Per-device body under shard_map; q/k/v are [B, T/n, H_local, D]."""
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     h = q.shape[2]
     if h % n:
         raise ValueError(

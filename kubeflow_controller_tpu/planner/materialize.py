@@ -62,6 +62,10 @@ ENV_TPU_ACCELERATOR = "TPU_ACCELERATOR_TYPE"
 # Multislice (DCN) contract — the names GKE multislice / megascale use.
 ENV_NUM_SLICES = "MEGASCALE_NUM_SLICES"
 ENV_SLICE_ID = "MEGASCALE_SLICE_ID"
+# Per-job persistent compile cache (workloads/compile_cache.py): rides the
+# pod spec like the *Dir fields, so replacements and warm readmissions of
+# the gang land on the SAME populated cache and skip trace+XLA entirely.
+ENV_COMPILE_CACHE = "KCTPU_COMPILE_CACHE"
 
 
 def labels_for(job: TFJob, typ: ReplicaType) -> Dict[str, str]:
@@ -173,6 +177,8 @@ def _dir_env(job: TFJob) -> Dict[str, str]:
         out["LOG_DIR"] = job.spec.log_dir
     if job.spec.export_dir:
         out["EXPORT_DIR"] = job.spec.export_dir
+    if job.spec.compile_cache_dir:
+        out[ENV_COMPILE_CACHE] = job.spec.compile_cache_dir
     return out
 
 

@@ -138,6 +138,7 @@ def compute_progress(
                 examples_per_sec=pr.examples_per_sec,
                 loss=pr.loss,
                 phase=pr.phase,
+                compile_source=pr.compile_source,
                 last_heartbeat=pr.timestamp,
                 stalled=idx in stalled_idx,
             ))

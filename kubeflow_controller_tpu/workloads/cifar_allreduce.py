@@ -14,6 +14,7 @@ import argparse
 import os
 import sys
 import time
+from ..parallel.compat import set_mesh as compat_set_mesh
 
 
 def main(argv=None) -> int:
@@ -91,7 +92,7 @@ def main(argv=None) -> int:
         return loss, (mut["batch_stats"] if mut else batch_stats)
 
     start = time.time()
-    with jax.set_mesh(mesh):
+    with compat_set_mesh(mesh):
         xb, yb = batch_stack(x, y, args.steps, bs // pc)
         batches = global_batches(mesh, AXIS_DATA, (xb, yb), bs)
         params, batch_stats, opt_state, loss = train_scan_stateful(

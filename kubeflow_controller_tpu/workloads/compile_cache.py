@@ -1,0 +1,342 @@
+"""Persistent compilation cache + AOT executable reuse — the compile half
+of the time-to-first-step pipeline.
+
+Cold TTFS is dominated by trace+lower+XLA-compile (~seconds per process on
+a small host, docs/PERF.md "Time to first step"), and the controller's warm
+readmission (PR 7) restarts a preempted gang's processes in ~0.08s only to
+re-pay that compile before the first step.  Two layers remove it:
+
+- **XLA persistent cache** (:func:`enable_persistent_cache`): jax's
+  on-disk compilation cache rooted at the per-job/per-node dir the
+  controller injects as ``$KCTPU_COMPILE_CACHE`` (planner ``_dir_env`` for
+  spec-pinned dirs, kubelet node default otherwise).  Any jit in the
+  process benefits; survives pod replacement and warm readmission because
+  the env rides the pod spec.
+- **Serialized executables** (:func:`aot_compile` /
+  :func:`load_executable`): ``jax.jit(step).lower(abstract).compile()``
+  keyed by a (model, mesh, dtype, batch-shape) :func:`fingerprint`, the
+  compiled program serialized under the cache dir.  A hit skips the whole
+  Python trace/lower/compile pipeline — worth more than a warm HLO cache
+  on a one-core host where every process's jit pipeline serializes with
+  every other's (trainer.train_scan_dist measured ~4.4s -> ~0.35s).
+
+Compiles are observable: ``kctpu_compile_seconds{source}`` histogram,
+``kctpu_compile_cache_{hits,misses}_total`` counters, a
+``workload/compile`` obs span, and — because a long compile is exactly
+what a frozen-step stall looks like from the controller — the progress
+reporter beats ``phase="compile"`` with a keepalive for the duration
+(checker.StallTracker holds the frozen-step deadline while a replica
+reports the compile phase).
+
+Import of this module must stay jax-free (the zygote preimports it and
+fingerprints are computed by cache-key tests in bare subprocesses); jax is
+imported inside the functions that need it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+from ..planner.materialize import ENV_COMPILE_CACHE
+
+_STATE_LOCK = threading.Lock()
+_ENABLED_DIR: Optional[str] = None
+
+AOT_SUFFIX = ".aot"
+
+
+def enable_persistent_cache(cache_dir: str = "",
+                            env: Optional[dict] = None) -> str:
+    """Point jax's persistent compilation cache at ``cache_dir`` (or
+    ``$KCTPU_COMPILE_CACHE``, or jax's own ``$JAX_COMPILATION_CACHE_DIR``
+    so pre-pipeline launchers still get the write-through + hit
+    accounting), with the thresholds zeroed so even the small programs
+    this repo trains get cached.  Idempotent per process; returns the
+    active dir ('' = no cache configured, nothing changed)."""
+    global _ENABLED_DIR
+    e = os.environ if env is None else env
+    d = (cache_dir or e.get(ENV_COMPILE_CACHE, "")
+         or e.get("JAX_COMPILATION_CACHE_DIR", ""))
+    if not d:
+        with _STATE_LOCK:
+            return _ENABLED_DIR or ""
+    with _STATE_LOCK:
+        if _ENABLED_DIR == d:
+            return d
+        try:
+            os.makedirs(d, exist_ok=True)
+        except OSError:
+            return _ENABLED_DIR or ""
+        import jax
+
+        # Threshold knobs differ across jax releases; a missing one only
+        # raises the bar for what gets cached, it never breaks the cache.
+        for key, value in (
+            ("jax_compilation_cache_dir", d),
+            ("jax_persistent_cache_min_compile_time_secs", 0.0),
+            ("jax_persistent_cache_min_entry_size_bytes", -1),
+        ):
+            try:
+                jax.config.update(key, value)
+            except (AttributeError, KeyError):
+                continue
+        _ENABLED_DIR = d
+    _install_xla_listener()
+    _enable_worker_cache_writes()
+    return d
+
+
+def active_cache_dir(env: Optional[dict] = None) -> str:
+    """The dir :func:`enable_persistent_cache` activated, else the env
+    contract's dir (for processes that haven't enabled the XLA layer)."""
+    with _STATE_LOCK:
+        if _ENABLED_DIR:
+            return _ENABLED_DIR
+    e = os.environ if env is None else env
+    return e.get(ENV_COMPILE_CACHE, "")
+
+
+def fingerprint(**parts: Any) -> str:
+    """Stable cache key from config parts (model, mesh, dtype, batch
+    shapes, baked-in hyperparameters...).  sha256 over sorted ``k=repr(v)``
+    lines — NOT ``hash()``, which is salted per process and would make
+    every restart a miss."""
+    h = hashlib.sha256()
+    for k in sorted(parts):
+        h.update(f"{k}={parts[k]!r}\n".encode())
+    return h.hexdigest()[:20]
+
+
+def cache_entries(cache_dir: str) -> dict:
+    """Shallow census of a cache dir for status surfaces (`kctpu
+    describe`): serialized-executable entries vs XLA persistent-cache
+    entries.  Never raises."""
+    aot = xla = 0
+    try:
+        for name in os.listdir(cache_dir):
+            if name.endswith(AOT_SUFFIX):
+                aot += 1
+            elif not name.startswith(".") and not name.endswith(".tmp"):
+                xla += 1
+    except OSError:
+        pass
+    return {"aot": aot, "xla": xla}
+
+
+# ---------------------------------------------------------------------------
+# XLA persistent-cache observability
+# ---------------------------------------------------------------------------
+
+_XLA_EVENTS = {"hits": 0, "installed": False}
+
+
+def _enable_worker_cache_writes() -> None:
+    """Let every process of a gang write the persistent cache, not just
+    process 0.
+
+    jax gates persistent-cache WRITES to process 0 (write-contention
+    hygiene for shared filesystems like GCS), but each process's program
+    hashes to its own cache key — so on a warm restart process 0 hits and
+    every other process re-pays its full compile, which is most of the
+    gang's TTFS.  On this single-node cluster the cache dir is a local
+    disk where concurrent writes are cheap and atomic (tmp+rename), so the
+    gate is pure loss: patch jax's write hook to write-through for
+    non-zero processes too.  No-ops when jax's internals have moved (the
+    pipeline then degrades to process-0-only warm hits, not an error)."""
+    try:
+        from jax._src import compilation_cache, compiler, distributed
+        orig = compiler._cache_write
+    except Exception:  # noqa: BLE001 - internals moved: degrade gracefully
+        return
+    if getattr(orig, "_kctpu_write_through", False):
+        return
+
+    def write_through(cache_key, compile_time_secs, module_name, backend,
+                      executable, host_callbacks):
+        if distributed.global_state.process_id and not host_callbacks:
+            try:
+                compilation_cache.put_executable_and_time(
+                    cache_key, module_name, executable, backend,
+                    int(compile_time_secs))
+            except Exception:  # noqa: BLE001 - cache write is best-effort
+                pass
+            return
+        return orig(cache_key, compile_time_secs, module_name, backend,
+                    executable, host_callbacks)
+
+    write_through._kctpu_write_through = True
+    compiler._cache_write = write_through
+
+
+def _install_xla_listener() -> None:
+    """Mirror jax's own compilation-cache-hit monitoring events into a
+    process-local counter, so compiles served from the XLA disk cache are
+    distinguishable from real compiles even on the implicit-jit path
+    (where no serialized executable is involved)."""
+    with _STATE_LOCK:
+        if _XLA_EVENTS["installed"]:
+            return
+        _XLA_EVENTS["installed"] = True
+    try:
+        import jax.monitoring
+
+        def on_event(event: str, **kw) -> None:
+            if event == "/jax/compilation_cache/cache_hits":
+                with _STATE_LOCK:
+                    _XLA_EVENTS["hits"] += 1
+
+        jax.monitoring.register_event_listener(on_event)
+    except Exception:  # noqa: BLE001 - monitoring surface varies by release
+        pass
+
+
+def xla_cache_hits() -> int:
+    """XLA persistent-cache hits observed in this process so far."""
+    with _STATE_LOCK:
+        return _XLA_EVENTS["hits"]
+
+
+def aot_supported() -> bool:
+    """Whether serialized-EXECUTABLE reuse is safe here.  Single-process:
+    always.  Multi-process: on older jaxlib releases a deserialized
+    executable mishandles donated-buffer aliasing — the first step
+    computes correctly, subsequent steps read freed buffers (losses jump
+    ~5 orders of magnitude, glibc aborts with heap corruption; bisected
+    with a standalone 2-process step-loop, the no-donation psum round-trip
+    is fine) — so the layer self-disables below 0.6 and the XLA
+    persistent cache (which re-lowers, then skips only the XLA compile)
+    carries the multi-host warm path instead.  KCTPU_FORCE_AOT=1
+    overrides for newer runtimes the version probe misjudges."""
+    if os.environ.get("KCTPU_FORCE_AOT"):
+        return True
+    import jax
+
+    if jax.process_count() <= 1:
+        return True
+    try:
+        major, minor = (int(v) for v in jax.__version__.split(".")[:2])
+    except ValueError:
+        return True
+    return (major, minor) >= (0, 6)
+
+
+# ---------------------------------------------------------------------------
+# Serialized-executable layer
+# ---------------------------------------------------------------------------
+
+def _metrics():
+    from ..obs.metrics import REGISTRY
+
+    hist = REGISTRY.histogram(
+        "kctpu_compile_seconds",
+        "Wall time to produce a runnable executable, by source "
+        "(compiled = trace+lower+XLA; cache-hit = deserialized)",
+        ("source",))
+    hits = REGISTRY.counter(
+        "kctpu_compile_cache_hits_total",
+        "Serialized-executable cache hits (compile pipeline skipped)")
+    misses = REGISTRY.counter(
+        "kctpu_compile_cache_misses_total",
+        "Serialized-executable cache misses (full compile paid)")
+    return hist, hits, misses
+
+
+def observe_compile(source: str, seconds: float) -> None:
+    """Record one executable acquisition on the obs registry."""
+    hist, hits, misses = _metrics()
+    hist.labels(source).observe(seconds)
+    (hits if source == "cache-hit" else misses).inc()
+
+
+def load_executable(path: str):
+    """Deserialize an AOT entry; None on any damage/absence (callers fall
+    back to compiling — a stale cache must never fail a job)."""
+    if not path or not os.path.exists(path):
+        return None
+    from jax.experimental.serialize_executable import deserialize_and_load
+
+    try:
+        with open(path, "rb") as fh:
+            payload, in_tree, out_tree = pickle.load(fh)
+        return deserialize_and_load(payload, in_tree, out_tree)
+    except Exception:  # noqa: BLE001 - corrupt/stale entry: recompile
+        return None
+
+
+def store_executable(path: str, compiled) -> bool:
+    """Serialize a compiled executable atomically (tmp+rename, so a
+    concurrent reader never loads a torn entry); best-effort."""
+    if not path:
+        return False
+    from jax.experimental.serialize_executable import serialize
+
+    try:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            pickle.dump(serialize(compiled), fh)
+        os.replace(tmp, path)
+        return True
+    except Exception:  # noqa: BLE001 - cache write is best-effort
+        return False
+
+
+@dataclass
+class AOTResult:
+    """Outcome of one :func:`aot_compile` call."""
+
+    compiled: Any
+    source: str        # "cache-hit" | "compiled"
+    seconds: float
+    key: str
+    path: str = ""
+
+
+def aot_compile(jitted, abstract_args: Sequence[Any], *, key: str,
+                cache_dir: str = "", what: str = "step",
+                donated: bool = True) -> AOTResult:
+    """An executable for ``jitted`` at ``abstract_args`` (ShapeDtypeStructs
+    — values are NOT needed, which is what lets the compile overlap host
+    setup), reused from ``<cache_dir>/<what>-<key>.aot`` when a prior
+    process of the same fingerprint already paid the compile.
+
+    ``donated=False`` declares the jitted function donation-free, which
+    keeps the serialized-executable layer enabled even where
+    :func:`aot_supported` rules donating executables out (the corruption
+    is specific to donated aliasing).  Callers must key donation into the
+    fingerprint — the two forms are different programs.
+
+    Beats ``phase="compile"`` with a keepalive for the duration, emits the
+    ``workload/compile`` span, and observes the compile metrics."""
+    import time
+
+    from ..obs.trace import span
+    from .progress import reporter
+
+    d = cache_dir or active_cache_dir()
+    path = (os.path.join(d, f"{what}-{key}{AOT_SUFFIX}")
+            if d and key and (aot_supported() or not donated) else "")
+    t0 = time.perf_counter()
+    with reporter().compiling(), span("workload/compile", what=what,
+                                      key=key) as sp:
+        compiled = load_executable(path)
+        source = "cache-hit" if compiled is not None else "compiled"
+        if compiled is None:
+            xla_hits0 = xla_cache_hits()
+            compiled = jitted.lower(*abstract_args).compile()
+            # Re-lowered, but XLA itself came off the persistent disk
+            # cache: still a cache hit as far as the pipeline (and the
+            # warm-restart evidence) is concerned.
+            if xla_cache_hits() > xla_hits0:
+                source = "cache-hit"
+            store_executable(path, compiled)
+        seconds = time.perf_counter() - t0
+        sp.args["source"] = source
+        sp.args["seconds"] = round(seconds, 4)
+    observe_compile(source, seconds)
+    return AOTResult(compiled=compiled, source=source, seconds=seconds,
+                     key=key, path=path)

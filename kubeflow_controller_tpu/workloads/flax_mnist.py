@@ -13,6 +13,7 @@ import argparse
 import os
 import sys
 import time
+from ..parallel.compat import set_mesh as compat_set_mesh
 
 
 def main(argv=None) -> int:
@@ -58,7 +59,7 @@ def main(argv=None) -> int:
     opt_state = opt.init(params)
 
     start = time.time()
-    with jax.set_mesh(mesh):
+    with compat_set_mesh(mesh):
         xb, yb = batch_stack(x, y, args.steps, bs)
         sharding = NamedSharding(mesh, P(None, AXIS_DATA))
         batches = (jax.device_put(xb, sharding), jax.device_put(yb, sharding))

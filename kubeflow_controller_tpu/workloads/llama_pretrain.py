@@ -17,6 +17,7 @@ import argparse
 import os
 import sys
 import time
+from ..parallel.compat import set_mesh as compat_set_mesh
 
 
 def main(argv=None) -> int:
@@ -139,7 +140,7 @@ def main(argv=None) -> int:
                                sp=args.sp, pp=args.pp, ep=args.ep))
     pspecs = llama_param_pspecs(cfg)
 
-    with jax.set_mesh(mesh):
+    with compat_set_mesh(mesh):
         init_key = jax.random.PRNGKey(0)
         params = jax.jit(
             lambda k: llama_init(k, cfg), out_shardings=jax.tree.map(

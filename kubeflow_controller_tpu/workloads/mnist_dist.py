@@ -16,15 +16,25 @@ Roles:
   (mnist_replica.py:121-122) — the data plane it used to host now rides
   XLA collectives.
 - ``worker`` / TPU replica: joins via jax.distributed (runtime.initialize),
-  generates its shard of every global batch on device, trains over the
-  global mesh.
+  trains over the global mesh.
 
-The whole workload is ONE compiled program per worker (train_scan_dist):
-batch generation, the training scan with a single fused flat-gradient
-all-reduce per step, and the sharded eval — where the reference pays one
-grpc round-trip per variable per step plus host-side feed_dict staging
-(mnist_replica.py:251-264).  On a latency-bound transport the collective
-COUNT is the cost model, not the payload size (docs/PERF.md).
+Two fit shapes:
+
+- **scan** (default): the whole workload is ONE compiled program per
+  worker (train_scan_dist) — batch generation, the training scan with a
+  single fused flat-gradient all-reduce per step, and the sharded eval.
+  Minimum dispatch overhead; progress is keepalive-only while the program
+  runs opaque.
+- **step-loop** (``--step-loop``): the time-to-first-step pipeline.  One
+  AOT-compiled step executable (trainer.make_dist_step) driven per-step:
+  host setup (dataset synthesis, param init — pure numpy) runs on a
+  background thread OVERLAPPED with the rendezvous, the step executable is
+  AOT-compiled from abstract shapes (post-rendezvous, concurrently with
+  that setup — compile needs shapes, not values; cache-hit via
+  compile_cache skips it entirely), and the first step beats ``step=1``
+  the moment it completes.  ``--no-overlap`` is the serial baseline
+  (rendezvous, then setup, then compile — the pre-pipeline ordering)
+  measured by ``bench.py --ttfs``.
 """
 
 from __future__ import annotations
@@ -53,7 +63,17 @@ def main(argv=None) -> int:
     p.add_argument("--platform", default=os.environ.get("WORKLOAD_PLATFORM", ""))
     p.add_argument("--aot-cache", default=os.environ.get("WORKLOAD_AOT_CACHE", ""),
                    help="directory for serialized-executable reuse across "
-                        "identical jobs (see trainer.train_scan_dist)")
+                        "identical jobs (see trainer.train_scan_dist); "
+                        "defaults to $KCTPU_COMPILE_CACHE when that is set")
+    p.add_argument("--step-loop", action="store_true",
+                   default=bool(os.environ.get("WORKLOAD_STEP_LOOP")),
+                   help="per-step-dispatch TTFS pipeline instead of the "
+                        "single-program scan (real per-step progress beats, "
+                        "AOT step executable, overlapped host setup)")
+    p.add_argument("--no-overlap", action="store_true",
+                   default=bool(os.environ.get("KCTPU_NO_OVERLAP")),
+                   help="serial baseline: run host setup after rendezvous "
+                        "instead of overlapping the two (bench.py --ttfs)")
     args = p.parse_args(argv)
 
     if args.job_name == "ps":
@@ -79,17 +99,40 @@ def main(argv=None) -> int:
     from ..obs import trace as obs_trace
     from ..parallel import AXIS_DATA, MeshSpec, build_mesh
     from . import data as d
-    from .runtime import JobRuntime
-    from .trainer import default_optimizer, numpy_opt_state, train_scan_dist
+    from .compile_cache import enable_persistent_cache
+    from .runtime import HostSetup, JobRuntime
+    from .trainer import default_optimizer, numpy_opt_state
 
     # Launch-path phases as obs spans (the single source of truth for the
     # phase breakdown: the "Phase times:" line below and bench.py's
-    # --trace-out dump both come from these).
+    # --trace-out / --ttfs dumps all come from these).
     t_start = time.time()
+    # Persistent compile cache BEFORE anything can compile: both the XLA
+    # disk cache and the serialized-executable layer root here.
+    cache_dir = enable_persistent_cache()
+    aot_dir = args.aot_cache or cache_dir
+
+    rt = JobRuntime.from_env()
+    rt.merge_tf_args(args.job_name, args.task_index, args.worker_hosts)
+
+    # Host setup — pure numpy, so it can run CONCURRENTLY with the
+    # rendezvous (and, in step-loop mode, with the AOT compile: setup
+    # produces values, compile needs only shapes).  The serial baseline
+    # (--no-overlap) runs exactly this work inline after rendezvous.
+    def host_setup():
+        means = d.mnist_teacher_means()
+        params = m.mlp_init(0)  # same seed -> same init everywhere
+        opt_state = numpy_opt_state(default_optimizer(args.lr), params)
+        train = eval_set = None
+        if args.step_loop:
+            train = d.synthetic_mnist_np(1, args.train_size)
+            eval_set = d.synthetic_mnist_np(2, args.eval_size)
+        return means, params, opt_state, train, eval_set
+
+    setup = HostSetup(host_setup, overlap=not args.no_overlap)
+
     with obs_trace.span("workload/rendezvous",
                         task_index=args.task_index) as sp_rdv:
-        rt = JobRuntime.from_env()
-        rt.merge_tf_args(args.job_name, args.task_index, args.worker_hosts)
         rt.initialize()
 
     # One global mesh over every process's devices: classic Worker gangs and
@@ -97,74 +140,31 @@ def main(argv=None) -> int:
     pc, proc = jax.process_count(), jax.process_index()
     with obs_trace.span("workload/init", process=proc) as sp_init:
         mesh = build_mesh(MeshSpec(dp=-1, fsdp=1))
-
-        # Int seed, not PRNGKey: as_seed(PRNGKey(0)) == 0, and building even
-        # one key costs a threefry jit compile this process never needs.
-        params = m.mlp_init(0)  # same seed -> same init everywhere
         opt = default_optimizer(args.lr)
-        # Host-numpy optimizer state (identical to opt.init for the default
-        # chain — see trainer.numpy_opt_state): skips the init-time jit
-        # cascade that rivals this worker's whole training run.
-        opt_state = numpy_opt_state(opt, params)
-
         # Round the global batch down to a multiple of the data-parallel size
         # (the reference's batch 100 over e.g. 8 devices -> 96 per step).
         dp = mesh.shape[AXIS_DATA]
         bs = max(dp, args.batch_size - args.batch_size % dp)
         local_bs = bs // dp
-        # Dataset = train_size samples revisited epoch-by-epoch, regenerated
-        # identically on every shard in-program (see synthetic_mnist_traced);
-        # each shard slices its columns of every batch.
         spe = max(1, args.train_size // bs)  # steps per epoch
         eval_local = max(1, args.eval_size // dp)
-        # Host numpy on purpose: the traced generator closes over it as a
-        # compile-time constant; an eager jnp.asarray would pay a device_put
-        # plus its tiny-jit before the program even starts.
-        means = d.mnist_teacher_means()
 
-        def local_batches(i):
-            x, y = d.synthetic_mnist_traced(1, spe * bs, means)
-            x = x.reshape(spe, bs, m.IMAGE_PIXELS)
-            y = y.reshape(spe, bs)
-            return (jax.lax.dynamic_slice_in_dim(x, i * local_bs, local_bs, axis=1),
-                    jax.lax.dynamic_slice_in_dim(y, i * local_bs, local_bs, axis=1))
-
-        def eval_counts(p, i):
-            ex, ey = d.synthetic_mnist_traced(2, dp * eval_local, means)
-            ex = jax.lax.dynamic_slice_in_dim(ex, i * eval_local, eval_local, axis=0)
-            ey = jax.lax.dynamic_slice_in_dim(ey, i * eval_local, eval_local, axis=0)
-            correct = jnp.sum(jnp.argmax(m.mlp_apply(p, ex), axis=-1) == ey)
-            return correct, jnp.asarray(eval_local, jnp.float32)
-
-        aot = ""
-        if args.aot_cache:
-            os.makedirs(args.aot_cache, exist_ok=True)
-            # lr is baked into the compiled program as a constant (the optax
-            # chain closes over it), so it MUST be part of the key: two jobs
-            # differing only in --lr must not share an executable.
-            aot = os.path.join(
-                args.aot_cache,
-                f"mnist-dist-s{args.steps}-b{bs}-n{args.train_size}"
-                f"-e{args.eval_size}-lr{args.lr:g}-dp{dp}-pc{pc}-p{proc}.aot")
-
-    # The whole job — per-step batch generation, the 200-step scan with its
-    # single fused all-reduce, and the sharded eval — is ONE compiled
-    # program; `fit` below is one dispatch per worker.
-    with obs_trace.span("workload/fit", process=proc, steps=args.steps) as sp_fit:
-        params, opt_state, loss, acc = train_scan_dist(
-            lambda p, b: m.mlp_loss(p, b[0], b[1]), opt, params, opt_state,
-            args.steps, mesh, AXIS_DATA, local_batches, eval_counts,
-            aot_cache=aot, examples_per_step=bs,
-        )
-        loss, acc = float(loss), float(acc)
+    if args.step_loop:
+        fit_out = _fit_step_loop(args, jax, jnp, m, rt, setup, mesh, opt,
+                                 dp, pc, proc, bs, spe, aot_dir)
+    else:
+        fit_out = _fit_scan(args, jax, jnp, d, m, rt, setup, mesh, opt,
+                            dp, pc, proc, bs, local_bs, spe, eval_local,
+                            aot_dir)
+    loss, acc, sp_fit, params, opt_state = fit_out
     elapsed = sp_fit.dur
 
     print(f"Worker {proc}/{pc} on {jax.device_count()} devices "
           f"(mesh dp={dp})")
     # Phase breakdown (bench.py reads the same spans from the trace dump).
-    # The phases partition total: rendezvous = jax.distributed join, init =
-    # host-side model/optimizer init + means, fit = the single compiled
-    # program (trace + cache-load + batch gen + train scan + eval).
+    # rendezvous = jax.distributed join; init = mesh + batch math; the
+    # host_setup span runs concurrently under overlap (bench reports it
+    # separately); fit covers compile + staging + train + eval.
     print(f"Phase times: rendezvous={sp_rdv.dur:.3f}s "
           f"init={sp_init.dur:.3f}s "
           f"fit={sp_fit.dur:.3f}s "
@@ -180,10 +180,166 @@ def main(argv=None) -> int:
         CheckpointManager(rt.model_dir).save(args.steps, params, opt_state)
         if proc == 0:
             print(f"Checkpoint saved to {rt.model_dir}")
+    if pc > 1:
+        # Leave together, then disconnect cleanly: process 0 hosts the
+        # coordination service, and an early exit turns a peer still
+        # finishing its (local) eval — or even just its interpreter
+        # teardown — into a TSL fatal ("Terminating process...") and a
+        # pointless OnFailure restart against a dead coordinator.  The
+        # barrier ends the device work in lockstep; the explicit shutdown
+        # stops the background error-polling before anyone's service goes
+        # away (observed as a rare warm-run flake without it).
+        try:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("mnist-dist-done")
+        except Exception:  # noqa: BLE001 - best-effort; exit skew is rare
+            pass
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
     if args.target_accuracy and acc < args.target_accuracy:
         print(f"accuracy {acc} below target {args.target_accuracy}", file=sys.stderr)
         return 1
     return 0
+
+
+def _fit_scan(args, jax, jnp, d, m, rt, setup, mesh, opt, dp, pc, proc,
+              bs, local_bs, spe, eval_local, aot_dir):
+    """The single-program scan fit (the headline-bench path)."""
+    from ..obs import trace as obs_trace
+    from ..parallel import AXIS_DATA
+    from .trainer import train_scan_dist
+
+    # Dataset = train_size samples revisited epoch-by-epoch, regenerated
+    # identically on every shard in-program (see synthetic_mnist_traced);
+    # each shard slices its columns of every batch.  Host numpy templates
+    # on purpose: the traced generator closes over them as a compile-time
+    # constant.
+    means, params, opt_state, _, _ = setup.result()
+
+    def local_batches(i):
+        x, y = d.synthetic_mnist_traced(1, spe * bs, means)
+        x = x.reshape(spe, bs, m.IMAGE_PIXELS)
+        y = y.reshape(spe, bs)
+        return (jax.lax.dynamic_slice_in_dim(x, i * local_bs, local_bs, axis=1),
+                jax.lax.dynamic_slice_in_dim(y, i * local_bs, local_bs, axis=1))
+
+    def eval_counts(p, i):
+        ex, ey = d.synthetic_mnist_traced(2, dp * eval_local, means)
+        ex = jax.lax.dynamic_slice_in_dim(ex, i * eval_local, eval_local, axis=0)
+        ey = jax.lax.dynamic_slice_in_dim(ey, i * eval_local, eval_local, axis=0)
+        correct = jnp.sum(jnp.argmax(m.mlp_apply(p, ex), axis=-1) == ey)
+        return correct, jnp.asarray(eval_local, jnp.float32)
+
+    aot = ""
+    if aot_dir:
+        os.makedirs(aot_dir, exist_ok=True)
+        # lr is baked into the compiled program as a constant (the optax
+        # chain closes over it), so it MUST be part of the key: two jobs
+        # differing only in --lr must not share an executable.
+        aot = os.path.join(
+            aot_dir,
+            f"mnist-dist-s{args.steps}-b{bs}-n{args.train_size}"
+            f"-e{args.eval_size}-lr{args.lr:g}-dp{dp}-pc{pc}-p{proc}.aot")
+
+    # The whole job — per-step batch generation, the steps-long scan with
+    # its single fused all-reduce, and the sharded eval — is ONE compiled
+    # program; `fit` below is one dispatch per worker.
+    with obs_trace.span("workload/fit", process=proc, steps=args.steps) as sp_fit:
+        params, opt_state, loss, acc = train_scan_dist(
+            lambda p, b: m.mlp_loss(p, b[0], b[1]), opt, params, opt_state,
+            args.steps, mesh, AXIS_DATA, local_batches, eval_counts,
+            aot_cache=aot, examples_per_step=bs,
+        )
+        loss, acc = float(loss), float(acc)
+    return loss, acc, sp_fit, params, opt_state
+
+
+def _fit_step_loop(args, jax, jnp, m, rt, setup, mesh, opt, dp, pc, proc,
+                   bs, spe, aot_dir):
+    """The TTFS pipeline fit: AOT step executable + per-step dispatch.
+
+    Ordering is the whole point: the step is compiled (or cache-loaded)
+    from ABSTRACT shapes immediately after rendezvous, while the host
+    setup thread may still be synthesizing data — then data staging, then
+    the first step (the pipeline's finish line), then the rest."""
+    import numpy as np
+
+    from ..obs import trace as obs_trace
+    from ..parallel import AXIS_DATA
+    from .compile_cache import aot_compile, fingerprint
+    from .trainer import (
+        global_batches,
+        make_dist_step,
+        replicate_global,
+        replicate_pytree,
+        train_step_loop_dist,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .compile_cache import aot_supported
+
+    with obs_trace.span("workload/fit", process=proc, steps=args.steps,
+                        step_loop=True) as sp_fit:
+        # Donate the carries only where donated executables survive the
+        # serialize/deserialize round trip (compile_cache.aot_supported);
+        # elsewhere the donation-free form costs a ~ms/step copy and buys
+        # the whole serialized-executable warm path.
+        donate = aot_supported()
+        step = make_dist_step(lambda p, b: m.mlp_loss(p, b[0], b[1]), opt,
+                              mesh, AXIS_DATA, donate=donate)
+        # Abstract twins of what host_setup is concurrently building: the
+        # numpy init's shapes via eval_shape (runs the cheap init math,
+        # keeps only shapes) and the optax state tree from opt.init's
+        # traced shape — no data required, which is why this compile can
+        # run while the dataset is still being synthesized.
+        p_abs = jax.eval_shape(lambda: m.mlp_init(0))
+        s_abs = jax.eval_shape(opt.init, p_abs)
+        batch_sharding = NamedSharding(mesh, P(None, AXIS_DATA))
+        x_abs = jax.ShapeDtypeStruct((spe, bs, m.IMAGE_PIXELS), np.float32,
+                                     sharding=batch_sharding)
+        y_abs = jax.ShapeDtypeStruct((spe, bs), np.int32,
+                                     sharding=batch_sharding)
+        t_abs = jax.ShapeDtypeStruct((), np.int32)
+        key = fingerprint(workload="mnist-dist-step", model="mlp",
+                          dtype="float32", lr=args.lr, bs=bs, spe=spe,
+                          dp=dp, pc=pc, proc=proc, donate=donate,
+                          platform=args.platform or "default")
+        if args.no_overlap:
+            # Faithful pre-pipeline ordering: rendezvous, THEN host setup,
+            # THEN compile, each fully serialized on the critical path.
+            means, params, opt_state, train, eval_set = setup.result()
+        res = aot_compile(step, (p_abs, s_abs, x_abs, y_abs, t_abs),
+                          key=key, cache_dir=aot_dir, donated=donate)
+
+        means, params, opt_state, train, eval_set = setup.result()
+        with obs_trace.span("workload/stage", process=proc):
+            # Stack the epoch's batches [spe, bs, ...] and contribute this
+            # process's columns of every batch (the host-staged analog of
+            # the scan mode's in-program generation).
+            x_np, y_np = train
+            idx = (np.arange(spe)[:, None] * bs + np.arange(bs)[None, :]) \
+                % x_np.shape[0]
+            rows = bs // pc
+            cols = slice(proc * rows, (proc + 1) * rows)
+            x_all, y_all = global_batches(
+                mesh, AXIS_DATA,
+                (x_np[idx][:, cols], y_np[idx][:, cols].astype(np.int32)), bs)
+            params = replicate_pytree(mesh, params)
+            opt_state = replicate_pytree(mesh, opt_state)
+
+        params, opt_state, loss = train_step_loop_dist(
+            res.compiled, params, opt_state, x_all, y_all, args.steps,
+            examples_per_step=bs, compile_source=res.source)
+        loss = float(loss)
+
+        ex, ey = replicate_global(
+            mesh, np.asarray(eval_set[0]),
+            np.asarray(eval_set[1]).astype(np.int32))
+        acc = float(jax.jit(m.mlp_accuracy)(params, ex, ey))
+    return loss, acc, sp_fit, params, opt_state
 
 
 if __name__ == "__main__":

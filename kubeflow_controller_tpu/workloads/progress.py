@@ -72,7 +72,8 @@ class ProgressReporter:
     def beat(self, step: Optional[int] = None,
              examples_per_sec: Optional[float] = None,
              loss: Optional[float] = None,
-             phase: Optional[str] = None) -> None:
+             phase: Optional[str] = None,
+             compile_source: Optional[str] = None) -> None:
         """Publish one heartbeat; None fields carry the previous value.
         The beat time is stamped server-side (store.update_progress), so
         ``timestamp`` stays 0 on the wire."""
@@ -87,8 +88,34 @@ class ProgressReporter:
                 self._last["loss"] = float(loss)
             if phase is not None:
                 self._last["phase"] = phase
+            if compile_source is not None:
+                self._last["compileSource"] = compile_source
             body = dict(self._last)
         self._publish(body)
+
+    def compiling(self, interval_s: float = 2.0):
+        """Context manager for a (possibly long) compile: beats
+        ``phase="compile"`` and keeps the liveness clock fresh with a
+        keepalive for the duration.  The "compile" phase is load-bearing —
+        the controller's frozen-step deadline holds off while a replica
+        reports it (checker.StallTracker), so a multi-minute XLA compile
+        is not flagged TrainingStalled.  The caller beats the next phase
+        ("fit") itself once the executable is in hand."""
+        from contextlib import contextmanager
+
+        @contextmanager
+        def _ctx():
+            self.beat(phase="compile")
+            nested = self._keepalive is not None
+            if not nested:
+                self.start_keepalive(interval_s)
+            try:
+                yield self
+            finally:
+                if not nested:
+                    self.stop_keepalive()
+
+        return _ctx()
 
     def _publish(self, body: Dict) -> None:
         try:

@@ -10,8 +10,9 @@ place of tf.train.Server.
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from ..planner.materialize import (
     ENV_COORDINATOR,
@@ -22,6 +23,66 @@ from ..planner.materialize import (
     ENV_TPU_ACCELERATOR,
     ENV_TPU_WORKER_HOSTNAMES,
 )
+
+# Node-agent-injected shared dir for rendezvous readiness file-drops: the
+# coordinator process drops `<coordinator>.ready` here immediately before
+# binding, so peer processes skip the polling window entirely when the
+# file already exists (and poll the cheap stat, not a TCP connect, when it
+# doesn't).  Absent outside the single-node fake cluster — real clusters
+# have no shared /tmp, and there the TCP probe alone does the job.
+ENV_RENDEZVOUS_DIR = "KCTPU_RENDEZVOUS_DIR"
+
+
+def _ready_filename(coordinator: str) -> str:
+    return coordinator.replace("/", "_").replace(":", "_") + ".ready"
+
+
+class HostSetup:
+    """Host-side setup running on a background thread, overlapped with the
+    rendezvous window (and with AOT compilation — setup produces VALUES,
+    compile needs only SHAPES, so nothing orders them).
+
+    ``fn`` must stay jax-free (pure numpy / python): touching a jax device
+    API before ``jax.distributed.initialize`` returns would initialize the
+    local backend out from under the distributed runtime.  ``overlap=False``
+    is the serial baseline — ``fn`` runs inline at :meth:`result`, after
+    rendezvous, which is exactly the pre-pipeline ordering.
+    """
+
+    def __init__(self, fn: Callable[[], Any], overlap: bool = True):
+        self._fn = fn
+        self._overlap = overlap
+        self._value: Any = None
+        self._exc: Optional[BaseException] = None
+        self._done = False
+        self._thread: Optional[threading.Thread] = None
+        if overlap:
+            self._thread = threading.Thread(
+                target=self._run, name="host-setup", daemon=True)
+            self._thread.start()
+
+    def _run(self) -> None:
+        from ..obs.trace import span
+
+        try:
+            with span("workload/host_setup", overlap=self._overlap):
+                self._value = self._fn()
+        except BaseException as e:  # noqa: BLE001 - re-raised at result()
+            self._exc = e
+        self._done = True
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        """The setup value; joins the thread (or, serial mode, runs the
+        setup now).  Re-raises whatever the setup raised."""
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError("host setup did not finish")
+        elif not self._done:
+            self._run()
+        if self._exc is not None:
+            raise self._exc
+        return self._value
 
 
 @dataclass
@@ -97,7 +158,14 @@ class JobRuntime:
         # process is alive and in rendezvous — the exact window whose
         # silent stalls had to be bisected by hand in round 5.
         reporter().beat(phase="rendezvous")
-        if self.process_id != 0:
+        if self.process_id == 0:
+            # Single-node fast path (fake cluster / multi-process CPU
+            # gangs): announce "coordinator process is here and about to
+            # bind" via a file drop, so peers that raced ahead stop
+            # stat-polling immediately instead of burning their poll
+            # budget against a port that cannot be bound yet.
+            self._drop_ready_file()
+        else:
             # Wait for the coordinator's port to be LISTENING before the
             # first gRPC connect: a connect attempt that lands even a few
             # ms before the coordinator binds puts the channel into gRPC's
@@ -110,6 +178,15 @@ class JobRuntime:
                       coordinator=self.coordinator,
                       process=self.process_id):
                 self._wait_coordinator()
+        try:
+            # Multi-process gangs on the cpu platform (classic Worker
+            # gangs, CI) need a cross-process collectives backend: on jax
+            # releases where this knob exists it defaults to none and XLA
+            # refuses multi-process CPU programs outright.  Must be set
+            # before the backend initializes — i.e. exactly here.
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 - knob renamed/absent: not needed
+            pass
         with span("runtime/distributed_initialize",
                   process=self.process_id,
                   num_processes=self.num_processes):
@@ -121,12 +198,36 @@ class JobRuntime:
         self._initialized = True
         reporter().beat(phase="init")  # rendezvous done, host-side setup next
 
+    def _ready_path(self) -> str:
+        d = os.environ.get(ENV_RENDEZVOUS_DIR, "")
+        if not d or not self.coordinator:
+            return ""
+        return os.path.join(d, _ready_filename(self.coordinator))
+
+    def _drop_ready_file(self) -> None:
+        path = self._ready_path()
+        if not path:
+            return
+        try:
+            with open(path, "w") as fh:
+                fh.write(str(os.getpid()))
+        except OSError:
+            pass  # readiness is an optimization, never a requirement
+
     def _wait_coordinator(self, timeout_s: float = 60.0,
                           poll_s: float = 0.005) -> None:
-        """Poll the coordinator host:port until a TCP connect succeeds (the
-        service is bound) or ``timeout_s`` passes — then let the real gRPC
-        client connect first-try.  On timeout, fall through and let
-        jax.distributed.initialize surface its own (clearer) error."""
+        """Wait for the coordinator to be connectable before the first gRPC
+        dial, then let the real client connect first-try.  Two stages:
+
+        1. When the node agent provides a shared rendezvous dir, stat-poll
+           the coordinator's readiness file-drop (written immediately
+           before it binds) — a stat costs ~1us vs a TCP connect attempt's
+           syscall round-trip, and crucially it cannot resolve-fail, so a
+           worker that races far ahead never lands in the resolver backoff.
+        2. TCP-poll the port until the listener is actually up.
+
+        On timeout, fall through and let jax.distributed.initialize
+        surface its own (clearer) error."""
         import socket
         import time
 
@@ -135,6 +236,11 @@ class JobRuntime:
         if not host or not port.isdigit():
             return
         deadline = time.monotonic() + timeout_s
+        ready = self._ready_path()
+        if ready:
+            while time.monotonic() < deadline and not os.path.exists(ready):
+                time.sleep(0.002)
+        resolver_backoff = 0.02
         while time.monotonic() < deadline:
             try:
                 with socket.create_connection((host, int(port)),
@@ -143,8 +249,12 @@ class JobRuntime:
             except socket.gaierror:
                 # Name not resolvable yet (coordinator service DNS record
                 # still propagating): NXDOMAIN answers return near-instantly,
-                # so a 5ms loop would hammer the resolver — back off.
-                time.sleep(0.25)
+                # so a 5ms loop would hammer the resolver — back off, but
+                # start small: a flat 250ms sleep here was worth up to a
+                # quarter second of whole-gang idle when the record landed
+                # right after the first probe.
+                time.sleep(resolver_backoff)
+                resolver_backoff = min(resolver_backoff * 2, 0.25)
             except OSError:
                 time.sleep(poll_s)
 

@@ -164,6 +164,8 @@ def train_scan_dist(
     from jax.flatten_util import ravel_pytree
     from jax.sharding import PartitionSpec as P
 
+    from ..parallel.compat import pvary, shard_map
+
     dp = mesh.shape[axis]
 
     def inner(params, opt_state):
@@ -183,8 +185,7 @@ def train_scan_dist(
             # leaf — the exact per-variable shape this function exists to
             # avoid); pvary keeps the local grads local so the one explicit
             # flat psum below is the step's only collective.
-            pv = jax.tree_util.tree_map(
-                lambda a: jax.lax.pcast(a, axis, to="varying"), p)
+            pv = jax.tree_util.tree_map(lambda a: pvary(a, axis), p)
             loss, grads = jax.value_and_grad(loss_fn)(pv, b)
             flat, unravel = ravel_pytree(grads)
             # One latency-bound collective for the whole step: grads + loss.
@@ -206,7 +207,7 @@ def train_scan_dist(
         return out
 
     fit = jax.jit(
-        jax.shard_map(inner, mesh=mesh, in_specs=(P(), P()), out_specs=P()),
+        shard_map(inner, mesh=mesh, in_specs=(P(), P()), out_specs=P()),
         donate_argnums=(0, 1),
     )
 
@@ -222,7 +223,8 @@ def train_scan_dist(
         # program returns — then the final beat carries the real step
         # count, throughput, and loss.
         rep = reporter()
-        rep.beat(phase="fit")
+        rep.beat(phase="fit",
+                 compile_source={"hit": "cache-hit", "miss": "compiled"}.get(cache, ""))
         rep.start_keepalive()
         try:
             with obs_span("trainer/fit", steps=steps,
@@ -242,33 +244,180 @@ def train_scan_dist(
                                    if dur > 0 and examples_per_step else None))
         return out
 
-    if aot_cache:
-        import os
-        import pickle
+    from .compile_cache import aot_supported
 
-        from jax.experimental.serialize_executable import (
-            deserialize_and_load,
-            serialize,
+    if aot_cache and aot_supported():
+        import time as _time
+
+        from ..obs.trace import span as obs_span
+        from .compile_cache import (
+            load_executable,
+            observe_compile,
+            store_executable,
         )
+        from .progress import reporter as _reporter
 
-        if os.path.exists(aot_cache):
-            try:
-                with open(aot_cache, "rb") as fh:
-                    payload, in_tree, out_tree = pickle.load(fh)
-                loaded = deserialize_and_load(payload, in_tree, out_tree)
-                return _timed(lambda: loaded(params, opt_state), "hit")
-            except Exception:
-                pass  # stale/corrupt entry: recompile below
-        compiled = fit.trace(params, opt_state).lower().compile()
-        try:
-            tmp = f"{aot_cache}.tmp.{os.getpid()}"
-            with open(tmp, "wb") as fh:
-                pickle.dump(serialize(compiled), fh)
-            os.replace(tmp, aot_cache)
-        except Exception:
-            pass  # cache write is best-effort
+        t0 = _time.perf_counter()
+        loaded = load_executable(aot_cache)
+        if loaded is not None:
+            observe_compile("cache-hit", _time.perf_counter() - t0)
+            return _timed(lambda: loaded(params, opt_state), "hit")
+        # A long compile looks exactly like a frozen-step stall from the
+        # controller: beat phase="compile" with a keepalive for the
+        # duration (the stall detector holds its step deadline for it).
+        with _reporter().compiling(), obs_span("workload/compile",
+                                               what="fit") as sp:
+            compiled = fit.trace(params, opt_state).lower().compile()
+            sp.args["source"] = "compiled"
+        observe_compile("compiled", _time.perf_counter() - t0)
+        store_executable(aot_cache, compiled)
         return _timed(lambda: compiled(params, opt_state), "miss")
     return _timed(lambda: fit(params, opt_state), "off")
+
+
+def make_dist_step(
+    loss_fn: Callable[[Any, Any], jax.Array],
+    optimizer: optax.GradientTransformation,
+    mesh,
+    axis: str,
+    donate: bool = True,
+):
+    """One jitted distributed train step — the TTFS pipeline's unit of
+    compilation.
+
+    ``step(params, opt_state, x_all, y_all, t) -> (params, opt_state,
+    loss)``: the whole stacked dataset (``[n_steps, global_bs, ...]``,
+    batch dim sharded over ``axis``) stays resident on device and the step
+    indexes batch ``t % n_steps`` itself, so the host loop dispatches ONE
+    program per step with no per-step staging and no per-index recompiles.
+    Same collective shape as :func:`train_scan_dist`'s scan body — grads
+    and loss ride one flat psum — and params/opt_state are donated, so
+    buffers update in place.
+
+    Per-step dispatch costs more than the scan for a whole fixed-length
+    run, but it is what makes the first step (and every step) OBSERVABLE
+    host-side — the progress plane gets real per-step beats instead of a
+    keepalive — and what lets the executable be AOT-compiled from abstract
+    shapes alone, before the training data exists
+    (compile_cache.aot_compile overlaps host setup).
+
+    ``donate=False`` trades the in-place carry update for a per-step
+    params/opt_state copy (~ms at MLP scale): deserialized executables on
+    older jaxlib mishandle donated aliasing (heap corruption —
+    compile_cache.aot_supported), so the donation-free form is what makes
+    SERIALIZED multi-process executables safe there."""
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.compat import pvary, shard_map
+
+    dp = mesh.shape[axis]
+
+    def inner(params, opt_state, x_all, y_all, t):
+        n = x_all.shape[0]
+        b = jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(
+                a, jax.lax.rem(t, jnp.int32(n)), axis=0, keepdims=False),
+            (x_all, y_all))
+        # Varying view of the replicated params: keeps grads local so the
+        # explicit flat psum below is the step's only collective (see
+        # train_scan_dist).
+        pv = jax.tree_util.tree_map(lambda a: pvary(a, axis), params)
+        loss, grads = jax.value_and_grad(loss_fn)(pv, b)
+        flat, unravel = ravel_pytree(grads)
+        flat = jax.lax.psum(
+            jnp.concatenate([flat, loss[None].astype(flat.dtype)]), axis) / dp
+        updates, opt_state = optimizer.update(unravel(flat[:-1]), opt_state,
+                                              params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, flat[-1]
+
+    return jax.jit(
+        shard_map(inner, mesh=mesh,
+                  in_specs=(P(), P(), P(None, axis), P(None, axis), P()),
+                  out_specs=(P(), P(), P())),
+        donate_argnums=(0, 1) if donate else (),
+    )
+
+
+def train_step_loop_dist(
+    step: Callable,
+    params: Any,
+    opt_state: Any,
+    x_all: Any,
+    y_all: Any,
+    steps: int,
+    examples_per_step: int = 0,
+    compile_source: str = "",
+    beat_interval_s: float = 0.25,
+) -> Tuple[Any, Any, jax.Array]:
+    """Drive a (usually AOT-precompiled) :func:`make_dist_step` executable
+    for ``steps`` steps with REAL per-step progress.
+
+    The first step is special — it is the end of the time-to-first-step
+    pipeline: it gets its own ``workload/first_step`` span and an
+    immediate ``step=1`` beat carrying ``compile_source`` ("cache-hit" vs
+    "compiled"), so the controller's progress plane records both when
+    training actually started and whether the compile was paid or skipped.
+    Subsequent steps beat at most every ``beat_interval_s`` (a float(loss)
+    sync per beat; per-step syncing would serialize host and device).
+    Returns (params, opt_state, last_loss)."""
+    import time as _time
+
+    import numpy as np
+
+    from ..obs.trace import span as obs_span
+    from .progress import reporter
+
+    rep = reporter()
+    t0 = _time.perf_counter()
+    with obs_span("workload/first_step") as sp_first:
+        params, opt_state, loss = step(params, opt_state, x_all, y_all,
+                                       np.int32(0))
+        loss = jax.block_until_ready(loss)
+        sp_first.args["process"] = jax.process_index()
+    rep.beat(step=1, loss=float(loss), phase="fit",
+             compile_source=compile_source,
+             examples_per_sec=(examples_per_step / sp_first.dur
+                               if sp_first.dur > 0 and examples_per_step
+                               else None))
+    next_beat = _time.perf_counter() + beat_interval_s
+    with obs_span("workload/fit", steps=steps) as sp_fit:
+        for t in range(1, steps):
+            params, opt_state, loss = step(params, opt_state, x_all, y_all,
+                                           np.int32(t))
+            now = _time.perf_counter()
+            if now >= next_beat:
+                next_beat = now + beat_interval_s
+                done = t + 1
+                rep.beat(step=done, loss=float(loss),
+                         examples_per_sec=(done * examples_per_step /
+                                           (now - t0)
+                                           if examples_per_step else None))
+        loss = jax.block_until_ready(loss)
+    dur = sp_first.dur + sp_fit.dur
+    record_step_telemetry(steps, dur, examples_per_step)
+    rep.beat(step=steps, loss=float(loss), phase="fit",
+             examples_per_sec=(steps * examples_per_step / dur
+                               if dur > 0 and examples_per_step else None))
+    return params, opt_state, loss
+
+
+def replicate_pytree(mesh, tree):
+    """Every-leaf-replicated global arrays from host-identical pytrees
+    (the multi-process-safe ``device_put`` for params/opt_state — every
+    process passes bitwise-identical host values)."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P())
+    if jax.process_count() == 1:
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, sharding), tree)
+    return jax.tree_util.tree_map(
+        lambda a: jax.make_array_from_process_local_data(
+            sharding, np.asarray(a), np.asarray(a).shape), tree)
 
 
 def batch_stack(x: jax.Array, y: jax.Array, steps: int, batch_size: int):

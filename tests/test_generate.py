@@ -11,6 +11,7 @@ from kubeflow_controller_tpu.models.generate import (
     generate,
     init_cache,
 )
+from kubeflow_controller_tpu.parallel.compat import set_mesh as compat_set_mesh
 
 
 def setup():
@@ -200,7 +201,7 @@ class TestShardedDecode:
         dense = llama_forward(params, tokens, cfg)
         mesh = build_mesh(MeshSpec(dp=2, tp=2, fsdp=2))
         sharded = self._sharded(cfg, params, mesh)
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             def prefill(p, t):
                 cache = init_cache(cfg, 4, 16)
                 return forward_with_cache(p, t, cache, 0, cfg)[0]
@@ -218,7 +219,7 @@ class TestShardedDecode:
         ref = generate(params, prompt, cfg, max_new_tokens=6)
         mesh = build_mesh(MeshSpec(dp=2, tp=2, fsdp=2))
         sharded = self._sharded(cfg, params, mesh)
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             out = jax.jit(
                 lambda p, t: generate(p, t, cfg, max_new_tokens=6)
             )(sharded, prompt)
@@ -235,7 +236,7 @@ class TestShardedDecode:
         ref = generate(params, prompt, cfg, max_new_tokens=6)
         mesh = build_mesh(MeshSpec(dp=2, tp=2, fsdp=2))
         sharded = self._sharded(cfg, params, mesh)
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             out = jax.jit(
                 lambda p, t: generate(p, t, cfg, max_new_tokens=6, kv_block=4)
             )(sharded, prompt)

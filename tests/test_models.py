@@ -19,6 +19,7 @@ from kubeflow_controller_tpu.models import (
     softmax_init,
 )
 from kubeflow_controller_tpu.parallel import MeshSpec, build_mesh
+from kubeflow_controller_tpu.parallel.compat import set_mesh as compat_set_mesh
 
 
 class TestMNIST:
@@ -108,7 +109,7 @@ class TestLlama:
             lambda a, s: jax.device_put(a, jax.sharding.NamedSharding(mesh, s)),
             params, pspecs,
         )
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             out = jax.jit(
                 lambda p, t: llama_forward(p, t, cfg, mesh=mesh)
             )(sharded_params, tokens)
@@ -203,7 +204,7 @@ class TestChunkedCE:
         sharded = jax.tree.map(
             lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
             params, llama_param_pspecs(cfg))
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             out = jax.jit(lambda p, t: llama_loss(p, t, cfg_c, mesh=mesh))(
                 sharded, tokens)
         np.testing.assert_allclose(float(out), float(dense), rtol=5e-5)

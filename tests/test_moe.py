@@ -10,6 +10,7 @@ from kubeflow_controller_tpu.models.generate import forward_with_cache, init_cac
 from kubeflow_controller_tpu.models.llama import llama_param_pspecs
 from kubeflow_controller_tpu.models.moe import moe_ffn, moe_ffn_reference
 from kubeflow_controller_tpu.parallel import MeshSpec, build_mesh
+from kubeflow_controller_tpu.parallel.compat import set_mesh as compat_set_mesh
 
 
 def _weights(key, D=16, E=4, F=32):
@@ -191,7 +192,7 @@ class TestMoELlama:
             lambda a, s: jax.device_put(a, jax.sharding.NamedSharding(mesh, s)),
             params, pspecs,
         )
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             out = jax.jit(lambda p, t: llama_forward(p, t, cfg, mesh=mesh))(
                 sharded, tokens)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -398,7 +399,7 @@ class TestGroupedDispatch:
         x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 128))
         ref = moe_ffn_reference(x, router, wg, wu, wd, top_k=2)
         mesh = build_mesh(MeshSpec(dp=1, fsdp=2, ep=2, tp=2))
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             with warnings.catch_warnings():
                 warnings.simplefilter("error")  # any fallback = test failure
                 y, stats = jax.jit(
@@ -427,7 +428,7 @@ class TestGroupedDispatch:
 
         gw_ref, gx_ref = jax.grad(loss_ref, argnums=(0, 1))(wg, x)
         mesh = build_mesh(MeshSpec(dp=1, fsdp=2, ep=2, tp=2))
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             gw, gx = jax.jit(jax.grad(loss_grp, argnums=(0, 1)))(wg, x)
         np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_ref),
                                    atol=2e-4, rtol=2e-4)
@@ -447,7 +448,7 @@ class TestGroupedDispatch:
         router, wg, wu, wd = self._big_weights(jax.random.PRNGKey(0))
         x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 128))
         mesh = build_mesh(MeshSpec(pp=2, ep=2, fsdp=2))
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             with warnings.catch_warnings():
                 warnings.simplefilter("error")  # any fallback warning fails
                 # jit required: partial-manual shard_map (pp left auto) has

@@ -16,6 +16,7 @@ from kubeflow_controller_tpu.parallel import (
 )
 from kubeflow_controller_tpu.parallel.mesh import data_parallel_size, mesh_shape_for
 from kubeflow_controller_tpu.parallel.ring import attention_reference
+from kubeflow_controller_tpu.parallel.compat import set_mesh as compat_set_mesh
 
 
 class TestMeshSpec:
@@ -71,7 +72,7 @@ class TestShardingRules:
         x = jnp.zeros((4, 8, 6))
         # No mesh context: identity.
         assert with_logical_constraint(x, ("batch", "seq", "heads")) is x
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             y = jax.jit(lambda a: with_logical_constraint(a, ("batch", "seq", "heads")))(x)
         assert y.shape == x.shape
 
@@ -93,7 +94,7 @@ class TestRingAttention:
             jax.random.normal(kk, (b, t, h, d), dtype=jnp.float32)
             for kk in jax.random.split(key, 3)
         )
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             out = ring_attention(q, k, v, mesh, causal=causal)
         ref = attention_reference(q, k, v, causal=causal)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
@@ -106,7 +107,7 @@ class TestRingAttention:
             jax.random.normal(kk, (b, t, h, d), dtype=jnp.float32)
             for kk in jax.random.split(key, 3)
         )
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             out = ring_attention(q, k, v, mesh, causal=True)
         ref = attention_reference(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
@@ -119,7 +120,7 @@ class TestRingAttention:
             jax.random.normal(kk, (b, t, h, d), dtype=jnp.float32)
             for kk in jax.random.split(key, 3)
         )
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             f = jax.jit(lambda a, b_, c: ring_attention(a, b_, c, mesh, causal=True))
             out = f(q, k, v)
         assert out.shape == (b, t, h, d)
@@ -145,7 +146,7 @@ class TestRingAttention:
         def loss_ref(q, k, v):
             return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
 
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             gq, gk, gv = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
         rq, rk, rv = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
         for got, want in ((gq, rq), (gk, rk), (gv, rv)):
@@ -179,7 +180,7 @@ class TestFlashBlock:
             jax.random.normal(kk, (b, t, h, d)).astype(jnp.bfloat16)
             for kk in jax.random.split(key, 3)
         )
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             out = ring_attention(q, k, v, mesh, causal=causal, inner="flash")
         ref = attention_reference(q, k, v, causal=causal)
         np.testing.assert_allclose(
@@ -201,7 +202,7 @@ class TestUlyssesAttention:
             jax.random.normal(kk, (b, t, h, d), dtype=jnp.float32)
             for kk in jax.random.split(key, 3)
         )
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             out = ulysses_attention(q, k, v, mesh, causal=causal)
         ref = attention_reference(q, k, v, causal=causal)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -218,7 +219,7 @@ class TestUlyssesAttention:
             jax.random.normal(kk, (b, t, h, d), dtype=jnp.float32)
             for kk in jax.random.split(key, 3)
         )
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             out = jax.jit(
                 lambda a, b_, c: ulysses_attention(a, b_, c, mesh, causal=True)
             )(q, k, v)
@@ -237,7 +238,7 @@ class TestUlyssesAttention:
             jax.random.normal(kk, (b, t, h, d), dtype=jnp.float32)
             for kk in jax.random.split(key, 3)
         )
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             g = jax.grad(
                 lambda q: jnp.mean(ulysses_attention(q, k, v, mesh) ** 2))(q)
             gr = jax.grad(
@@ -265,7 +266,7 @@ class TestUlyssesAttention:
         sharded = jax.tree.map(
             lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
             params, llama_param_pspecs(cfg))
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             out = jax.jit(
                 lambda p, t: llama_forward(p, t, cfg_u, mesh=mesh))(sharded, tokens)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),

@@ -9,6 +9,7 @@ from kubeflow_controller_tpu.models import LlamaConfig, llama_forward, llama_ini
 from kubeflow_controller_tpu.models.llama import llama_forward_pp
 from kubeflow_controller_tpu.parallel import MeshSpec, build_mesh
 from kubeflow_controller_tpu.parallel.pipeline import gpipe, split_stages
+from kubeflow_controller_tpu.parallel.compat import set_mesh as compat_set_mesh
 
 
 class TestGPipe:
@@ -29,7 +30,7 @@ class TestGPipe:
 
         mesh = build_mesh(MeshSpec(pp=2, fsdp=-1))
         stages = split_stages(params, 2)
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             out = jax.jit(lambda s, xm: gpipe(stage_fn, s, xm, mesh))(stages, x)
         np.testing.assert_allclose(
             np.asarray(out.reshape(24, D)), np.asarray(seq), atol=1e-5, rtol=1e-5)
@@ -93,7 +94,7 @@ class Test1F1B:
 
         mesh = build_mesh(MeshSpec(pp=pp, fsdp=-1))
         stages = split_stages(params, n_stages)
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             loss, gstage, gloss, gmicro = jax.jit(
                 lambda s, lp, x, t: pipeline_1f1b(
                     stage_fn, s, x, loss_fn, lp, t, mesh)
@@ -117,7 +118,7 @@ class Test1F1B:
             params, lp, x, targets, stage_fn, loss_fn)
         mesh = build_mesh(MeshSpec(pp=2, fsdp=-1))
         stages = split_stages(params, 2)
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             loss, gstage, _, _ = jax.jit(
                 lambda s, lp, x, t: pipeline_1f1b(
                     stage_fn, s, x, loss_fn, lp, t, mesh)
@@ -136,7 +137,7 @@ class TestLlamaPipeline:
         tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size)
         ref = llama_forward(params, tokens, cfg)
         mesh = build_mesh(MeshSpec(pp=2, fsdp=-1))
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             out = jax.jit(
                 lambda p, t: llama_forward_pp(p, t, cfg, mesh, n_microbatches=2)
             )(params, tokens)
@@ -155,7 +156,7 @@ class TestLlamaPipeline:
             lambda p: llama_loss(p, tokens, cfg))(params)
 
         mesh = build_mesh(MeshSpec(pp=2, fsdp=-1))
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             loss, grads = jax.jit(
                 lambda p, t: llama_loss_and_grads_pp(p, t, cfg, mesh,
                                                      n_microbatches=2)
@@ -185,7 +186,7 @@ class TestLlamaPipeline:
             lambda p: llama_loss(p, tokens, cfg))(params)
 
         mesh = build_mesh(MeshSpec(pp=2, fsdp=-1))
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             loss, grads = jax.jit(
                 lambda p, t: llama_loss_and_grads_pp(p, t, cfg, mesh,
                                                      n_microbatches=1)
@@ -224,7 +225,7 @@ class TestLlamaPipeline:
             lambda p: llama_loss(p, tokens, cfg))(params)  # non-pp grouped
 
         mesh = build_mesh(MeshSpec(pp=2, ep=2, fsdp=2))
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             with warnings.catch_warnings():
                 warnings.filterwarnings(
                     "error", message=".*moe dispatch='grouped' cannot run.*")
@@ -252,7 +253,7 @@ class TestLlamaPipeline:
         params = llama_init(jax.random.PRNGKey(0), cfg)
         tokens = jax.random.randint(jax.random.PRNGKey(6), (4, 8), 0, cfg.vocab_size)
         mesh = build_mesh(MeshSpec(pp=2, fsdp=-1))
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             loss, grads = jax.jit(
                 lambda p, t: llama_loss_and_grads_pp(p, t, cfg, mesh,
                                                      n_microbatches=2)
@@ -268,7 +269,7 @@ class TestLlamaPipeline:
         tokens = jax.random.randint(jax.random.PRNGKey(7), (4, 8), 0, cfg.vocab_size)
         ref_logits, ref_aux = llama_forward(params, tokens, cfg, return_aux=True)
         mesh = build_mesh(MeshSpec(pp=2, fsdp=-1))
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             out, aux = jax.jit(
                 lambda p, t: llama_forward_pp(p, t, cfg, mesh,
                                               n_microbatches=1,
@@ -291,7 +292,7 @@ class TestLlamaPipeline:
             logp = jax.nn.log_softmax(logits[:, :-1])
             return -jnp.mean(jnp.take_along_axis(logp, tokens[:, 1:, None], axis=-1))
 
-        with jax.set_mesh(mesh):
+        with compat_set_mesh(mesh):
             l, g = jax.jit(jax.value_and_grad(loss))(params)
         assert float(l) > 0
         gnorm = float(jnp.linalg.norm(g["layers"]["wq"]))

@@ -1,0 +1,426 @@
+"""Time-to-first-step pipeline tests: cache-key fingerprint stability,
+serialized-executable reuse across sequential fits, overlap-vs-serial
+bit-equivalence, the compile-phase heartbeat's journey to TFJobStatus,
+stall-detector interaction, rendezvous readiness, and per-process dataset
+memoization."""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import jax
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from kubeflow_controller_tpu.workloads import compile_cache as cc
+from kubeflow_controller_tpu.workloads import data as d
+from kubeflow_controller_tpu.workloads.progress import ProgressReporter, drop_filename
+from kubeflow_controller_tpu.workloads.runtime import (
+    ENV_RENDEZVOUS_DIR,
+    HostSetup,
+    JobRuntime,
+)
+
+
+# ---------------------------------------------------------------------------
+# Fingerprint
+# ---------------------------------------------------------------------------
+
+class TestFingerprint:
+    def test_stable_and_order_independent(self):
+        a = cc.fingerprint(model="mlp", bs=96, dp=2)
+        assert a == cc.fingerprint(model="mlp", bs=96, dp=2)
+        assert a == cc.fingerprint(dp=2, bs=96, model="mlp")
+        assert len(a) == 20
+        assert all(ch in "0123456789abcdef" for ch in a)
+
+    def test_shape_change_is_a_different_key(self):
+        base = cc.fingerprint(model="mlp", bs=96, dp=2, dtype="float32")
+        assert base != cc.fingerprint(model="mlp", bs=128, dp=2, dtype="float32")
+        assert base != cc.fingerprint(model="mlp", bs=96, dp=4, dtype="float32")
+        assert base != cc.fingerprint(model="mlp", bs=96, dp=2, dtype="bfloat16")
+
+    def test_stable_across_processes(self):
+        # hash() is salted per process; the fingerprint must not be.  A
+        # subprocess with a pinned, different PYTHONHASHSEED must agree
+        # with this process.
+        code = ("from kubeflow_controller_tpu.workloads.compile_cache "
+                "import fingerprint; "
+                "print(fingerprint(model='mlp', bs=96, lr=5e-3))")
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env={**os.environ, "PYTHONHASHSEED": "12345",
+                 "JAX_PLATFORMS": "cpu"},
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == cc.fingerprint(model="mlp", bs=96, lr=5e-3)
+
+
+# ---------------------------------------------------------------------------
+# AOT compile + serialized-executable reuse
+# ---------------------------------------------------------------------------
+
+def _hit_miss():
+    from kubeflow_controller_tpu.obs.metrics import REGISTRY
+
+    return (REGISTRY.counter("kctpu_compile_cache_hits_total", "").value,
+            REGISTRY.counter("kctpu_compile_cache_misses_total", "").value)
+
+
+class TestAOTCompile:
+    def test_miss_then_hit_with_metrics_and_span(self, tmp_path):
+        from kubeflow_controller_tpu.obs.trace import TRACER
+
+        jitted = jax.jit(lambda x: x * 2.0 + 1.0)
+        abstract = (jax.ShapeDtypeStruct((8,), np.float32),)
+        key = cc.fingerprint(test="aot-roundtrip", n=8)
+        h0, m0 = _hit_miss()
+        r1 = cc.aot_compile(jitted, abstract, key=key,
+                            cache_dir=str(tmp_path), what="t")
+        assert r1.source == "compiled"
+        assert os.path.exists(r1.path)
+        r2 = cc.aot_compile(jitted, abstract, key=key,
+                            cache_dir=str(tmp_path), what="t")
+        assert r2.source == "cache-hit"
+        h1, m1 = _hit_miss()
+        assert (h1 - h0, m1 - m0) == (1, 1)
+        # Both executables compute the same thing.
+        x = np.arange(8, dtype=np.float32)
+        assert np.array_equal(np.asarray(r1.compiled(x)),
+                              np.asarray(r2.compiled(x)))
+        spans = [s for s in TRACER.spans("workload/compile")
+                 if s.args.get("key") == key]
+        assert {s.args.get("source") for s in spans} == {"cache-hit", "compiled"}
+
+    def test_shape_change_misses(self, tmp_path):
+        jitted = jax.jit(lambda x: x * 3.0)
+        k8 = cc.fingerprint(test="shape", n=8)
+        k16 = cc.fingerprint(test="shape", n=16)
+        cc.aot_compile(jitted, (jax.ShapeDtypeStruct((8,), np.float32),),
+                       key=k8, cache_dir=str(tmp_path), what="t")
+        r = cc.aot_compile(jitted, (jax.ShapeDtypeStruct((16,), np.float32),),
+                           key=k16, cache_dir=str(tmp_path), what="t")
+        assert r.source == "compiled"  # a new shape never reuses the old key
+        assert cc.cache_entries(str(tmp_path))["aot"] == 2
+
+    def test_corrupt_entry_falls_back_to_compile(self, tmp_path):
+        jitted = jax.jit(lambda x: x - 1.0)
+        key = cc.fingerprint(test="corrupt")
+        r1 = cc.aot_compile(jitted, (jax.ShapeDtypeStruct((4,), np.float32),),
+                            key=key, cache_dir=str(tmp_path), what="t")
+        with open(r1.path, "wb") as fh:
+            fh.write(b"not a pickle")
+        r2 = cc.aot_compile(jitted, (jax.ShapeDtypeStruct((4,), np.float32),),
+                            key=key, cache_dir=str(tmp_path), what="t")
+        assert r2.source == "compiled"
+        assert np.allclose(np.asarray(r2.compiled(np.ones(4, np.float32))),
+                           np.zeros(4))
+
+
+class TestSequentialFits:
+    """The satellite's cross-process reuse story: two sequential
+    single-host fits against one cache dir — the second loads the first's
+    serialized executable instead of compiling (the same file-level
+    mechanism a NEW process uses, exercised here without paying a second
+    interpreter+jax boot)."""
+
+    def _run(self, cache, model_dir=None, extra=()):
+        from kubeflow_controller_tpu.workloads import mnist_dist
+
+        env = {"KCTPU_COMPILE_CACHE": cache}
+        if model_dir:
+            env["MODEL_DIR"] = model_dir
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            rc = mnist_dist.main([
+                "--platform", "cpu", "--step-loop", "--steps", "6",
+                "--batch-size", "32", "--train-size", "512",
+                "--eval-size", "256", *extra])
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        assert rc == 0
+
+    def test_second_fit_is_a_cache_hit(self, tmp_path):
+        cache = str(tmp_path / "cache")
+        h0, m0 = _hit_miss()
+        self._run(cache)
+        h1, m1 = _hit_miss()
+        assert m1 - m0 >= 1 and h1 - h0 == 0  # cold: compiled, no hit
+        self._run(cache)
+        h2, m2 = _hit_miss()
+        assert h2 - h1 >= 1 and m2 - m1 == 0  # warm: hit, zero new misses
+
+    def test_overlap_and_serial_paths_are_bit_identical(self, tmp_path):
+        from kubeflow_controller_tpu.models import mnist as m
+        from kubeflow_controller_tpu.workloads.checkpoint import CheckpointManager
+        from kubeflow_controller_tpu.workloads.trainer import (
+            default_optimizer,
+            numpy_opt_state,
+        )
+
+        target_p = m.mlp_init(0)
+        target_s = numpy_opt_state(default_optimizer(5e-3), target_p)
+        outs = {}
+        for mode, extra in (("overlap", ()), ("serial", ("--no-overlap",))):
+            mdir = str(tmp_path / f"model-{mode}")
+            self._run(str(tmp_path / f"cache-{mode}"), model_dir=mdir,
+                      extra=extra)
+            params, _, step = CheckpointManager(mdir).restore(target_p, target_s)
+            outs[mode] = (step, params)
+        assert outs["overlap"][0] == outs["serial"][0]
+        a, b = outs["overlap"][1], outs["serial"][1]
+        assert sorted(a) == sorted(b)
+        for k in a:
+            assert np.asarray(a[k]).tobytes() == np.asarray(b[k]).tobytes(), k
+
+
+# ---------------------------------------------------------------------------
+# Compile phase vs the stall detector + the progress plane
+# ---------------------------------------------------------------------------
+
+class TestCompilePhaseStall:
+    def _policy(self):
+        from kubeflow_controller_tpu.checker import StallPolicy, StallTracker
+
+        return StallTracker(StallPolicy(heartbeat_deadline_s=10.0,
+                                        step_deadline_s=10.0))
+
+    def test_compile_phase_holds_the_frozen_step_deadline(self):
+        from kubeflow_controller_tpu.api.core import PodProgress
+
+        tr = self._policy()
+        t0 = 1000.0
+        assert not tr.observe("k", PodProgress(step=0, phase="compile",
+                                               timestamp=t0), now=t0)
+        # Way past the step deadline, step frozen at 0 — but the replica
+        # says it is compiling and its keepalive keeps beats fresh.
+        for dt in (8.0, 16.0, 24.0):
+            assert not tr.observe(
+                "k", PodProgress(step=0, phase="compile", timestamp=t0 + dt),
+                now=t0 + dt)
+        # Compile ends; the advancement clock starts from the LAST compile
+        # beat, not from step-0's first sighting.
+        assert not tr.observe("k", PodProgress(step=0, phase="fit",
+                                               timestamp=t0 + 30), now=t0 + 30)
+        # A genuine post-compile freeze still trips the deadline.
+        assert tr.observe("k", PodProgress(step=0, phase="fit",
+                                           timestamp=t0 + 41), now=t0 + 41)
+
+    def test_heartbeat_deadline_still_applies_while_compiling(self):
+        from kubeflow_controller_tpu.api.core import PodProgress
+
+        tr = self._policy()
+        t0 = 1000.0
+        # Beats STOPPED mid-compile (process died): stalled regardless of
+        # the claimed phase.
+        assert tr.observe("k", PodProgress(step=0, phase="compile",
+                                           timestamp=t0), now=t0 + 11)
+
+    def test_compile_beat_reaches_job_progress(self):
+        from kubeflow_controller_tpu.api.core import (
+            PHASE_RUNNING,
+            Pod,
+            PodProgress,
+        )
+        from kubeflow_controller_tpu.api.meta import ObjectMeta
+        from kubeflow_controller_tpu.api.tfjob import (
+            ReplicaType,
+            TFJob,
+            TFReplicaSpec,
+        )
+        from kubeflow_controller_tpu.api.labels import LABEL_INDEX
+        from kubeflow_controller_tpu.planner.materialize import labels_for
+        from kubeflow_controller_tpu.updater.status import compute_progress
+
+        job = TFJob(metadata=ObjectMeta(name="j", namespace="default"))
+        job.spec.tf_replica_specs = [
+            TFReplicaSpec(replicas=1, tf_replica_type=ReplicaType.WORKER)]
+        pod = Pod(metadata=ObjectMeta(name="j-worker-0", namespace="default"))
+        pod.metadata.labels = {**labels_for(job, ReplicaType.WORKER),
+                               LABEL_INDEX: "0"}
+        pod.status.phase = PHASE_RUNNING
+        pod.status.progress = PodProgress(step=0, phase="compile",
+                                          timestamp=time.time())
+        p = compute_progress(job, {ReplicaType.WORKER: [pod]})
+        assert p is not None and p.replicas[0].phase == "compile"
+        # ... and the executable provenance rides the same plane.
+        pod.status.progress = PodProgress(step=1, phase="fit",
+                                          compile_source="cache-hit",
+                                          timestamp=time.time())
+        p = compute_progress(job, {ReplicaType.WORKER: [pod]})
+        assert p.replicas[0].compile_source == "cache-hit"
+
+
+class TestReporterCompiling:
+    def test_compiling_beats_phase_and_keepalive(self, tmp_path):
+        import json
+
+        rep = ProgressReporter(namespace="ns", name="pod-0",
+                               drop_dir=str(tmp_path))
+        path = tmp_path / drop_filename("ns", "pod-0")
+        with rep.compiling(interval_s=0.05):
+            body = json.loads(path.read_text())
+            assert body["phase"] == "compile"
+            assert rep._keepalive is not None
+            m0 = path.stat().st_mtime_ns
+            deadline = time.time() + 5
+            while path.stat().st_mtime_ns == m0 and time.time() < deadline:
+                time.sleep(0.02)
+            assert path.stat().st_mtime_ns > m0  # keepalive re-drops
+        assert rep._keepalive is None
+        rep.beat(phase="fit", compile_source="cache-hit")
+        body = json.loads(path.read_text())
+        assert body["phase"] == "fit"
+        assert body["compileSource"] == "cache-hit"
+
+
+# ---------------------------------------------------------------------------
+# Overlap helper + rendezvous readiness
+# ---------------------------------------------------------------------------
+
+class TestHostSetup:
+    def test_overlap_runs_in_background(self):
+        started = threading.Event()
+
+        def fn():
+            started.set()
+            return 41 + 1
+
+        hs = HostSetup(fn, overlap=True)
+        assert started.wait(timeout=5.0)
+        assert hs.result() == 42
+
+    def test_serial_defers_until_result(self):
+        calls = []
+        hs = HostSetup(lambda: calls.append(1) or "v", overlap=False)
+        assert calls == []  # nothing ran yet: the serial baseline ordering
+        assert hs.result() == "v"
+        assert calls == [1]
+        assert hs.result() == "v"  # memoized, not re-run
+        assert calls == [1]
+
+    def test_exception_propagates(self):
+        hs = HostSetup(lambda: 1 / 0, overlap=True)
+        with pytest.raises(ZeroDivisionError):
+            hs.result()
+
+
+class TestRendezvousReadiness:
+    def test_coordinator_drops_ready_file(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(ENV_RENDEZVOUS_DIR, str(tmp_path))
+        rt = JobRuntime(coordinator="svc.example:2222", num_processes=2,
+                        process_id=0)
+        rt._drop_ready_file()
+        assert os.path.exists(tmp_path / "svc.example_2222.ready")
+
+    def test_worker_waits_for_drop_then_port(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(ENV_RENDEZVOUS_DIR, str(tmp_path))
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        port = srv.getsockname()[1]
+        coord = f"127.0.0.1:{port}"
+        rt = JobRuntime(coordinator=coord, num_processes=2, process_id=1)
+
+        def coordinator_side():
+            time.sleep(0.15)
+            JobRuntime(coordinator=coord, num_processes=2,
+                       process_id=0)._drop_ready_file()
+            srv.listen(1)
+
+        t = threading.Thread(target=coordinator_side, daemon=True)
+        t0 = time.monotonic()
+        t.start()
+        rt._wait_coordinator(timeout_s=10.0)
+        took = time.monotonic() - t0
+        srv.close()
+        assert 0.1 < took < 5.0  # waited for the drop, then connected
+
+    def test_no_dir_falls_back_to_tcp_poll(self, monkeypatch):
+        monkeypatch.delenv(ENV_RENDEZVOUS_DIR, raising=False)
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        rt = JobRuntime(coordinator=f"127.0.0.1:{srv.getsockname()[1]}",
+                        num_processes=2, process_id=1)
+        t0 = time.monotonic()
+        rt._wait_coordinator(timeout_s=5.0)
+        assert time.monotonic() - t0 < 2.0
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Memoization
+# ---------------------------------------------------------------------------
+
+class TestDatasetMemoization:
+    def test_teacher_means_is_one_shared_readonly_array(self):
+        a = d.mnist_teacher_means()
+        b = d.mnist_teacher_means()
+        assert a is b
+        assert not a.flags.writeable
+
+    def test_synthetic_mnist_memoized_per_seed_and_size(self):
+        a = d.synthetic_mnist(7, 64)
+        assert d.synthetic_mnist(7, 64)[0] is a[0]
+        assert d.synthetic_mnist(8, 64)[0] is not a[0]
+        assert d.synthetic_mnist(7, 128)[0] is not a[0]
+
+    def test_numpy_and_jax_variants_sample_the_same_mixture(self):
+        xn, yn = d.synthetic_mnist_np(3, 32)
+        xj, yj = d.synthetic_mnist(3, 32)
+        assert np.array_equal(xn, np.asarray(xj))
+        assert np.array_equal(yn.astype(np.int32), np.asarray(yj))
+
+    def test_tokens_memoized(self):
+        a = d.synthetic_tokens(1, 4, 16, 32)
+        assert d.synthetic_tokens(1, 4, 16, 32) is a
+        assert d.synthetic_tokens(2, 4, 16, 32) is not a
+
+
+# ---------------------------------------------------------------------------
+# Env plumbing: planner + kubelet
+# ---------------------------------------------------------------------------
+
+class TestCompileCacheEnvPlumbing:
+    def test_planner_injects_spec_dir_next_to_model_dir(self):
+        from kubeflow_controller_tpu.api.meta import ObjectMeta
+        from kubeflow_controller_tpu.api.tfjob import TFJob
+        from kubeflow_controller_tpu.planner.materialize import (
+            ENV_COMPILE_CACHE,
+            _dir_env,
+        )
+
+        job = TFJob(metadata=ObjectMeta(name="j"))
+        job.spec.model_dir = "/ckpt"
+        job.spec.compile_cache_dir = "/jit-cache"
+        env = _dir_env(job)
+        assert env["MODEL_DIR"] == "/ckpt"
+        assert env[ENV_COMPILE_CACHE] == "/jit-cache"
+
+    def test_kubelet_node_default_yields_to_spec_env(self):
+        from kubeflow_controller_tpu.cluster import Cluster, FakeKubelet
+        from kubeflow_controller_tpu.planner.materialize import ENV_COMPILE_CACHE
+
+        kubelet = FakeKubelet(Cluster())
+        try:
+            env: dict = {}
+            kubelet._wire_startup_env(env)
+            assert env[ENV_COMPILE_CACHE] == kubelet._compile_cache_dir
+            assert env[ENV_RENDEZVOUS_DIR] == kubelet._rendezvous_dir
+            pinned = {ENV_COMPILE_CACHE: "/job-pinned"}
+            kubelet._wire_startup_env(pinned)
+            assert pinned[ENV_COMPILE_CACHE] == "/job-pinned"
+        finally:
+            kubelet.stop()
