@@ -619,6 +619,277 @@ def _pct(values, q):
     return vs[min(len(vs) - 1, int(round(q / 100.0 * (len(vs) - 1))))]
 
 
+def run_chaos(n_jobs: int, kills: int, seed: int = 7, steps: int = 240,
+              checkpoint_every: int = 40, workers: int = 2,
+              batch: int = 256, step_sleep: float = 0.01,
+              simulated: bool = False, deadline_s: float = 240.0) -> dict:
+    """Chaos bench (recovery plane): N gang training jobs, K pods SIGKILLed
+    at randomized mid-fit steps, measuring what recovery actually costs.
+
+    Executed mode (default): each job is a ``workers``-wide dist-mnist
+    ``--step-loop`` gang (gang_restart semantics — one failure domain) with
+    periodic async Orbax checkpoints every ``checkpoint_every`` steps into
+    a per-job MODEL_DIR and a SHARED compile cache, so recovery is
+    restore + cache-hit (PR 8), not restore + recompile.  A seeded monkey
+    (recovery/chaos.py) SIGKILLs one random worker per planned kill once
+    the job's progress passes a randomized trigger step; the controller's
+    restart policy replaces the whole gang under a bumped generation; the
+    replacement restores and resumes.  Jobs run sequentially — the 1-core
+    CI host cannot overlap two real training gangs honestly.
+
+    Per kill: steps lost (step_at_kill - resumed_from_step, bounded by the
+    checkpoint interval when resume works), and recovery latency (kill ->
+    job's min step back past the pre-kill step).  Plus the policy probe:
+    a ``restart_policy: Never`` pod is killed and must yield terminal
+    Failed with a policy reason — no hang, no restart.
+
+    ``simulated=True`` swaps the training gangs for PhasePolicy-simulated
+    pods (kills flip them Failed through the injected-failure path):
+    orchestration-only, no checkpoint math, used to chaos-test the
+    controller at job counts real training cannot reach."""
+    import shutil
+    import tempfile
+
+    from kubeflow_controller_tpu.api.core import (
+        Container,
+        EnvVar,
+        PodTemplateSpec,
+    )
+    from kubeflow_controller_tpu.api.meta import ObjectMeta
+    from kubeflow_controller_tpu.api.tfjob import (
+        ReplicaType,
+        TFJob,
+        TFJobPhase,
+        TFReplicaSpec,
+    )
+    from kubeflow_controller_tpu.cluster import Cluster, FakeKubelet, PhasePolicy
+    from kubeflow_controller_tpu.controller import Controller
+    from kubeflow_controller_tpu.recovery.chaos import ChaosMonkey, ChaosReport
+
+    cluster = Cluster()
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=4.0,
+                                                      heartbeat_s=0.05),
+                          execute=not simulated)
+    ctrl = Controller(cluster, resync_period_s=1.0)
+    kubelet.start()
+    ctrl.run(threadiness=2)
+    if not simulated:
+        kubelet.wait_warm()
+    monkey = ChaosMonkey(cluster, kubelet, seed=seed)
+    tmp_roots = []
+
+    def fresh_dir(prefix: str) -> str:
+        d = tempfile.mkdtemp(prefix=prefix)
+        tmp_roots.append(d)
+        return d
+
+    cache_dir = fresh_dir("chaos-cache-")
+
+    def mk_train_job(name: str) -> TFJob:
+        job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+        job.spec.model_dir = fresh_dir(f"chaos-ckpt-{name}-")
+        job.spec.compile_cache_dir = cache_dir
+        job.spec.checkpoint_every_steps = checkpoint_every
+        t = PodTemplateSpec()
+        c = Container(
+            name="tensorflow", image="dist",
+            command=[sys.executable, "-m",
+                     "kubeflow_controller_tpu.workloads.mnist_dist",
+                     "--platform", "cpu", "--step-loop",
+                     "--steps", str(steps), "--batch-size", str(batch),
+                     "--train-size", "4096", "--eval-size", "512"],
+            working_dir=REPO,
+        )
+        c.env.append(EnvVar(name="KCTPU_STEP_SLEEP", value=str(step_sleep)))
+        t.spec.containers.append(c)
+        t.spec.restart_policy = "OnFailure"
+        job.spec.tf_replica_specs = [TFReplicaSpec(
+            replicas=workers, tf_replica_type=ReplicaType.WORKER, template=t,
+            gang_restart=True)]
+        return job
+
+    def mk_sim_job(name: str) -> TFJob:
+        job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+        for typ, n in ((ReplicaType.PS, 1), (ReplicaType.WORKER, 2)):
+            t = PodTemplateSpec()
+            t.spec.containers.append(Container(name="tensorflow", image="img"))
+            t.spec.restart_policy = "OnFailure"
+            job.spec.tf_replica_specs.append(
+                TFReplicaSpec(replicas=n, tf_replica_type=typ, template=t))
+        return job
+
+    def wait_phase(name: str, want, timeout: float):
+        end = time.time() + timeout
+        j = None
+        while time.time() < end:
+            j = cluster.tfjobs.get("default", name)
+            if j.status.phase in (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED):
+                return j.status.phase == want, j
+            time.sleep(0.05)
+        return False, j
+
+    report = ChaosReport()
+    succeeded = []
+    failed = []
+    # Spread K kills over the N jobs round-robin.
+    kills_per_job = [kills // n_jobs + (1 if i < kills % n_jobs else 0)
+                     for i in range(n_jobs)]
+    never_probe = {"terminal_failed": False, "reason": "", "elapsed_s": 0.0}
+    restarts_total = 0
+    chaos_elapsed = 0.0
+    try:
+        t_all = time.time()
+        for i in range(n_jobs):
+            name = f"chaos-{i:02d}"
+            job = mk_sim_job(name) if simulated else mk_train_job(name)
+            cluster.tfjobs.create(job)
+            for _ in range(kills_per_job[i]):
+                # Strike after the first checkpoint interval (so resume has
+                # something to restore) at a randomized trigger step.
+                lo = checkpoint_every + 5
+                hi = max(lo + 1, min(2 * checkpoint_every + 20, steps - 40))
+                trigger = (monkey.rng.randint(5, 30) if simulated
+                           else monkey.rng.randint(lo, hi))
+                rec = monkey.kill_at_step("default", name, trigger,
+                                          deadline_s=deadline_s)
+                if rec is None:
+                    continue  # job ended before the trigger: no kill
+                monkey.await_recovery("default", rec,
+                                      deadline_s=deadline_s)
+                report.kills.append(rec)
+            ok, j = wait_phase(name, TFJobPhase.SUCCEEDED, deadline_s)
+            (succeeded if ok else failed).append(name)
+            if j is not None:
+                restarts_total += sum(
+                    rs.restarts for rs in j.status.tf_replica_statuses)
+        chaos_elapsed = time.time() - t_all
+
+        # --- restart_policy: Never probe -------------------------------
+        probe = TFJob(metadata=ObjectMeta(name="chaos-never",
+                                          namespace="default"))
+        t = PodTemplateSpec()
+        if simulated:
+            t.spec.containers.append(Container(name="main", image="img"))
+        else:
+            t.spec.containers.append(Container(
+                name="main", image="sleep",
+                command=[sys.executable, "-c",
+                         "import time; time.sleep(120)"],
+                working_dir=REPO))
+        t.spec.restart_policy = "Never"
+        probe.spec.tf_replica_specs = [TFReplicaSpec(
+            replicas=1, tf_replica_type=ReplicaType.WORKER, template=t)]
+        t0 = time.time()
+        cluster.tfjobs.create(probe)
+        end = time.time() + 30
+        killed = False
+        while time.time() < end and not killed:
+            for p in cluster.pods.list("default"):
+                if (p.metadata.labels.get("tf_job_name") == "chaos-never"
+                        and p.status.phase == "Running"):
+                    killed = monkey.kill_pod(
+                        "default", p.metadata.name) is not None
+                    break
+            time.sleep(0.05)
+        if killed:
+            # wait_phase returns ok==True only for the WANTED phase; we
+            # asked for FAILED, so ok IS the terminal-Failed verdict.
+            ok_failed, j = wait_phase("chaos-never", TFJobPhase.FAILED, 30.0)
+            never_probe["terminal_failed"] = bool(ok_failed)
+            never_probe["reason"] = j.status.reason if j is not None else ""
+            never_probe["elapsed_s"] = round(time.time() - t0, 3)
+    finally:
+        ctrl.stop()
+        kubelet.stop()
+        for d in tmp_roots:
+            shutil.rmtree(d, ignore_errors=True)
+
+    events = [e for e in ctrl.recorder.all_events()
+              if e.reason in ("ReplicaRestarted", "BackoffLimitExceeded")]
+    return {
+        "jobs": n_jobs,
+        "kills_planned": kills,
+        "kills_executed": len(report.kills),
+        "seed": seed,
+        "simulated": simulated,
+        "steps": steps,
+        "checkpoint_every": checkpoint_every,
+        "elapsed_s": round(chaos_elapsed, 3),
+        "succeeded": succeeded,
+        "failed": failed,
+        "recovered_rate": round(report.recovered_rate, 4),
+        "recovery_p50_s": round(report.recovery_percentile(50), 3),
+        "recovery_p99_s": round(report.recovery_percentile(99), 3),
+        "max_lost_steps": report.max_lost_steps,
+        "restarts_total": restarts_total,
+        "restart_events": sum(e.count for e in events
+                              if e.reason == "ReplicaRestarted"),
+        "kill_records": [{
+            "job": k.job, "pod": k.pod, "mode": k.mode,
+            "step_at_kill": k.step_at_kill,
+            "resumed_from_step": k.resumed_from_step,
+            "lost_steps": k.lost_steps,
+            "recovered": k.recovered,
+            "recovery_s": round(k.recovery_s, 3),
+        } for k in report.kills],
+        "never_probe": never_probe,
+    }
+
+
+def chaos_main(args) -> int:
+    result = run_chaos(args.chaos, kills=args.kills, seed=args.seed,
+                       checkpoint_every=args.checkpoint_every,
+                       simulated=args.simulated,
+                       deadline_s=args.deadline or 240.0)
+    print(json.dumps({
+        "metric": (f"chaos_{result['jobs']}_jobs_{result['kills_planned']}"
+                   f"_kills_recovery_p99"),
+        "value": result["recovery_p99_s"],
+        "unit": "s",
+        "details": result,
+    }))
+    rc = 0
+    if result["failed"]:
+        print(f"chaos bench: {len(result['failed'])} jobs did not reach "
+              f"Succeeded: {result['failed']}", file=sys.stderr)
+        rc = 1
+    if result["kills_executed"] < 1:
+        print("chaos bench: no kill was executed (jobs finished before "
+              "the trigger — widen steps/step-sleep)", file=sys.stderr)
+        rc = 1
+    if result["recovered_rate"] < 1.0 and result["kills_executed"]:
+        print(f"chaos bench regression: recovered-Succeeded rate "
+              f"{result['recovered_rate']} < 1.0", file=sys.stderr)
+        rc = 1
+    if not result["simulated"]:
+        bad = [k for k in result["kill_records"]
+               if k["lost_steps"] < 0
+               or k["lost_steps"] > result["checkpoint_every"]]
+        if bad:
+            print(f"chaos bench regression: lost steps exceed the "
+                  f"checkpoint interval ({result['checkpoint_every']}): "
+                  f"{bad}", file=sys.stderr)
+            rc = 1
+    if (args.max_recovery_p99 > 0
+            and result["recovery_p99_s"] > args.max_recovery_p99):
+        print(f"chaos bench regression: recovery p99 "
+              f"{result['recovery_p99_s']}s > --max-recovery-p99 "
+              f"{args.max_recovery_p99}", file=sys.stderr)
+        rc = 1
+    if not result["never_probe"]["terminal_failed"]:
+        print(f"chaos bench regression: restart_policy Never kill did not "
+              f"yield terminal Failed: {result['never_probe']}",
+              file=sys.stderr)
+        rc = 1
+    elif not result["never_probe"]["reason"].startswith(
+            ("RestartPolicyNever", "BackoffLimitExceeded")):
+        print(f"chaos bench regression: Never-probe reason lacks the "
+              f"policy verdict: {result['never_probe']['reason']!r}",
+            file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def _ttfs_phases(trace_dir: str) -> dict:
     """Per-phase breakdown of one TTFS run from the workers' span dumps:
     worst-across-workers duration per pipeline phase (the job's TTFS is
@@ -1486,6 +1757,28 @@ def main(argv=None) -> int:
                    help="ttfs mode: exit nonzero unless overlapped cold "
                         "TTFS is strictly below the serial --no-overlap "
                         "baseline")
+    p.add_argument("--chaos", type=int, default=0, metavar="N",
+                   help="run the chaos/recovery benchmark: N dist-mnist "
+                        "--step-loop gang jobs with periodic checkpoints, "
+                        "--kills pods SIGKILLed at randomized mid-fit "
+                        "steps; gates recovered-Succeeded, lost steps vs "
+                        "the checkpoint interval, and the restart_policy "
+                        "Never terminal-Failed probe")
+    p.add_argument("--kills", type=int, default=2, metavar="K",
+                   help="chaos mode: pods to kill (spread over the jobs)")
+    p.add_argument("--seed", type=int, default=7, metavar="S",
+                   help="chaos mode: fault-injection RNG seed")
+    p.add_argument("--checkpoint-every", type=int, default=40, metavar="N",
+                   help="chaos mode: spec.checkpoint_every_steps for the "
+                        "jobs (the lost-steps bound)")
+    p.add_argument("--simulated", action="store_true",
+                   help="chaos mode: PhasePolicy-simulated pods instead of "
+                        "real training (orchestration-only chaos at scale; "
+                        "no lost-steps accounting)")
+    p.add_argument("--max-recovery-p99", type=float, default=0.0,
+                   metavar="S",
+                   help="chaos mode: exit nonzero when recovery-time p99 "
+                        "exceeds S seconds (0 = no gate)")
     p.add_argument("--churn", type=int, default=0, metavar="N",
                    help="run the watch-plane churn benchmark: N simulated "
                         "TFJobs over the REST transport with every watch "
@@ -1541,6 +1834,8 @@ def main(argv=None) -> int:
         return scale_main(args)
     if args.replicas:
         return widejob_main(args)
+    if args.chaos:
+        return chaos_main(args)
     if args.churn:
         return churn_main(args)
     if args.contend:

@@ -105,6 +105,11 @@ class PodProgress:
     # Executable provenance ("cache-hit" | "compiled"), reported by the
     # TTFS pipeline once the compile phase resolves.
     compile_source: str = ""
+    # Step the workload restored from at (re)start (0 = fresh start):
+    # the recovery plane's lost-work accounting, and — together with
+    # phase="restore" — what tells the stall detector a step counter that
+    # jumped backward is a resume, not a stall.
+    resumed_from_step: int = 0
     # Wall-clock of the beat (stamped server-side when the reporter left
     # it 0, so clock-skewed workloads cannot fake liveness).
     timestamp: float = 0.0

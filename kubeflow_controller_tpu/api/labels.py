@@ -31,6 +31,13 @@ ANNOTATION_SLICE_INDEX = f"{DOMAIN}/slice-index"
 # Scheduling priority class (spec.priorityClassName, stamped per pod so the
 # gang scheduler reads it at admission time): "low" | "default" | "high".
 ANNOTATION_PRIORITY_CLASS = f"{DOMAIN}/priority-class"
+# --- recovery plane (net-new) ---
+# Gang generation: bumped on the TFJob by the controller each time it
+# replaces a torn gang, stamped onto every member pod (annotation + the
+# KCTPU_GANG_GENERATION env) so a replacement gang rendezvouses in a fresh
+# namespace — generation-keyed readiness drops and coordinator ports can
+# never collide with the dead generation's leftovers.
+ANNOTATION_GANG_GENERATION = f"{DOMAIN}/gang-generation"
 
 
 def selector_for(job_name: str, replica_type: str, runtime_id: str) -> dict:

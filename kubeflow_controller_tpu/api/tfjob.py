@@ -183,6 +183,12 @@ class TFReplicaSpec:
     termination_policy: Optional[TerminationPolicySpec] = None
     # Net-new: present iff tf_replica_type == TPU.
     tpu: Optional[TPUSpec] = None
+    # Net-new (recovery plane): treat this replica set as ONE failure
+    # domain — any member failing replaces the whole set at once, exactly
+    # like a TPU slice (a multi-process jax.distributed Worker gang's torn
+    # collective cannot be rejoined member-by-member).  TPU replicas always
+    # behave this way; Worker gangs opt in.
+    gang_restart: bool = False
 
 
 @dataclass
@@ -208,6 +214,16 @@ class TFJobSpec:
     # admitted first under slice contention and may preempt strictly lower
     # ones (scheduler/).
     priority_class_name: str = ""
+    # Net-new (recovery plane): periodic checkpoint interval for the
+    # workload's step loop (steps between async CheckpointManager saves;
+    # 0 = only the final save).  Bounds the steps a kill can lose to the
+    # interval.  Injected as $KCTPU_CHECKPOINT_EVERY next to the *Dir env.
+    checkpoint_every_steps: int = 0
+    # Net-new (recovery plane): consecutive failures of one replica index
+    # tolerated before the job goes terminal Failed with
+    # BackoffLimitExceeded (the k8s Job field; -1 = unlimited).  The streak
+    # resets after RestartPolicyConfig.reset_after_s of healthy Running.
+    backoff_limit: int = 6
     tf_replica_specs: List[TFReplicaSpec] = field(default_factory=list)
 
 
@@ -231,6 +247,10 @@ class TFReplicaStatus:
     state: TFReplicaState = TFReplicaState.UNKNOWN
     pod_names: List[str] = field(default_factory=list)
     tf_replicas_states: Dict[TFReplicaState, int] = field(default_factory=dict)
+    # Net-new (recovery plane): monotonic restart count across this type's
+    # indices (the kubectl RESTARTS analog; fed by the controller's
+    # RestartTracker, never reset by streak forgiveness).
+    restarts: int = 0
 
 
 @dataclass
@@ -246,6 +266,10 @@ class ReplicaProgress:
     # How this replica obtained its executable ("cache-hit" | "compiled"),
     # once it reported — the warm-restart evidence on the status surface.
     compile_source: str = ""
+    # Step the replica restored from on (re)start (0 = fresh start): the
+    # checkpoint-resume evidence — lost work after a kill is bounded by
+    # step_at_kill - resumed_from_step <= spec.checkpoint_every_steps.
+    resumed_from_step: int = 0
     last_heartbeat: float = 0.0
     stalled: bool = False
 
@@ -333,6 +357,10 @@ def validate_tfjob(job: TFJob) -> None:
         raise ValidationError(
             f"unknown priorityClassName {job.spec.priority_class_name!r} "
             "(want low | default | high)")
+    if job.spec.checkpoint_every_steps < 0:
+        raise ValidationError("checkpointEverySteps must be >= 0")
+    if job.spec.backoff_limit < -1:
+        raise ValidationError("backoffLimit must be >= -1 (-1 = unlimited)")
     specs = job.spec.tf_replica_specs
     if not specs:
         raise ValidationError("spec.tfReplicaSpecs must be non-empty")
@@ -346,6 +374,11 @@ def validate_tfjob(job: TFJob) -> None:
             raise ValidationError(f"{s.tf_replica_type.value}: template is required")
         if not s.template.spec.containers:
             raise ValidationError(f"{s.tf_replica_type.value}: template needs >= 1 container")
+        if s.gang_restart and s.tf_replica_type not in (ReplicaType.WORKER,
+                                                        ReplicaType.TPU):
+            raise ValidationError(
+                f"{s.tf_replica_type.value}: gangRestart applies only to "
+                "Worker/TPU replica sets")
         if s.tf_replica_type == ReplicaType.LOCAL:
             if len(specs) != 1:
                 raise ValidationError("Local jobs must have exactly one replica spec")

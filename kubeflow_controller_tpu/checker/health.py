@@ -114,8 +114,9 @@ class StallTracker:
         self.policy = policy or StallPolicy()
         self._lock = threading.Lock()
         # pod key -> (last step, wall clock when the step last advanced,
-        #             wall clock of the last observation — for pruning)
-        self._steps: Dict[str, Tuple[int, float, float]] = {}
+        #             wall clock of the last observation — for pruning,
+        #             restoring: True while the pod is mid-restore)
+        self._steps: Dict[str, Tuple[int, float, float, bool]] = {}
 
     def observe(self, key: str, progress, now: Optional[float] = None) -> bool:
         """Record one observation of a Running pod's progress; returns True
@@ -133,15 +134,28 @@ class StallTracker:
         # whole compile as "time since last advance" once training starts.
         # The heartbeat deadline above still applies — a compile whose
         # process died stops beating and is flagged like any other hang.
-        compiling = getattr(progress, "phase", "") == "compile"
+        # phase="restore" gets the same hold: a replica restoring a
+        # checkpoint after an in-place restart beats with a frozen (or
+        # backward-jumped) step counter while Orbax reads the tree.
+        held_phase = getattr(progress, "phase", "") in ("compile", "restore")
         with self._lock:
-            last_step, advanced_at, _ = self._steps.get(key, (None, 0.0, 0.0))
-            if last_step is None or progress.step != last_step or compiling:
-                # First sighting, or the counter moved (a DECREASE is an
-                # in-place workload restart — progress reset, not a stall).
-                # The advancement clock is the beat's own time.
+            last_step, advanced_at, _, restoring = self._steps.get(
+                key, (None, 0.0, 0.0, False))
+            if last_step is not None and progress.step < last_step:
+                # Step DECREASED: an in-place restart resuming from an
+                # older checkpoint, not a stall.  Enter the restore hold —
+                # the frozen-step deadline stays parked until the counter
+                # moves FORWARD again (mirroring the compile-phase hold);
+                # the heartbeat deadline still applies throughout.
+                restoring = True
+            elif last_step is not None and progress.step > last_step:
+                restoring = False  # training advanced: hold released
+            if (last_step is None or progress.step != last_step
+                    or held_phase or restoring):
+                # First sighting, the counter moved, or a held phase:
+                # the advancement clock is the beat's own time.
                 advanced_at = progress.timestamp or t
-            self._steps[key] = (progress.step, advanced_at, t)
+            self._steps[key] = (progress.step, advanced_at, t, restoring)
             if len(self._steps) % 256 == 0:
                 self._prune_locked(t)
         if (not stalled and pol.step_deadline_s > 0
@@ -155,7 +169,8 @@ class StallTracker:
 
     def _prune_locked(self, now: float) -> None:
         cutoff = now - self.policy.prune_after_s
-        for k in [k for k, (_, _, seen) in self._steps.items() if seen < cutoff]:
+        for k in [k for k, (_, _, seen, _) in self._steps.items()
+                  if seen < cutoff]:
             del self._steps[k]
 
     def __len__(self) -> int:
@@ -165,8 +180,13 @@ class StallTracker:
 
 def check_health(job: TFJob, pods_by_type: Dict[ReplicaType, List[Pod]],
                  now: Optional[float] = None,
-                 tracker: Optional[StallTracker] = None) -> JobHealth:
+                 tracker: Optional[StallTracker] = None,
+                 exhausted: Optional[Dict[ReplicaType, set]] = None) -> JobHealth:
+    """``exhausted`` (optional): replica indices whose restart budget the
+    recovery policy has spent — failures there are terminal even under a
+    replace-on-failure restart policy."""
     out = JobHealth()
+    exhausted = exhausted or {}
     for spec in job.spec.tf_replica_specs:
         typ = spec.tf_replica_type
         desired = desired_replicas(spec)
@@ -203,7 +223,9 @@ def check_health(job: TFJob, pods_by_type: Dict[ReplicaType, List[Pod]],
             1 for i in range(desired)
             if any(p.status.phase == PHASE_SUCCEEDED for p in by_idx.get(i, []))
         )
-        if rh.failed and not replace:
+        if rh.failed and (not replace or exhausted.get(typ)):
+            # Terminal by policy: restartPolicy Never, or the recovery
+            # plane's backoff limit is exhausted for an index of this type.
             rh.health = Health.FAILED
         elif typ != ReplicaType.PS and desired > 0 and succeeded_indices == desired:
             rh.health = Health.COMPLETE

@@ -202,7 +202,7 @@ def cmd_get(args) -> int:
         print("No resources found.")
         return 0
     print(f"{'NAMESPACE':<12} {'NAME':<32} {'PHASE':<12} {'REASON':<28} "
-          f"{'STEP':<10} {'RATE':<10} REPLICAS")
+          f"{'STEP':<10} {'RATE':<10} {'RESTARTS':<9} REPLICAS")
     for j in jobs:
         kinds = ",".join(
             f"{s.tf_replica_type.value}x{s.replicas}" for s in j.spec.tf_replica_specs
@@ -218,8 +218,12 @@ def cmd_get(args) -> int:
         if len(reason) > 27:
             reason = reason[:26] + "…"
         step, rate = _progress_cells(j)
+        # kubectl RESTARTS parity: the recovery plane's monotonic restart
+        # total across every replica of the job.
+        restarts = sum(rs.restarts for rs in j.status.tf_replica_statuses)
         print(f"{j.metadata.namespace:<12} {j.metadata.name:<32} "
-              f"{phase:<12} {reason:<28} {step:<10} {rate:<10} {kinds}")
+              f"{phase:<12} {reason:<28} {step:<10} {rate:<10} "
+              f"{restarts:<9} {kinds}")
     return 0
 
 
@@ -252,7 +256,9 @@ def cmd_describe(args) -> int:
         print(f"Condition: {c.type.value}={c.status} {c.reason}{msg}")
     for rs in j.status.tf_replica_statuses:
         hist = {k.value: v for k, v in rs.tf_replicas_states.items()}
-        print(f"Replicas:  {rs.type.value}: state={rs.state.value} {hist}")
+        restarts = f" restarts={rs.restarts}" if rs.restarts else ""
+        print(f"Replicas:  {rs.type.value}: state={rs.state.value} "
+              f"{hist}{restarts}")
         for pn in rs.pod_names:
             print(f"           pod {pn}")
     _describe_health(cluster, j, ns)
@@ -322,9 +328,11 @@ def _describe_progress(j) -> None:
                 if r.last_heartbeat else "never")
         mark = "  STALLED" if r.stalled else ""
         src = f" compile={r.compile_source}" if r.compile_source else ""
+        res = (f" resumed@{r.resumed_from_step}"
+               if r.resumed_from_step else "")
         print(f"  {r.type.value}-{r.index}: step={r.step} "
               f"rate={r.examples_per_sec:g} loss={r.loss:g} "
-              f"phase={r.phase or '-'}{src} beat {beat}{mark}")
+              f"phase={r.phase or '-'}{src}{res} beat {beat}{mark}")
 
 
 def _describe_health(cluster, job, ns: str) -> None:

@@ -528,6 +528,62 @@ class FakeKubelet:
             self.set_phase(pod.metadata.namespace, pod.metadata.name,
                            PHASE_FAILED, reason=reason)
 
+    @staticmethod
+    def _is_gang_member(env: Dict[str, str]) -> bool:
+        """A multi-process jax.distributed member must NEVER restart in
+        place: its world died (or it is the one that died) and a rejoined
+        process would hang against the torn collective.  The pod fails
+        instead, and the controller's recovery plane replaces the whole
+        gang under a fresh generation."""
+        from ..planner.materialize import ENV_NUM_PROCESSES
+
+        try:
+            return int(env.get(ENV_NUM_PROCESSES, "1") or "1") > 1
+        except ValueError:
+            return False
+
+    def chaos_kill(self, namespace: str, name: str) -> Optional[str]:
+        """Chaos-plane fault injection (recovery/chaos.py): kill one pod the
+        way its runtime mode dies for real — SIGKILL the executed process
+        (cold subprocess or warm zygote fork), else flip the simulated pod
+        to Failed through the injected-failure path slice failures use.
+        Returns the mode used ("process" | "warm" | "simulated") or None
+        when there was nothing to kill."""
+        import signal as _signal
+
+        key = f"{namespace}/{name}"
+        proc = self._procs.get(key)
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.send_signal(_signal.SIGKILL)
+                return "process"
+            except OSError:
+                return None
+        warm = self._warm.get(key)
+        if warm is not None:
+            if warm.pid:
+                try:
+                    os.kill(warm.pid, _signal.SIGKILL)
+                    return "warm"
+                except OSError:
+                    pass
+            if self._pool is not None:
+                self._pool.kill(warm)
+                return "warm"
+            return None
+        try:
+            pod = self.cluster.pods.get(namespace, name)
+        except NotFound:
+            return None
+        if pod.status.phase in (PHASE_PENDING, PHASE_RUNNING):
+            # Simulated pod: same flow as a slice failure — suppress the
+            # in-place outcome and let the controller replace it.
+            self._injected_failures.add(key)
+            self.set_phase(namespace, name, PHASE_FAILED,
+                           reason="ChaosKill: injected fault")
+            return "simulated"
+        return None
+
     def fail_slice(self, slice_name: str, reason: str = "SliceFailed") -> list:
         """Inject a whole-slice failure — the TPU failure domain (SURVEY §5):
         every pod of the gang bound to the slice has its process killed and
@@ -614,8 +670,13 @@ class FakeKubelet:
         distinct coordinator hostname to a stable free localhost port so
         every pod of a gang rendezvouses at the same 127.0.0.1 address —
         the same indirection kube-dns provides, collapsed to one machine.
+
+        The mapping is keyed by (hostname, gang generation): a replacement
+        gang (recovery plane) gets a FRESH port, so its coordinator can
+        never race the dead generation's not-yet-released socket — the
+        fake-DNS analog of the generation-keyed readiness drops.
         """
-        from ..planner.materialize import ENV_COORDINATOR
+        from ..planner.materialize import ENV_COORDINATOR, ENV_GANG_GENERATION
 
         addr = env.get(ENV_COORDINATOR, "")
         if not addr or ":" not in addr:
@@ -628,14 +689,15 @@ class FakeKubelet:
             return  # already an IP literal
         except OSError:
             pass
+        dns_key = f"{host}#g{env.get(ENV_GANG_GENERATION, '0') or '0'}"
         with self._svc_lock:
-            port = self._svc_ports.get(host)
+            port = self._svc_ports.get(dns_key)
             if port is None:
                 s = socket.socket()
                 s.bind(("127.0.0.1", 0))
                 port = s.getsockname()[1]
                 s.close()
-                self._svc_ports[host] = port
+                self._svc_ports[dns_key] = port
         env[ENV_COORDINATOR] = f"127.0.0.1:{port}"
 
     def _wire_progress_env(self, pod: Pod, env: Dict[str, str]) -> None:
@@ -725,12 +787,31 @@ class FakeKubelet:
             if proc.returncode == 0:
                 self.set_phase(ns, name, PHASE_SUCCEEDED)
                 return
-            if pod.spec.restart_policy in ("Always", "OnFailure") and restarts < self.max_restarts:
+            if (pod.spec.restart_policy in ("Always", "OnFailure")
+                    and restarts < self.max_restarts
+                    and not self._is_gang_member(env)):
+                # Gang members never restart in place (torn collective);
+                # the recovery plane replaces the whole gang instead.
                 restarts += 1
                 continue
-            tail = self._file_tail(err_path).decode(errors="replace")
-            self.set_phase(ns, name, PHASE_FAILED, reason=f"Error: exit {proc.returncode}: {tail}")
+            self.set_phase(ns, name, PHASE_FAILED,
+                           reason=self._exit_reason(proc.returncode, err_path))
             return
+
+    def _exit_reason(self, code: int, err_path: str = "",
+                     tail: bytes = b"") -> str:
+        """Failure reason for a nonzero exit: the gang guard's cooperative
+        tear-down code gets a first-class reason (it is a *detection*, not
+        a crash — kctpu describe should say so), everything else keeps the
+        stderr-tail shape tests and operators rely on."""
+        from ..recovery.rendezvous import EXIT_REJOIN
+
+        if code == EXIT_REJOIN:
+            return ("GangBroken: peer loss detected (exit "
+                    f"{EXIT_REJOIN}); awaiting gang replacement")
+        if not tail and err_path:
+            tail = self._file_tail(err_path)
+        return f"Error: exit {code}: {tail.decode(errors='replace')}"
 
     def _execute_warm(self, pod: Pod, argv, env) -> None:
         """Fork the pod process from the warm zygote (see zygote.py)."""
@@ -764,11 +845,14 @@ class FakeKubelet:
                 if code == 0:
                     self.set_phase(ns, name, PHASE_SUCCEEDED)
                     return
-                if pod.spec.restart_policy in ("Always", "OnFailure") and restarts < self.max_restarts:
+                if (pod.spec.restart_policy in ("Always", "OnFailure")
+                        and restarts < self.max_restarts
+                        and not self._is_gang_member(env)):
                     restarts += 1
                     continue
-                tail = proc.stderr_tail().decode(errors="replace")
-                self.set_phase(ns, name, PHASE_FAILED, reason=f"Error: exit {code}: {tail}")
+                self.set_phase(ns, name, PHASE_FAILED,
+                               reason=self._exit_reason(
+                                   code, tail=proc.stderr_tail()))
                 return
         finally:
             self._warm.pop(key, None)

@@ -59,11 +59,20 @@ from ..planner.types import Action
 from ..updater import compute_status, should_update
 from ..utils import serde
 from ..utils.names import generate_runtime_id
+from ..recovery.policy import (
+    ACTION_BACKOFF,
+    ACTION_EXHAUSTED,
+    ACTION_REPLACE,
+    RestartPolicyConfig,
+    RestartTracker,
+)
 from .events import (
     EventRecorder,
+    REASON_BACKOFF_LIMIT_EXCEEDED,
     REASON_GANG_ADMITTED,
     REASON_GANG_PREEMPTED,
     REASON_GANG_QUEUED,
+    REASON_REPLICA_RESTARTED,
     REASON_TRAINING_RESUMED,
     REASON_TRAINING_STALLED,
     TYPE_NORMAL,
@@ -93,6 +102,7 @@ class Controller:
         recorder: Optional[EventRecorder] = None,
         stall_policy: Optional[StallPolicy] = None,
         manage_workers: int = 8,
+        restart_config: Optional[RestartPolicyConfig] = None,
     ):
         self.cluster = cluster
         self.inventory = inventory
@@ -115,6 +125,13 @@ class Controller:
         # health, a TrainingStalled event, and kctpu_job_stalled=1.
         self.stall_policy = stall_policy or StallPolicy()
         self.stall_tracker = StallTracker(self.stall_policy)
+        # Recovery plane: per-replica restart accounting with exponential
+        # backoff + jitter and a backoffLimit -> terminal Failed
+        # (recovery/policy.py).  The tracker gates the planner's
+        # index-preserving replacement path and feeds the RESTARTS column,
+        # ReplicaRestarted/BackoffLimitExceeded events, and the
+        # kctpu_replica_restarts_total / restart-latency metrics.
+        self.restart_tracker = RestartTracker(restart_config)
         # Per-job stalled-replica set from the LAST sync, for edge-triggered
         # TrainingStalled/TrainingResumed events (the condition itself is
         # level-triggered in status).
@@ -288,6 +305,7 @@ class Controller:
     def _on_tfjob_delete(self, job: TFJob) -> None:
         key = key_of(job.metadata)
         self.expectations.delete_expectations(key)
+        self.restart_tracker.forget_job(key)
         self._drop_progress_series(key, job)
         if self.inventory is not None and is_tpu_job(job):
             self.inventory.release_gang(gang_name(job))
@@ -414,15 +432,23 @@ class Controller:
 
         pods_by_type, services_by_type = self._gather(job)
 
+        # Recovery plane: restart accounting + policy verdicts for every
+        # failed replica index (events, metrics, backoff requeue, and —
+        # when a gang is about to be replaced — the generation bump that
+        # keys the replacement's re-rendezvous).
+        job, recovery = self._assess_recovery(
+            key, job, pods_by_type, needs_sync=needs_sync and not deleting)
+
         if needs_sync and not deleting:
-            self._manage(key, job, pods_by_type, services_by_type)
+            self._manage(key, job, pods_by_type, services_by_type, recovery)
 
         # Status rollup runs every sync, whether or not we acted.  The
         # stall tracker rides along: Running pods' heartbeats/steps are
         # checked against the deadlines and surface as Degraded health +
         # stalled progress in the computed status.
         new_status = compute_status(job, pods_by_type,
-                                    tracker=self.stall_tracker)
+                                    tracker=self.stall_tracker,
+                                    recovery=recovery)
         self._publish_progress(key, job, new_status)
         self._publish_gang_state(key, job, pods_by_type)
         if should_update(job.status, new_status):
@@ -571,6 +597,7 @@ class Controller:
             except NotFound:
                 pass
         self.expectations.delete_expectations(key)
+        self.restart_tracker.forget_job(key)
 
     def _gather(self, job: TFJob):
         """Claim pods/services once at job scope, then partition by replica
@@ -595,12 +622,91 @@ class Controller:
             ]
         return pods_by_type, services_by_type
 
-    def _manage(self, key, job, pods_by_type, services_by_type) -> None:
+    def _assess_recovery(self, key: str, job: TFJob, pods_by_type,
+                         needs_sync: bool):
+        """Run the restart policy engine over this sync's pod view; emit
+        the recovery-plane events, schedule the backoff requeue, and bump
+        the job's gang generation when a gang replacement will execute this
+        sync.  Returns (possibly generation-patched job, assessment)."""
+        recovery = self.restart_tracker.assess(key, job, pods_by_type,
+                                               time.time())
+        for nf in recovery.new_failures:
+            d = nf.decision
+            if d.action == ACTION_EXHAUSTED:
+                continue  # the newly_exhausted edge below tells the story
+            if d.action not in (ACTION_REPLACE, ACTION_BACKOFF):
+                continue  # restartPolicy Never: terminal, no restart event
+            delay = (f" after {d.delay_s:.2g}s backoff" if d.delay_s > 0
+                     else "")
+            why = f": {nf.reason}" if nf.reason else ""
+            self.recorder.event(
+                job, TYPE_NORMAL, REASON_REPLICA_RESTARTED,
+                f"replica {nf.type.value}-{nf.index} restart #{d.count}"
+                f"{delay} (pod {nf.pod_name}{why})",
+                dedup_key=f"{nf.type.value}-{nf.index}")
+        for typ, idx, d in recovery.newly_exhausted:
+            self.recorder.event(
+                job, TYPE_WARNING, REASON_BACKOFF_LIMIT_EXCEEDED,
+                f"replica {typ.value}-{idx} failed {d.count} times "
+                f"(streak {d.streak} > backoffLimit "
+                f"{job.spec.backoff_limit}); giving up — job failed",
+                dedup_key=f"{typ.value}-{idx}")
+        if recovery.requeue_after_s > 0:
+            # A Failed pod emits no further watch events; without this the
+            # backoff window would only be noticed by a resync.
+            self.queue.add_after(key, recovery.requeue_after_s + 0.02)
+        if needs_sync:
+            job = self._maybe_bump_gang_generation(key, job, pods_by_type,
+                                                   recovery)
+        return job, recovery
+
+    def _maybe_bump_gang_generation(self, key: str, job: TFJob,
+                                    pods_by_type, recovery) -> TFJob:
+        """A gang about to be replaced gets a fresh generation, persisted
+        as a job annotation BEFORE the replacement pods are materialized:
+        the planner stamps it into every member (annotation + env), keying
+        the new gang's rendezvous namespace — readiness drops and
+        fake-DNS coordinator ports — away from the dead generation's."""
+        from ..api.labels import ANNOTATION_GANG_GENERATION
+        from ..planner.plan import is_gang_spec
+
+        will_replace = False
+        for spec in job.spec.tf_replica_specs:
+            if not is_gang_spec(spec):
+                continue
+            typ = spec.tf_replica_type
+            restart = (spec.template.spec.restart_policy
+                       if spec.template else "OnFailure")
+            if restart not in ("OnFailure", "Always"):
+                continue
+            verdicts = [d.action for (t, _), d in recovery.decisions.items()
+                        if t == typ]
+            if verdicts and all(v == ACTION_REPLACE for v in verdicts):
+                will_replace = True
+        if not will_replace:
+            return job
+
+        ns, name = job.metadata.namespace, job.metadata.name
+        cur = int(job.metadata.annotations.get(ANNOTATION_GANG_GENERATION,
+                                               "0") or "0")
+
+        def bump(m):
+            m.annotations[ANNOTATION_GANG_GENERATION] = str(cur + 1)
+
+        try:
+            return self.cluster.tfjobs.patch_meta(ns, name, bump)
+        except NotFound:
+            return job
+
+    def _manage(self, key, job, pods_by_type, services_by_type,
+                recovery=None) -> None:
         """Execute the plan (ref: manageTFJob at controller.go:359-445)."""
         with trace.span("sync/manage", key=key) as sp:
-            self._manage_inner(key, job, pods_by_type, services_by_type, sp)
+            self._manage_inner(key, job, pods_by_type, services_by_type, sp,
+                               recovery)
 
-    def _manage_inner(self, key, job, pods_by_type, services_by_type, sp) -> None:
+    def _manage_inner(self, key, job, pods_by_type, services_by_type, sp,
+                      recovery=None) -> None:
         """Execute the plan through slow-start batches (client-go's
         ``slowStartBatch``; see slowstart.py).  Three ordered phases keep
         the serial invariants — deletes land before the creates that reuse
@@ -619,7 +725,7 @@ class Controller:
         - all errors are aggregated into one ManageError so the sync
           requeues with backoff, instead of the historical abort-on-first
           that silently dropped the remaining replicas' events."""
-        plan = plan_job(job, pods_by_type, services_by_type)
+        plan = plan_job(job, pods_by_type, services_by_type, recovery)
         sp.args["creations"] = plan.creations
         sp.args["deletions"] = plan.deletions
         if plan.empty:

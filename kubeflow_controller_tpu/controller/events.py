@@ -30,6 +30,9 @@ REASON_TRAINING_RESUMED = "TrainingResumed"
 REASON_GANG_QUEUED = "GangQueued"
 REASON_GANG_ADMITTED = "GangAdmitted"
 REASON_GANG_PREEMPTED = "GangPreempted"
+# Recovery-plane reasons (net-new: the restart policy engine).
+REASON_REPLICA_RESTARTED = "ReplicaRestarted"
+REASON_BACKOFF_LIMIT_EXCEEDED = "BackoffLimitExceeded"
 
 TYPE_NORMAL = "Normal"
 TYPE_WARNING = "Warning"
@@ -48,6 +51,10 @@ class Event:
     # event ages come from the last-seen clock, the ordering from this one.
     first_timestamp: float = 0.0
     count: int = 1
+    # Aggregation override (e.g. the replica id for ReplicaRestarted):
+    # repeats collapse on (object, reason, dedup_key) even as the message
+    # text changes with the restart count.
+    dedup_key: str = ""
 
     def __post_init__(self):
         if not self.first_timestamp:
@@ -88,30 +95,39 @@ class EventRecorder:
                 target=self._sink_loop, name="event-sink", daemon=True)
             self._sink_thread.start()
 
-    def event(self, obj, event_type: str, reason: str, message: str) -> None:
+    def event(self, obj, event_type: str, reason: str, message: str,
+              dedup_key: str = "") -> None:
+        """``dedup_key`` overrides the message in the aggregation key: a
+        crash-looping replica's ReplicaRestarted events carry a changing
+        count/backoff in the message, but must still collapse into ONE
+        aggregated event per (job, reason, replica) — pass the replica id
+        as the dedup key and the live event's message tracks the newest."""
         key = f"{obj.metadata.namespace}/{obj.metadata.name}"
         kind = getattr(obj, "kind", type(obj).__name__)
         aggregated = False
         with self._lock:
             # Aggregate against the most recent event for the SAME
-            # (object, reason, message) — broadcaster behavior, keyed so
-            # interleavings across jobs cannot defeat it.  first_timestamp
-            # keeps the original sighting; timestamp tracks the latest.
-            agg_key = (key, reason, message)
+            # (object, reason, message-or-dedup-key) — broadcaster behavior,
+            # keyed so interleavings across jobs cannot defeat it.
+            # first_timestamp keeps the original sighting; timestamp tracks
+            # the latest.
+            agg_key = (key, reason, dedup_key or message)
             live = self._agg.get(agg_key)
             if live is not None:
                 live.count += 1
                 live.timestamp = time.time()
+                live.message = message  # newest wording wins under dedup_key
                 aggregated = True
             else:
-                ev = Event(kind, key, event_type, reason, message)
+                ev = Event(kind, key, event_type, reason, message,
+                           dedup_key=dedup_key)
                 self._events.append(ev)
                 self._agg[agg_key] = ev
                 if len(self._events) > self._max:
                     dropped = self._events[: len(self._events) - self._max]
                     self._events = self._events[-self._max :]
                     for d in dropped:
-                        k = (d.object_key, d.reason, d.message)
+                        k = (d.object_key, d.reason, d.dedup_key or d.message)
                         if self._agg.get(k) is d:
                             del self._agg[k]
         if not aggregated:
@@ -125,7 +141,7 @@ class EventRecorder:
                 self._sink_queue.put_nowait(
                     (kind, obj.metadata.namespace or "default",
                      obj.metadata.name, obj.metadata.uid,
-                     key, event_type, reason, message))
+                     key, event_type, reason, message, dedup_key))
             except queue.Full:
                 pass  # drop under pressure: audit stream is best-effort
 
@@ -162,13 +178,13 @@ class EventRecorder:
 
     def _write_sink(self, kind: str, ns: str, obj_name: str, uid: str,
                     key: str, event_type: str, reason: str,
-                    message: str) -> None:
+                    message: str, dedup_key: str = "") -> None:
         """Runs ONLY on the flusher thread: no locking needed for the dedup
         index, and API latency never touches the sync path."""
         from ..api.core import EventObject, ObjectReference
         from ..cluster.store import APIError, NotFound
 
-        agg = (key, reason, message)
+        agg = (key, reason, dedup_key or message)
         now = time.time()
         try:
             name = self._sink_names.get(agg)
@@ -177,6 +193,7 @@ class EventRecorder:
                     ev = self._sink.get(ns, name)
                     ev.count += 1
                     ev.last_timestamp = now
+                    ev.message = message  # newest wording under dedup_key
                     self._sink.update(ev)
                     return
                 except NotFound:

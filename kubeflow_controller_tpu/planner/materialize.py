@@ -66,6 +66,12 @@ ENV_SLICE_ID = "MEGASCALE_SLICE_ID"
 # pod spec like the *Dir fields, so replacements and warm readmissions of
 # the gang land on the SAME populated cache and skip trace+XLA entirely.
 ENV_COMPILE_CACHE = "KCTPU_COMPILE_CACHE"
+# Recovery plane (recovery/): the controller-bumped gang generation a
+# replacement gang rendezvouses under, the gang identity for the
+# workload-side guard, and the periodic checkpoint interval.
+ENV_GANG_GENERATION = "KCTPU_GANG_GENERATION"
+ENV_GANG_NAME_WORKLOAD = "KCTPU_GANG_NAME"
+ENV_CHECKPOINT_EVERY = "KCTPU_CHECKPOINT_EVERY"
 
 
 def labels_for(job: TFJob, typ: ReplicaType) -> Dict[str, str]:
@@ -179,7 +185,21 @@ def _dir_env(job: TFJob) -> Dict[str, str]:
         out["EXPORT_DIR"] = job.spec.export_dir
     if job.spec.compile_cache_dir:
         out[ENV_COMPILE_CACHE] = job.spec.compile_cache_dir
+    if job.spec.checkpoint_every_steps > 0:
+        out[ENV_CHECKPOINT_EVERY] = str(job.spec.checkpoint_every_steps)
     return out
+
+
+def gang_generation(job: TFJob) -> int:
+    """The job's current gang generation (controller-bumped annotation;
+    0 = first incarnation)."""
+    from ..api.labels import ANNOTATION_GANG_GENERATION
+
+    try:
+        return int(job.metadata.annotations.get(
+            ANNOTATION_GANG_GENERATION, "0") or "0")
+    except ValueError:
+        return 0
 
 
 # ---------------------------------------------------------------------------
@@ -205,14 +225,14 @@ def make_pod(job: TFJob, spec: TFReplicaSpec, index: int) -> Pod:
         if not any(p.container_port == TF_PORT for p in c.ports):
             c.ports.append(ContainerPort(name="tf-port", container_port=TF_PORT))
         if typ == ReplicaType.WORKER:
-            _wire_worker_collectives(job, c, index)
+            _wire_worker_collectives(job, pod, c, index)
     elif typ == ReplicaType.TPU:
         _wire_tpu_pod(job, spec, pod, index)
     # Local: no wiring at all (ref: local.go — single pod, no services).
     return pod
 
 
-def _wire_worker_collectives(job: TFJob, c, index: int) -> None:
+def _wire_worker_collectives(job: TFJob, pod: Pod, c, index: int) -> None:
     """Give classic Worker replicas the jax.distributed contract too.
 
     The reference's workers exchange gradients only through the PS grpc
@@ -223,6 +243,8 @@ def _wire_worker_collectives(job: TFJob, c, index: int) -> None:
     ``set_env_default`` so a template-provided address (e.g. a test's
     127.0.0.1 override) wins over the generated service DNS name.
     """
+    from ..api.labels import ANNOTATION_GANG_GENERATION, ANNOTATION_GANG_NAME
+
     worker = replica_spec_for(job, ReplicaType.WORKER)
     n = worker.replicas if worker else 1
     if n <= 1:
@@ -232,6 +254,19 @@ def _wire_worker_collectives(job: TFJob, c, index: int) -> None:
     c.set_env_default(ENV_NUM_PROCESSES, str(n))
     # Per-pod, never meaningful as a uniform template value: always stamp.
     c.set_env(ENV_PROCESS_ID, str(index))
+    # Recovery plane: a multi-process Worker set IS a gang (one failure
+    # domain for the collectives it runs) — stamp the gang identity and
+    # the controller-bumped generation so replacement gangs rendezvous in
+    # a fresh generation namespace and the workload-side guard knows who
+    # its peers are.
+    gen = gang_generation(job)
+    c.set_env(ENV_GANG_GENERATION, str(gen))
+    c.set_env(ENV_GANG_NAME_WORKLOAD, gang_name(job))
+    pod.metadata.annotations = {
+        **pod.metadata.annotations,
+        ANNOTATION_GANG_NAME: gang_name(job),
+        ANNOTATION_GANG_GENERATION: str(gen),
+    }
 
 
 def _wire_tpu_pod(job: TFJob, spec: TFReplicaSpec, pod: Pod, index: int) -> None:
@@ -261,6 +296,12 @@ def _wire_tpu_pod(job: TFJob, spec: TFReplicaSpec, pod: Pod, index: int) -> None
     c.set_env(ENV_TPU_ACCELERATOR, tpu.accelerator_type)
     c.set_env(ENV_NUM_SLICES, str(tpu.num_slices))
     c.set_env(ENV_SLICE_ID, str(slice_idx))
+    # Recovery plane: generation-keyed rendezvous + guard identity.
+    from ..api.labels import ANNOTATION_GANG_GENERATION
+
+    gen = gang_generation(job)
+    c.set_env(ENV_GANG_GENERATION, str(gen))
+    c.set_env(ENV_GANG_NAME_WORKLOAD, gang_name(job))
     # Chip request: never nvidia.com/gpu (BASELINE.json north star).
     c.resources.requests[RESOURCE_TPU] = str(tpu.chips_per_host)
     c.resources.limits[RESOURCE_TPU] = str(tpu.chips_per_host)
@@ -272,6 +313,7 @@ def _wire_tpu_pod(job: TFJob, spec: TFReplicaSpec, pod: Pod, index: int) -> None
         ANNOTATION_NUM_SLICES: str(tpu.num_slices),
         ANNOTATION_SLICE_INDEX: str(slice_idx),
         ANNOTATION_PRIORITY_CLASS: job.spec.priority_class_name or "default",
+        ANNOTATION_GANG_GENERATION: str(gen),
     }
     if pod.spec.restart_policy == "Always":
         # A slice process that dies must fail the pod so the whole gang is

@@ -76,7 +76,12 @@ def plan_job(
     job: TFJob,
     pods_by_type: Dict[ReplicaType, List[Pod]],
     services_by_type: Dict[ReplicaType, List[Service]],
+    recovery=None,
 ) -> Plan:
+    """``recovery`` (optional) is a RecoveryAssessment from the restart
+    policy engine (recovery/policy.py): indices in backoff are left alone
+    this sync (the controller requeues after the delay), indices whose
+    backoff limit is exhausted are terminal (the updater fails the job)."""
     if job.status.phase in (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED):
         return _plan_cleanup(job, pods_by_type, services_by_type)
 
@@ -98,11 +103,29 @@ def plan_job(
                     )
     # Pass 2: pods.
     for spec in _ordered_specs(job):
-        events.extend(_plan_pods(spec, pods_by_type.get(spec.tf_replica_type, [])))
+        events.extend(_plan_pods(
+            spec, pods_by_type.get(spec.tf_replica_type, []), recovery))
     return Plan(events)
 
 
-def _plan_pods(spec: TFReplicaSpec, pods: List[Pod]) -> List[PlanEvent]:
+def is_gang_spec(spec: TFReplicaSpec) -> bool:
+    """One failure domain, replaced as a unit: TPU slices always; Worker
+    gangs via the explicit spec.gang_restart opt-in (a multi-process
+    jax.distributed gang's torn collective cannot be rejoined per-index)."""
+    return spec.tf_replica_type == ReplicaType.TPU or spec.gang_restart
+
+
+def _gate(recovery, typ: ReplicaType, index: int) -> str:
+    """The restart-policy verdict for a failed index: "replace" without an
+    engine (the pre-recovery behavior, kept for pure-planner callers)."""
+    if recovery is None:
+        return "replace"
+    d = recovery.decision_for(typ, index)
+    return d.action if d is not None else "replace"
+
+
+def _plan_pods(spec: TFReplicaSpec, pods: List[Pod],
+               recovery=None) -> List[PlanEvent]:
     typ = spec.tf_replica_type
     n = desired_replicas(spec)
     by_idx = pods_by_index(pods)
@@ -111,8 +134,8 @@ def _plan_pods(spec: TFReplicaSpec, pods: List[Pod]) -> List[PlanEvent]:
 
     events: List[PlanEvent] = []
 
-    if typ == ReplicaType.TPU:
-        return _plan_tpu_gang(spec, n, by_idx, replace_on_failure)
+    if is_gang_spec(spec):
+        return _plan_gang(spec, n, by_idx, replace_on_failure, recovery)
 
     for i in range(n):
         plist = sorted(by_idx.get(i, []), key=lambda p: p.metadata.creation_timestamp or 0)
@@ -132,6 +155,11 @@ def _plan_pods(spec: TFReplicaSpec, pods: List[Pod]) -> List[PlanEvent]:
         if failed and not replace_on_failure:
             continue  # terminal failure: updater rolls up phase=Failed
         if failed:
+            verdict = _gate(recovery, typ, i)
+            if verdict in ("backoff", "exhausted", "never"):
+                # backoff: wait out the window (controller requeues);
+                # exhausted/never: terminal, updater fails the job.
+                continue
             # Index-preserving replacement: clear the failed record(s) and
             # re-create at the same index.
             for p in failed:
@@ -149,12 +177,22 @@ def _plan_pods(spec: TFReplicaSpec, pods: List[Pod]) -> List[PlanEvent]:
     return events
 
 
-def _plan_tpu_gang(
-    spec: TFReplicaSpec, n: int, by_idx: Dict[int, List[Pod]], replace_on_failure: bool
+def _plan_gang(
+    spec: TFReplicaSpec, n: int, by_idx: Dict[int, List[Pod]],
+    replace_on_failure: bool, recovery=None
 ) -> List[PlanEvent]:
     """All-or-nothing: if any member failed (and we replace), tear down every
-    surviving member and re-create the full gang."""
+    surviving member and re-create the full gang.  Under the restart policy
+    engine, the whole gang waits out the worst failed member's backoff and
+    goes terminal if ANY member's limit is exhausted (one failure domain —
+    its restart budget is shared)."""
+    typ = spec.tf_replica_type
     events: List[PlanEvent] = []
+    failed_indices = [
+        i for i, plist in by_idx.items()
+        if any(p.status.phase == PHASE_FAILED for p in plist)
+        and not any(is_pod_active(p) for p in plist)
+    ]
     any_failed = any(
         p.status.phase == PHASE_FAILED for plist in by_idx.values() for p in plist
     )
@@ -164,15 +202,22 @@ def _plan_tpu_gang(
     if all_succeeded:
         return events
     if any_failed and replace_on_failure:
+        verdicts = [_gate(recovery, typ, i) for i in failed_indices]
+        if "exhausted" in verdicts:
+            return events  # terminal: the gang's restart budget is spent
+        if "backoff" in verdicts or not failed_indices:
+            # Waiting out a member's backoff (controller requeues), or the
+            # failure is already being replaced (active pod at the index).
+            return events
         # Delete EVERY member record — including Succeeded ones — so stale
         # results cannot mix with the replacement gang's (a fresh gang is a
         # fresh jax.distributed world; old per-host outcomes are void).
         for i, plist in sorted(by_idx.items()):
             for p in plist:
-                events.append(PlanEvent(Action.DELETE_POD, ReplicaType.TPU, index=i,
+                events.append(PlanEvent(Action.DELETE_POD, typ, index=i,
                                         name=p.metadata.name, reason="gang-replace"))
         for i in range(n):
-            events.append(PlanEvent(Action.ADD_POD, ReplicaType.TPU, index=i,
+            events.append(PlanEvent(Action.ADD_POD, typ, index=i,
                                     reason="gang-replace"))
         return events
     if any_failed:
@@ -180,13 +225,13 @@ def _plan_tpu_gang(
     for i in range(n):
         plist = by_idx.get(i, [])
         if not any(is_pod_active(p) or p.status.phase == PHASE_SUCCEEDED for p in plist):
-            events.append(PlanEvent(Action.ADD_POD, ReplicaType.TPU, index=i))
+            events.append(PlanEvent(Action.ADD_POD, typ, index=i))
     # Scale-down beyond the slice host count.
     for i, plist in sorted(by_idx.items()):
         if i >= n:
             for p in plist:
                 if is_pod_active(p):
-                    events.append(PlanEvent(Action.DELETE_POD, ReplicaType.TPU, index=i,
+                    events.append(PlanEvent(Action.DELETE_POD, typ, index=i,
                                             name=p.metadata.name, reason="scale-down"))
     return events
 

@@ -1,0 +1,36 @@
+"""Recovery plane: restart policy, gang re-rendezvous, chaos injection.
+
+The reference's own design doc names "no recovery from pod failure" as its
+flagship gap (design_doc.md:228-260, PAPER.md §0).  This package closes it
+across all three layers:
+
+- :mod:`.policy` — the controller-side restart policy engine: per-replica
+  restart accounting with exponential backoff + jitter and a
+  ``backoffLimit`` that turns a crash loop into terminal ``Failed``
+  (driven off ``spec.template.spec.restart_policy``, like k8s Jobs);
+- :mod:`.rendezvous` — the workload-side gang guard: peer-liveness
+  heartbeat files and the cooperative tear-down (exit ``EXIT_REJOIN``)
+  that turns "survivor hangs in a torn collective forever" into
+  "survivor checkpoints continuously and re-enters rendezvous in the
+  next gang generation";
+- :mod:`.chaos` — the fault injector behind ``bench.py --chaos`` and
+  ``make chaos-smoke``: SIGKILL executed pods (or flip simulated pods to
+  Failed) at randomized mid-fit times and measure lost steps and
+  recovery latency.
+"""
+
+from .policy import (  # noqa: F401
+    ACTION_BACKOFF,
+    ACTION_EXHAUSTED,
+    ACTION_NEVER,
+    ACTION_REPLACE,
+    RecoveryAssessment,
+    RestartDecision,
+    RestartPolicyConfig,
+    RestartTracker,
+)
+from .rendezvous import (  # noqa: F401
+    ENV_GANG_MONITOR,
+    EXIT_REJOIN,
+    GangGuard,
+)

@@ -1,0 +1,167 @@
+"""Chaos harness: kill pods mid-fit, measure what recovery actually costs.
+
+This is the fault injector behind ``bench.py --chaos`` and the standing
+``make chaos-smoke`` robustness gate.  It does two things:
+
+- **inject**: SIGKILL an executed pod's process (real subprocess, real
+  half-written state), or flip a simulated pod to ``Failed`` through the
+  same injected-failure path slice failures use — at randomized mid-fit
+  times, seeded for reproducibility;
+- **measure**: for every kill, the step the job had reached when the
+  process died (from the progress plane), the step the replacement resumed
+  from (``resumed_from_step``, reported by the restored workload), the
+  steps lost between the two (bounded by ``spec.checkpoint_every_steps``
+  when checkpoint-resume works), and the recovery latency — kill until the
+  job's minimum step climbs back past the pre-kill step.
+
+The monkey only *observes* public surfaces (job progress, pod phases), so
+the same harness measures any future recovery mechanism unchanged.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class KillRecord:
+    job: str
+    pod: str
+    mode: str = ""               # "process" | "warm" | "simulated"
+    t_kill: float = 0.0
+    step_at_kill: int = 0
+    recovered: bool = False
+    recovery_s: float = 0.0      # kill -> min step back past step_at_kill
+    resumed_from_step: int = -1  # -1 = replacement never reported one
+    lost_steps: int = -1         # step_at_kill - resumed_from_step
+
+
+@dataclass
+class ChaosReport:
+    kills: List[KillRecord] = field(default_factory=list)
+
+    @property
+    def recovered_rate(self) -> float:
+        if not self.kills:
+            return 0.0
+        return sum(1 for k in self.kills if k.recovered) / len(self.kills)
+
+    def recovery_percentile(self, q: float) -> float:
+        vals = sorted(k.recovery_s for k in self.kills if k.recovered)
+        if not vals:
+            return 0.0
+        return vals[min(len(vals) - 1,
+                        int(round(q / 100.0 * (len(vals) - 1))))]
+
+    @property
+    def max_lost_steps(self) -> int:
+        known = [k.lost_steps for k in self.kills if k.lost_steps >= 0]
+        return max(known) if known else -1
+
+
+class ChaosMonkey:
+    """Seeded fault injector over one fake cluster + kubelet."""
+
+    def __init__(self, cluster, kubelet, seed: int = 0):
+        self.cluster = cluster
+        self.kubelet = kubelet
+        self.rng = random.Random(seed)
+        from ..obs.metrics import REGISTRY
+
+        self._c_kills = REGISTRY.counter(
+            "kctpu_chaos_kills_total", "Chaos faults injected", ("mode",))
+
+    # -- injection -----------------------------------------------------------
+
+    def kill_pod(self, namespace: str, name: str) -> Optional[KillRecord]:
+        """Kill one pod the way its mode dies for real: SIGKILL the
+        subprocess (cold or warm-forked), else flip the simulated pod to
+        Failed through the kubelet's injected-failure path."""
+        mode = self.kubelet.chaos_kill(namespace, name)
+        if mode is None:
+            return None
+        self._c_kills.labels(mode).inc()
+        rec = KillRecord(job="", pod=name, mode=mode, t_kill=time.time())
+        return rec
+
+    def pick_victim(self, pods) -> Optional[object]:
+        """A uniformly random active pod (seeded rng)."""
+        cands = [p for p in pods if p.status.phase == "Running"]
+        if not cands:
+            return None
+        return self.rng.choice(cands)
+
+    def kill_at_step(self, namespace: str, job_name: str, min_step: int,
+                     deadline_s: float = 120.0,
+                     poll_s: float = 0.01) -> Optional[KillRecord]:
+        """Wait until ``job_name``'s progress reaches ``min_step`` mid-fit,
+        then SIGKILL one random worker of the job.  Returns the record (with
+        ``step_at_kill`` from the progress plane) or None when the job ended
+        before the trigger."""
+        from ..api.tfjob import TFJobPhase
+
+        end = time.time() + deadline_s
+        while time.time() < end:
+            j = self.cluster.tfjobs.get(namespace, job_name)
+            if j.status.phase in (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED):
+                return None  # finished before we could strike
+            p = j.status.progress
+            if p is not None and p.step >= min_step:
+                pods = [q for q in self.cluster.pods.list(namespace)
+                        if q.metadata.labels.get("tf_job_name") == job_name]
+                victim = self.pick_victim(pods)
+                if victim is None:
+                    return None
+                rec = self.kill_pod(namespace, victim.metadata.name)
+                if rec is None:
+                    return None
+                rec.job = job_name
+                rec.step_at_kill = p.step
+                return rec
+            time.sleep(poll_s)
+        return None
+
+    # -- measurement ---------------------------------------------------------
+
+    def await_recovery(self, namespace: str, rec: KillRecord,
+                       deadline_s: float = 180.0,
+                       poll_s: float = 0.02) -> KillRecord:
+        """Fill in the recovery half of a kill record: first wait for the
+        RESET (the job's progress drops below the pre-kill step — the
+        replacement gang's restore/restart showing on the step plane;
+        surviving replicas' still-high steps must not fake a recovery),
+        then recovered = min step climbs back past ``step_at_kill``.  A
+        job that reaches Succeeded counts as recovered either way.
+        ``resumed_from_step`` is read from the replacement's progress."""
+        from ..api.tfjob import TFJobPhase
+
+        end = time.time() + deadline_s
+        seen_reset = False
+        while time.time() < end:
+            j = self.cluster.tfjobs.get(namespace, rec.job)
+            p = j.status.progress
+            if p is None or p.reporting == 0 or p.step < rec.step_at_kill:
+                seen_reset = True
+            if p is not None:
+                for r in p.replicas:
+                    if r.resumed_from_step > 0:
+                        rec.resumed_from_step = max(rec.resumed_from_step,
+                                                    r.resumed_from_step)
+                if seen_reset and p.reporting > 0 and p.step >= rec.step_at_kill:
+                    rec.recovered = True
+            if j.status.phase == TFJobPhase.SUCCEEDED:
+                rec.recovered = True
+            elif j.status.phase == TFJobPhase.FAILED:
+                break
+            if rec.recovered:
+                rec.recovery_s = time.time() - rec.t_kill
+                if rec.resumed_from_step >= 0:
+                    rec.lost_steps = max(
+                        0, rec.step_at_kill - rec.resumed_from_step)
+                return rec
+            time.sleep(poll_s)
+        rec.recovery_s = time.time() - rec.t_kill
+        return rec

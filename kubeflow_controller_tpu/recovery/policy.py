@@ -1,0 +1,320 @@
+"""Restart policy engine: per-replica restart accounting, backoff, limits.
+
+The reference observes pod failures and does nothing (design_doc.md:228-260);
+PR 1-8 grew an index-preserving replacement path (planner/plan.py) but it is
+policy-free: a Failed pod is replaced *immediately* and *forever* — a crash
+loop restarts at full speed until someone deletes the job.  This module is
+the k8s-Job-shaped policy in front of that path:
+
+- **accounting**: every distinct failed pod observed at a replica index is
+  one restart (pod names are unique via generateName, so observation across
+  many syncs counts each failure exactly once);
+- **backoff**: the FIRST failure in a streak restarts immediately (a slice
+  loss or a one-off crash should recover at full speed — the slice-failure
+  and preemption benches depend on it), subsequent failures wait
+  ``initial_backoff_s * factor^(streak-2)`` capped at ``max_backoff_s``,
+  with multiplicative jitter so a wide job's crash-looping replicas do not
+  re-create in lockstep;
+- **limit**: a streak longer than ``spec.backoff_limit`` is terminal — the
+  planner stops replacing, the updater rolls the job up to ``Failed`` with
+  a ``BackoffLimitExceeded`` reason;
+- **reset**: ``reset_after_s`` of continuous Running clears the streak
+  (the CrashLoopBackOff recovery rule), while the monotonic ``total``
+  feeds the status/CLI RESTARTS column;
+- **exemption**: pods failed by the capacity plane (``reason=Preempted…``)
+  are NOT restarts — preemption is scheduling, not failure, and its
+  readmission latency is the warm-pool path's whole point.
+
+The tracker is observation-driven and thread-safe; :meth:`RestartTracker.assess`
+is called once per sync and returns a :class:`RecoveryAssessment` the
+planner (gate replacements), updater (status restarts / terminal reason)
+and controller (events, requeue-after, gang-generation bump) all consume.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..api.core import PHASE_FAILED, PHASE_RUNNING, PHASE_SUCCEEDED, is_pod_active
+from ..api.tfjob import ReplicaType, TFJob
+from ..planner.materialize import pods_by_index
+from ..planner.plan import desired_replicas
+
+# Decision actions.
+ACTION_REPLACE = "replace"      # re-create now (backoff elapsed or first failure)
+ACTION_BACKOFF = "backoff"      # failed, but the backoff window is still open
+ACTION_EXHAUSTED = "exhausted"  # streak > backoffLimit: terminal Failed
+ACTION_NEVER = "never"          # restartPolicy Never: terminal by policy
+
+
+@dataclass
+class RestartPolicyConfig:
+    """Controller-level knobs (the per-job limit lives on the spec)."""
+
+    # First failure in a streak restarts immediately; the second waits
+    # initial_backoff_s, then * factor per further failure, capped.
+    initial_backoff_s: float = 0.25
+    backoff_factor: float = 2.0
+    max_backoff_s: float = 30.0
+    # Multiplicative jitter: the delay is scaled by uniform(1, 1+jitter).
+    jitter: float = 0.1
+    # Continuous Running that resets the streak (not the monotonic total).
+    reset_after_s: float = 600.0
+
+
+@dataclass
+class RestartDecision:
+    action: str
+    count: int = 0        # monotonic failures at this index (status/CLI)
+    streak: int = 0       # resettable consecutive-failure run (backoff input)
+    delay_s: float = 0.0  # backoff applied to this restart
+    remaining_s: float = 0.0  # backoff left (action == ACTION_BACKOFF)
+    reason: str = ""      # coarse pod failure reason
+
+
+@dataclass
+class NewFailure:
+    """A failed pod seen for the first time this sync (one event each)."""
+
+    type: ReplicaType
+    index: int
+    pod_name: str
+    reason: str
+    decision: RestartDecision
+
+
+@dataclass
+class RecoveryAssessment:
+    """One sync's restart-policy verdict for a job."""
+
+    decisions: Dict[Tuple[ReplicaType, int], RestartDecision] = field(
+        default_factory=dict)
+    new_failures: List[NewFailure] = field(default_factory=list)
+    newly_exhausted: List[Tuple[ReplicaType, int, RestartDecision]] = field(
+        default_factory=list)
+    # Monotonic restart totals per replica type (TFReplicaStatus.restarts).
+    counts: Dict[ReplicaType, int] = field(default_factory=dict)
+    # Soonest backoff expiry across indices (0 = nothing waiting): the
+    # controller requeues the key after this, since a pod already Failed
+    # generates no further watch events to re-trigger the sync.
+    requeue_after_s: float = 0.0
+
+    def decision_for(self, typ: ReplicaType,
+                     index: int) -> Optional[RestartDecision]:
+        return self.decisions.get((typ, index))
+
+    def exhausted(self, typ: ReplicaType) -> Set[int]:
+        return {i for (t, i), d in self.decisions.items()
+                if t == typ and d.action == ACTION_EXHAUSTED}
+
+    def restarts_for(self, typ: ReplicaType) -> int:
+        return self.counts.get(typ, 0)
+
+
+class _IndexState:
+    __slots__ = ("failed_pods", "total", "streak", "ready_at", "delay_s",
+                 "pending_since", "exhausted_emitted", "running_pod",
+                 "running_since")
+
+    def __init__(self):
+        self.failed_pods: Set[str] = set()
+        self.total = 0
+        self.streak = 0
+        self.ready_at = 0.0
+        self.delay_s = 0.0
+        self.pending_since = 0.0   # first failure time awaiting a replacement
+        self.exhausted_emitted = False
+        self.running_pod = ""
+        self.running_since = 0.0
+
+
+def _coarse_reason(reason: str) -> str:
+    """Bounded-cardinality metric label from a free-form pod reason:
+    the leading token ("Error", "SliceFailed", "ChaosKill", "GangBroken")."""
+    if not reason:
+        return "unknown"
+    return reason.split(":", 1)[0].split(None, 1)[0][:32]
+
+
+class RestartTracker:
+    """Per-(job, replica type, index) restart accounting + decisions."""
+
+    def __init__(self, config: Optional[RestartPolicyConfig] = None,
+                 rng: Optional[random.Random] = None):
+        self.config = config or RestartPolicyConfig()
+        self._rng = rng or random.Random()
+        self._lock = threading.Lock()
+        # job key -> (type, index) -> state
+        self._jobs: Dict[str, Dict[Tuple[ReplicaType, int], _IndexState]] = {}
+        from ..obs.metrics import REGISTRY
+
+        self._c_restarts = REGISTRY.counter(
+            "kctpu_replica_restarts_total",
+            "Replica restarts planned by the recovery policy, by coarse "
+            "pod failure reason", ("reason",))
+        self._h_latency = REGISTRY.histogram(
+            "kctpu_restart_latency_seconds",
+            "Failure observed -> replacement replica Running",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120))
+        self._h_backoff = REGISTRY.histogram(
+            "kctpu_restart_backoff_seconds",
+            "Backoff applied before a replica restart",
+            buckets=(0.0, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60))
+
+    # ---------------------------------------------------------------- assess
+
+    def assess(self, key: str, job: TFJob, pods_by_type, now: float
+               ) -> RecoveryAssessment:
+        """Observe one sync's pod view; return decisions for every replica
+        index that currently has a terminal-failed pod and no live/succeeded
+        replacement."""
+        out = RecoveryAssessment()
+        limit = job.spec.backoff_limit
+        with self._lock:
+            states = self._jobs.setdefault(key, {})
+            for spec in job.spec.tf_replica_specs:
+                typ = spec.tf_replica_type
+                restart = (spec.template.spec.restart_policy
+                           if spec.template else "OnFailure")
+                replace = restart in ("OnFailure", "Always")
+                by_idx = pods_by_index(pods_by_type.get(typ, []))
+                for i in range(desired_replicas(spec)):
+                    plist = by_idx.get(i, [])
+                    st = states.get((typ, i))
+                    running = next((p for p in plist
+                                    if p.status.phase == PHASE_RUNNING), None)
+                    if running is not None and st is not None:
+                        self._observe_running(st, running.metadata.name, now)
+                    # Count failures not injected by the scheduler: a
+                    # preemption is capacity policy, not a crash, and must
+                    # not burn the backoff budget or delay readmission.
+                    failed = [p for p in plist
+                              if p.status.phase == PHASE_FAILED
+                              and not (p.status.reason or "").startswith(
+                                  "Preempted")]
+                    fresh = [p for p in failed
+                             if st is None
+                             or p.metadata.name not in st.failed_pods]
+                    if fresh:
+                        if st is None:
+                            st = states.setdefault((typ, i), _IndexState())
+                        self._record_failures(st, fresh, replace, now)
+                        for p in fresh:
+                            out.new_failures.append(NewFailure(
+                                typ, i, p.metadata.name,
+                                p.status.reason or "", None))
+                    if st is not None:
+                        out.counts[typ] = out.counts.get(typ, 0) + st.total
+                    # A decision exists only while the failure is unresolved:
+                    # failed record(s) present, nothing alive or done at the
+                    # index yet.
+                    blocked = any(is_pod_active(p) for p in plist) or any(
+                        p.status.phase == PHASE_SUCCEEDED for p in plist)
+                    if not failed or blocked or st is None:
+                        continue
+                    d = self._decide(st, replace, limit, now)
+                    d.reason = failed[-1].status.reason or ""
+                    out.decisions[(typ, i)] = d
+                    if d.action == ACTION_BACKOFF:
+                        rem = d.remaining_s
+                        if (out.requeue_after_s == 0.0
+                                or rem < out.requeue_after_s):
+                            out.requeue_after_s = rem
+                    if (d.action == ACTION_EXHAUSTED
+                            and not st.exhausted_emitted):
+                        st.exhausted_emitted = True
+                        out.newly_exhausted.append((typ, i, d))
+        # Attach decisions to the new-failure records (post-decision: the
+        # decision reflects ALL failures seen this sync, not a partial view).
+        for nf in out.new_failures:
+            nf.decision = out.decisions.get((nf.type, nf.index)) or \
+                RestartDecision(ACTION_REPLACE, reason=nf.reason)
+        return out
+
+    def _observe_running(self, st: _IndexState, pod_name: str,
+                         now: float) -> None:
+        if st.running_pod != pod_name:
+            st.running_pod = pod_name
+            st.running_since = now
+            if st.pending_since and pod_name not in st.failed_pods:
+                # Replacement reached Running: the restart latency sample.
+                self._h_latency.observe(max(0.0, now - st.pending_since))
+                st.pending_since = 0.0
+        elif (st.streak and self.config.reset_after_s > 0
+              and now - st.running_since >= self.config.reset_after_s):
+            st.streak = 0  # healthy long enough: forgive the streak
+
+    def _record_failures(self, st: _IndexState, fresh, replace: bool,
+                         now: float) -> None:
+        cfg = self.config
+        for p in fresh:
+            st.failed_pods.add(p.metadata.name)
+            st.total += 1
+            st.streak += 1
+            if replace:
+                self._c_restarts.labels(
+                    _coarse_reason(p.status.reason or "")).inc()
+        if not st.pending_since:
+            st.pending_since = now
+        delay = 0.0
+        if st.streak > 1:
+            delay = min(
+                cfg.initial_backoff_s
+                * (cfg.backoff_factor ** (st.streak - 2)),
+                cfg.max_backoff_s)
+            if cfg.jitter > 0:
+                delay *= 1.0 + self._rng.uniform(0.0, cfg.jitter)
+        st.delay_s = delay
+        st.ready_at = now + delay
+        if replace:
+            self._h_backoff.observe(delay)
+
+    def _decide(self, st: _IndexState, replace: bool, limit: int,
+                now: float) -> RestartDecision:
+        if not replace:
+            return RestartDecision(ACTION_NEVER, count=st.total,
+                                   streak=st.streak)
+        if limit >= 0 and st.streak > limit:
+            return RestartDecision(ACTION_EXHAUSTED, count=st.total,
+                                   streak=st.streak, delay_s=st.delay_s)
+        if now < st.ready_at:
+            return RestartDecision(ACTION_BACKOFF, count=st.total,
+                                   streak=st.streak, delay_s=st.delay_s,
+                                   remaining_s=st.ready_at - now)
+        return RestartDecision(ACTION_REPLACE, count=st.total,
+                               streak=st.streak, delay_s=st.delay_s)
+
+    # -------------------------------------------------------------- plumbing
+
+    def backoff_schedule(self, streaks) -> List[float]:
+        """The deterministic (jitter-free) delay for each streak length in
+        ``streaks`` — the schedule tests pin down."""
+        cfg = self.config
+        out = []
+        for s in streaks:
+            if s <= 1:
+                out.append(0.0)
+            else:
+                out.append(min(
+                    cfg.initial_backoff_s * (cfg.backoff_factor ** (s - 2)),
+                    cfg.max_backoff_s))
+        return out
+
+    def restarts(self, key: str) -> Dict[ReplicaType, int]:
+        """Monotonic restart totals per type (for status without a sync)."""
+        out: Dict[ReplicaType, int] = {}
+        with self._lock:
+            for (typ, _), st in self._jobs.get(key, {}).items():
+                out[typ] = out.get(typ, 0) + st.total
+        return out
+
+    def forget_job(self, key: str) -> None:
+        with self._lock:
+            self._jobs.pop(key, None)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._jobs.values())

@@ -139,6 +139,7 @@ def compute_progress(
                 loss=pr.loss,
                 phase=pr.phase,
                 compile_source=pr.compile_source,
+                resumed_from_step=pr.resumed_from_step,
                 last_heartbeat=pr.timestamp,
                 stalled=idx in stalled_idx,
             ))
@@ -166,7 +167,13 @@ def compute_status(
     pods_by_type: Dict[ReplicaType, List[Pod]],
     now: Optional[float] = None,
     tracker=None,
+    recovery=None,
 ) -> TFJobStatus:
+    """``recovery`` (optional) is the RestartTracker's RecoveryAssessment:
+    it supplies the per-type restart totals (TFReplicaStatus.restarts, the
+    CLI RESTARTS column) and the backoff-limit verdicts — an index whose
+    restart budget is exhausted is terminal exactly like restartPolicy
+    Never, with the job's reason naming the policy that gave up."""
     status = serde.deep_copy(job.status)
     prev_phase = status.phase
 
@@ -185,6 +192,9 @@ def compute_status(
     # reason is "Preempted: …" was evicted by a higher-priority gang.
     gang_queue_msg = ""
     gang_preempt_msg = ""
+    # Recovery-plane terminal verdicts ("BackoffLimitExceeded: …" /
+    # "RestartPolicyNever: …") — the first one becomes the Failed reason.
+    terminal_msgs: List[str] = []
 
     for spec in job.spec.tf_replica_specs:
         typ = spec.tf_replica_type
@@ -210,6 +220,8 @@ def compute_status(
             if st == TFReplicaState.RUNNING:
                 any_running = True
 
+        exhausted = recovery.exhausted(typ) if recovery is not None else set()
+
         by_idx = pods_by_index(pods)
         done: Dict[int, str] = {}
         for i in range(desired):
@@ -221,6 +233,19 @@ def compute_status(
             if failed and not replace_on_failure and not has_active and i not in done:
                 done[i] = PHASE_FAILED
                 any_terminal_failure = True
+                terminal_msgs.append(
+                    f"RestartPolicyNever: {typ.value}-{i} failed "
+                    f"({failed[-1].status.reason or 'no reason'})")
+            elif failed and i in exhausted and not has_active and i not in done:
+                # The restart policy engine gave up on this index: terminal,
+                # exactly like restartPolicy Never, with the budget named.
+                done[i] = PHASE_FAILED
+                any_terminal_failure = True
+                d = recovery.decision_for(typ, i)
+                terminal_msgs.append(
+                    f"BackoffLimitExceeded: {typ.value}-{i} failed "
+                    f"{d.count if d else '?'} times "
+                    f"(backoffLimit {job.spec.backoff_limit})")
             elif failed and replace_on_failure and not has_active:
                 recovering = True
             if not plist:
@@ -235,6 +260,8 @@ def compute_status(
                 state=_aggregate_state(states, desired),
                 pod_names=sorted(p.metadata.name for p in pods),
                 tf_replicas_states=hist,
+                restarts=(recovery.restarts_for(typ)
+                          if recovery is not None else 0),
             )
         )
 
@@ -290,7 +317,11 @@ def compute_status(
     # health.py) so `describe` and the status surface tell one story.
     from ..checker import check_health
 
-    health = check_health(job, pods_by_type, now=now, tracker=tracker)
+    health = check_health(
+        job, pods_by_type, now=now, tracker=tracker,
+        exhausted=({t.tf_replica_type: recovery.exhausted(t.tf_replica_type)
+                    for t in job.spec.tf_replica_specs}
+                   if recovery is not None else None))
     health_msg = "; ".join(
         f"{t.value}={rh.health.value} {rh.running}/{rh.desired} running"
         + (f", missing {rh.missing_indices}" if rh.missing_indices else "")
@@ -309,6 +340,13 @@ def compute_status(
 
     terminal = phase in (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED)
     any_stalled = any(rh.stalled_indices for rh in health.replicas.values())
+    # Recovery-plane terminal verdict: why the job failed, on the status
+    # surface (`kctpu get` REASON column + the acceptance contract that a
+    # killed Never-policy pod yields a POLICY condition, not a silent hang).
+    if phase == TFJobPhase.FAILED and terminal_msgs:
+        if not status.reason or status.reason.startswith(
+                ("GangQueued", "BackoffLimitExceeded", "RestartPolicyNever")):
+            status.reason = terminal_msgs[0]
     # Queue state surfaces as the job's Pending reason + Scheduled=False
     # (GangQueued) so `kctpu get` answers "why is this job not running".
     if gang_queue_msg and not terminal:
@@ -326,10 +364,17 @@ def compute_status(
                           else "AllReplicasReady" if ready
                           else "ReplicasNotReady"),
                   message=health_msg, now=now)
-    set_condition(status, TFJobConditionType.RECOVERING, recovering,
-                  reason=("GangPreempted" if recovering and gang_preempt_msg
-                          else "ReplacingFailedReplicas" if recovering else ""),
-                  message=gang_preempt_msg if recovering else "", now=now)
+    if not recovering and phase == TFJobPhase.FAILED and terminal_msgs:
+        # The recovery plane GAVE UP (backoff limit spent, or the policy
+        # forbids restarts): Recovering=False carries the verdict.
+        set_condition(status, TFJobConditionType.RECOVERING, False,
+                      reason=terminal_msgs[0].split(":", 1)[0],
+                      message="; ".join(terminal_msgs), now=now)
+    else:
+        set_condition(status, TFJobConditionType.RECOVERING, recovering,
+                      reason=("GangPreempted" if recovering and gang_preempt_msg
+                              else "ReplacingFailedReplicas" if recovering else ""),
+                      message=gang_preempt_msg if recovering else "", now=now)
     has_active = any(
         is_pod_active(p) for pods in pods_by_type.values() for p in pods
     )

@@ -9,10 +9,14 @@ replacement replica resumes instead of restarting from scratch.
 
 from __future__ import annotations
 
+import logging
 import os
+import shutil
 from typing import Any, Optional, Tuple
 
 import jax
+
+logger = logging.getLogger("kubeflow_controller_tpu.checkpoint")
 
 
 class CheckpointManager:
@@ -55,21 +59,25 @@ class CheckpointManager:
         return self._mgr.latest_step()
 
     def restore(self, target_params: Any, target_opt_state: Any) -> Tuple[Any, Any, int]:
-        """Restore the latest checkpoint onto abstract/like targets; returns
-        (params, opt_state, step).  Raises if none exists.
+        """Restore the latest *readable* checkpoint onto abstract/like
+        targets; returns (params, opt_state, step).  Raises if none exists.
 
         Shardings are preserved: a target leaf that is a live mesh-sharded
         ``jax.Array`` (the normal case — params are initialized with their
         NamedShardings before restore, e.g. llama_pretrain) restores
         directly into that layout rather than fully-replicated onto default
         devices, which would OOM or mis-place multi-host models on resume.
+
+        Corrupt-checkpoint fallback (the recovery plane's contract): a
+        SIGKILL mid-save can leave the newest step dir torn in ways Orbax's
+        own finalization marker does not catch (truncated array files, a
+        half-written tree).  A step that fails to load is deleted (with one
+        warning) and the previous step is tried, so a resuming replica
+        degrades to losing one checkpoint interval instead of crash-looping
+        on the same bad read forever.
         """
         import orbax.checkpoint as ocp
         from jax.sharding import NamedSharding
-
-        step = self._mgr.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint under {self.directory}")
 
         def abstract(x):
             s = getattr(x, "sharding", None)
@@ -78,7 +86,35 @@ class CheckpointManager:
             return ocp.utils.to_shape_dtype_struct(x)
 
         ref = {"params": target_params, "opt_state": target_opt_state}
-        restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(jax.tree.map(abstract, ref))
-        )
-        return restored["params"], restored["opt_state"], step
+        abstract_ref = jax.tree.map(abstract, ref)
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        for i, step in enumerate(steps):
+            try:
+                restored = self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(abstract_ref))
+                return restored["params"], restored["opt_state"], step
+            except Exception as e:  # noqa: BLE001 — corrupt/partial step
+                # (FileNotFoundError here means missing files INSIDE the
+                # step dir — torn, not absent; fall back like any corruption.)
+                if i + 1 >= len(steps):
+                    raise  # nothing older to fall back to
+                logger.warning(
+                    "checkpoint step %d under %s is unreadable (%s); "
+                    "deleting it and falling back to step %d",
+                    step, self.directory, e, steps[i + 1])
+                self._drop_step(step)
+        raise FileNotFoundError(f"no readable checkpoint under {self.directory}")
+
+    def _drop_step(self, step: int) -> None:
+        """Remove a bad step so no later resume trips over it again (the
+        manager's own delete first; rmtree as the fallback for dirs the
+        manager no longer recognizes)."""
+        try:
+            self._mgr.delete(step)
+            return
+        except Exception:  # noqa: BLE001
+            pass
+        shutil.rmtree(os.path.join(self.directory, str(step)),
+                      ignore_errors=True)

@@ -70,6 +70,19 @@ def main(argv=None) -> int:
                    help="per-step-dispatch TTFS pipeline instead of the "
                         "single-program scan (real per-step progress beats, "
                         "AOT step executable, overlapped host setup)")
+    p.add_argument("--checkpoint-every", type=int,
+                   default=int(os.environ.get("KCTPU_CHECKPOINT_EVERY", "0")
+                               or "0"),
+                   help="step-loop mode: async CheckpointManager.save every "
+                        "N steps into MODEL_DIR (0 = only the final save); "
+                        "bounds the steps a mid-fit kill can lose — "
+                        "injected from spec.checkpoint_every_steps")
+    p.add_argument("--step-sleep", type=float,
+                   default=float(os.environ.get("KCTPU_STEP_SLEEP", "0")
+                                 or "0"),
+                   help="step-loop mode: host-side sleep per step (seconds) "
+                        "— stretches the fit window so chaos/fault benches "
+                        "can kill reliably mid-fit")
     p.add_argument("--no-overlap", action="store_true",
                    default=bool(os.environ.get("KCTPU_NO_OVERLAP")),
                    help="serial baseline: run host setup after rendezvous "
@@ -114,6 +127,16 @@ def main(argv=None) -> int:
 
     rt = JobRuntime.from_env()
     rt.merge_tf_args(args.job_name, args.task_index, args.worker_hosts)
+
+    # Recovery plane (opt-in via $KCTPU_GANG_MONITOR): the gang guard's
+    # heartbeat files + peer monitor turn "survivor hangs in a torn
+    # collective" into "survivor exits for re-rendezvous" — started before
+    # the rendezvous so a peer that dies INSIDE the join is detected too.
+    from ..recovery.rendezvous import guard_from_env
+
+    guard = guard_from_env(rt)
+    if guard is not None:
+        guard.start()
 
     # Host setup — pure numpy, so it can run CONCURRENTLY with the
     # rendezvous (and, in step-loop mode, with the AOT compile: setup
@@ -180,6 +203,10 @@ def main(argv=None) -> int:
         CheckpointManager(rt.model_dir).save(args.steps, params, opt_state)
         if proc == 0:
             print(f"Checkpoint saved to {rt.model_dir}")
+    if guard is not None:
+        # Clean completion: the done marker BEFORE the exit barrier, so a
+        # fast peer's silence is never mistaken for death.
+        guard.mark_done()
     if pc > 1:
         # Leave together, then disconnect cleanly: process 0 hosts the
         # coordination service, and an early exit turns a peer still
@@ -330,10 +357,50 @@ def _fit_step_loop(args, jax, jnp, m, rt, setup, mesh, opt, dp, pc, proc,
             params = replicate_pytree(mesh, params)
             opt_state = replicate_pytree(mesh, opt_state)
 
+        # Checkpoint-resume (recovery plane): restore the latest readable
+        # step BEFORE the first beat — a replacement/restarted replica
+        # resumes where the gang's checkpoints left off instead of at step
+        # 0, and the progress plane reports resumed_from_step so a
+        # backward-jumping step counter reads as a resume, not a stall.
+        start_step = 0
+        mgr = None
+        ck_fn = None
+        if rt.model_dir:
+            from ..obs import trace as _tr
+            from .checkpoint import CheckpointManager
+            from .progress import reporter as _reporter
+
+            mgr = CheckpointManager(rt.model_dir)
+            if mgr.latest_step() is not None:
+                _reporter().beat(phase="restore")
+                with _tr.span("workload/restore", process=proc) as sp_r:
+                    params, opt_state, start_step = mgr.restore(
+                        params, opt_state)
+                    sp_r.args["step"] = start_step
+                start_step = min(start_step, args.steps)
+                _reporter().beat(step=start_step, phase="restore",
+                                 resumed_from_step=start_step)
+            if args.checkpoint_every > 0:
+                def ck_fn(s, p, o, _mgr=mgr):
+                    _mgr.save(s, p, o, wait=False)
+
+        step_fn = res.compiled
+        if args.step_sleep > 0:
+            def step_fn(p, s, x, y, t, _inner=res.compiled,
+                        _zz=args.step_sleep):
+                time.sleep(_zz)
+                return _inner(p, s, x, y, t)
+
         params, opt_state, loss = train_step_loop_dist(
-            res.compiled, params, opt_state, x_all, y_all, args.steps,
-            examples_per_step=bs, compile_source=res.source)
+            step_fn, params, opt_state, x_all, y_all, args.steps,
+            examples_per_step=bs, compile_source=res.source,
+            start_step=start_step, checkpoint_every=args.checkpoint_every,
+            checkpoint_fn=ck_fn)
         loss = float(loss)
+        if mgr is not None:
+            # Flush in-flight async saves before anything else reopens the
+            # directory (main()'s final save builds a fresh manager).
+            mgr.wait()
 
         ex, ey = replicate_global(
             mesh, np.asarray(eval_set[0]),

@@ -73,7 +73,8 @@ class ProgressReporter:
              examples_per_sec: Optional[float] = None,
              loss: Optional[float] = None,
              phase: Optional[str] = None,
-             compile_source: Optional[str] = None) -> None:
+             compile_source: Optional[str] = None,
+             resumed_from_step: Optional[int] = None) -> None:
         """Publish one heartbeat; None fields carry the previous value.
         The beat time is stamped server-side (store.update_progress), so
         ``timestamp`` stays 0 on the wire."""
@@ -90,6 +91,11 @@ class ProgressReporter:
                 self._last["phase"] = phase
             if compile_source is not None:
                 self._last["compileSource"] = compile_source
+            if resumed_from_step is not None:
+                # Checkpoint-resume evidence: sticky for the pod's life so
+                # the recovery plane can compute lost steps from any later
+                # beat (a merge field like the others).
+                self._last["resumedFromStep"] = int(resumed_from_step)
             body = dict(self._last)
         self._publish(body)
 

@@ -31,10 +31,18 @@ from ..planner.materialize import (
 # doesn't).  Absent outside the single-node fake cluster — real clusters
 # have no shared /tmp, and there the TCP probe alone does the job.
 ENV_RENDEZVOUS_DIR = "KCTPU_RENDEZVOUS_DIR"
+# Controller-bumped gang generation (recovery plane): a replacement gang
+# rendezvouses in a generation-keyed namespace, so the dead generation's
+# leftover readiness drop can never convince a new peer that a coordinator
+# which no longer exists is about to bind.
+ENV_GANG_GENERATION = "KCTPU_GANG_GENERATION"
 
 
-def _ready_filename(coordinator: str) -> str:
-    return coordinator.replace("/", "_").replace(":", "_") + ".ready"
+def _ready_filename(coordinator: str, generation: int = 0) -> str:
+    base = coordinator.replace("/", "_").replace(":", "_")
+    if generation:
+        base += f"_g{generation}"
+    return base + ".ready"
 
 
 class HostSetup:
@@ -99,6 +107,10 @@ class JobRuntime:
     # inside a slice), e.g. MeshSpec(dp=num_slices, ...).
     num_slices: int = 1
     slice_id: int = 0
+    # Recovery plane: which gang generation this process belongs to (0 =
+    # first incarnation).  Bumped by the controller on gang replacement;
+    # keys the readiness drops below so generations never cross-talk.
+    gang_generation: int = 0
     data_dir: str = ""
     model_dir: str = ""
     log_dir: str = ""
@@ -117,6 +129,7 @@ class JobRuntime:
             worker_hostnames=hostnames,
             num_slices=int(e.get(ENV_NUM_SLICES, "1") or "1"),
             slice_id=int(e.get(ENV_SLICE_ID, "0") or "0"),
+            gang_generation=int(e.get(ENV_GANG_GENERATION, "0") or "0"),
             data_dir=e.get("DATA_DIR", ""),
             model_dir=e.get("MODEL_DIR", ""),
             log_dir=e.get("LOG_DIR", ""),
@@ -202,7 +215,8 @@ class JobRuntime:
         d = os.environ.get(ENV_RENDEZVOUS_DIR, "")
         if not d or not self.coordinator:
             return ""
-        return os.path.join(d, _ready_filename(self.coordinator))
+        return os.path.join(
+            d, _ready_filename(self.coordinator, self.gang_generation))
 
     def _drop_ready_file(self) -> None:
         path = self._ready_path()

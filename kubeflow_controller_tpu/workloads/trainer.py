@@ -351,17 +351,33 @@ def train_step_loop_dist(
     examples_per_step: int = 0,
     compile_source: str = "",
     beat_interval_s: float = 0.25,
+    start_step: int = 0,
+    checkpoint_every: int = 0,
+    checkpoint_fn: Optional[Callable[[int, Any, Any], None]] = None,
 ) -> Tuple[Any, Any, jax.Array]:
     """Drive a (usually AOT-precompiled) :func:`make_dist_step` executable
-    for ``steps`` steps with REAL per-step progress.
+    from ``start_step`` to ``steps`` with REAL per-step progress.
 
     The first step is special — it is the end of the time-to-first-step
     pipeline: it gets its own ``workload/first_step`` span and an
-    immediate ``step=1`` beat carrying ``compile_source`` ("cache-hit" vs
+    immediate beat carrying ``compile_source`` ("cache-hit" vs
     "compiled"), so the controller's progress plane records both when
     training actually started and whether the compile was paid or skipped.
     Subsequent steps beat at most every ``beat_interval_s`` (a float(loss)
     sync per beat; per-step syncing would serialize host and device).
+
+    Recovery hooks (the kill→restore→resume loop, docs/RECOVERY.md):
+
+    - ``start_step`` > 0 resumes a restored run — the loop executes steps
+      ``start_step..steps-1`` and the first beat carries
+      ``resumed_from_step`` so the controller's progress plane knows a
+      backward-jumping step counter is a resume (phase="restore" hold in
+      the stall detector), and the chaos bench can compute lost steps;
+    - ``checkpoint_fn(done_steps, params, opt_state)`` runs every
+      ``checkpoint_every`` completed steps (callers pass an ASYNC
+      CheckpointManager.save so the write overlaps the next steps) —
+      bounding the steps a kill can lose to the interval.
+
     Returns (params, opt_state, last_loss)."""
     import time as _time
 
@@ -370,36 +386,50 @@ def train_step_loop_dist(
     from ..obs.trace import span as obs_span
     from .progress import reporter
 
+    # A restore at (or past) the finish line re-runs the last step: the
+    # loop keeps a well-defined loss and the final checkpoint/telemetry
+    # shape, at the cost of one redundant step.
+    start_step = max(0, min(start_step, steps - 1))
+    run_steps = steps - start_step
     rep = reporter()
     t0 = _time.perf_counter()
-    with obs_span("workload/first_step") as sp_first:
+    with obs_span("workload/first_step", start_step=start_step) as sp_first:
         params, opt_state, loss = step(params, opt_state, x_all, y_all,
-                                       np.int32(0))
+                                       np.int32(start_step))
         loss = jax.block_until_ready(loss)
         sp_first.args["process"] = jax.process_index()
-    rep.beat(step=1, loss=float(loss), phase="fit",
+    rep.beat(step=start_step + 1, loss=float(loss), phase="fit",
              compile_source=compile_source,
+             resumed_from_step=start_step if start_step else None,
              examples_per_sec=(examples_per_step / sp_first.dur
                                if sp_first.dur > 0 and examples_per_step
                                else None))
     next_beat = _time.perf_counter() + beat_interval_s
-    with obs_span("workload/fit", steps=steps) as sp_fit:
-        for t in range(1, steps):
+    with obs_span("workload/fit", steps=steps,
+                  start_step=start_step) as sp_fit:
+        for t in range(start_step + 1, steps):
             params, opt_state, loss = step(params, opt_state, x_all, y_all,
                                            np.int32(t))
+            done = t + 1
+            if (checkpoint_fn is not None and checkpoint_every > 0
+                    and done % checkpoint_every == 0 and done < steps):
+                # Async save: Orbax serializes in the background, the next
+                # step overlaps the write; a step becomes restorable only
+                # once finalized (checkpoint.py), so a kill mid-save falls
+                # back to the previous interval.
+                checkpoint_fn(done, params, opt_state)
             now = _time.perf_counter()
             if now >= next_beat:
                 next_beat = now + beat_interval_s
-                done = t + 1
                 rep.beat(step=done, loss=float(loss),
-                         examples_per_sec=(done * examples_per_step /
-                                           (now - t0)
+                         examples_per_sec=((done - start_step)
+                                           * examples_per_step / (now - t0)
                                            if examples_per_step else None))
         loss = jax.block_until_ready(loss)
     dur = sp_first.dur + sp_fit.dur
-    record_step_telemetry(steps, dur, examples_per_step)
+    record_step_telemetry(run_steps, dur, examples_per_step)
     rep.beat(step=steps, loss=float(loss), phase="fit",
-             examples_per_sec=(steps * examples_per_step / dur
+             examples_per_sec=(run_steps * examples_per_step / dur
                                if dur > 0 and examples_per_step else None))
     return params, opt_state, loss
 

@@ -1,0 +1,679 @@
+"""Recovery plane tests: restart policy engine (backoff math, limits,
+index-preserved re-create), checkpoint-resume (kill→restore ≡ uninterrupted,
+corrupt-checkpoint fallback), gang-generation fan-out, restore-phase stall
+hold, and ReplicaRestarted event dedup."""
+
+import os
+import random
+import time
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import (
+    PHASE_FAILED,
+    PHASE_RUNNING,
+    Container,
+    Pod,
+    PodProgress,
+    PodTemplateSpec,
+)
+from kubeflow_controller_tpu.api.labels import (
+    ANNOTATION_GANG_GENERATION,
+    LABEL_INDEX,
+    LABEL_JOB_TYPE,
+)
+from kubeflow_controller_tpu.api.meta import ObjectMeta
+from kubeflow_controller_tpu.api.tfjob import (
+    ReplicaType,
+    TFJob,
+    TFJobConditionType,
+    TFJobPhase,
+    TFReplicaSpec,
+)
+from kubeflow_controller_tpu.checker import StallPolicy, StallTracker
+from kubeflow_controller_tpu.recovery import (
+    ACTION_BACKOFF,
+    ACTION_EXHAUSTED,
+    ACTION_NEVER,
+    ACTION_REPLACE,
+    RestartPolicyConfig,
+    RestartTracker,
+)
+from kubeflow_controller_tpu.updater import compute_status
+
+
+def mk_job(name="job", n=2, restart="OnFailure", typ=ReplicaType.WORKER,
+           gang=False, backoff_limit=6):
+    job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+    t = PodTemplateSpec()
+    t.spec.containers.append(Container(name="c", image="img"))
+    t.spec.restart_policy = restart
+    job.spec.backoff_limit = backoff_limit
+    job.spec.tf_replica_specs = [TFReplicaSpec(
+        replicas=n, tf_replica_type=typ, template=t, gang_restart=gang)]
+    return job
+
+
+def mk_pod(name, typ="Worker", index=0, phase=PHASE_FAILED, reason="",
+           job="job"):
+    p = Pod(metadata=ObjectMeta(name=name, namespace="default"))
+    p.metadata.labels = {LABEL_JOB_TYPE: typ, LABEL_INDEX: str(index),
+                         "tf_job_name": job}
+    p.status.phase = phase
+    p.status.reason = reason
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Backoff schedule math (deterministic, injected clock)
+# ---------------------------------------------------------------------------
+
+class TestBackoffSchedule:
+    def test_schedule_first_free_then_exponential_capped(self):
+        tr = RestartTracker(RestartPolicyConfig(
+            initial_backoff_s=1.0, backoff_factor=2.0, max_backoff_s=8.0,
+            jitter=0.0))
+        assert tr.backoff_schedule([1, 2, 3, 4, 5, 6, 7]) == \
+            [0.0, 1.0, 2.0, 4.0, 8.0, 8.0, 8.0]
+
+    def test_assess_applies_backoff_with_injected_clock(self):
+        tr = RestartTracker(RestartPolicyConfig(
+            initial_backoff_s=2.0, backoff_factor=2.0, max_backoff_s=60.0,
+            jitter=0.0))
+        job = mk_job()
+        t0 = 1000.0
+        # First failure: replace immediately (delay 0).
+        pods = {ReplicaType.WORKER: [mk_pod("w0-a", index=0)]}
+        a = tr.assess("default/job", job, pods, t0)
+        d = a.decision_for(ReplicaType.WORKER, 0)
+        assert d.action == ACTION_REPLACE and d.count == 1
+        assert d.delay_s == 0.0 and a.requeue_after_s == 0.0
+        # Second distinct failed pod: 2s backoff from the observation time.
+        pods = {ReplicaType.WORKER: [mk_pod("w0-a", index=0),
+                                     mk_pod("w0-b", index=0)]}
+        a = tr.assess("default/job", job, pods, t0 + 10)
+        d = a.decision_for(ReplicaType.WORKER, 0)
+        assert d.action == ACTION_BACKOFF and d.count == 2
+        assert d.delay_s == pytest.approx(2.0)
+        assert d.remaining_s == pytest.approx(2.0)
+        assert a.requeue_after_s == pytest.approx(2.0)
+        # Mid-window: still waiting, remaining shrinks with the clock.
+        a = tr.assess("default/job", job, pods, t0 + 11.5)
+        d = a.decision_for(ReplicaType.WORKER, 0)
+        assert d.action == ACTION_BACKOFF
+        assert d.remaining_s == pytest.approx(0.5)
+        # Window elapsed: replace.
+        a = tr.assess("default/job", job, pods, t0 + 12.1)
+        assert a.decision_for(ReplicaType.WORKER, 0).action == ACTION_REPLACE
+        # Third failure: 4s (factor^1), seen at its own observation time.
+        pods[ReplicaType.WORKER].append(mk_pod("w0-c", index=0))
+        a = tr.assess("default/job", job, pods, t0 + 20)
+        d = a.decision_for(ReplicaType.WORKER, 0)
+        assert d.action == ACTION_BACKOFF and d.delay_s == pytest.approx(4.0)
+
+    def test_jitter_is_deterministic_with_seeded_rng(self):
+        def delays(seed):
+            tr = RestartTracker(RestartPolicyConfig(
+                initial_backoff_s=1.0, jitter=0.5),
+                rng=random.Random(seed))
+            job = mk_job()
+            pods = {ReplicaType.WORKER: [mk_pod("a", index=0),
+                                         mk_pod("b", index=0)]}
+            a = tr.assess("default/job", job, pods, 0.0)
+            return a.decision_for(ReplicaType.WORKER, 0).delay_s
+
+        assert delays(42) == delays(42)
+        d = delays(42)
+        assert 1.0 <= d <= 1.5  # multiplicative jitter in [1, 1.5)x
+
+    def test_streak_resets_after_healthy_running(self):
+        tr = RestartTracker(RestartPolicyConfig(
+            initial_backoff_s=1.0, jitter=0.0, reset_after_s=100.0))
+        job = mk_job()
+        key = "default/job"
+        # Two failures -> streak 2.
+        pods = {ReplicaType.WORKER: [mk_pod("a", index=0),
+                                     mk_pod("b", index=0)]}
+        tr.assess(key, job, pods, 0.0)
+        # Replacement runs healthy past the reset window.
+        run = {ReplicaType.WORKER: [mk_pod("c", index=0,
+                                           phase=PHASE_RUNNING)]}
+        tr.assess(key, job, run, 10.0)
+        tr.assess(key, job, run, 200.0)  # >= reset_after_s of Running
+        # Next failure: streak back to 1 -> immediate replace, but the
+        # monotonic total keeps counting (status RESTARTS never decreases).
+        pods = {ReplicaType.WORKER: [mk_pod("d", index=0)]}
+        a = tr.assess(key, job, pods, 210.0)
+        d = a.decision_for(ReplicaType.WORKER, 0)
+        assert d.action == ACTION_REPLACE and d.streak == 1
+        assert a.restarts_for(ReplicaType.WORKER) == 3
+
+    def test_preempted_pods_are_exempt(self):
+        tr = RestartTracker(RestartPolicyConfig(jitter=0.0))
+        job = mk_job()
+        pods = {ReplicaType.WORKER: [mk_pod(
+            "a", index=0, reason="Preempted: evicted by gang x (class high)")]}
+        a = tr.assess("default/job", job, pods, 0.0)
+        assert a.decision_for(ReplicaType.WORKER, 0) is None
+        assert a.restarts_for(ReplicaType.WORKER) == 0
+
+
+# ---------------------------------------------------------------------------
+# backoffLimit -> terminal Failed; restartPolicy Never -> terminal Failed
+# ---------------------------------------------------------------------------
+
+class TestTerminalPolicy:
+    def test_backoff_limit_exceeded_fails_job_with_condition(self):
+        tr = RestartTracker(RestartPolicyConfig(jitter=0.0))
+        job = mk_job(backoff_limit=0)  # first failure is one too many
+        pods = {ReplicaType.WORKER: [mk_pod("a", index=0),
+                                     mk_pod("w1", index=1,
+                                            phase=PHASE_RUNNING)]}
+        a = tr.assess("default/job", job, pods, 0.0)
+        d = a.decision_for(ReplicaType.WORKER, 0)
+        assert d.action == ACTION_EXHAUSTED
+        assert [(t, i) for t, i, _ in a.newly_exhausted] == \
+            [(ReplicaType.WORKER, 0)]
+        st = compute_status(job, pods, recovery=a)
+        assert st.phase == TFJobPhase.FAILED
+        assert st.reason.startswith("BackoffLimitExceeded")
+        cond = next(c for c in st.conditions
+                    if c.type == TFJobConditionType.RECOVERING)
+        assert cond.status == "False"
+        assert cond.reason == "BackoffLimitExceeded"
+        # The edge only fires once: a second assess reports nothing new.
+        a2 = tr.assess("default/job", job, pods, 1.0)
+        assert a2.newly_exhausted == []
+
+    def test_restart_policy_never_fails_with_policy_reason(self):
+        job = mk_job(restart="Never", n=1)
+        pods = {ReplicaType.WORKER: [mk_pod("a", index=0,
+                                            reason="Error: exit 1: boom")]}
+        st = compute_status(job, pods)
+        assert st.phase == TFJobPhase.FAILED
+        assert st.reason.startswith("RestartPolicyNever")
+        cond = next(c for c in st.conditions
+                    if c.type == TFJobConditionType.RECOVERING)
+        assert cond.reason == "RestartPolicyNever"
+
+    def test_restarts_surface_in_replica_status(self):
+        tr = RestartTracker(RestartPolicyConfig(jitter=0.0))
+        job = mk_job()
+        pods = {ReplicaType.WORKER: [mk_pod("a", index=0),
+                                     mk_pod("b", index=1)]}
+        a = tr.assess("default/job", job, pods, 0.0)
+        st = compute_status(job, pods, recovery=a)
+        rs = next(r for r in st.tf_replica_statuses
+                  if r.type == ReplicaType.WORKER)
+        assert rs.restarts == 2
+
+
+# ---------------------------------------------------------------------------
+# Controller e2e: index-preserved re-create, events, gang generation
+# ---------------------------------------------------------------------------
+
+def wait_for(fn, timeout=15.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+@pytest.fixture
+def rig():
+    from kubeflow_controller_tpu.cluster import Cluster, FakeKubelet, PhasePolicy
+    from kubeflow_controller_tpu.controller import Controller
+
+    cluster = Cluster()
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=3.0))
+    ctrl = Controller(cluster, resync_period_s=0.5,
+                      restart_config=RestartPolicyConfig(
+                          initial_backoff_s=0.05, jitter=0.0))
+    kubelet.start()
+    ctrl.run(threadiness=2)
+    yield cluster, ctrl, kubelet
+    ctrl.stop()
+    kubelet.stop()
+
+
+def mk_sim_job(name, n=3, gang=False, backoff_limit=6):
+    job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+    t = PodTemplateSpec()
+    t.spec.containers.append(Container(name="c", image="img"))
+    t.spec.restart_policy = "OnFailure"
+    job.spec.backoff_limit = backoff_limit
+    job.spec.tf_replica_specs = [TFReplicaSpec(
+        replicas=n, tf_replica_type=ReplicaType.WORKER, template=t,
+        gang_restart=gang)]
+    return job
+
+
+class TestControllerRecovery:
+    def test_index_preserved_recreate_with_restart_event(self, rig):
+        cluster, ctrl, kubelet = rig
+        cluster.tfjobs.create(mk_sim_job("rec", n=3))
+        wait_for(lambda: len(cluster.pods.list("default")) == 3)
+        target = next(p for p in cluster.pods.list("default")
+                      if p.metadata.labels[LABEL_INDEX] == "1")
+        others = {p.metadata.name for p in cluster.pods.list("default")
+                  if p.metadata.name != target.metadata.name}
+        kubelet.set_phase("default", target.metadata.name, PHASE_FAILED,
+                          reason="Error: exit 1: boom")
+
+        def replaced():
+            pods = [p for p in cluster.pods.list("default")
+                    if p.metadata.labels[LABEL_INDEX] == "1"]
+            return (pods and all(p.metadata.name != target.metadata.name
+                                 for p in pods)) or None
+        wait_for(replaced)
+        # Index preserved, siblings untouched (no gang semantics here).
+        assert others <= {p.metadata.name for p in cluster.pods.list("default")}
+        evs = [e for e in ctrl.recorder.events_for("default", "rec")
+               if e.reason == "ReplicaRestarted"]
+        assert len(evs) == 1
+        assert "Worker-1" in evs[0].message and "restart #1" in evs[0].message
+        # RESTARTS lands on the status surface.
+        wait_for(lambda: sum(
+            rs.restarts for rs in cluster.tfjobs.get(
+                "default", "rec").status.tf_replica_statuses) == 1)
+
+    def test_restart_events_dedupe_per_index(self, rig):
+        cluster, ctrl, kubelet = rig
+        cluster.tfjobs.create(mk_sim_job("loop", n=2))
+        wait_for(lambda: len(cluster.pods.list("default")) == 2)
+
+        def fail_current_index0():
+            pods = [p for p in cluster.pods.list("default")
+                    if p.metadata.labels[LABEL_INDEX] == "0"
+                    and p.status.phase == PHASE_RUNNING]
+            if not pods:
+                return None
+            kubelet.set_phase("default", pods[0].metadata.name, PHASE_FAILED,
+                              reason="Error: exit 1: crash loop")
+            return pods[0].metadata.name
+
+        first = wait_for(fail_current_index0)
+        wait_for(lambda: next(
+            (p for p in cluster.pods.list("default")
+             if p.metadata.labels[LABEL_INDEX] == "0"
+             and p.metadata.name != first
+             and p.status.phase == PHASE_RUNNING), None))
+        second = wait_for(fail_current_index0)
+        assert second != first
+
+        def one_aggregated_event():
+            evs = [e for e in ctrl.recorder.events_for("default", "loop")
+                   if e.reason == "ReplicaRestarted"]
+            return (len(evs) == 1 and evs[0].count >= 2
+                    and "restart #2" in evs[0].message) or None
+        wait_for(one_aggregated_event)
+
+    def test_backoff_limit_zero_terminal_failed_e2e(self, rig):
+        cluster, ctrl, kubelet = rig
+        cluster.tfjobs.create(mk_sim_job("spent", n=1, backoff_limit=0))
+        wait_for(lambda: len(cluster.pods.list("default")) == 1)
+        pod = cluster.pods.list("default")[0]
+        kubelet.set_phase("default", pod.metadata.name, PHASE_FAILED,
+                          reason="Error: exit 1: dead on arrival")
+        wait_for(lambda: cluster.tfjobs.get("default", "spent").status.phase
+                 == TFJobPhase.FAILED)
+        j = cluster.tfjobs.get("default", "spent")
+        assert j.status.reason.startswith("BackoffLimitExceeded")
+        evs = [e for e in ctrl.recorder.events_for("default", "spent")
+               if e.reason == "BackoffLimitExceeded"]
+        assert len(evs) == 1
+        # No replacement was created.
+        assert len(cluster.pods.list("default")) == 1
+
+    def test_gang_generation_bump_fans_out_to_replacements(self, rig):
+        from kubeflow_controller_tpu.planner.materialize import (
+            ENV_GANG_GENERATION,
+        )
+
+        cluster, ctrl, kubelet = rig
+        cluster.tfjobs.create(mk_sim_job("gang", n=2, gang=True))
+        wait_for(lambda: len([p for p in cluster.pods.list("default")
+                              if p.status.phase == PHASE_RUNNING]) == 2)
+        before = {p.metadata.name for p in cluster.pods.list("default")}
+        victim = sorted(cluster.pods.list("default"),
+                        key=lambda p: p.metadata.name)[0]
+        kubelet.set_phase("default", victim.metadata.name, PHASE_FAILED,
+                          reason="Error: exit -9: killed")
+
+        def regenerated():
+            pods = cluster.pods.list("default")
+            fresh = [p for p in pods if p.metadata.name not in before]
+            return len(fresh) == 2 or None
+        wait_for(regenerated)
+        # The WHOLE gang was replaced (gang semantics), the job's
+        # generation annotation bumped, and every replacement carries it
+        # as annotation + env.
+        job = cluster.tfjobs.get("default", "gang")
+        assert job.metadata.annotations[ANNOTATION_GANG_GENERATION] == "1"
+        fresh = [p for p in cluster.pods.list("default")
+                 if p.metadata.name not in before]
+        assert len(fresh) == 2
+        assert {p.metadata.labels[LABEL_INDEX] for p in fresh} == {"0", "1"}
+        for p in fresh:
+            assert p.metadata.annotations[ANNOTATION_GANG_GENERATION] == "1"
+            env = {e.name: e.value for e in p.spec.containers[0].env}
+            assert env[ENV_GANG_GENERATION] == "1"
+        wait_for(lambda: cluster.tfjobs.get("default", "gang").status.phase
+                 == TFJobPhase.SUCCEEDED, timeout=20.0)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint-resume: kill at step S ≡ uninterrupted; corrupt fallback
+# ---------------------------------------------------------------------------
+
+class TestCheckpointResume:
+    def _setup(self):
+        import jax
+        import numpy as np
+
+        from kubeflow_controller_tpu.models import mnist as m
+        from kubeflow_controller_tpu.parallel import (
+            AXIS_DATA,
+            MeshSpec,
+            build_mesh,
+        )
+        from kubeflow_controller_tpu.workloads import data as d
+        from kubeflow_controller_tpu.workloads.trainer import (
+            default_optimizer,
+            global_batches,
+            make_dist_step,
+            numpy_opt_state,
+            replicate_pytree,
+        )
+
+        mesh = build_mesh(MeshSpec(dp=-1, fsdp=1))
+        opt = default_optimizer(5e-3)
+        step = make_dist_step(lambda p, b: m.mlp_loss(p, b[0], b[1]), opt,
+                              mesh, AXIS_DATA, donate=False)
+        bs, spe = 16, 4
+        x, y = d.synthetic_mnist_np(1, 64)
+        idx = (np.arange(spe)[:, None] * bs
+               + np.arange(bs)[None, :]) % x.shape[0]
+        x_all, y_all = global_batches(
+            mesh, AXIS_DATA, (x[idx], y[idx].astype(np.int32)), bs)
+
+        def fresh_state():
+            params = replicate_pytree(mesh, m.mlp_init(0))
+            opt_state = replicate_pytree(
+                mesh, numpy_opt_state(opt, m.mlp_init(0)))
+            return params, opt_state
+
+        return step, x_all, y_all, fresh_state, jax
+
+    def test_kill_resume_matches_uninterrupted(self, tmp_path):
+        import numpy as np
+
+        from kubeflow_controller_tpu.workloads.checkpoint import (
+            CheckpointManager,
+        )
+        from kubeflow_controller_tpu.workloads.trainer import (
+            train_step_loop_dist,
+        )
+
+        step, x_all, y_all, fresh_state, jax = self._setup()
+        steps, every, kill_at = 12, 5, 7
+
+        # Uninterrupted run.
+        p0, s0 = fresh_state()
+        pa, _, _ = train_step_loop_dist(step, p0, s0, x_all, y_all, steps)
+
+        # Interrupted run: train to the kill point with periodic saves...
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        p0, s0 = fresh_state()
+        train_step_loop_dist(
+            step, p0, s0, x_all, y_all, kill_at,
+            checkpoint_every=every,
+            checkpoint_fn=lambda s, p, o: mgr.save(s, p, o, wait=False))
+        mgr.wait()
+        # ...the process dies at step 7; the replacement restores the
+        # latest checkpoint (step 5: lost steps <= the interval)...
+        p1, s1 = fresh_state()
+        p1, s1, start = mgr.restore(p1, s1)
+        assert start == 5
+        assert kill_at - start <= every  # lost work bounded by the interval
+        # ...and resumes to completion: bitwise-identical final params.
+        pb, _, _ = train_step_loop_dist(step, p1, s1, x_all, y_all, steps,
+                                        start_step=start)
+        for a, b in zip(jax.tree_util.tree_leaves(pa),
+                        jax.tree_util.tree_leaves(pb)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_corrupt_latest_falls_back_to_previous_step(self, tmp_path):
+        from kubeflow_controller_tpu.workloads.checkpoint import (
+            CheckpointManager,
+        )
+        from kubeflow_controller_tpu.workloads.trainer import (
+            train_step_loop_dist,
+        )
+
+        step, x_all, y_all, fresh_state, jax = self._setup()
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        p0, s0 = fresh_state()
+        train_step_loop_dist(
+            step, p0, s0, x_all, y_all, 11, checkpoint_every=5,
+            checkpoint_fn=lambda s, p, o: mgr.save(s, p, o, wait=True))
+        assert mgr.latest_step() == 10
+        # Corrupt every file of the latest step (a SIGKILL-torn write).
+        root = tmp_path / "ckpt" / "10"
+        for dirpath, _, files in os.walk(root):
+            for fn in files:
+                with open(os.path.join(dirpath, fn), "wb") as fh:
+                    fh.write(b"corrupt")
+        p1, s1 = fresh_state()
+        mgr2 = CheckpointManager(str(tmp_path / "ckpt"))
+        p1, s1, start = mgr2.restore(p1, s1)
+        assert start == 5          # fell back one interval
+        assert not root.exists()   # the bad step was deleted, not retried
+
+    def test_restore_raises_when_nothing_readable(self, tmp_path):
+        from kubeflow_controller_tpu.workloads.checkpoint import (
+            CheckpointManager,
+        )
+
+        step, x_all, y_all, fresh_state, jax = self._setup()
+        mgr = CheckpointManager(str(tmp_path / "empty"))
+        p, s = fresh_state()
+        with pytest.raises(FileNotFoundError):
+            mgr.restore(p, s)
+
+
+# ---------------------------------------------------------------------------
+# Stall detector: restore-phase hold
+# ---------------------------------------------------------------------------
+
+class TestRestoreHold:
+    def _beat(self, step, t, phase="fit"):
+        return PodProgress(step=step, phase=phase, timestamp=t)
+
+    def test_step_decrease_enters_hold_until_forward_progress(self):
+        tr = StallTracker(StallPolicy(heartbeat_deadline_s=0,
+                                      step_deadline_s=10.0))
+        k = "default/pod"
+        assert tr.observe(k, self._beat(50, 0.0), now=0.0) is False
+        # In-place restart: the counter jumps BACKWARD — not a stall.
+        assert tr.observe(k, self._beat(5, 1.0), now=1.0) is False
+        # Frozen at the restored step far past the deadline: still held
+        # (mirrors the compile-phase hold; restore/rewind is not a wedge).
+        assert tr.observe(k, self._beat(5, 30.0), now=30.0) is False
+        assert tr.observe(k, self._beat(5, 60.0), now=60.0) is False
+        # Forward progress releases the hold...
+        assert tr.observe(k, self._beat(6, 61.0), now=61.0) is False
+        # ...after which a genuine freeze past the deadline DOES fire.
+        assert tr.observe(k, self._beat(6, 80.0), now=80.0) is True
+
+    def test_restore_phase_holds_like_compile(self):
+        tr = StallTracker(StallPolicy(heartbeat_deadline_s=0,
+                                      step_deadline_s=10.0))
+        k = "default/pod"
+        assert tr.observe(k, self._beat(0, 0.0, "restore"), now=0.0) is False
+        assert tr.observe(k, self._beat(0, 50.0, "restore"), now=50.0) is False
+        # Training resumes, then freezes: the deadline applies again.
+        assert tr.observe(k, self._beat(1, 51.0), now=51.0) is False
+        assert tr.observe(k, self._beat(1, 70.0), now=70.0) is True
+
+    def test_heartbeat_deadline_still_applies_during_restore(self):
+        tr = StallTracker(StallPolicy(heartbeat_deadline_s=5.0,
+                                      step_deadline_s=10.0))
+        k = "default/pod"
+        assert tr.observe(k, self._beat(0, 0.0, "restore"), now=0.0) is False
+        # Beats STOPPED (stale timestamp): a dead restore is a stall.
+        assert tr.observe(k, self._beat(0, 0.0, "restore"), now=30.0) is True
+
+
+# ---------------------------------------------------------------------------
+# Event recorder dedup_key
+# ---------------------------------------------------------------------------
+
+class TestEventDedup:
+    def test_dedup_key_collapses_changing_messages(self):
+        from kubeflow_controller_tpu.controller.events import EventRecorder
+
+        rec = EventRecorder()
+        job = mk_job("j1")
+        rec.event(job, "Normal", "ReplicaRestarted",
+                  "replica Worker-1 restart #1", dedup_key="Worker-1")
+        rec.event(job, "Normal", "ReplicaRestarted",
+                  "replica Worker-1 restart #2 after 0.25s backoff",
+                  dedup_key="Worker-1")
+        # A different replica is a different aggregate.
+        rec.event(job, "Normal", "ReplicaRestarted",
+                  "replica Worker-2 restart #1", dedup_key="Worker-2")
+        evs = [e for e in rec.events_for("default", "j1")
+               if e.reason == "ReplicaRestarted"]
+        assert len(evs) == 2
+        w1 = next(e for e in evs if e.dedup_key == "Worker-1")
+        assert w1.count == 2
+        assert "restart #2" in w1.message  # newest wording wins
+
+    def test_without_dedup_key_distinct_messages_stay_distinct(self):
+        from kubeflow_controller_tpu.controller.events import EventRecorder
+
+        rec = EventRecorder()
+        job = mk_job("j2")
+        rec.event(job, "Normal", "X", "m1")
+        rec.event(job, "Normal", "X", "m2")
+        assert len(rec.events_for("default", "j2")) == 2
+
+
+# ---------------------------------------------------------------------------
+# Planner gating under decisions
+# ---------------------------------------------------------------------------
+
+class TestPlannerGate:
+    def test_backoff_blocks_replacement_this_sync(self):
+        from kubeflow_controller_tpu.planner import plan_job
+        from kubeflow_controller_tpu.planner.types import Action
+        from kubeflow_controller_tpu.recovery.policy import (
+            RecoveryAssessment,
+            RestartDecision,
+        )
+
+        job = mk_job(n=2)
+        job.spec.runtime_id = "rid01"
+        pods = {ReplicaType.WORKER: [mk_pod("a", index=0),
+                                     mk_pod("w1", index=1,
+                                            phase=PHASE_RUNNING)]}
+        waiting = RecoveryAssessment(decisions={
+            (ReplicaType.WORKER, 0): RestartDecision(ACTION_BACKOFF,
+                                                     remaining_s=1.0)})
+        plan = plan_job(job, pods, {}, waiting)
+        assert [e for e in plan.events
+                if e.action in (Action.ADD_POD, Action.DELETE_POD)] == []
+        # Once the window closes the same plan replaces index-preserved.
+        ready = RecoveryAssessment(decisions={
+            (ReplicaType.WORKER, 0): RestartDecision(ACTION_REPLACE)})
+        plan = plan_job(job, pods, {}, ready)
+        acts = [(e.action, e.index) for e in plan.events
+                if e.action in (Action.ADD_POD, Action.DELETE_POD)]
+        assert (Action.DELETE_POD, 0) in acts and (Action.ADD_POD, 0) in acts
+        assert (Action.ADD_POD, 1) not in acts
+
+    def test_gang_waits_out_worst_member_and_exhausts_as_a_unit(self):
+        from kubeflow_controller_tpu.planner import plan_job
+        from kubeflow_controller_tpu.planner.types import Action
+        from kubeflow_controller_tpu.recovery.policy import (
+            RecoveryAssessment,
+            RestartDecision,
+        )
+
+        job = mk_job(n=2, gang=True)
+        job.spec.runtime_id = "rid02"
+        pods = {ReplicaType.WORKER: [mk_pod("a", index=0),
+                                     mk_pod("w1", index=1,
+                                            phase=PHASE_RUNNING)]}
+        waiting = RecoveryAssessment(decisions={
+            (ReplicaType.WORKER, 0): RestartDecision(ACTION_BACKOFF,
+                                                     remaining_s=1.0)})
+        plan = plan_job(job, pods, {}, waiting)
+        assert [e for e in plan.events
+                if e.action in (Action.ADD_POD, Action.DELETE_POD)] == []
+        spent = RecoveryAssessment(decisions={
+            (ReplicaType.WORKER, 0): RestartDecision(ACTION_EXHAUSTED)})
+        plan = plan_job(job, pods, {}, spent)
+        assert [e for e in plan.events
+                if e.action in (Action.ADD_POD, Action.DELETE_POD)] == []
+        ready = RecoveryAssessment(decisions={
+            (ReplicaType.WORKER, 0): RestartDecision(ACTION_REPLACE)})
+        plan = plan_job(job, pods, {}, ready)
+        dels = [e for e in plan.events if e.action == Action.DELETE_POD]
+        adds = [e for e in plan.events if e.action == Action.ADD_POD]
+        # Whole gang: the survivor is torn down too, both indices recreated.
+        assert {e.name for e in dels} == {"a", "w1"}
+        assert {e.index for e in adds} == {0, 1}
+
+
+# ---------------------------------------------------------------------------
+# Gang guard (rendezvous module)
+# ---------------------------------------------------------------------------
+
+class TestGangGuard:
+    def test_peer_death_detected_clean_done_is_not(self, tmp_path):
+        from kubeflow_controller_tpu.recovery import GangGuard
+
+        broken = []
+        g0 = GangGuard(str(tmp_path), "gang", member=0, peers=2,
+                       interval_s=0.05, timeout_s=0.6,
+                       on_broken=broken.append)
+        g1 = GangGuard(str(tmp_path), "gang", member=1, peers=2,
+                       interval_s=0.05, timeout_s=0.6,
+                       on_broken=lambda m: None)
+        g0.start(), g1.start()
+        try:
+            time.sleep(0.4)
+            assert broken == []  # both beating: healthy
+            # Member 1 finishes CLEANLY: silence after a done marker must
+            # not read as death.
+            g1.mark_done()
+            time.sleep(0.9)
+            assert broken == []
+            # A new gang where the peer dies WITHOUT the marker: detected.
+            broken2 = []
+            h0 = GangGuard(str(tmp_path), "gang2", member=0, peers=2,
+                           interval_s=0.05, timeout_s=0.3,
+                           on_broken=broken2.append)
+            h1 = GangGuard(str(tmp_path), "gang2", member=1, peers=2,
+                           interval_s=0.05, timeout_s=0.3,
+                           on_broken=lambda m: None)
+            h0.start(), h1.start()
+            time.sleep(0.2)
+            h1.stop()  # heartbeat stops, no done marker — "SIGKILL"
+            wait_for(lambda: broken2 == [1], timeout=5.0)
+            h0.stop()
+        finally:
+            g0.stop(), g1.stop()
+
+    def test_generation_scopes_the_files(self, tmp_path):
+        from kubeflow_controller_tpu.recovery import GangGuard
+
+        a = GangGuard(str(tmp_path), "g", member=0, peers=2, generation=0)
+        b = GangGuard(str(tmp_path), "g", member=0, peers=2, generation=1)
+        assert a.alive_file(0) != b.alive_file(0)
+        assert "g1" in os.path.basename(b.alive_file(0))
