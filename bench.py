@@ -360,13 +360,16 @@ def run_store_stress(sharded: bool, duration_s: float = 2.0,
             ops[slot] += 1
             i += 1
 
-    threads = [threading.Thread(target=drainer, args=(w,), daemon=True)
-               for w in watchers]
+    threads = [threading.Thread(target=drainer, args=(w,), daemon=True,
+                                name=f"bench-drainer-{i}")
+               for i, w in enumerate(watchers)]
     for j, kind in enumerate(kinds):
         threads.append(threading.Thread(
-            target=writer, args=(kind, 2 * j), daemon=True))
+            target=writer, args=(kind, 2 * j), daemon=True,
+            name=f"bench-writer-{kind}"))
         threads.append(threading.Thread(
-            target=reader, args=(kind, 2 * j + 1), daemon=True))
+            target=reader, args=(kind, 2 * j + 1), daemon=True,
+            name=f"bench-reader-{kind}"))
     t0 = time.time()
     for t in threads:
         t.start()

@@ -1,0 +1,18 @@
+"""Concurrency + controller-invariant analysis plane.
+
+Three layers, all stdlib-only:
+
+- :mod:`.vet` — ``kctpu vet``: AST linter enforcing the project's codified
+  invariants (no blocking calls under a lock, no ``copy.deepcopy`` on hot
+  paths, no snapshot mutation, ``spec.template`` deep-copied before
+  mutation, threads named+daemonized, metric catalogue in sync, event
+  reason hygiene).
+- :mod:`.lockcheck` — runtime lock-order detector over the
+  ``utils.locks`` facade: per-thread held stacks, a global
+  acquisition-order graph with cycle reporting, and held-across-blocking-
+  call detection (``KCTPU_LOCKCHECK=1``).
+- :mod:`.interleave` — schedule-fuzz race harness: seeded pre-acquire
+  yield injection + switch-interval shrinking driving adversarial
+  interleavings through the store/workqueue/scheduler invariants
+  (``make race-smoke``).
+"""

@@ -1,0 +1,359 @@
+"""Runtime lock-order detector over the named-lock facade.
+
+Enabled with ``KCTPU_LOCKCHECK=1`` (any entrypoint: pytest, bench, the
+smokes — ``utils.locks`` bootstraps on first lock creation) or
+programmatically via :func:`install`.  While installed it maintains:
+
+- a **per-thread held-lock stack** of facade locks;
+- a **global acquisition-order graph**: acquiring lock B while holding
+  lock A records the edge A→B (keyed by lock *name*, so every store shard
+  of a kind, every workqueue instance of a name collapse onto one node).
+  Same-name edges and reentrant re-acquisitions are skipped.  A cycle in
+  the graph is a potential deadlock: two threads can interleave the two
+  orders and park forever;
+- **held-across-blocking-call violations**: ``time.sleep``, blocking
+  ``queue.Queue.get``/bounded ``put``, socket connect/accept/recv/send/
+  bind, ``subprocess.Popen``/``wait`` are patched to check the caller's
+  held stack.  A lock declared ``allow_blocking=True`` (an I/O-serializing
+  lock, e.g. the warm pool's zygote-stdin pipe lock) suppresses the check
+  for calls made under it alone.
+
+At test exit (tests/conftest.py's session fixture) or via
+:meth:`LockChecker.report`, cycles and violations are rendered with the
+file:line of the first acquisition/blocking call that recorded them.
+Overhead is measured in docs/PERF.md ("Analysis-plane overhead").
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import subprocess
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..utils import locks
+
+_orig_sleep = locks._orig_sleep
+
+
+def _site(skip_prefixes: Tuple[str, ...] = ()) -> str:
+    """file:line of the innermost non-analysis frame of the caller."""
+    for fr in reversed(traceback.extract_stack(limit=16)):
+        fn = fr.filename.replace("\\", "/")
+        if "/analysis/lockcheck" in fn or "/utils/locks" in fn:
+            continue
+        if fn.endswith("/threading.py") or fn.endswith("/queue.py"):
+            continue
+        if any(fn.endswith(p) for p in skip_prefixes):
+            continue
+        return f"{fr.filename}:{fr.lineno}"
+    return "<unknown>"
+
+
+@dataclass
+class BlockingViolation:
+    what: str  # e.g. "time.sleep", "socket.connect"
+    held: Tuple[str, ...]  # names of facade locks held at the call
+    site: str  # file:line of the blocking call
+    count: int = 1
+
+
+@dataclass
+class Report:
+    cycles: List[List[str]] = field(default_factory=list)
+    blocking: List[BlockingViolation] = field(default_factory=list)
+    edges: Dict[Tuple[str, str], str] = field(default_factory=dict)
+    acquires: int = 0
+
+    @property
+    def clean(self) -> bool:
+        return not self.cycles and not self.blocking
+
+    def render(self) -> str:
+        lines = [f"lockcheck: {self.acquires} acquisitions, "
+                 f"{len(self.edges)} distinct order edges"]
+        for cyc in self.cycles:
+            lines.append("LOCK-ORDER CYCLE: " + " -> ".join(cyc + cyc[:1]))
+            for a, b in zip(cyc, cyc[1:] + cyc[:1]):
+                site = self.edges.get((a, b), "<unknown>")
+                lines.append(f"  {a} -> {b} first recorded at {site}")
+        for v in self.blocking:
+            lines.append(
+                f"BLOCKING CALL UNDER LOCK: {v.what} at {v.site} "
+                f"while holding {list(v.held)} (x{v.count})")
+        if self.clean:
+            lines.append("lockcheck: clean (no cycles, no blocking calls "
+                         "under locks)")
+        return "\n".join(lines)
+
+
+class LockChecker:
+    """The live detector: fed by the facade's acquire/release hooks and the
+    patched blocking primitives."""
+
+    def __init__(self):
+        self._local = threading.local()
+        # Raw lock, deliberately NOT a facade lock: the checker must never
+        # feed itself.
+        self._mu = threading.Lock()
+        # (held-name, acquired-name) -> first-seen site.
+        self._edges: Dict[Tuple[str, str], str] = {}
+        # (what, site, held-names) -> violation, deduplicated.
+        self._violations: Dict[Tuple[str, str, Tuple[str, ...]], BlockingViolation] = {}
+        self._acquires = 0
+
+    # -- facade hooks --------------------------------------------------------
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def acquired(self, lock, reentered: bool) -> None:
+        if reentered:
+            return
+        me = threading.get_ident()
+        st = self._stack()
+        if st:
+            new_edges = []
+            for held in st:
+                # _owner guards against a stale stack entry left by a
+                # cross-thread release (thread A acquires, thread B frees).
+                if (held._owner == me and held.name != lock.name
+                        and (held.name, lock.name) not in self._edges):
+                    new_edges.append((held.name, lock.name))
+            if new_edges:
+                site = _site()
+                with self._mu:
+                    for e in new_edges:
+                        self._edges.setdefault(e, site)
+        st.append(lock)
+        self._acquires += 1  # benign race: diagnostic counter only
+
+    def released(self, lock) -> None:
+        st = self._stack()
+        # Usually LIFO; tolerate out-of-order and cross-thread releases.
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] is lock:
+                del st[i]
+                return
+
+    def held(self) -> Tuple[str, ...]:
+        return tuple(l.name for l in self._stack())
+
+    # -- blocking-call hook --------------------------------------------------
+
+    def blocking_call(self, what: str) -> None:
+        st = self._stack()
+        if not st:
+            return
+        if locks.blocking_allowed():
+            return  # caller declared the stall deliberate (locks.blocking_ok)
+        me = threading.get_ident()
+        strict = [l for l in st if not l.allow_blocking and l._owner == me]
+        if not strict:
+            return
+        held = tuple(l.name for l in strict)
+        site = _site()
+        key = (what, site, held)
+        with self._mu:
+            v = self._violations.get(key)
+            if v is not None:
+                v.count += 1
+            else:
+                self._violations[key] = BlockingViolation(what, held, site)
+
+    # -- reporting -----------------------------------------------------------
+
+    def report(self) -> Report:
+        with self._mu:
+            edges = dict(self._edges)
+            violations = [BlockingViolation(v.what, v.held, v.site, v.count)
+                          for v in self._violations.values()]
+        return Report(cycles=find_cycles({a: {b for (x, b) in edges if x == a}
+                                          for (a, _) in edges}),
+                      blocking=violations, edges=edges,
+                      acquires=self._acquires)
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+            self._violations.clear()
+            self._acquires = 0
+
+
+def find_cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Elementary cycles in a small digraph (iterative Tarjan SCCs; each
+    non-trivial SCC is reported once as a representative cycle path)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    nodes = set(graph)
+    for tos in graph.values():
+        nodes |= tos
+
+    def strongconnect(root: str) -> None:
+        work = [(root, iter(sorted(graph.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                if len(scc) > 1:
+                    sccs.append(list(reversed(scc)))
+                elif v in graph.get(v, ()):  # self-loop (same-name nesting)
+                    sccs.append([v])
+
+    for n in sorted(nodes):
+        if n not in index:
+            strongconnect(n)
+    return sccs
+
+
+# -- blocking-primitive patching ---------------------------------------------
+
+_PATCHES: List[Tuple[object, str, object]] = []
+_CHECKER: Optional[LockChecker] = None
+
+
+def _patch(owner, attr: str, wrapper) -> None:
+    _PATCHES.append((owner, attr, getattr(owner, attr)))
+    setattr(owner, attr, wrapper)
+
+
+def _notify(what: str) -> None:
+    """Route a blocking-primitive call to the LIVE checker (consulted per
+    call, not captured at patch time, so tests can swap in a standalone
+    checker without re-patching or polluting a session-wide one)."""
+    c = locks.get_checker()
+    if c is not None:
+        c.blocking_call(what)
+
+
+def _install_patches() -> None:
+    orig_sleep = _orig_sleep
+
+    def sleep(seconds):
+        _notify("time.sleep")
+        return orig_sleep(seconds)
+
+    _patch(locks._time, "sleep", sleep)
+
+    orig_get = queue.Queue.get
+
+    def q_get(self, block=True, timeout=None):
+        if block:
+            _notify("queue.Queue.get")
+        return orig_get(self, block, timeout)
+
+    _patch(queue.Queue, "get", q_get)
+
+    orig_put = queue.Queue.put
+
+    def q_put(self, item, block=True, timeout=None):
+        if block and self.maxsize > 0:
+            _notify("queue.Queue.put")
+        return orig_put(self, item, block, timeout)
+
+    _patch(queue.Queue, "put", q_put)
+
+    for meth in ("connect", "accept", "recv", "recv_into", "sendall", "bind"):
+        orig = getattr(socket.socket, meth)
+
+        def sock_op(self, *a, _orig=orig, _what=f"socket.{meth}", **kw):
+            _notify(_what)
+            return _orig(self, *a, **kw)
+
+        _patch(socket.socket, meth, sock_op)
+
+    orig_create = socket.create_connection
+
+    def create_connection(*a, **kw):
+        _notify("socket.create_connection")
+        return orig_create(*a, **kw)
+
+    _patch(socket, "create_connection", create_connection)
+
+    orig_popen_init = subprocess.Popen.__init__
+
+    def popen_init(self, *a, **kw):
+        _notify("subprocess.Popen")
+        return orig_popen_init(self, *a, **kw)
+
+    _patch(subprocess.Popen, "__init__", popen_init)
+
+    orig_wait = subprocess.Popen.wait
+
+    def popen_wait(self, timeout=None):
+        _notify("subprocess.Popen.wait")
+        return orig_wait(self, timeout)
+
+    _patch(subprocess.Popen, "wait", popen_wait)
+
+
+def _remove_patches() -> None:
+    while _PATCHES:
+        owner, attr, orig = _PATCHES.pop()
+        setattr(owner, attr, orig)
+
+
+# -- public API --------------------------------------------------------------
+
+def install() -> LockChecker:
+    """Install (idempotent) and return the process-wide checker."""
+    global _CHECKER
+    if _CHECKER is not None:
+        return _CHECKER
+    checker = LockChecker()
+    _install_patches()
+    locks.set_checker(checker)
+    _CHECKER = checker
+    return checker
+
+
+def installed() -> Optional[LockChecker]:
+    return _CHECKER
+
+
+def uninstall() -> None:
+    global _CHECKER
+    if _CHECKER is None:
+        return
+    locks.set_checker(None)
+    _remove_patches()
+    _CHECKER = None

@@ -1,0 +1,706 @@
+"""``kctpu vet``: AST-walking project linter for codified invariants.
+
+The reference gated CI on gometalinter/go-vet (config.json:4-16,
+.travis.yml:1-14); this is the analog grown past style into the
+concurrency/controller invariants that actually bite this codebase
+(docs/ANALYSIS.md has the catalogue with rationale):
+
+- ``lock-blocking-call``  — no blocking call (``time.sleep``, REST/socket
+  I/O, ``queue.get``, ``subprocess``) inside a ``with <lock>`` body;
+- ``hot-path-deepcopy``   — no ``copy.deepcopy`` outside ``utils/serde.py``
+  (use ``serde.deep_copy``);
+- ``snapshot-mutation``   — objects returned by ``get_snapshot`` /
+  ``list_snapshot*`` are immutable shared references: never mutated;
+- ``template-copy``       — ``spec.template`` is shared by every replica:
+  deep-copy before mutation (the reference's own shared-template bug,
+  design_doc.md:262-268);
+- ``thread-hygiene``      — every ``threading.Thread`` carries ``name=``
+  and ``daemon=True``;
+- ``metric-prefix`` / ``metric-catalogue`` — registered metric names carry
+  the ``kctpu_`` prefix and stay in sync with docs/OBSERVABILITY.md;
+- ``event-reason-style``  — event reasons are CamelCase literals (dynamic
+  reasons defeat the recorder's dedup keys).
+
+Zero third-party dependencies: stdlib ``ast`` only.  Suppress a finding
+with an inline ``# kctpu: vet-ok(<rule>)`` marker on the offending line
+(or the ``with`` header line for lock-body findings).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_SUPPRESS_RE = re.compile(r"#\s*kctpu:\s*vet-ok\(([a-z0-9_,\- ]+)\)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+class FileContext:
+    """One parsed file + its suppression markers and import aliases."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        # line -> set of rule names suppressed on that line ("*" = all).
+        self.suppressions: Dict[int, Set[str]] = {}
+        for i, line in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                self.suppressions[i] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()}
+        # bare names imported from blocking-relevant modules:
+        # name -> "module.orig" (e.g. sleep -> time.sleep).
+        self.bare_imports: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom) and node.module in (
+                    "time", "subprocess", "socket", "urllib.request"):
+                for alias in node.names:
+                    self.bare_imports[alias.asname or alias.name] = (
+                        f"{node.module}.{alias.name}")
+
+    def suppressed(self, rule: str, *lines: int) -> bool:
+        for ln in lines:
+            marks = self.suppressions.get(ln)
+            if marks and (rule in marks or "*" in marks):
+                return True
+        return False
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+def _tail_name(node: ast.AST) -> str:
+    """The final identifier of a Name/Attribute/Subscript/Call chain
+    ('self._svc_lock' -> '_svc_lock'; 'sh.lock' -> 'lock')."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Subscript):
+        return _tail_name(node.value)
+    if isinstance(node, ast.Call):
+        return _tail_name(node.func)
+    return ""
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """The root Name of an attribute/subscript chain ('obj.a.b[0].c' ->
+    'obj'), or None for non-chains."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _chain_attrs(node: ast.AST) -> List[str]:
+    """Attribute names along a chain, outermost last."""
+    attrs: List[str] = []
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Attribute):
+            attrs.append(node.attr)
+        node = node.value
+    return list(reversed(attrs))
+
+
+def _body_stmts_skipping_defs(body: Iterable[ast.stmt]) -> Iterable[ast.AST]:
+    """Every node under ``body`` except subtrees of nested function /
+    lambda definitions (deferred execution: not run under the lock)."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+_MUTATOR_METHODS = frozenset({
+    "append", "extend", "insert", "remove", "pop", "clear", "update",
+    "setdefault", "sort", "add", "discard", "popitem", "reverse",
+})
+
+_DEEPCOPY_NAMES = frozenset({"deep_copy", "slow_deep_copy", "deepcopy", "copy"})
+
+
+def _value_calls_deepcopy(value: ast.AST) -> bool:
+    for node in ast.walk(value):
+        if isinstance(node, ast.Call) and _tail_name(node.func) in _DEEPCOPY_NAMES:
+            return True
+    return False
+
+
+class _TaintTracker:
+    """Flow-sensitive (linear, branch-merged) taint walk over a function
+    body: ``source_fn`` decides whether an Assign value taints its target;
+    mutations of tainted chains are reported via ``on_mutation``."""
+
+    def __init__(self, source_fn, on_mutation):
+        self.source = source_fn
+        self.on_mutation = on_mutation
+        self.tainted: Set[str] = set()
+
+    def run(self, body: Sequence[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested scope: separate analysis
+        if isinstance(stmt, ast.Assign):
+            self._check_targets_mutation(stmt.targets, stmt)
+            self._apply_assign(stmt.targets, stmt.value)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._check_targets_mutation([stmt.target], stmt)
+            self._apply_assign([stmt.target], stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            self._check_targets_mutation([stmt.target], stmt)
+        elif isinstance(stmt, ast.For):
+            self._apply_iter_taint(stmt.target, stmt.iter)
+            self.run(stmt.body)
+            self.run(stmt.orelse)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self.run(stmt.body)
+            self.run(stmt.orelse)
+        elif isinstance(stmt, ast.With):
+            self.run(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self.run(stmt.body)
+            for h in stmt.handlers:
+                self.run(h.body)
+            self.run(stmt.orelse)
+            self.run(stmt.finalbody)
+        elif isinstance(stmt, ast.Expr):
+            self._check_call_mutation(stmt.value)
+
+    # taint sources / propagation
+
+    def _apply_assign(self, targets, value) -> None:
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        # tuple unpack: `objs, rv = list_snapshot_with_rv(...)` taints the
+        # first element (the object list).
+        for t in targets:
+            if isinstance(t, ast.Tuple) and t.elts and isinstance(t.elts[0], ast.Name):
+                if self.source(value, unpacked=True):
+                    self.tainted.add(t.elts[0].id)
+        if not names:
+            return
+        if self.source(value, unpacked=False):
+            self.tainted.update(names)
+        elif _value_calls_deepcopy(value):
+            self.tainted.difference_update(names)
+        else:
+            root = _root_name(value)
+            if root in self.tainted:
+                self.tainted.update(names)  # alias / element propagation
+            else:
+                self.tainted.difference_update(names)  # rebound clean
+
+    def _apply_iter_taint(self, target, it) -> None:
+        root = _root_name(it)
+        src = self.source(it, unpacked=False)
+        if root in self.tainted or src:
+            if isinstance(target, ast.Name):
+                self.tainted.add(target.id)
+            elif isinstance(target, ast.Tuple):
+                for el in target.elts:
+                    if isinstance(el, ast.Name):
+                        self.tainted.add(el.id)
+
+    # mutation sinks
+
+    def _check_targets_mutation(self, targets, stmt) -> None:
+        for t in targets:
+            if isinstance(t, (ast.Attribute, ast.Subscript)):
+                root = _root_name(t)
+                if root in self.tainted:
+                    self.on_mutation(stmt, root)
+
+    def _check_call_mutation(self, expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MUTATOR_METHODS):
+                root = _root_name(node.func.value)
+                if root in self.tainted:
+                    self.on_mutation(node, root)
+
+
+# -- rules -------------------------------------------------------------------
+
+class Rule:
+    name = ""
+    doc = ""
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def finish(self, root: str) -> Iterable[Finding]:
+        return ()
+
+
+_LOCKISH_RE = re.compile(r"(^|_)(lock|mutex|cond|guard)s?($|_)|lock$|cond$",
+                         re.IGNORECASE)
+
+
+class LockBlockingCallRule(Rule):
+    name = "lock-blocking-call"
+    doc = ("no blocking call (time.sleep, REST/socket I/O, queue.get, "
+           "subprocess) inside a `with <lock>` body")
+
+    def _blocking(self, ctx: FileContext, call: ast.Call) -> Optional[str]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            orig = ctx.bare_imports.get(fn.id, "")
+            if orig in ("time.sleep", "socket.create_connection",
+                        "urllib.request.urlopen"):
+                return orig
+            if orig.startswith("subprocess."):
+                return orig
+            return None
+        if not isinstance(fn, ast.Attribute):
+            return None
+        attr = fn.attr
+        base = fn.value
+        base_tail = _tail_name(base)
+        if attr == "sleep" and isinstance(base, ast.Name) and base.id == "time":
+            return "time.sleep"
+        if isinstance(base, ast.Name) and base.id == "subprocess":
+            return f"subprocess.{attr}"
+        if isinstance(base, ast.Name) and base.id == "socket" and attr in (
+                "socket", "create_connection"):
+            return f"socket.{attr}"
+        if attr in ("connect", "accept", "recv", "recv_into", "sendall", "bind"):
+            return f"socket .{attr}()"
+        if attr == "get" and re.search(r"queue|(^|_)q($|_)", base_tail, re.I):
+            return f"queue .get() on {base_tail}"
+        if attr == "getresponse" or (attr == "request" and "conn" in base_tail):
+            return f"HTTP .{attr}()"
+        if attr == "urlopen":
+            return "urllib urlopen"
+        return None
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.With):
+                continue
+            lockish = [item for item in node.items
+                       if _LOCKISH_RE.search(_tail_name(item.context_expr))]
+            if not lockish:
+                continue
+            lock_desc = _tail_name(lockish[0].context_expr)
+            for sub in _body_stmts_skipping_defs(node.body):
+                if not isinstance(sub, ast.Call):
+                    continue
+                what = self._blocking(ctx, sub)
+                if what is None:
+                    continue
+                if ctx.suppressed(self.name, sub.lineno, node.lineno):
+                    continue
+                yield Finding(
+                    ctx.path, sub.lineno, sub.col_offset, self.name,
+                    f"blocking call {what} inside `with {lock_desc}` "
+                    f"(lock held across I/O/sleep; move it outside the "
+                    f"critical section)")
+
+
+class HotPathDeepcopyRule(Rule):
+    name = "hot-path-deepcopy"
+    doc = "no copy.deepcopy outside utils/serde.py; use serde.deep_copy"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.path.replace(os.sep, "/").endswith("utils/serde.py"):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            is_deepcopy = (
+                (isinstance(fn, ast.Attribute) and fn.attr == "deepcopy"
+                 and isinstance(fn.value, ast.Name) and fn.value.id == "copy")
+                or (isinstance(fn, ast.Name)
+                    and ctx.bare_imports.get(fn.id) == "copy.deepcopy"))
+            if not is_deepcopy:
+                continue
+            if ctx.suppressed(self.name, node.lineno):
+                continue
+            yield Finding(
+                ctx.path, node.lineno, node.col_offset, self.name,
+                "copy.deepcopy on a controller/store path: use "
+                "serde.deep_copy (5-8x less CPU on this object model)")
+
+
+class SnapshotMutationRule(Rule):
+    name = "snapshot-mutation"
+    doc = ("objects returned by get_snapshot/list_snapshot* are shared "
+           "immutable references; mutate a deep copy instead")
+
+    _SOURCES = ("get_snapshot",)
+    _UNPACK_SOURCES_PREFIX = "list_snapshot"
+
+    def _is_source(self, value: ast.AST, unpacked: bool) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        tail = _tail_name(value.func)
+        if unpacked:
+            return tail.startswith(self._UNPACK_SOURCES_PREFIX)
+        return tail in self._SOURCES or tail.startswith(
+            self._UNPACK_SOURCES_PREFIX)
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+
+            def report(stmt, root, _fn=node):
+                if not ctx.suppressed(self.name, stmt.lineno):
+                    findings.append(Finding(
+                        ctx.path, stmt.lineno, stmt.col_offset, self.name,
+                        f"mutation of {root!r}, a shared store snapshot "
+                        f"(returned by get_snapshot/list_snapshot*): "
+                        f"serde.deep_copy it first"))
+
+            _TaintTracker(self._is_source, report).run(node.body)
+        return findings
+
+
+class TemplateCopyRule(Rule):
+    name = "template-copy"
+    doc = ("spec.template is shared by every replica the planner stamps: "
+           "deep-copy before mutating (the reference's shared-template bug)")
+
+    @staticmethod
+    def _is_template_read(value: ast.AST, unpacked: bool) -> bool:
+        return (isinstance(value, ast.Attribute)
+                and value.attr == "template")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                def report(stmt, root, _fn=node):
+                    if not ctx.suppressed(self.name, stmt.lineno):
+                        findings.append(Finding(
+                            ctx.path, stmt.lineno, stmt.col_offset, self.name,
+                            f"mutation of {root!r}, bound from spec.template "
+                            f"without a deep copy: every replica shares this "
+                            f"object (use serde.deep_copy)"))
+
+                _TaintTracker(self._is_template_read, report).run(node.body)
+        # Direct writes THROUGH a .template. chain anywhere, e.g.
+        # `spec.template.spec.containers[0].args += [...]`.
+        for node in ast.walk(ctx.tree):
+            target = None
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        target = t
+            elif isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, (ast.Attribute, ast.Subscript)):
+                target = node.target
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _MUTATOR_METHODS):
+                chain = _chain_attrs(node.func.value)
+                if "template" in chain:
+                    if not ctx.suppressed(self.name, node.lineno):
+                        findings.append(Finding(
+                            ctx.path, node.lineno, node.col_offset, self.name,
+                            "in-place mutation through a .template chain: "
+                            "the template is shared by every replica"))
+                continue
+            if target is None:
+                continue
+            chain = _chain_attrs(target)
+            if "template" in chain[:-1]:
+                if not ctx.suppressed(self.name, node.lineno):
+                    findings.append(Finding(
+                        ctx.path, node.lineno, node.col_offset, self.name,
+                        "assignment through a .template chain: the template "
+                        "is shared by every replica (deep-copy first)"))
+        return findings
+
+
+class ThreadHygieneRule(Rule):
+    name = "thread-hygiene"
+    doc = "every threading.Thread carries name= and daemon=True"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            is_thread = (
+                (isinstance(fn, ast.Attribute) and fn.attr == "Thread"
+                 and isinstance(fn.value, ast.Name)
+                 and fn.value.id == "threading")
+                or (isinstance(fn, ast.Name) and fn.id == "Thread"))
+            if not is_thread:
+                continue
+            kwargs = {kw.arg for kw in node.keywords if kw.arg}
+            missing = []
+            if "name" not in kwargs:
+                missing.append("name=")
+            if "daemon" not in kwargs:
+                missing.append("daemon=True")
+            else:
+                d = next(kw.value for kw in node.keywords if kw.arg == "daemon")
+                if isinstance(d, ast.Constant) and d.value is False:
+                    missing.append("daemon=True (got False)")
+            if missing and not ctx.suppressed(self.name, node.lineno):
+                yield Finding(
+                    ctx.path, node.lineno, node.col_offset, self.name,
+                    f"threading.Thread without {' and '.join(missing)}: "
+                    f"unnamed threads are undebuggable, non-daemon threads "
+                    f"wedge interpreter shutdown")
+
+
+class MetricRules(Rule):
+    """Two findings families from one scan: ``metric-prefix`` (kctpu_
+    prefix on every registered metric) and ``metric-catalogue``
+    (registered names <-> docs/OBSERVABILITY.md stay in sync)."""
+
+    name = "metric-prefix"
+    catalogue_rule = "metric-catalogue"
+    doc = ("registered metric names carry the kctpu_ prefix and appear in "
+           "docs/OBSERVABILITY.md")
+
+    _REGISTER_METHODS = frozenset({"counter", "gauge", "histogram"})
+
+    def __init__(self):
+        self.registered: List[Tuple[str, str, int]] = []  # (name, path, line)
+        # Every kctpu_-shaped string literal in scanned code: collector-
+        # built families (e.g. ReconcileMetrics._families) name metrics in
+        # data tables rather than registration calls, and must still count
+        # as "registered" for the doc-side drift check.
+        self.literals: Set[str] = set()
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.path.replace(os.sep, "/").endswith("obs/metrics.py"):
+            return  # the registry itself (generic helpers, validation)
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                    and re.match(r"^kctpu_[a-z0-9_]+$", node.value)):
+                self.literals.add(node.value)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
+                continue
+            fn = node.func
+            is_register = (
+                (isinstance(fn, ast.Attribute)
+                 and fn.attr in self._REGISTER_METHODS)
+                or (isinstance(fn, ast.Name) and fn.id == "Family"))
+            if not is_register:
+                continue
+            mname = first.value
+            if not re.match(r"^[a-zA-Z_][a-zA-Z0-9_]*$", mname):
+                continue  # not a metric name (e.g. a gauge help string)
+            self.registered.append((mname, ctx.path, node.lineno))
+            if not mname.startswith("kctpu_") and not ctx.suppressed(
+                    self.name, node.lineno):
+                yield Finding(
+                    ctx.path, node.lineno, node.col_offset, self.name,
+                    f"metric {mname!r} lacks the kctpu_ namespace prefix")
+
+    def finish(self, root: str) -> Iterable[Finding]:
+        doc_path = os.path.join(root, "docs", "OBSERVABILITY.md")
+        try:
+            with open(doc_path) as fh:
+                doc = fh.read()
+        except OSError:
+            yield Finding(doc_path, 1, 0, self.catalogue_rule,
+                          "docs/OBSERVABILITY.md missing: the metric "
+                          "catalogue cannot be checked")
+            return
+        doc_tokens = set(re.findall(r"kctpu_[a-z0-9_]*[a-z0-9]", doc))
+        code_names = {n for (n, _, _) in self.registered
+                      if n.startswith("kctpu_")} | self.literals
+        for mname, path, line in self.registered:
+            if mname.startswith("kctpu_") and mname not in doc_tokens:
+                yield Finding(
+                    path, line, 0, self.catalogue_rule,
+                    f"metric {mname!r} is registered but missing from "
+                    f"docs/OBSERVABILITY.md (catalogue drift)")
+        doc_lines = doc.splitlines()
+        for token in sorted(doc_tokens - code_names):
+            if any(c.startswith(token) for c in code_names):
+                continue  # family-prefix mention (e.g. kctpu_job_)
+            line = next((i for i, l in enumerate(doc_lines, 1) if token in l), 1)
+            yield Finding(
+                os.path.join("docs", "OBSERVABILITY.md"), line, 0,
+                self.catalogue_rule,
+                f"metric {token!r} is documented but never registered "
+                f"(catalogue drift)")
+
+
+_CAMEL_RE = re.compile(r"^[A-Z][A-Za-z0-9]*$")
+
+
+class EventReasonRule(Rule):
+    name = "event-reason-style"
+    doc = ("event reasons are CamelCase string literals (or REASON_* "
+           "constants): dynamic/styled-off reasons defeat dedup keys and "
+           "kubectl-style filtering")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        # REASON_* constants must hold CamelCase literals.
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (isinstance(t, ast.Name) and t.id.startswith("REASON_")
+                            and isinstance(node.value, ast.Constant)
+                            and isinstance(node.value.value, str)
+                            and not _CAMEL_RE.match(node.value.value)
+                            and not ctx.suppressed(self.name, node.lineno)):
+                        yield Finding(
+                            ctx.path, node.lineno, node.col_offset, self.name,
+                            f"event reason {node.value.value!r} is not "
+                            f"CamelCase")
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute) and fn.attr == "event"
+                    and "recorder" in _tail_name(fn.value).lower()):
+                continue
+            if len(node.args) < 3:
+                continue
+            reason = node.args[2]
+            if isinstance(reason, ast.Constant) and isinstance(reason.value, str):
+                if (not _CAMEL_RE.match(reason.value)
+                        and not ctx.suppressed(self.name, node.lineno)):
+                    yield Finding(
+                        ctx.path, reason.lineno, reason.col_offset, self.name,
+                        f"event reason {reason.value!r} is not CamelCase")
+            elif isinstance(reason, ast.Name):
+                if (not reason.id.startswith("REASON_")
+                        and not reason.id.isupper()
+                        and not ctx.suppressed(self.name, node.lineno)):
+                    yield Finding(
+                        ctx.path, reason.lineno, reason.col_offset, self.name,
+                        f"event reason comes from non-constant {reason.id!r}: "
+                        f"use a REASON_* constant (bounded cardinality)")
+            elif not ctx.suppressed(self.name, node.lineno):
+                yield Finding(
+                    ctx.path, reason.lineno, reason.col_offset, self.name,
+                    "event reason is a dynamic expression: reasons must be "
+                    "CamelCase literals/constants so dedup keys stay stable")
+
+
+def all_rules() -> List[Rule]:
+    return [
+        LockBlockingCallRule(),
+        HotPathDeepcopyRule(),
+        SnapshotMutationRule(),
+        TemplateCopyRule(),
+        ThreadHygieneRule(),
+        MetricRules(),
+        EventReasonRule(),
+    ]
+
+
+# -- driver ------------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", "tests", "fixtures"}
+
+#: Default scan roots, relative to the repo root.
+DEFAULT_TARGETS = ("kubeflow_controller_tpu", "bench.py")
+
+
+def iter_py_files(targets: Sequence[str]) -> Iterable[str]:
+    for target in targets:
+        if os.path.isfile(target):
+            yield target
+            continue
+        for dirpath, dirnames, filenames in os.walk(target):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def run(targets: Sequence[str] = (), root: str = ".",
+        rules: Optional[List[Rule]] = None,
+        skip_catalogue: bool = False) -> List[Finding]:
+    """Vet ``targets`` (files or directories); returns sorted findings."""
+    targets = list(targets) or [os.path.join(root, t) for t in DEFAULT_TARGETS]
+    rules = rules if rules is not None else all_rules()
+    findings: List[Finding] = []
+    for path in iter_py_files(targets):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            ctx = FileContext(path, source)
+        except SyntaxError as e:
+            findings.append(Finding(path, e.lineno or 1, 0, "syntax",
+                                    f"does not parse: {e.msg}"))
+            continue
+        except OSError as e:
+            findings.append(Finding(path, 1, 0, "io", str(e)))
+            continue
+        for rule in rules:
+            findings.extend(rule.check_file(ctx))
+    if not skip_catalogue:
+        for rule in rules:
+            findings.extend(rule.finish(root))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="kctpu vet",
+        description="AST linter for the project's codified concurrency/"
+                    "controller invariants (docs/ANALYSIS.md)")
+    ap.add_argument("targets", nargs="*",
+                    help="files/directories to vet (default: "
+                         + ", ".join(DEFAULT_TARGETS) + ")")
+    ap.add_argument("--root", default=".",
+                    help="repo root (for default targets + the metric "
+                         "catalogue in docs/OBSERVABILITY.md)")
+    ap.add_argument("--no-catalogue", action="store_true",
+                    help="skip the docs/OBSERVABILITY.md drift check "
+                         "(for vetting files outside the repo)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.name:20s} {rule.doc}")
+        return 0
+    findings = run(args.targets, root=args.root,
+                   skip_catalogue=args.no_catalogue)
+    for f in findings:
+        print(f.render())
+    n_files = len(list(iter_py_files(
+        list(args.targets) or [os.path.join(args.root, t)
+                               for t in DEFAULT_TARGETS])))
+    if findings:
+        print(f"kctpu vet: {len(findings)} finding(s) in {n_files} files",
+              file=sys.stderr)
+        return 1
+    print(f"kctpu vet: clean ({n_files} files)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -11,7 +11,6 @@ Health section (cli/main.py:_describe_health).
 from __future__ import annotations
 
 import enum
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -25,6 +24,7 @@ from ..api.core import (
     is_pod_active,
 )
 from ..api.tfjob import ReplicaType, TFJob
+from ..utils import locks
 from ..planner.materialize import pod_index, pods_by_index
 from ..planner.plan import desired_replicas
 
@@ -112,7 +112,7 @@ class StallTracker:
 
     def __init__(self, policy: Optional[StallPolicy] = None):
         self.policy = policy or StallPolicy()
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("checker.stall-tracker")
         # pod key -> (last step, wall clock when the step last advanced,
         #             wall clock of the last observation — for pruning,
         #             restoring: True while the pod is mid-restore)

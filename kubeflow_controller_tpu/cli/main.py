@@ -676,6 +676,12 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--dump", default="-", metavar="PATH",
                     help="output file (default: stdout)")
 
+    vt = sub.add_parser(
+        "vet", add_help=False,
+        help="AST-lint the project's codified concurrency/controller "
+             "invariants (docs/ANALYSIS.md); args pass through")
+    vt.add_argument("vet_args", nargs=argparse.REMAINDER)
+
     r = sub.add_parser("run", help="run the controller")
     r.add_argument("--in-memory", action="store_true",
                    help="run against the in-memory cluster substrate")
@@ -723,6 +729,14 @@ def main(argv=None) -> int:
 
 
 def _main(argv=None) -> int:
+    raw = list(sys.argv[1:] if argv is None else argv)
+    if raw[:1] == ["vet"]:
+        # Route ahead of argparse: REMAINDER does not reliably capture
+        # leading optionals (bpo-17050), so `kctpu vet --root X` would die
+        # in the parent parser.  The subparser stays for help listing.
+        from ..analysis import vet
+
+        return vet.main(raw[1:])
     args = build_parser().parse_args(argv)
     if args.version or args.cmd == "version":
         return cmd_version(args)
@@ -744,6 +758,10 @@ def _main(argv=None) -> int:
         return cmd_metrics(args)
     if args.cmd == "trace":
         return cmd_trace(args)
+    if args.cmd == "vet":
+        from ..analysis import vet
+
+        return vet.main(args.vet_args)
     if args.cmd == "run":
         return cmd_run(args)
     build_parser().print_help()

@@ -37,6 +37,7 @@ from ..api.core import (
     Pod,
 )
 from ..api.labels import LABEL_JOB_TYPE
+from ..utils import locks
 from .client import Cluster
 from .store import ADDED, DELETED, NotFound
 from .tpu import TPUInventory, pod_requests_tpu
@@ -97,13 +98,13 @@ class FakeKubelet:
         # image-pull-amortization analog; see zygote.py).
         self.warm_start = warm_start
         self._pool = None
-        self._pool_lock = threading.Lock()
+        self._pool_lock = locks.named_lock("kubelet.pool")
         self._watcher = None
         self._threads: Dict[str, threading.Thread] = {}
         self._procs: Dict[str, subprocess.Popen] = {}
         # Fake cluster DNS: coordinator service hostname -> local port.
         self._svc_ports: Dict[str, int] = {}
-        self._svc_lock = threading.Lock()
+        self._svc_lock = locks.named_lock("kubelet.svc-ports")
         self._warm: Dict[str, object] = {}
         # Pod keys whose failure was injected (fail_slice / preemption):
         # the drive loop must not restart them in place — the slice is
@@ -692,12 +693,17 @@ class FakeKubelet:
         dns_key = f"{host}#g{env.get(ENV_GANG_GENERATION, '0') or '0'}"
         with self._svc_lock:
             port = self._svc_ports.get(dns_key)
-            if port is None:
-                s = socket.socket()
-                s.bind(("127.0.0.1", 0))
-                port = s.getsockname()[1]
-                s.close()
-                self._svc_ports[dns_key] = port
+        if port is None:
+            # Bind the probe socket OUTSIDE the lock (socket I/O under
+            # _svc_lock stalled every concurrently-starting pod; caught by
+            # `kctpu vet` lock-blocking-call).  First registration wins:
+            # a gang racing here must agree on ONE port per dns_key.
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            candidate = s.getsockname()[1]
+            s.close()
+            with self._svc_lock:
+                port = self._svc_ports.setdefault(dns_key, candidate)
         env[ENV_COORDINATOR] = f"127.0.0.1:{port}"
 
     def _wire_progress_env(self, pod: Pod, env: Dict[str, str]) -> None:

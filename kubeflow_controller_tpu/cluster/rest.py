@@ -48,7 +48,7 @@ from ..obs.metrics import REGISTRY
 from ..api.core import EventObject, Pod, Service
 from ..api.meta import ObjectMeta
 from ..api.tfjob import TFJob
-from ..utils import serde
+from ..utils import locks, serde
 from .store import (
     ADDED,
     AlreadyExists,
@@ -194,7 +194,7 @@ class ConnectionPool:
         self.timeout = timeout
         self.maxsize = maxsize
         self._ssl = ssl_context
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("rest.conn-pool")
         self._idle: "collections.deque" = collections.deque()
         self._closed = False
         # Pool effectiveness on /metrics: dials is TCP(+TLS) setups paid,

@@ -70,7 +70,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..api.meta import ObjectMeta, get_controller_of, matches_selector
 from ..obs.metrics import REGISTRY, Family, Sample, bucket_quantile
-from ..utils import serde
+from ..utils import locks, serde
 from ..utils.names import generate_name
 
 
@@ -147,7 +147,7 @@ class _Shard:
                  "evicted_rv", "wait_counts", "wait_sum", "wait_max",
                  "contended", "overflows")
 
-    def __init__(self, kind: str, lock: "threading.RLock"):
+    def __init__(self, kind: str, lock: "locks.NamedRLock"):
         self.kind = kind
         self.lock = lock
         self.objects: Dict[tuple, Any] = {}
@@ -256,13 +256,13 @@ class ObjectStore:
         self._snapshot = sharded
         self._copy = serde.deep_copy if sharded else serde.slow_deep_copy
         self._shards: Dict[str, _Shard] = {}
-        self._shards_guard = threading.Lock()
+        self._shards_guard = locks.named_lock("store.shards-guard")
         # Baseline mode: one RLock shared by every shard.
-        self._global_lock = None if sharded else threading.RLock()
+        self._global_lock = None if sharded else locks.named_rlock("store.global")
         # Process-wide RV/uid counter: one tiny lock, never held while any
         # shard lock is being acquired (shard -> meta is the only nesting
         # order, so shards cannot deadlock through it).
-        self._meta_lock = threading.Lock()
+        self._meta_lock = locks.named_lock("store.meta")
         self._rv = 0
         self._uid = 0
         self._watch_cache_size = watch_cache_size
@@ -288,7 +288,8 @@ class ObjectStore:
             with self._shards_guard:
                 sh = self._shards.get(kind)
                 if sh is None:
-                    sh = _Shard(kind, self._global_lock or threading.RLock())
+                    sh = _Shard(kind, self._global_lock
+                                or locks.named_rlock(f"store.shard:{kind}"))
                     # Scrape-time depth callback: updating the gauge from
                     # _notify would re-serialize every shard's writers on
                     # the one instrument lock — the exact cross-kind

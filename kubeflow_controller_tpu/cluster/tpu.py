@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ..api.core import Pod, RESOURCE_TPU
+from ..utils import locks
 from ..api.labels import (
     ANNOTATION_ACCELERATOR,
     ANNOTATION_GANG_NAME,
@@ -68,7 +69,7 @@ class TPUInventory:
     """Tracks slices and gangs; admits gangs all-or-nothing."""
 
     def __init__(self, slices: Optional[List[TPUSlice]] = None):
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("tpu.inventory")
         self.slices: Dict[str, TPUSlice] = {s.name: s for s in (slices or [])}
         self._gangs: Dict[str, _Gang] = {}
         # Gangs seen idle by the last release_idle_gangs scan (two-scan

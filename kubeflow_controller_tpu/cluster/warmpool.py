@@ -11,6 +11,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
+from ..utils import locks
+
 
 @dataclass
 class WarmProc:
@@ -43,10 +45,14 @@ class WarmPool:
     """Owns the zygote subprocess; thread-safe spawn/kill."""
 
     def __init__(self, repo_root: Optional[str] = None):
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("warmpool.state")
+        # Serializes writes on the zygote's stdin pipe — its whole purpose
+        # is holding across I/O, so it is declared to the analysis plane.
+        self._io_lock = locks.named_lock("warmpool.stdin", allow_blocking=True)
         self._next_id = 0
         self._procs: Dict[int, WarmProc] = {}
         self._zygote: Optional[subprocess.Popen] = None
+        self._spawning = False
         self._reader: Optional[threading.Thread] = None
         self._tmpdir = tempfile.mkdtemp(prefix="warmpool-")
         self._repo_root = repo_root
@@ -54,27 +60,43 @@ class WarmPool:
 
     def start(self) -> None:
         with self._lock:
-            if self._zygote is not None:
-                return
+            if self._zygote is not None or self._spawning:
+                spawn_here = False
+            else:
+                self._spawning = True
+                spawn_here = True
+        if spawn_here:
+            # The zygote fork/exec runs OUTSIDE the state lock (a
+            # subprocess spawn under _lock blocked every concurrent
+            # spawn()/kill(); caught by `kctpu vet` lock-blocking-call).
+            # _spawning keeps racing starters parked on _ready instead.
             env = dict(os.environ)
             if self._repo_root:
                 env["PYTHONPATH"] = self._repo_root + os.pathsep + env.get("PYTHONPATH", "")
-            self._zygote = subprocess.Popen(
-                [sys.executable, "-m", "kubeflow_controller_tpu.cluster.zygote"],
-                stdin=subprocess.PIPE,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.DEVNULL,
-                env=env,
-                cwd=self._repo_root or None,
-            )
-            self._reader = threading.Thread(
-                target=self._read_loop, name="warmpool-reader", daemon=True
-            )
-            self._reader.start()
+            try:
+                z = subprocess.Popen(
+                    [sys.executable, "-m", "kubeflow_controller_tpu.cluster.zygote"],
+                    stdin=subprocess.PIPE,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.DEVNULL,
+                    env=env,
+                    cwd=self._repo_root or None,
+                )
+                reader = threading.Thread(
+                    target=self._read_loop, args=(z,), name="warmpool-reader",
+                    daemon=True)
+            except BaseException:
+                with self._lock:
+                    self._spawning = False
+                raise
+            with self._lock:
+                self._zygote = z
+                self._reader = reader
+                self._spawning = False
+            reader.start()
         self._ready.wait(timeout=60)
 
-    def _read_loop(self) -> None:
-        z = self._zygote
+    def _read_loop(self, z: subprocess.Popen) -> None:
         for raw in z.stdout:
             try:
                 msg = json.loads(raw)
@@ -95,7 +117,8 @@ class WarmPool:
                 proc._done.set()
         # zygote died: fail everything outstanding and allow a restart
         with self._lock:
-            self._zygote = None
+            if self._zygote is z:
+                self._zygote = None
             outstanding = list(self._procs.values())
             self._procs.clear()
             self._ready.clear()
@@ -111,7 +134,8 @@ class WarmPool:
         surface that as a pod StartError."""
         self.start()
         with self._lock:
-            if self._zygote is None or self._zygote.poll() is not None:
+            z = self._zygote
+            if z is None or z.poll() is not None:
                 raise OSError("warm-start zygote is not running")
             self._next_id += 1
             rid = self._next_id
@@ -122,32 +146,39 @@ class WarmPool:
                 stderr_path=os.path.join(self._tmpdir, f"{safe}-{rid}.err"),
             )
             self._procs[rid] = proc
-            req = {
-                "id": rid,
-                "argv": list(argv),
-                "env": dict(env),
-                "cwd": cwd or "",
-                "stdout": proc.stdout_path,
-                "stderr": proc.stderr_path,
-            }
-            try:
-                self._zygote.stdin.write((json.dumps(req) + "\n").encode())
-                self._zygote.stdin.flush()
-            except (BrokenPipeError, OSError) as e:
+        req = {
+            "id": rid,
+            "argv": list(argv),
+            "env": dict(env),
+            "cwd": cwd or "",
+            "stdout": proc.stdout_path,
+            "stderr": proc.stderr_path,
+        }
+        try:
+            # Pipe writes go through the dedicated stdin lock, not the
+            # state lock: request framing stays atomic without parking
+            # state readers behind pipe I/O.
+            with self._io_lock:
+                z.stdin.write((json.dumps(req) + "\n").encode())
+                z.stdin.flush()
+        except (BrokenPipeError, ValueError, OSError) as e:
+            with self._lock:
                 self._procs.pop(rid, None)
-                raise OSError(f"warm-start zygote unreachable: {e}") from e
+            raise OSError(f"warm-start zygote unreachable: {e}") from e
         return proc
 
     def kill(self, proc: WarmProc) -> None:
         with self._lock:
-            if self._zygote is None or proc.exit_code is not None:
-                return
-            try:
-                self._zygote.stdin.write(
+            z = self._zygote
+        if z is None or proc.exit_code is not None:
+            return
+        try:
+            with self._io_lock:
+                z.stdin.write(
                     (json.dumps({"kill": proc.req_id}) + "\n").encode())
-                self._zygote.stdin.flush()
-            except (BrokenPipeError, ValueError):
-                pass
+                z.stdin.flush()
+        except (BrokenPipeError, ValueError, OSError):
+            pass
 
     def stop(self) -> None:
         with self._lock:

@@ -57,7 +57,7 @@ from ..planner import plan_job
 from ..planner.materialize import gang_name, make_pod, make_service
 from ..planner.types import Action
 from ..updater import compute_status, should_update
-from ..utils import serde
+from ..utils import locks, serde
 from ..utils.names import generate_runtime_id
 from ..recovery.policy import (
     ACTION_BACKOFF,
@@ -115,7 +115,7 @@ class Controller:
         # controller is bounded regardless of threadiness.
         self.manage_workers = manage_workers
         self._manage_pool: Optional[ThreadPoolExecutor] = None
-        self._manage_pool_lock = threading.Lock()
+        self._manage_pool_lock = locks.named_lock("controller.manage-pool")
         self._h_batch = REGISTRY.histogram(
             "kctpu_manage_batch_size",
             "Plan events dispatched per slow-start batch",
@@ -136,7 +136,7 @@ class Controller:
         # TrainingStalled/TrainingResumed events (the condition itself is
         # level-triggered in status).
         self._stalled: Dict[str, frozenset] = {}
-        self._stalled_lock = threading.Lock()
+        self._stalled_lock = locks.named_lock("controller.stalled")
         # Per-job gang scheduling state ("queued"/"admitted"/"preempted")
         # from the LAST sync, for edge-triggered GangQueued/GangAdmitted/
         # GangPreempted events (shares the stalled lock — same cadence).

@@ -16,6 +16,8 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+from ..utils import locks
+
 logger = logging.getLogger("kubeflow_controller_tpu.events")
 
 # Event reasons (ref: pkg/controller/control/types.go:20-29).
@@ -72,7 +74,7 @@ class EventRecorder:
         import queue
 
         self.component = component
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("events.recorder")
         self._events: List[Event] = []
         # In-memory aggregation index: (object_key, reason, message) -> its
         # live Event.  Keyed, not last-element-only: interleaved events from

@@ -15,10 +15,11 @@ replicas.  The load-bearing contract (SURVEY.md §7 "hard parts"):
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict
+
+from ..utils import locks
 
 EXPECTATION_TTL_S = 5 * 60.0  # ExpectationsTimeout, controller_utils.go:125
 
@@ -39,7 +40,7 @@ class _Expectation:
 class ControllerExpectations:
     def __init__(self, ttl_s: float = EXPECTATION_TTL_S):
         self._ttl = ttl_s
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("controller.expectations")
         self._store: Dict[str, _Expectation] = {}
 
     def expect_creations(self, key: str, count: int) -> None:

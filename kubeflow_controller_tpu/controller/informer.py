@@ -31,6 +31,7 @@ from typing import Any, Callable, Dict, List, Optional, Set
 from ..api.meta import key_of
 from ..cluster.store import ADDED, DELETED, MODIFIED, Watcher
 from ..obs.metrics import REGISTRY
+from ..utils import locks
 
 
 class SharedInformer:
@@ -38,7 +39,7 @@ class SharedInformer:
         self._client = client
         self._resync_s = resync_period_s
         self.name = name or getattr(client, "kind", "objects")
-        self._lock = threading.RLock()
+        self._lock = locks.named_rlock(f"informer:{self.name}")
         self._cache: Dict[str, Any] = {}
         # index name -> index key -> set of cache keys; plus the reverse map
         # (cache key -> index name -> keys) so removal never recomputes keys

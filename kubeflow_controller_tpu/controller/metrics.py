@@ -12,11 +12,12 @@ import threading
 from typing import Dict, List, Optional
 
 from ..obs import metrics as obs_metrics
+from ..utils import locks
 
 
 class ReconcileMetrics:
     def __init__(self, max_samples: int = 100_000):
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("controller.reconcile-metrics")
         self._samples: List[float] = []
         self._max = max_samples
         self._sum = 0.0  # cumulative, survives sample-window truncation

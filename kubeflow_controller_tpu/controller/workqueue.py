@@ -27,6 +27,7 @@ import time
 from typing import Deque, Dict, List, Optional, Set
 
 from ..obs import metrics as obs_metrics
+from ..utils import locks
 
 
 class _QueueMetrics:
@@ -65,7 +66,7 @@ class ItemExponentialFailureRateLimiter:
         self.base_delay = base_delay
         self.max_delay = max_delay
         self._failures: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("workqueue.limiter")
 
     def when(self, item: str) -> float:
         with self._lock:
@@ -95,7 +96,7 @@ class RateLimitingQueue:
         # never be eaten by the wrong waiter (a single shared condition
         # with notify(1) could wake a get() waiter instead of the delay
         # loop and lose the wakeup).
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock(f"workqueue:{name}")
         self._cond = threading.Condition(self._lock)
         self._delay_cond = threading.Condition(self._lock)
         # FIFO of ready items: deque, so the get() hot path is O(1)

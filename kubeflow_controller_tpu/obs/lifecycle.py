@@ -18,11 +18,11 @@ churns jobs forever cannot grow it without bound.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, Optional, Tuple
 
 from .metrics import REGISTRY, Registry
+from ..utils import locks
 
 TERMINAL_PHASES = ("Succeeded", "Failed")
 
@@ -44,7 +44,7 @@ class JobLifecycle:
             "kctpu_job_phase_transitions_total",
             "TFJob phase transitions observed by the status updater",
             labelnames=("from_phase", "to_phase"))
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("obs.lifecycle")
         self._max = max_jobs
         # uid -> (current phase, entered-at wall clock)
         self._since: Dict[str, Tuple[str, float]] = {}
@@ -82,7 +82,7 @@ class JobLifecycle:
 
 
 _DEFAULT: Optional[JobLifecycle] = None
-_DEFAULT_LOCK = threading.Lock()
+_DEFAULT_LOCK = locks.named_lock("obs.lifecycle-default")
 
 
 def job_lifecycle() -> JobLifecycle:

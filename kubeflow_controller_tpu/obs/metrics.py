@@ -22,9 +22,10 @@ from __future__ import annotations
 
 import math
 import re
-import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..utils import locks
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -95,7 +96,7 @@ class _Instrument:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock(f"obs.metric:{name}")
 
     def _key(self, labelvalues: Sequence[str], kv: Dict[str, str]) -> Tuple[str, ...]:
         if kv:
@@ -361,7 +362,7 @@ class Registry:
     """Named instruments + pluggable collectors, rendered as one page."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("obs.registry")
         self._metrics: Dict[str, _Instrument] = {}
         self._collectors: Dict[str, Callable[[], Iterable[Family]]] = {}
 

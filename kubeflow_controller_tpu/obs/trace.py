@@ -29,6 +29,8 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional
 
+from ..utils import locks
+
 TRACE_DIR_ENV = "KCTPU_TRACE_DIR"
 
 
@@ -65,7 +67,7 @@ class Span:
 
 class Tracer:
     def __init__(self, capacity: int = 8192):
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("obs.tracer")
         self._spans: deque = deque(maxlen=capacity)
         self._local = threading.local()
 

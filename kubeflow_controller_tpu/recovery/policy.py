@@ -34,12 +34,12 @@ and controller (events, requeue-after, gang-generation bump) all consume.
 from __future__ import annotations
 
 import random
-import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..api.core import PHASE_FAILED, PHASE_RUNNING, PHASE_SUCCEEDED, is_pod_active
 from ..api.tfjob import ReplicaType, TFJob
+from ..utils import locks
 from ..planner.materialize import pods_by_index
 from ..planner.plan import desired_replicas
 
@@ -146,7 +146,7 @@ class RestartTracker:
                  rng: Optional[random.Random] = None):
         self.config = config or RestartPolicyConfig()
         self._rng = rng or random.Random()
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("recovery.restarts")
         # job key -> (type, index) -> state
         self._jobs: Dict[str, Dict[Tuple[ReplicaType, int], _IndexState]] = {}
         from ..obs.metrics import REGISTRY

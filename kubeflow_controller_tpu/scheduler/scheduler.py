@@ -37,11 +37,11 @@ Evictions are executed OUTSIDE the lock via the kubelet-registered evictor.
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..utils import locks
 from ..api.labels import (
     ANNOTATION_ACCELERATOR,
     ANNOTATION_GANG_NAME,
@@ -81,7 +81,7 @@ class GangScheduler:
     def __init__(self, inventory, policy: Optional[SchedulerPolicy] = None):
         self.inventory = inventory
         self.policy = policy or SchedulerPolicy()
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("scheduler.gang-queue")
         self._gangs: Dict[str, GangEntry] = {}
         # gang name -> first-ever enqueue time; survives entry deletion so
         # a preempted-then-replaced gang keeps its queue position.

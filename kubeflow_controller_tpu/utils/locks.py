@@ -1,0 +1,216 @@
+"""Named-lock facade: every project lock is created here.
+
+The controller is a dense multithreaded system (store shards, workqueue,
+informers, scheduler, recovery, kubelet, warm pool, REST pool, obs) whose
+failure modes — lock-order inversions, blocking calls made while a lock is
+held — surface only under rare interleavings.  Routing every lock through
+one constructor gives the analysis plane a seam:
+
+- **names**: each lock carries a stable dotted name ("store.shard:pods",
+  "workqueue:tfJobs"), so the runtime lock-order detector
+  (analysis/lockcheck.py) builds its acquisition-order graph over *roles*,
+  not object identities, and reports read like the code;
+- **hooks**: with ``KCTPU_LOCKCHECK=1`` every acquire/release feeds the
+  per-thread held-lock stack and the global order graph; with
+  ``KCTPU_SCHED_FUZZ=<seed>`` the schedule fuzzer (analysis/interleave.py)
+  injects seeded pre-acquire yields to force adversarial interleavings.
+  Both default to ``None`` and the uninstrumented fast path is two global
+  reads on top of the raw ``threading`` primitive;
+- **intent**: a lock whose whole purpose is serializing I/O (the warm
+  pool's zygote-stdin pipe) is declared ``allow_blocking=True`` — ordering
+  is still tracked, but blocking calls under it are by design and not
+  violations.
+
+The facade objects satisfy the ``threading.Condition`` lock protocol
+(``acquire``/``release``/``__enter__``/``__exit__``/``_is_owned``), so
+``threading.Condition(named_lock(...))`` works and condition waits keep the
+held-stack bookkeeping consistent (wait releases through the facade,
+reacquires through the facade).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+# Originals captured before any instrumentation can monkeypatch them: the
+# fuzzer must yield (and the lockcheck internals must sleep) through the
+# REAL functions, or an injected yield would itself be flagged as a
+# blocking call under a lock.
+_time = __import__("time")
+_orig_sleep = _time.sleep
+_orig_monotonic = _time.monotonic
+
+#: Installed by analysis.lockcheck.install(); None = zero-overhead path.
+_checker = None
+#: Installed by analysis.interleave.install(); None = no yield injection.
+_fuzzer = None
+
+_get_ident = threading.get_ident
+
+_blocking_ok = threading.local()
+
+
+class blocking_ok:
+    """Context manager declaring a DELIBERATE blocking call under a lock
+    on this thread (e.g. a test stalling one store shard's critical
+    section to assert other shards stay live).  The lockcheck
+    blocking-call detector skips the wrapped region; lock ordering is
+    still tracked.  Reentrant."""
+
+    def __enter__(self):
+        _blocking_ok.depth = getattr(_blocking_ok, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _blocking_ok.depth -= 1
+
+
+def blocking_allowed() -> bool:
+    return getattr(_blocking_ok, "depth", 0) > 0
+
+
+def set_checker(checker) -> None:
+    global _checker
+    _checker = checker
+
+
+def get_checker():
+    return _checker
+
+
+def set_fuzzer(fuzzer) -> None:
+    global _fuzzer
+    _fuzzer = fuzzer
+
+
+def get_fuzzer():
+    return _fuzzer
+
+
+class NamedLock:
+    """A ``threading.Lock`` with a role name and analysis hooks."""
+
+    _reentrant = False
+    __slots__ = ("name", "allow_blocking", "_lock", "_owner", "_count")
+
+    def __init__(self, name: str, allow_blocking: bool = False):
+        self.name = name
+        self.allow_blocking = allow_blocking
+        self._lock = self._make()
+        self._owner: Optional[int] = None
+        self._count = 0
+
+    def _make(self):
+        return threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        fuzz = _fuzzer
+        if fuzz is not None and blocking:
+            fuzz.before_acquire(self)
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            me = _get_ident()
+            if self._reentrant and self._owner == me:
+                self._count += 1
+                reentered = True
+            else:
+                self._owner = me
+                self._count = 1
+                reentered = False
+            checker = _checker
+            if checker is not None:
+                checker.acquired(self, reentered)
+        return ok
+
+    def release(self) -> None:
+        if self._count > 1:
+            self._count -= 1
+            self._lock.release()
+            return
+        self._owner = None
+        self._count = 0
+        checker = _checker
+        if checker is not None:
+            checker.released(self)
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    # threading.Condition protocol: with _is_owned defined the Condition
+    # falls back to calling OUR acquire/release for wait()'s
+    # release-save/acquire-restore, keeping the held stack consistent.
+    def _is_owned(self) -> bool:
+        return self._owner == _get_ident()
+
+    def __enter__(self) -> "NamedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+class NamedRLock(NamedLock):
+    """A ``threading.RLock`` with a role name and analysis hooks."""
+
+    _reentrant = True
+    __slots__ = ()
+
+    def _make(self):
+        return threading.RLock()
+
+
+def named_lock(name: str, allow_blocking: bool = False) -> NamedLock:
+    """A non-reentrant project lock.  ``name`` is the stable role the
+    lock-order graph is keyed by: instances of the same role share a node
+    (use ':<instance>' suffixes when distinct instances can nest)."""
+    _maybe_bootstrap()
+    return NamedLock(name, allow_blocking=allow_blocking)
+
+
+def named_rlock(name: str, allow_blocking: bool = False) -> NamedRLock:
+    """A reentrant project lock (same-thread re-acquisition is tracked and
+    never recorded as a self-edge)."""
+    _maybe_bootstrap()
+    return NamedRLock(name, allow_blocking=allow_blocking)
+
+
+def named_condition(name: str, lock: Optional[NamedLock] = None) -> threading.Condition:
+    """A ``threading.Condition`` over a named lock (shared ``lock`` lets
+    several conditions guard one critical section, as the workqueue does)."""
+    return threading.Condition(lock if lock is not None else named_lock(name))
+
+
+# -- env bootstrap -----------------------------------------------------------
+
+_bootstrapped = False
+
+
+def _maybe_bootstrap() -> None:
+    """First-lock-creation hook: honor ``KCTPU_LOCKCHECK=1`` and
+    ``KCTPU_SCHED_FUZZ=<seed>`` for ANY entrypoint (pytest, bench, smokes)
+    without per-entrypoint plumbing.  Lazy so ``import kubeflow_controller_tpu``
+    never pays for the analysis plane when the env is unset."""
+    global _bootstrapped
+    if _bootstrapped:
+        return
+    _bootstrapped = True
+    if _checker is None and os.environ.get("KCTPU_LOCKCHECK", "") not in ("", "0"):
+        from ..analysis import lockcheck
+
+        lockcheck.install()
+    fuzz = os.environ.get("KCTPU_SCHED_FUZZ", "")
+    if _fuzzer is None and fuzz not in ("", "0"):
+        from ..analysis import interleave
+
+        try:
+            seed = int(fuzz)
+        except ValueError:
+            seed = 1
+        interleave.install(seed)

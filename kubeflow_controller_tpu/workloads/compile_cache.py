@@ -38,13 +38,13 @@ from __future__ import annotations
 import hashlib
 import os
 import pickle
-import threading
 from dataclasses import dataclass
 from typing import Any, Optional, Sequence
 
 from ..planner.materialize import ENV_COMPILE_CACHE
+from ..utils import locks
 
-_STATE_LOCK = threading.Lock()
+_STATE_LOCK = locks.named_lock("workload.compile-cache")
 _ENABLED_DIR: Optional[str] = None
 
 AOT_SUFFIX = ".aot"

@@ -28,6 +28,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
+from ..utils import locks
+
 # Env contract injected by the node agent (cluster/kubelet.py) — the
 # downward-API analog: who am I, and where do beats go.
 ENV_POD_NAMESPACE = "KCTPU_POD_NAMESPACE"
@@ -51,7 +53,8 @@ class ProgressReporter:
     url: str = ""       # API server base URL (REST transport)
     drop_dir: str = ""  # file-drop directory (fallback transport)
     _last: Dict[str, float] = field(default_factory=dict)
-    _lock: threading.Lock = field(default_factory=threading.Lock)
+    _lock: "locks.NamedLock" = field(
+        default_factory=lambda: locks.named_lock("workload.progress"))
     _keepalive: Optional[threading.Thread] = None
     _stop: Optional[threading.Event] = None
 
@@ -184,7 +187,7 @@ class ProgressReporter:
 
 
 _REPORTER: Optional[ProgressReporter] = None
-_REPORTER_LOCK = threading.Lock()
+_REPORTER_LOCK = locks.named_lock("workload.progress-reporter")
 
 
 def reporter() -> ProgressReporter:

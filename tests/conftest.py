@@ -20,3 +20,23 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _kctpu_lockcheck():
+    """With KCTPU_LOCKCHECK=1, run the WHOLE suite under the runtime
+    lock-order detector (analysis/lockcheck.py) and fail the session at
+    exit on any acquisition-order cycle or blocking-call-under-lock — the
+    interleaving-dependent bug classes no individual test can assert on."""
+    if os.environ.get("KCTPU_LOCKCHECK", "") in ("", "0"):
+        yield
+        return
+    from kubeflow_controller_tpu.analysis import lockcheck
+
+    checker = lockcheck.install()
+    yield
+    report = checker.report()
+    print("\n" + report.render())
+    assert report.clean, "lockcheck found concurrency violations (above)"

@@ -1,0 +1,30 @@
+"""Vet fixture: blocking calls inside `with <lock>` bodies (all BAD)."""
+import queue
+import socket
+import subprocess
+import threading
+import time
+
+_lock = threading.Lock()
+_q = queue.Queue()
+
+
+def sleep_under_lock():
+    with _lock:
+        time.sleep(0.1)  # BAD: lock held across sleep
+
+
+def queue_get_under_lock():
+    with _lock:
+        return _q.get(timeout=1.0)  # BAD: lock held across a blocking pop
+
+
+def socket_under_cond(cond):
+    with cond:
+        s = socket.socket()  # BAD: socket created in the critical section
+        s.connect(("127.0.0.1", 80))  # BAD: lock held across connect
+
+
+def subprocess_under_lock():
+    with _lock:
+        subprocess.run(["true"])  # BAD: lock held across a child process

@@ -1,0 +1,32 @@
+"""Vet fixture: deepcopy on a hot path, thread hygiene, metric prefix,
+event-reason style (all BAD)."""
+import copy
+import threading
+
+
+def hot_copy(obj):
+    return copy.deepcopy(obj)  # BAD: use serde.deep_copy
+
+
+def spawn_anonymous(worker):
+    t = threading.Thread(target=worker)  # BAD: no name, no daemon
+    t.start()
+    return t
+
+
+def spawn_non_daemon(worker):
+    t = threading.Thread(target=worker, name="w", daemon=False)  # BAD
+    t.start()
+    return t
+
+
+def register(registry):
+    return registry.counter("sync_total", "syncs")  # BAD: no kctpu_ prefix
+
+
+REASON_BAD_STYLE = "created pod"  # BAD: not CamelCase
+
+
+def emit(recorder, job, n):
+    recorder.event(job, "Normal", "created pod", "msg")  # BAD reason style
+    recorder.event(job, "Normal", f"Restarted{n}", "msg")  # BAD dynamic reason

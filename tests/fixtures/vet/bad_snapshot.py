@@ -1,0 +1,20 @@
+"""Vet fixture: mutating store snapshots (shared immutable references)."""
+
+
+def mutate_get_snapshot(store):
+    obj = store.get_snapshot("pods", "default", "p0")
+    obj.status.phase = "Running"  # BAD: shared reference mutated in place
+    return obj
+
+
+def mutate_list_snapshot(store):
+    objs, rv = store.list_snapshot_with_rv("pods", "default")
+    for o in objs:
+        o.metadata.labels.update({"x": "y"})  # BAD: mutator on a snapshot
+    return rv
+
+
+def mutate_alias(store):
+    snap = store.get_snapshot("pods", "default", "p0")
+    alias = snap
+    alias.metadata.name = "renamed"  # BAD: alias of a snapshot

@@ -1,0 +1,15 @@
+"""Vet fixture: the reference's shared-template mutation bug
+(design_doc.md:262-268) — per-replica arg injection mutating the ONE
+template object every other replica also builds from."""
+
+
+def make_pod_buggy(spec, index):
+    template = spec.template  # BAD binding: no deep copy
+    template.spec.containers[0].args.append(f"--task_index={index}")
+    return template
+
+
+def inject_args_buggy(job, spec, index):
+    # Direct mutation through the shared chain: every replica sees it.
+    spec.template.metadata.labels["index"] = str(index)
+    spec.template.spec.restart_policy = "Never"

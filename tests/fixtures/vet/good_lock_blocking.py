@@ -1,0 +1,42 @@
+"""Vet fixture: the same work with blocking calls OUTSIDE the lock."""
+import queue
+import socket
+import subprocess
+import threading
+import time
+
+_lock = threading.Lock()
+_q = queue.Queue()
+
+
+def sleep_outside_lock():
+    with _lock:
+        deadline = time.time() + 0.1
+    time.sleep(max(0.0, deadline - time.time()))
+
+
+def queue_get_outside_lock():
+    item = _q.get(timeout=1.0)
+    with _lock:
+        return item
+
+
+def socket_outside_cond(cond):
+    s = socket.socket()
+    s.connect(("127.0.0.1", 80))
+    with cond:
+        return s
+
+
+def deferred_under_lock_is_fine():
+    with _lock:
+        # A closure DEFINED under the lock runs later: not a finding.
+        def later():
+            time.sleep(0.1)
+        return later
+
+
+def subprocess_outside_lock():
+    proc = subprocess.run(["true"])
+    with _lock:
+        return proc.returncode

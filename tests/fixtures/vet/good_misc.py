@@ -1,0 +1,24 @@
+"""Vet fixture: the same intents done right."""
+import threading
+
+from kubeflow_controller_tpu.utils import serde
+
+REASON_GOOD_STYLE = "SuccessfulCreate"
+
+
+def hot_copy(obj):
+    return serde.deep_copy(obj)
+
+
+def spawn_named_daemon(worker):
+    t = threading.Thread(target=worker, name="fixture-worker", daemon=True)
+    t.start()
+    return t
+
+
+def register(registry):
+    return registry.counter("kctpu_fixture_total", "fixture counter")
+
+
+def emit(recorder, job, n):
+    recorder.event(job, "Normal", REASON_GOOD_STYLE, f"Created pod {n}")

@@ -1,0 +1,28 @@
+"""Vet fixture: snapshot reads used correctly (read-only, or deep-copied
+before mutation)."""
+
+from kubeflow_controller_tpu.utils import serde
+
+
+def read_snapshot(store):
+    obj = store.get_snapshot("pods", "default", "p0")
+    return obj.status.phase  # reads are fine
+
+
+def copy_then_mutate(store):
+    obj = serde.deep_copy(store.get_snapshot("pods", "default", "p0"))
+    obj.status.phase = "Running"  # fine: our own copy
+    return obj
+
+
+def rebind_then_mutate(store):
+    obj = store.get_snapshot("pods", "default", "p0")
+    obj = serde.deep_copy(obj)
+    obj.metadata.labels.update({"x": "y"})  # fine: rebound to a copy
+    return obj
+
+
+def plain_get_is_mutable(store):
+    obj = store.get("pods", "default", "p0")  # get() returns a caller copy
+    obj.status.phase = "Running"
+    return obj

@@ -1,0 +1,16 @@
+"""Vet fixture: per-replica materialization off a deep-copied template
+(what planner/materialize.py actually does)."""
+
+from kubeflow_controller_tpu.utils import serde
+
+
+def make_pod_correct(spec, index):
+    template = serde.deep_copy(spec.template)
+    template.spec.containers[0].args.append(f"--task_index={index}")
+    template.metadata.labels["index"] = str(index)
+    return template
+
+
+def read_only_is_fine(spec):
+    restart = spec.template.spec.restart_policy if spec.template else "OnFailure"
+    return restart

@@ -1,0 +1,20 @@
+"""Vet fixture: violations silenced with inline `# kctpu: vet-ok(rule)`
+markers (docs/ANALYSIS.md)."""
+import copy
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def intentional_sleep_under_lock():
+    with _lock:  # kctpu: vet-ok(lock-blocking-call)
+        time.sleep(0.001)
+
+
+def intentional_deepcopy(obj):
+    return copy.deepcopy(obj)  # kctpu: vet-ok(hot-path-deepcopy)
+
+
+def intentional_anonymous(worker):
+    return threading.Thread(target=worker)  # kctpu: vet-ok(thread-hygiene)

@@ -1,0 +1,384 @@
+"""Analysis plane: `kctpu vet` rules against paired good/bad fixtures, the
+runtime lock-order detector, the schedule-fuzz harness, and the planner's
+shared-template regression (the reference bug, design_doc.md:262-268)."""
+
+import os
+import threading
+
+import pytest
+
+from kubeflow_controller_tpu.analysis import interleave, lockcheck, vet
+from kubeflow_controller_tpu.utils import locks
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO_ROOT, "tests", "fixtures", "vet")
+
+
+def vet_rules(path):
+    """Rule names found in one fixture file (catalogue check skipped)."""
+    findings = vet.run([os.path.join(FIXTURES, path)], root=REPO_ROOT,
+                       skip_catalogue=True)
+    return findings, {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# kctpu vet: rules against paired fixtures
+# ---------------------------------------------------------------------------
+
+class TestVetRules:
+    def test_lock_blocking_bad(self):
+        findings, rules = vet_rules("bad_lock_blocking.py")
+        assert rules == {"lock-blocking-call"}
+        # one per blocking call: sleep, queue.get, socket() + connect, run
+        assert len(findings) == 5
+        msgs = " ".join(f.message for f in findings)
+        assert "time.sleep" in msgs and "queue" in msgs
+        assert all(f.line > 0 and f.path.endswith("bad_lock_blocking.py")
+                   for f in findings)
+
+    def test_lock_blocking_good(self):
+        findings, _ = vet_rules("good_lock_blocking.py")
+        assert findings == []
+
+    def test_template_bad_reproduces_reference_bug(self):
+        findings, rules = vet_rules("bad_template.py")
+        assert rules == {"template-copy"}
+        # the buggy binding mutation + two direct .template. writes
+        assert len(findings) == 3
+
+    def test_template_good(self):
+        findings, _ = vet_rules("good_template.py")
+        assert findings == []
+
+    def test_snapshot_bad(self):
+        findings, rules = vet_rules("bad_snapshot.py")
+        assert rules == {"snapshot-mutation"}
+        assert len(findings) == 3  # direct, list-element mutator, alias
+
+    def test_snapshot_good(self):
+        findings, _ = vet_rules("good_snapshot.py")
+        assert findings == []
+
+    def test_misc_bad(self):
+        findings, rules = vet_rules("bad_misc.py")
+        assert rules == {"hot-path-deepcopy", "thread-hygiene",
+                         "metric-prefix", "event-reason-style"}
+        by_rule = {}
+        for f in findings:
+            by_rule.setdefault(f.rule, []).append(f)
+        assert len(by_rule["thread-hygiene"]) == 2
+        assert len(by_rule["event-reason-style"]) == 3  # constant + 2 calls
+
+    def test_misc_good(self):
+        findings, _ = vet_rules("good_misc.py")
+        assert findings == []
+
+    def test_inline_suppression(self):
+        findings, _ = vet_rules("suppressed.py")
+        assert findings == []
+
+    def test_findings_carry_file_line_rule(self):
+        findings, _ = vet_rules("bad_misc.py")
+        rendered = [f.render() for f in findings]
+        assert all(":" in r and "[" in r for r in rendered)
+
+    def test_repo_is_vet_clean(self):
+        """The acceptance gate: `make vet` exits 0 on the repo."""
+        findings = vet.run(root=REPO_ROOT)
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_metric_catalogue_drift_detected(self, tmp_path):
+        """A registered-but-undocumented metric is catalogue drift."""
+        mod = tmp_path / "drifty.py"
+        mod.write_text(
+            "def reg(registry):\n"
+            "    return registry.counter('kctpu_not_in_catalogue_total', 'x')\n")
+        findings = vet.run([str(mod)], root=REPO_ROOT)
+        assert any(f.rule == "metric-catalogue"
+                   and "kctpu_not_in_catalogue_total" in f.message
+                   for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# Runtime lock-order detector
+# ---------------------------------------------------------------------------
+
+class _FakeLock:
+    _reentrant = False
+
+    def __init__(self, name, allow_blocking=False):
+        self.name = name
+        self.allow_blocking = allow_blocking
+        self._owner = threading.get_ident()  # "held by this thread"
+
+
+class TestLockcheck:
+    def test_seeded_ab_ba_cycle_is_flagged(self):
+        checker = lockcheck.LockChecker()
+        a, b = _FakeLock("lock.A"), _FakeLock("lock.B")
+        checker.acquired(a, False)
+        checker.acquired(b, False)  # A -> B
+        checker.released(b)
+        checker.released(a)
+        checker.acquired(b, False)
+        checker.acquired(a, False)  # B -> A: the inversion
+        checker.released(a)
+        checker.released(b)
+        report = checker.report()
+        assert len(report.cycles) == 1
+        assert set(report.cycles[0]) == {"lock.A", "lock.B"}
+        assert not report.clean
+        assert "LOCK-ORDER CYCLE" in report.render()
+        # edges carry the first-seen site for the report
+        assert all(site for site in report.edges.values())
+
+    def test_consistent_order_is_clean(self):
+        checker = lockcheck.LockChecker()
+        a, b = _FakeLock("lock.A"), _FakeLock("lock.B")
+        for _ in range(3):
+            checker.acquired(a, False)
+            checker.acquired(b, False)
+            checker.released(b)
+            checker.released(a)
+        report = checker.report()
+        assert report.clean and report.cycles == []
+        assert ("lock.A", "lock.B") in report.edges
+
+    def test_reentrant_reacquire_records_no_self_edge(self):
+        checker = lockcheck.LockChecker()
+        a = _FakeLock("lock.A")
+        checker.acquired(a, False)
+        checker.acquired(a, True)  # RLock re-entry
+        checker.released(a)
+        report = checker.report()
+        assert report.edges == {} and report.clean
+
+    def test_blocking_call_under_lock_detected(self):
+        checker = lockcheck.LockChecker()
+        a = _FakeLock("lock.A")
+        checker.acquired(a, False)
+        for _ in range(2):  # same call site: dedups into one, count=2
+            checker.blocking_call("time.sleep")
+        checker.released(a)
+        checker.blocking_call("time.sleep")  # not held: no violation
+        report = checker.report()
+        assert len(report.blocking) >= 1
+        v = report.blocking[0]
+        assert v.what == "time.sleep" and v.held == ("lock.A",)
+        assert v.count >= 2
+
+    def test_blocking_ok_region_is_exempt(self):
+        """locks.blocking_ok() declares a deliberate stall (tests freezing
+        one shard's critical section on purpose): no violation inside,
+        violations resume after."""
+        checker = lockcheck.LockChecker()
+        a = _FakeLock("lock.A")
+        checker.acquired(a, False)
+        with locks.blocking_ok():
+            checker.blocking_call("time.sleep")
+        assert checker.report().clean
+        checker.blocking_call("time.sleep")
+        checker.released(a)
+        assert not checker.report().clean
+
+    def test_allow_blocking_lock_is_exempt(self):
+        checker = lockcheck.LockChecker()
+        io = _FakeLock("warmpool.stdin", allow_blocking=True)
+        checker.acquired(io, False)
+        checker.blocking_call("subprocess.Popen")
+        checker.released(io)
+        assert checker.report().clean
+
+    def test_patched_sleep_feeds_live_checker(self):
+        """End to end through the facade: a real named lock held across a
+        real (patched) time.sleep lands in the report."""
+        import time as _time
+
+        prev = locks.get_checker()
+        fresh = lockcheck.installed() is None
+        lockcheck.install()
+        mine = lockcheck.LockChecker()
+        locks.set_checker(mine)
+        try:
+            lk = locks.named_lock("test.sleepy")
+            with lk:
+                _time.sleep(0.001)
+            report = mine.report()
+            assert any(v.what == "time.sleep" and "test.sleepy" in v.held
+                       for v in report.blocking)
+        finally:
+            locks.set_checker(prev)
+            if fresh:
+                lockcheck.uninstall()
+
+    def test_real_nested_named_locks_record_edge(self):
+        prev = locks.get_checker()
+        mine = lockcheck.LockChecker()
+        locks.set_checker(mine)
+        try:
+            outer = locks.named_lock("test.outer")
+            inner = locks.named_lock("test.inner")
+            with outer:
+                with inner:
+                    pass
+            assert ("test.outer", "test.inner") in mine.report().edges
+        finally:
+            locks.set_checker(prev)
+
+    def test_named_lock_condition_interop(self):
+        """threading.Condition over a facade lock: notify/wait work and the
+        held stack stays balanced through wait's release/reacquire."""
+        prev = locks.get_checker()
+        mine = lockcheck.LockChecker()
+        locks.set_checker(mine)
+        try:
+            lk = locks.named_lock("test.cond")
+            cond = threading.Condition(lk)
+            hits = []
+
+            def waiter():
+                with cond:
+                    while not hits:
+                        cond.wait(timeout=2.0)
+                    hits.append("woke")
+
+            t = threading.Thread(target=waiter, name="cond-waiter", daemon=True)
+            t.start()
+            import time as _time
+            _time.sleep(0.05)
+            with cond:
+                hits.append("set")
+                cond.notify()
+            t.join(timeout=2.0)
+            assert not t.is_alive() and "woke" in hits
+            assert mine.report().clean
+        finally:
+            locks.set_checker(prev)
+
+    def test_detector_silent_on_real_concurrency(self):
+        """The store scenario (writers/readers/watchers over named locks)
+        must produce zero cycles and zero blocking-call violations."""
+        prev = locks.get_checker()
+        fresh = lockcheck.installed() is None
+        lockcheck.install()
+        mine = lockcheck.LockChecker()
+        locks.set_checker(mine)
+        try:
+            interleave.scenario_store(0.3)
+            report = mine.report()
+            assert report.clean, report.render()
+            assert report.acquires > 0
+        finally:
+            locks.set_checker(prev)
+            if fresh:
+                lockcheck.uninstall()
+
+    def test_find_cycles_units(self):
+        f = lockcheck.find_cycles
+        assert f({"a": {"b"}, "b": {"c"}}) == []
+        assert f({"a": {"b"}, "b": {"a"}}) == [["a", "b"]] or \
+            f({"a": {"b"}, "b": {"a"}}) == [["b", "a"]]
+        assert f({"a": {"a"}}) == [["a"]]
+        three = f({"a": {"b"}, "b": {"c"}, "c": {"a"}})
+        assert len(three) == 1 and set(three[0]) == {"a", "b", "c"}
+
+
+# ---------------------------------------------------------------------------
+# Schedule-fuzz harness
+# ---------------------------------------------------------------------------
+
+class TestInterleave:
+    def test_seed_decisions_reproducible(self):
+        """The race-smoke reproducibility contract: the decision stream is
+        a pure function of (seed, thread name)."""
+        a = interleave.ScheduleFuzzer(101)
+        b = interleave.ScheduleFuzzer(101)
+        assert a.decisions("worker-1", 64) == b.decisions("worker-1", 64)
+        assert a.decisions("worker-1", 64) != a.decisions("worker-2", 64)
+        assert (interleave.ScheduleFuzzer(101).decisions("w", 64)
+                != interleave.ScheduleFuzzer(202).decisions("w", 64))
+
+    def test_install_shrinks_switch_interval_and_uninstall_restores(self):
+        import sys
+        before = sys.getswitchinterval()
+        try:
+            interleave.install(7)
+            assert sys.getswitchinterval() == pytest.approx(
+                interleave.FUZZ_SWITCH_INTERVAL)
+            assert locks.get_fuzzer() is not None
+        finally:
+            interleave.uninstall()
+        assert sys.getswitchinterval() == pytest.approx(before)
+        assert locks.get_fuzzer() is None
+
+    def test_fuzzer_injects_yields_through_the_facade(self):
+        try:
+            fuzzer = interleave.install(31, p_yield=1.0, max_sleep_us=1.0)
+            lk = locks.named_lock("test.fuzzed")
+            for _ in range(10):
+                with lk:
+                    pass
+            assert fuzzer.yields >= 10
+        finally:
+            interleave.uninstall()
+
+    @pytest.mark.slow
+    def test_run_seed_full_pass_clean(self):
+        out = interleave.run_seed(101, duration_s=0.2)
+        assert out["scenarios"] == {"store": True, "workqueue": True,
+                                    "inventory": True}
+        assert out["report"].clean, out["report"].render()
+        assert out["yields"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Planner shared-template regression (the reference bug)
+# ---------------------------------------------------------------------------
+
+class TestPlannerTemplateCopy:
+    def _job(self):
+        from kubeflow_controller_tpu.api.tfjob import (
+            ReplicaType, TFJob, TFJobSpec, TFReplicaSpec)
+        from kubeflow_controller_tpu.api.core import (
+            Container, PodTemplateSpec)
+
+        job = TFJob()
+        job.metadata.namespace = "default"
+        job.metadata.name = "tmpl-regress"
+        tmpl = PodTemplateSpec()
+        c = Container(name="tensorflow", command=["python"],
+                      args=["--flag=base"])
+        tmpl.spec.containers.append(c)
+        spec = TFReplicaSpec(tf_replica_type=ReplicaType.WORKER, replicas=3,
+                             template=tmpl)
+        job.spec = TFJobSpec(tf_replica_specs=[spec])
+        return job, spec
+
+    def test_make_pod_leaves_spec_template_untouched(self):
+        """Per-replica arg injection must land on a deep copy: building
+        pods for indices 0..2 leaves the shared template bit-identical
+        (the reference mutated it once per replica, design_doc.md:262-268)."""
+        from kubeflow_controller_tpu.planner.materialize import make_pod
+        from kubeflow_controller_tpu.utils import serde
+
+        job, spec = self._job()
+        before = serde.to_dict(spec.template)
+        pods = [make_pod(job, spec, i) for i in range(3)]
+        assert serde.to_dict(spec.template) == before
+        # and the per-pod wiring really is per-pod, not accumulated
+        args0 = pods[0].spec.containers[0].args
+        args2 = pods[2].spec.containers[0].args
+        assert args0 != args2  # distinct task indices injected
+        assert spec.template.spec.containers[0].args == ["--flag=base"]
+
+    def test_pods_do_not_share_container_objects(self):
+        from kubeflow_controller_tpu.planner.materialize import make_pod
+
+        job, spec = self._job()
+        p0 = make_pod(job, spec, 0)
+        p1 = make_pod(job, spec, 1)
+        assert p0.spec.containers[0] is not p1.spec.containers[0]
+        assert p0.spec.containers[0] is not spec.template.spec.containers[0]
+        p0.spec.containers[0].args.append("--mutate")
+        assert "--mutate" not in p1.spec.containers[0].args
+        assert "--mutate" not in spec.template.spec.containers[0].args

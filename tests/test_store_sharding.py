@@ -20,6 +20,7 @@ from kubeflow_controller_tpu.api.tfjob import ReplicaType, TFJob, TFReplicaSpec
 from kubeflow_controller_tpu.cluster.apiserver import FakeAPIServer
 from kubeflow_controller_tpu.cluster.rest import Kubeconfig, RestCluster
 from kubeflow_controller_tpu.cluster.store import ADDED, ObjectStore
+from kubeflow_controller_tpu.utils import locks
 from kubeflow_controller_tpu.obs.metrics import (
     REGISTRY,
     bucket_quantile,
@@ -72,7 +73,8 @@ class TestShardIndependence:
 
         def slow_patch(meta):
             entered.set()
-            time.sleep(0.5)
+            with locks.blocking_ok():  # deliberate stall under the shard lock
+                time.sleep(0.5)
             meta.labels["patched"] = "yes"
 
         t = threading.Thread(
@@ -101,7 +103,8 @@ class TestShardIndependence:
 
         def slow_patch(meta):
             entered.set()
-            time.sleep(0.4)
+            with locks.blocking_ok():  # deliberate stall under the global lock
+                time.sleep(0.4)
 
         t = threading.Thread(
             target=lambda: s.patch_meta("pods", "default", "p", slow_patch),
@@ -425,7 +428,8 @@ def test_apiserver_parallel_lists_of_different_kinds_do_not_queue():
 
         def slow_patch(meta):
             entered.set()
-            time.sleep(0.6)
+            with locks.blocking_ok():  # deliberate stall under the shard lock
+                time.sleep(0.6)
 
         t = threading.Thread(
             target=lambda: store.patch_meta("tfjobs", "default", "j",
