@@ -4,9 +4,19 @@
 
 PY ?= python
 
-.PHONY: all test test-fast lint typecheck cov cov-local bench dryrun validate vet race-smoke metrics-smoke scale-smoke stall-smoke widejob-smoke churn-smoke store-smoke sched-smoke ttfs-smoke chaos-smoke
+.PHONY: all ci test test-fast lint typecheck cov cov-local bench dryrun validate vet race-smoke check-smoke metrics-smoke scale-smoke stall-smoke widejob-smoke churn-smoke store-smoke sched-smoke ttfs-smoke chaos-smoke
 
-all: lint vet test race-smoke
+all: lint vet test race-smoke check-smoke
+
+# The documented pre-merge gate (README.md): static analysis first (vet,
+# incl. the whole-program lock graph + raw-lock facade enforcement), then
+# the seeded race harness, then the model checkers (linearizability +
+# watch-delivery exactness under deterministic simulation, self-test
+# included), then tier-1 under the runtime lock-order detector.  Run
+# without -j: the order is the diagnosis ladder (cheapest, most precise
+# signal first).
+ci: vet race-smoke check-smoke
+	KCTPU_LOCKCHECK=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m "not slow"
 
 # Fast/slow split: `test-fast` (-m "not slow") is the quick signal — 214 of
 # 259 tests, minutes instead of ~15; the 45 @pytest.mark.slow tests are the
@@ -70,6 +80,20 @@ vet:
 race-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m kubeflow_controller_tpu.analysis.interleave \
 		--seeds 101,202,303 --duration 0.5
+
+# Model-check smoke (`kctpu check`): FIRST the checkers' own known-bad
+# synthetic fixtures must be rejected (stale read, lost update,
+# non-monotonic list RV, duplicate/gapped/reordered watch streams — a
+# checker that stops biting proves nothing), THEN 3 seeded
+# deterministic-simulation passes over the REAL store/watch plane —
+# writers/watchers under schedule fuzz with forced stream drops
+# mid-batch, bounded-queue overflow drops, and watcher crash-points
+# (killed mid-replay, RV-resumed) — must report zero linearizability,
+# RV-monotonicity, or delivery violations.  A red seed prints its exact
+# one-line repro and exports KCTPU_FUZZ_SEED.  ~8 s (docs/ANALYSIS.md).
+check-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m kubeflow_controller_tpu.analysis.simcheck \
+		--self-test --seeds 11,22,33 --duration 0.5
 
 validate:
 	$(PY) -m kubeflow_controller_tpu.cli validate -f examples/jobs/

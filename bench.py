@@ -221,7 +221,8 @@ def run_dist_mnist(trace_dir: str = "") -> dict:
 
 def run_scale(n_jobs: int, deadline_s: float = 0.0,
               settle_s: float = 2.5, heartbeat_s: float = 0.0,
-              store_sharded: bool = True) -> dict:
+              store_sharded: bool = True,
+              record_history: bool = False) -> dict:
     """N concurrent orchestration-bound TFJobs (1 PS + 2 workers each,
     simulated pod phases) from creation to all-Succeeded.  Uses only the
     public controller surface so the same file measures older commits;
@@ -234,7 +235,16 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
 
     ``store_sharded=False`` runs on the global-lock, copy-under-the-lock
     baseline store (``bench.py --scale N --no-shard``) — what the
-    store-contention comparison measures against."""
+    store-contention comparison measures against.
+
+    ``record_history=True`` attaches the linearizability checker's
+    opt-in op recorder to the store and runs the cross-kind RV
+    monotonicity checks over the full controller workload at the end
+    (the per-key WGL pass is skipped: controller histories use
+    finalizer-gated deletes the sequential spec deliberately doesn't
+    model — docs/ANALYSIS.md).  Comparing against a default run measures
+    the recording overhead; with the flag OFF the hook costs nothing,
+    which is the bench gate the hook ships under."""
     from kubeflow_controller_tpu.api.core import Container, PodTemplateSpec
     from kubeflow_controller_tpu.api.meta import ObjectMeta
     from kubeflow_controller_tpu.api.tfjob import (
@@ -258,6 +268,12 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
         return job
 
     cluster = Cluster(store=ObjectStore(sharded=store_sharded))
+    recorder = None
+    if record_history:
+        from kubeflow_controller_tpu.analysis.linearize import HistoryRecorder
+
+        recorder = HistoryRecorder()
+        cluster.store.attach_recorder(recorder)
     kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=0.05,
                                                       heartbeat_s=heartbeat_s))
     ctrl = Controller(cluster, resync_period_s=1.0)
@@ -294,9 +310,21 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
     finally:
         ctrl.stop()
         kubelet.stop()
+    history = None
+    if recorder is not None:
+        from kubeflow_controller_tpu.analysis.linearize import check_records
+
+        cluster.store.detach_recorder()
+        records = recorder.records()
+        violations = check_records(records, per_key=False)
+        history = {
+            "ops_recorded": len(records),
+            "rv_violations": [v.render() for v in violations],
+        }
     return {
         "elapsed_s": elapsed,
         "jobs": n_jobs,
+        "history": history,
         "timed_out": sorted(pending),
         "failed": failed,
         "metrics": snap,
@@ -1631,7 +1659,8 @@ def store_contention_main(args) -> int:
 def scale_main(args) -> int:
     result = run_scale(args.scale, deadline_s=args.deadline,
                        heartbeat_s=args.heartbeat_s,
-                       store_sharded=not args.no_shard)
+                       store_sharded=not args.no_shard,
+                       record_history=args.record_history)
     m = result["metrics"]
     elapsed = result["elapsed_s"]
     gathers = m.get("gather_indexed", 0) + m.get("gather_full_lists", 0)
@@ -1663,6 +1692,7 @@ def scale_main(args) -> int:
             "settle_full_lists": result["settle_full_lists"],
             "settle_window_s": result["settle_s"],
             "heartbeat_s": args.heartbeat_s,
+            "history": result["history"],
             "workload": ("N x (1xPS + 2xWorker) simulated pods "
                          "(PhasePolicy run_s=0.05, no real training): "
                          "pure orchestration throughput"),
@@ -1672,6 +1702,12 @@ def scale_main(args) -> int:
     if not ok:
         print(f"scale bench: {len(result['timed_out'])} timed out, "
               f"{len(result['failed'])} failed", file=sys.stderr)
+        return 1
+    if result["history"] and result["history"]["rv_violations"]:
+        print("scale bench: RV-monotonicity violations under "
+              "--record-history:\n  "
+              + "\n  ".join(result["history"]["rv_violations"]),
+              file=sys.stderr)
         return 1
     if args.max_seconds and elapsed > args.max_seconds:
         print(f"scale bench regression: {elapsed:.3f}s > "
@@ -1829,6 +1865,12 @@ def main(argv=None) -> int:
                    metavar="MS",
                    help="store-contention mode: exit nonzero when the worst "
                         "shard's lock-wait p99 exceeds MS (-1 = no gate)")
+    p.add_argument("--record-history", action="store_true",
+                   help="scale mode: attach the linearizability checker's "
+                        "op recorder to the store and gate cross-kind RV "
+                        "monotonicity over the whole run; compare against "
+                        "a default run to measure recording overhead "
+                        "(off = zero-cost, the hook is not installed)")
     args = p.parse_args(argv)
 
     if args.scale and args.store_contention:

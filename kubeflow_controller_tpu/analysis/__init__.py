@@ -1,12 +1,16 @@
 """Concurrency + controller-invariant analysis plane.
 
-Three layers, all stdlib-only:
+Six layers, all stdlib-only:
 
 - :mod:`.vet` — ``kctpu vet``: AST linter enforcing the project's codified
   invariants (no blocking calls under a lock, no ``copy.deepcopy`` on hot
   paths, no snapshot mutation, ``spec.template`` deep-copied before
-  mutation, threads named+daemonized, metric catalogue in sync, event
-  reason hygiene).
+  mutation, threads named+daemonized, no bare ``threading`` locks outside
+  the facade, metric catalogue in sync, event reason hygiene).
+- :mod:`.lockgraph` — the ``lock-graph`` vet rule: a whole-program STATIC
+  lock graph (intraprocedural summaries + call-graph propagation over the
+  named-lock vocabulary) reporting potential lock-order cycles and
+  blocking-calls-under-lock on paths no test executes.
 - :mod:`.lockcheck` — runtime lock-order detector over the
   ``utils.locks`` facade: per-thread held stacks, a global
   acquisition-order graph with cycle reporting, and held-across-blocking-
@@ -15,4 +19,11 @@ Three layers, all stdlib-only:
   yield injection + switch-interval shrinking driving adversarial
   interleavings through the store/workqueue/scheduler invariants
   (``make race-smoke``).
+- :mod:`.linearize` / :mod:`.watchcheck` — model checkers for the store's
+  consistency contract: Wing–Gong/WGL linearizability over recorded op
+  histories + cross-kind RV monotonicity, and exactly-once / RV-ordered /
+  gap-free watch delivery.
+- :mod:`.simcheck` — ``kctpu check`` / ``make check-smoke``: seeded
+  deterministic-simulation driver running both model checkers against the
+  live store/watch plane under drops and crash-point injection.
 """

@@ -210,7 +210,9 @@ def scenario_workqueue(duration_s: float = 0.6) -> None:
     q = RateLimitingQueue(name="race-smoke")
     stop = threading.Event()
     in_flight: dict = {}
-    mu = threading.Lock()  # scenario-local bookkeeping, not product code
+    # Scenario-local bookkeeping, deliberately raw: fuzzing the assertion
+    # lock would perturb the very schedules under test.
+    mu = threading.Lock()  # kctpu: vet-ok(raw-lock)
 
     def producer(idx: int):
         i = 0
@@ -313,15 +315,21 @@ def run_seed(seed: int, duration_s: float = 0.6,
              scenarios=None) -> dict:
     """One fuzz pass: install fuzzer + lockcheck, run every scenario,
     return {scenario: ok} plus the lockcheck report.  Raises on invariant
-    violations; the caller checks the report for cycles/blocking calls."""
+    violations; the caller checks the report for cycles/blocking calls.
+
+    Everything from the first install onward runs under try/finally: a
+    scenario that raises (the interesting case — that's a repro!) must
+    still restore the switch interval and un-patch the yield injector, or
+    every later test in the process inherits a 10 µs switch interval and
+    a live fuzzer."""
     from . import lockcheck
 
-    fuzzer = install(seed)
     fresh_checker = lockcheck.installed() is None
-    checker = lockcheck.install()
-    checker.reset()  # per-seed report even when the checker is shared
     results = {}
     try:
+        fuzzer = install(seed)
+        checker = lockcheck.install()
+        checker.reset()  # per-seed report even when the checker is shared
         for name, fn in (scenarios or SCENARIOS).items():
             fn(duration_s)
             results[name] = True
@@ -332,6 +340,18 @@ def run_seed(seed: int, duration_s: float = 0.6,
             lockcheck.uninstall()
     return {"seed": seed, "scenarios": results, "yields": fuzzer.yields,
             "report": report}
+
+
+def repro_command(seed: int, duration_s: float,
+                  scenario: Optional[str] = None) -> str:
+    """The one-line reproducer a red run prints: same seed, same
+    perturbation stream."""
+    cmd = (f"KCTPU_FUZZ_SEED={seed} python -m "
+           f"kubeflow_controller_tpu.analysis.interleave "
+           f"--seeds {seed} --duration {duration_s}")
+    if scenario:
+        cmd += f" --scenario {scenario}"
+    return cmd
 
 
 def main(argv=None) -> int:
@@ -349,6 +369,15 @@ def main(argv=None) -> int:
     scenarios = ({args.scenario: SCENARIOS[args.scenario]}
                  if args.scenario else None)
     failed = False
+
+    def red(seed: int) -> None:
+        # Export the failing seed (child processes and wrapper scripts
+        # can pick it up) and print the exact reproducer.
+        import os
+
+        os.environ["KCTPU_FUZZ_SEED"] = str(seed)
+        print(f"repro: {repro_command(seed, args.duration, args.scenario)}")
+
     for seed in seeds:
         # Reproducibility: the decision stream for a seed is a pure
         # function of (seed, thread name) — verify before spending time.
@@ -358,6 +387,7 @@ def main(argv=None) -> int:
             out = run_seed(seed, args.duration, scenarios)
         except AssertionError as e:
             print(f"race-smoke seed={seed}: FAIL: {e}")
+            red(seed)
             failed = True
             continue
         report = out["report"]
@@ -366,7 +396,9 @@ def main(argv=None) -> int:
               f"yields={out['yields']} cycles={len(report.cycles)} "
               f"blocking={len(report.blocking)}"
               + ("" if ok else "\n" + report.render()))
-        failed = failed or not ok
+        if not ok:
+            red(seed)
+            failed = True
     return 1 if failed else 0
 
 

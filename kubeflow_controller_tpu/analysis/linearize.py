@@ -1,0 +1,573 @@
+"""History-based linearizability checker for the :class:`ObjectStore`.
+
+The store promises the controller a *linearizable* per-key contract —
+create/get/update-CAS/delete behave as if every operation took effect
+atomically at some instant between its invocation and its return — plus
+strictly monotonic resourceVersions across ALL kinds (one process-wide
+counter).  PR 5/6 built resume and sharding on top of that contract, the
+WAL/sharded control plane will rebuild the store underneath it; this
+module makes the contract *checked* instead of assumed:
+
+- an **opt-in recording hook** (:meth:`ObjectStore.attach_recorder` +
+  :class:`HistoryRecorder` here) captures concurrent op histories as
+  ``(invoke_ts, return_ts, op, args, result)`` intervals.  The hook is
+  instance-level method wrapping: with recording off the store runs the
+  unmodified class methods — literally zero cost, gated by
+  ``bench.py --scale N --record-history`` staying within noise;
+- a **Wing–Gong / WGL-style search** (:func:`linearize_key`) verifies
+  each per-key history against the sequential spec below, with memoized
+  pruning on (remaining-ops, state) configurations — the standard trick
+  that makes mostly-sequential histories linear-time while still
+  exploring every legal order inside concurrency windows;
+- a **cross-kind RV token check** (:func:`check_rv_tokens`): write RVs
+  are globally unique and strictly increase along real time; LIST
+  collection RVs never run backwards (the "non-monotonic list RV" bug
+  class).
+
+Sequential spec (per key; state = ABSENT or the current resourceVersion):
+
+    create ok        ABSENT -> rv            AlreadyExists needs present
+    get/read rv      needs state == rv       NotFound/absent needs ABSENT
+    update-CAS ok    needs state == expected (None = last-write-wins) -> rv
+    update Conflict  needs present and state != expected
+    rmw ok           needs present -> rv     (patch/patch_meta/progress)
+    delete ok        needs present -> ABSENT NotFound needs ABSENT
+
+Out of scope: finalizer-gated graceful deletion (a delete that leaves the
+object present with an unobserved RV bump) — the simulation driver
+(analysis/simcheck.py) never uses finalizers, and histories recorded from
+workloads that do should only be fed to :func:`check_rv_tokens`.
+
+Known-bad synthetic histories (stale read, lost update, non-monotonic
+list RV, duplicate write RV) live in :data:`KNOWN_BAD`; ``make
+check-smoke`` asserts every one is rejected before trusting a green run.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+#: Ops digested into CAS read-modify-writes with an RV expectation.
+_CAS_OPS = ("update", "update_status")
+#: Ops digested into unconditional read-modify-writes (result carries rv).
+_RMW_OPS = ("patch", "patch_meta", "update_progress", "mark_deleting")
+#: Ops whose success mints a fresh global RV (strict monotonic tokens).
+_WRITE_OPS = ("create",) + _CAS_OPS + _RMW_OPS
+
+
+@dataclass(frozen=True)
+class OpRecord:
+    """One recorded store operation, normalized to scalars at record time
+    (results are caller-owned copies the caller may mutate afterwards)."""
+
+    op: str                      # create|get|update|...|delete|list_with_rv
+    kind: str
+    namespace: Optional[str]
+    name: Optional[str]          # None for list
+    expected_rv: Optional[int]   # CAS expectation (update/update_status)
+    rv: Optional[int]            # new/observed RV; list: collection RV
+    # list only: ((namespace, name, rv), ...) of the returned objects
+    items: Optional[Tuple[Tuple[str, str, int], ...]]
+    selected: bool               # list only: label-selector filtered
+    err: str                     # "" or the APIError subclass name
+    invoke: float
+    ret: float
+    thread: str
+
+    @property
+    def ok(self) -> bool:
+        return not self.err
+
+    def label(self) -> str:
+        where = f"{self.kind}/{self.namespace}/{self.name or '*'}"
+        out = (self.err or
+               (f"rv={self.rv}" if self.rv is not None else "ok"))
+        exp = f" cas={self.expected_rv}" if self.expected_rv is not None else ""
+        return (f"{self.op}({where}){exp} -> {out} "
+                f"[{self.invoke:.6f},{self.ret:.6f}] @{self.thread}")
+
+
+def _int_rv(rv: Any) -> Optional[int]:
+    try:
+        return int(rv)
+    except (TypeError, ValueError):
+        return None
+
+
+class HistoryRecorder:
+    """Thread-safe sink for :meth:`ObjectStore.attach_recorder`.
+
+    ``record`` normalizes each call into an :class:`OpRecord`
+    immediately — the result object belongs to the caller and may be
+    mutated the moment the wrapper returns, so nothing is kept lazily."""
+
+    clock = staticmethod(time.perf_counter)
+
+    def __init__(self):
+        # Raw lock, deliberately NOT a facade lock: the recorder measures
+        # the store's locking behavior and must not feed the lock-order
+        # graph (or the fuzzer) it exists to check.
+        self._mu = threading.Lock()  # kctpu: vet-ok(raw-lock)
+        self._records: List[OpRecord] = []
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._records)
+
+    def records(self) -> List[OpRecord]:
+        with self._mu:
+            return list(self._records)
+
+    def record(self, op: str, args: tuple, kwargs: dict,
+               result: Any, error: Optional[BaseException],
+               t0: float, t1: float) -> None:
+        rec = self._normalize(op, args, kwargs, result, error, t0, t1)
+        if rec is None:
+            return
+        with self._mu:
+            self._records.append(rec)
+
+    def _normalize(self, op, args, kwargs, result, error, t0, t1):
+        err = type(error).__name__ if error is not None else ""
+        thread = threading.current_thread().name
+        kind = args[0] if args else kwargs.get("kind", "?")
+        expected = rv = items = None
+        ns = name = None
+        selected = False
+        if op == "create":
+            obj = args[1] if len(args) > 1 else kwargs.get("obj")
+            meta = obj.metadata
+            ns, name = meta.namespace, meta.name
+            if error is None:
+                m = result.metadata
+                ns, name, rv = m.namespace, m.name, _int_rv(m.resource_version)
+            elif not name:
+                return None  # failed generateName create: key unknowable
+        elif op == "get":
+            ns, name = args[1], args[2]
+            if error is None:
+                rv = _int_rv(result.metadata.resource_version)
+        elif op in _CAS_OPS:
+            obj = args[1] if len(args) > 1 else kwargs.get("obj")
+            meta = obj.metadata
+            ns, name = meta.namespace, meta.name
+            expected = _int_rv(meta.resource_version)
+            if error is None:
+                rv = _int_rv(result.metadata.resource_version)
+        elif op in _RMW_OPS:
+            ns, name = args[1], args[2]
+            if error is None:
+                rv = _int_rv(result.metadata.resource_version)
+        elif op == "delete":
+            ns, name = args[1], args[2]
+        elif op == "list_with_rv":
+            ns = args[1] if len(args) > 1 else kwargs.get("namespace")
+            selector = args[2] if len(args) > 2 else kwargs.get("selector")
+            selected = selector is not None
+            if error is None:
+                objs, coll_rv = result
+                rv = _int_rv(coll_rv)
+                items = tuple(
+                    (o.metadata.namespace, o.metadata.name,
+                     _int_rv(o.metadata.resource_version)) for o in objs)
+        else:
+            return None
+        return OpRecord(op=op, kind=kind, namespace=ns, name=name,
+                        expected_rv=expected, rv=rv, items=items,
+                        selected=selected, err=err, invoke=t0, ret=t1,
+                        thread=thread)
+
+
+# ---------------------------------------------------------------------------
+# Digestion: raw records -> per-key interval ops + global RV tokens
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class KeyOp:
+    """One interval op against a single (kind, namespace, name) key, in
+    the normalized per-key vocabulary the sequential spec speaks."""
+
+    kind: str                 # create|read|cas|rmw|delete
+    expected: Optional[int]   # cas only
+    rv: Optional[int]         # read: observed (None = absent); writes: new
+    ok: bool
+    err: str
+    invoke: float
+    ret: float
+    label: str
+
+
+def _key_op(rec: OpRecord, kind: str, rv=None, expected=None,
+            label: Optional[str] = None) -> KeyOp:
+    return KeyOp(kind=kind, expected=expected, rv=rv, ok=rec.ok,
+                 err=rec.err, invoke=rec.invoke, ret=rec.ret,
+                 label=label or rec.label())
+
+
+def build_key_histories(
+        records: Sequence[OpRecord]) -> Dict[tuple, List[KeyOp]]:
+    """Group records into per-key histories.  LIST ops are decomposed into
+    per-key read observations sharing the list's interval: presence of
+    (name, rv) observes ``read rv``; absence of a key the history knows
+    about (same kind, namespace in the list's scope, no selector) observes
+    ``read ABSENT``."""
+    known: Dict[str, set] = {}  # kind -> {(ns, name)}
+    for r in records:
+        if r.name is not None:
+            known.setdefault(r.kind, set()).add((r.namespace, r.name))
+        if r.items:
+            for ns, name, _ in r.items:
+                known.setdefault(r.kind, set()).add((ns, name))
+    out: Dict[tuple, List[KeyOp]] = {}
+
+    def add(kind, ns, name, op: KeyOp):
+        out.setdefault((kind, ns, name), []).append(op)
+
+    for r in records:
+        if r.op == "create":
+            add(r.kind, r.namespace, r.name, _key_op(r, "create", rv=r.rv))
+        elif r.op == "get":
+            add(r.kind, r.namespace, r.name, _key_op(
+                r, "read", rv=None if r.err == "NotFound" else r.rv))
+        elif r.op in _CAS_OPS:
+            add(r.kind, r.namespace, r.name,
+                _key_op(r, "cas", rv=r.rv, expected=r.expected_rv))
+        elif r.op in _RMW_OPS:
+            add(r.kind, r.namespace, r.name, _key_op(r, "rmw", rv=r.rv))
+        elif r.op == "delete":
+            add(r.kind, r.namespace, r.name, _key_op(r, "delete"))
+        elif r.op == "list_with_rv" and r.ok:
+            present = set()
+            for ns, name, rv in r.items or ():
+                present.add((ns, name))
+                add(r.kind, ns, name, _key_op(
+                    r, "read", rv=rv,
+                    label=f"list-observes rv={rv} {r.label()}"))
+            if r.selected:
+                continue  # selector may exclude: no absence evidence
+            for ns, name in known.get(r.kind, ()):
+                if (ns, name) in present:
+                    continue
+                if r.namespace is not None and ns != r.namespace:
+                    continue
+                add(r.kind, ns, name, _key_op(
+                    r, "read", rv=None,
+                    label=f"list-observes absent {r.label()}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Sequential spec + WGL search
+# ---------------------------------------------------------------------------
+
+#: Spec rejection sentinel (never a legal state).
+_INVALID = object()
+#: Per-key "object absent" state (present = the int resourceVersion).
+ABSENT = None
+
+
+def apply_op(state, op: KeyOp):
+    """The store's per-key sequential spec: next state, or ``_INVALID``
+    when ``op``'s outcome is impossible from ``state``."""
+    k = op.kind
+    if k == "create":
+        if op.ok:
+            return op.rv if state is ABSENT else _INVALID
+        if op.err == "AlreadyExists":
+            return state if state is not ABSENT else _INVALID
+        return state  # Invalid etc.: no state evidence
+    if k == "read":
+        if op.rv is None:
+            return state if state is ABSENT else _INVALID
+        return state if state == op.rv else _INVALID
+    if k == "cas":
+        if op.ok:
+            if state is ABSENT:
+                return _INVALID
+            if op.expected is not None and state != op.expected:
+                return _INVALID
+            return op.rv
+        if op.err == "Conflict":
+            ok = (state is not ABSENT and op.expected is not None
+                  and state != op.expected)
+            return state if ok else _INVALID
+        if op.err == "NotFound":
+            return state if state is ABSENT else _INVALID
+        return state
+    if k == "rmw":
+        if op.ok:
+            return op.rv if state is not ABSENT else _INVALID
+        if op.err == "NotFound":
+            return state if state is ABSENT else _INVALID
+        return state
+    if k == "delete":
+        if op.ok:
+            return ABSENT if state is not ABSENT else _INVALID
+        if op.err == "NotFound":
+            return state if state is ABSENT else _INVALID
+        return state
+    raise ValueError(f"unknown key-op kind {k!r}")
+
+
+class SearchBudgetExceeded(Exception):
+    """The WGL search explored more configurations than allowed — shrink
+    the history (shorter run / wider keyspace), don't trust the result."""
+
+
+@dataclass
+class KeyResult:
+    key: tuple
+    ok: bool
+    n_ops: int
+    witness: Optional[List[KeyOp]] = None
+    best_prefix: int = 0
+    pending: List[KeyOp] = field(default_factory=list)
+
+    def message(self) -> str:
+        kind, ns, name = self.key
+        lines = [f"{kind}/{ns}/{name}: no linearization of {self.n_ops} ops "
+                 f"(longest valid prefix {self.best_prefix})"]
+        for op in self.pending[:6]:
+            lines.append(f"  pending: {op.label}")
+        return "\n".join(lines)
+
+
+def linearize_key(ops: Sequence[KeyOp], key: tuple = ("?", "?", "?"),
+                  max_configs: int = 2_000_000) -> KeyResult:
+    """Wing–Gong/WGL search with memoized pruning: find any total order of
+    ``ops`` that (a) respects real-time precedence (A.ret < B.invoke means
+    A before B) and (b) the sequential spec accepts.  Memoizes visited
+    (remaining-set, state) configurations so a failed subtree is never
+    re-explored from another path — the pruning that keeps near-sequential
+    histories linear."""
+    n = len(ops)
+    if n == 0:
+        return KeyResult(key, True, 0, witness=[])
+    order = sorted(range(n), key=lambda i: (ops[i].invoke, ops[i].ret))
+    ops = [ops[i] for i in order]
+    invoke = [o.invoke for o in ops]
+    ret = [o.ret for o in ops]
+    full = (1 << n) - 1
+
+    def candidates(mask: int) -> List[int]:
+        # Minimal ops: no other remaining op returned before their invoke.
+        rem, m = [], None
+        mm = mask
+        while mm:
+            b = mm & -mm
+            i = b.bit_length() - 1
+            rem.append(i)
+            if m is None or ret[i] < m:
+                m = ret[i]
+            mm ^= b
+        return [i for i in rem if invoke[i] <= m]
+
+    seen = {(full, ABSENT)}
+    stack = [(full, ABSENT, iter(candidates(full)))]
+    path: List[int] = []
+    best_prefix, best_mask = 0, full
+    budget = max_configs
+    while stack:
+        mask, state, it = stack[-1]
+        advanced = False
+        for i in it:
+            nstate = apply_op(state, ops[i])
+            if nstate is _INVALID:
+                continue
+            nmask = mask & ~(1 << i)
+            cfg = (nmask, nstate)
+            if cfg in seen:
+                continue
+            seen.add(cfg)
+            budget -= 1
+            if budget <= 0:
+                raise SearchBudgetExceeded(
+                    f"{key}: >{max_configs} configurations over {n} ops")
+            path.append(i)
+            if len(path) > best_prefix:
+                best_prefix, best_mask = len(path), nmask
+            if nmask == 0:
+                return KeyResult(key, True, n, witness=[ops[j] for j in path])
+            stack.append((nmask, nstate, iter(candidates(nmask))))
+            advanced = True
+            break
+        if not advanced:
+            stack.pop()
+            if stack:
+                path.pop()
+    pending = [ops[i] for i in range(n) if best_mask & (1 << i)]
+    pending.sort(key=lambda o: o.invoke)
+    return KeyResult(key, False, n, best_prefix=best_prefix, pending=pending)
+
+
+# ---------------------------------------------------------------------------
+# Cross-kind RV monotonicity
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Violation:
+    checker: str   # "linearizability" | "rv-monotonicity"
+    scope: str     # key or token description
+    message: str
+
+    def render(self) -> str:
+        return f"[{self.checker}] {self.scope}: {self.message}"
+
+
+def check_rv_tokens(records: Sequence[OpRecord]) -> List[Violation]:
+    """Global (cross-kind) RV discipline over the whole history:
+
+    - every successful write's RV is globally unique;
+    - a write that begins after another token returned carries a strictly
+      greater RV (the process-wide counter only moves forward);
+    - a LIST's collection RV is >= every token that fully preceded it.
+    """
+    out: List[Violation] = []
+    tokens = []  # (invoke, ret, value, strict, label)
+    write_rvs: Dict[int, str] = {}
+    for r in records:
+        if not r.ok or r.rv is None:
+            continue
+        if r.op in _WRITE_OPS:
+            if r.op == "mark_deleting":
+                # May return the unchanged object (already deleting):
+                # its RV is an observation, not a freshly minted token.
+                continue
+            prev = write_rvs.get(r.rv)
+            if prev is not None:
+                out.append(Violation(
+                    "rv-monotonicity", f"rv={r.rv}",
+                    f"duplicate write RV: {prev} and {r.label()}"))
+            else:
+                write_rvs[r.rv] = r.label()
+            tokens.append((r.invoke, r.ret, r.rv, True, r.label()))
+        elif r.op == "list_with_rv":
+            tokens.append((r.invoke, r.ret, r.rv, False, r.label()))
+    tokens.sort(key=lambda t: t[0])
+    by_ret = sorted(tokens, key=lambda t: t[1])
+    frontier = None  # (value, label) with max value among returned tokens
+    j = 0
+    for invoke, _ret, value, strict, lab in tokens:
+        while j < len(by_ret) and by_ret[j][1] < invoke:
+            _, _, v, _, vlab = by_ret[j]
+            if frontier is None or v > frontier[0]:
+                frontier = (v, vlab)
+            j += 1
+        if frontier is None:
+            continue
+        fval, flab = frontier
+        if (value < fval) or (strict and value == fval):
+            out.append(Violation(
+                "rv-monotonicity", f"rv={value}",
+                f"RV ran backwards: {lab} began after {flab} returned"))
+    return out
+
+
+def check_records(records: Sequence[OpRecord],
+                  max_configs: int = 2_000_000,
+                  per_key: bool = True) -> List[Violation]:
+    """The full check: cross-kind RV tokens, then a WGL linearization per
+    key.  ``per_key=False`` (bench histories with unmodeled write paths,
+    e.g. finalizer-gated deletes) keeps only the token checks."""
+    out = check_rv_tokens(records)
+    if not per_key:
+        return out
+    for key, ops in sorted(build_key_histories(records).items()):
+        res = linearize_key(ops, key=key, max_configs=max_configs)
+        if not res.ok:
+            out.append(Violation("linearizability", "/".join(key),
+                                 res.message()))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Known-bad / known-good synthetic histories (the self-test fixtures)
+# ---------------------------------------------------------------------------
+
+def _rec(op: str, name: Optional[str] = "a", *, kind: str = "pods",
+         ns: str = "default", expected=None, rv=None, items=None,
+         err: str = "", t=(0.0, 1.0), thread: str = "t0") -> OpRecord:
+    return OpRecord(op=op, kind=kind, namespace=ns, name=name,
+                    expected_rv=expected, rv=rv, items=items,
+                    selected=False, err=err, invoke=t[0], ret=t[1],
+                    thread=thread)
+
+
+#: Histories the checker MUST reject (make check-smoke gates on this —
+#: a checker that stops rejecting these proves nothing with a green run).
+KNOWN_BAD: Dict[str, List[OpRecord]] = {
+    # get returns rv=1 after the CAS to rv=2 completed: a stale read.
+    "stale-read": [
+        _rec("create", rv=1, t=(0, 1)),
+        _rec("update", expected=1, rv=2, t=(2, 3)),
+        _rec("get", rv=1, t=(4, 5)),
+    ],
+    # Two overlapping CAS updates with the same expectation both succeed.
+    "lost-update": [
+        _rec("create", rv=1, t=(0, 1)),
+        _rec("update", expected=1, rv=2, t=(2, 6), thread="w1"),
+        _rec("update", expected=1, rv=3, t=(3, 7), thread="w2"),
+    ],
+    # Sequential LISTs whose collection RV runs backwards.
+    "non-monotonic-list-rv": [
+        _rec("list_with_rv", None, items=(), rv=5, t=(0, 1)),
+        _rec("list_with_rv", None, items=(), rv=3, t=(2, 3)),
+    ],
+    # The global counter minted one RV twice (across kinds).
+    "duplicate-write-rv": [
+        _rec("create", "a", kind="pods", rv=7, t=(0, 1)),
+        _rec("create", "b", kind="services", rv=7, t=(2, 3)),
+    ],
+    # A read observes an object the (completed) delete already removed.
+    "read-after-delete": [
+        _rec("create", rv=1, t=(0, 1)),
+        _rec("delete", t=(2, 3)),
+        _rec("get", rv=1, t=(4, 5)),
+    ],
+    # LIST snapshot misses a key whose create completed before it began.
+    "list-gap": [
+        _rec("create", "a", rv=1, t=(0, 1)),
+        _rec("create", "b", rv=2, t=(2, 3)),
+        _rec("list_with_rv", None, items=(("default", "a", 1),), rv=4,
+             t=(4, 5)),
+    ],
+}
+
+#: A genuinely concurrent but linearizable history: overlapping CAS where
+#: exactly one wins, the loser Conflicts, reads see a legal serialization.
+KNOWN_GOOD: Dict[str, List[OpRecord]] = {
+    "cas-winner-loser": [
+        _rec("create", rv=1, t=(0, 1)),
+        _rec("update", expected=1, rv=2, t=(2, 6), thread="w1"),
+        _rec("update", expected=1, err="Conflict", t=(3, 7), thread="w2"),
+        _rec("get", rv=2, t=(8, 9)),
+        _rec("delete", t=(10, 11)),
+        _rec("get", err="NotFound", t=(12, 13)),
+    ],
+    "overlapping-create-read": [
+        _rec("create", rv=3, t=(0, 4)),
+        # Read overlaps the create: both "absent" and "rv=3" are legal...
+        _rec("get", rv=3, t=(1, 5)),
+        # ...and a second racer's AlreadyExists pins create-before-it.
+        _rec("create", err="AlreadyExists", t=(2, 6), thread="w2"),
+    ],
+}
+
+
+def self_test() -> List[str]:
+    """Run the checker against its own fixtures; returns failure messages
+    (empty = the checker still distinguishes good from bad)."""
+    failures = []
+    for name, hist in KNOWN_BAD.items():
+        if not check_records(hist):
+            failures.append(f"known-bad history {name!r} was ACCEPTED")
+    for name, hist in KNOWN_GOOD.items():
+        got = check_records(hist)
+        if got:
+            failures.append(
+                f"known-good history {name!r} was rejected: "
+                + "; ".join(v.render() for v in got))
+    return failures

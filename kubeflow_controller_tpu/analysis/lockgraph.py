@@ -1,0 +1,765 @@
+"""Whole-program static lock graph — the ``lock-graph`` rule in
+``kctpu vet``.
+
+The runtime detector (analysis/lockcheck.py) only verifies lock
+discipline on paths the test suite *executes*; this is its static
+complement: an intraprocedural-summary + call-graph analysis over the
+same named-lock vocabulary (utils/locks.py role names) that reports
+*potential* lock-order cycles and blocking-calls-under-lock on paths no
+test ever runs.
+
+How it works (all stdlib ``ast``, shared :class:`vet.FileContext`):
+
+1. **Vocabulary.**  Every ``locks.named_lock("role")`` /
+   ``named_rlock`` / ``named_condition`` creation is resolved to its
+   role name (f-string names collapse to their literal prefix + ``*``,
+   e.g. ``store.shard:*`` — the same per-role collapsing the runtime
+   graph does by keying on names).  Bindings are tracked for
+   ``self.attr = ...`` (per class, including one level of constructor
+   argument propagation, so ``_Shard(kind, named_rlock(...))`` gives
+   ``_Shard.lock`` its names), module globals, and locals.
+2. **Summaries.**  Each function is walked once, lexically tracking the
+   held-lock set through ``with`` statements whose context resolves to
+   the vocabulary (including ``with obj:`` where ``obj``'s class has a
+   lock-acquiring ``__enter__``).  The summary records direct
+   acquisitions, direct nesting edges, direct blocking calls (the
+   ``lock-blocking-call`` vocabulary), and every call site with the
+   held set at the call.
+3. **Propagation.**  A fixpoint over the call graph computes each
+   function's transitive acquire-set and transitive blocking calls;
+   call sites then contribute ``held x acquires(callee)`` edges and
+   blocking findings.  Calls are resolved conservatively: ``self.m()``
+   by class (with base-class walk), ``mod.f()`` by import alias, bare
+   names per module, and ``obj.m()`` only when ``obj``'s class was
+   locally inferred or the method name is project-unique — an
+   *under*-approximation by design (a missed edge is the runtime
+   detector's job; a fabricated edge would drown the report in noise).
+4. **Findings.**  Cycles in the name-keyed edge graph (via
+   ``lockcheck.find_cycles``) and blocking calls reachable with a
+   non-``allow_blocking`` lock held.  Suppress with
+   ``# kctpu: vet-ok(lock-graph)`` on the acquisition/call/blocking
+   line — plus a justification comment, per docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .lockcheck import find_cycles
+from .vet import FileContext, Finding, LockBlockingCallRule, Rule, _tail_name
+
+RULE = "lock-graph"
+
+_NAMED_LOCK_CTORS = {"named_lock", "named_rlock", "NamedLock", "NamedRLock"}
+_COND_CTOR = "named_condition"
+
+
+def _module_of(path: str) -> str:
+    return os.path.basename(path)[:-3] if path.endswith(".py") else path
+
+
+def _name_from_arg(arg: ast.AST) -> Optional[str]:
+    """A lock role name from the ctor's first argument: literal, or the
+    literal prefix of an f-string + '*' (matching how the runtime graph
+    collapses per-instance names onto roles)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.JoinedStr):
+        prefix = ""
+        for part in arg.values:
+            if isinstance(part, ast.Constant) and isinstance(part.value, str):
+                prefix += part.value
+            else:
+                break
+        return prefix + "*"
+    return None
+
+
+class LockSet:
+    """A resolved set of role names + whether they are allow_blocking."""
+
+    __slots__ = ("names", "allow_blocking")
+
+    def __init__(self, names: Set[str], allow_blocking: bool = False):
+        self.names = names
+        self.allow_blocking = allow_blocking
+
+    def merge(self, other: "LockSet") -> "LockSet":
+        return LockSet(self.names | other.names,
+                       self.allow_blocking and other.allow_blocking)
+
+
+def _ctor_lockset(call: ast.Call) -> Optional[LockSet]:
+    tail = _tail_name(call.func)
+    if tail in _NAMED_LOCK_CTORS:
+        name = _name_from_arg(call.args[0]) if call.args else None
+        if name is None:
+            return None
+        allow = any(kw.arg == "allow_blocking"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True for kw in call.keywords)
+        return LockSet({name}, allow)
+    return None
+
+
+def _walk_skipping_defs(node: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk over ``node``'s subtree that does not descend into nested
+    function/lambda bodies (deferred execution: not part of this
+    function's lock context).  The root itself is never skipped."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+#: Method names too generic for the unique-definition fallback: a project
+#: class defining one of these must not swallow every stdlib call of the
+#: same name (``stop_event.set()`` is not ``Gauge.set``).
+_GENERIC_METHODS = frozenset({
+    "set", "get", "put", "add", "pop", "run", "stop", "start", "next",
+    "send", "close", "join", "wait", "clear", "count", "index", "read",
+    "write", "items", "keys", "values", "update", "append", "remove",
+    "insert", "extend", "copy", "flush", "release", "acquire", "render",
+    "reset", "done", "result", "submit", "shutdown", "notify", "match",
+    "search", "group", "encode", "decode", "strip", "split",
+})
+
+
+class _Class:
+    def __init__(self, module: str, name: str, node: ast.ClassDef, path: str):
+        self.key = (module, name)
+        self.name = name
+        self.node = node
+        self.path = path
+        self.bases = [_tail_name(b) for b in node.bases]
+        self.methods: Dict[str, "_Func"] = {}
+        self.attr_locks: Dict[str, LockSet] = {}
+        # __init__ params that are stored into attrs: param name -> attr.
+        self.param_attrs: Dict[str, str] = {}
+        self.init_params: List[str] = []
+
+
+class _Func:
+    def __init__(self, module: str, cls: Optional[_Class], name: str,
+                 node: ast.AST, ctx: FileContext):
+        self.module = module
+        self.cls = cls
+        self.name = name
+        self.node = node
+        self.ctx = ctx
+        self.key = (module, cls.name if cls else None, name)
+        self.returns_cls: Optional[str] = None  # class NAME constructed+returned
+        # (role, allow_blocking, line)
+        self.direct_acquires: List[Tuple[str, bool, int]] = []
+        # (held_role, acquired_role) -> (path, line)
+        self.direct_edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        # every blocking call in this function: (what, line)
+        self.blocking: List[Tuple[str, int]] = []
+        # blocking calls lexically under a held vocabulary lock:
+        # (what, held_strict_names, line)
+        self.blocking_under: List[Tuple[str, Tuple[str, ...], int]] = []
+        # call sites: (ref descriptor, held tuple of (role, allow), line)
+        self.calls: List[Tuple[tuple, Tuple[Tuple[str, bool], ...], int]] = []
+        # resolved after indexing:
+        self.callees: List[Tuple["_Func", Tuple[Tuple[str, bool], ...], int]] = []
+        self.trans_acquires: Set[Tuple[str, bool]] = set()
+        # representative transitive blocking sites: what -> (path, line)
+        self.trans_blocking: Dict[str, Tuple[str, int]] = {}
+
+
+class LockGraph:
+    """Accumulates files (``add_file``) then analyzes (``findings``)."""
+
+    def __init__(self):
+        self.files: List[FileContext] = []
+        self.classes: Dict[Tuple[str, str], _Class] = {}
+        self.class_names: Dict[str, List[_Class]] = {}
+        self.funcs: Dict[tuple, _Func] = {}
+        self.module_funcs: Dict[Tuple[str, str], _Func] = {}
+        self.method_names: Dict[str, List[_Func]] = {}
+        self.module_locks: Dict[Tuple[str, str], LockSet] = {}
+        # per-file import alias -> module basename
+        self.imports: Dict[str, Dict[str, str]] = {}
+        self._blocking_probe = LockBlockingCallRule()
+
+    # -- pass A: collection ---------------------------------------------------
+
+    def add_file(self, ctx: FileContext) -> None:
+        self.files.append(ctx)
+        module = _module_of(ctx.path)
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[-1]] = \
+                        a.name.split(".")[-1]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    aliases[a.asname or a.name] = a.name
+        self.imports[ctx.path] = aliases
+        for node in ctx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._add_class(module, node, ctx)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = _Func(module, None, node.name, node, ctx)
+                self.funcs[fn.key] = fn
+                self.module_funcs[(module, node.name)] = fn
+            elif isinstance(node, ast.Assign):
+                ls = self._resolve_lock_expr(node.value, module, None, {})
+                if ls is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.module_locks[(module, t.id)] = ls
+
+    def _add_class(self, module: str, node: ast.ClassDef,
+                   ctx: FileContext) -> None:
+        cls = _Class(module, node.name, node, ctx.path)
+        self.classes[cls.key] = cls
+        self.class_names.setdefault(cls.name, []).append(cls)
+        for sub in node.body:
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = _Func(module, cls, sub.name, sub, ctx)
+                cls.methods[sub.name] = fn
+                self.funcs[fn.key] = fn
+                self.method_names.setdefault(sub.name, []).append(fn)
+            elif isinstance(sub, ast.AnnAssign) and isinstance(
+                    sub.target, ast.Name) and sub.value is not None:
+                # dataclass field(default_factory=lambda: named_lock(...))
+                ls = self._dataclass_field_lockset(module, sub.value)
+                if ls is not None:
+                    cls.attr_locks[sub.target.id] = ls
+
+    def _dataclass_field_lockset(self, module: str,
+                                 value: ast.AST) -> Optional[LockSet]:
+        if not (isinstance(value, ast.Call)
+                and _tail_name(value.func) == "field"):
+            return None
+        for kw in value.keywords:
+            if kw.arg == "default_factory" and isinstance(kw.value, ast.Lambda):
+                return self._resolve_lock_expr(kw.value.body, module, None, {})
+        return None
+
+    # -- lock-expression resolution ------------------------------------------
+
+    def _resolve_lock_expr(self, expr: ast.AST, module: str,
+                           cls: Optional[_Class],
+                           local_locks: Dict[str, LockSet]) -> Optional[LockSet]:
+        """Resolve an expression to the named locks it denotes, or None.
+        ``module``/``cls``/``local_locks`` give binding context for
+        attribute / global / local references inside the expression."""
+        if isinstance(expr, ast.Call):
+            ls = _ctor_lockset(expr)
+            if ls is not None:
+                return ls
+            if _tail_name(expr.func) == _COND_CTOR:
+                # named_condition(name, lock): the condition acquires the
+                # given lock when present, else a fresh lock of `name`.
+                lock_arg = (expr.args[1] if len(expr.args) > 1 else
+                            next((kw.value for kw in expr.keywords
+                                  if kw.arg == "lock"), None))
+                if lock_arg is not None and not (
+                        isinstance(lock_arg, ast.Constant)
+                        and lock_arg.value is None):
+                    return self._resolve_lock_expr(lock_arg, module, cls,
+                                                   local_locks)
+                name = _name_from_arg(expr.args[0]) if expr.args else None
+                return LockSet({name}) if name else None
+            return None
+        if isinstance(expr, ast.BoolOp):
+            out: Optional[LockSet] = None
+            for operand in expr.values:
+                ls = self._resolve_lock_expr(operand, module, cls, local_locks)
+                if ls is not None:
+                    out = ls if out is None else out.merge(ls)
+            return out
+        if isinstance(expr, ast.IfExp):
+            a = self._resolve_lock_expr(expr.body, module, cls, local_locks)
+            b = self._resolve_lock_expr(expr.orelse, module, cls, local_locks)
+            if a and b:
+                return a.merge(b)
+            return a or b
+        if isinstance(expr, ast.Name):
+            if expr.id in local_locks:
+                return local_locks[expr.id]
+            return self.module_locks.get((module, expr.id))
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                    and cls is not None:
+                return self._class_attr_lock(cls, expr.attr)
+            # Unknown receiver: unique-attribute fallback across classes.
+            owners = [c for c in self.classes.values()
+                      if expr.attr in c.attr_locks]
+            if len(owners) == 1:
+                return owners[0].attr_locks[expr.attr]
+            return None
+        return None
+
+    def _class_attr_lock(self, cls: _Class, attr: str) -> Optional[LockSet]:
+        seen = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop()
+            if c.key in seen:
+                continue
+            seen.add(c.key)
+            if attr in c.attr_locks:
+                return c.attr_locks[attr]
+            for base in c.bases:
+                for bc in self.class_names.get(base, ()):
+                    stack.append(bc)
+        return None
+
+    # -- pass B: binding resolution ------------------------------------------
+
+    def _collect_bindings(self) -> None:
+        # B1: self.attr = <lock expr> inside methods, plus __init__
+        # param -> attr plumbing for B2.  Two passes so an attr referencing
+        # an earlier attr (named_condition over self._lock) resolves
+        # regardless of AST visit order.
+        for _pass in range(2):
+            self._collect_attr_bindings()
+        # B2/B3 below.
+        self._collect_ctor_and_returns()
+
+    def _collect_attr_bindings(self) -> None:
+        for cls in self.classes.values():
+            for mname, fn in cls.methods.items():
+                args = [a.arg for a in fn.node.args.args]
+                if mname == "__init__":
+                    cls.init_params = args
+                local: Dict[str, LockSet] = {}
+                for stmt in _walk_skipping_defs(fn.node):
+                    if not isinstance(stmt, ast.Assign):
+                        continue
+                    ls = self._resolve_lock_expr(stmt.value, fn.module, cls,
+                                                 local)
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Attribute) and isinstance(
+                                t.value, ast.Name) and t.value.id == "self":
+                            if ls is not None:
+                                cls.attr_locks[t.attr] = ls
+                            elif (mname == "__init__"
+                                  and isinstance(stmt.value, ast.Name)
+                                  and stmt.value.id in args):
+                                cls.param_attrs[stmt.value.id] = t.attr
+                        elif isinstance(t, ast.Name) and ls is not None:
+                            local[t.id] = ls
+
+    def _collect_ctor_and_returns(self) -> None:
+        # B2: constructor-argument propagation: ClsName(.., <lock expr>).
+        for fn in self.funcs.values():
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                tail = _tail_name(node.func)
+                targets = [c for c in self.class_names.get(tail, ())
+                           if c.param_attrs]
+                if len(targets) != 1:
+                    continue
+                tcls = targets[0]
+                params = tcls.init_params[1:]  # drop self
+                for i, arg in enumerate(node.args):
+                    pname = params[i] if i < len(params) else None
+                    self._maybe_ctor_lock(tcls, pname, arg, fn)
+                for kw in node.keywords:
+                    self._maybe_ctor_lock(tcls, kw.arg, kw.value, fn)
+        # B3: returned-class inference (v = Cls(...); return v).
+        for fn in self.funcs.values():
+            constructed: Dict[str, str] = {}
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Assign) and isinstance(
+                        node.value, ast.Call):
+                    tail = _tail_name(node.value.func)
+                    if tail in self.class_names:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                constructed[t.id] = tail
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    if isinstance(node.value, ast.Name):
+                        got = constructed.get(node.value.id)
+                        if got:
+                            fn.returns_cls = got
+                    elif isinstance(node.value, ast.Call):
+                        tail = _tail_name(node.value.func)
+                        if tail in self.class_names:
+                            fn.returns_cls = tail
+
+    def _maybe_ctor_lock(self, tcls: _Class, pname: Optional[str],
+                         arg: ast.AST, site_fn: _Func) -> None:
+        if pname is None:
+            return
+        attr = tcls.param_attrs.get(pname)
+        if attr is None:
+            return
+        ls = self._resolve_lock_expr(arg, site_fn.module, site_fn.cls, {})
+        if ls is None:
+            return
+        prev = tcls.attr_locks.get(attr)
+        tcls.attr_locks[attr] = ls if prev is None else prev.merge(ls)
+
+    # -- pass C: function summaries ------------------------------------------
+
+    def _class_of_name(self, name: Optional[str]) -> Optional[_Class]:
+        if name is None:
+            return None
+        cands = self.class_names.get(name, ())
+        return cands[0] if len(cands) == 1 else None
+
+    def _enter_lockset(self, cls_name: str) -> Optional[LockSet]:
+        """The locks a class's __enter__ acquires directly (for
+        ``with obj:`` held-set extension)."""
+        cls = self._class_of_name(cls_name)
+        if cls is None:
+            return None
+        enter = cls.methods.get("__enter__")
+        if enter is None:
+            return None
+        out: Optional[LockSet] = None
+        local: Dict[str, LockSet] = {}
+        for node in ast.walk(enter.node):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and node.func.attr == "acquire":
+                ls = self._resolve_lock_expr(node.func.value,
+                                             cls.key[0], cls, local)
+                if ls is not None:
+                    out = ls if out is None else out.merge(ls)
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    ls = self._resolve_lock_expr(item.context_expr,
+                                                 cls.key[0], cls, local)
+                    if ls is not None:
+                        out = ls if out is None else out.merge(ls)
+        return out
+
+    def _summarize(self, fn: _Func) -> None:
+        cls = fn.cls
+        ctx = fn.ctx
+        local_locks: Dict[str, LockSet] = {}
+        local_classes: Dict[str, str] = {}
+
+        def resolve_receiver_cls(expr: ast.AST) -> Optional[str]:
+            if isinstance(expr, ast.Name):
+                return local_classes.get(expr.id)
+            if isinstance(expr, ast.Call):
+                tail = _tail_name(expr.func)
+                if tail in self.class_names:
+                    return tail
+                callee = self._resolve_call(fn, expr, ())
+                if callee is not None and len(callee) == 1 \
+                        and callee[0].returns_cls:
+                    return callee[0].returns_cls
+            return None
+
+        def scan_calls(node: ast.AST, held) -> None:
+            for sub in _walk_skipping_defs(node):
+                if isinstance(sub, ast.Call):
+                    self._record_call(fn, sub, held)
+
+        def walk(stmts: Sequence[ast.stmt], held) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue  # nested defs: separate execution context
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    acquired = []
+                    for item in stmt.items:
+                        ce = item.context_expr
+                        ls = self._resolve_lock_expr(ce, fn.module, cls,
+                                                     local_locks)
+                        if ls is None:
+                            rcls = resolve_receiver_cls(ce)
+                            if rcls is not None:
+                                ls = self._enter_lockset(rcls)
+                        if ls is not None:
+                            for role in sorted(ls.names):
+                                fn.direct_acquires.append(
+                                    (role, ls.allow_blocking, stmt.lineno))
+                                for held_role, _allow in held:
+                                    if held_role != role:
+                                        fn.direct_edges.setdefault(
+                                            (held_role, role),
+                                            (ctx.path, stmt.lineno))
+                                acquired.append((role, ls.allow_blocking))
+                        # the context expr itself may call things
+                        scan_calls(ce, tuple(held))
+                    walk(stmt.body, tuple(list(held) + acquired))
+                    continue
+                if isinstance(stmt, (ast.If, ast.While)):
+                    scan_calls(stmt.test, held)
+                    walk(stmt.body, held)
+                    walk(stmt.orelse, held)
+                    continue
+                if isinstance(stmt, ast.For):
+                    scan_calls(stmt.iter, held)
+                    walk(stmt.body, held)
+                    walk(stmt.orelse, held)
+                    continue
+                if isinstance(stmt, ast.Try):
+                    walk(stmt.body, held)
+                    for h in stmt.handlers:
+                        walk(h.body, held)
+                    walk(stmt.orelse, held)
+                    walk(stmt.finalbody, held)
+                    continue
+                # Simple statement: locals bookkeeping + call scan.
+                if isinstance(stmt, ast.Assign):
+                    ls = self._resolve_lock_expr(stmt.value, fn.module,
+                                                 cls, local_locks)
+                    rcls = resolve_receiver_cls(stmt.value)
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            if ls is not None:
+                                local_locks[t.id] = ls
+                            if rcls is not None:
+                                local_classes[t.id] = rcls
+                scan_calls(stmt, held)
+
+        walk(fn.node.body, ())
+
+    def _record_call(self, fn: _Func, call: ast.Call, held) -> None:
+        ctx = fn.ctx
+        # blocking?
+        what = self._blocking_probe._blocking(ctx, call)
+        if what is not None:
+            fn.blocking.append((what, call.lineno))
+            strict = tuple(r for r, allow in held if not allow)
+            if strict:
+                fn.blocking_under.append((what, strict, call.lineno))
+            return
+        # explicit .acquire() on a vocabulary lock
+        if isinstance(call.func, ast.Attribute) and call.func.attr == "acquire":
+            ls = self._resolve_lock_expr(call.func.value, fn.module,
+                                         fn.cls, {})
+            if ls is not None:
+                for role in sorted(ls.names):
+                    fn.direct_acquires.append(
+                        (role, ls.allow_blocking, call.lineno))
+                    for held_role, _allow in held:
+                        if held_role != role:
+                            fn.direct_edges.setdefault(
+                                (held_role, role), (ctx.path, call.lineno))
+                return
+        fn.calls.append((self._call_descriptor(fn, call), tuple(held),
+                         call.lineno))
+
+    # -- call resolution ------------------------------------------------------
+
+    def _call_descriptor(self, fn: _Func, call: ast.Call) -> tuple:
+        f = call.func
+        if isinstance(f, ast.Name):
+            return ("bare", f.id)
+        if isinstance(f, ast.Attribute):
+            base = f.value
+            if isinstance(base, ast.Name):
+                if base.id == "self":
+                    return ("self", f.attr)
+                alias = self.imports.get(fn.ctx.path, {}).get(base.id)
+                if alias is not None:
+                    return ("mod", alias, f.attr)
+                return ("attr", f.attr, None)
+            return ("attr", f.attr, None)
+        return ("unknown",)
+
+    def _resolve_call(self, fn: _Func, call_or_ref, held) -> Optional[tuple]:
+        ref = (self._call_descriptor(fn, call_or_ref)
+               if isinstance(call_or_ref, ast.Call) else call_or_ref)
+        kind = ref[0]
+        if kind == "self" and fn.cls is not None:
+            m = self._lookup_method(fn.cls, ref[1])
+            if m is not None:
+                return (m,)
+        elif kind == "bare":
+            f = self.module_funcs.get((fn.module, ref[1]))
+            if f is not None:
+                return (f,)
+        elif kind == "mod":
+            f = self.module_funcs.get((ref[1], ref[2]))
+            if f is not None:
+                return (f,)
+        elif kind == "attr":
+            name = ref[1]
+            if name.startswith("__"):
+                return None
+            if name in _GENERIC_METHODS:
+                return None
+            cands = self.method_names.get(name, ())
+            # Unknown receiver: a small candidate set is acceptable — the
+            # caller only uses it when every candidate AGREES on its lock
+            # effects (consensus resolution), so ambiguity can never
+            # fabricate an edge one real receiver wouldn't produce.
+            if 1 <= len(cands) <= 4:
+                return tuple(cands)
+        return None
+
+    def _lookup_method(self, cls: _Class, name: str) -> Optional[_Func]:
+        seen = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop()
+            if c.key in seen:
+                continue
+            seen.add(c.key)
+            if name in c.methods:
+                return c.methods[name]
+            for base in c.bases:
+                for bc in self.class_names.get(base, ()):
+                    stack.append(bc)
+        return None
+
+    # -- pass D: propagation + findings ---------------------------------------
+
+    def analyze(self) -> Tuple[Dict[Tuple[str, str], Tuple[str, int]],
+                               List[Finding]]:
+        self._collect_bindings()
+        for fn in self.funcs.values():
+            self._summarize(fn)
+        # Exactly-resolved calls (self./module/bare) feed the first
+        # fixpoint; ambiguous-receiver candidates are held back.
+        multi = []
+        for fn in self.funcs.values():
+            for ref, held, line in fn.calls:
+                got = self._resolve_call(fn, ref, held)
+                if not got:
+                    continue
+                if len(got) == 1:
+                    fn.callees.append((got[0], held, line))
+                else:
+                    multi.append((fn, got, held, line))
+        for fn in self.funcs.values():
+            fn.trans_acquires = {(r, a) for r, a, _ in fn.direct_acquires}
+            fn.trans_blocking = {w: (fn.ctx.path, ln)
+                                 for w, ln in fn.blocking}
+        self._fixpoint()
+        # Consensus resolution for ambiguous receivers: count the call
+        # only if every candidate has identical lock effects (e.g. every
+        # `.inc()` acquires obs.metric:*) — candidates that disagree
+        # (kubelet.fail_slice does REST I/O, inventory.fail_slice doesn't)
+        # prove the receiver matters, and guessing would fabricate paths.
+        adopted = 0
+        for fn, cands, held, line in multi:
+            sigs = {(frozenset(c.trans_acquires),
+                     frozenset(c.trans_blocking)) for c in cands}
+            if len(sigs) == 1:
+                fn.callees.append((cands[0], held, line))
+                adopted += 1
+        if adopted:
+            self._fixpoint()
+        # Edges.
+        edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for fn in self.funcs.values():
+            for edge, site in fn.direct_edges.items():
+                edges.setdefault(edge, site)
+            for callee, held, line in fn.callees:
+                for role, _allow in callee.trans_acquires:
+                    for held_role, _ha in held:
+                        if held_role != role:
+                            edges.setdefault((held_role, role),
+                                             (fn.ctx.path, line))
+        findings = self._cycle_findings(edges)
+        findings.extend(self._blocking_findings())
+        return edges, findings
+
+    def _fixpoint(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.funcs.values():
+                for callee, _held, _line in fn.callees:
+                    before = len(fn.trans_acquires)
+                    fn.trans_acquires |= callee.trans_acquires
+                    if len(fn.trans_acquires) != before:
+                        changed = True
+                    for what, site in callee.trans_blocking.items():
+                        if what not in fn.trans_blocking:
+                            fn.trans_blocking[what] = site
+                            changed = True
+
+    def _suppressed_at(self, path: str, line: int) -> bool:
+        for ctx in self.files:
+            if ctx.path == path:
+                return ctx.suppressed(RULE, line)
+        return False
+
+    def _cycle_findings(self, edges) -> List[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+        out: List[Finding] = []
+        for cyc in find_cycles(graph):
+            pairs = list(zip(cyc, cyc[1:] + cyc[:1]))
+            sites = [edges.get(p, ("<unknown>", 0)) for p in pairs]
+            if any(self._suppressed_at(p, l) for p, l in sites):
+                continue  # a suppressed edge breaks the cycle by fiat
+            detail = "; ".join(
+                f"{a}->{b} at {os.path.relpath(p) if p != '<unknown>' else p}"
+                f":{l}" for (a, b), (p, l) in zip(pairs, sites))
+            path, line = sites[0]
+            out.append(Finding(
+                path, line, 0, RULE,
+                f"potential lock-order cycle "
+                f"{' -> '.join(cyc + cyc[:1])} ({detail}); two threads "
+                f"interleaving these orders can deadlock"))
+        return out
+
+    def _blocking_findings(self) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in self.funcs.values():
+            for what, held, line in fn.blocking_under:
+                if fn.ctx.suppressed(RULE, line):
+                    continue
+                out.append(Finding(
+                    fn.ctx.path, line, 0, RULE,
+                    f"blocking call {what} with {list(held)} held "
+                    f"(resolved through the named-lock vocabulary)"))
+            for callee, held, line in fn.callees:
+                strict = [r for r, allow in held if not allow]
+                if not strict or not callee.trans_blocking:
+                    continue
+                if fn.ctx.suppressed(RULE, line):
+                    continue
+                what, (bpath, bline) = next(iter(
+                    sorted(callee.trans_blocking.items())))
+                if self._suppressed_at(bpath, bline):
+                    continue
+                out.append(Finding(
+                    fn.ctx.path, line, 0, RULE,
+                    f"call to {'.'.join(str(k) for k in callee.key if k)} "
+                    f"with {strict} held reaches blocking {what} "
+                    f"({os.path.relpath(bpath)}:{bline})"))
+        return out
+
+
+class LockGraphRule(Rule):
+    """vet integration: collect every scanned file, analyze in finish()."""
+
+    name = RULE
+    doc = ("whole-program static lock graph: potential lock-order cycles "
+           "and blocking-calls-under-lock via call-graph propagation of "
+           "held named-lock sets")
+
+    def __init__(self):
+        self._graph = LockGraph()
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        self._graph.add_file(ctx)
+        return ()
+
+    def finish(self, root: str) -> Iterable[Finding]:
+        _edges, findings = self._graph.analyze()
+        return findings
+
+
+def build_graph(paths: Sequence[str]):
+    """Standalone helper (tests/debugging): analyze ``paths`` and return
+    (edges, findings)."""
+    g = LockGraph()
+    for path in paths:
+        with open(path, encoding="utf-8") as fh:
+            g.add_file(FileContext(path, fh.read()))
+    return g.analyze()

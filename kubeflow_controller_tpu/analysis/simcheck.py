@@ -1,0 +1,295 @@
+"""Deterministic-simulation driver: model-check the REAL store/watch
+plane under seeded adversarial schedules (``kctpu check`` /
+``make check-smoke``).
+
+Generalizes the race harness (analysis/interleave.py): the same seeded
+pre-acquire yield injection + 10 µs switch interval drive mixed
+**writer / watcher / dropper / crasher** threads against one live
+:class:`ObjectStore`, while
+
+- every store op is recorded through the opt-in history hook and checked
+  for **linearizability** + cross-kind **RV monotonicity**
+  (analysis/linearize.py),
+- every watch stream is shadow-consumed and checked for **exactly-once,
+  RV-ordered, gap-free delivery** (analysis/watchcheck.py) across
+  bounded-queue overflow drops, server-side forced drops mid-batch, and
+  crash-point injection (a watcher killed mid-replay, resumed from its
+  last RV),
+- the runtime lock-order detector stays live throughout.
+
+Every thread's decision stream is a pure function of (seed, role), so a
+failing seed reproduces: a red run prints the one-line repro command and
+exports the seed via ``KCTPU_FUZZ_SEED``.
+
+``--self-test`` first feeds the checkers their known-bad synthetic
+fixtures (stale read, lost update, non-monotonic list RV, duplicate /
+gapped / reordered streams) and fails unless every one is rejected — a
+green simulation only means something if the checkers still bite.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import threading
+from typing import Dict, List, Optional
+
+from ..utils import locks
+from . import interleave, linearize, watchcheck
+from .linearize import HistoryRecorder, Violation
+
+_orig_sleep = locks._orig_sleep
+
+#: Kinds the simulation writes/watches (per-kind store shards + streams).
+KINDS = ("pods", "services")
+#: Writer keyspace per kind: small enough to force CAS contention.
+KEYSPACE = 12
+
+
+def _mk_obj(name: str):
+    from ..api.core import Pod
+
+    pod = Pod()
+    pod.metadata.namespace = "default"
+    pod.metadata.name = name
+    return pod
+
+
+class _Writer:
+    """One seeded writer: create / get / CAS-update / delete / list over a
+    small keyspace.  Conflict/NotFound/AlreadyExists are expected outcomes
+    (they are exactly what the CAS spec constrains), never errors."""
+
+    def __init__(self, store, kind: str, seed: int, idx: int):
+        self.store = store
+        self.kind = kind
+        self.name = f"sim-writer-{kind}-{idx}"
+        self.rng = random.Random(f"{seed}:{self.name}")
+        self.ops = 0
+
+    def run(self, stop: threading.Event) -> None:
+        from ..cluster.store import APIError
+
+        rng = self.rng
+        while not stop.is_set():
+            name = f"{self.kind[:3]}-{rng.randrange(KEYSPACE):03d}"
+            roll = rng.random()
+            try:
+                if roll < 0.35:
+                    self.store.create(self.kind, _mk_obj(name))
+                elif roll < 0.75:
+                    # CAS read-modify-write on the freshest RV we can get.
+                    obj = self.store.get(self.kind, "default", name)
+                    obj.metadata.labels["touch"] = str(self.ops)
+                    self.store.update(self.kind, obj)
+                elif roll < 0.90:
+                    self.store.get(self.kind, "default", name)
+                elif roll < 0.97:
+                    self.store.delete(self.kind, "default", name,
+                                      cascade=False)
+                else:
+                    self.store.list_with_rv(self.kind, "default")
+            except APIError:
+                pass  # expected outcome class: recorded, spec-checked
+            self.ops += 1
+
+
+def run_seed(seed: int, duration_s: float = 0.5,
+             writers_per_kind: int = 2,
+             drop_interval_s: float = 0.06,
+             crash_interval_s: float = 0.08,
+             max_configs: int = 2_000_000) -> dict:
+    """One full simulation pass.  Returns a result dict with the
+    violation list (empty = the run proved nothing broke) and counters
+    for the report line."""
+    from ..cluster.store import ObjectStore
+    from . import lockcheck
+
+    results: dict = {"seed": seed}
+    fresh_checker = lockcheck.installed() is None
+    consumers: List[watchcheck.ShadowConsumer] = []
+    oracles: Dict[str, watchcheck.ShadowConsumer] = {}
+    try:
+        interleave.install(seed)
+        checker = lockcheck.install()
+        checker.reset()
+        # Cache sized so no resume ever 410s (gap-free is then a hard
+        # requirement, not best-effort); queues tiny so slow consumers
+        # really overflow and exercise drop + RV-resume replay.
+        store = ObjectStore(watch_cache_size=262144, watch_queue_size=32)
+        recorder = HistoryRecorder()
+        store.attach_recorder(recorder)
+        # Oracles first (before any write): unbounded, never force-dropped.
+        for kind in KINDS:
+            oracles[kind] = watchcheck.ShadowConsumer(
+                store, kind, max_queue=0, name=f"oracle-{kind}").start()
+        rng = random.Random(f"{seed}:driver")
+        for kind in KINDS:
+            consumers.append(watchcheck.ShadowConsumer(
+                store, kind, name=f"fast-{kind}").start())
+            # Slow enough that the bounded queue (32) genuinely overflows
+            # under the writers' event rate: the PR-6 drop + transparent
+            # RV-resume replay path runs many times per second here.
+            consumers.append(watchcheck.ShadowConsumer(
+                store, kind, namespace="default", name=f"slow-{kind}",
+                slow_every=2, slow_us=rng.uniform(400, 900)).start())
+        stop = threading.Event()
+        writers = [_Writer(store, kind, seed, i)
+                   for kind in KINDS for i in range(writers_per_kind)]
+        threads = [threading.Thread(target=w.run, args=(stop,),
+                                    name=w.name, daemon=True)
+                   for w in writers]
+
+        drops = crashes = 0
+
+        def chaos():
+            # Seeded dropper/crasher: alternately force-drop a kind's
+            # streams server-side (mid-batch) and kill one consumer
+            # client-side (mid-replay whenever the seed lands it there).
+            nonlocal drops, crashes
+            crng = random.Random(f"{seed}:chaos")
+            next_drop = next_crash = 0.0
+            t = 0.0
+            step = 0.01
+            while not stop.is_set():
+                _orig_sleep(step)
+                t += step
+                if t >= next_drop:
+                    kind = crng.choice(KINDS)
+                    drops += store.drop_watchers(
+                        kind, exclude=(oracles[kind].watcher,))
+                    next_drop = t + drop_interval_s * crng.uniform(0.5, 1.5)
+                if t >= next_crash:
+                    victim = crng.choice(consumers)
+                    victim.crash()
+                    crashes += 1
+                    next_crash = t + crash_interval_s * crng.uniform(0.5, 1.5)
+
+        threads.append(threading.Thread(target=chaos, name="sim-chaos",
+                                        daemon=True))
+        for t in threads:
+            t.start()
+        _orig_sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        alive = [t.name for t in threads if t.is_alive()]
+        for c in consumers + list(oracles.values()):
+            c.stop()   # join the consumer thread first...
+            c.drain()  # ...then drain what was still buffered, single-threaded
+        store.detach_recorder()
+        overflow_drops = sum(sh.overflows for sh in store._shards.values())
+        report = checker.report()
+    finally:
+        interleave.uninstall()
+        if fresh_checker:
+            lockcheck.uninstall()
+
+    violations: List[Violation] = []
+    if alive:
+        violations.append(Violation("simulation", "threads",
+                                    f"threads did not finish: {alive}"))
+    records = recorder.records()
+    try:
+        violations.extend(linearize.check_records(records,
+                                                  max_configs=max_configs))
+    except linearize.SearchBudgetExceeded as e:
+        violations.append(Violation("linearizability", "budget", str(e)))
+    violations.extend(watchcheck.verify_consumers(oracles, consumers))
+    if not report.clean:
+        violations.append(Violation("lockcheck", "report", report.render()))
+    results.update({
+        "ops": len(records),
+        "keys": len(linearize.build_key_histories(records)),
+        "events": {k: len(o.events) for k, o in oracles.items()},
+        "drops": drops,
+        "crashes": crashes,
+        "overflow_drops": overflow_drops,
+        "violations": violations,
+    })
+    return results
+
+
+def repro_command(seed: int, duration_s: float) -> str:
+    return (f"KCTPU_FUZZ_SEED={seed} python -m "
+            f"kubeflow_controller_tpu.analysis.simcheck "
+            f"--seeds {seed} --duration {duration_s}")
+
+
+def run_self_test() -> List[str]:
+    """Known-bad synthetic histories/streams must be rejected and the
+    known-good ones accepted, or the green light means nothing."""
+    return linearize.self_test() + watchcheck.self_test()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="kctpu check",
+        description="model-check the store/watch plane under seeded "
+                    "deterministic simulation (docs/ANALYSIS.md, "
+                    "`make check-smoke`)")
+    ap.add_argument("--seeds", default="11,22,33",
+                    help="comma-separated simulation seeds (one pass each)")
+    ap.add_argument("--duration", type=float, default=0.5,
+                    help="seconds of simulated load per seed")
+    ap.add_argument("--self-test", action="store_true",
+                    help="first require every known-bad synthetic "
+                         "history/stream fixture to be rejected")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings (schema_version 1)")
+    args = ap.parse_args(argv)
+    seeds = [int(s) for s in args.seeds.split(",") if s.strip()]
+
+    findings: List[dict] = []
+    lines: List[str] = []
+    failed = False
+    if args.self_test:
+        failures = run_self_test()
+        n_fixtures = (len(linearize.KNOWN_BAD) + len(linearize.KNOWN_GOOD)
+                      + len(watchcheck.KNOWN_BAD_STREAMS) + 1)
+        if failures:
+            failed = True
+            for msg in failures:
+                findings.append({"seed": None, "checker": "self-test",
+                                 "scope": "fixtures", "message": msg})
+                lines.append(f"check self-test: FAIL: {msg}")
+        else:
+            lines.append(f"check self-test: {n_fixtures} synthetic "
+                         f"fixtures rejected/accepted correctly")
+    for seed in seeds:
+        out = run_seed(seed, duration_s=args.duration)
+        vs: List[Violation] = out["violations"]
+        status = "ok" if not vs else f"FAIL ({len(vs)} violations)"
+        lines.append(
+            f"check seed={seed}: {status} ops={out['ops']} "
+            f"keys={out['keys']} events={out['events']} "
+            f"drops={out['drops']} crashes={out['crashes']} "
+            f"overflow-drops={out['overflow_drops']}")
+        for v in vs:
+            findings.append({"seed": seed, "checker": v.checker,
+                             "scope": v.scope, "message": v.message})
+            lines.append("  " + v.render())
+        if vs:
+            failed = True
+            os.environ["KCTPU_FUZZ_SEED"] = str(seed)
+            lines.append(f"  repro: {repro_command(seed, args.duration)}")
+    if args.as_json:
+        print(json.dumps({
+            "tool": "kctpu-check", "schema_version": 1,
+            "clean": not failed, "seeds": seeds,
+            "self_test": bool(args.self_test), "findings": findings,
+        }, indent=2))
+        for line in lines:
+            print(line, file=sys.stderr)
+    else:
+        for line in lines:
+            print(line)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -69,7 +69,8 @@ class FileContext:
         self.bare_imports: Dict[str, str] = {}
         for node in ast.walk(self.tree):
             if isinstance(node, ast.ImportFrom) and node.module in (
-                    "time", "subprocess", "socket", "urllib.request"):
+                    "time", "subprocess", "socket", "urllib.request",
+                    "threading"):
                 for alias in node.names:
                     self.bare_imports[alias.asname or alias.name] = (
                         f"{node.module}.{alias.name}")
@@ -471,6 +472,41 @@ class ThreadHygieneRule(Rule):
                     f"wedge interpreter shutdown")
 
 
+class RawLockRule(Rule):
+    name = "raw-lock"
+    doc = ("bare threading.Lock()/RLock()/Condition() outside "
+           "utils/locks.py: use the named-lock facade (locks.named_lock/"
+           "named_rlock/named_condition) so the analysis plane sees it")
+
+    _CTORS = frozenset({"Lock", "RLock", "Condition"})
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.path.replace(os.sep, "/").endswith("utils/locks.py"):
+            return  # the facade itself wraps the raw primitives
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            ctor = None
+            if (isinstance(fn, ast.Attribute) and fn.attr in self._CTORS
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "threading"):
+                ctor = f"threading.{fn.attr}"
+            elif isinstance(fn, ast.Name):
+                orig = ctx.bare_imports.get(fn.id, "")
+                if orig in ("threading.Lock", "threading.RLock",
+                            "threading.Condition"):
+                    ctor = orig
+            if ctor is None or ctx.suppressed(self.name, node.lineno):
+                continue
+            yield Finding(
+                ctx.path, node.lineno, node.col_offset, self.name,
+                f"bare {ctor}() bypasses the named-lock facade: the "
+                f"lock-order detector and the static lock graph cannot "
+                f"see it (use locks.named_lock/named_rlock/"
+                f"named_condition)")
+
+
 class MetricRules(Rule):
     """Two findings families from one scan: ``metric-prefix`` (kctpu_
     prefix on every registered metric) and ``metric-catalogue``
@@ -478,6 +514,9 @@ class MetricRules(Rule):
 
     name = "metric-prefix"
     catalogue_rule = "metric-catalogue"
+    #: finish() reads docs/OBSERVABILITY.md at the repo root: skipped when
+    #: vetting isolated files (run(skip_catalogue=True)).
+    needs_repo_docs = True
     doc = ("registered metric names carry the kctpu_ prefix and appear in "
            "docs/OBSERVABILITY.md")
 
@@ -606,14 +645,18 @@ class EventReasonRule(Rule):
 
 
 def all_rules() -> List[Rule]:
+    from .lockgraph import LockGraphRule  # lazy: lockgraph imports vet
+
     return [
         LockBlockingCallRule(),
         HotPathDeepcopyRule(),
         SnapshotMutationRule(),
         TemplateCopyRule(),
         ThreadHygieneRule(),
+        RawLockRule(),
         MetricRules(),
         EventReasonRule(),
+        LockGraphRule(),
     ]
 
 
@@ -658,9 +701,13 @@ def run(targets: Sequence[str] = (), root: str = ".",
             continue
         for rule in rules:
             findings.extend(rule.check_file(ctx))
-    if not skip_catalogue:
-        for rule in rules:
-            findings.extend(rule.finish(root))
+    for rule in rules:
+        # skip_catalogue only skips repo-doc-coupled finishers (the
+        # metric catalogue); whole-program rules (lock-graph) always
+        # finish — they analyze exactly the files just scanned.
+        if skip_catalogue and getattr(rule, "needs_repo_docs", False):
+            continue
+        findings.extend(rule.finish(root))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
@@ -681,6 +728,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--no-catalogue", action="store_true",
                     help="skip the docs/OBSERVABILITY.md drift check "
                          "(for vetting files outside the repo)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout "
+                         "(schema_version 1: {path, line, col, rule, "
+                         "message}) for CI annotation and editors")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
     if args.list_rules:
@@ -689,11 +740,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 0
     findings = run(args.targets, root=args.root,
                    skip_catalogue=args.no_catalogue)
-    for f in findings:
-        print(f.render())
     n_files = len(list(iter_py_files(
         list(args.targets) or [os.path.join(args.root, t)
                                for t in DEFAULT_TARGETS])))
+    if args.as_json:
+        import json
+
+        print(json.dumps({
+            "tool": "kctpu-vet", "schema_version": 1,
+            "clean": not findings, "files": n_files,
+            "findings": [{"path": f.path, "line": f.line, "col": f.col,
+                          "rule": f.rule, "message": f.message}
+                         for f in findings],
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
     if findings:
         print(f"kctpu vet: {len(findings)} finding(s) in {n_files} files",
               file=sys.stderr)

@@ -1,0 +1,295 @@
+"""Watch-delivery exactness checker: every watcher sees an exactly-once,
+RV-ordered, gap-free event stream — across PR-5 RV-resume, PR-6
+queue-overflow re-resume, and client reconnects.
+
+This is the invariant the whole read plane leans on (informers never
+re-list in steady state because replay is exact) and the one a WAL
+rebuild of the watch cache must preserve.  The checker has two halves:
+
+- :func:`verify_stream` — pure verification of a delivered event log
+  against an **oracle** (the ground-truth event sequence for the kind):
+  RVs strictly increase (ordered AND exactly-once in one property), and
+  the delivered set equals the oracle's events in ``(start_rv,
+  last_delivered_rv]`` restricted to the consumer's namespace filter
+  (gap-free, nothing invented, right objects).  Synthetic known-bad
+  streams (:data:`KNOWN_BAD_STREAMS`) pin that the verifier still
+  rejects duplicates, gaps, reorderings, and wrong-object deliveries.
+
+- :class:`ShadowConsumer` — a live consumer for the simulation driver
+  (analysis/simcheck.py): drains a store watch stream with optional
+  seeded slow-downs (to force bounded-queue overflow drops), records
+  every delivery, and supports two crash-point injections: ``crash()``
+  kills the watcher wherever it happens to be — including mid-replay —
+  and re-subscribes from the last observed RV (the PR-5 client
+  contract), and the driver's ``store.drop_watchers`` drops the stream
+  server-side mid-batch.  Whatever the injection mix, the consumer's
+  MERGED log must still verify.
+
+The oracle is itself a watcher — unbounded queue, excluded from forced
+drops, opened before the first write — whose own log is verified for
+strict RV order before anything is compared against it.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils import locks
+from .linearize import Violation
+
+_orig_sleep = locks._orig_sleep
+
+
+@dataclass(frozen=True)
+class SEvent:
+    """One delivered watch event, reduced to what exactness is judged on."""
+
+    rv: int
+    type: str          # ADDED | MODIFIED | DELETED
+    namespace: str
+    name: str
+
+    def label(self) -> str:
+        return f"{self.type}({self.namespace}/{self.name})@rv={self.rv}"
+
+
+def from_watch_event(ev) -> Optional[SEvent]:
+    """Reduce a store ``WatchEvent`` (BOOKMARKs -> None: they carry no
+    object change and are not part of the exactness contract)."""
+    if ev.type == "BOOKMARK":
+        return None
+    m = ev.object.metadata
+    return SEvent(rv=int(m.resource_version), type=ev.type,
+                  namespace=m.namespace, name=m.name)
+
+
+def verify_stream(events: Sequence[SEvent],
+                  oracle: Optional[Sequence[SEvent]] = None,
+                  start_rv: int = 0,
+                  namespace: Optional[str] = None,
+                  label: str = "stream") -> List[Violation]:
+    """Verify one consumer's delivered log.  With ``oracle`` (the kind's
+    ground-truth sequence) the check is exact: the log must equal the
+    oracle's events in ``(start_rv, last_delivered]`` under the namespace
+    filter.  Without an oracle only intra-stream ordering/exactly-once
+    holds (strictly increasing RVs)."""
+    out: List[Violation] = []
+    last: Optional[SEvent] = None
+    for ev in events:
+        if last is not None and ev.rv <= last.rv:
+            kind = "duplicate" if ev.rv == last.rv else "out-of-order"
+            out.append(Violation(
+                "watch-delivery", label,
+                f"{kind} delivery: {last.label()} then {ev.label()}"))
+        last = ev
+    if oracle is None or out:
+        return out
+    upto = last.rv if last is not None else start_rv
+    expect = [e for e in oracle
+              if start_rv < e.rv <= upto
+              and (namespace is None or e.namespace == namespace)]
+    got_by_rv = {e.rv: e for e in events}
+    expect_by_rv = {e.rv: e for e in expect}
+    for e in expect:
+        g = got_by_rv.get(e.rv)
+        if g is None:
+            out.append(Violation(
+                "watch-delivery", label,
+                f"gap: oracle event {e.label()} never delivered "
+                f"(window {start_rv}..{upto}]"))
+        elif g != e:
+            out.append(Violation(
+                "watch-delivery", label,
+                f"wrong delivery at rv={e.rv}: got {g.label()}, "
+                f"oracle says {e.label()}"))
+    for ev in events:
+        if ev.rv not in expect_by_rv:
+            out.append(Violation(
+                "watch-delivery", label,
+                f"invented delivery: {ev.label()} matches no oracle event"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Live consumers for the simulation driver
+# ---------------------------------------------------------------------------
+
+class ShadowConsumer:
+    """Drains a store watch stream into a verifiable log, surviving
+    crash-point injection.
+
+    ``slow_every``/``slow_us`` (driven by a seeded RNG upstream) throttle
+    consumption so the bounded watcher queue overflows — exercising the
+    store's drop + transparent RV-resume replay.  ``crash()`` requests a
+    kill at the next delivery: the watcher is stopped wherever it is
+    (mid-replay included), and a NEW watch opens at ``since_rv=last_rv``.
+    The merged log across all incarnations is what gets verified."""
+
+    def __init__(self, store, kind: str, namespace: Optional[str] = None,
+                 max_queue: Optional[int] = None, name: str = "consumer",
+                 slow_every: int = 0, slow_us: float = 0.0):
+        self.store = store
+        self.kind = kind
+        self.namespace = namespace
+        self.max_queue = max_queue
+        self.name = name
+        self.slow_every = slow_every
+        self.slow_us = slow_us
+        self.events: List[SEvent] = []
+        self.last_rv = 0
+        self.incarnations = 1
+        self.crashes = 0
+        self.too_old = 0  # resume refused with a 410: run mis-sized
+        self._crash_req = threading.Event()
+        self._stop = threading.Event()
+        self.watcher = store.watch(kind, namespace=namespace,
+                                   max_queue=max_queue)
+        self.thread = threading.Thread(target=self._run,
+                                       name=f"watchcheck-{name}",
+                                       daemon=True)
+
+    def start(self) -> "ShadowConsumer":
+        self.thread.start()
+        return self
+
+    def crash(self) -> None:
+        """Inject a crash point: kill + RV-resume at the next delivery."""
+        self._crash_req.set()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        self.watcher.stop()
+        self.thread.join(timeout=timeout)
+
+    @property
+    def gaps(self) -> int:
+        return self.watcher.gaps
+
+    def _reincarnate(self) -> None:
+        # The crash: the consumer dies wherever it was (possibly with a
+        # half-drained replay in its queue) and a fresh client resumes
+        # from the last RV it durably observed — the PR-5 contract says
+        # the replay makes the merged stream exact anyway.
+        self.watcher.stop()
+        try:
+            self.watcher = self.store.watch(
+                self.kind, namespace=self.namespace,
+                since_rv=str(self.last_rv), max_queue=self.max_queue)
+        except Exception:
+            # TooOldResourceVersion: the window is gone; surface it as a
+            # sizing failure instead of dying silently mid-thread.
+            self.too_old += 1
+            self._stop.set()
+            return
+        self.incarnations += 1
+        self.crashes += 1
+
+    def _run(self) -> None:
+        n = 0
+        while not self._stop.is_set():
+            if self._crash_req.is_set():
+                self._crash_req.clear()
+                self._reincarnate()
+            ev = self.watcher.next(timeout=0.02)
+            if ev is None:
+                if self.watcher._stopped and not self._stop.is_set():
+                    # Killed under us (store stop): nothing more to drain.
+                    return
+                continue
+            sev = from_watch_event(ev)
+            if sev is None:
+                continue
+            self.events.append(sev)
+            self.last_rv = sev.rv
+            n += 1
+            if self.slow_every and n % self.slow_every == 0:
+                # Original sleep: a consumer stall is not a product
+                # blocking call and must not trip lockcheck's patches.
+                _orig_sleep(self.slow_us * 1e-6)
+
+    def drain(self, idle_rounds: int = 3) -> None:
+        """Post-run: consume whatever is still buffered so verification
+        covers as much of the history as possible."""
+        idle = 0
+        while idle < idle_rounds:
+            ev = self.watcher.next(timeout=0.05)
+            if ev is None:
+                idle += 1
+                continue
+            idle = 0
+            sev = from_watch_event(ev)
+            if sev is not None:
+                self.events.append(sev)
+                self.last_rv = sev.rv
+
+
+def verify_consumers(oracles: Dict[str, "ShadowConsumer"],
+                     consumers: Sequence["ShadowConsumer"]) -> List[Violation]:
+    """Verify every consumer against its kind's oracle (after verifying
+    each oracle's own internal order).  A nonzero ``gaps`` counter means
+    the watch cache was outrun (a 410): the stream is legitimately
+    incomplete and the run is mis-sized, reported as its own violation so
+    a green run can't hide behind it."""
+    out: List[Violation] = []
+    for kind, oracle in sorted(oracles.items()):
+        out.extend(verify_stream(oracle.events, label=f"oracle:{kind}"))
+    for c in consumers:
+        oracle = oracles.get(c.kind)
+        if c.gaps or c.too_old:
+            out.append(Violation(
+                "watch-delivery", c.name,
+                f"{c.gaps + c.too_old} resume gap(s) (410): watch cache "
+                f"too small for the run — resize the simulation, nothing "
+                f"was verified"))
+            continue
+        out.extend(verify_stream(
+            c.events, oracle=oracle.events if oracle else None,
+            namespace=c.namespace, label=c.name))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Known-bad synthetic streams (the self-test fixtures)
+# ---------------------------------------------------------------------------
+
+def _ev(rv: int, type_: str = "ADDED", ns: str = "default",
+        name: str = "a") -> SEvent:
+    return SEvent(rv=rv, type=type_, namespace=ns, name=name)
+
+
+_ORACLE = [_ev(1), _ev(2, "MODIFIED"), _ev(3, "MODIFIED"),
+           _ev(4, "DELETED")]
+
+#: (events, oracle) pairs verify_stream MUST reject.
+KNOWN_BAD_STREAMS: Dict[str, Tuple[List[SEvent], Optional[List[SEvent]]]] = {
+    "duplicate-delivery": ([_ev(1), _ev(2, "MODIFIED"), _ev(2, "MODIFIED"),
+                            _ev(3, "MODIFIED")], _ORACLE),
+    "reordered-delivery": ([_ev(1), _ev(3, "MODIFIED"), _ev(2, "MODIFIED")],
+                           _ORACLE),
+    "gap-in-stream": ([_ev(1), _ev(2, "MODIFIED"), _ev(4, "DELETED")],
+                      _ORACLE),
+    "wrong-object": ([_ev(1), _ev(2, "MODIFIED", name="b")], _ORACLE),
+    "invented-event": ([_ev(1), _ev(2, "MODIFIED"), _ev(3, "MODIFIED"),
+                        _ev(4, "DELETED"), _ev(5, "MODIFIED")],
+                       _ORACLE),
+}
+
+#: The exact oracle prefix: must verify clean.
+KNOWN_GOOD_STREAM: Tuple[List[SEvent], List[SEvent]] = (_ORACLE, _ORACLE)
+
+
+def self_test() -> List[str]:
+    """Exercise the verifier against its own fixtures; returns failure
+    messages (empty = duplicates/gaps/reorders are still rejected)."""
+    failures = []
+    for name, (events, oracle) in KNOWN_BAD_STREAMS.items():
+        if not verify_stream(events, oracle=oracle, label=name):
+            failures.append(f"known-bad stream {name!r} was ACCEPTED")
+    good_events, good_oracle = KNOWN_GOOD_STREAM
+    got = verify_stream(good_events, oracle=good_oracle, label="known-good")
+    if got:
+        failures.append("known-good stream was rejected: "
+                        + "; ".join(v.render() for v in got))
+    return failures

@@ -679,8 +679,17 @@ def build_parser() -> argparse.ArgumentParser:
     vt = sub.add_parser(
         "vet", add_help=False,
         help="AST-lint the project's codified concurrency/controller "
-             "invariants (docs/ANALYSIS.md); args pass through")
+             "invariants incl. the static lock graph (docs/ANALYSIS.md); "
+             "args pass through (--json for machine-readable findings)")
     vt.add_argument("vet_args", nargs=argparse.REMAINDER)
+
+    ck = sub.add_parser(
+        "check", add_help=False,
+        help="model-check the store/watch plane: linearizability + "
+             "watch-delivery exactness under seeded deterministic "
+             "simulation (docs/ANALYSIS.md); args pass through "
+             "(--self-test, --seeds, --json)")
+    ck.add_argument("check_args", nargs=argparse.REMAINDER)
 
     r = sub.add_parser("run", help="run the controller")
     r.add_argument("--in-memory", action="store_true",
@@ -737,6 +746,11 @@ def _main(argv=None) -> int:
         from ..analysis import vet
 
         return vet.main(raw[1:])
+    if raw[:1] == ["check"]:
+        # Same early routing as vet, same bpo-17050 reason.
+        from ..analysis import simcheck
+
+        return simcheck.main(raw[1:])
     args = build_parser().parse_args(argv)
     if args.version or args.cmd == "version":
         return cmd_version(args)
@@ -762,6 +776,10 @@ def _main(argv=None) -> int:
         from ..analysis import vet
 
         return vet.main(args.vet_args)
+    if args.cmd == "check":
+        from ..analysis import simcheck
+
+        return simcheck.main(args.check_args)
     if args.cmd == "run":
         return cmd_run(args)
     build_parser().print_help()

@@ -267,6 +267,7 @@ class ObjectStore:
         self._uid = 0
         self._watch_cache_size = watch_cache_size
         self._watch_queue_size = watch_queue_size
+        self._recorder = None  # opt-in history hook; see attach_recorder
         self._c_replayed = REGISTRY.counter(
             "kctpu_watch_replayed_events_total",
             "Watch events replayed from the server watch cache on "
@@ -810,6 +811,78 @@ class ObjectStore:
         with self._shard(w.kind):
             if not w._dropped and not w._stopped:
                 w.queue.put(_bookmark_event(str(self._rv)))
+
+    # -- analysis hooks (opt-in; zero-cost when detached) ---------------------
+
+    #: Public ops wrapped by :meth:`attach_recorder` — exactly the surface
+    #: the linearizability checker's sequential spec models
+    #: (analysis/linearize.py).  ``list`` rides through ``list_with_rv``;
+    #: ``watch`` streams are the watch-delivery checker's territory.
+    RECORDED_OPS = ("create", "get", "update", "update_status", "patch",
+                    "patch_meta", "update_progress", "mark_deleting",
+                    "delete", "list_with_rv")
+
+    def attach_recorder(self, recorder) -> None:
+        """Start recording op histories into ``recorder`` (an
+        ``analysis.linearize.HistoryRecorder``-shaped object: ``clock()``
+        and ``record(op, args, kwargs, result, error, t0, t1)``).
+
+        Implementation is instance-level method wrapping: each op in
+        :data:`RECORDED_OPS` gets a shadowing instance attribute that
+        timestamps the call, delegates to the class method, and reports
+        result or APIError.  With no recorder attached the instance dict
+        is untouched and calls dispatch straight to the unmodified class
+        methods — the disabled path costs literally nothing, which is
+        what lets the hook ship enabled-able in production builds
+        (gated by ``bench.py --scale N --record-history`` staying within
+        noise of the baseline)."""
+        if getattr(self, "_recorder", None) is not None:
+            raise RuntimeError("a recorder is already attached")
+        for op in self.RECORDED_OPS:
+            inner = getattr(type(self), op)
+
+            def wrapper(*a, _op=op, _inner=inner, **kw):
+                t0 = recorder.clock()
+                try:
+                    out = _inner(self, *a, **kw)
+                except APIError as e:
+                    recorder.record(_op, a, kw, None, e,
+                                    t0, recorder.clock())
+                    raise
+                recorder.record(_op, a, kw, out, None, t0, recorder.clock())
+                return out
+
+            self.__dict__[op] = wrapper
+        self._recorder = recorder
+
+    def detach_recorder(self) -> None:
+        """Remove the recording wrappers; the store returns to the
+        zero-overhead class-method dispatch."""
+        for op in self.RECORDED_OPS:
+            self.__dict__.pop(op, None)
+        self._recorder = None
+
+    def drop_watchers(self, kind: str, exclude: tuple = ()) -> int:
+        """Force-drop every live watcher of ``kind`` (minus ``exclude``) —
+        the chaos hook the simulation driver (analysis/simcheck.py) uses
+        to drop streams mid-batch.  Exactly the eviction the write path
+        applies to an overflowing consumer, under the same shard lock:
+        buffered events drain, the sentinel lands after them, auto-resume
+        watchers replay the window from the watch cache on their next
+        ``next()``.  Returns the number of watchers dropped."""
+        sh = self._shard(kind)
+        with sh:
+            dropped = 0
+            keep: List[Watcher] = []
+            for w in sh.watchers:
+                if w in exclude:
+                    keep.append(w)
+                    continue
+                w._dropped = True
+                w.queue.put(None)
+                dropped += 1
+            sh.watchers = keep
+        return dropped
 
     # -- observability --------------------------------------------------------
 

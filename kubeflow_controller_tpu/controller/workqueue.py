@@ -97,8 +97,9 @@ class RateLimitingQueue:
         # with notify(1) could wake a get() waiter instead of the delay
         # loop and lose the wakeup).
         self._lock = locks.named_lock(f"workqueue:{name}")
-        self._cond = threading.Condition(self._lock)
-        self._delay_cond = threading.Condition(self._lock)
+        self._cond = locks.named_condition(f"workqueue:{name}", self._lock)
+        self._delay_cond = locks.named_condition(f"workqueue:{name}",
+                                                 self._lock)
         # FIFO of ready items: deque, so the get() hot path is O(1)
         # popleft instead of list.pop(0)'s O(depth) shift per item.
         self._queue: Deque[str] = collections.deque()

@@ -205,7 +205,11 @@ def _maybe_bootstrap() -> None:
         from ..analysis import lockcheck
 
         lockcheck.install()
-    fuzz = os.environ.get("KCTPU_SCHED_FUZZ", "")
+    # KCTPU_FUZZ_SEED is the spelling red analysis runs export with their
+    # repro command (interleave/simcheck); KCTPU_SCHED_FUZZ wins if both
+    # are set.
+    fuzz = (os.environ.get("KCTPU_SCHED_FUZZ", "")
+            or os.environ.get("KCTPU_FUZZ_SEED", ""))
     if _fuzzer is None and fuzz not in ("", "0"):
         from ..analysis import interleave
 

@@ -5,7 +5,7 @@ import subprocess
 import threading
 import time
 
-_lock = threading.Lock()
+_lock = threading.Lock()  # kctpu: vet-ok(raw-lock) - fixture prop
 _q = queue.Queue()
 
 

@@ -1,0 +1,45 @@
+"""Vet fixture: violations only the WHOLE-PROGRAM lock graph can see
+(the lock-graph rule) — every function is individually clean, the bugs
+live across call edges no runtime test executes.
+
+Variable names deliberately avoid the local lock-blocking-call rule's
+name heuristic (*lock*/*cond*/*guard*): these findings must come from
+vocabulary resolution, not from naming luck.
+"""
+import time
+
+from kubeflow_controller_tpu.utils import locks
+
+
+class Ledger:
+    def __init__(self):
+        self._accounts = locks.named_lock("fixture.accounts")
+        self._audit = locks.named_lock("fixture.audit")
+
+    # -- the inversion: accounts -> audit on one path, audit -> accounts
+    # on another, each hop hidden behind a call -------------------------------
+
+    def _append_audit(self):
+        with self._audit:
+            pass
+
+    def post(self):
+        with self._accounts:  # accounts -> audit (via _append_audit)
+            self._append_audit()
+
+    def _lock_accounts_and_fix(self):
+        with self._accounts:
+            pass
+
+    def reconcile(self):
+        with self._audit:  # audit -> accounts: the inversion (BAD)
+            self._lock_accounts_and_fix()
+
+    # -- blocking reached through a call hop ----------------------------------
+
+    def _settle_remote(self):
+        time.sleep(0.2)  # fine here: nothing held in THIS function
+
+    def flush(self):
+        with self._accounts:
+            self._settle_remote()  # BAD: sleep reached under accounts

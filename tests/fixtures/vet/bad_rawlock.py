@@ -1,0 +1,13 @@
+"""Vet fixture: bare threading primitives bypassing the named-lock
+facade (all BAD — the raw-lock rule)."""
+import threading
+from threading import Lock
+
+_module_level = threading.Lock()  # BAD: invisible to the analysis plane
+
+
+class Worker:
+    def __init__(self):
+        self._mu = threading.RLock()  # BAD: bare RLock
+        self._cv = threading.Condition()  # BAD: bare Condition (own RLock)
+        self._imported = Lock()  # BAD: bare-imported ctor

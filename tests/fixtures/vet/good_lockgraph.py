@@ -1,0 +1,32 @@
+"""Vet fixture: the same shape with a consistent lock order and the
+blocking call hoisted out of the critical section — lock-graph clean."""
+import time
+
+from kubeflow_controller_tpu.utils import locks
+
+
+class Ledger:
+    def __init__(self):
+        self._accounts = locks.named_lock("fixture.accounts")
+        self._audit = locks.named_lock("fixture.audit")
+
+    def _append_audit(self):
+        with self._audit:
+            pass
+
+    def post(self):
+        with self._accounts:  # accounts -> audit everywhere
+            self._append_audit()
+
+    def reconcile(self):
+        with self._accounts:  # same order on the second path
+            self._append_audit()
+
+    def _settle_remote(self):
+        time.sleep(0.2)
+
+    def flush(self):
+        with self._accounts:
+            pending = True
+        if pending:
+            self._settle_remote()  # blocking outside the critical section

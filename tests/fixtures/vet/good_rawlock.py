@@ -1,0 +1,11 @@
+"""Vet fixture: the same locks routed through the named-lock facade."""
+from kubeflow_controller_tpu.utils import locks
+
+_module_level = locks.named_lock("fixture.module")
+
+
+class Worker:
+    def __init__(self):
+        self._mu = locks.named_rlock("fixture.worker")
+        self._cv = locks.named_condition("fixture.worker-cv")
+        self._io = locks.named_lock("fixture.io", allow_blocking=True)

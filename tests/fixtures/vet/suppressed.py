@@ -4,7 +4,7 @@ import copy
 import threading
 import time
 
-_lock = threading.Lock()
+_lock = threading.Lock()  # kctpu: vet-ok(raw-lock)
 
 
 def intentional_sleep_under_lock():

@@ -1,0 +1,439 @@
+"""Model-checking layer (PR 11): the linearizability checker (sequential
+spec + WGL search + cross-kind RV tokens), the store's opt-in recording
+hook, watch-delivery exactness, the deterministic-simulation driver, and
+the interleave exception-path fixes."""
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from kubeflow_controller_tpu.analysis import (
+    interleave,
+    linearize,
+    lockcheck,
+    simcheck,
+    watchcheck,
+)
+from kubeflow_controller_tpu.analysis.linearize import (
+    HistoryRecorder,
+    SearchBudgetExceeded,
+    _rec,
+    build_key_histories,
+    check_records,
+    check_rv_tokens,
+    linearize_key,
+)
+from kubeflow_controller_tpu.api.core import Pod
+from kubeflow_controller_tpu.cluster.store import Conflict, ObjectStore
+
+
+def _pod(name: str, ns: str = "default") -> Pod:
+    p = Pod()
+    p.metadata.namespace = ns
+    p.metadata.name = name
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Sequential spec + WGL search on synthetic histories
+# ---------------------------------------------------------------------------
+
+class TestKnownHistories:
+    @pytest.mark.parametrize("name", sorted(linearize.KNOWN_BAD))
+    def test_known_bad_rejected(self, name):
+        """Every known-bad synthetic history MUST be rejected — the
+        check-smoke precondition for trusting a green simulation."""
+        violations = check_records(linearize.KNOWN_BAD[name])
+        assert violations, f"known-bad history {name!r} was accepted"
+
+    @pytest.mark.parametrize("name", ["stale-read", "lost-update",
+                                      "non-monotonic-list-rv"])
+    def test_satellite_required_rejections(self, name):
+        """The three bug classes the issue names explicitly."""
+        assert check_records(linearize.KNOWN_BAD[name])
+
+    @pytest.mark.parametrize("name", sorted(linearize.KNOWN_GOOD))
+    def test_known_good_accepted(self, name):
+        got = check_records(linearize.KNOWN_GOOD[name])
+        assert got == [], [v.render() for v in got]
+
+    def test_self_test_is_green(self):
+        assert linearize.self_test() == []
+        assert watchcheck.self_test() == []
+        assert simcheck.run_self_test() == []
+
+
+class TestWGLSearch:
+    def test_overlapping_ops_explore_both_orders(self):
+        """A read overlapping a CAS may legally see either the old or the
+        new RV; a read AFTER the CAS returned may only see the new one."""
+        base = [_rec("create", rv=1, t=(0, 1)),
+                _rec("update", expected=1, rv=2, t=(2, 6))]
+        ok_old = base + [_rec("get", rv=1, t=(3, 5))]   # overlaps the CAS
+        ok_new = base + [_rec("get", rv=2, t=(3, 5))]
+        bad = base + [_rec("get", rv=1, t=(7, 8))]      # strictly after
+        assert check_records(ok_old) == []
+        assert check_records(ok_new) == []
+        assert check_records(bad)
+
+    def test_memoized_search_handles_long_sequential_history(self):
+        recs = [_rec("create", rv=1, t=(0, 1))]
+        t, rv = 2, 1
+        for i in range(400):
+            recs.append(_rec("update", expected=rv, rv=rv + 1, t=(t, t + 1)))
+            rv += 1
+            t += 2
+        assert check_records(recs) == []
+
+    def test_search_budget_is_enforced(self):
+        # 8 fully-overlapping RMWs with distinct RVs followed by a read
+        # no order can satisfy: the search must refute every (mask, last-
+        # writer) configuration — ~8·2^8 states — before giving up, so a
+        # budget of 200 trips first.
+        recs = [_rec("create", rv=100, t=(-2, -1))]
+        recs += [_rec("patch", rv=i, t=(0, 10)) for i in range(1, 9)]
+        recs.append(_rec("get", rv=999, t=(11, 12)))
+        ops = build_key_histories(recs)
+        (key, key_ops), = ops.items()
+        with pytest.raises(SearchBudgetExceeded):
+            linearize_key(key_ops, key=key, max_configs=200)
+
+    def test_failure_report_names_pending_ops(self):
+        res = linearize_key(
+            build_key_histories(linearize.KNOWN_BAD["stale-read"])[
+                ("pods", "default", "a")],
+            key=("pods", "default", "a"))
+        assert not res.ok
+        assert "pending" in res.message()
+
+
+class TestRVTokens:
+    def test_concurrent_writes_may_interleave(self):
+        # Overlapping writes: no real-time order, any RVs are fine.
+        recs = [_rec("create", "a", rv=2, t=(0, 5)),
+                _rec("create", "b", kind="services", rv=1, t=(1, 6))]
+        assert check_rv_tokens(recs) == []
+
+    def test_sequential_writes_must_increase(self):
+        recs = [_rec("create", "a", rv=5, t=(0, 1)),
+                _rec("create", "b", kind="services", rv=4, t=(2, 3))]
+        out = check_rv_tokens(recs)
+        assert out and out[0].checker == "rv-monotonicity"
+
+    def test_list_rv_may_repeat_but_not_regress(self):
+        ok = [_rec("list_with_rv", None, items=(), rv=7, t=(0, 1)),
+              _rec("list_with_rv", None, items=(), rv=7, t=(2, 3))]
+        assert check_rv_tokens(ok) == []
+
+
+# ---------------------------------------------------------------------------
+# The store recording hook
+# ---------------------------------------------------------------------------
+
+class TestRecorderHook:
+    def test_detached_store_has_zero_footprint(self):
+        store = ObjectStore()
+        baseline_dict = set(store.__dict__)
+        rec = HistoryRecorder()
+        store.attach_recorder(rec)
+        assert set(store.__dict__) - baseline_dict >= set(
+            ObjectStore.RECORDED_OPS)
+        store.detach_recorder()
+        # Back to plain class-method dispatch: no wrapper attrs remain.
+        assert not (set(store.__dict__) & set(ObjectStore.RECORDED_OPS))
+        assert store.create.__func__ is ObjectStore.create
+
+    def test_double_attach_refused(self):
+        store = ObjectStore()
+        store.attach_recorder(HistoryRecorder())
+        with pytest.raises(RuntimeError):
+            store.attach_recorder(HistoryRecorder())
+        store.detach_recorder()
+
+    def test_errors_recorded_with_class_name(self):
+        store = ObjectStore()
+        rec = HistoryRecorder()
+        store.attach_recorder(rec)
+        created = store.create("pods", _pod("x"))
+        stale = _pod("x")
+        stale.metadata.resource_version = "999"
+        with pytest.raises(Conflict):
+            store.update("pods", stale)
+        store.detach_recorder()
+        recs = rec.records()
+        assert [r.op for r in recs] == ["create", "update"]
+        assert recs[1].err == "Conflict"
+        assert recs[1].expected_rv == 999
+        assert int(created.metadata.resource_version) == recs[0].rv
+
+    def test_plain_list_routes_through_recorded_list_with_rv(self):
+        store = ObjectStore()
+        rec = HistoryRecorder()
+        store.attach_recorder(rec)
+        store.create("pods", _pod("x"))
+        store.list("pods", "default")
+        store.detach_recorder()
+        assert [r.op for r in rec.records()] == ["create", "list_with_rv"]
+        lst = rec.records()[-1]
+        assert lst.items and lst.items[0][1] == "x"
+
+    def test_real_history_checks_clean(self):
+        store = ObjectStore()
+        rec = HistoryRecorder()
+        store.attach_recorder(rec)
+        store.create("pods", _pod("x"))
+        got = store.get("pods", "default", "x")
+        got.metadata.labels["a"] = "b"
+        store.update("pods", got)
+        store.delete("pods", "default", "x", cascade=False)
+        store.detach_recorder()
+        assert check_records(rec.records()) == []
+
+
+class TestRVMonotonicityProperty:
+    """The satellite property test: strict cross-kind RV monotonicity
+    under concurrent writers, on the sharded store AND the global-lock
+    baseline (whose one lock must not change the contract)."""
+
+    @pytest.mark.parametrize("sharded", [True, False])
+    def test_concurrent_writers_all_kinds(self, sharded):
+        store = ObjectStore(sharded=sharded)
+        rec = HistoryRecorder()
+        store.attach_recorder(rec)
+        kinds = ("pods", "services", "tfjobs")
+        stop = threading.Event()
+        errors = []
+
+        def writer(kind, idx):
+            i = 0
+            try:
+                while not stop.is_set():
+                    name = f"{kind}-{(i + idx) % 6}"
+                    try:
+                        store.create(kind, _pod(name))
+                    except Exception:
+                        try:
+                            obj = store.get(kind, "default", name)
+                            store.update(kind, obj)
+                        except Exception:
+                            pass
+                    if i % 5 == 0:
+                        try:
+                            store.delete(kind, "default", name,
+                                         cascade=False)
+                        except Exception:
+                            pass
+                    store.list_with_rv(kind, "default")
+                    i += 1
+            except BaseException as e:  # pragma: no cover - diagnostic
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(k, j),
+                                    name=f"rvprop-{k}-{j}", daemon=True)
+                   for k in kinds for j in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.25)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        store.detach_recorder()
+        assert not errors
+        records = rec.records()
+        assert len(records) > 100
+        assert check_rv_tokens(records) == []
+        # And the per-key WGL pass holds on the same history.
+        assert check_records(records) == []
+
+
+# ---------------------------------------------------------------------------
+# Watch-delivery exactness
+# ---------------------------------------------------------------------------
+
+class TestWatchcheck:
+    @pytest.mark.parametrize("name", sorted(watchcheck.KNOWN_BAD_STREAMS))
+    def test_known_bad_streams_rejected(self, name):
+        events, oracle = watchcheck.KNOWN_BAD_STREAMS[name]
+        assert watchcheck.verify_stream(events, oracle=oracle, label=name)
+
+    def test_good_stream_accepted(self):
+        events, oracle = watchcheck.KNOWN_GOOD_STREAM
+        assert watchcheck.verify_stream(events, oracle=oracle) == []
+
+    def test_overflow_drop_resume_is_exact(self):
+        """A slow consumer on a tiny bounded queue is dropped and
+        transparently RV-resumed by the store; its merged stream must
+        still be exactly-once, ordered, and gap-free vs the oracle."""
+        store = ObjectStore(watch_cache_size=65536, watch_queue_size=8)
+        oracle = watchcheck.ShadowConsumer(store, "pods", max_queue=0,
+                                           name="oracle").start()
+        slow = watchcheck.ShadowConsumer(store, "pods", name="slow",
+                                         slow_every=2, slow_us=500).start()
+        for i in range(300):
+            store.create("pods", _pod(f"p-{i:03d}"))
+        time.sleep(0.3)
+        for c in (slow, oracle):
+            c.stop()
+            c.drain()
+        overflows = sum(sh.overflows for sh in store._shards.values())
+        assert overflows > 0, "queue never overflowed: test mis-sized"
+        out = watchcheck.verify_consumers({"pods": oracle}, [slow])
+        assert out == [], [v.render() for v in out]
+        assert slow.events, "slow consumer saw nothing"
+
+    def test_crash_point_resume_is_exact(self):
+        store = ObjectStore(watch_cache_size=65536)
+        oracle = watchcheck.ShadowConsumer(store, "pods", max_queue=0,
+                                           name="oracle").start()
+        victim = watchcheck.ShadowConsumer(store, "pods",
+                                           name="victim").start()
+        for i in range(100):
+            store.create("pods", _pod(f"p-{i:03d}"))
+            if i % 25 == 10:
+                victim.crash()
+        time.sleep(0.3)
+        for c in (victim, oracle):
+            c.stop()
+            c.drain()
+        assert victim.crashes >= 1
+        out = watchcheck.verify_consumers({"pods": oracle}, [victim])
+        assert out == [], [v.render() for v in out]
+
+    def test_forced_drop_mid_batch_is_exact(self):
+        store = ObjectStore(watch_cache_size=65536)
+        oracle = watchcheck.ShadowConsumer(store, "pods", max_queue=0,
+                                           name="oracle").start()
+        c = watchcheck.ShadowConsumer(store, "pods", name="dropped").start()
+        total_dropped = 0
+        for i in range(120):
+            store.create("pods", _pod(f"p-{i:03d}"))
+            if i % 40 == 20:
+                # A later drop can land before the consumer re-subscribed
+                # from the previous one (it is then not in the watcher
+                # list) — only the total matters.
+                total_dropped += store.drop_watchers(
+                    "pods", exclude=(oracle.watcher,))
+        assert total_dropped >= 1
+        time.sleep(0.3)
+        for x in (c, oracle):
+            x.stop()
+            x.drain()
+        out = watchcheck.verify_consumers({"pods": oracle}, [c])
+        assert out == [], [v.render() for v in out]
+
+    def test_negative_control_lost_event_is_flagged(self):
+        """End-to-end negative: silently drop one delivered event from a
+        consumer's log and the verifier must report the gap."""
+        store = ObjectStore(watch_cache_size=65536)
+        oracle = watchcheck.ShadowConsumer(store, "pods", max_queue=0,
+                                           name="oracle").start()
+        c = watchcheck.ShadowConsumer(store, "pods", name="lossy").start()
+        for i in range(30):
+            store.create("pods", _pod(f"p-{i:03d}"))
+        time.sleep(0.2)
+        for x in (c, oracle):
+            x.stop()
+            x.drain()
+        assert len(c.events) >= 10
+        del c.events[4]  # the injected delivery bug
+        out = watchcheck.verify_consumers({"pods": oracle}, [c])
+        assert any("gap" in v.message for v in out)
+
+
+# ---------------------------------------------------------------------------
+# The simulation driver
+# ---------------------------------------------------------------------------
+
+class TestSimcheck:
+    def test_one_seed_clean_with_injection(self):
+        out = simcheck.run_seed(7, duration_s=0.25)
+        assert out["violations"] == [], \
+            [v.render() for v in out["violations"]]
+        assert out["ops"] > 200
+        assert out["drops"] >= 1
+        assert all(n > 0 for n in out["events"].values())
+
+    def test_repro_command_round_trips_the_seed(self):
+        cmd = simcheck.repro_command(42, 0.5)
+        assert "KCTPU_FUZZ_SEED=42" in cmd
+        assert "--seeds 42" in cmd
+        assert "simcheck" in cmd
+
+    def test_main_json_envelope(self, capsys, monkeypatch):
+        monkeypatch.delenv("KCTPU_FUZZ_SEED", raising=False)
+        rc = simcheck.main(["--self-test", "--seeds", "9",
+                            "--duration", "0.15", "--json"])
+        captured = capsys.readouterr()
+        import json
+
+        doc = json.loads(captured.out)
+        assert rc == 0
+        assert doc["tool"] == "kctpu-check"
+        assert doc["schema_version"] == 1
+        assert doc["clean"] is True
+        assert doc["self_test"] is True
+        assert doc["findings"] == []
+
+    def test_failing_seed_exports_env_and_prints_repro(self, capsys,
+                                                      monkeypatch):
+        monkeypatch.delenv("KCTPU_FUZZ_SEED", raising=False)
+
+        def broken_run_seed(seed, duration_s=0.5):
+            return {"seed": seed, "ops": 0, "keys": 0, "events": {},
+                    "drops": 0, "crashes": 0, "overflow_drops": 0,
+                    "violations": [linearize.Violation(
+                        "linearizability", "pods/default/a", "boom")]}
+
+        monkeypatch.setattr(simcheck, "run_seed", broken_run_seed)
+        rc = simcheck.main(["--seeds", "13", "--duration", "0.1"])
+        captured = capsys.readouterr()
+        assert rc == 1
+        assert os.environ.get("KCTPU_FUZZ_SEED") == "13"
+        assert "repro: KCTPU_FUZZ_SEED=13" in captured.out
+
+
+# ---------------------------------------------------------------------------
+# interleave.py exception-path fixes (satellite)
+# ---------------------------------------------------------------------------
+
+class TestInterleaveExceptionPaths:
+    def test_run_seed_restores_on_scenario_exception(self):
+        from kubeflow_controller_tpu.utils import locks
+
+        before = sys.getswitchinterval()
+        assert locks.get_fuzzer() is None
+
+        def explode(duration_s):
+            raise AssertionError("scenario blew up")
+
+        with pytest.raises(AssertionError):
+            interleave.run_seed(5, 0.05, scenarios={"explode": explode})
+        assert sys.getswitchinterval() == pytest.approx(before)
+        assert locks.get_fuzzer() is None
+        # A fresh checker installed by run_seed is also torn down.
+        if os.environ.get("KCTPU_LOCKCHECK", "") in ("", "0"):
+            assert lockcheck.installed() is None
+
+    def test_failed_scenario_prints_repro_and_exports_seed(self, capsys,
+                                                           monkeypatch):
+        monkeypatch.delenv("KCTPU_FUZZ_SEED", raising=False)
+
+        def explode(duration_s):
+            raise AssertionError("injected failure")
+
+        monkeypatch.setitem(interleave.SCENARIOS, "store", explode)
+        rc = interleave.main(["--seeds", "17", "--duration", "0.05",
+                              "--scenario", "store"])
+        captured = capsys.readouterr()
+        assert rc == 1
+        assert os.environ.get("KCTPU_FUZZ_SEED") == "17"
+        assert "repro: KCTPU_FUZZ_SEED=17" in captured.out
+        assert "--scenario store" in captured.out
+
+    def test_repro_command_format(self):
+        cmd = interleave.repro_command(101, 0.5, "workqueue")
+        assert cmd.startswith("KCTPU_FUZZ_SEED=101 ")
+        assert "--seeds 101" in cmd and "--scenario workqueue" in cmd
