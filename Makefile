@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all ci test test-fast lint typecheck cov cov-local bench dryrun validate vet race-smoke check-smoke metrics-smoke scale-smoke stall-smoke widejob-smoke churn-smoke store-smoke sched-smoke ttfs-smoke chaos-smoke
+.PHONY: all ci test test-fast lint typecheck cov cov-local bench dryrun validate vet race-smoke check-smoke metrics-smoke scale-smoke stall-smoke widejob-smoke churn-smoke store-smoke sched-smoke ttfs-smoke chaos-smoke ha-smoke
 
 all: lint vet test race-smoke check-smoke
 
@@ -15,7 +15,7 @@ all: lint vet test race-smoke check-smoke
 # included), then tier-1 under the runtime lock-order detector.  Run
 # without -j: the order is the diagnosis ladder (cheapest, most precise
 # signal first).
-ci: vet race-smoke check-smoke
+ci: vet race-smoke check-smoke ha-smoke
 	KCTPU_LOCKCHECK=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m "not slow"
 
 # Fast/slow split: `test-fast` (-m "not slow") is the quick signal — 214 of
@@ -89,11 +89,14 @@ race-smoke:
 # writers/watchers under schedule fuzz with forced stream drops
 # mid-batch, bounded-queue overflow drops, and watcher crash-points
 # (killed mid-replay, RV-resumed) — must report zero linearizability,
-# RV-monotonicity, or delivery violations.  A red seed prints its exact
-# one-line repro and exports KCTPU_FUZZ_SEED.  ~8 s (docs/ANALYSIS.md).
+# RV-monotonicity, or delivery violations.  --crash-restart additionally
+# reruns each seed against a WAL-backed store that is killed mid-run and
+# recovered (ha/wal.py), with the checkers spanning the boundary.  A red
+# seed prints its exact one-line repro and exports KCTPU_FUZZ_SEED.
+# ~15 s (docs/ANALYSIS.md).
 check-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m kubeflow_controller_tpu.analysis.simcheck \
-		--self-test --seeds 11,22,33 --duration 0.5
+		--self-test --seeds 11,22,33 --duration 0.5 --crash-restart
 
 validate:
 	$(PY) -m kubeflow_controller_tpu.cli validate -f examples/jobs/
@@ -242,6 +245,30 @@ chaos-smoke:
 		      '| max lost steps', d['details']['max_lost_steps'], \
 		      '/', d['details']['checkpoint_every'], \
 		      '| never-probe', d['details']['never_probe']['reason'][:40])"
+
+# HA smoke (the control plane's standing availability gate): 2 controller
+# candidates over one WAL-backed store; the leader is SIGKILLed mid-storm
+# (lease renewals stop dead, its controller keeps running as a zombie).
+# Gates (docs/HA.md; measured: failover ~0.4 s at a 0.5 s lease, shard
+# speedup ~3x — HA_r01.json): failover < 2x the lease duration, the
+# deposed leader's writes ALL bounce off the fencing token (>= 1
+# rejection, zero accepted), zero lost reconciles (every job Succeeded),
+# WAL replay rebuilds an RV-identical store, the crash-restart
+# deterministic-simulation seed passes the PR-11 linearizability +
+# watch-exactness checkers across the recover boundary, and 4-shard
+# --scale 200 syncs/sec >= 1.5x single-controller over REST with 3 ms
+# injected RTT.  ~60-90 s wall-clock.
+ha-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --ha --controllers 4 --ha-scale 200 \
+		--kill-leader --max-failover-ratio 2.0 --min-shard-speedup 1.5 \
+		> /tmp/kctpu_ha_smoke.json
+	@$(PY) -c "import json; d = json.load(open('/tmp/kctpu_ha_smoke.json')); \
+		assert {'metric', 'value', 'unit', 'details'} <= set(d), d; \
+		print('ha-smoke ok: failover', d['value'], 's', \
+		      '| fencing rejections', d['details']['fencing_rejections'], \
+		      '| replay', d['details']['wal_replay_s'], 's rv-identical', \
+		      d['details']['wal_rv_identical'], \
+		      '| shard speedup', d['details']['shard_speedup'], 'x')"
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -358,7 +358,8 @@ def run_store_stress(sharded: bool, duration_s: float = 2.0,
     store = ObjectStore(sharded=sharded)
     for kind in kinds:
         for i in range(n_objects):
-            store.create(kind, Pod(metadata=ObjectMeta(
+            # Direct-store load generator, not a controller path: unfenced.
+            store.create(kind, Pod(metadata=ObjectMeta(  # kctpu: vet-ok(fencing-token)
                 name=f"{kind}-{i:04d}", namespace="default")))
 
     stop = threading.Event()
@@ -374,7 +375,7 @@ def run_store_stress(sharded: bool, duration_s: float = 2.0,
         while not stop.is_set():
             obj = store.get(kind, "default", f"{kind}-{i % n_objects:04d}")
             obj.status.phase = "Running"
-            store.update(kind, obj)
+            store.update(kind, obj)  # kctpu: vet-ok(fencing-token) — stress driver
             ops[slot] += 2
             i += 1
 
@@ -641,6 +642,341 @@ def run_churn(n_jobs: int, drops: int = 4, drop_interval_s: float = 0.4,
         "storm_reconcile_p99_s": storm_p99,
         "metrics": snap,
     }
+
+
+def run_ha(controllers: int = 4, n_jobs: int = 24, lease_s: float = 0.5,
+           kill_leader: bool = True, run_s: float = 0.4, seed: int = 11,
+           deadline_s: float = 120.0) -> dict:
+    """HA control-plane drill: kill the leader mid-storm, gate failover +
+    zero lost reconciles + split-brain fencing + WAL replay exactness.
+
+    Two controller candidates (each a full Controller with
+    ``controllers`` shard workers, built lazily on LeaderElected and
+    hard-stopped on LeaderLost) contend for the lease stored in the SAME
+    WAL-backed store they control.  Mid-storm the leader is "SIGKILLed"
+    (``LeaseManager.kill()``: renewals stop dead, no release, no
+    callbacks) while its controller keeps running as a zombie — whose
+    in-flight writes the store must reject by fencing token once the
+    standby's acquire lands.  Afterwards the store is recovered from its
+    WAL and compared state-identically, and a crash-restart
+    deterministic-simulation seed runs the PR-11 linearizability +
+    watch-exactness checkers across a recover boundary
+    (analysis/simcheck.py run_crash_restart_seed)."""
+    import shutil
+    import tempfile
+
+    from kubeflow_controller_tpu.api.core import Container, PodTemplateSpec
+    from kubeflow_controller_tpu.api.meta import ObjectMeta
+    from kubeflow_controller_tpu.api.tfjob import (
+        ReplicaType,
+        TFJob,
+        TFJobPhase,
+        TFReplicaSpec,
+    )
+    from kubeflow_controller_tpu.analysis import simcheck
+    from kubeflow_controller_tpu.cluster import Cluster, FakeKubelet, PhasePolicy
+    from kubeflow_controller_tpu.cluster.store import ObjectStore
+    from kubeflow_controller_tpu.controller import Controller
+    from kubeflow_controller_tpu.ha.lease import LeaseManager
+    from kubeflow_controller_tpu.ha.wal import WriteAheadLog
+    from kubeflow_controller_tpu.obs.metrics import REGISTRY
+
+    def mk_sim_job(name: str) -> TFJob:
+        job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+        for typ, n in ((ReplicaType.PS, 1), (ReplicaType.WORKER, 2)):
+            t = PodTemplateSpec()
+            t.spec.containers.append(Container(name="tensorflow", image="img"))
+            t.spec.restart_policy = "OnFailure"
+            job.spec.tf_replica_specs.append(
+                TFReplicaSpec(replicas=n, tf_replica_type=typ, template=t))
+        return job
+
+    wal_dir = tempfile.mkdtemp(prefix="kctpu-ha-wal-")
+    wal = WriteAheadLog(wal_dir, fsync=True)
+    store = ObjectStore(wal=wal)
+    node_cluster = Cluster(store=store)
+    kubelet = FakeKubelet(node_cluster, policy=PhasePolicy(run_s=run_s))
+
+    class Candidate:
+        """One control-plane process: lease candidacy + a controller that
+        exists only while (it believes) it is the leader."""
+
+        def __init__(self, ident: str):
+            self.cluster = Cluster(store=store)
+            self.ctrl = None
+            self.elected_at = 0.0
+            self.mgr = LeaseManager(
+                self.cluster.leases, ident, duration_s=lease_s,
+                shards=controllers,
+                on_elected=self._up, on_lost=self._down)
+            self.cluster.set_fence_provider(self.mgr.token)
+
+        def _up(self, gen: int) -> None:
+            self.elected_at = time.time()
+            self.ctrl = Controller(self.cluster, resync_period_s=1.0,
+                                   controller_shards=controllers)
+            self.ctrl.run(threadiness=1)
+
+        def _down(self) -> None:
+            ctrl, self.ctrl = self.ctrl, None
+            if ctrl is not None:
+                ctrl.stop()
+
+        def hard_stop(self) -> None:
+            if self.ctrl is not None:
+                self.ctrl.stop()
+                self.ctrl = None
+
+    fence_counter = REGISTRY.counter("kctpu_ha_fencing_rejections_total", "")
+    a = Candidate("ctrl-a")
+    b = Candidate("ctrl-b")
+    kubelet.start()
+    names = [f"ha-{i:03d}" for i in range(n_jobs)]
+    failover_s = -1.0
+    fencing_rejections = 0
+    try:
+        a.mgr.start()
+        t0 = time.time()
+        while not a.mgr.is_leader and time.time() < t0 + 10:
+            time.sleep(0.01)
+        assert a.mgr.is_leader, "first candidate never elected"
+        b.mgr.start()
+
+        t0 = time.time()
+        for n in names:
+            node_cluster.tfjobs.create(mk_sim_job(n))
+
+        def succeeded() -> int:
+            return sum(1 for j in node_cluster.tfjobs.list("default")
+                       if j.status.phase == TFJobPhase.SUCCEEDED)
+
+        # Mid-storm: some jobs done, most still reconciling.
+        while succeeded() < max(1, n_jobs // 4) and time.time() < t0 + deadline_s:
+            time.sleep(0.02)
+        if kill_leader:
+            fence_base = fence_counter.value
+            t_kill = time.time()
+            a.mgr.kill()  # renewals stop dead; controller keeps running (zombie)
+            while not b.mgr.is_leader and time.time() < t_kill + 10 * lease_s:
+                time.sleep(0.005)
+            assert b.mgr.is_leader, "standby never took over"
+            failover_s = time.time() - t_kill
+            # Zombie window: the deposed controller keeps running and any
+            # write it still has in flight must bounce off the fence.  Its
+            # organic write rate depends on how much of the storm is left,
+            # so ALSO drive a deterministic batch of writes through its
+            # fenced clients — the "in-flight status updates at the moment
+            # of deposal" every failover has.
+            from kubeflow_controller_tpu.cluster.store import FencingError
+
+            def mark(m):
+                m.annotations["ha-zombie-write"] = "1"
+
+            for j in node_cluster.tfjobs.list("default")[:8]:
+                try:
+                    a.cluster.tfjobs.patch_meta(
+                        j.metadata.namespace, j.metadata.name, mark)
+                    raise AssertionError(
+                        "deposed leader write was ACCEPTED (split-brain)")
+                except FencingError:
+                    pass
+            time.sleep(2 * lease_s)
+            fencing_rejections = int(fence_counter.value - fence_base)
+            a.hard_stop()
+
+        pending = set(names)
+        while pending and time.time() < t0 + deadline_s:
+            for j in node_cluster.tfjobs.list("default"):
+                if j.status.phase in (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED):
+                    pending.discard(j.metadata.name)
+            time.sleep(0.05)
+        lost = sorted(
+            j.metadata.name for j in node_cluster.tfjobs.list("default")
+            if j.status.phase != TFJobPhase.SUCCEEDED)
+        storm_elapsed = time.time() - t0
+    finally:
+        a.mgr.stop(release=False)
+        b.mgr.stop(release=False)
+        a.hard_stop()
+        b.hard_stop()
+        kubelet.stop()
+        wal.flush()
+
+    # WAL replay: the recovered store must be state-identical (objects,
+    # RV counter, uid counter) to the one that just ran the storm.
+    wal_size = wal.size_bytes()
+    state_before = store.export_state()
+    t_replay = time.perf_counter()
+    recovered = ObjectStore.recover(WriteAheadLog(wal_dir, fsync=False))
+    replay_s = time.perf_counter() - t_replay
+    rv_identical = recovered.export_state() == state_before
+
+    # Model-check a crash-restart boundary with the PR-11 checkers.
+    crash_check = simcheck.run_crash_restart_seed(seed, duration_s=0.4)
+    shutil.rmtree(wal_dir, ignore_errors=True)
+    return {
+        "controllers": controllers,
+        "jobs": n_jobs,
+        "lease_s": lease_s,
+        "kill_leader": kill_leader,
+        "failover_s": failover_s,
+        "fencing_rejections": fencing_rejections,
+        "lost_reconciles": lost,
+        "storm_elapsed_s": storm_elapsed,
+        "wal_replay_s": replay_s,
+        "wal_size_bytes": wal_size,
+        "wal_rv_identical": rv_identical,
+        "crash_restart_check": {
+            "seed": seed,
+            "ops": crash_check["ops"],
+            "wal_records": crash_check["wal_records"],
+            "rv_identical": crash_check["rv_identical"],
+            "violations": [v.render() for v in crash_check["violations"]],
+        },
+    }
+
+
+def run_ha_scale(n_jobs: int, shards: int, rtt_ms: float = 3.0,
+                 deadline_s: float = 0.0) -> dict:
+    """Shard-scaling probe: the --scale workload with the controller on
+    the REST transport against an API server with injected RTT — the
+    regime sharding exists for, where each sync worker blocks on real
+    round-trips and N shard workers genuinely overlap them.  Reports
+    syncs/sec; bench --ha runs it at 1 shard and at N and gates the
+    ratio (ISSUE 12: 4-shard --scale 200 >= 1.5x single-controller)."""
+    from kubeflow_controller_tpu.api.core import Container, PodTemplateSpec
+    from kubeflow_controller_tpu.api.meta import ObjectMeta
+    from kubeflow_controller_tpu.api.tfjob import (
+        ReplicaType,
+        TFJob,
+        TFJobPhase,
+        TFReplicaSpec,
+    )
+    from kubeflow_controller_tpu.cluster import Cluster, FakeKubelet, PhasePolicy
+    from kubeflow_controller_tpu.cluster.apiserver import FakeAPIServer
+    from kubeflow_controller_tpu.cluster.rest import Kubeconfig, RestCluster
+    from kubeflow_controller_tpu.controller import Controller
+
+    def mk_sim_job(name: str) -> TFJob:
+        job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+        for typ, n in ((ReplicaType.PS, 1), (ReplicaType.WORKER, 2)):
+            t = PodTemplateSpec()
+            t.spec.containers.append(Container(name="tensorflow", image="img"))
+            t.spec.restart_policy = "OnFailure"
+            job.spec.tf_replica_specs.append(
+                TFReplicaSpec(replicas=n, tf_replica_type=typ, template=t))
+        return job
+
+    cluster = Cluster()
+    server = FakeAPIServer(cluster.store, latency_s=rtt_ms / 1000.0)
+    url = server.start()
+    rest = RestCluster(Kubeconfig(server=url))
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=0.05))
+    ctrl = Controller(rest, resync_period_s=5.0, controller_shards=shards)
+    kubelet.start()
+    ctrl.run(threadiness=1)
+    if not deadline_s:
+        deadline_s = max(120.0, 3.0 * n_jobs)
+    names = [f"hascale-{i:04d}" for i in range(n_jobs)]
+    try:
+        t0 = time.time()
+        for n in names:
+            cluster.tfjobs.create(mk_sim_job(n))
+        pending = set(names)
+        failed = []
+        while pending and time.time() < t0 + deadline_s:
+            for j in cluster.tfjobs.list("default"):
+                if j.metadata.name not in pending:
+                    continue
+                if j.status.phase == TFJobPhase.SUCCEEDED:
+                    pending.discard(j.metadata.name)
+                elif j.status.phase == TFJobPhase.FAILED:
+                    pending.discard(j.metadata.name)
+                    failed.append(j.metadata.name)
+            if pending:
+                time.sleep(0.05)
+        elapsed = time.time() - t0
+        snap = ctrl.metrics.snapshot()
+    finally:
+        ctrl.stop()
+        kubelet.stop()
+        rest.close()
+        server.stop()
+    return {
+        "jobs": n_jobs,
+        "shards": shards,
+        "rtt_ms": rtt_ms,
+        "elapsed_s": elapsed,
+        "timed_out": sorted(pending),
+        "failed": failed,
+        "syncs": snap["syncs"],
+        "syncs_per_sec": snap["syncs"] / elapsed if elapsed else 0.0,
+        "reconcile_p50_ms": snap["reconcile_p50_s"] * 1e3,
+        "reconcile_p99_ms": snap["reconcile_p99_s"] * 1e3,
+    }
+
+
+def ha_main(args) -> int:
+    failover = run_ha(controllers=args.controllers, n_jobs=args.ha_jobs,
+                      lease_s=args.lease_s, kill_leader=args.kill_leader,
+                      seed=args.seed)
+    single = run_ha_scale(args.ha_scale, shards=1, rtt_ms=args.rtt_ms or 3.0)
+    sharded = run_ha_scale(args.ha_scale, shards=args.controllers,
+                           rtt_ms=args.rtt_ms or 3.0)
+    speedup = (sharded["syncs_per_sec"] / single["syncs_per_sec"]
+               if single["syncs_per_sec"] else 0.0)
+    out = {
+        "metric": "ha_failover_seconds",
+        "value": round(failover["failover_s"], 3),
+        "unit": "s",
+        "details": {
+            **{k: (round(v, 4) if isinstance(v, float) else v)
+               for k, v in failover.items()},
+            "scale_single": single,
+            "scale_sharded": sharded,
+            "shard_speedup": round(speedup, 3),
+        },
+    }
+    print(json.dumps(out, indent=2))
+    ok = True
+    if args.kill_leader:
+        if failover["failover_s"] < 0:
+            print("GATE FAIL: leader was never killed / standby never "
+                  "elected", file=sys.stderr)
+            ok = False
+        elif (args.max_failover_ratio > 0
+              and failover["failover_s"] > args.max_failover_ratio * args.lease_s):
+            print(f"GATE FAIL: failover {failover['failover_s']:.3f}s > "
+                  f"{args.max_failover_ratio} x lease {args.lease_s}s",
+                  file=sys.stderr)
+            ok = False
+        if failover["fencing_rejections"] <= 0:
+            print("GATE FAIL: zombie leader produced zero fencing "
+                  "rejections (split-brain not exercised)", file=sys.stderr)
+            ok = False
+    if failover["lost_reconciles"]:
+        print(f"GATE FAIL: lost reconciles (jobs not Succeeded): "
+              f"{failover['lost_reconciles']}", file=sys.stderr)
+        ok = False
+    if not failover["wal_rv_identical"]:
+        print("GATE FAIL: WAL replay did not rebuild an RV-identical store",
+              file=sys.stderr)
+        ok = False
+    if (failover["crash_restart_check"]["violations"]
+            or not failover["crash_restart_check"]["rv_identical"]):
+        print(f"GATE FAIL: crash-restart model check: "
+              f"{failover['crash_restart_check']['violations']}",
+              file=sys.stderr)
+        ok = False
+    if single["timed_out"] or single["failed"] or sharded["timed_out"] or sharded["failed"]:
+        print("GATE FAIL: scale probe did not converge", file=sys.stderr)
+        ok = False
+    if args.min_shard_speedup > 0 and speedup < args.min_shard_speedup:
+        print(f"GATE FAIL: {args.controllers}-shard syncs/sec only "
+              f"{speedup:.2f}x single-controller "
+              f"(< {args.min_shard_speedup})", file=sys.stderr)
+        ok = False
+    return 0 if ok else 1
 
 
 def _pct(values, q):
@@ -1865,6 +2201,35 @@ def main(argv=None) -> int:
                    metavar="MS",
                    help="store-contention mode: exit nonzero when the worst "
                         "shard's lock-wait p99 exceeds MS (-1 = no gate)")
+    p.add_argument("--ha", action="store_true",
+                   help="HA control-plane drill: kill-the-leader-mid-storm "
+                        "(failover time, fencing rejections, zero lost "
+                        "reconciles, WAL replay exactness, crash-restart "
+                        "model check) + 1-vs-N-shard --scale syncs/sec "
+                        "over REST with --rtt-ms injected latency "
+                        "(make ha-smoke; docs/HA.md)")
+    p.add_argument("--controllers", type=int, default=4, metavar="N",
+                   help="--ha: controller shard workers (and the sharded "
+                        "side of the 1-vs-N scale probe; default 4)")
+    p.add_argument("--ha-jobs", type=int, default=24, metavar="N",
+                   help="--ha: jobs in the failover storm (default 24)")
+    p.add_argument("--ha-scale", type=int, default=200, metavar="N",
+                   help="--ha: jobs in the 1-vs-N shard scale probe "
+                        "(default 200)")
+    p.add_argument("--lease-s", type=float, default=0.5, metavar="S",
+                   help="--ha: leader lease duration (default 0.5)")
+    p.add_argument("--kill-leader", action="store_true",
+                   help="--ha: SIGKILL the leader mid-storm (lease "
+                        "renewals stop dead, controller keeps running as "
+                        "a fenced-off zombie)")
+    p.add_argument("--max-failover-ratio", type=float, default=0.0,
+                   metavar="R",
+                   help="--ha gate: failover must beat R x lease duration "
+                        "(0 = no gate; ISSUE 12 gates 2.0)")
+    p.add_argument("--min-shard-speedup", type=float, default=0.0,
+                   metavar="X",
+                   help="--ha gate: N-shard syncs/sec must be >= X x "
+                        "single-controller (0 = no gate; ISSUE 12 gates 1.5)")
     p.add_argument("--record-history", action="store_true",
                    help="scale mode: attach the linearizability checker's "
                         "op recorder to the store and gate cross-kind RV "
@@ -1873,6 +2238,8 @@ def main(argv=None) -> int:
                         "(off = zero-cost, the hook is not installed)")
     args = p.parse_args(argv)
 
+    if args.ha:
+        return ha_main(args)
     if args.scale and args.store_contention:
         return store_contention_main(args)
     if args.scale:

@@ -212,6 +212,151 @@ def run_seed(seed: int, duration_s: float = 0.5,
     return results
 
 
+def run_crash_restart_seed(seed: int, duration_s: float = 0.5,
+                           writers_per_kind: int = 2,
+                           max_configs: int = 2_000_000) -> dict:
+    """Crash-restart injection (``kctpu check --crash-restart``): the same
+    seeded writers/consumers, but against a **WAL-backed** store that is
+    killed mid-run and rebuilt with ``ObjectStore.recover`` (ha/wal.py) —
+    the PR-11 checkers then run over a history SPANNING the boundary:
+
+    - linearizability + cross-kind RV monotonicity over the merged
+      pre/post-crash op records (recovery must restore the RV counter
+      exactly: a duplicate or regressing RV after restart is a violation);
+    - watch-delivery exactness for consumers that *resume across the
+      crash*: each ShadowConsumer (oracle included) is crash()-resumed
+      against the recovered store from its last observed RV, so the
+      REBUILT watch cache must replay precisely the tail the consumer had
+      not yet drained when the old store died;
+    - the recovered store must be state-identical (objects, RV, uid) to
+      the crashed one (``export_state`` equality), and every journaled
+      record of a kind must appear in that kind's oracle log — the WAL is
+      the ground truth the oracle is audited against.
+    """
+    import tempfile
+
+    from ..cluster.store import ObjectStore
+    from ..ha.wal import WriteAheadLog
+    from . import lockcheck
+
+    results: dict = {"seed": seed, "crash_restart": True}
+    fresh_checker = lockcheck.installed() is None
+    consumers: List[watchcheck.ShadowConsumer] = []
+    oracles: Dict[str, watchcheck.ShadowConsumer] = {}
+    tmp = tempfile.mkdtemp(prefix="kctpu-crash-restart-")
+    try:
+        interleave.install(seed)
+        checker = lockcheck.install()
+        checker.reset()
+        wal = WriteAheadLog(tmp, fsync=False)  # in-process crash: no power loss
+        store = ObjectStore(watch_cache_size=262144, watch_queue_size=32,
+                            wal=wal)
+        recorder = HistoryRecorder()
+        store.attach_recorder(recorder)
+        for kind in KINDS:
+            oracles[kind] = watchcheck.ShadowConsumer(
+                store, kind, max_queue=0, name=f"oracle-{kind}").start()
+        rng = random.Random(f"{seed}:driver")
+        for kind in KINDS:
+            consumers.append(watchcheck.ShadowConsumer(
+                store, kind, name=f"fast-{kind}").start())
+            consumers.append(watchcheck.ShadowConsumer(
+                store, kind, namespace="default", name=f"slow-{kind}",
+                slow_every=2, slow_us=rng.uniform(400, 900)).start())
+
+        def run_phase(target_store, phase: str, seconds: float) -> None:
+            stop = threading.Event()
+            writers = [_Writer(target_store, kind, seed, i)
+                       for kind in KINDS for i in range(writers_per_kind)]
+            for w in writers:
+                w.name = f"{w.name}-{phase}"
+            threads = [threading.Thread(target=w.run, args=(stop,),
+                                        name=w.name, daemon=True)
+                       for w in writers]
+            for t in threads:
+                t.start()
+            _orig_sleep(seconds)
+            stop.set()
+            for t in threads:
+                t.join(timeout=10.0)
+
+        # Phase 1: load the live store, then CRASH it: writers stop dead,
+        # every stream dies with undrained buffers (the interesting case —
+        # the rebuilt cache must replay what the queues were still holding).
+        run_phase(store, "p1", duration_s / 2)
+        state_at_crash = store.export_state()
+        for kind in KINDS:
+            store.drop_watchers(kind)
+        store.detach_recorder()
+        wal.flush()
+
+        # Restart: recover a second store from the same WAL directory.
+        store2 = ObjectStore.recover(WriteAheadLog(tmp, fsync=False),
+                                     watch_cache_size=262144,
+                                     watch_queue_size=32)
+        rv_identical = store2.export_state() == state_at_crash
+        store2.attach_recorder(recorder)
+        # Resume every consumer (oracles too) against the recovered store
+        # from its last observed RV — the PR-5 client contract, now
+        # crossing a process-death boundary.
+        for c in consumers + list(oracles.values()):
+            c.store = store2
+            c.crash()
+        run_phase(store2, "p2", duration_s / 2)
+
+        for c in consumers + list(oracles.values()):
+            c.stop()
+            c.drain()
+        store2.detach_recorder()
+        wal_records = WriteAheadLog(tmp, fsync=False).replay()
+        report = checker.report()
+    finally:
+        interleave.uninstall()
+        if fresh_checker:
+            lockcheck.uninstall()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    violations: List[Violation] = []
+    if not rv_identical:
+        violations.append(Violation(
+            "wal-replay", "state",
+            "recovered store is not state-identical to the crashed store "
+            "(objects / RV counter / uid counter diverged)"))
+    records = recorder.records()
+    try:
+        violations.extend(linearize.check_records(records,
+                                                  max_configs=max_configs))
+    except linearize.SearchBudgetExceeded as e:
+        violations.append(Violation("linearizability", "budget", str(e)))
+    violations.extend(watchcheck.verify_consumers(oracles, consumers))
+    # WAL-vs-oracle audit: every journaled record must have been delivered
+    # to its kind's oracle (merged across the crash) — the oracle cannot
+    # silently agree with consumers about a lost event.
+    for kind, oracle in oracles.items():
+        seen = {(e.rv, e.type) for e in oracle.events}
+        for rec in wal_records:
+            if rec.kind == kind and (rec.rv, rec.ev) not in seen:
+                violations.append(Violation(
+                    "wal-replay", f"oracle:{kind}",
+                    f"journaled event rv={rec.rv} {rec.ev} never reached "
+                    f"the {kind} oracle across the crash boundary"))
+    if not report.clean:
+        violations.append(Violation("lockcheck", "report", report.render()))
+    results.update({
+        "ops": len(records),
+        "keys": len(linearize.build_key_histories(records)),
+        "events": {k: len(o.events) for k, o in oracles.items()},
+        "wal_records": len(wal_records),
+        "rv_identical": rv_identical,
+        "resumed_consumers": sum(c.crashes for c in consumers)
+        + sum(o.crashes for o in oracles.values()),
+        "violations": violations,
+    })
+    return results
+
+
 def repro_command(seed: int, duration_s: float) -> str:
     return (f"KCTPU_FUZZ_SEED={seed} python -m "
             f"kubeflow_controller_tpu.analysis.simcheck "
@@ -239,6 +384,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--self-test", action="store_true",
                     help="first require every known-bad synthetic "
                          "history/stream fixture to be rejected")
+    ap.add_argument("--crash-restart", action="store_true",
+                    help="also run each seed as a crash-restart injection: "
+                         "a WAL-backed store killed mid-run and recovered "
+                         "(ha/wal.py), with the linearizability + "
+                         "watch-exactness checkers spanning the boundary")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable findings (schema_version 1)")
     args = ap.parse_args(argv)
@@ -277,6 +427,26 @@ def main(argv: Optional[List[str]] = None) -> int:
             failed = True
             os.environ["KCTPU_FUZZ_SEED"] = str(seed)
             lines.append(f"  repro: {repro_command(seed, args.duration)}")
+        if not args.crash_restart:
+            continue
+        out = run_crash_restart_seed(seed, duration_s=args.duration)
+        vs = out["violations"]
+        status = "ok" if not vs else f"FAIL ({len(vs)} violations)"
+        lines.append(
+            f"check crash-restart seed={seed}: {status} ops={out['ops']} "
+            f"keys={out['keys']} wal-records={out['wal_records']} "
+            f"rv-identical={out['rv_identical']} "
+            f"resumed-consumers={out['resumed_consumers']}")
+        for v in vs:
+            findings.append({"seed": seed, "checker": v.checker,
+                             "scope": "crash-restart:" + v.scope,
+                             "message": v.message})
+            lines.append("  " + v.render())
+        if vs:
+            failed = True
+            os.environ["KCTPU_FUZZ_SEED"] = str(seed)
+            lines.append(f"  repro: {repro_command(seed, args.duration)}"
+                         f" --crash-restart")
     if args.as_json:
         print(json.dumps({
             "tool": "kctpu-check", "schema_version": 1,

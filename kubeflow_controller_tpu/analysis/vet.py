@@ -16,6 +16,9 @@ concurrency/controller invariants that actually bite this codebase
   design_doc.md:262-268);
 - ``thread-hygiene``      — every ``threading.Thread`` carries ``name=``
   and ``daemon=True``;
+- ``fencing-token``       — direct store writes carry ``fence=`` (the
+  leader-generation token; docs/HA.md) so a deposed leader's in-flight
+  writes are rejectable — the HA plane's cross-shard invariant;
 - ``metric-prefix`` / ``metric-catalogue`` — registered metric names carry
   the ``kctpu_`` prefix and stay in sync with docs/OBSERVABILITY.md;
 - ``event-reason-style``  — event reasons are CamelCase literals (dynamic
@@ -507,6 +510,50 @@ class RawLockRule(Rule):
                 f"named_condition)")
 
 
+class FencingTokenRule(Rule):
+    name = "fencing-token"
+    doc = ("every direct store write (create/update/update_status/patch/"
+           "patch_meta/update_progress/mark_deleting/delete on a *store "
+           "receiver) must pass fence= — the leader-generation token that "
+           "lets the store reject a deposed leader's in-flight writes "
+           "(docs/HA.md; split-brain is silent corruption otherwise)")
+
+    #: The store's write surface (cluster/store.py) — the exact op set the
+    #: fencing check gates server-side.
+    _WRITE_OPS = frozenset({
+        "create", "update", "update_status", "patch", "patch_meta",
+        "update_progress", "mark_deleting", "delete",
+    })
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        p = ctx.path.replace(os.sep, "/")
+        # The store itself implements the ops; the analysis plane drives
+        # the store directly as a model-checking load generator (not a
+        # controller path — deliberately unfenced).
+        if p.endswith("cluster/store.py") or "/analysis/" in p:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute)
+                    and fn.attr in self._WRITE_OPS):
+                continue
+            recv = _tail_name(fn.value)
+            if "store" not in recv.lower():
+                continue  # typed clients / dicts / unrelated receivers
+            if any(kw.arg == "fence" for kw in node.keywords):
+                continue
+            if ctx.suppressed(self.name, node.lineno):
+                continue
+            yield Finding(
+                ctx.path, node.lineno, node.col_offset, self.name,
+                f"store write .{fn.attr}() on {recv!r} without fence=: "
+                f"writes reachable from controller sync paths must carry "
+                f"the lease generation (or be explicitly marked as a "
+                f"non-leader writer)")
+
+
 class MetricRules(Rule):
     """Two findings families from one scan: ``metric-prefix`` (kctpu_
     prefix on every registered metric) and ``metric-catalogue``
@@ -654,6 +701,7 @@ def all_rules() -> List[Rule]:
         TemplateCopyRule(),
         ThreadHygieneRule(),
         RawLockRule(),
+        FencingTokenRule(),
         MetricRules(),
         EventReasonRule(),
         LockGraphRule(),

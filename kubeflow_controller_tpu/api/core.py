@@ -199,6 +199,31 @@ class EventObject:
     source_component: str = ""
 
 
+@dataclass
+class LeaseSpec:
+    """coordination.k8s.io LeaseSpec analog (ha/lease.py): the leader
+    record plus the two HA extensions the rest of the plane keys off —
+    ``generation`` is the fencing token stamped on every leader write
+    (monotonic across acquisitions), ``shards`` advertises the leader's
+    controller-shard count so the CLI can recompute per-job ownership
+    with no extra coordination (ha/ring.py shard_of)."""
+
+    holder_identity: str = ""
+    lease_duration_s: float = 2.0
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    generation: int = 0
+    shards: int = 1
+
+
+@dataclass
+class Lease:
+    api_version: str = "coordination.k8s.io/v1"
+    kind: str = "Lease"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LeaseSpec = field(default_factory=LeaseSpec)
+
+
 def is_pod_active(pod: Pod) -> bool:
     """active = not Succeeded, not Failed, not being deleted
     (ref: IsPodActive at vendor/.../controller_utils.go:832-840)."""

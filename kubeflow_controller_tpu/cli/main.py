@@ -128,7 +128,19 @@ def cmd_serve(args) -> int:
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
     )
     stop = setup_signal_handler()
-    store = ObjectStore()
+    if args.wal_dir:
+        # Durable mode (docs/HA.md): recover WAL-over-snapshot (an empty
+        # directory recovers to an empty store), then keep journaling.
+        # A restarted `serve` on the same directory comes back
+        # RV-identical — watch clients resume, nothing re-lists.
+        from ..cluster.store import ObjectStore as _Store
+        from ..ha.wal import WriteAheadLog
+
+        store = _Store.recover(WriteAheadLog(args.wal_dir))
+        print(f"recovered store from {args.wal_dir} "
+              f"(rv {store.export_state()['rv']})", flush=True)
+    else:
+        store = ObjectStore()
     _, kubelet = _build_substrate(args, Cluster(store=store))
     server = FakeAPIServer(store, token=args.token, port=args.port,
                            kubelet=kubelet)
@@ -188,6 +200,51 @@ def _progress_cells(j) -> tuple:
     return step, f"{p.examples_per_sec:g}"
 
 
+def _fetch_lease(cluster):
+    """The controller leader lease, or None (no HA control plane / server
+    unreachable) — what `get`/`describe`/`top` surface leadership from."""
+    from ..ha.lease import LEASE_NAME, LEASE_NAMESPACE
+
+    try:
+        return cluster.leases.get(LEASE_NAMESPACE, LEASE_NAME)
+    except APIError:
+        return None
+
+
+def _lease_live(lease) -> bool:
+    held_until = (max(lease.spec.renew_time, lease.spec.acquire_time)
+                  + lease.spec.lease_duration_s)
+    return bool(lease.spec.holder_identity) and time.time() < held_until
+
+
+def _leader_line(lease) -> str:
+    """One-line leadership summary: holder, generation (= fencing token),
+    shard count, and lease freshness."""
+    if lease is None:
+        return ""
+    if not _lease_live(lease):
+        return (f"leader: <none> (lease expired; last holder "
+                f"{lease.spec.holder_identity or '<none>'}, "
+                f"generation {lease.spec.generation})")
+    age = max(0.0, time.time() - lease.spec.renew_time)
+    return (f"leader: {lease.spec.holder_identity} "
+            f"(generation {lease.spec.generation}, "
+            f"{lease.spec.shards} controller shard(s), "
+            f"renewed {age:.1f}s ago)")
+
+
+def _shard_cell(job, lease) -> str:
+    """The owning controller shard for a job, recomputed from the lease's
+    advertised shard count over the job's UID — the same hash ring the
+    controller routes by (ha/ring.py)."""
+    from ..ha.ring import shard_of
+
+    if lease is None or lease.spec.shards <= 1:
+        return "-"
+    s = shard_of(job.metadata.uid or job.metadata.name, lease.spec.shards)
+    return str(s) if s is not None else "-"
+
+
 def cmd_get(args) -> int:
     """kubectl-get analog: one line per TFJob (REST mode only)."""
     cluster = _rest_cluster_or_die(args, probe=False)
@@ -198,11 +255,14 @@ def cmd_get(args) -> int:
     except APIError as e:
         print(f"error talking to API server: {e}", file=sys.stderr)
         return 2
+    lease = _fetch_lease(cluster)
+    if lease is not None:
+        print(_leader_line(lease))
     if not jobs:
         print("No resources found.")
         return 0
     print(f"{'NAMESPACE':<12} {'NAME':<32} {'PHASE':<12} {'REASON':<28} "
-          f"{'STEP':<10} {'RATE':<10} {'RESTARTS':<9} REPLICAS")
+          f"{'STEP':<10} {'RATE':<10} {'RESTARTS':<9} {'SHARD':<6} REPLICAS")
     for j in jobs:
         kinds = ",".join(
             f"{s.tf_replica_type.value}x{s.replicas}" for s in j.spec.tf_replica_specs
@@ -223,7 +283,7 @@ def cmd_get(args) -> int:
         restarts = sum(rs.restarts for rs in j.status.tf_replica_statuses)
         print(f"{j.metadata.namespace:<12} {j.metadata.name:<32} "
               f"{phase:<12} {reason:<28} {step:<10} {rate:<10} "
-              f"{restarts:<9} {kinds}")
+              f"{restarts:<9} {_shard_cell(j, lease):<6} {kinds}")
     return 0
 
 
@@ -247,6 +307,13 @@ def cmd_describe(args) -> int:
     print(f"Name:      {j.metadata.name}")
     print(f"Namespace: {j.metadata.namespace}")
     print(f"RuntimeID: {j.spec.runtime_id}")
+    lease = _fetch_lease(cluster)
+    if lease is not None:
+        print(f"Leader:    {_leader_line(lease).removeprefix('leader: ')}")
+        shard = _shard_cell(j, lease)
+        if shard != "-":
+            print(f"Shard:     {shard} of {lease.spec.shards} "
+                  f"(consistent hash of uid {j.metadata.uid})")
     print(f"Phase:     {j.status.phase.value}"
           + (f"  ({j.status.reason})" if j.status.reason else ""))
     if j.status.reason.startswith("GangQueued"):
@@ -390,6 +457,40 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def _print_shard_depths(cluster, jobs, lease) -> None:
+    """Per-shard queue pressure: live depth gauges when the server's
+    /metrics exposes them (in-process deployments, where the controller
+    shares the server registry), else the active-job distribution over
+    the same hash ring the controller routes by."""
+    import re
+
+    shards = lease.spec.shards
+    if shards <= 1:
+        return
+    depths = {}
+    try:
+        for line in cluster.metrics_text().splitlines():
+            m = re.match(r'kctpu_ha_shard_queue_depth\{shard="(\d+)"\}\s+'
+                         r'([0-9.eE+-]+)', line)
+            if m:
+                depths[int(m.group(1))] = int(float(m.group(2)))
+    except APIError:
+        pass
+    if depths:
+        cells = " ".join(f"{s}:{depths.get(s, 0)}" for s in range(shards))
+        print(f"shards: queue depth {cells}")
+        return
+    active = {}
+    for j in jobs:
+        if j.status.phase.value in ("Succeeded", "Failed"):
+            continue
+        cell = _shard_cell(j, lease)
+        if cell != "-":
+            active[int(cell)] = active.get(int(cell), 0) + 1
+    cells = " ".join(f"{s}:{active.get(s, 0)}" for s in range(shards))
+    print(f"shards: active jobs {cells}")
+
+
 def cmd_top(args) -> int:
     """kubectl-top analog for TFJobs: live training-plane progress, one
     row per job — step, throughput, straggler lag, stall state, heartbeat
@@ -404,8 +505,13 @@ def cmd_top(args) -> int:
             print(f"error talking to API server: {e}", file=sys.stderr)
             return 2
         now = time.time()
+        lease = _fetch_lease(cluster)
+        if lease is not None:
+            print(_leader_line(lease))
+            _print_shard_depths(cluster, jobs, lease)
         print(f"{'NAMESPACE':<12} {'NAME':<32} {'PHASE':<10} {'STEP':<10} "
-              f"{'RATE':<10} {'LOSS':<10} {'LAG':<6} {'STALLED':<20} BEAT")
+              f"{'RATE':<10} {'LOSS':<10} {'LAG':<6} {'STALLED':<20} "
+              f"{'SHARD':<6} BEAT")
         # Stalled jobs surface first (the rows an operator is looking for),
         # then the busiest.
         def sort_key(j):
@@ -427,7 +533,8 @@ def cmd_top(args) -> int:
                         else "never")
             print(f"{j.metadata.namespace:<12} {j.metadata.name:<32} "
                   f"{j.status.phase.value:<10} {step:<10} {rate:<10} "
-                  f"{loss:<10} {lag:<6} {stalled:<20} {beat}")
+                  f"{loss:<10} {lag:<6} {stalled:<20} "
+                  f"{_shard_cell(j, lease):<6} {beat}")
         if not args.watch:
             return 0
         try:
@@ -520,7 +627,6 @@ def cmd_run(args) -> int:
     if args.trace_out:
         # Executed pods inherit this via the kubelet's env merge and dump
         # their spans here; merged with the controller's own spans at exit.
-        import os
         import tempfile
 
         trace_dir = tempfile.mkdtemp(prefix="kctpu-trace-")
@@ -537,9 +643,35 @@ def cmd_run(args) -> int:
     else:
         cluster = Cluster()
         inventory, kubelet = _build_substrate(args, cluster)
+    lease_mgr = None
+    if args.leader_elect:
+        # HA mode (docs/HA.md): acquire the leader lease before starting
+        # the controller; every write carries the lease generation as its
+        # fencing token, so if this process is ever deposed its in-flight
+        # writes are rejected server-side.
+        import socket
+
+        from ..ha.lease import LeaseManager
+
+        identity = f"{socket.gethostname()}-{os.getpid()}"
+        lease_mgr = LeaseManager(cluster.leases, identity,
+                                 duration_s=args.lease_duration,
+                                 shards=max(1, args.controller_shards))
+        cluster.set_fence_provider(lease_mgr.token)
+        lease_mgr.start()
+        logger.info("leader election: candidate %s waiting for the lease",
+                    identity)
+        while not lease_mgr.is_leader and not stop.is_set():
+            time.sleep(0.05)
+        if stop.is_set():
+            lease_mgr.stop()
+            return 0
+        logger.info("leader election: %s elected (generation %d)",
+                    identity, lease_mgr.generation)
     ctrl = Controller(cluster, inventory=inventory,
                       resync_period_s=args.resync_period,
-                      manage_workers=args.manage_workers)
+                      manage_workers=args.manage_workers,
+                      controller_shards=max(1, args.controller_shards))
     if kubelet is not None:
         kubelet.start()
     ctrl.run(threadiness=args.threadiness)
@@ -570,6 +702,8 @@ def cmd_run(args) -> int:
         return 2
     finally:
         ctrl.stop()
+        if lease_mgr is not None:
+            lease_mgr.stop(release=True)
         if kubelet is not None:
             kubelet.stop()
         if args.trace_out:
@@ -623,6 +757,10 @@ def build_parser() -> argparse.ArgumentParser:
                                      "as a standalone process")
     s.add_argument("--port", type=int, default=0,
                    help="listen port (default: ephemeral, printed at startup)")
+    s.add_argument("--wal-dir", default="", metavar="DIR",
+                   help="durable mode: journal every write to a WAL in DIR "
+                        "and recover WAL-over-snapshot at startup, so a "
+                        "restarted server is RV-identical (docs/HA.md)")
     s.add_argument("--token", default="", help="require this bearer token")
     s.add_argument("--execute", action="store_true",
                    help="kubelet executes container commands as local processes")
@@ -705,6 +843,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write a merged Chrome trace (controller + executed "
                         "pods) to PATH at exit")
     r.add_argument("--threadiness", type=int, default=2, help="sync workers (ref: 2)")
+    r.add_argument("--controller-shards", type=int, default=1, metavar="N",
+                   help="consistent-hash shard workers over job UIDs "
+                        "(each gets --threadiness sync workers; "
+                        "docs/HA.md)")
+    r.add_argument("--leader-elect", action="store_true",
+                   help="acquire the leader lease before starting (fast "
+                        "failover; writes carry the fencing token)")
+    r.add_argument("--lease-duration", type=float, default=2.0, metavar="S",
+                   help="leader lease duration (renewed at S/4)")
     r.add_argument("--manage-workers", type=int, default=8,
                    help="max concurrent child create/delete calls per "
                         "controller (slow-start batched; 1 = serial plan "

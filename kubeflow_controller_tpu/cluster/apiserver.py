@@ -22,10 +22,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple, Type
 from urllib.parse import parse_qs, urlparse
 
-from ..api.core import EventObject, Pod, Service
+from ..api.core import EventObject, Lease, Pod, Service
 from ..api.tfjob import TFJob
 from ..obs.metrics import REGISTRY
-from ..utils import serde
+from ..utils import locks, serde
 from .rest import CORE_API, TFJOB_API, TFJOB_GROUP, TFJOB_VERSION
 from .store import (
     BOOKMARK,
@@ -44,7 +44,15 @@ _KINDS: Dict[str, Tuple[Type, str, str]] = {
     "pods": (Pod, "v1", "Pod"),
     "services": (Service, "v1", "Service"),
     "events": (EventObject, "v1", "Event"),
+    # Leader-election coordination object (ha/lease.py); served under the
+    # core prefix for routing simplicity — the fake API server does not
+    # model API groups beyond the tfjobs CRD split.
+    "leases": (Lease, "coordination.k8s.io/v1", "Lease"),
 }
+
+#: Fencing token header (docs/HA.md): writes from a fenced REST client
+#: carry the leader generation; the store rejects stale tokens.
+FENCE_HEADER = "X-Kctpu-Fence"
 
 
 def _parse_selector(q: Dict[str, list]) -> Optional[Dict[str, str]]:
@@ -182,6 +190,12 @@ class FakeAPIServer:
             "Response-body bytes served by collection LIST requests")
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # Live watch-stream watchers, so stop() can close every stream
+        # deterministically (stop() wakes the handler's queue wait instead
+        # of racing the 0.5 s poll) — restart-in-tests must not depend on
+        # stream threads noticing the generation bump eventually.
+        self._streams: set = set()
+        self._streams_lock = locks.named_lock("apiserver.streams")
         # Watch-stream generation: drop_watches() bumps it and every live
         # stream closes at its next loop turn, forcing clients through
         # their reconnect path — a real API server does this on timeouts/
@@ -304,10 +318,22 @@ class FakeAPIServer:
         return f"http://{host}:{port}"
 
     def stop(self) -> None:
+        """Deterministic shutdown: close every live watch stream (each
+        handler wakes on its watcher's stop sentinel and exits via the
+        generation check — no 0.5 s poll race), stop the HTTP server,
+        then flush the WAL so a test that restarts the server replays a
+        byte-complete journal (no reliance on the torn-tail recovery
+        path for a CLEAN exit)."""
+        self._watch_gen += 1
+        with self._streams_lock:
+            streams = list(self._streams)
+        for w in streams:
+            w.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        self.store.flush_wal()
 
     def drop_watches(self) -> None:
         """Close every active watch stream (clients must reconnect and
@@ -347,6 +373,13 @@ class FakeAPIServer:
 
     def _handle(self, h, method: str, r: _Route) -> None:
         store = self.store
+        fence = None
+        raw_fence = h.headers.get(FENCE_HEADER)
+        if raw_fence:
+            try:
+                fence = int(raw_fence)
+            except ValueError:
+                raise Invalid(f"invalid {FENCE_HEADER} {raw_fence!r}")
         if r.name is None:
             if method == "GET" and r.watch:
                 self._stream_watch(h, r)
@@ -372,7 +405,7 @@ class FakeAPIServer:
                 obj = self._parse(r.plural, h._body())
                 if r.namespace:
                     obj.metadata.namespace = r.namespace
-                out = store.create(r.plural, obj)
+                out = store.create(r.plural, obj, fence=fence)
                 h._send(201, self._wire(r.plural, out))
                 return
             raise NotFound(f"{method} not supported on collection")
@@ -383,7 +416,8 @@ class FakeAPIServer:
 
             progress = serde.from_dict(PodProgress, h._body())
             h._send(200, self._wire(
-                r.plural, store.update_progress(r.plural, ns, r.name, progress)))
+                r.plural, store.update_progress(r.plural, ns, r.name, progress,
+                                fence=fence)))
             return
         if method == "GET" and r.plural == "pods" and r.subresource == "log":
             if self.kubelet is None:
@@ -405,12 +439,14 @@ class FakeAPIServer:
         if method == "PUT" and r.subresource == "status":
             obj = self._parse(r.plural, h._body())
             obj.metadata.namespace, obj.metadata.name = ns, r.name
-            h._send(200, self._wire(r.plural, store.update_status(r.plural, obj)))
+            h._send(200, self._wire(
+                r.plural, store.update_status(r.plural, obj, fence=fence)))
             return
         if method == "PUT":
             obj = self._parse(r.plural, h._body())
             obj.metadata.namespace, obj.metadata.name = ns, r.name
-            h._send(200, self._wire(r.plural, store.update(r.plural, obj)))
+            h._send(200, self._wire(
+                r.plural, store.update(r.plural, obj, fence=fence)))
             return
         if method == "PATCH":
             # Every PATCH body is one dialect: RFC 7386 merge, applied
@@ -420,10 +456,11 @@ class FakeAPIServer:
             # status-subresource strip lives in store.patch, shared with
             # the in-process client.
             h._send(200, self._wire(
-                r.plural, store.patch(r.plural, ns, r.name, h._body())))
+                r.plural, store.patch(r.plural, ns, r.name, h._body(),
+                                      fence=fence)))
             return
         if method == "DELETE":
-            store.delete(r.plural, ns, r.name)
+            store.delete(r.plural, ns, r.name, fence=fence)
             h._send(200, {"kind": "Status", "apiVersion": "v1",
                           "status": "Success", "code": 200})
             return
@@ -451,6 +488,8 @@ class FakeAPIServer:
         w = self.store.watch(r.plural, r.namespace,
                              since_rv=r.resource_version, bookmark=True,
                              auto_resume=False)
+        with self._streams_lock:
+            self._streams.add(w)
         gen = self._watch_gen
         last_bookmark = time.monotonic()
         try:
@@ -505,4 +544,6 @@ class FakeAPIServer:
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
+            with self._streams_lock:
+                self._streams.discard(w)
             w.stop()

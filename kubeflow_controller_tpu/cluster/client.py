@@ -5,30 +5,47 @@ Functional equivalent of the generated typed clients
 typed/kubeflow/v1alpha1/tfjob.go:34-154 for TFJobs; client-go core/v1 for
 pods/services).  A real REST implementation of these three classes is all it
 would take to run the controller against a live API server.
+
+HA fencing (docs/HA.md): every WRITE through a typed client carries the
+cluster's current fencing token (``fence=``) — the lease generation of the
+leader this client acts for, or None for unfenced writers (node agents,
+workloads, tests).  The plumbing is mandatory (``kctpu vet`` rule
+``fencing-token``): a store write without a fence decision is how a
+deposed leader corrupts state after failover.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
-from ..api.core import Pod, Service
+from ..api.core import Lease, Pod, Service
 from ..api.tfjob import TFJob
-from .store import ObjectStore, Watcher
+from .store import LEASES_KIND, ObjectStore, Watcher
 
 TFJOBS = "tfjobs"
 PODS = "pods"
 SERVICES = "services"
 EVENTS = "events"
+LEASES = LEASES_KIND
+
+#: Fence provider signature: () -> Optional[int] (the lease generation).
+FenceProvider = Callable[[], Optional[int]]
+
+
+def _unfenced() -> Optional[int]:
+    return None
 
 
 class _TypedClient:
     kind: str = ""
 
-    def __init__(self, store: ObjectStore):
+    def __init__(self, store: ObjectStore,
+                 fence: Optional[FenceProvider] = None):
         self._store = store
+        self._fence = fence or _unfenced
 
     def create(self, obj):
-        return self._store.create(self.kind, obj)
+        return self._store.create(self.kind, obj, fence=self._fence())
 
     def get(self, namespace: str, name: str):
         return self._store.get(self.kind, namespace, name)
@@ -43,10 +60,11 @@ class _TypedClient:
         return self._store.list_with_rv(self.kind, namespace, selector)
 
     def update(self, obj):
-        return self._store.update(self.kind, obj)
+        return self._store.update(self.kind, obj, fence=self._fence())
 
     def delete(self, namespace: str, name: str):
-        return self._store.delete(self.kind, namespace, name)
+        return self._store.delete(self.kind, namespace, name,
+                                  fence=self._fence())
 
     def watch(self, namespace: Optional[str] = None,
               resource_version: Optional[str] = None) -> Watcher:
@@ -54,18 +72,20 @@ class _TypedClient:
                                  since_rv=resource_version or None)
 
     def patch_meta(self, namespace: str, name: str, fn):
-        return self._store.patch_meta(self.kind, namespace, name, fn)
+        return self._store.patch_meta(self.kind, namespace, name, fn,
+                                      fence=self._fence())
 
     def patch(self, namespace: str, name: str, body: Dict):
         """Arbitrary object patch (RFC 7386 merge) — PatchService analog."""
-        return self._store.patch(self.kind, namespace, name, body)
+        return self._store.patch(self.kind, namespace, name, body,
+                                 fence=self._fence())
 
 
 class TFJobClient(_TypedClient):
     kind = TFJOBS
 
     def update_status(self, job: TFJob) -> TFJob:
-        return self._store.update_status(self.kind, job)
+        return self._store.update_status(self.kind, job, fence=self._fence())
 
 
 class PodClient(_TypedClient):
@@ -75,12 +95,14 @@ class PodClient(_TypedClient):
         return self.list(namespace)
 
     def mark_deleting(self, namespace: str, name: str) -> Pod:
-        return self._store.mark_deleting(self.kind, namespace, name)
+        return self._store.mark_deleting(self.kind, namespace, name,
+                                         fence=self._fence())
 
     def update_progress(self, namespace: str, name: str, progress) -> Pod:
         """Write the pod's training-plane heartbeat (progress subresource:
         last-write-wins, only ``.status.progress`` is applied)."""
-        return self._store.update_progress(self.kind, namespace, name, progress)
+        return self._store.update_progress(self.kind, namespace, name,
+                                           progress, fence=self._fence())
 
 
 class ServiceClient(_TypedClient):
@@ -94,13 +116,39 @@ class EventClient(_TypedClient):
     kind = EVENTS
 
 
+class LeaseClient(_TypedClient):
+    """coordination.k8s.io Leases (ha/lease.py).  Lease writes are exempt
+    from the fence check server-side — the lease IS the fencing
+    authority — so the provider plumbed here is inert for this kind."""
+
+    kind = LEASES
+
+    def get(self, namespace: str, name: str) -> Lease:
+        return self._store.get(self.kind, namespace, name)
+
+
 class Cluster:
     """One handle bundling the store and its typed clients (the analog of
-    building both clientsets in cmd/controller/main.go:52-60)."""
+    building both clientsets in cmd/controller/main.go:52-60).
 
-    def __init__(self, store: Optional[ObjectStore] = None):
+    ``fence_provider`` (settable later via :meth:`set_fence_provider`,
+    e.g. to a :meth:`LeaseManager.token <..ha.lease.LeaseManager.token>`
+    bound method) stamps every write issued through this handle with the
+    leader generation it acts for."""
+
+    def __init__(self, store: Optional[ObjectStore] = None,
+                 fence_provider: Optional[FenceProvider] = None):
         self.store = store or ObjectStore()
-        self.tfjobs = TFJobClient(self.store)
-        self.pods = PodClient(self.store)
-        self.services = ServiceClient(self.store)
-        self.events = EventClient(self.store)
+        self._fence_provider = fence_provider
+        self.tfjobs = TFJobClient(self.store, self._fence)
+        self.pods = PodClient(self.store, self._fence)
+        self.services = ServiceClient(self.store, self._fence)
+        self.events = EventClient(self.store, self._fence)
+        self.leases = LeaseClient(self.store, self._fence)
+
+    def _fence(self) -> Optional[int]:
+        fp = self._fence_provider
+        return fp() if fp is not None else None
+
+    def set_fence_provider(self, fp: Optional[FenceProvider]) -> None:
+        self._fence_provider = fp

@@ -418,7 +418,9 @@ class FakeKubelet:
         # The kubelet is the sole status writer for its pods: last-write-wins.
         pod.metadata.resource_version = ""
         try:
-            self.cluster.store.update_status("pods", pod)
+            # Node agent, not a controller sync path: pod-status writes are
+            # deliberately unfenced — kubelets outlive leader failovers.
+            self.cluster.store.update_status("pods", pod)  # kctpu: vet-ok(fencing-token)
         except NotFound:
             pass
 

@@ -45,7 +45,7 @@ from typing import Any, Callable, Dict, List, Optional, Type
 
 from ..obs.metrics import REGISTRY
 
-from ..api.core import EventObject, Pod, Service
+from ..api.core import EventObject, Lease, Pod, Service
 from ..api.meta import ObjectMeta
 from ..api.tfjob import TFJob
 from ..utils import locks, serde
@@ -314,6 +314,11 @@ class RestTransport:
                  pool_size: int = 8, watch_resume: bool = True):
         self.config = config
         self.timeout = timeout
+        # HA fencing (docs/HA.md): when set, every mutating request
+        # carries the leader generation as an X-Kctpu-Fence header; the
+        # server rejects tokens below its fence floor (409 Conflict), so
+        # a deposed leader's in-flight REST writes cannot land.
+        self.fence_provider = None  # Optional[Callable[[], Optional[int]]]
         # Whether watch streams reconnect with their last-seen RV
         # (RestWatcher resume) or gap on every drop.  False is the
         # pre-resumption baseline (bench.py --churn --no-resume).
@@ -334,12 +339,17 @@ class RestTransport:
     def close(self) -> None:
         self.pool.close()
 
-    def _headers(self, data: Optional[bytes], content_type: str) -> Dict[str, str]:
+    def _headers(self, data: Optional[bytes], content_type: str,
+                 method: str = "GET") -> Dict[str, str]:
         h = {"Accept": "application/json"}
         if data is not None:
             h["Content-Type"] = content_type
         if self.config.token:
             h["Authorization"] = f"Bearer {self.config.token}"
+        if method not in _SAFE_METHODS and self.fence_provider is not None:
+            fence = self.fence_provider()
+            if fence is not None:
+                h["X-Kctpu-Fence"] = str(fence)
         return h
 
     def _request(self, method: str, path: str,
@@ -353,7 +363,7 @@ class RestTransport:
             url_path += "?" + urllib.parse.urlencode(params)
         url = self.config.server + url_path
         data = json.dumps(body).encode() if body is not None else None
-        headers = self._headers(data, content_type)
+        headers = self._headers(data, content_type, method=method)
         # One extra replay for safe verbs on transient connection errors
         # (e.g. the server dropped the connection mid-response); the
         # stale-keep-alive reconnect below is budgeted separately and is
@@ -821,6 +831,13 @@ class RestEventClient(_RestTypedClient):
     kind_name = "Event"
 
 
+class RestLeaseClient(_RestTypedClient):
+    cls = Lease
+    plural = "leases"
+    api_version = "coordination.k8s.io/v1"
+    kind_name = "Lease"
+
+
 class RestCluster:
     """Drop-in for cluster.Cluster backed by HTTP — what ``-kubeconfig``
     selects in the CLI.  No ``.store``: there is no in-process substrate,
@@ -835,6 +852,13 @@ class RestCluster:
         self.pods = RestPodClient(self.transport)
         self.services = RestServiceClient(self.transport)
         self.events = RestEventClient(self.transport)
+        self.leases = RestLeaseClient(self.transport)
+
+    def set_fence_provider(self, fp) -> None:
+        """Stamp every write from this cluster handle with the given
+        fence token provider (e.g. ``LeaseManager.token``) — the REST
+        half of the Cluster.set_fence_provider contract."""
+        self.transport.fence_provider = fp
 
     def close(self) -> None:
         """Release pooled keep-alive connections (idempotent)."""

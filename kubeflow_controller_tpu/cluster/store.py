@@ -90,6 +90,14 @@ class Conflict(APIError):
     """Stale resourceVersion on update (optimistic-concurrency failure)."""
 
 
+class FencingError(Conflict):
+    """Write carried a fencing token (lease generation) below the current
+    leader generation: the writer was deposed and its in-flight updates
+    are rejected (docs/HA.md "Fencing").  A Conflict subclass so every
+    existing retry/abort path treats a fenced-off write like a lost CAS —
+    which, semantically, it is."""
+
+
 class Invalid(APIError):
     pass
 
@@ -105,6 +113,12 @@ ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
 BOOKMARK = "BOOKMARK"
+
+# The coordination kind (ha/lease.py).  Lease writes are exempt from the
+# fence check — the lease IS the fencing authority, so gating it on itself
+# would wedge every election — and instead RAISE the floor: a stored lease
+# with a higher generation deposes every older token.
+LEASES_KIND = "leases"
 
 
 @dataclass
@@ -127,6 +141,17 @@ class Bookmark:
 
 def _bookmark_event(rv: str) -> WatchEvent:
     return WatchEvent(BOOKMARK, Bookmark(metadata=ObjectMeta(resource_version=rv)))
+
+
+def _uid_seq(uid: str) -> int:
+    """The sequence component of a store-issued ``uid-N`` (0 for foreign
+    uids) — how recovery restores the uid counter from replayed objects."""
+    if uid.startswith("uid-"):
+        try:
+            return int(uid[4:])
+        except ValueError:
+            return 0
+    return 0
 
 
 # Lock-wait histogram bucket upper bounds (seconds).  Uncontended acquires
@@ -249,8 +274,25 @@ class ObjectStore:
     """
 
     def __init__(self, watch_cache_size: int = 1024, sharded: bool = True,
-                 watch_queue_size: int = 8192):
+                 watch_queue_size: int = 8192, wal=None):
         self._sharded = sharded
+        # Durability (ha/wal.py): with a WriteAheadLog attached, every
+        # write's (rv, event, kind, snapshot) is journaled — fsync'd under
+        # the WAL lock — before the write returns.  recover() rebuilds an
+        # RV-identical store (shards + watch caches) from it.
+        self._wal = wal
+        # Fencing floor: the highest lease generation ever stored through
+        # this store (see LEASES_KIND above).  Writes carrying an older
+        # fence token raise FencingError; unfenced writes (fence=None —
+        # node agents, workloads, tests) are never gated.  Plain int:
+        # mutated only under the leases shard lock, read racily elsewhere
+        # (a momentarily stale floor only delays a rejection by one write,
+        # it can never un-depose a leader — the floor is monotonic).
+        self._fence_floor = 0
+        self._c_fence_rejected = REGISTRY.counter(
+            "kctpu_ha_fencing_rejections_total",
+            "Store writes rejected because their fencing token (lease "
+            "generation) was below the current leader generation")
         # With snapshot reads off (baseline), every read copies inside the
         # lock with the slow copier — the exact pre-PR-6 cost profile.
         self._snapshot = sharded
@@ -327,6 +369,14 @@ class ObjectStore:
         # wasn't there to see.  Caller holds the shard lock.
         if not self._snapshot:
             obj = serde.slow_deep_copy(obj)  # baseline: per-event copy
+        if self._wal is not None:
+            # Journal-before-visible: the record hits the fsync'd log
+            # before any watcher (or the caller) can observe the write.
+            # Per-kind append order == RV order because this runs under
+            # the shard lock; cross-kind interleaving in the file is
+            # harmless (replay is keyed by kind).
+            self._wal.append(int(obj.metadata.resource_version), ev_type,
+                             sh.kind, obj)
         ev = WatchEvent(ev_type, obj)
         buf = sh.watch_cache
         buf.append((int(obj.metadata.resource_version), ev))
@@ -385,9 +435,41 @@ class ObjectStore:
             w._dropped = False
             sh.watchers.append(w)
 
+    # -- HA: fencing ---------------------------------------------------------
+
+    @property
+    def fence_floor(self) -> int:
+        """Current leader generation: the fence every leader write must
+        meet or beat (docs/HA.md)."""
+        return self._fence_floor
+
+    def _check_fence(self, kind: str, fence: Optional[int]) -> None:
+        """Reject a write whose fencing token predates the current leader
+        generation.  Runs under the target shard lock, before any
+        mutation.  ``fence=None`` = unfenced writer (kubelet, workloads,
+        tests): never gated — fencing exists to stop DEPOSED leaders, not
+        non-leaders."""
+        if fence is None or kind == LEASES_KIND:
+            return
+        if fence < self._fence_floor:
+            self._c_fence_rejected.inc()
+            raise FencingError(
+                f"{kind}: fencing token {fence} < leader generation "
+                f"{self._fence_floor}: writer was deposed")
+
+    def _maybe_raise_fence(self, kind: str, obj: Any) -> None:
+        """A stored lease with a higher generation deposes older tokens.
+        Caller holds the leases shard lock, so floor updates serialize."""
+        if kind != LEASES_KIND:
+            return
+        gen = int(getattr(getattr(obj, "spec", None), "generation", 0) or 0)
+        if gen > self._fence_floor:
+            self._fence_floor = gen
+
     # -- API surface ---------------------------------------------------------
 
-    def create(self, kind: str, obj: Any) -> Any:
+    def create(self, kind: str, obj: Any,
+               fence: Optional[int] = None) -> Any:
         # The incoming object is copied BEFORE the lock (the store must
         # own its snapshot; the caller keeps mutating theirs), stamped and
         # inserted under it, and the caller-owned return copy is made
@@ -396,6 +478,7 @@ class ObjectStore:
         meta: ObjectMeta = obj.metadata
         sh = self._shard(kind)
         with sh:
+            self._check_fence(kind, fence)
             if not meta.name:
                 if not meta.generate_name:
                     raise Invalid("either name or generateName is required")
@@ -414,6 +497,7 @@ class ObjectStore:
             meta.resource_version = self._next_rv()
             meta.creation_timestamp = time.time()
             sh.objects[key] = obj
+            self._maybe_raise_fence(kind, obj)
             self._notify(sh, ADDED, obj)
             if not self._snapshot:
                 return serde.slow_deep_copy(obj)
@@ -509,13 +593,15 @@ class ObjectStore:
         with sh:
             return self._select(sh, namespace, selector), str(self._rv)
 
-    def update(self, kind: str, obj: Any) -> Any:
+    def update(self, kind: str, obj: Any,
+               fence: Optional[int] = None) -> Any:
         obj = self._copy(obj)
         meta: ObjectMeta = obj.metadata
         key = (meta.namespace, meta.name)
         sh = self._shard(kind)
         finalized = None
         with sh:
+            self._check_fence(kind, fence)
             existing = sh.objects.get(key)
             if existing is None:
                 raise NotFound(f"{kind} {key} not found")
@@ -530,6 +616,7 @@ class ObjectStore:
             obj.metadata.deletion_timestamp = existing.metadata.deletion_timestamp
             obj.metadata.resource_version = self._next_rv()
             sh.objects[key] = obj
+            self._maybe_raise_fence(kind, obj)
             self._notify(sh, MODIFIED, obj)
             finalized = self._maybe_finalize(sh, key)
             if not self._snapshot:
@@ -540,7 +627,8 @@ class ObjectStore:
         return out if out is not None else self._copy(obj)
 
     def patch_meta(self, kind: str, namespace: str, name: str,
-                   fn: Callable[[ObjectMeta], None]) -> Any:
+                   fn: Callable[[ObjectMeta], None],
+                   fence: Optional[int] = None) -> Any:
         """Server-side metadata patch (the adoption/release path: owner-ref
         merge patches, ref: pkg/controller/ref/service.go:126-164).  ``fn``
         mutates a write-time copy under the shard lock, so it cannot race
@@ -549,6 +637,7 @@ class ObjectStore:
         sh = self._shard(kind)
         finalized = None
         with sh:
+            self._check_fence(kind, fence)
             existing = sh.objects.get((namespace, name))
             if existing is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
@@ -565,7 +654,8 @@ class ObjectStore:
         self._finish_finalize(finalized, namespace)
         return out if out is not None else self._copy(obj)
 
-    def patch(self, kind: str, namespace: str, name: str, body: Dict) -> Any:
+    def patch(self, kind: str, namespace: str, name: str, body: Dict,
+              fence: Optional[int] = None) -> Any:
         """Full-object JSON merge patch (RFC 7386) — the PatchService analog
         (ref: pkg/controller/control/service.go:50-53), generalized to every
         kind.  Server-side under the shard lock, so it cannot race other
@@ -574,6 +664,7 @@ class ObjectStore:
         sh = self._shard(kind)
         finalized = None
         with sh:
+            self._check_fence(kind, fence)
             existing = sh.objects.get((namespace, name))
             if existing is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
@@ -602,7 +693,8 @@ class ObjectStore:
         self._finish_finalize(finalized, namespace)
         return out if out is not None else self._copy(obj)
 
-    def update_status(self, kind: str, obj: Any) -> Any:
+    def update_status(self, kind: str, obj: Any,
+                      fence: Optional[int] = None) -> Any:
         """Status-subresource style update: only .status is applied.  A
         stale resourceVersion raises Conflict (as the real subresource does);
         an empty resourceVersion means last-write-wins."""
@@ -611,6 +703,7 @@ class ObjectStore:
         key = (meta.namespace, meta.name)
         sh = self._shard(kind)
         with sh:
+            self._check_fence(kind, fence)
             existing = sh.objects.get(key)
             if existing is None:
                 raise NotFound(f"{kind} {key} not found")
@@ -629,7 +722,7 @@ class ObjectStore:
         return self._copy(new)
 
     def update_progress(self, kind: str, namespace: str, name: str,
-                        progress: Any) -> Any:
+                        progress: Any, fence: Optional[int] = None) -> Any:
         """Progress-subresource update: only ``.status.progress`` is applied,
         last-write-wins (the workload is the sole writer for its own pod,
         like the kubelet for phase — no resourceVersion ping-pong on a
@@ -640,6 +733,7 @@ class ObjectStore:
             progress.timestamp = time.time()
         sh = self._shard(kind)
         with sh:
+            self._check_fence(kind, fence)
             existing = sh.objects.get((namespace, name))
             if existing is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
@@ -652,7 +746,8 @@ class ObjectStore:
                 return serde.slow_deep_copy(new)
         return self._copy(new)
 
-    def delete(self, kind: str, namespace: str, name: str, cascade: bool = True) -> None:
+    def delete(self, kind: str, namespace: str, name: str,
+               cascade: bool = True, fence: Optional[int] = None) -> None:
         """Delete an object.  With finalizers present this is GRACEFUL, as
         on a real API server: deletionTimestamp is stamped and the object
         stays (MODIFIED) until every finalizer is removed via update/patch —
@@ -663,6 +758,7 @@ class ObjectStore:
         sh = self._shard(kind)
         removed = None
         with sh:
+            self._check_fence(kind, fence)
             obj = sh.objects.get((namespace, name))
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
@@ -731,13 +827,15 @@ class ObjectStore:
                 except NotFound:
                     pass  # lost a race with a concurrent deleter: already gone
 
-    def mark_deleting(self, kind: str, namespace: str, name: str) -> Any:
+    def mark_deleting(self, kind: str, namespace: str, name: str,
+                      fence: Optional[int] = None) -> Any:
         """Set deletionTimestamp without removing (graceful-deletion state,
         which FilterActivePods treats as inactive).  Deliberately does NOT
         finalize an object with no finalizers: the node agent owns the final
         delete, as a kubelet does for a terminating pod."""
         sh = self._shard(kind)
         with sh:
+            self._check_fence(kind, fence)
             obj = sh.objects.get((namespace, name))
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
@@ -883,6 +981,122 @@ class ObjectStore:
                 dropped += 1
             sh.watchers = keep
         return dropped
+
+    # -- HA: durability (WAL-over-snapshot recovery; ha/wal.py) ---------------
+
+    def export_state(self) -> Dict[str, Any]:
+        """Full-store state capture for snapshots and RV-identity checks:
+        ``{rv, uid, kinds: {kind: [{cls, obj}, ...]}}``.
+
+        The counters are read FIRST: a concurrent writer may land between
+        the counter read and its kind's capture, in which case its record
+        appears both in the captured state and in the WAL tail kept by
+        ``compact`` (rv > this state's rv) — replay is an idempotent
+        upsert, so the overlap is harmless.  Shard locks are taken one at
+        a time, never nested."""
+        from ..ha.wal import type_tag
+
+        with self._meta_lock:
+            rv0, uid0 = self._rv, self._uid
+        kinds: Dict[str, list] = {}
+        with self._shards_guard:
+            names = list(self._shards)
+        for kind in names:
+            sh = self._shard(kind)
+            with sh:
+                kinds[kind] = [
+                    {"cls": type_tag(o), "obj": serde.to_dict(o)}
+                    for o in sh.objects.values()
+                ]
+        return {"rv": rv0, "uid": uid0, "kinds": kinds}
+
+    def compact_wal(self) -> int:
+        """Snapshot the store and truncate the WAL to records newer than
+        the snapshot (ha/wal.py compact).  Returns records kept."""
+        if self._wal is None:
+            raise RuntimeError("store has no WAL attached")
+        return self._wal.compact(self.export_state())
+
+    def flush_wal(self) -> None:
+        """fsync any buffered WAL tail (no-op without a WAL) — the
+        FakeAPIServer shutdown hook, so a stopped server's journal is
+        byte-complete on disk."""
+        if self._wal is not None:
+            self._wal.flush()
+
+    @classmethod
+    def recover(cls, wal, watch_cache_size: int = 1024,
+                sharded: bool = True,
+                watch_queue_size: int = 8192) -> "ObjectStore":
+        """Rebuild a store from WAL-over-snapshot: load the newest intact
+        snapshot, replay every journaled record after it, and resume
+        appending to the same WAL.  The result is RV-identical to the
+        crashed store — same objects, same resourceVersions, same uid
+        counter, and the same per-kind watch-cache tail, so a watch
+        client resuming with its pre-crash RV replays exactly the events
+        it missed (verified by tests/test_ha.py + the PR-11 checkers
+        under ``kctpu check --crash-restart``)."""
+        import time as _time
+
+        from ..ha.wal import materialize, replay_seconds_gauge
+
+        t0 = _time.perf_counter()
+        store = cls(watch_cache_size=watch_cache_size, sharded=sharded,
+                    watch_queue_size=watch_queue_size)
+        max_rv = 0
+        max_uid = 0
+        snap = wal.load_snapshot()
+        if snap is not None:
+            snap_rv = int(snap["rv"])
+            for kind, entries in snap["kinds"].items():
+                sh = store._shard(kind)
+                with sh:
+                    for e in entries:
+                        obj = materialize(e["cls"], e["obj"])
+                        m = obj.metadata
+                        sh.objects[(m.namespace, m.name)] = obj
+                        max_uid = max(max_uid, _uid_seq(m.uid))
+                        rv = int(m.resource_version or 0)
+                        if rv > max_rv:
+                            max_rv = rv
+                    # Events at or before the snapshot are not in the
+                    # rebuilt ring: resumes below it are exactly 410s.
+                    sh.evicted_rv = max(sh.evicted_rv, snap_rv)
+            max_rv = max(max_rv, snap_rv)
+            max_uid = max(max_uid, int(snap.get("uid", 0)))
+        for rec in wal.replay():
+            rv, uid = store._replay_apply(rec)
+            max_rv = max(max_rv, rv)
+            max_uid = max(max_uid, uid)
+        with store._meta_lock:
+            store._rv = max(store._rv, max_rv)
+            store._uid = max(store._uid, max_uid)
+        store._wal = wal
+        replay_seconds_gauge().set(_time.perf_counter() - t0)
+        return store
+
+    def _replay_apply(self, rec) -> Tuple[int, int]:
+        """Apply one WAL record during recovery: upsert/remove the stored
+        object and rebuild the watch-cache ring through the same bounded
+        eviction the live path uses.  No watchers exist yet (the store is
+        private to recover()), so nothing is notified; nothing re-appends
+        to the WAL.  Idempotent: replaying a record the snapshot already
+        contains just rewrites the same snapshot object."""
+        obj = rec.materialize()
+        sh = self._shard(rec.kind)
+        with sh:
+            key = (obj.metadata.namespace, obj.metadata.name)
+            if rec.ev == DELETED:
+                sh.objects.pop(key, None)
+            else:
+                sh.objects[key] = obj
+            buf = sh.watch_cache
+            buf.append((rec.rv, WatchEvent(rec.ev, obj)))
+            if len(buf) > self._watch_cache_size:
+                evicted_rv, _ = buf.popleft()
+                if evicted_rv > sh.evicted_rv:
+                    sh.evicted_rv = evicted_rv
+        return rec.rv, _uid_seq(obj.metadata.uid)
 
     # -- observability --------------------------------------------------------
 

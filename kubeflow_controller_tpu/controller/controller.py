@@ -103,9 +103,17 @@ class Controller:
         stall_policy: Optional[StallPolicy] = None,
         manage_workers: int = 8,
         restart_config: Optional[RestartPolicyConfig] = None,
+        controller_shards: int = 1,
     ):
         self.cluster = cluster
         self.inventory = inventory
+        # HA sharding (ha/shards.py): with controller_shards > 1 the
+        # single workqueue becomes a consistent-hash-routed queue per
+        # shard worker — each job's syncs stay on one shard (per-job
+        # ordering), shards progress independently (the --scale
+        # parallelism bench.py --ha gates), and set_controller_shards()
+        # rebalances with a draining handoff.
+        self.controller_shards = max(1, controller_shards)
         # Plan-execution fan-out: ``manage_workers`` bounds the threads that
         # issue child create/delete calls concurrently (the slow-start
         # batches in _manage_inner).  <=1 selects the serial path — the
@@ -165,7 +173,14 @@ class Controller:
         self._owns_recorder = recorder is None
         self.recorder = recorder or EventRecorder(
             sink=getattr(cluster, "events", None))
-        self.queue = RateLimitingQueue(name="tfJobs")
+        if self.controller_shards > 1:
+            from ..ha.shards import ShardedWorkQueue
+
+            self.queue = ShardedWorkQueue(
+                self.controller_shards, name="tfJobs",
+                uid_fn=self._shard_uid, on_handoff=self._on_shard_handoff)
+        else:
+            self.queue = RateLimitingQueue(name="tfJobs")
         self.expectations = ControllerExpectations()
         self.metrics = ReconcileMetrics()
         # Prometheus surface: reconcile latency quantiles + op counters land
@@ -212,15 +227,24 @@ class Controller:
         """Start informers, wait for cache sync, spawn workers
         (ref: controller.go:174-198; threadiness=2 at main.go:70)."""
         logger.info("starting TFJob controller")
+        self._threadiness = threadiness
         for inf in (self.tfjob_informer, self.pod_informer, self.service_informer):
             inf.start()
         for inf in (self.tfjob_informer, self.pod_informer, self.service_informer):
             if not inf.wait_for_cache_sync(wait_sync_timeout):
                 raise TimeoutError(f"timed out waiting for {inf.name} cache sync")
-        for i in range(threadiness):
-            t = threading.Thread(target=self._worker, name=f"tfjob-worker-{i}", daemon=True)
-            self._workers.append(t)
-            t.start()
+        if self.controller_shards > 1:
+            # Sharded mode: `threadiness` workers PER shard, each pinned
+            # to its shard's queue (per-job ordering within a shard, full
+            # parallelism across shards).
+            for s in range(self.controller_shards):
+                self._spawn_shard_workers(s)
+        else:
+            for i in range(threadiness):
+                t = threading.Thread(target=self._worker,
+                                     name=f"tfjob-worker-{i}", daemon=True)
+                self._workers.append(t)
+                t.start()
         # Stall timer: a stalled pod, by definition, generates no watch
         # events, so progressing jobs are re-enqueued on a clock — the
         # level-triggered backstop that lets the stall deadline actually
@@ -254,18 +278,58 @@ class Controller:
         if self._owns_recorder:
             self.recorder.close()  # drain pending Event API writes
 
-    def _worker(self) -> None:
+    def _spawn_shard_workers(self, shard: int) -> None:
+        for i in range(getattr(self, "_threadiness", 1)):
+            t = threading.Thread(target=self._worker, args=(shard,),
+                                 name=f"tfjob-worker-s{shard}-{i}",
+                                 daemon=True)
+            self._workers.append(t)
+            t.start()
+
+    def set_controller_shards(self, n: int) -> None:
+        """Rebalance the shard ring to ``n`` workers: pending + delayed
+        work is handed off through the new routing after in-flight syncs
+        drain, moved jobs' expectations are replayed (ha/shards.py), and
+        workers are spawned for new shards / retired shards' workers exit
+        on their queue's ShutDown."""
+        if self.controller_shards <= 1:
+            raise RuntimeError("controller was not built with "
+                               "controller_shards > 1")
+        new_idx = self.queue.set_shards(n)
+        self.controller_shards = n
+        if not self._stop.is_set():
+            for s in new_idx:
+                self._spawn_shard_workers(s)
+
+    def _shard_uid(self, key: str) -> Optional[str]:
+        """Ring identity for a job key: its UID (the partition domain the
+        CLI's shard_of display shares); None until the informer knows it."""
+        ns, name = split_key(key)
+        job = self.tfjob_informer.get(ns, name)
+        return job.metadata.uid if job is not None else None
+
+    def _on_shard_handoff(self, key: str) -> None:
+        """A job moved shards: replay its expectations so the new owner's
+        first sync re-plans from the observed world instead of trusting
+        in-flight counts accumulated by the old shard (whose pending
+        watch events may have raced the handoff)."""
+        self.expectations.delete_expectations(key)
+
+    def _worker(self, shard: Optional[int] = None) -> None:
         while not self._stop.is_set():
             try:
-                self._process_next_work_item()
+                self._process_next_work_item(shard)
             except ShutDown:
                 return
             except Exception:  # the worker itself must never die
                 logger.exception("unhandled error in worker loop")
 
-    def _process_next_work_item(self) -> None:
+    def _process_next_work_item(self, shard: Optional[int] = None) -> None:
         """ref: controller.go:210-259."""
-        key = self.queue.get(timeout=0.5)
+        if shard is None:
+            key = self.queue.get(timeout=0.5)
+        else:
+            key = self.queue.get_shard(shard, timeout=0.5)
         if key is None:
             return
         t0 = time.monotonic()
@@ -374,6 +438,11 @@ class Controller:
             # Deleted: expectations cleaned in the delete handler; cascade GC
             # removes children server-side.
             self.expectations.delete_expectations(key)
+            if self.controller_shards > 1:
+                # Final sync of a dead job, running on its owning shard:
+                # the cached ring identity (its UID) can be dropped now —
+                # a recreated same-name job routes by its own fresh UID.
+                self.queue.forget_route(key)
             return
         # Never mutate the informer cache (the reference mutates lister
         # objects — the shared-template bug class).

@@ -212,6 +212,39 @@ class RateLimitingQueue:
                     timeout = max(0.0, self._waiting[0][0] - now)
                 self._delay_cond.wait(timeout=timeout)
 
+    # -- shard handoff support (ha/shards.py) --------------------------------
+
+    def drain_pending(self) -> List[tuple]:
+        """Atomically claim every item not currently being processed —
+        ready FIFO, delayed heap, and the dirty flags of items queued
+        behind an in-flight sync — and return ``(item, ready_at)`` pairs
+        (``ready_at`` 0.0 = ready now, else the absolute deadline).
+
+        After this call the queue holds only its in-flight syncs: a
+        ``done()`` on them will NOT requeue (their dirty flag was
+        claimed), which is exactly what a shard handoff needs — the new
+        owner re-adds the claimed keys and per-key ordering is preserved
+        by waiting out the in-flight syncs before the re-add."""
+        with self._cond:
+            out = [(item, 0.0) for item in self._queue]
+            self._queue.clear()
+            out.extend((item, ready_at) for ready_at, _, item in self._waiting)
+            self._waiting = []
+            # Remaining dirty after removing the ready items = items that
+            # went dirty while in-flight (done() would have requeued them).
+            queued = {item for item, _ in out}
+            out.extend((item, 0.0) for item in self._dirty if item not in queued)
+            self._dirty.clear()
+            self._enqueued_at.clear()
+            self._metrics.depth.set(0)
+            return out
+
+    def processing_snapshot(self) -> Set[str]:
+        """Keys currently inside a worker's sync (racy by nature; used by
+        the shard-handoff quiesce loop, which re-polls)."""
+        with self._cond:
+            return set(self._processing)
+
     # -- lifecycle -----------------------------------------------------------
 
     def shut_down(self) -> None:

@@ -1,0 +1,49 @@
+"""HA control plane: durable WAL store, lease-based leader election,
+sharded controller workers.
+
+Three layers, each usable alone (ROADMAP "HA, horizontally-scaled
+control plane"; docs/HA.md has the protocol write-ups + failure matrix):
+
+- :mod:`.wal` — an append-only, fsync'd, CRC-framed write-ahead log plus
+  compacting snapshots.  ``ObjectStore(wal=...)`` journals every write;
+  ``ObjectStore.recover(wal)`` replays WAL-over-snapshot and rebuilds the
+  PR-6 shards and the PR-5 watch cache with identical resourceVersions,
+  so watch clients resume across an apiserver restart with no re-list.
+- :mod:`.lease` — lease-based leader election stored through the store
+  itself (CAS-renewed at interval), with a fencing token (the lease
+  generation) stamped on every leader write so a deposed leader's
+  in-flight updates are rejected (``FencingError``).
+- :mod:`.ring` / :mod:`.shards` — a consistent-hash ring over controller
+  shard workers: each shard owns a partition of job UIDs with its own
+  workqueue (per-job ordering preserved), rebalanced on membership
+  change with a handoff that drains in-flight syncs and replays
+  expectations.
+
+Lazy attribute exports keep this package import-cycle-free: cluster/
+store.py imports :mod:`.wal` helpers while :mod:`.lease` imports
+cluster.store error types.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "WriteAheadLog": ".wal",
+    "WALRecord": ".wal",
+    "WALError": ".wal",
+    "LeaseManager": ".lease",
+    "LEASE_NAME": ".lease",
+    "LEASE_NAMESPACE": ".lease",
+    "HashRing": ".ring",
+    "ShardedWorkQueue": ".shards",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod, __name__), name)

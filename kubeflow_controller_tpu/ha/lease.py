@@ -1,0 +1,242 @@
+"""Lease-based leader election, stored through the object store itself.
+
+The coordination object is a :class:`~..api.core.Lease` living in the
+same store the leader will write through (in-process or REST — the
+manager only needs a leases *client*), so election inherits the store's
+CAS semantics instead of inventing a consensus protocol:
+
+- **acquire**: create the lease (first leader), or CAS-update it once it
+  is expired/released, bumping ``spec.generation``.  Losing the CAS means
+  another candidate won — no retry storm, the next tick re-reads.
+- **renew**: the holder CAS-updates ``renew_time`` every
+  ``renew_every_s`` (default duration/4).  A renew that loses its CAS, or
+  ``duration_s`` elapsing without a successful renew (API server away,
+  process wedged), edge-triggers :data:`EVENT_LOST`.
+- **fencing**: the store raises its fence floor to any stored lease's
+  generation (cluster/store.py ``_maybe_raise_fence``), so the moment a
+  new leader's acquire lands, every write still carrying the deposed
+  leader's token is rejected with ``FencingError`` — the classic fencing-
+  token construction; no deposed-leader write can land after the new
+  leader's first write.
+
+Failover time is bounded by ``duration_s + renew_every_s`` (candidate
+polls at the renew cadence), comfortably under the ``2 × duration``
+gate ``make ha-smoke`` enforces.
+
+``kill()`` simulates a SIGKILL for chaos drills: renewals stop dead, no
+release, no callbacks — the zombie keeps *believing* it is the leader
+(``token()`` still returns its stale generation), which is exactly the
+split-brain scenario fencing exists to neutralize.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from ..api.core import Lease, LeaseSpec
+from ..api.meta import ObjectMeta
+from ..cluster.store import AlreadyExists, APIError, Conflict, NotFound
+from ..obs.metrics import REGISTRY
+
+logger = logging.getLogger("kubeflow_controller_tpu.ha.lease")
+
+LEASE_NAMESPACE = "default"
+LEASE_NAME = "tfjob-controller"
+
+# Edge-triggered transition names (event reasons + log vocabulary).
+EVENT_ELECTED = "LeaderElected"
+EVENT_LOST = "LeaderLost"
+
+
+class LeaseManager:
+    """One candidate's election loop.  Thread-safe observers:
+    ``is_leader``, ``generation``, ``token()`` (the fence provider)."""
+
+    def __init__(self, leases_client, identity: str,
+                 name: str = LEASE_NAME, namespace: str = LEASE_NAMESPACE,
+                 duration_s: float = 2.0,
+                 renew_every_s: Optional[float] = None,
+                 shards: int = 1,
+                 on_elected: Optional[Callable[[int], None]] = None,
+                 on_lost: Optional[Callable[[], None]] = None,
+                 clock: Callable[[], float] = time.time):
+        self.client = leases_client
+        self.identity = identity
+        self.name = name
+        self.namespace = namespace
+        self.duration_s = duration_s
+        self.renew_every_s = renew_every_s or duration_s / 4.0
+        self.shards = shards
+        self.on_elected = on_elected
+        self.on_lost = on_lost
+        self.clock = clock
+        self.is_leader = False
+        #: Last generation this identity held.  NOT cleared on loss: a
+        #: deposed leader's in-flight writes must keep carrying the stale
+        #: token so the store can reject them (docs/HA.md "Fencing").
+        self.generation = 0
+        self._last_renew_ok = 0.0
+        self._stop = threading.Event()
+        self._killed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._g_leader = REGISTRY.gauge(
+            "kctpu_ha_leader",
+            "1 while this candidate holds the controller leader lease",
+            ("identity",))
+        self._c_elections = REGISTRY.counter(
+            "kctpu_ha_elections_total",
+            "Times this candidate acquired the leader lease", ("identity",))
+        self._c_renewals = REGISTRY.counter(
+            "kctpu_ha_lease_renewals_total",
+            "Successful CAS renewals of the held lease", ("identity",))
+        self._c_losses = REGISTRY.counter(
+            "kctpu_ha_lease_losses_total",
+            "Edge-triggered LeaderLost transitions (deposed or expired)",
+            ("identity",))
+        self._g_leader.labels(self.identity).set(0.0)
+
+    # -- fence provider -------------------------------------------------------
+
+    def token(self) -> Optional[int]:
+        """Current fencing token for this candidate's writes: its last
+        held generation, or None before it ever led (an unfenced write —
+        a never-elected candidate should not be writing at all)."""
+        return self.generation or None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "LeaseManager":
+        self._thread = threading.Thread(
+            target=self._loop, name=f"lease-{self.identity}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, release: bool = True, timeout: float = 5.0) -> None:
+        """Graceful shutdown.  With ``release`` the held lease is emptied
+        (holder "", renew 0) so the next candidate acquires on its very
+        next tick instead of waiting out the expiry window."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+        if release and self.is_leader and not self._killed.is_set():
+            try:
+                lease = self.client.get(self.namespace, self.name)
+                if (lease.spec.holder_identity == self.identity
+                        and lease.spec.generation == self.generation):
+                    lease.spec.holder_identity = ""
+                    lease.spec.renew_time = 0.0
+                    self.client.update(lease)
+            except (APIError, OSError):
+                pass  # the expiry window covers an unreleasable lease
+        if self.is_leader:
+            self._lost("released")
+
+    def kill(self) -> None:
+        """Chaos hook: die like a SIGKILL — stop renewing, release
+        nothing, fire no callbacks.  ``is_leader``/``token()`` keep their
+        zombie values so the harness can demonstrate fencing rejections
+        on the deposed leader's in-flight writes."""
+        self._killed.set()
+        self._stop.set()
+
+    # -- loop -----------------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._tick()
+            except (APIError, OSError) as e:
+                # API server unreachable: a leader that cannot renew for a
+                # full duration is no longer the leader.
+                if (self.is_leader
+                        and self.clock() - self._last_renew_ok > self.duration_s):
+                    self._lost(f"renew failing: {e}")
+            self._stop.wait(self.renew_every_s)
+
+    def _tick(self) -> None:
+        if self.is_leader:
+            self._renew()
+        else:
+            self._try_acquire()
+
+    def _renew(self) -> None:
+        now = self.clock()
+        try:
+            lease = self.client.get(self.namespace, self.name)
+        except NotFound:
+            self._lost("lease object deleted")
+            return
+        if (lease.spec.holder_identity != self.identity
+                or lease.spec.generation != self.generation):
+            self._lost(f"deposed by {lease.spec.holder_identity or '<none>'} "
+                       f"(generation {lease.spec.generation})")
+            return
+        lease.spec.renew_time = now
+        try:
+            self.client.update(lease)  # CAS on the GET's resourceVersion
+        except (Conflict, NotFound):
+            return  # racer moved it; next tick re-reads and decides
+        self._last_renew_ok = now
+        self._c_renewals.labels(self.identity).inc()
+
+    def _try_acquire(self) -> None:
+        now = self.clock()
+        try:
+            lease = self.client.get(self.namespace, self.name)
+        except NotFound:
+            fresh = Lease(
+                metadata=ObjectMeta(name=self.name, namespace=self.namespace),
+                spec=LeaseSpec(holder_identity=self.identity,
+                               lease_duration_s=self.duration_s,
+                               acquire_time=now, renew_time=now,
+                               generation=1, shards=self.shards))
+            try:
+                self.client.create(fresh)
+            except (AlreadyExists, Conflict):
+                return  # lost the founding race; next tick re-reads
+            self._elected(1)
+            return
+        held_until = (max(lease.spec.renew_time, lease.spec.acquire_time)
+                      + lease.spec.lease_duration_s)
+        if lease.spec.holder_identity and now < held_until:
+            return  # live leader elsewhere
+        gen = lease.spec.generation + 1
+        lease.spec.holder_identity = self.identity
+        lease.spec.lease_duration_s = self.duration_s
+        lease.spec.acquire_time = now
+        lease.spec.renew_time = now
+        lease.spec.generation = gen
+        lease.spec.shards = self.shards
+        try:
+            self.client.update(lease)  # CAS: only one candidate wins
+        except (Conflict, NotFound):
+            return
+        self._elected(gen)
+
+    # -- edges ----------------------------------------------------------------
+
+    def _elected(self, generation: int) -> None:
+        self.is_leader = True
+        self.generation = generation
+        self._last_renew_ok = self.clock()
+        self._g_leader.labels(self.identity).set(1.0)
+        self._c_elections.labels(self.identity).inc()
+        logger.info("%s: %s (generation %d, %d shard(s))",
+                    self.identity, EVENT_ELECTED, generation, self.shards)
+        if self.on_elected is not None:
+            self.on_elected(generation)
+
+    def _lost(self, why: str) -> None:
+        if not self.is_leader:
+            return
+        self.is_leader = False
+        self._g_leader.labels(self.identity).set(0.0)
+        self._c_losses.labels(self.identity).inc()
+        logger.warning("%s: %s (%s); fence token %d retained for "
+                       "split-brain rejection", self.identity, EVENT_LOST,
+                       why, self.generation)
+        if self.on_lost is not None:
+            self.on_lost()

@@ -1,0 +1,107 @@
+"""Consistent-hash ring over controller shard workers.
+
+Maps job UIDs onto shard members the way Maple partitions control across
+clusters (PAPERS.md): each member owns the arc between its virtual nodes
+and the next, so membership changes move only ~1/N of the keyspace —
+the property that makes rebalance a *handoff* instead of a reshuffle.
+
+Deterministic everywhere it is computed: the CLI recomputes the same
+ownership from the lease's advertised shard count (``kctpu get`` SHARD
+column, ``kctpu describe`` Shard line) that the controller's
+``ShardedWorkQueue`` routes by, with no coordination beyond the member
+list itself.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Dict, Iterable, List, Optional
+
+
+def _hash(s: str) -> int:
+    # md5 for speed + spread; this is placement, not security.
+    return int.from_bytes(hashlib.md5(s.encode()).digest()[:8], "big")
+
+
+class HashRing:
+    """Classic consistent-hash ring with virtual nodes.
+
+    Not internally locked: owners mutate it under their own router lock
+    (``ShardedWorkQueue``) or use it read-only after construction (CLI).
+    ``version`` bumps on every membership change so routers can detect a
+    stale cached assignment.
+    """
+
+    def __init__(self, members: Iterable[str] = (), vnodes: int = 64):
+        self.vnodes = vnodes
+        self.version = 0
+        self._members: List[str] = []
+        self._ring: List[int] = []       # sorted vnode hashes
+        self._owner: Dict[int, str] = {}  # vnode hash -> member
+        for m in members:
+            self.add(m)
+
+    @property
+    def members(self) -> List[str]:
+        return list(self._members)
+
+    def __len__(self) -> int:
+        return len(self._members)
+
+    def __contains__(self, member: str) -> bool:
+        return member in self._members
+
+    def add(self, member: str) -> None:
+        if member in self._members:
+            return
+        self._members.append(member)
+        for i in range(self.vnodes):
+            h = _hash(f"{member}#{i}")
+            # Collisions across members are astronomically unlikely at 64
+            # bits; deterministic tie-break keeps duplicate hashes stable.
+            if h in self._owner:
+                continue
+            bisect.insort(self._ring, h)
+            self._owner[h] = member
+        self.version += 1
+
+    def remove(self, member: str) -> None:
+        if member not in self._members:
+            return
+        self._members.remove(member)
+        for i in range(self.vnodes):
+            h = _hash(f"{member}#{i}")
+            if self._owner.get(h) == member:
+                del self._owner[h]
+                idx = bisect.bisect_left(self._ring, h)
+                if idx < len(self._ring) and self._ring[idx] == h:
+                    self._ring.pop(idx)
+        self.version += 1
+
+    def owner(self, key: str) -> Optional[str]:
+        """The member owning ``key`` (the first vnode clockwise of the
+        key's hash), or None on an empty ring."""
+        if not self._ring:
+            return None
+        h = _hash(key)
+        idx = bisect.bisect_right(self._ring, h)
+        if idx == len(self._ring):
+            idx = 0
+        return self._owner[self._ring[idx]]
+
+
+_SHARD_RINGS: Dict[int, HashRing] = {}
+
+
+def shard_of(uid: str, shards: int) -> Optional[int]:
+    """Ownership as an integer shard index — the shared convention between
+    the controller's router and the CLI's display: members are the string
+    indices ``"0".."shards-1"`` on a default-vnode ring."""
+    if shards <= 0:
+        return None
+    ring = _SHARD_RINGS.get(shards)
+    if ring is None:
+        ring = _SHARD_RINGS[shards] = HashRing(str(i) for i in range(shards))
+    owner = ring.owner(uid)
+    return int(owner) if owner is not None else None

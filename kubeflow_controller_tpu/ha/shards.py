@@ -1,0 +1,269 @@
+"""Sharded controller workers: a consistent-hash partition of job UIDs,
+each shard owning its own rate-limited workqueue.
+
+The scaling half of the HA plane (docs/HA.md "Sharded controllers"): one
+:class:`ShardedWorkQueue` replaces the controller's single
+``RateLimitingQueue`` when ``controller_shards > 1``.  Every enqueue
+routes ``namespace/name`` keys through the :class:`~.ring.HashRing` on
+the job's **UID** (cached; the key itself is the deterministic fallback
+before the UID is known), so
+
+- per-job ordering is preserved: a job's syncs always land on the same
+  shard queue, whose dirty/processing discipline serializes them;
+- ``--scale`` work parallelizes: shard workers block on *their* queue
+  and on their syncs' REST round-trips independently (bench.py --ha
+  gates 4-shard ≥ 1.5× single-controller syncs/sec at --scale 200).
+
+**Rebalance** (``set_shards``) is a handoff, not a restart:
+
+1. the router lock closes the intake (adds block, sub-millisecond);
+2. every queue's pending + delayed work is atomically claimed
+   (``drain_pending``), which also claims the dirty flags of keys queued
+   behind an in-flight sync so a completing ``done()`` cannot requeue
+   into the old shard;
+3. the ring membership changes (removed shards' queues shut down after
+   the move — their workers exit on ShutDown);
+4. **in-flight syncs drain**: the router waits until no key whose
+   ownership moved is still processing anywhere (per-key ordering across
+   the boundary);
+5. moved keys get their **expectations replayed** via the ``on_handoff``
+   callback (the controller deletes them, so the new owner's first sync
+   re-plans from observed state instead of trusting counts the old shard
+   accumulated) and every claimed key is re-added through the new
+   routing, delays preserved.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..controller.workqueue import (
+    ItemExponentialFailureRateLimiter,
+    RateLimitingQueue,
+    ShutDown,
+)
+from ..obs.metrics import REGISTRY
+from ..utils import locks
+from .ring import HashRing
+
+logger = logging.getLogger("kubeflow_controller_tpu.ha.shards")
+
+_orig_sleep = locks._orig_sleep
+
+
+class ShardedWorkQueue:
+    """N per-shard :class:`RateLimitingQueue`s behind one UID-hash router.
+
+    Implements the controller-facing queue surface (``add``, ``add_after``,
+    ``add_rate_limited``, ``forget``, ``done``, ``num_requeues``,
+    ``shut_down``, ``__len__``) plus ``get_shard(shard)`` for the
+    per-shard workers.  One shared rate limiter keeps per-key failure
+    counts stable across handoffs."""
+
+    def __init__(self, shards: int, name: str = "tfJobs",
+                 uid_fn: Optional[Callable[[str], Optional[str]]] = None,
+                 on_handoff: Optional[Callable[[str], None]] = None):
+        if shards < 1:
+            raise ValueError("shards must be >= 1")
+        self.name = name
+        self._uid_fn = uid_fn
+        self._on_handoff = on_handoff
+        self._limiter = ItemExponentialFailureRateLimiter()
+        # Router lock: membership + routing + intake.  Never held while
+        # calling back into the controller or waiting on a sync; the
+        # quiesce loop polls processing snapshots (queue-internal locks)
+        # with the router held — queue locks never wrap the router lock,
+        # so the order router -> queue is acyclic.
+        self._lock = locks.named_lock(f"ha.shardq:{name}")
+        # In-flight map: key -> the queue OBJECT that handed it out (not
+        # an index: a shrink may retire the index mid-sync).  Its own
+        # tiny lock so done() never blocks on a rebalance in progress
+        # (the rebalance WAITS on those same done()s to quiesce).
+        self._inflight: Dict[str, RateLimitingQueue] = {}
+        self._inflight_lock = locks.named_lock(f"ha.shardq.inflight:{name}")
+        self._uid_cache: Dict[str, str] = {}
+        self._ring = HashRing()
+        self._queues: List[RateLimitingQueue] = []
+        self._shutting_down = False
+        self._g_depth = REGISTRY.gauge(
+            "kctpu_ha_shard_queue_depth",
+            "Pending keys per controller shard workqueue", ("shard",))
+        self._g_members = REGISTRY.gauge(
+            "kctpu_ha_ring_members",
+            "Controller shard workers currently on the hash ring")
+        self._c_rebalances = REGISTRY.counter(
+            "kctpu_ha_rebalances_total",
+            "Shard-ring membership changes (handoff rebalances)")
+        self._c_handoffs = REGISTRY.counter(
+            "kctpu_ha_handoff_keys_total",
+            "Job keys moved to a different shard by a rebalance")
+        with self._lock:
+            self._resize_locked(shards)
+
+    # -- routing -------------------------------------------------------------
+
+    @property
+    def shards(self) -> int:
+        with self._lock:
+            return len(self._queues)
+
+    def _route_id(self, key: str) -> str:
+        """The ring key for a job key: its UID when resolvable (the
+        ISSUE-spec partition domain — stable across renames and
+        consistent with the CLI's shard_of display), else the key itself
+        (deterministic before the first cache fill)."""
+        uid = self._uid_cache.get(key)
+        if uid is None and self._uid_fn is not None:
+            uid = self._uid_fn(key)
+            if uid:
+                self._uid_cache[key] = uid
+        return uid or key
+
+    def _route_locked(self, key: str) -> int:
+        owner = self._ring.owner(self._route_id(key))
+        return int(owner) if owner is not None else 0
+
+    def forget_route(self, key: str) -> None:
+        """Drop the key's cached UID (job deleted; a recreated same-name
+        job gets a fresh UID and may legitimately land elsewhere)."""
+        with self._lock:
+            self._uid_cache.pop(key, None)
+
+    # -- queue surface (controller-facing) -----------------------------------
+
+    def add(self, key: str) -> None:
+        with self._lock:
+            if self._shutting_down:
+                return
+            self._queues[self._route_locked(key)].add(key)
+
+    def add_after(self, key: str, delay: float) -> None:
+        with self._lock:
+            if self._shutting_down:
+                return
+            self._queues[self._route_locked(key)].add_after(key, delay)
+
+    def add_rate_limited(self, key: str) -> None:
+        self.add_after(key, self._limiter.when(key))
+
+    def forget(self, key: str) -> None:
+        self._limiter.forget(key)
+
+    def num_requeues(self, key: str) -> int:
+        return self._limiter.num_requeues(key)
+
+    def get_shard(self, shard: int, timeout: Optional[float] = None) -> Optional[str]:
+        """Blocking pop from one shard's queue (the shard worker loop).
+        Raises ShutDown when that shard is being retired or the whole
+        queue shut down."""
+        with self._lock:
+            if shard >= len(self._queues):
+                raise ShutDown()
+            q = self._queues[shard]
+        key = q.get(timeout=timeout)
+        if key is not None:
+            with self._inflight_lock:
+                self._inflight[key] = q
+        return key
+
+    def done(self, key: str) -> None:
+        with self._inflight_lock:
+            q = self._inflight.pop(key, None)
+        if q is not None:
+            q.done(key)
+
+    def shut_down(self) -> None:
+        with self._lock:
+            self._shutting_down = True
+            for q in self._queues:
+                q.shut_down()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(len(q) for q in self._queues)
+
+    # -- rebalance -----------------------------------------------------------
+
+    def _resize_locked(self, n: int) -> Tuple[List[RateLimitingQueue], List[int]]:
+        """Adjust membership to n shards; returns (retired queues, new
+        shard indices).  Caller holds the router lock."""
+        retired: List[RateLimitingQueue] = []
+        new_idx: List[int] = []
+        while len(self._queues) > n:
+            i = len(self._queues) - 1
+            self._ring.remove(str(i))
+            retired.append(self._queues.pop())
+            self._g_depth.remove(str(i))
+        while len(self._queues) < n:
+            i = len(self._queues)
+            q = RateLimitingQueue(rate_limiter=self._limiter,
+                                  name=f"{self.name}-shard-{i}")
+            self._queues.append(q)
+            self._ring.add(str(i))
+            self._g_depth.labels(str(i)).set_function(lambda q=q: len(q))
+            new_idx.append(i)
+        self._g_members.set(len(self._queues))
+        return retired, new_idx
+
+    def set_shards(self, n: int, quiesce_timeout: float = 10.0) -> List[int]:
+        """Rebalance to ``n`` shard workers with a draining handoff (see
+        module docstring).  Returns the indices of newly created shards
+        (the controller spawns workers for them)."""
+        if n < 1:
+            raise ValueError("shards must be >= 1")
+        with self._lock:
+            if self._shutting_down:
+                return []
+            old_queues = list(enumerate(self._queues))
+            claimed: List[Tuple[int, str, float]] = []
+            for idx, q in old_queues:
+                for key, ready_at in q.drain_pending():
+                    claimed.append((idx, key, ready_at))
+            retired, new_idx = self._resize_locked(n)
+            # Which keys changed owner?  (Routing answered under the same
+            # lock the membership changed under: no torn view.)
+            moved = {key for idx, key, _ in claimed
+                     if self._route_locked(key) != idx}
+            # In-flight syncs whose key moved (or whose whole shard
+            # retired) must finish before the new owner may start: poll
+            # the old queues' processing sets.  done() only needs the
+            # inflight lock, never the router lock — no deadlock.
+            deadline = locks._orig_monotonic() + quiesce_timeout
+            while True:
+                busy = []
+                for idx, q in old_queues:
+                    gone = q in retired
+                    for key in q.processing_snapshot():
+                        if gone or self._route_locked(key) != idx:
+                            busy.append(key)
+                            moved.add(key)
+                if not busy:
+                    break
+                if locks._orig_monotonic() > deadline:
+                    logger.warning(
+                        "shard handoff quiesce timed out; %d in-flight "
+                        "sync(s) still running: %s", len(busy), busy[:5])
+                    break
+                _orig_sleep(0.002)
+            for q in retired:
+                q.shut_down()
+            # Expectations replay + re-add through the new routing.
+            if self._on_handoff is not None:
+                for key in sorted(moved):
+                    self._on_handoff(key)
+            self._c_handoffs.inc(len(moved))
+            now = time.time()  # drain_pending deadlines are wall-clock
+            readd = {key: ready_at for _, key, ready_at in claimed}
+            for key in moved - set(readd):
+                readd[key] = 0.0  # moved in-flight keys get one level sync
+            for key, ready_at in sorted(readd.items()):
+                q = self._queues[self._route_locked(key)]
+                delay = ready_at - now if ready_at else 0.0
+                if delay > 0:
+                    q.add_after(key, delay)
+                else:
+                    q.add(key)
+            self._c_rebalances.inc()
+            return new_idx

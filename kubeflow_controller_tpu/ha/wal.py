@@ -1,0 +1,336 @@
+"""Write-ahead log + compacting snapshots for the object store.
+
+The durability layer of the HA control plane (docs/HA.md "WAL format").
+Every store write already funnels through exactly one choke point —
+``ObjectStore._notify`` emits one watch event per resourceVersion, carrying
+the immutable stored snapshot — so the WAL records exactly that stream:
+``(rv, event-type, kind, object)``.  Replaying it reproduces the store
+bit-for-bit: the per-kind collections, the RV/uid counters, AND the PR-5
+watch-cache rings (events ARE the ring), so a watch client that resumes
+against a recovered apiserver replays precisely the events it missed.
+
+On-disk layout (one directory):
+
+- ``wal.log`` — ``KCTPUWAL1\\n`` magic, then length-prefixed CRC-framed
+  records: ``<u32 len><u32 crc32(payload)><payload>`` with a compact-JSON
+  payload ``{rv, ev, kind, cls, obj}``.  Appends are flushed and (by
+  default) fsync'd under the WAL lock before the store write returns —
+  a write acknowledged to a client is durable.
+- ``snap-<rv>.json`` — compacting snapshots: the full store state
+  ``{rv, uid, kinds: {kind: [{cls, obj}, ...]}}`` written atomically
+  (tmp + fsync + rename).  ``compact(state)`` writes one and rewrites
+  ``wal.log`` keeping only records with ``rv > state["rv"]``; records in
+  the overlap window are both in the snapshot and the log — replay is an
+  idempotent upsert, so double-application is harmless by construction.
+
+Failure handling (docs/HA.md failure matrix):
+
+- torn tail (crash mid-append): replay stops at the first bad frame —
+  short header, short payload, CRC mismatch, or unparseable JSON — and
+  **truncates the file there** (``kctpu_wal_torn_tail_truncations_total``).
+  Everything before the tear was fsync'd and survives.
+- corrupt snapshot (crash mid-snapshot never happens — the rename is
+  atomic — but disk rot can): an unparseable snapshot is skipped and the
+  next-newest used; the WAL still holds every record after ITS rv.
+
+Lock order: a store write appends while holding its shard lock, so the
+global order is ``store.shard:* -> ha.wal`` — the WAL lock never wraps a
+shard acquisition (compaction takes the state capture as an argument for
+exactly this reason).  File I/O under ``ha.wal`` is the lock's purpose:
+it is declared ``allow_blocking``.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import logging
+import os
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from ..obs.metrics import REGISTRY
+from ..utils import locks, serde
+
+logger = logging.getLogger("kubeflow_controller_tpu.ha.wal")
+
+MAGIC = b"KCTPUWAL1\n"
+_FRAME = struct.Struct("<II")
+
+#: Object types may only be materialized out of this package — a WAL is
+#: data, not code, and must not be able to import arbitrary modules.
+_ALLOWED_PREFIX = "kubeflow_controller_tpu."
+
+_CLS_CACHE: Dict[str, type] = {}
+
+
+class WALError(Exception):
+    """Unrecoverable WAL corruption (bad magic / unresolvable type tag)."""
+
+
+def type_tag(obj: Any) -> str:
+    """Stable dotted import path of ``obj``'s class, stored per record so
+    replay can rebuild typed objects without a kind registry."""
+    cls = type(obj)
+    return f"{cls.__module__}.{cls.__qualname__}"
+
+
+def materialize(tag: str, d: dict) -> Any:
+    """Inverse of :func:`type_tag` + ``serde.from_dict``; import is
+    restricted to the project package."""
+    cls = _CLS_CACHE.get(tag)
+    if cls is None:
+        mod, _, name = tag.rpartition(".")
+        if not mod.startswith(_ALLOWED_PREFIX.rstrip(".")):
+            raise WALError(f"refusing to materialize type {tag!r}: outside "
+                           f"the {_ALLOWED_PREFIX}* namespace")
+        cls = getattr(importlib.import_module(mod), name)
+        _CLS_CACHE[tag] = cls
+    return serde.from_dict(cls, d)
+
+
+@dataclass(frozen=True)
+class WALRecord:
+    """One journaled store write: the (rv, event, kind, object) tuple the
+    store's ``_notify`` choke point emitted."""
+
+    rv: int
+    ev: str        # ADDED | MODIFIED | DELETED
+    kind: str      # plural collection ("pods", "tfjobs", "leases", ...)
+    cls: str       # dotted type tag for materialization
+    obj: dict      # serde.to_dict of the immutable stored snapshot
+
+    def materialize(self) -> Any:
+        return materialize(self.cls, self.obj)
+
+
+class WriteAheadLog:
+    """Append-only journal + snapshot directory; thread-safe."""
+
+    def __init__(self, directory: str, fsync: bool = True,
+                 keep_snapshots: int = 2):
+        os.makedirs(directory, exist_ok=True)
+        self.dir = directory
+        self.fsync = fsync
+        self.keep_snapshots = keep_snapshots
+        self.path = os.path.join(directory, "wal.log")
+        self._lock = locks.named_lock("ha.wal", allow_blocking=True)
+        self._c_appends = REGISTRY.counter(
+            "kctpu_wal_appends_total", "Records appended to the WAL")
+        self._c_bytes = REGISTRY.counter(
+            "kctpu_wal_bytes_total", "Framed bytes appended to the WAL")
+        self._c_fsyncs = REGISTRY.counter(
+            "kctpu_wal_fsyncs_total", "fsync() calls issued by WAL appends")
+        self._c_replayed = REGISTRY.counter(
+            "kctpu_wal_replayed_records_total",
+            "Records read back by WAL replay (recovery or compaction)")
+        self._c_torn = REGISTRY.counter(
+            "kctpu_wal_torn_tail_truncations_total",
+            "Torn/corrupt WAL tails truncated during replay (crash "
+            "mid-append recovery)")
+        self._c_snapshots = REGISTRY.counter(
+            "kctpu_wal_snapshots_total", "Compacting snapshots written")
+        self._c_compactions = REGISTRY.counter(
+            "kctpu_wal_compactions_total",
+            "WAL compactions (snapshot + log rewrite)")
+        self._g_size = REGISTRY.gauge(
+            "kctpu_wal_size_bytes", "Current size of wal.log on disk")
+        self._g_size.set_function(self.size_bytes)
+        self._fh = None
+        with self._lock:
+            self._open_append()
+
+    # -- append path ---------------------------------------------------------
+
+    def _open_append(self) -> None:
+        fresh = not (os.path.exists(self.path)
+                     and os.path.getsize(self.path) >= len(MAGIC))
+        self._fh = open(self.path, "ab")
+        if fresh:
+            self._fh.write(MAGIC)
+            self._fh.flush()
+            if self.fsync:
+                os.fsync(self._fh.fileno())
+
+    def append(self, rv: int, ev_type: str, kind: str, obj: Any) -> None:
+        """Journal one store write.  Called by the store while it holds the
+        kind's shard lock; durable (flushed + fsync'd) on return."""
+        payload = json.dumps(
+            {"rv": rv, "ev": ev_type, "kind": kind,
+             "cls": type_tag(obj), "obj": serde.to_dict(obj)},
+            separators=(",", ":")).encode()
+        frame = _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
+        with self._lock:
+            self._fh.write(frame)
+            self._fh.flush()
+            if self.fsync:
+                os.fsync(self._fh.fileno())
+                self._c_fsyncs.inc()
+        self._c_appends.inc()
+        self._c_bytes.inc(len(frame))
+
+    def flush(self) -> None:
+        """Flush + fsync the journal (the FakeAPIServer shutdown hook: a
+        stopped server leaves no buffered tail behind)."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+                self._fh.close()
+                self._fh = None
+
+    def size_bytes(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    # -- replay --------------------------------------------------------------
+
+    def replay(self) -> List[WALRecord]:
+        """Every intact record, in append order.  A torn/corrupt tail is
+        truncated in place (see module docstring) so the next append
+        starts from the last good frame."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+            return self._replay_locked()
+
+    def _replay_locked(self) -> List[WALRecord]:
+        records: List[WALRecord] = []
+        torn = None
+        with open(self.path, "rb") as fh:
+            magic = fh.read(len(MAGIC))
+            if magic != MAGIC:
+                if magic:
+                    raise WALError(f"{self.path}: bad magic {magic[:16]!r}")
+                return records  # zero-length file: nothing journaled yet
+            good = fh.tell()
+            while True:
+                hdr = fh.read(_FRAME.size)
+                if not hdr:
+                    break
+                if len(hdr) < _FRAME.size:
+                    torn = "short frame header"
+                    break
+                n, crc = _FRAME.unpack(hdr)
+                payload = fh.read(n)
+                if len(payload) < n:
+                    torn = "short payload"
+                    break
+                if zlib.crc32(payload) != crc:
+                    torn = "CRC mismatch"
+                    break
+                try:
+                    d = json.loads(payload)
+                except ValueError:
+                    torn = "unparseable payload"
+                    break
+                records.append(WALRecord(
+                    rv=int(d["rv"]), ev=d["ev"], kind=d["kind"],
+                    cls=d["cls"], obj=d["obj"]))
+                good = fh.tell()
+        if torn is not None:
+            logger.warning("WAL %s: %s at offset %d; truncating torn tail "
+                           "(%d intact records kept)",
+                           self.path, torn, good, len(records))
+            if self._fh is not None:
+                self._fh.close()
+            with open(self.path, "r+b") as fh:
+                fh.truncate(good)
+                fh.flush()
+                os.fsync(fh.fileno())
+            self._open_append()
+            self._c_torn.inc()
+        self._c_replayed.inc(len(records))
+        return records
+
+    # -- snapshots + compaction ---------------------------------------------
+
+    def _snap_paths(self) -> List[str]:
+        try:
+            names = sorted(n for n in os.listdir(self.dir)
+                           if n.startswith("snap-") and n.endswith(".json"))
+        except OSError:
+            return []
+        return [os.path.join(self.dir, n) for n in names]
+
+    def write_snapshot(self, state: dict) -> str:
+        """Atomically persist a full-store state capture (see
+        ``ObjectStore.export_state``) keyed by its resourceVersion."""
+        rv = int(state["rv"])
+        path = os.path.join(self.dir, f"snap-{rv:016d}.json")
+        tmp = path + ".tmp"
+        body = json.dumps(state, separators=(",", ":")).encode()
+        with open(tmp, "wb") as fh:
+            fh.write(body)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        self._c_snapshots.inc()
+        return path
+
+    def load_snapshot(self) -> Optional[dict]:
+        """Newest parseable snapshot state, or None.  Corrupt snapshots are
+        skipped (never deleted here — compaction prunes)."""
+        for path in reversed(self._snap_paths()):
+            try:
+                with open(path, "rb") as fh:
+                    d = json.load(fh)
+                if "rv" in d and "kinds" in d:
+                    return d
+            except (OSError, ValueError):
+                logger.warning("skipping unreadable snapshot %s", path)
+        return None
+
+    def compact(self, state: dict) -> int:
+        """Write ``state`` as a snapshot, then rewrite the journal keeping
+        only records with ``rv > state['rv']`` (older records are now
+        redundant with the snapshot).  Returns records kept.  Concurrent
+        appends block on the WAL lock for the rewrite — the store is free
+        to keep writing; its shard locks are never touched here."""
+        self.write_snapshot(state)
+        cut = int(state["rv"])
+        with self._lock:
+            records = self._replay_locked()
+            keep = [r for r in records if r.rv > cut]
+            tmp = self.path + ".tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(MAGIC)
+                for r in keep:
+                    payload = json.dumps(
+                        {"rv": r.rv, "ev": r.ev, "kind": r.kind,
+                         "cls": r.cls, "obj": r.obj},
+                        separators=(",", ":")).encode()
+                    fh.write(_FRAME.pack(len(payload), zlib.crc32(payload)))
+                    fh.write(payload)
+                fh.flush()
+                os.fsync(fh.fileno())
+            if self._fh is not None:
+                self._fh.close()
+            os.replace(tmp, self.path)
+            self._open_append()
+            # Prune old snapshots past the retention window.
+            snaps = self._snap_paths()
+            for path in snaps[:-self.keep_snapshots]:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        self._c_compactions.inc()
+        return len(keep)
+
+
+def replay_seconds_gauge():
+    """Shared gauge for recovery timing (set by ``ObjectStore.recover``)."""
+    return REGISTRY.gauge(
+        "kctpu_wal_last_replay_seconds",
+        "Wall-clock seconds the last WAL-over-snapshot recovery took")

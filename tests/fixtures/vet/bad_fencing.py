@@ -1,0 +1,18 @@
+"""Fixture: store writes on controller sync paths WITHOUT a fencing
+token — every write here must be flagged by the fencing-token rule.
+A deposed leader running exactly this code after a failover corrupts
+state the new leader already moved past (docs/HA.md)."""
+
+
+def sync_job(store, job):
+    store.update("tfjobs", job)                       # BAD: no fence
+    store.update_status("tfjobs", job)                # BAD: no fence
+
+
+def manage_children(self, pod):
+    self._store.create("pods", pod)                   # BAD: no fence
+    self._store.delete("pods", "default", "p-0")      # BAD: no fence
+
+
+def adopt(cluster, ns, name, fn):
+    cluster.store.patch_meta("pods", ns, name, fn)    # BAD: no fence

@@ -1,0 +1,29 @@
+"""Fixture: the same store writes carrying the leader fencing token (or
+an explicit provider) — the fencing-token rule must stay silent.  Reads
+and non-store receivers are out of scope by design."""
+
+
+def sync_job(store, job, lease):
+    store.update("tfjobs", job, fence=lease.generation)
+    store.update_status("tfjobs", job, fence=lease.generation)
+
+
+def manage_children(self, pod):
+    self._store.create("pods", pod, fence=self._fence())
+    self._store.delete("pods", "default", "p-0", fence=self._fence())
+
+
+def adopt(cluster, ns, name, fn, token):
+    cluster.store.patch_meta("pods", ns, name, fn, fence=token)
+
+
+def read_paths(store):
+    store.get("pods", "default", "p-0")       # reads are never fenced
+    store.list("pods", "default")
+    store.watch("pods")
+
+
+def typed_client_write(cluster, job):
+    # Typed clients stamp the fence internally (cluster/client.py): the
+    # rule keys on *store receivers, not client objects.
+    cluster.tfjobs.update(job)
