@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all ci test test-fast lint typecheck cov cov-local bench dryrun validate vet race-smoke check-smoke metrics-smoke scale-smoke stall-smoke widejob-smoke churn-smoke store-smoke sched-smoke ttfs-smoke chaos-smoke ha-smoke
+.PHONY: all ci test test-fast lint typecheck cov cov-local bench dryrun validate vet race-smoke check-smoke metrics-smoke scale-smoke stall-smoke widejob-smoke churn-smoke store-smoke sched-smoke ttfs-smoke chaos-smoke elastic-smoke ha-smoke
 
 all: lint vet test race-smoke check-smoke
 
@@ -15,7 +15,7 @@ all: lint vet test race-smoke check-smoke
 # included), then tier-1 under the runtime lock-order detector.  Run
 # without -j: the order is the diagnosis ladder (cheapest, most precise
 # signal first).
-ci: vet race-smoke check-smoke ha-smoke
+ci: vet race-smoke check-smoke chaos-smoke elastic-smoke ha-smoke
 	KCTPU_LOCKCHECK=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m "not slow"
 
 # Fast/slow split: `test-fast` (-m "not slow") is the quick signal — 214 of
@@ -245,6 +245,28 @@ chaos-smoke:
 		      '| max lost steps', d['details']['max_lost_steps'], \
 		      '/', d['details']['checkpoint_every'], \
 		      '| never-probe', d['details']['never_probe']['reason'][:40])"
+
+# Elastic smoke (the degraded-width training gate, docs/RECOVERY.md
+# "Elastic width"): ONE real 3-worker dist-mnist --step-loop gang with
+# elastic {min_width: 2} and async checkpoints every 40 steps; 1 worker
+# SIGKILLed mid-fit.  Gates: the controller re-shards the survivors to
+# width 2 and steps/sec stays > 0 THROUGH the degraded window (no
+# full-gang stop), the gang re-expands to full width resuming from the
+# degraded run's checkpoint (never restore-from-scratch), lost steps <=
+# the checkpoint interval per transition, and the scheduler contention
+# probe admits a blocked high-priority gang by HARVESTING width from a
+# running elastic victim — zero whole-gang preemptions.  ~60-90 s.
+elastic-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --elastic --kills 1 --seed 7 \
+		> /tmp/kctpu_elastic_smoke.json
+	@$(PY) -c "import json; d = json.load(open('/tmp/kctpu_elastic_smoke.json')); \
+		assert {'metric', 'value', 'unit', 'details'} <= set(d), d; \
+		print('elastic-smoke ok: degraded steps/sec', d['value'], \
+		      '| degraded at width', [r['degraded_width'] for r in d['details']['records']], \
+		      '| t-degraded', d['details']['time_to_degraded_s'], 's', \
+		      '| t-restored', d['details']['time_to_restored_s'], 's', \
+		      '| lost', d['details']['lost_steps'], '/', d['details']['checkpoint_every'], \
+		      '| harvest', d['details']['harvest']['counters'].get('harvested_slices', {}))"
 
 # HA smoke (the control plane's standing availability gate): 2 controller
 # candidates over one WAL-backed store; the leader is SIGKILLed mid-storm

@@ -1257,6 +1257,402 @@ def chaos_main(args) -> int:
     return rc
 
 
+def run_elastic(kills: int = 1, seed: int = 7, steps: int = 240,
+                checkpoint_every: int = 40, workers: int = 3,
+                min_width: int = 2, batch: int = 256,
+                step_sleep: float = 0.03, warmup_s: float = 2.0,
+                min_degraded_s: float = 2.0,
+                deadline_s: float = 240.0) -> dict:
+    """Elastic bench (the degraded-width training gate, ELASTIC_r01.json).
+
+    Probe 1 — degraded-width training (executed): ONE ``workers``-wide
+    dist-mnist ``--step-loop`` gang with ``elastic: {min_width}``, async
+    Orbax checkpoints every ``checkpoint_every`` steps.  A seeded monkey
+    SIGKILLs 1 of N workers mid-fit; the controller re-shards the
+    survivors to width N-1 (generation bump + width annotation), they
+    restore the latest checkpoint and KEEP TRAINING while the replacement
+    warms (``warmup_s`` models the warm-up window), then the gang
+    re-expands to full width resuming from the degraded run's checkpoint.
+    Measured off the public status surface: time-to-degraded, steps/sec
+    THROUGH the degraded window (the "no full-gang stop" gate),
+    time-to-restored, and lost steps per transition (≤ the checkpoint
+    interval — resume, never restore-from-scratch).
+
+    Probe 2 — width harvesting (simulated scheduler contention): a
+    low-priority elastic TPU gang spans all 4 slices; a high-priority
+    2-slice gang arrives.  The scheduler must admit it by HARVESTING two
+    slices from the elastic victim (zero whole-gang preemptions): the
+    victim re-shards down, keeps running, and re-expands to full width
+    once the high job finishes and contention clears."""
+    import shutil
+    import tempfile
+
+    from kubeflow_controller_tpu.api.core import (
+        Container,
+        EnvVar,
+        PodTemplateSpec,
+    )
+    from kubeflow_controller_tpu.api.meta import ObjectMeta
+    from kubeflow_controller_tpu.api.tfjob import (
+        ElasticSpec,
+        ReplicaType,
+        TFJob,
+        TFJobPhase,
+        TFReplicaSpec,
+        TPUSpec,
+    )
+    from kubeflow_controller_tpu.cluster import Cluster, FakeKubelet, PhasePolicy
+    from kubeflow_controller_tpu.controller import Controller
+    from kubeflow_controller_tpu.elastic import ElasticPolicy
+    from kubeflow_controller_tpu.obs.metrics import REGISTRY
+    from kubeflow_controller_tpu.recovery.chaos import ChaosMonkey
+
+    counters = {
+        "preemptions": ("kctpu_sched_preemptions_total", ("priority_class",)),
+        "harvested_slices": ("kctpu_sched_harvested_slices_total",
+                             ("priority_class",)),
+        "transitions": ("kctpu_elastic_transitions_total", ("kind",)),
+    }
+
+    def counter_totals() -> dict:
+        out = {}
+        for key, (name, labels) in counters.items():
+            c = REGISTRY.counter(name, "", labels)
+            with c._lock:
+                out[key] = dict(c._values)
+        return out
+
+    def delta(after: dict, before: dict) -> dict:
+        out = {}
+        for key in after:
+            out[key] = {"/".join(k) or "total": v - before[key].get(k, 0.0)
+                        for k, v in after[key].items()
+                        if v - before[key].get(k, 0.0)}
+        return out
+
+    # ---- probe 1: degraded-width training through a real kill ---------
+    cluster = Cluster()
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=4.0,
+                                                      heartbeat_s=0.05),
+                          execute=True)
+    ctrl = Controller(cluster, resync_period_s=1.0,
+                      elastic_policy=ElasticPolicy(
+                          warmup_s=warmup_s,
+                          min_degraded_s=min_degraded_s))
+    kubelet.start()
+    ctrl.run(threadiness=2)
+    kubelet.wait_warm()
+    monkey = ChaosMonkey(cluster, kubelet, seed=seed)
+    tmp_roots = []
+
+    def fresh_dir(prefix: str) -> str:
+        d = tempfile.mkdtemp(prefix=prefix)
+        tmp_roots.append(d)
+        return d
+
+    cache_dir = fresh_dir("elastic-cache-")
+
+    def mk_train_job(name: str) -> TFJob:
+        job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+        job.spec.model_dir = fresh_dir(f"elastic-ckpt-{name}-")
+        job.spec.compile_cache_dir = cache_dir
+        job.spec.checkpoint_every_steps = checkpoint_every
+        job.spec.elastic = ElasticSpec(min_width=min_width)
+        t = PodTemplateSpec()
+        c = Container(
+            name="tensorflow", image="dist",
+            command=[sys.executable, "-m",
+                     "kubeflow_controller_tpu.workloads.mnist_dist",
+                     "--platform", "cpu", "--step-loop",
+                     "--steps", str(steps), "--batch-size", str(batch),
+                     "--train-size", "4096", "--eval-size", "512"],
+            working_dir=REPO,
+        )
+        c.env.append(EnvVar(name="KCTPU_STEP_SLEEP", value=str(step_sleep)))
+        t.spec.containers.append(c)
+        t.spec.restart_policy = "OnFailure"
+        job.spec.tf_replica_specs = [TFReplicaSpec(
+            replicas=workers, tf_replica_type=ReplicaType.WORKER, template=t,
+            gang_restart=True)]
+        return job
+
+    def wait_phase(name: str, want, timeout: float):
+        end = time.time() + timeout
+        j = None
+        while time.time() < end:
+            j = cluster.tfjobs.get("default", name)
+            if j.status.phase in (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED):
+                return j.status.phase == want, j
+            time.sleep(0.05)
+        return False, j
+
+    before = counter_totals()
+    elastic_records = []
+    kill_records = []
+    succeeded = []
+    failed = []
+    try:
+        for i in range(max(1, kills)):
+            name = f"elastic-{i:02d}"
+            cluster.tfjobs.create(mk_train_job(name))
+            lo = checkpoint_every + 5
+            hi = max(lo + 1, min(2 * checkpoint_every + 20, steps - 60))
+            trigger = monkey.rng.randint(lo, hi)
+            rec = monkey.kill_at_step("default", name, trigger,
+                                      deadline_s=deadline_s)
+            if rec is not None:
+                er = monkey.await_elastic("default", rec, spec_width=workers,
+                                          deadline_s=deadline_s)
+                elastic_records.append(er)
+                kill_records.append(rec)
+            ok, j = wait_phase(name, TFJobPhase.SUCCEEDED, deadline_s)
+            (succeeded if ok else failed).append(name)
+    finally:
+        ctrl.stop()
+        kubelet.stop()
+        for d in tmp_roots:
+            shutil.rmtree(d, ignore_errors=True)
+
+    events = {e.reason for name in succeeded + failed
+              for e in ctrl.recorder.events_for("default", name)}
+
+    # ---- probe 2: width harvesting under slice contention -------------
+    harvest = _run_harvest_probe(delta, counter_totals)
+
+    degrade_delta = delta(counter_totals(), before)
+    lost = [max(0, k.step_at_kill - e.degraded_resumed_from)
+            for k, e in zip(kill_records, elastic_records)
+            if e.degraded_resumed_from >= 0]
+    return {
+        "kills_planned": kills,
+        "kills_executed": len(kill_records),
+        "seed": seed,
+        "steps": steps,
+        "checkpoint_every": checkpoint_every,
+        "workers": workers,
+        "min_width": min_width,
+        "warmup_s": warmup_s,
+        "min_degraded_s": min_degraded_s,
+        "step_sleep_s": step_sleep,
+        "succeeded": succeeded,
+        "failed": failed,
+        "degraded_rate": round(
+            sum(1 for e in elastic_records if e.degraded)
+            / max(1, len(elastic_records)), 3),
+        "restored_rate": round(
+            sum(1 for e in elastic_records if e.restored)
+            / max(1, len(elastic_records)), 3),
+        "time_to_degraded_s": [round(e.time_to_degraded_s, 3)
+                               for e in elastic_records],
+        "time_to_restored_s": [round(e.time_to_restored_s, 3)
+                               for e in elastic_records],
+        "degraded_steps_per_sec": [e.degraded_steps_per_sec
+                                   for e in elastic_records],
+        "degraded_step_samples": [e.degraded_step_samples
+                                  for e in elastic_records],
+        "lost_steps": lost,
+        "max_lost_steps": max(lost) if lost else -1,
+        "events_seen": sorted(events & {"GangDegraded", "GangRestored"}),
+        "records": [{
+            "job": e.job, "spec_width": e.spec_width,
+            "degraded_width": e.degraded_width,
+            "step_at_kill": k.step_at_kill,
+            "degraded_resumed_from": e.degraded_resumed_from,
+            "restored_resumed_from": e.restored_resumed_from,
+            "time_to_degraded_s": round(e.time_to_degraded_s, 3),
+            "time_to_restored_s": round(e.time_to_restored_s, 3),
+            "degraded_steps_per_sec": e.degraded_steps_per_sec,
+        } for k, e in zip(kill_records, elastic_records)],
+        "counters": degrade_delta,
+        "harvest": harvest,
+    }
+
+
+def _run_harvest_probe(delta, counter_totals, run_s: float = 6.0,
+                       high_run_s: float = 2.0) -> dict:
+    """Probe 2 of the elastic bench: a blocked high-priority gang must be
+    admitted by HARVESTING width from a running low-priority elastic gang
+    — zero whole-gang preemptions — and the victim must re-expand once
+    the high job completes and capacity frees."""
+    from kubeflow_controller_tpu.api.core import Container, PodTemplateSpec
+    from kubeflow_controller_tpu.api.meta import ObjectMeta
+    from kubeflow_controller_tpu.api.tfjob import (
+        ElasticSpec,
+        ReplicaType,
+        TFJob,
+        TFJobPhase,
+        TFReplicaSpec,
+        TPUSpec,
+    )
+    from kubeflow_controller_tpu.cluster import (
+        Cluster,
+        FakeKubelet,
+        PhasePolicy,
+        TPUInventory,
+        TPUSlice,
+    )
+    from kubeflow_controller_tpu.controller import Controller
+    from kubeflow_controller_tpu.elastic import ElasticPolicy
+    from kubeflow_controller_tpu.scheduler import GangScheduler, SchedulerPolicy
+
+    def mk_tpu_job(name: str, cls: str, num_slices: int,
+                   elastic_min: int = 0) -> TFJob:
+        job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+        job.spec.priority_class_name = cls
+        if elastic_min:
+            job.spec.elastic = ElasticSpec(min_width=elastic_min)
+        t = PodTemplateSpec()
+        t.spec.containers.append(Container(name="tensorflow", image="img"))
+        t.spec.restart_policy = "OnFailure"
+        job.spec.tf_replica_specs = [TFReplicaSpec(
+            replicas=2 * num_slices, tf_replica_type=ReplicaType.TPU,
+            template=t,
+            tpu=TPUSpec(accelerator_type="v5e-8", num_hosts=2,
+                        num_slices=num_slices))]
+        return job
+
+    cluster = Cluster()
+    inv = TPUInventory([TPUSlice(f"slice-{i}", "v5e-8", num_hosts=2)
+                        for i in range(4)])
+    sched = GangScheduler(inv, SchedulerPolicy())
+    # The victim must OUTLIVE the probe (a real elastic victim is a
+    # long-running training job): only the high-priority foreground job
+    # completes on the clock.
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(
+        run_s=run_s, heartbeat_s=0.05,
+        run_s_by_job={"harvest-low": 120.0, "harvest-high": high_run_s}),
+        inventory=sched)
+    ctrl = Controller(cluster, inventory=sched, resync_period_s=0.5,
+                      elastic_policy=ElasticPolicy(warmup_s=0.2,
+                                                   min_degraded_s=0.2,
+                                                   capacity_poll_s=0.1))
+    kubelet.start()
+    ctrl.run(threadiness=2)
+
+    def pods_running(name: str) -> int:
+        return sum(1 for p in cluster.pods.list("default")
+                   if p.metadata.labels.get("tf_job_name") == name
+                   and p.status.phase == "Running")
+
+    def width_of(name: str):
+        w = cluster.tfjobs.get("default", name).status.width
+        return w.current if w is not None else None
+
+    out = {"high_admitted": False, "high_ttfs_s": 0.0,
+           "low_degraded_width": 0, "low_restored": False,
+           "low_failed_phase": False, "counters": {}}
+    before = counter_totals()
+    try:
+        # Low-priority elastic gang: all 4 slices (8 pods), floor 2 slices.
+        cluster.tfjobs.create(mk_tpu_job("harvest-low", "low", 4,
+                                         elastic_min=4))
+        end = time.time() + 30
+        while time.time() < end and pods_running("harvest-low") < 8:
+            time.sleep(0.02)
+
+        # Blocked high-priority gang: needs 2 slices, none free.
+        t0 = time.time()
+        cluster.tfjobs.create(mk_tpu_job("harvest-high", "high", 2))
+        end = time.time() + 30
+        while time.time() < end:
+            if pods_running("harvest-high") >= 4:
+                out["high_admitted"] = True
+                out["high_ttfs_s"] = round(time.time() - t0, 3)
+                break
+            time.sleep(0.02)
+        # Contention clears: the high job completes; the victim must
+        # re-expand to full width and keep running.  The victim's
+        # degraded width is the MINIMUM width observed along the way
+        # (the transition is level-triggered; a single sample can race
+        # the patch).
+        min_w = 8
+        end = time.time() + 60
+        while time.time() < end:
+            j = cluster.tfjobs.get("default", "harvest-low")
+            if j.status.phase == TFJobPhase.FAILED:
+                out["low_failed_phase"] = True
+                break
+            w = width_of("harvest-low")
+            if w is not None:
+                min_w = min(min_w, w)
+            if (min_w < 8 and w is not None and w >= 8
+                    and pods_running("harvest-low") >= 8):
+                out["low_restored"] = True
+                break
+            time.sleep(0.02)
+        out["low_degraded_width"] = min_w
+    finally:
+        ctrl.stop()
+        kubelet.stop()
+    out["counters"] = delta(counter_totals(), before)
+    return out
+
+
+def elastic_main(args) -> int:
+    result = run_elastic(kills=args.kills, seed=args.seed,
+                         checkpoint_every=args.checkpoint_every,
+                         deadline_s=args.deadline or 240.0)
+    rate = (min(result["degraded_steps_per_sec"])
+            if result["degraded_steps_per_sec"] else 0.0)
+    print(json.dumps({
+        "metric": "elastic_degraded_steps_per_sec",
+        "value": rate,
+        "unit": "steps/s",
+        "details": result,
+    }))
+    rc = 0
+    if result["failed"]:
+        print(f"elastic bench: jobs did not reach Succeeded: "
+              f"{result['failed']}", file=sys.stderr)
+        rc = 1
+    if result["kills_executed"] < 1:
+        print("elastic bench: no kill was executed (job finished before "
+              "the trigger — widen steps/step-sleep)", file=sys.stderr)
+        rc = 1
+    if result["degraded_rate"] < 1.0 and result["kills_executed"]:
+        print(f"elastic bench regression: degraded-width training rate "
+              f"{result['degraded_rate']} < 1.0 (the gang stopped instead "
+              f"of training through the kill)", file=sys.stderr)
+        rc = 1
+    if rate <= 0.0 and result["kills_executed"]:
+        print("elastic bench regression: steps/sec during the degraded "
+              "window was not > 0", file=sys.stderr)
+        rc = 1
+    if result["restored_rate"] < 1.0 and result["kills_executed"]:
+        print(f"elastic bench regression: re-expand rate "
+              f"{result['restored_rate']} < 1.0 (no return to full "
+              f"width)", file=sys.stderr)
+        rc = 1
+    bad = [r for r in result["records"]
+           if r["degraded_resumed_from"] < 0
+           or r["step_at_kill"] - r["degraded_resumed_from"]
+           > result["checkpoint_every"]]
+    if bad:
+        print(f"elastic bench regression: lost steps exceed the "
+              f"checkpoint interval ({result['checkpoint_every']}): {bad}",
+              file=sys.stderr)
+        rc = 1
+    h = result["harvest"]
+    if not h["high_admitted"]:
+        print(f"elastic bench regression: high-priority gang was not "
+              f"admitted under contention: {h}", file=sys.stderr)
+        rc = 1
+    if h["counters"].get("preemptions"):
+        print(f"elastic bench regression: whole-gang preemption of an "
+              f"elastic victim ({h['counters']['preemptions']}) — width "
+              f"harvesting should have covered it", file=sys.stderr)
+        rc = 1
+    if not h["counters"].get("harvested_slices"):
+        print("elastic bench regression: no slices were harvested",
+              file=sys.stderr)
+        rc = 1
+    if h["low_failed_phase"] or not h["low_restored"]:
+        print(f"elastic bench regression: harvested victim did not "
+              f"survive + re-expand: {h}", file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def _ttfs_phases(trace_dir: str) -> dict:
     """Per-phase breakdown of one TTFS run from the workers' span dumps:
     worst-across-workers duration per pipeline phase (the job's TTFS is
@@ -2139,6 +2535,16 @@ def main(argv=None) -> int:
                         "steps; gates recovered-Succeeded, lost steps vs "
                         "the checkpoint interval, and the restart_policy "
                         "Never terminal-Failed probe")
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic bench (recovery+capacity planes): kill 1 "
+                        "of N workers of a real elastic training gang and "
+                        "gate steps/sec > 0 through the degraded window, "
+                        "re-expand without restore-from-scratch, lost "
+                        "steps <= the checkpoint interval; plus the "
+                        "scheduler harvest probe (blocked high-priority "
+                        "gang admitted by harvesting width, zero "
+                        "whole-gang preemptions of elastic victims) — "
+                        "ELASTIC_r01.json / make elastic-smoke")
     p.add_argument("--kills", type=int, default=2, metavar="K",
                    help="chaos mode: pods to kill (spread over the jobs)")
     p.add_argument("--seed", type=int, default=7, metavar="S",
@@ -2246,6 +2652,8 @@ def main(argv=None) -> int:
         return scale_main(args)
     if args.replicas:
         return widejob_main(args)
+    if args.elastic:
+        return elastic_main(args)
     if args.chaos:
         return chaos_main(args)
     if args.churn:

@@ -638,6 +638,40 @@ class MetricRules(Rule):
                 f"(catalogue drift)")
 
 
+class GangWidthEnvRule(Rule):
+    name = "gang-width-env"
+    doc = ("workload code derives gang width from $KCTPU_GANG_WIDTH / "
+           "JobRuntime.gang_width, never from spec.replicas: an elastic "
+           "gang's runtime width differs from its spec width per "
+           "generation (degrade/harvest/re-expand), so a spec-derived "
+           "shard layout silently mis-shards the degraded gang")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        # Scoped to the workload layer: the control plane (planner,
+        # updater, scheduler) legitimately reads spec.replicas — it is
+        # the one that TURNS spec width into runtime width.
+        if "workloads/" not in ctx.path.replace(os.sep, "/"):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Attribute) or node.attr != "replicas":
+                continue
+            chain = _chain_attrs(node)
+            root = (_root_name(node) or "").lower()
+            spec_ish = ("spec" in chain[:-1]
+                        or "tf_replica_specs" in chain[:-1]
+                        or "spec" in root or root == "job")
+            if not spec_ish:
+                continue
+            if ctx.suppressed(self.name, node.lineno):
+                continue
+            yield Finding(
+                ctx.path, node.lineno, node.col_offset, self.name,
+                "workload reads gang width from spec.replicas: use "
+                "$KCTPU_GANG_WIDTH / JobRuntime.gang_width — the runtime "
+                "width is a per-generation property (elastic re-shard) "
+                "and the spec width is wrong while degraded")
+
+
 _CAMEL_RE = re.compile(r"^[A-Z][A-Za-z0-9]*$")
 
 
@@ -702,6 +736,7 @@ def all_rules() -> List[Rule]:
         ThreadHygieneRule(),
         RawLockRule(),
         FencingTokenRule(),
+        GangWidthEnvRule(),
         MetricRules(),
         EventReasonRule(),
         LockGraphRule(),

@@ -38,6 +38,19 @@ ANNOTATION_PRIORITY_CLASS = f"{DOMAIN}/priority-class"
 # namespace — generation-keyed readiness drops and coordinator ports can
 # never collide with the dead generation's leftovers.
 ANNOTATION_GANG_GENERATION = f"{DOMAIN}/gang-generation"
+# --- elastic plane (net-new) ---
+# Current runtime width of the job's elastic gang, written on the TFJob by
+# the controller alongside every generation bump (absent/invalid = the
+# spec width).  Width is a *runtime* property: the planner plans this many
+# members, the materializer stamps it into $KCTPU_GANG_WIDTH, and the
+# workloads shard data by it — never by spec.replicas.
+ANNOTATION_GANG_WIDTH = f"{DOMAIN}/gang-width"
+# Elastic floor, stamped per pod so the SCHEDULER can see how far a
+# running gang may be harvested without controller round-trips:
+# min-width in member pods, min-slices in bound slices (TPU gangs;
+# harvesting is slice-granular).
+ANNOTATION_ELASTIC_MIN_WIDTH = f"{DOMAIN}/elastic-min-width"
+ANNOTATION_ELASTIC_MIN_SLICES = f"{DOMAIN}/elastic-min-slices"
 
 
 def selector_for(job_name: str, replica_type: str, runtime_id: str) -> dict:

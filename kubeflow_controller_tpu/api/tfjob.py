@@ -57,12 +57,16 @@ class TFJobPhase(str, enum.Enum):
 
 
 class TFJobConditionType(str, enum.Enum):
-    """ref: types.go:154-161 — declared upstream, populated by our updater."""
+    """ref: types.go:154-161 — declared upstream, populated by our updater.
+
+    ``DEGRADED`` is net-new (elastic plane): True with reason
+    ``WidthReduced`` while an elastic gang trains below its spec width."""
 
     SCHEDULED = "Scheduled"
     READY = "Ready"
     RECOVERING = "Recovering"
     RECYCLING = "Recycling"
+    DEGRADED = "Degraded"
 
 
 class TFReplicaState(str, enum.Enum):
@@ -174,6 +178,32 @@ def validate_tpu_spec(spec: TPUSpec) -> None:
 
 
 @dataclass
+class ElasticSpec:
+    """Net-new (elastic plane): width as a *runtime* property of a gang.
+
+    A gang that loses a member normally stalls whole behind the failed
+    index's backoff + re-rendezvous (recovery plane).  With an elastic
+    range the controller instead drives a **re-shard transition**: bump
+    the gang generation, rejoin the survivors at the reduced width from
+    the latest checkpoint ($KCTPU_GANG_WIDTH carries the width per
+    generation; data shards rebalance because workloads derive sharding
+    from the runtime width, never from spec.replicas), and re-expand to
+    full width once the replacement has warmed — the Podracer/Sebulba
+    "never block the learner on a lost peer" shape (PAPERS.md).  The
+    scheduler may likewise *harvest* width down to ``min_width`` instead
+    of preempting the gang whole.
+    """
+
+    # Smallest width the gang may be re-sharded down to (crash or
+    # harvest); must be >= 1 and <= the spec width.  A transition that
+    # would cross the floor falls back to whole-gang recovery.
+    min_width: int = 1
+    # Largest width re-expansion targets; 0 = the spec width.  (Growth
+    # beyond spec width is reserved; validation caps at spec width.)
+    max_width: int = 0
+
+
+@dataclass
 class TFReplicaSpec:
     """ref: types.go:58-79."""
 
@@ -224,6 +254,10 @@ class TFJobSpec:
     # BackoffLimitExceeded (the k8s Job field; -1 = unlimited).  The streak
     # resets after RestartPolicyConfig.reset_after_s of healthy Running.
     backoff_limit: int = 6
+    # Net-new (elastic plane): opt-in runtime width range for the job's
+    # gang replica set (None = width is fixed at spec.replicas, every
+    # member loss is whole-gang recovery).
+    elastic: Optional[ElasticSpec] = None
     tf_replica_specs: List[TFReplicaSpec] = field(default_factory=list)
 
 
@@ -301,14 +335,27 @@ class JobProgress:
 
 
 @dataclass
+class JobWidth:
+    """Elastic-plane width rollup: where the gang is vs where it should be
+    (current = the controller's runtime width target, spec = full width,
+    min = the elastic floor).  None on non-elastic jobs."""
+
+    current: int = 0
+    spec: int = 0
+    min: int = 0
+
+
+@dataclass
 class TFJobStatus:
-    """ref: types.go:92-101 (+ net-new training-plane ``progress``)."""
+    """ref: types.go:92-101 (+ net-new training-plane ``progress`` and
+    elastic-plane ``width``)."""
 
     phase: TFJobPhase = TFJobPhase.NONE
     reason: str = ""
     conditions: List[TFJobCondition] = field(default_factory=list)
     tf_replica_statuses: List[TFReplicaStatus] = field(default_factory=list)
     progress: Optional[JobProgress] = None
+    width: Optional[JobWidth] = None
 
 
 @dataclass
@@ -402,6 +449,32 @@ def validate_tfjob(job: TFJob) -> None:
                     raise ValidationError("TPU replicas must not request nvidia.com/gpu")
     if any(t == ReplicaType.LOCAL for t in types_seen) and len(types_seen) > 1:
         raise ValidationError("Local replica type cannot be mixed with others")
+    if job.spec.elastic is not None:
+        el = job.spec.elastic
+        gang_specs = [s for s in specs
+                      if s.tf_replica_type == ReplicaType.TPU or s.gang_restart]
+        if len(gang_specs) != 1:
+            raise ValidationError(
+                "spec.elastic requires exactly one gang replica set "
+                "(a TPU slice or a gangRestart Worker set)")
+        g = gang_specs[0]
+        full = (tpu_total_hosts(g.tpu)
+                if g.tf_replica_type == ReplicaType.TPU and g.tpu is not None
+                else g.replicas)
+        if not 1 <= el.min_width <= full:
+            raise ValidationError(
+                f"elastic.minWidth {el.min_width} out of range 1..{full}")
+        if el.max_width != 0 and not el.min_width <= el.max_width <= full:
+            raise ValidationError(
+                f"elastic.maxWidth {el.max_width} out of range "
+                f"{el.min_width}..{full} (0 = spec width)")
+        if g.tf_replica_type == ReplicaType.TPU and g.tpu is not None:
+            per = tpu_slice_hosts(g.tpu)
+            if el.min_width % per != 0:
+                raise ValidationError(
+                    f"elastic.minWidth {el.min_width} must be a multiple of "
+                    f"the slice host count ({per}): TPU width changes are "
+                    f"slice-granular")
     # Chief termination policy must name an existing replica type/index.
     for s in specs:
         tp = s.termination_policy
@@ -430,6 +503,17 @@ def is_local_job(job: TFJob) -> bool:
 def is_tpu_job(job: TFJob) -> bool:
     """Net-new classifier: any replica spec of type TPU."""
     return any(s.tf_replica_type == ReplicaType.TPU for s in job.spec.tf_replica_specs)
+
+
+def elastic_gang_spec(job: TFJob) -> Optional[TFReplicaSpec]:
+    """The replica set an elastic range applies to: the job's single gang
+    spec (validated) when ``spec.elastic`` is set, else None."""
+    if job.spec.elastic is None:
+        return None
+    for s in job.spec.tf_replica_specs:
+        if s.tf_replica_type == ReplicaType.TPU or s.gang_restart:
+            return s
+    return None
 
 
 def replica_spec_for(job: TFJob, typ: ReplicaType) -> Optional[TFReplicaSpec]:

@@ -25,8 +25,7 @@ from ..api.core import (
 )
 from ..api.tfjob import ReplicaType, TFJob
 from ..utils import locks
-from ..planner.materialize import pod_index, pods_by_index
-from ..planner.plan import desired_replicas
+from ..planner.materialize import gang_width, pod_index, pods_by_index
 
 
 class Health(str, enum.Enum):
@@ -137,7 +136,12 @@ class StallTracker:
         # phase="restore" gets the same hold: a replica restoring a
         # checkpoint after an in-place restart beats with a frozen (or
         # backward-jumped) step counter while Orbax reads the tree.
-        held_phase = getattr(progress, "phase", "") in ("compile", "restore")
+        # phase="reshard" (elastic plane) too: a width transition pauses
+        # the step counter while survivors restore the checkpoint at the
+        # new width and rebalance their data shards — long enough, it
+        # would otherwise edge-trigger a spurious TrainingStalled.
+        held_phase = getattr(progress, "phase", "") in (
+            "compile", "restore", "reshard")
         with self._lock:
             last_step, advanced_at, _, restoring = self._steps.get(
                 key, (None, 0.0, 0.0, False))
@@ -189,7 +193,10 @@ def check_health(job: TFJob, pods_by_type: Dict[ReplicaType, List[Pod]],
     exhausted = exhausted or {}
     for spec in job.spec.tf_replica_specs:
         typ = spec.tf_replica_type
-        desired = desired_replicas(spec)
+        # Elastic gangs are measured against their CURRENT width: a
+        # degraded gang whose every current member runs is Healthy here
+        # (the reduced width is the job-level Degraded condition's story).
+        desired = gang_width(job, spec)
         pods = pods_by_type.get(typ, [])
         rh = ReplicaHealth(type=typ, desired=desired)
         by_idx = pods_by_index(pods)

@@ -267,6 +267,10 @@ def cmd_get(args) -> int:
         kinds = ",".join(
             f"{s.tf_replica_type.value}x{s.replicas}" for s in j.spec.tf_replica_specs
         )
+        # Elastic width, when it differs from spec: "Workerx3[w=2]".
+        w = j.status.width
+        if w is not None and w.current < w.spec:
+            kinds += f"[w={w.current}]"
         # kubectl parity: deletionTimestamp set -> Terminating (a job stays
         # in this state until a running controller processes its finalizer).
         phase = ("Terminating" if j.metadata.deletion_timestamp is not None
@@ -316,6 +320,10 @@ def cmd_describe(args) -> int:
                   f"(consistent hash of uid {j.metadata.uid})")
     print(f"Phase:     {j.status.phase.value}"
           + (f"  ({j.status.reason})" if j.status.reason else ""))
+    if j.status.width is not None:
+        w = j.status.width
+        tag = "  DEGRADED (replacement warming)" if w.current < w.spec else ""
+        print(f"Width:     {w.current}/{w.spec} (elastic floor {w.min}){tag}")
     if j.status.reason.startswith("GangQueued"):
         print(f"Queue:     {j.status.reason}")
     for c in j.status.conditions:

@@ -49,6 +49,10 @@ class PhasePolicy:
 
     pending_s: float = 0.0
     run_s: float = 0.02
+    # Per-job run_s overrides (keyed by the tf_job_name label): lets one
+    # bench run short foreground jobs against a long-running victim
+    # (e.g. the elastic harvest probe) under one kubelet.
+    run_s_by_job: Dict[str, float] = field(default_factory=dict)
     # Replica types that never reach a terminal phase on their own.
     run_forever_types: tuple = ("PS",)
     # Pod names to fail once (fault injection for recovery tests).
@@ -68,6 +72,10 @@ class PhasePolicy:
     # simulated pods predate the progress plane and most tests don't
     # want the extra status churn).
     heartbeat_s: float = 0.0
+
+    def run_s_for(self, pod: Pod) -> float:
+        return self.run_s_by_job.get(
+            pod.metadata.labels.get("tf_job_name", ""), self.run_s)
 
     def outcome(self, pod: Pod) -> Optional[str]:
         if pod.metadata.name in self.fail_once:
@@ -196,8 +204,7 @@ class FakeKubelet:
         if self._watcher:
             self._watcher.stop()
         for proc in list(self._procs.values()):
-            if proc.poll() is None:
-                proc.terminate()
+            self._terminate_proc(proc)
         if self._pool is not None:
             self._pool.stop()
         shutil.rmtree(self._log_dir, ignore_errors=True)
@@ -372,8 +379,8 @@ class FakeKubelet:
             elif ev.type == DELETED:
                 key = self._key(ev.object)
                 proc = self._procs.get(key)
-                if proc is not None and proc.poll() is None:
-                    proc.terminate()
+                if proc is not None:
+                    self._terminate_proc(proc)
                 warm = self._warm.get(key)
                 if warm is not None and self._pool is not None:
                     self._pool.kill(warm)
@@ -383,6 +390,30 @@ class FakeKubelet:
     @staticmethod
     def _key(pod: Pod) -> str:
         return f"{pod.metadata.namespace}/{pod.metadata.name}"
+
+    @staticmethod
+    def _terminate_proc(proc, grace_s: float = 0.5) -> None:
+        """Terminate a cold-started pod process with SIGKILL escalation:
+        a multi-process jax.distributed worker ignores SIGTERM (XLA's
+        coordination runtime installs its own handlers), and a HEALTHY
+        gang torn down by an elastic re-shard would otherwise keep
+        training as an orphan — writing checkpoints over the replacement
+        generation's (the warm path escalates inside the zygote)."""
+        if proc.poll() is not None:
+            return
+        proc.terminate()
+
+        def _escalate(p=proc):
+            if p.poll() is None:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+
+        t = threading.Timer(grace_s, _escalate)
+        t.name = "kubelet-kill-escalate"
+        t.daemon = True
+        t.start()
 
     def _spawn(self, pod: Pod) -> None:
         key = self._key(pod)
@@ -523,8 +554,8 @@ class FakeKubelet:
                 continue
             self._injected_failures.add(key)
             proc = self._procs.get(key)
-            if proc is not None and proc.poll() is None:
-                proc.terminate()
+            if proc is not None:
+                self._terminate_proc(proc)
             warm = self._warm.get(key)
             if warm is not None and self._pool is not None:
                 self._pool.kill(warm)
@@ -603,8 +634,8 @@ class FakeKubelet:
                 continue
             self._injected_failures.add(key)
             proc = self._procs.get(key)
-            if proc is not None and proc.poll() is None:
-                proc.terminate()
+            if proc is not None:
+                self._terminate_proc(proc)
             warm = self._warm.get(key)
             if warm is not None and self._pool is not None:
                 self._pool.kill(warm)
@@ -625,12 +656,13 @@ class FakeKubelet:
         outcome = self.policy.outcome(pod)
         if outcome is None:
             return  # runs forever (PS)
+        run_s = self.policy.run_s_for(pod)
         hb = self.policy.heartbeat_s
         if hb > 0:
             # "Training": publish an advancing step every heartbeat tick
             # for the whole simulated run (suspend_heartbeats silences the
             # publishing, not the clock — a stall, not a pause).
-            deadline = time.monotonic() + self.policy.run_s
+            deadline = time.monotonic() + run_s
             step = 0
             while not self._stop.is_set():
                 remaining = deadline - time.monotonic()
@@ -643,7 +675,7 @@ class FakeKubelet:
                 if not self._hb_suspended:
                     self._publish_sim_beat(ns, name, step, hb)
         else:
-            time.sleep(self.policy.run_s)
+            time.sleep(run_s)
         if self._key(pod) in self._injected_failures:
             self._injected_failures.discard(self._key(pod))
             return  # fail_slice already marked the pod Failed
@@ -674,12 +706,20 @@ class FakeKubelet:
         every pod of a gang rendezvouses at the same 127.0.0.1 address —
         the same indirection kube-dns provides, collapsed to one machine.
 
-        The mapping is keyed by (hostname, gang generation): a replacement
-        gang (recovery plane) gets a FRESH port, so its coordinator can
-        never race the dead generation's not-yet-released socket — the
-        fake-DNS analog of the generation-keyed readiness drops.
+        The mapping is keyed by (hostname, gang generation, gang width): a
+        replacement gang (recovery plane) gets a FRESH port, so its
+        coordinator can never race the dead generation's not-yet-released
+        socket — the fake-DNS analog of the generation-keyed readiness
+        drops.  Width rides the key too (elastic plane): every re-shard
+        bumps the generation anyway, but a width mismatch must never
+        rendezvous against another width's coordinator even if a
+        generation is somehow reused.
         """
-        from ..planner.materialize import ENV_COORDINATOR, ENV_GANG_GENERATION
+        from ..planner.materialize import (
+            ENV_COORDINATOR,
+            ENV_GANG_GENERATION,
+            ENV_GANG_WIDTH,
+        )
 
         addr = env.get(ENV_COORDINATOR, "")
         if not addr or ":" not in addr:
@@ -692,7 +732,8 @@ class FakeKubelet:
             return  # already an IP literal
         except OSError:
             pass
-        dns_key = f"{host}#g{env.get(ENV_GANG_GENERATION, '0') or '0'}"
+        dns_key = (f"{host}#g{env.get(ENV_GANG_GENERATION, '0') or '0'}"
+                   f"w{env.get(ENV_GANG_WIDTH, '') or '-'}")
         with self._svc_lock:
             port = self._svc_ports.get(dns_key)
         if port is None:

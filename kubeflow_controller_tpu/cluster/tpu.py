@@ -110,7 +110,18 @@ class TPUInventory:
             gang = self._gangs.setdefault(
                 gang_name, _Gang(gang_name, size, accel, num_slices=n_slices))
             gang.pods[f"{pod.metadata.namespace}/{pod.metadata.name}"] = pod
+            gang.size = size  # annotation is authoritative across widths
             if gang.slice_names:
+                if n_slices > len(gang.slice_names):
+                    # Elastic re-expansion: the new generation spans more
+                    # slices than the (harvested/degraded) binding — grow
+                    # it in place, all-or-nothing, before anyone starts.
+                    extra = self._find_free_slices(
+                        accel, n_slices - len(gang.slice_names))
+                    if extra is None:
+                        return False  # capacity not back yet: hold
+                    self._bind_locked(gang, extra)
+                    gang.num_slices = len(gang.slice_names)
                 return True  # already admitted; late pod joins
             if len(gang.pods) < gang.size:
                 return False  # gang incomplete: hold everything
@@ -125,7 +136,9 @@ class TPUInventory:
         for sl in found:
             sl.bound_gang = gang.name
             sl.bound_at = now
-        gang.slice_names = [sl.name for sl in found]
+        # Append (fresh binds start from an empty list): elastic
+        # re-expansion grows an admitted gang's binding in place.
+        gang.slice_names = gang.slice_names + [sl.name for sl in found]
         self._version += 1
 
     def _unbind_locked(self, sl: TPUSlice) -> None:
@@ -157,6 +170,56 @@ class TPUInventory:
                 gang.pods.update(pods)
             self._bind_locked(gang, found)
             return list(gang.slice_names)
+
+    def note_gang_pod(self, gang_name: str, pod: Pod) -> None:
+        """Record a member pod on an already-bound gang.  The scheduler
+        front-end admits pods without calling :meth:`offer`, and an
+        elastic re-shard replaces EVERY pod of an admitted gang without
+        rebinding — without this, the node-side idle reaper only sees
+        the dead generation's keys and frees the slices out from under
+        the running gang."""
+        with self._lock:
+            g = self._gangs.get(gang_name)
+            if g is not None:
+                g.pods[f"{pod.metadata.namespace}/{pod.metadata.name}"] = pod
+
+    def release_slices(self, gang_name: str, n_release: int) -> List[str]:
+        """Partial release (elastic width harvesting): unbind the gang's
+        LAST ``n_release`` bound slices and return their names.  Bind
+        order is slice-index order, so the coordinator's slice (index 0)
+        is always kept — at least one slice survives."""
+        with self._lock:
+            g = self._gangs.get(gang_name)
+            if g is None or n_release <= 0:
+                return []
+            n_release = min(n_release, max(0, len(g.slice_names) - 1))
+            if n_release <= 0:
+                return []
+            keep = len(g.slice_names) - n_release
+            released = g.slice_names[keep:]
+            g.slice_names = g.slice_names[:keep]
+            g.num_slices = keep
+            for name in released:
+                sl = self.slices.get(name)
+                if sl is not None:
+                    self._unbind_locked(sl)
+            return released
+
+    def grow_gang(self, gang_name: str, accelerator_type: str,
+                  n_extra: int) -> Optional[List[str]]:
+        """Bind ``n_extra`` more free slices to an admitted gang
+        (elastic re-expansion), all-or-nothing; returns the new slice
+        names or None when capacity is short."""
+        with self._lock:
+            g = self._gangs.get(gang_name)
+            if g is None or n_extra <= 0:
+                return None
+            found = self._find_free_slices(accelerator_type, n_extra)
+            if found is None:
+                return None
+            self._bind_locked(g, found)
+            g.num_slices = len(g.slice_names)
+            return [sl.name for sl in found]
 
     def has_free_slice(self, accelerator_type: str = "") -> bool:
         with self._lock:

@@ -112,16 +112,27 @@ def _child(req: dict) -> None:
 _REPLY_FD = [None]
 
 
-def _kill_group(pid: int) -> None:
-    """SIGTERM a child's process group, falling back to the pid itself if
+def _kill_group(pid: int, sig: int = signal.SIGTERM) -> None:
+    """Signal a child's process group, falling back to the pid itself if
     the group does not exist yet (fork->setsid race on immediate deletes)."""
     try:
-        os.killpg(pid, signal.SIGTERM)
+        os.killpg(pid, sig)
     except ProcessLookupError:
         try:
-            os.kill(pid, signal.SIGTERM)
+            os.kill(pid, sig)
         except ProcessLookupError:
             pass
+
+
+# SIGTERM -> SIGKILL escalation grace.  A multi-process jax.distributed
+# worker IGNORES SIGTERM (XLA's coordination runtime installs its own
+# handlers), so a HEALTHY gang torn down by the controller — the elastic
+# plane's re-shard transitions do exactly this — would otherwise survive
+# as an orphan, keep training, and keep writing checkpoints over the
+# replacement generation's.  Short on purpose: these pods have no
+# graceful-termination contract, and a torn mid-save checkpoint is
+# already handled by the restore fallback.
+KILL_ESCALATE_S = 0.5
 
 
 def main() -> int:
@@ -139,6 +150,7 @@ def main() -> int:
 
     out.write(json.dumps({"event": "ready"}) + "\n")
     pids: Dict[int, int] = {}  # id -> pid
+    pending_kills: Dict[int, float] = {}  # pid -> SIGKILL deadline
     buf = b""
     stdin_fd = sys.stdin.fileno()
     while True:
@@ -157,6 +169,7 @@ def main() -> int:
                     pid = pids.get(req["kill"])
                     if pid:
                         _kill_group(pid)
+                        pending_kills[pid] = time.time() + KILL_ESCALATE_S
                     continue
                 pid = os.fork()
                 if pid == 0:
@@ -169,18 +182,31 @@ def main() -> int:
             done, status = os.waitpid(pid, os.WNOHANG)
             if done:
                 del pids[rid]
+                pending_kills.pop(pid, None)
                 out.write(json.dumps({
                     "id": rid, "event": "exit",
                     "code": os.waitstatus_to_exitcode(status),
                 }) + "\n")
+        # Escalate kills that SIGTERM did not take (see KILL_ESCALATE_S).
+        now = time.time()
+        for pid, deadline in list(pending_kills.items()):
+            if pid not in pids.values():
+                pending_kills.pop(pid, None)
+            elif now >= deadline:
+                _kill_group(pid, signal.SIGKILL)
+                pending_kills.pop(pid, None)
     for pid in pids.values():
         _kill_group(pid)
     deadline = time.time() + 3
-    for pid in list(pids.values()):
+    for rid, pid in list(pids.items()):
         while time.time() < deadline:
             if os.waitpid(pid, os.WNOHANG)[0]:
+                pids.pop(rid, None)
                 break
             time.sleep(0.02)
+    for pid in pids.values():
+        # SIGTERM-immune leftovers (multi-process jax gangs): no orphans.
+        _kill_group(pid, signal.SIGKILL)
     return 0
 
 

@@ -66,12 +66,15 @@ from ..recovery.policy import (
     RestartPolicyConfig,
     RestartTracker,
 )
+from ..elastic import ElasticEngine, ElasticPolicy
 from .events import (
     EventRecorder,
     REASON_BACKOFF_LIMIT_EXCEEDED,
     REASON_GANG_ADMITTED,
+    REASON_GANG_DEGRADED,
     REASON_GANG_PREEMPTED,
     REASON_GANG_QUEUED,
+    REASON_GANG_RESTORED,
     REASON_REPLICA_RESTARTED,
     REASON_TRAINING_RESUMED,
     REASON_TRAINING_STALLED,
@@ -104,6 +107,7 @@ class Controller:
         manage_workers: int = 8,
         restart_config: Optional[RestartPolicyConfig] = None,
         controller_shards: int = 1,
+        elastic_policy: Optional[ElasticPolicy] = None,
     ):
         self.cluster = cluster
         self.inventory = inventory
@@ -140,6 +144,14 @@ class Controller:
         # ReplicaRestarted/BackoffLimitExceeded events, and the
         # kctpu_replica_restarts_total / restart-latency metrics.
         self.restart_tracker = RestartTracker(restart_config)
+        # Elastic plane: the width transition engine (elastic/engine.py).
+        # For jobs with spec.elastic, member loss becomes a re-shard to
+        # reduced width (training continues from the latest checkpoint
+        # while the replacement warms) and a later re-expand back to full
+        # width — instead of the whole gang stalling behind one index's
+        # backoff.  The scheduler's width harvesting funnels through the
+        # same transition (WidthHarvested pod reasons).
+        self.elastic_engine = ElasticEngine(elastic_policy)
         # Per-job stalled-replica set from the LAST sync, for edge-triggered
         # TrainingStalled/TrainingResumed events (the condition itself is
         # level-triggered in status).
@@ -370,6 +382,7 @@ class Controller:
         key = key_of(job.metadata)
         self.expectations.delete_expectations(key)
         self.restart_tracker.forget_job(key)
+        self.elastic_engine.forget_job(key, job)
         self._drop_progress_series(key, job)
         if self.inventory is not None and is_tpu_job(job):
             self.inventory.release_gang(gang_name(job))
@@ -667,6 +680,7 @@ class Controller:
                 pass
         self.expectations.delete_expectations(key)
         self.restart_tracker.forget_job(key)
+        self.elastic_engine.forget_job(key, job)
 
     def _gather(self, job: TFJob):
         """Claim pods/services once at job scope, then partition by replica
@@ -725,9 +739,73 @@ class Controller:
             # backoff window would only be noticed by a resync.
             self.queue.add_after(key, recovery.requeue_after_s + 0.02)
         if needs_sync:
-            job = self._maybe_bump_gang_generation(key, job, pods_by_type,
-                                                   recovery)
+            # Elastic plane first: an applied width transition IS this
+            # gang's generation bump (degrade/harvest/re-expand); only
+            # non-elastic paths fall through to the whole-gang bump.
+            job, applied = self._assess_elastic(key, job, pods_by_type,
+                                                recovery)
+            if not applied:
+                job = self._maybe_bump_gang_generation(key, job,
+                                                       pods_by_type,
+                                                       recovery)
         return job, recovery
+
+    def _assess_elastic(self, key: str, job: TFJob, pods_by_type,
+                        recovery):
+        """Consult the width transition engine; apply a proposed
+        transition as ONE metadata patch — gang-generation + 1 and the
+        gang-width annotation — so this very sync's plan replaces the
+        stale generation at the new width.  Emits the edge-triggered
+        ``Warning GangDegraded`` / ``Normal GangRestored`` events (each
+        transition is an edge by construction: the bump retires the
+        failed generation the engine keyed on).  Returns (possibly
+        patched job, transition-applied?)."""
+        from ..api.labels import (
+            ANNOTATION_GANG_GENERATION,
+            ANNOTATION_GANG_WIDTH,
+        )
+        from ..elastic import KIND_EXPAND
+
+        a = self.elastic_engine.assess(
+            key, job, pods_by_type, recovery, time.time(),
+            inventory=self.inventory)
+        if a is None:
+            return job, False
+        if a.requeue_after_s > 0:
+            # Warm-up expiry and freed capacity emit no watch events on
+            # the job; the engine names when it next needs to look.
+            self.queue.add_after(key, a.requeue_after_s + 0.02)
+        tr = a.transition
+        if tr is None:
+            return job, False
+        ns, name = job.metadata.namespace, job.metadata.name
+        cur = int(job.metadata.annotations.get(ANNOTATION_GANG_GENERATION,
+                                               "0") or "0")
+
+        def apply(m):
+            m.annotations[ANNOTATION_GANG_GENERATION] = str(cur + 1)
+            m.annotations[ANNOTATION_GANG_WIDTH] = str(tr.to_width)
+
+        try:
+            job = self.cluster.tfjobs.patch_meta(ns, name, apply)
+        except NotFound:
+            return job, False
+        if tr.kind == KIND_EXPAND:
+            if tr.complete:
+                self.recorder.event(
+                    job, TYPE_NORMAL, REASON_GANG_RESTORED,
+                    f"gang re-expanded to full width {tr.to_width} "
+                    f"(from {tr.from_width}); resuming from the degraded "
+                    f"run's checkpoint")
+        else:
+            why = f" ({tr.reason})" if tr.reason else ""
+            self.recorder.event(
+                job, TYPE_WARNING, REASON_GANG_DEGRADED,
+                f"gang width {tr.from_width} -> {tr.to_width} "
+                f"[{tr.kind}]{why}; survivors re-shard from the latest "
+                f"checkpoint and keep training while the replacement "
+                f"warms")
+        return job, True
 
     def _maybe_bump_gang_generation(self, key: str, job: TFJob,
                                     pods_by_type, recovery) -> TFJob:

@@ -35,6 +35,11 @@ REASON_GANG_PREEMPTED = "GangPreempted"
 # Recovery-plane reasons (net-new: the restart policy engine).
 REASON_REPLICA_RESTARTED = "ReplicaRestarted"
 REASON_BACKOFF_LIMIT_EXCEEDED = "BackoffLimitExceeded"
+# Elastic-plane reasons (net-new: the width transition engine) — edge-
+# triggered: one GangDegraded per shrink transition, one GangRestored
+# when the gang returns to full width.
+REASON_GANG_DEGRADED = "GangDegraded"
+REASON_GANG_RESTORED = "GangRestored"
 
 TYPE_NORMAL = "Normal"
 TYPE_WARNING = "Warning"

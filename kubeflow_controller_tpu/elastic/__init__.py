@@ -1,0 +1,25 @@
+"""Elastic gangs: width as a runtime property of a training gang.
+
+The recovery plane (recovery/) made a gang member's death survivable —
+but every width change is still teardown + full-gang restore, so one dead
+worker stalls the whole gang behind backoff + re-rendezvous.  This
+package makes width *elastic* (ROADMAP "elastic capacity"; Podracer's
+Sebulba decoupling is the shape — PAPERS.md): a gang that loses a member
+keeps training at reduced width from its latest checkpoint while the
+replacement warms, re-expands to full width when it is ready, and can
+have width *harvested* by the scheduler instead of being preempted whole.
+
+See :mod:`engine` for the transition state machine; docs/RECOVERY.md
+("Elastic width") for the protocol.
+"""
+
+from .engine import (  # noqa: F401
+    ElasticAssessment,
+    ElasticEngine,
+    ElasticPolicy,
+    ElasticTransition,
+    KIND_DEGRADE,
+    KIND_EXPAND,
+    KIND_HARVEST,
+    REASON_HARVESTED_PREFIX,
+)

@@ -1,0 +1,327 @@
+"""The re-shard transition engine: degraded-width training + re-expand.
+
+One :class:`ElasticEngine` lives in the controller and is consulted once
+per sync, after the restart policy engine (recovery/policy.py) has
+assessed the pod view.  It drives a three-state machine per elastic job:
+
+- **steady** — width == target: nothing to do;
+- **degrade / harvest** — a gang member died (crash, slice loss, chaos)
+  or the scheduler harvested capacity (``WidthHarvested`` pod reason):
+  instead of stalling the whole gang behind the failed index's backoff
+  (the recovery plane's whole-gang replacement), the engine proposes a
+  width transition to ``current - failed`` (floored at
+  ``spec.elastic.min_width``).  The controller applies it as ONE
+  ``patch_meta``: gang-generation + 1 and the gang-width annotation —
+  the planner then replaces the stale generation at the new width, the
+  survivors re-rendezvous from the latest checkpoint with data shards
+  rebalanced (``$KCTPU_GANG_WIDTH`` is per generation), and training
+  continues while the replacement backs off and warms;
+- **expand** — the degraded gang is fully Running at the current
+  generation, the replacement's warm-up window (``warmup_s``, and any
+  remaining backoff of the failed indices, captured at degrade time) has
+  elapsed, and — for TPU gangs — free slices exist: the engine proposes
+  the second generation bump back toward full width, resuming from the
+  degraded run's checkpoint, never a restore-from-scratch.  Harvested
+  TPU width grows back slice-granularly as contention clears.
+
+A shrink that would cross the elastic floor proposes nothing — the
+recovery plane's whole-gang path (backoff, restart budget, terminal
+``BackoffLimitExceeded``) remains the authority there, and an exhausted
+restart budget always wins over a transition.
+
+Observability: ``kctpu_gang_width`` (per-job gauge, series removed with
+the job) and ``kctpu_elastic_transitions_total{kind}`` (``degrade`` /
+``harvest`` / ``expand``; the scheduler's harvest pass shares the same
+family) — catalogued in docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..api.core import PHASE_FAILED, PHASE_RUNNING, PHASE_SUCCEEDED, is_pod_active
+from ..api.tfjob import ReplicaType, TFJob, TFJobPhase, elastic_gang_spec, tpu_slice_hosts
+from ..obs.metrics import REGISTRY
+from ..planner.materialize import gang_generation, gang_width, pods_by_index, spec_width
+from ..planner.plan import _pod_generation
+from ..recovery.policy import ACTION_BACKOFF, ACTION_EXHAUSTED
+from ..utils import locks
+
+# Transition kinds (the kctpu_elastic_transitions_total label values).
+KIND_DEGRADE = "degrade"
+KIND_HARVEST = "harvest"
+KIND_EXPAND = "expand"
+
+# Pod failure-reason prefix the scheduler's harvest pass stamps; exempt
+# from restart accounting (recovery/policy.py) exactly like "Preempted".
+REASON_HARVESTED_PREFIX = "WidthHarvested"
+
+
+@dataclass
+class ElasticPolicy:
+    """Controller-level knobs for the transition engine."""
+
+    # Modeled replacement warm-up: the degraded window lasts at least
+    # this long, so a re-expand never races the teardown it follows (and
+    # a fresh interpreter/compile/readmission has time to actually warm).
+    warmup_s: float = 2.0
+    # Minimum ACTUALLY-TRAINING degraded window: the clock starts when
+    # the re-sharded gang clears its startup phases (restore can eat the
+    # whole warm-up on a cold compile; the point of degraded operation
+    # is steps, not process uptime).
+    min_degraded_s: float = 1.0
+    # Requeue cadence while a degraded TPU gang waits for free slices —
+    # freed capacity emits no watch event on the job.
+    capacity_poll_s: float = 0.25
+    # How long an under-reporting degraded gang (members that have never
+    # beaten — the first beat trails import/restore; a gang with no
+    # progress plane never reports) may hold re-expansion.  Members that
+    # report a STARTING phase hold it outright, without a deadline.
+    progress_grace_s: float = 10.0
+
+
+@dataclass
+class ElasticTransition:
+    kind: str            # KIND_DEGRADE | KIND_HARVEST | KIND_EXPAND
+    from_width: int
+    to_width: int
+    reason: str = ""     # the pod failure reason that drove a shrink
+    # False for a partial (capacity-limited) expansion: more growth is
+    # still owed, GangRestored must not fire yet.
+    complete: bool = True
+
+
+@dataclass
+class ElasticAssessment:
+    """One sync's elastic verdict: an optional transition to apply (one
+    patch_meta: generation + width) plus the requeue the engine needs to
+    observe its own future (warm-up expiry, capacity freeing)."""
+
+    width: int = 0
+    spec_w: int = 0
+    min_width: int = 0
+    transition: Optional[ElasticTransition] = None
+    requeue_after_s: float = 0.0
+
+    @property
+    def degraded(self) -> bool:
+        return 0 < self.width < self.spec_w
+
+
+@dataclass
+class _State:
+    # Earliest wall-clock a re-expand may fire (degrade time + warm-up /
+    # remaining backoff).  0 = no hold (e.g. engine restarted mid-window).
+    reexpand_at: float = 0.0
+    # When the re-sharded gang was first seen TRAINING at the reduced
+    # width (past its startup phases); anchors min_degraded_s.
+    training_at: float = 0.0
+    # When the re-sharded gang was first fully Running at the current
+    # generation; bounds the partial-progress hold (progress_grace_s).
+    full_running_at: float = 0.0
+
+
+class ElasticEngine:
+    """Per-job width state machine; thread-safe (sync workers race)."""
+
+    def __init__(self, policy: Optional[ElasticPolicy] = None):
+        self.policy = policy or ElasticPolicy()
+        self._lock = locks.named_lock("elastic.engine")
+        self._jobs: Dict[str, _State] = {}
+        self._g_width = REGISTRY.gauge(
+            "kctpu_gang_width",
+            "Current runtime width of the job's elastic gang",
+            ("namespace", "tfjob"))
+        self._c_transitions = REGISTRY.counter(
+            "kctpu_elastic_transitions_total",
+            "Elastic width transitions driven by the controller engine "
+            "(degrade/expand) and the scheduler's harvest pass (harvest)",
+            ("kind",))
+
+    # ------------------------------------------------------------- assess
+
+    def assess(self, key: str, job: TFJob, pods_by_type, recovery,
+               now: float, inventory=None) -> Optional[ElasticAssessment]:
+        """Returns None for non-elastic jobs; otherwise this sync's
+        verdict.  ``recovery`` is the RestartTracker's assessment (None
+        in pure-planner tests), ``inventory`` the TPU slice inventory
+        when the controller holds one (gates TPU re-expansion on free
+        capacity)."""
+        spec = elastic_gang_spec(job)
+        if spec is None:
+            return None
+        if job.status.phase in (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED):
+            return None
+        restart = (spec.template.spec.restart_policy
+                   if spec.template else "OnFailure")
+        if restart not in ("OnFailure", "Always"):
+            return None  # Never-policy gangs are terminal on any failure
+        typ = spec.tf_replica_type
+        full = spec_width(spec)
+        el = job.spec.elastic
+        target_full = min(full, el.max_width or full)
+        m = max(1, el.min_width)
+        w = gang_width(job, spec)
+        out = ElasticAssessment(width=w, spec_w=full, min_width=m)
+        self._g_width.labels(job.metadata.namespace, job.metadata.name).set(w)
+
+        # An exhausted restart budget is terminal — never transitioned
+        # around (the budget is the job's, not the width's).
+        if recovery is not None and recovery.exhausted(typ):
+            return out
+
+        gen = gang_generation(job)
+        by_idx = pods_by_index(pods_by_type.get(typ, []))
+        # Unresolved member deaths of the CURRENT generation (an older
+        # generation's corpses are a transition already in flight).
+        failed_reasons: Dict[int, str] = {}
+        for i, plist in sorted(by_idx.items()):
+            if any(is_pod_active(p) or p.status.phase == PHASE_SUCCEEDED
+                   for p in plist):
+                continue
+            failed = [p for p in plist if p.status.phase == PHASE_FAILED
+                      and _pod_generation(p) == gen]
+            if failed:
+                failed_reasons[i] = failed[-1].status.reason or ""
+
+        if failed_reasons:
+            return self._assess_shrink(key, out, spec, typ, w, m,
+                                       failed_reasons, recovery, now)
+        if w < target_full:
+            return self._assess_expand(key, out, job, spec, typ, w,
+                                       target_full, gen, by_idx, now,
+                                       inventory)
+        with self._lock:
+            self._jobs.pop(key, None)  # steady at target: clear holds
+        return out
+
+    def _assess_shrink(self, key: str, out: ElasticAssessment, spec,
+                       typ: ReplicaType, w: int, m: int, failed_reasons,
+                       recovery, now: float) -> ElasticAssessment:
+        target = w - len(failed_reasons)
+        if typ == ReplicaType.TPU and spec.tpu is not None:
+            # TPU width is slice-granular: one dead host voids its whole
+            # slice (the failure domain), so round the survivors down to
+            # whole slices.
+            per = tpu_slice_hosts(spec.tpu)
+            target = (target // per) * per
+        # The degraded window must outlast the failed indices' remaining
+        # backoff (the replacement cannot come sooner) and the modeled
+        # warm-up — captured NOW, because the re-shard deletes the failed
+        # pod records and with them the recovery decisions.
+        backoff = 0.0
+        if recovery is not None:
+            for i in failed_reasons:
+                d = recovery.decision_for(typ, i)
+                if d is not None and d.action == ACTION_BACKOFF:
+                    backoff = max(backoff, d.remaining_s)
+                if d is not None and d.action == ACTION_EXHAUSTED:
+                    return out  # terminal; never transition around it
+        hold = max(self.policy.warmup_s, backoff)
+        with self._lock:
+            st = self._jobs.setdefault(key, _State())
+            st.reexpand_at = max(st.reexpand_at, now + hold)
+            st.training_at = 0.0  # a fresh shrink restarts the window
+            st.full_running_at = 0.0
+        if target < m:
+            # Below the elastic floor: the recovery plane's whole-gang
+            # path owns this failure (backoff, budget, terminal).
+            return out
+        harvest = any(r.startswith(REASON_HARVESTED_PREFIX)
+                      for r in failed_reasons.values())
+        kind = KIND_HARVEST if harvest else KIND_DEGRADE
+        self._c_transitions.labels(kind).inc()
+        out.transition = ElasticTransition(
+            kind, from_width=w, to_width=target,
+            reason=next(iter(failed_reasons.values())))
+        out.requeue_after_s = hold
+        return out
+
+    def _assess_expand(self, key: str, out: ElasticAssessment, job: TFJob,
+                       spec, typ: ReplicaType, w: int, target_full: int,
+                       gen: int, by_idx, now: float,
+                       inventory) -> ElasticAssessment:
+        # The degraded gang must be whole and Running at the current
+        # generation first — expanding mid-re-shard would tear down pods
+        # that never trained.
+        running = sum(
+            1 for plist in by_idx.values() for p in plist
+            if p.status.phase == PHASE_RUNNING and _pod_generation(p) == gen)
+        if running < w:
+            return out
+        # "Running" is process-up, not training: a member still in its
+        # startup phases (rendezvous/compile/re-shard restore) has not
+        # trained a step at this width — expanding now would tear down a
+        # gang that never ran, and the bench's degraded window would be
+        # a lie.  Progress beats re-sync the job, so this un-blocks
+        # itself the moment the first post-re-shard step lands.  The
+        # min_degraded_s clock (below) anchors on the first sync where
+        # the whole gang reports training.
+        starting = ("rendezvous", "init", "compile", "restore", "reshard")
+        reporting = 0
+        for plist in by_idx.values():
+            for p in plist:
+                if (p.status.phase != PHASE_RUNNING
+                        or _pod_generation(p) != gen):
+                    continue
+                pr = p.status.progress
+                if pr is None:
+                    continue
+                reporting += 1
+                if (pr.phase or "") in starting:
+                    out.requeue_after_s = self.policy.capacity_poll_s
+                    return out
+        with self._lock:
+            st = self._jobs.setdefault(key, _State())
+            if st.full_running_at == 0.0:
+                st.full_running_at = now
+            if (reporting < w
+                    and now - st.full_running_at
+                    < self.policy.progress_grace_s):
+                # Not every member is observably training yet (the first
+                # beat trails import/restore; a gang with no progress
+                # plane at all never reports): hold, bounded by the
+                # grace, so min_degraded_s measures TRAINING time.
+                out.requeue_after_s = self.policy.capacity_poll_s
+                return out
+            if st.training_at == 0.0:
+                st.training_at = now
+            reexpand_at = max(st.reexpand_at,
+                              st.training_at + self.policy.min_degraded_s)
+        if now < reexpand_at:
+            out.requeue_after_s = reexpand_at - now
+            return out
+        target = target_full
+        if (typ == ReplicaType.TPU and spec.tpu is not None
+                and inventory is not None):
+            # Harvested/lost width is re-granted as contention clears:
+            # grow slice-granularly into whatever is free now, up to the
+            # target — and keep polling while short (freed slices emit no
+            # watch event on this job).
+            per = tpu_slice_hosts(spec.tpu)
+            free = inventory.free_slice_count(spec.tpu.accelerator_type)
+            grantable = w + free * per
+            target = min(target_full, (grantable // per) * per)
+            if target <= w:
+                out.requeue_after_s = self.policy.capacity_poll_s
+                return out
+        self._c_transitions.labels(KIND_EXPAND).inc()
+        out.transition = ElasticTransition(
+            KIND_EXPAND, from_width=w, to_width=target,
+            complete=target >= target_full)
+        if target < target_full:
+            out.requeue_after_s = self.policy.capacity_poll_s
+        return out
+
+    # ----------------------------------------------------------- plumbing
+
+    def forget_job(self, key: str, job: Optional[TFJob] = None) -> None:
+        with self._lock:
+            self._jobs.pop(key, None)
+        if job is not None:
+            self._g_width.remove(job.metadata.namespace, job.metadata.name)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._jobs)

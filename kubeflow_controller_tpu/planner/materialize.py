@@ -72,6 +72,11 @@ ENV_COMPILE_CACHE = "KCTPU_COMPILE_CACHE"
 ENV_GANG_GENERATION = "KCTPU_GANG_GENERATION"
 ENV_GANG_NAME_WORKLOAD = "KCTPU_GANG_NAME"
 ENV_CHECKPOINT_EVERY = "KCTPU_CHECKPOINT_EVERY"
+# Elastic plane: the gang's CURRENT width, per generation.  Workloads
+# derive data sharding and collective topology from this (and from the
+# jax runtime it configures), never from spec.replicas — the invariant
+# `kctpu vet` rule gang-width-env enforces.
+ENV_GANG_WIDTH = "KCTPU_GANG_WIDTH"
 
 
 def labels_for(job: TFJob, typ: ReplicaType) -> Dict[str, str]:
@@ -202,6 +207,38 @@ def gang_generation(job: TFJob) -> int:
         return 0
 
 
+def spec_width(spec: TFReplicaSpec) -> int:
+    """The replica set's FULL width: the slice topology's host count for
+    TPU (the source of truth), spec.replicas otherwise."""
+    if spec.tf_replica_type == ReplicaType.TPU and spec.tpu is not None:
+        return tpu_total_hosts(spec.tpu)
+    return spec.replicas
+
+
+def gang_width(job: TFJob, spec: TFReplicaSpec) -> int:
+    """The replica set's CURRENT runtime width.
+
+    For the job's elastic gang this is the controller-written gang-width
+    annotation (bumped in lockstep with the gang generation on every
+    re-shard transition), clamped to [elastic.min_width, spec width];
+    for everything else — and for an absent/invalid annotation — it is
+    the spec width.  Planner, materializer, updater and health checker
+    all key off this one function, so a width transition is one
+    annotation write."""
+    from ..api.labels import ANNOTATION_GANG_WIDTH
+    from ..api.tfjob import elastic_gang_spec
+
+    full = spec_width(spec)
+    if elastic_gang_spec(job) is not spec:
+        return full
+    try:
+        w = int(job.metadata.annotations.get(ANNOTATION_GANG_WIDTH, "")
+                or full)
+    except ValueError:
+        return full
+    return max(max(1, job.spec.elastic.min_width), min(w, full))
+
+
 # ---------------------------------------------------------------------------
 # Pod / Service materializers
 # ---------------------------------------------------------------------------
@@ -246,7 +283,13 @@ def _wire_worker_collectives(job: TFJob, pod: Pod, c, index: int) -> None:
     from ..api.labels import ANNOTATION_GANG_GENERATION, ANNOTATION_GANG_NAME
 
     worker = replica_spec_for(job, ReplicaType.WORKER)
-    n = worker.replicas if worker else 1
+    # Elastic plane: the collective spans the CURRENT width, not the spec
+    # width — a degraded gang is a complete (smaller) jax.distributed
+    # world, and its data shards rebalance because every member reads the
+    # width from here rather than from spec.replicas.
+    n = gang_width(job, worker) if worker else 1
+    if worker is not None:
+        _stamp_elastic(job, worker, pod, c)
     if n <= 1:
         return
     coord = f"{service_name(job, ReplicaType.WORKER, 0)}:{TF_PORT}"
@@ -269,10 +312,44 @@ def _wire_worker_collectives(job: TFJob, pod: Pod, c, index: int) -> None:
     }
 
 
+def _stamp_elastic(job: TFJob, spec: TFReplicaSpec, pod: Pod, c) -> None:
+    """Elastic-plane stamps (no-op for non-elastic replica sets): the
+    current width for the workload ($KCTPU_GANG_WIDTH + pod annotation)
+    and the elastic floor for the scheduler (min-width in pods;
+    min-slices on TPU, where harvesting is slice-granular).  Gang
+    identity env rides along so even a width-1 degraded survivor knows
+    its generation (the re-shard/restore marker needs it)."""
+    from ..api.labels import (
+        ANNOTATION_ELASTIC_MIN_SLICES,
+        ANNOTATION_ELASTIC_MIN_WIDTH,
+        ANNOTATION_GANG_WIDTH,
+    )
+    from ..api.tfjob import elastic_gang_spec
+
+    if elastic_gang_spec(job) is not spec:
+        return
+    w = gang_width(job, spec)
+    c.set_env(ENV_GANG_WIDTH, str(w))
+    c.set_env(ENV_GANG_GENERATION, str(gang_generation(job)))
+    c.set_env(ENV_GANG_NAME_WORKLOAD, gang_name(job))
+    ann = {
+        ANNOTATION_GANG_WIDTH: str(w),
+        ANNOTATION_ELASTIC_MIN_WIDTH: str(job.spec.elastic.min_width),
+    }
+    if spec.tf_replica_type == ReplicaType.TPU and spec.tpu is not None:
+        per = tpu_slice_hosts(spec.tpu)
+        ann[ANNOTATION_ELASTIC_MIN_SLICES] = str(
+            max(1, -(-job.spec.elastic.min_width // per)))
+    pod.metadata.annotations = {**pod.metadata.annotations, **ann}
+
+
 def _wire_tpu_pod(job: TFJob, spec: TFReplicaSpec, pod: Pod, index: int) -> None:
     tpu = spec.tpu
     per_slice = tpu_slice_hosts(tpu)
-    total = tpu_total_hosts(tpu)
+    # Elastic plane: the gang spans its CURRENT width (gang-width
+    # annotation) — fewer hosts, proportionally fewer slices.  The spec
+    # topology is the full-width shape re-expansion returns to.
+    total = gang_width(job, spec)
     slice_idx, local_idx = divmod(index, per_slice)
     coord = f"{coordinator_service_name(job)}:{tpu.coordinator_port}"
     # Per-host DNS via the headless subdomain service: hostname + subdomain
@@ -294,7 +371,10 @@ def _wire_tpu_pod(job: TFJob, spec: TFReplicaSpec, pod: Pod, index: int) -> None
         for i in range(slice_idx * per_slice, (slice_idx + 1) * per_slice)
     ))
     c.set_env(ENV_TPU_ACCELERATOR, tpu.accelerator_type)
-    c.set_env(ENV_NUM_SLICES, str(tpu.num_slices))
+    # Slice count follows the current width (width changes are
+    # slice-granular for TPU gangs — validated at the API).
+    num_slices_now = max(1, -(-total // per_slice))
+    c.set_env(ENV_NUM_SLICES, str(num_slices_now))
     c.set_env(ENV_SLICE_ID, str(slice_idx))
     # Recovery plane: generation-keyed rendezvous + guard identity.
     from ..api.labels import ANNOTATION_GANG_GENERATION
@@ -310,11 +390,12 @@ def _wire_tpu_pod(job: TFJob, spec: TFReplicaSpec, pod: Pod, index: int) -> None
         ANNOTATION_GANG_NAME: gang_name(job),
         ANNOTATION_GANG_SIZE: str(total),
         ANNOTATION_ACCELERATOR: tpu.accelerator_type,
-        ANNOTATION_NUM_SLICES: str(tpu.num_slices),
+        ANNOTATION_NUM_SLICES: str(num_slices_now),
         ANNOTATION_SLICE_INDEX: str(slice_idx),
         ANNOTATION_PRIORITY_CLASS: job.spec.priority_class_name or "default",
         ANNOTATION_GANG_GENERATION: str(gen),
     }
+    _stamp_elastic(job, spec, pod, c)
     if pod.spec.restart_policy == "Always":
         # A slice process that dies must fail the pod so the whole gang is
         # rescheduled (the slice is the failure domain) — never restart

@@ -39,7 +39,7 @@ from ..api.core import (
     is_pod_active,
 )
 from ..api.tfjob import ReplicaType, TFJob, TFJobPhase, TFReplicaSpec, tpu_total_hosts
-from .materialize import pods_by_index, services_by_index
+from .materialize import gang_generation, gang_width, pods_by_index, services_by_index
 from .types import Action, Plan, PlanEvent
 
 # Service/pod ordering across types (ref: distributed.go:59-117 emits worker
@@ -56,10 +56,14 @@ def desired_replicas(spec: TFReplicaSpec) -> int:
     return spec.replicas
 
 
-def desired_service_indices(spec: TFReplicaSpec) -> range:
+def desired_service_indices(spec: TFReplicaSpec, job: TFJob = None) -> range:
     typ = spec.tf_replica_type
     if typ in (ReplicaType.PS, ReplicaType.WORKER):
-        return range(desired_replicas(spec))
+        # Elastic gangs: one service per CURRENT member (extra indices
+        # are scaled down while degraded, re-created on re-expand —
+        # service names are deterministic, so repair is index-exact).
+        n = gang_width(job, spec) if job is not None else desired_replicas(spec)
+        return range(n)
     if typ == ReplicaType.TPU:
         return range(1)  # only the coordinator service (replica 0)
     return range(0)  # Local: no services (ref: local.go)
@@ -90,7 +94,7 @@ def plan_job(
     for spec in _ordered_specs(job):
         typ = spec.tf_replica_type
         by_idx = services_by_index(services_by_type.get(typ, []))
-        want = desired_service_indices(spec)
+        want = desired_service_indices(spec, job)
         for i in want:
             if not by_idx.get(i):
                 events.append(PlanEvent(Action.ADD_SERVICE, typ, index=i))
@@ -104,7 +108,7 @@ def plan_job(
     # Pass 2: pods.
     for spec in _ordered_specs(job):
         events.extend(_plan_pods(
-            spec, pods_by_type.get(spec.tf_replica_type, []), recovery))
+            job, spec, pods_by_type.get(spec.tf_replica_type, []), recovery))
     return Plan(events)
 
 
@@ -124,10 +128,12 @@ def _gate(recovery, typ: ReplicaType, index: int) -> str:
     return d.action if d is not None else "replace"
 
 
-def _plan_pods(spec: TFReplicaSpec, pods: List[Pod],
+def _plan_pods(job: TFJob, spec: TFReplicaSpec, pods: List[Pod],
                recovery=None) -> List[PlanEvent]:
     typ = spec.tf_replica_type
-    n = desired_replicas(spec)
+    # Elastic gangs plan at the CURRENT width (the controller-written
+    # annotation); everything else at the spec width.
+    n = gang_width(job, spec)
     by_idx = pods_by_index(pods)
     restart = (spec.template.spec.restart_policy if spec.template else "OnFailure")
     replace_on_failure = restart in ("OnFailure", "Always")
@@ -135,7 +141,7 @@ def _plan_pods(spec: TFReplicaSpec, pods: List[Pod],
     events: List[PlanEvent] = []
 
     if is_gang_spec(spec):
-        return _plan_gang(spec, n, by_idx, replace_on_failure, recovery)
+        return _plan_gang(job, spec, n, by_idx, replace_on_failure, recovery)
 
     for i in range(n):
         plist = sorted(by_idx.get(i, []), key=lambda p: p.metadata.creation_timestamp or 0)
@@ -177,15 +183,32 @@ def _plan_pods(spec: TFReplicaSpec, pods: List[Pod],
     return events
 
 
+def _pod_generation(p: Pod) -> int:
+    from ..api.labels import ANNOTATION_GANG_GENERATION
+
+    try:
+        return int(p.metadata.annotations.get(
+            ANNOTATION_GANG_GENERATION, "0") or "0")
+    except ValueError:
+        return 0
+
+
 def _plan_gang(
-    spec: TFReplicaSpec, n: int, by_idx: Dict[int, List[Pod]],
+    job: TFJob, spec: TFReplicaSpec, n: int, by_idx: Dict[int, List[Pod]],
     replace_on_failure: bool, recovery=None
 ) -> List[PlanEvent]:
     """All-or-nothing: if any member failed (and we replace), tear down every
     surviving member and re-create the full gang.  Under the restart policy
     engine, the whole gang waits out the worst failed member's backoff and
     goes terminal if ANY member's limit is exhausted (one failure domain —
-    its restart budget is shared)."""
+    its restart budget is shared).
+
+    Width transitions (elastic plane) ride the generation: active members
+    whose gang-generation annotation lags the job's mean the controller
+    has driven a re-shard (degrade / harvest / re-expand) — the STALE gang
+    is replaced wholesale at the CURRENT width ``n``, without waiting out
+    anyone's backoff (the survivors are healthy; the point of the
+    transition is to keep them training)."""
     typ = spec.tf_replica_type
     events: List[PlanEvent] = []
     failed_indices = [
@@ -200,6 +223,23 @@ def _plan_gang(
         any(p.status.phase == PHASE_SUCCEEDED for p in by_idx.get(i, [])) for i in range(n)
     )
     if all_succeeded:
+        return events
+    expected_gen = gang_generation(job)
+    stale = any(
+        _pod_generation(p) != expected_gen
+        for plist in by_idx.values() for p in plist if is_pod_active(p))
+    if stale and replace_on_failure:
+        verdicts = [_gate(recovery, typ, i) for i in failed_indices]
+        if "exhausted" in verdicts:
+            return events  # terminal: the gang's restart budget is spent
+        for i, plist in sorted(by_idx.items()):
+            for p in plist:
+                events.append(PlanEvent(Action.DELETE_POD, typ, index=i,
+                                        name=p.metadata.name,
+                                        reason="reshard"))
+        for i in range(n):
+            events.append(PlanEvent(Action.ADD_POD, typ, index=i,
+                                    reason="reshard"))
         return events
     if any_failed and replace_on_failure:
         verdicts = [_gate(recovery, typ, i) for i in failed_indices]

@@ -40,6 +40,34 @@ class KillRecord:
 
 
 @dataclass
+class ElasticRecord:
+    """One elastic kill's measured timeline (bench.py --elastic):
+
+    - ``time_to_degraded_s`` — kill → the job training AGAIN at the
+      reduced width (width status below spec AND the min step advancing
+      past its post-reset restore point);
+    - ``degraded_steps_per_sec`` — observed step rate while degraded
+      (the "steps/sec > 0 throughout the degraded window" gate: the
+      survivors keep training while the replacement warms);
+    - ``time_to_restored_s`` — kill → back at full width and advancing;
+    - ``degraded_width`` / ``spec_width`` and the resume evidence
+      (``resumed_from_step`` per transition: never restore-from-scratch).
+    """
+
+    job: str = ""
+    spec_width: int = 0
+    degraded_width: int = 0
+    time_to_degraded_s: float = 0.0
+    time_to_restored_s: float = 0.0
+    degraded_steps_per_sec: float = 0.0
+    degraded_step_samples: int = 0
+    degraded_resumed_from: int = -1   # re-shard down restore point
+    restored_resumed_from: int = -1   # re-expand restore point
+    degraded: bool = False
+    restored: bool = False
+
+
+@dataclass
 class ChaosReport:
     kills: List[KillRecord] = field(default_factory=list)
 
@@ -165,3 +193,81 @@ class ChaosMonkey:
             time.sleep(poll_s)
         rec.recovery_s = time.time() - rec.t_kill
         return rec
+
+    def await_elastic(self, namespace: str, rec: KillRecord,
+                      spec_width: int, deadline_s: float = 180.0,
+                      poll_s: float = 0.02) -> "ElasticRecord":
+        """Measure an elastic kill's timeline off the public status
+        surface (width rollup + progress plane): time-to-degraded (kill →
+        training again at reduced width), the step rate THROUGH the
+        degraded window, and time-to-restored (kill → full width and
+        advancing).  Observation-only, like :meth:`await_recovery`."""
+        from ..api.tfjob import TFJobPhase
+
+        out = ElasticRecord(job=rec.job, spec_width=spec_width)
+        end = time.time() + deadline_s
+        samples = []  # (t, min step) while degraded, strictly advancing
+        last_step = None
+        phase = "await-degrade"
+        while time.time() < end:
+            j = self.cluster.tfjobs.get(namespace, rec.job)
+            w = j.status.width
+            p = j.status.progress
+            now = time.time()
+            cur = w.current if w is not None else spec_width
+            if p is not None:
+                for r in p.replicas:
+                    if r.resumed_from_step > 0:
+                        if phase in ("await-degrade", "degraded"):
+                            out.degraded_resumed_from = max(
+                                out.degraded_resumed_from,
+                                r.resumed_from_step)
+                        else:
+                            out.restored_resumed_from = max(
+                                out.restored_resumed_from,
+                                r.resumed_from_step)
+            if phase == "await-degrade":
+                # Degraded = the width dropped AND the survivors' min
+                # step ADVANCED at that width (a frozen restore doesn't
+                # count — the gate is "keeps training").
+                if cur < spec_width and p is not None and p.reporting > 0:
+                    step = p.step
+                    if last_step is not None and step > last_step > 0:
+                        out.degraded = True
+                        out.degraded_width = cur
+                        out.time_to_degraded_s = now - rec.t_kill
+                        samples.append((now, step))
+                        phase = "degraded"
+                    last_step = step
+            elif phase == "degraded":
+                if cur >= spec_width:
+                    phase = "await-restore"
+                    last_step = None
+                elif p is not None and p.reporting > 0:
+                    if samples and p.step > samples[-1][1]:
+                        samples.append((now, p.step))
+            else:  # await-restore: full width again, advancing again
+                if p is not None and p.reporting > 0:
+                    step = p.step
+                    if last_step is not None and step > last_step:
+                        out.restored = True
+                        out.time_to_restored_s = now - rec.t_kill
+                        break
+                    last_step = step
+            if j.status.phase == TFJobPhase.SUCCEEDED:
+                # Finishing at full width IS restored (the final steps
+                # ran post-expand); finishing degraded is not.
+                if phase == "await-restore":
+                    out.restored = True
+                    out.time_to_restored_s = now - rec.t_kill
+                break
+            if j.status.phase == TFJobPhase.FAILED:
+                break
+            time.sleep(poll_s)
+        if len(samples) >= 2:
+            dt = samples[-1][0] - samples[0][0]
+            ds = samples[-1][1] - samples[0][1]
+            out.degraded_steps_per_sec = (round(ds / dt, 3) if dt > 0
+                                          else 0.0)
+        out.degraded_step_samples = len(samples)
+        return out

@@ -191,10 +191,13 @@ class RestartTracker:
                     # Count failures not injected by the scheduler: a
                     # preemption is capacity policy, not a crash, and must
                     # not burn the backoff budget or delay readmission.
+                    # Width harvesting (elastic plane) is the same class —
+                    # the scheduler took capacity; the member did nothing
+                    # wrong, and the re-shard must not inherit a backoff.
                     failed = [p for p in plist
                               if p.status.phase == PHASE_FAILED
                               and not (p.status.reason or "").startswith(
-                                  "Preempted")]
+                                  ("Preempted", "WidthHarvested"))]
                     fresh = [p for p in failed
                              if st is None
                              or p.metadata.name not in st.failed_pods]

@@ -50,6 +50,10 @@ class GangEntry:
     queued: bool = False
     admitted: bool = False
     admitted_at: float = 0.0
+    # Elastic floor in slices (0 = not elastic): how far the gang's
+    # binding may be HARVESTED by a blocked higher-priority gang instead
+    # of preempting it whole (scheduler._harvest_for_locked).
+    min_slices: int = 0
     # True once any member pod passed the admission gate (left Pending):
     # an admitted-but-unstarted gang can be requeued silently, a started
     # one must be evicted pod-by-pod.

@@ -44,10 +44,12 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..utils import locks
 from ..api.labels import (
     ANNOTATION_ACCELERATOR,
+    ANNOTATION_ELASTIC_MIN_SLICES,
     ANNOTATION_GANG_NAME,
     ANNOTATION_GANG_SIZE,
     ANNOTATION_NUM_SLICES,
     ANNOTATION_PRIORITY_CLASS,
+    ANNOTATION_SLICE_INDEX,
 )
 from ..obs.metrics import REGISTRY
 from ..planner.materialize import pod_index
@@ -58,6 +60,11 @@ from .queue import GangEntry, PRIORITY_CLASSES, normalize_class, priority_for, s
 # process, exactly as pod phase already does).
 REASON_QUEUED_PREFIX = "GangQueued"
 REASON_PREEMPTED_PREFIX = "Preempted"
+# Elastic plane: pods failed because their slices were HARVESTED (not
+# preempted) — the controller's width engine re-shards the gang down
+# instead of replacing it whole, and the recovery policy exempts the
+# reason from restart accounting exactly like Preempted.
+REASON_HARVESTED_PREFIX = "WidthHarvested"
 
 
 @dataclass
@@ -110,6 +117,10 @@ class GangScheduler:
         self._c_backfill = REGISTRY.counter(
             "kctpu_sched_backfills_total",
             "Gangs admitted past a blocked wider head gang")
+        self._c_harvest = REGISTRY.counter(
+            "kctpu_sched_harvested_slices_total",
+            "Slices harvested from running elastic gangs instead of "
+            "whole-gang preemption (victim's class)", ("priority_class",))
         g_util = REGISTRY.gauge(
             "kctpu_slice_utilization",
             "Bound fraction of healthy TPU slices (scrape-time)")
@@ -151,6 +162,28 @@ class GangScheduler:
                 )
                 self._gangs[gang_name] = e
             e.pods[key] = pod
+            # Elastic floor rides the pods (refreshed every offer: a new
+            # generation may carry a new width/floor).
+            e.min_slices = int(
+                ann.get(ANNOTATION_ELASTIC_MIN_SLICES, "0") or "0")
+            if e.admitted:
+                # Keep the bound inventory gang's member map current: a
+                # re-shard replaces every pod without rebinding, and the
+                # idle reaper keys off that map.
+                self.inventory.note_gang_pod(e.name, pod)
+                want = int(ann.get(ANNOTATION_NUM_SLICES, "1") or "1")
+                if want > len(e.slice_names):
+                    # Elastic re-expansion: harvested width is re-granted
+                    # from free capacity, all-or-nothing, before any
+                    # member of the wider generation starts.
+                    extra = self.inventory.grow_gang(
+                        e.name, e.accelerator_type,
+                        want - len(e.slice_names))
+                    if extra is None:
+                        return False  # contention not cleared yet: hold
+                    e.slice_names = e.slice_names + extra
+                    e.num_slices = len(e.slice_names)
+                    self._dirty = True
             if not e.admitted:
                 if len(e.pods) < e.size:
                     return False  # incomplete: hold everything
@@ -230,10 +263,67 @@ class GangScheduler:
             self._c_backfill.inc()
         return True
 
+    def _harvest_for_locked(self, e: GangEntry, now: float,
+                            evictions: List[Tuple[List[str], str]]) -> int:
+        """Width harvesting: shrink running strictly-lower-priority
+        ELASTIC gangs toward their floor instead of preempting anyone
+        whole.  The harvested slices are released, the pods on them fail
+        with a ``WidthHarvested`` reason (exempt from restart
+        accounting), and the controller's width engine re-shards each
+        victim down — it keeps training.  Victim order matches
+        preemption (lowest class, youngest first); returns slices
+        gained."""
+        free = self.inventory.free_slice_count(e.accelerator_type)
+        need = e.num_slices
+        gained = 0
+        victims = sorted(
+            (v for v in self._gangs.values()
+             if v.admitted and v.started and v.priority < e.priority
+             and v.min_slices > 0 and len(v.slice_names) > v.min_slices
+             and (not e.accelerator_type
+                  or v.accelerator_type in ("", e.accelerator_type))),
+            key=lambda v: (v.priority, -v.fairness_at))
+        for v in victims:
+            if free + gained >= need:
+                break
+            surplus = len(v.slice_names) - v.min_slices
+            take = min(surplus, need - free - gained)
+            released = self.inventory.release_slices(v.name, take)
+            if not released:
+                continue
+            gained += len(released)
+            kept = len(v.slice_names) - len(released)
+            v.slice_names = v.slice_names[:kept]
+            v.num_slices = kept
+            self._c_harvest.labels(v.priority_class).inc(len(released))
+            self._dirty = True
+            # Fail exactly the members on the released slices; survivors
+            # keep running until the controller's re-shard replaces them
+            # at the reduced width.
+            reason = (f"{REASON_HARVESTED_PREFIX}: {len(released)} "
+                      f"slice(s) harvested for gang {e.name} "
+                      f"(class {e.priority_class})")
+            victim_keys = []
+            for k, p in list(v.pods.items()):
+                try:
+                    si = int(p.metadata.annotations.get(
+                        ANNOTATION_SLICE_INDEX, "0") or "0")
+                except ValueError:
+                    si = 0
+                if si >= kept:
+                    victim_keys.append(k)
+                    v.pods.pop(k, None)
+            if victim_keys:
+                evictions.append((victim_keys, reason))
+        return gained
+
     def _preempt_for_locked(self, e: GangEntry, now: float,
                             evictions: List[Tuple[List[str], str]]) -> bool:
         """Evict enough strictly-lower-priority admitted gangs for ``e`` to
-        fit: lowest class first, youngest first within a class."""
+        fit — after first HARVESTING width from elastic victims (which
+        keeps them training at reduced width; whole-gang eviction is the
+        last resort): lowest class first, youngest first within a class."""
+        self._harvest_for_locked(e, now, evictions)
         free = self.inventory.free_slice_count(e.accelerator_type)
         need = e.num_slices
         victims = sorted(
@@ -320,6 +410,30 @@ class GangScheduler:
                        if e.queued and not e.admitted)
 
     # -------------------------------------------------- inventory delegation
+
+    def free_slice_count(self, accelerator_type: str = "") -> int:
+        """Capacity view for the controller's elastic engine: degraded
+        TPU gangs re-expand only into free slices."""
+        return self.inventory.free_slice_count(accelerator_type)
+
+    def has_free_slice(self, accelerator_type: str = "") -> bool:
+        return self.inventory.has_free_slice(accelerator_type)
+
+    def grow_gang(self, gang_name: str, accelerator_type: str,
+                  n_extra: int):
+        """Direct growth passthrough (the scheduler's own offer() path
+        grows through the entry; this keeps the inventory protocol whole
+        for callers holding a scheduler-shaped inventory)."""
+        grown = self.inventory.grow_gang(gang_name, accelerator_type,
+                                         n_extra)
+        if grown:
+            with self._lock:
+                e = self._gangs.get(gang_name)
+                if e is not None:
+                    e.slice_names = e.slice_names + list(grown)
+                    e.num_slices = len(e.slice_names)
+                self._dirty = True
+        return grown
 
     def gang_slice(self, gang_name: str) -> str:
         return self.inventory.gang_slice(gang_name)

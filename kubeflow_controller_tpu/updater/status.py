@@ -47,8 +47,7 @@ from ..api.tfjob import (
     TFReplicaState,
     TFReplicaStatus,
 )
-from ..planner.materialize import pod_index, pods_by_index
-from ..planner.plan import desired_replicas
+from ..planner.materialize import gang_width, pod_index, pods_by_index, spec_width
 from ..utils import serde
 
 _POD_TO_REPLICA_STATE = {
@@ -198,7 +197,10 @@ def compute_status(
 
     for spec in job.spec.tf_replica_specs:
         typ = spec.tf_replica_type
-        desired = desired_replicas(spec)
+        # Elastic gangs roll up against their CURRENT width: a degraded
+        # gang with every current member Running is Scheduled/Ready (the
+        # reduced width itself surfaces as the Degraded condition below).
+        desired = gang_width(job, spec)
         pods = pods_by_type.get(typ, [])
         restart = spec.template.spec.restart_policy if spec.template else "OnFailure"
         replace_on_failure = restart in ("OnFailure", "Always")
@@ -287,7 +289,7 @@ def compute_status(
         if any_terminal_failure:
             phase = TFJobPhase.FAILED
         elif deciding and all(
-            len(index_done.get(s.tf_replica_type, {})) == desired_replicas(s)
+            len(index_done.get(s.tf_replica_type, {})) == gang_width(job, s)
             and all(v == PHASE_SUCCEEDED for v in index_done[s.tf_replica_type].values())
             for s in deciding
         ):
@@ -380,6 +382,28 @@ def compute_status(
     )
     set_condition(status, TFJobConditionType.RECYCLING, terminal and has_active,
                   reason="ReclaimingReplicas" if terminal and has_active else "", now=now)
+
+    # -- elastic width rollup (net-new; elastic/engine.py drives it) --
+    # Only elastic jobs carry the width status + Degraded condition, so
+    # the pre-elastic status shape serializes unchanged for everyone else.
+    from ..api.tfjob import JobWidth, elastic_gang_spec
+
+    el_spec = elastic_gang_spec(job)
+    if el_spec is not None:
+        w = gang_width(job, el_spec)
+        full = spec_width(el_spec)
+        status.width = JobWidth(current=w, spec=full,
+                                min=max(1, job.spec.elastic.min_width))
+        reduced = w < full
+        set_condition(
+            status, TFJobConditionType.DEGRADED, reduced,
+            reason="WidthReduced" if reduced else "FullWidth",
+            message=(f"elastic gang training at width {w}/{full} "
+                     f"(floor {status.width.min}); replacement warming"
+                     if reduced else ""),
+            now=now)
+    else:
+        status.width = None
     return status
 
 

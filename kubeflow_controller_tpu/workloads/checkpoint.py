@@ -107,6 +107,34 @@ class CheckpointManager:
                 self._drop_step(step)
         raise FileNotFoundError(f"no readable checkpoint under {self.directory}")
 
+    # -- elastic width marker -------------------------------------------
+
+    WIDTH_MARKER = "gang_width"
+
+    def read_width(self) -> Optional[int]:
+        """The gang width that wrote the checkpoints here (None = never
+        recorded).  A restore under a DIFFERENT runtime width is a
+        re-shard: data shards rebalance and the workload beats
+        ``phase="reshard"`` so the stall detector holds its frozen-step
+        deadline through the transition."""
+        try:
+            with open(os.path.join(self.directory, self.WIDTH_MARKER)) as fh:
+                return int(fh.read().strip() or "0") or None
+        except (OSError, ValueError):
+            return None
+
+    def write_width(self, width: int) -> None:
+        """Record the writing gang's width (process 0 only; atomic
+        tmp+rename so a kill mid-write never leaves a torn marker)."""
+        path = os.path.join(self.directory, self.WIDTH_MARKER)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as fh:
+                fh.write(str(width))
+            os.replace(tmp, path)
+        except OSError:
+            pass  # marker is advisory; restore falls back to "restore"
+
     def _drop_step(self, step: int) -> None:
         """Remove a bad step so no later resume trips over it again (the
         manager's own delete first; rmtree as the fallback for dirs the

@@ -371,15 +371,31 @@ def _fit_step_loop(args, jax, jnp, m, rt, setup, mesh, opt, dp, pc, proc,
             from .progress import reporter as _reporter
 
             mgr = CheckpointManager(rt.model_dir)
+            # Elastic plane: the width that WROTE these checkpoints comes
+            # from the marker, this generation's width from the runtime
+            # env ($KCTPU_GANG_WIDTH) — never from any job spec.  A
+            # mismatch makes this restore a RE-SHARD: the same model
+            # state fans out over a different member count (data shards
+            # rebalance by construction — sharding is keyed on the
+            # runtime width), and the beats say phase="reshard" so the
+            # controller's stall detector holds its frozen-step deadline
+            # through the transition.
+            prev_width = mgr.read_width()
+            phase = ("reshard"
+                     if prev_width is not None and prev_width != rt.gang_width
+                     else "restore")
             if mgr.latest_step() is not None:
-                _reporter().beat(phase="restore")
-                with _tr.span("workload/restore", process=proc) as sp_r:
+                _reporter().beat(phase=phase)
+                with _tr.span("workload/restore", process=proc,
+                              reshard=(phase == "reshard")) as sp_r:
                     params, opt_state, start_step = mgr.restore(
                         params, opt_state)
                     sp_r.args["step"] = start_step
                 start_step = min(start_step, args.steps)
-                _reporter().beat(step=start_step, phase="restore",
+                _reporter().beat(step=start_step, phase=phase,
                                  resumed_from_step=start_step)
+            if proc == 0:
+                mgr.write_width(rt.gang_width)
             if args.checkpoint_every > 0:
                 def ck_fn(s, p, o, _mgr=mgr):
                     _mgr.save(s, p, o, wait=False)

@@ -16,6 +16,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..planner.materialize import (
     ENV_COORDINATOR,
+    ENV_GANG_WIDTH,
     ENV_NUM_PROCESSES,
     ENV_NUM_SLICES,
     ENV_PROCESS_ID,
@@ -111,6 +112,12 @@ class JobRuntime:
     # first incarnation).  Bumped by the controller on gang replacement;
     # keys the readiness drops below so generations never cross-talk.
     gang_generation: int = 0
+    # Elastic plane: the gang's CURRENT width for this generation
+    # ($KCTPU_GANG_WIDTH, bumped in lockstep with the generation on every
+    # re-shard transition; falls back to num_processes).  This — never
+    # spec.replicas — is what workloads shard data by: the `kctpu vet`
+    # rule gang-width-env enforces the contract.
+    gang_width: int = 0
     data_dir: str = ""
     model_dir: str = ""
     log_dir: str = ""
@@ -130,6 +137,8 @@ class JobRuntime:
             num_slices=int(e.get(ENV_NUM_SLICES, "1") or "1"),
             slice_id=int(e.get(ENV_SLICE_ID, "0") or "0"),
             gang_generation=int(e.get(ENV_GANG_GENERATION, "0") or "0"),
+            gang_width=(int(e.get(ENV_GANG_WIDTH, "0") or "0")
+                        or int(e.get(ENV_NUM_PROCESSES, "1") or "1")),
             data_dir=e.get("DATA_DIR", ""),
             model_dir=e.get("MODEL_DIR", ""),
             log_dir=e.get("LOG_DIR", ""),
@@ -149,6 +158,8 @@ class JobRuntime:
             return
         self.coordinator = self.coordinator or hosts[0]
         self.num_processes = len(hosts)
+        if self.gang_width <= 1:
+            self.gang_width = len(hosts)  # runtime width; never spec
         self.process_id = task_index
 
     def initialize(self) -> None:

@@ -1,0 +1,17 @@
+"""Fixture: workload deriving gang width from the SPEC — the exact bug
+the gang-width-env rule exists for.  An elastic gang's runtime width is a
+per-generation property (degrade/harvest/re-expand); spec.replicas is the
+FULL width and mis-shards the degraded gang.  Path contains 'workloads/'
+so the rule applies."""
+
+
+def shard_for(job, index):
+    # BAD: width from the job spec (the full width, not this
+    # generation's) — a degraded gang of 2 would shard as if it were 3.
+    width = job.spec.tf_replica_specs[0].replicas
+    return index * (4096 // width)
+
+
+def local_batch(spec, batch):
+    # BAD: bare replica-spec read.
+    return batch // spec.replicas

@@ -1,0 +1,17 @@
+"""Fixture: workload deriving gang width from the runtime env contract —
+what gang-width-env requires.  $KCTPU_GANG_WIDTH (JobRuntime.gang_width)
+is stamped per generation by the materializer, so data shards rebalance
+across elastic re-shard transitions automatically."""
+
+import os
+
+
+def shard_for(rt, index):
+    # GOOD: width from the per-generation runtime contract.
+    width = rt.gang_width or int(os.environ.get("KCTPU_GANG_WIDTH", "1"))
+    return index * (4096 // width)
+
+
+def local_batch(rt, batch):
+    # GOOD: the jax runtime's process count IS the runtime width.
+    return batch // max(1, rt.num_processes)

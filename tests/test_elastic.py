@@ -1,0 +1,790 @@
+"""Elastic plane: width as a runtime property of a gang — API validation,
+width-keyed planning/materialization, the transition engine
+(degrade/harvest/re-expand), the WidthHarvested restart exemption, the
+reshard stall hold, scheduler width harvesting, the gang-width-env vet
+rule, the controller e2e, and re-shard numerical continuity."""
+
+import os
+import time
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import (
+    PHASE_FAILED,
+    PHASE_PENDING,
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+    Container,
+    Pod,
+    PodProgress,
+    PodTemplateSpec,
+)
+from kubeflow_controller_tpu.api.labels import (
+    ANNOTATION_ELASTIC_MIN_SLICES,
+    ANNOTATION_ELASTIC_MIN_WIDTH,
+    ANNOTATION_GANG_GENERATION,
+    ANNOTATION_GANG_WIDTH,
+    LABEL_INDEX,
+    LABEL_JOB_TYPE,
+)
+from kubeflow_controller_tpu.api.meta import ObjectMeta
+from kubeflow_controller_tpu.api.tfjob import (
+    ElasticSpec,
+    ReplicaType,
+    TFJob,
+    TFJobConditionType,
+    TFJobPhase,
+    TFReplicaSpec,
+    TPUSpec,
+    ValidationError,
+    validate_tfjob,
+)
+from kubeflow_controller_tpu.elastic import (
+    KIND_DEGRADE,
+    KIND_EXPAND,
+    KIND_HARVEST,
+    ElasticEngine,
+    ElasticPolicy,
+)
+from kubeflow_controller_tpu.planner.materialize import (
+    ENV_GANG_WIDTH,
+    ENV_NUM_PROCESSES,
+    ENV_NUM_SLICES,
+    gang_width,
+    make_pod,
+)
+from kubeflow_controller_tpu.planner.plan import plan_job
+from kubeflow_controller_tpu.planner.types import Action
+from kubeflow_controller_tpu.recovery import RestartPolicyConfig, RestartTracker
+from kubeflow_controller_tpu.updater import compute_status
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def mk_elastic_job(name="ejob", n=3, min_width=2, gang=True,
+                   restart="OnFailure", runtime_id="rid"):
+    job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+    job.metadata.uid = f"uid-{name}"
+    job.spec.runtime_id = runtime_id
+    t = PodTemplateSpec()
+    t.spec.containers.append(Container(name="c", image="img"))
+    t.spec.restart_policy = restart
+    job.spec.elastic = ElasticSpec(min_width=min_width)
+    job.spec.tf_replica_specs = [TFReplicaSpec(
+        replicas=n, tf_replica_type=ReplicaType.WORKER, template=t,
+        gang_restart=gang)]
+    return job
+
+
+def mk_tpu_elastic_job(name="tjob", num_slices=2, min_width=2,
+                       runtime_id="rid"):
+    job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+    job.metadata.uid = f"uid-{name}"
+    job.spec.runtime_id = runtime_id
+    t = PodTemplateSpec()
+    t.spec.containers.append(Container(name="c", image="img"))
+    t.spec.restart_policy = "OnFailure"
+    job.spec.elastic = ElasticSpec(min_width=min_width)
+    job.spec.tf_replica_specs = [TFReplicaSpec(
+        replicas=2 * num_slices, tf_replica_type=ReplicaType.TPU, template=t,
+        tpu=TPUSpec(accelerator_type="v5e-8", num_hosts=2,
+                    num_slices=num_slices))]
+    return job
+
+
+def mk_member(name, index, phase=PHASE_RUNNING, gen=0, reason="",
+              typ="Worker", job="ejob", fit_step=None):
+    p = Pod(metadata=ObjectMeta(name=name, namespace="default"))
+    p.metadata.labels = {LABEL_JOB_TYPE: typ, LABEL_INDEX: str(index),
+                         "tf_job_name": job}
+    p.metadata.annotations = {ANNOTATION_GANG_GENERATION: str(gen)}
+    p.status.phase = phase
+    p.status.reason = reason
+    if fit_step is not None:
+        p.status.progress = PodProgress(step=fit_step, phase="fit",
+                                        timestamp=time.time())
+    return p
+
+
+def set_width(job, width, gen):
+    job.metadata.annotations[ANNOTATION_GANG_WIDTH] = str(width)
+    job.metadata.annotations[ANNOTATION_GANG_GENERATION] = str(gen)
+
+
+# ---------------------------------------------------------------------------
+# API validation + width keying
+# ---------------------------------------------------------------------------
+
+class TestElasticSpecValidation:
+    def test_valid_elastic_worker_gang(self):
+        validate_tfjob(mk_elastic_job())
+
+    def test_min_width_above_spec_rejected(self):
+        with pytest.raises(ValidationError, match="minWidth"):
+            validate_tfjob(mk_elastic_job(n=3, min_width=4))
+
+    def test_min_width_zero_rejected(self):
+        with pytest.raises(ValidationError, match="minWidth"):
+            validate_tfjob(mk_elastic_job(min_width=0))
+
+    def test_elastic_requires_a_gang_spec(self):
+        job = mk_elastic_job(gang=False)
+        with pytest.raises(ValidationError, match="gang replica set"):
+            validate_tfjob(job)
+
+    def test_tpu_min_width_must_be_slice_granular(self):
+        job = mk_tpu_elastic_job(num_slices=2, min_width=3)
+        with pytest.raises(ValidationError, match="slice host count"):
+            validate_tfjob(job)
+
+    def test_tpu_slice_granular_floor_ok(self):
+        validate_tfjob(mk_tpu_elastic_job(num_slices=2, min_width=2))
+
+    def test_max_width_out_of_range_rejected(self):
+        job = mk_elastic_job(n=3, min_width=2)
+        job.spec.elastic.max_width = 5
+        with pytest.raises(ValidationError, match="maxWidth"):
+            validate_tfjob(job)
+
+
+class TestGangWidth:
+    def test_defaults_to_spec_width(self):
+        job = mk_elastic_job(n=3)
+        assert gang_width(job, job.spec.tf_replica_specs[0]) == 3
+
+    def test_annotation_overrides_and_clamps(self):
+        job = mk_elastic_job(n=3, min_width=2)
+        spec = job.spec.tf_replica_specs[0]
+        set_width(job, 2, 1)
+        assert gang_width(job, spec) == 2
+        set_width(job, 1, 2)  # below the floor: clamped up
+        assert gang_width(job, spec) == 2
+        set_width(job, 9, 3)  # above spec: clamped down
+        assert gang_width(job, spec) == 3
+
+    def test_non_elastic_spec_ignores_annotation(self):
+        job = mk_elastic_job(n=3)
+        job.spec.elastic = None
+        set_width(job, 2, 1)
+        assert gang_width(job, job.spec.tf_replica_specs[0]) == 3
+
+    def test_worker_pods_materialize_at_current_width(self):
+        job = mk_elastic_job(n=3, min_width=2)
+        spec = job.spec.tf_replica_specs[0]
+        set_width(job, 2, 1)
+        pod = make_pod(job, spec, 0)
+        env = {e.name: e.value for e in pod.spec.containers[0].env}
+        assert env[ENV_NUM_PROCESSES] == "2"
+        assert env[ENV_GANG_WIDTH] == "2"
+        assert pod.metadata.annotations[ANNOTATION_GANG_WIDTH] == "2"
+        assert pod.metadata.annotations[ANNOTATION_ELASTIC_MIN_WIDTH] == "2"
+
+    def test_tpu_pods_follow_width_slice_granularly(self):
+        job = mk_tpu_elastic_job(num_slices=2, min_width=2)  # width 4
+        spec = job.spec.tf_replica_specs[0]
+        set_width(job, 2, 1)  # degraded to one slice
+        pod = make_pod(job, spec, 0)
+        env = {e.name: e.value for e in pod.spec.containers[0].env}
+        assert env[ENV_NUM_PROCESSES] == "2"
+        assert env[ENV_NUM_SLICES] == "1"
+        assert env[ENV_GANG_WIDTH] == "2"
+        assert pod.metadata.annotations[ANNOTATION_ELASTIC_MIN_SLICES] == "1"
+
+
+# ---------------------------------------------------------------------------
+# Planner: stale-generation re-shard
+# ---------------------------------------------------------------------------
+
+class _StubDecision:
+    def __init__(self, action):
+        self.action = action
+
+
+class _StubRecovery:
+    def __init__(self, decisions):
+        self._d = decisions
+
+    def decision_for(self, typ, index):
+        a = self._d.get(index)
+        return _StubDecision(a) if a else None
+
+
+class TestPlannerReshard:
+    def _pods(self, job, n=3, gen=0, failed=()):
+        return {ReplicaType.WORKER: [
+            mk_member(f"p{i}", i, gen=gen,
+                      phase=PHASE_FAILED if i in failed else PHASE_RUNNING)
+            for i in range(n)]}
+
+    def test_stale_generation_replaces_at_current_width(self):
+        job = mk_elastic_job(n=3, min_width=2)
+        set_width(job, 2, 1)  # transition applied; pods still at gen 0
+        plan = plan_job(job, self._pods(job, n=3, gen=0, failed=(1,)), {})
+        deletes = [e for e in plan.events if e.action == Action.DELETE_POD]
+        adds = [e for e in plan.events if e.action == Action.ADD_POD]
+        assert len(deletes) == 3  # every record, survivors included
+        assert all(e.reason == "reshard" for e in deletes + adds)
+        assert sorted(e.index for e in adds) == [0, 1]  # the new width
+
+    def test_reshard_ignores_backoff_verdicts(self):
+        job = mk_elastic_job(n=3, min_width=2)
+        set_width(job, 2, 1)
+        plan = plan_job(job, self._pods(job, n=3, gen=0, failed=(1,)), {},
+                        recovery=_StubRecovery({1: "backoff"}))
+        adds = [e for e in plan.events if e.action == Action.ADD_POD]
+        assert sorted(e.index for e in adds) == [0, 1]
+
+    def test_exhausted_budget_blocks_the_reshard(self):
+        job = mk_elastic_job(n=3, min_width=2)
+        set_width(job, 2, 1)
+        plan = plan_job(job, self._pods(job, n=3, gen=0, failed=(1,)), {},
+                        recovery=_StubRecovery({1: "exhausted"}))
+        assert not [e for e in plan.events
+                    if e.action in (Action.ADD_POD, Action.DELETE_POD)]
+
+    def test_same_generation_healthy_gang_is_left_alone(self):
+        job = mk_elastic_job(n=3)
+        plan = plan_job(job, self._pods(job, n=3, gen=0), {})
+        assert not [e for e in plan.events if e.action == Action.ADD_POD]
+
+
+# ---------------------------------------------------------------------------
+# The transition engine
+# ---------------------------------------------------------------------------
+
+class TestElasticEngine:
+    def test_member_death_degrades_to_survivor_width(self):
+        eng = ElasticEngine(ElasticPolicy(warmup_s=5.0))
+        job = mk_elastic_job(n=3, min_width=2)
+        pods = {ReplicaType.WORKER: [
+            mk_member("a", 0), mk_member("b", 1),
+            mk_member("c", 2, phase=PHASE_FAILED, reason="Error: exit -9")]}
+        a = eng.assess("default/ejob", job, pods, None, now=100.0)
+        assert a.transition is not None
+        assert a.transition.kind == KIND_DEGRADE
+        assert (a.transition.from_width, a.transition.to_width) == (3, 2)
+        assert a.requeue_after_s == 5.0  # the warm-up hold
+
+    def test_floor_crossing_defers_to_whole_gang_recovery(self):
+        eng = ElasticEngine()
+        job = mk_elastic_job(n=3, min_width=2)
+        set_width(job, 2, 1)
+        pods = {ReplicaType.WORKER: [
+            mk_member("a", 0, gen=1),
+            mk_member("b", 1, gen=1, phase=PHASE_FAILED, reason="Error")]}
+        a = eng.assess("default/ejob", job, pods, None, now=100.0)
+        assert a.transition is None  # 2-1 < min_width: recovery owns it
+
+    def test_harvested_reason_yields_harvest_kind(self):
+        eng = ElasticEngine()
+        job = mk_elastic_job(n=3, min_width=2)
+        pods = {ReplicaType.WORKER: [
+            mk_member("a", 0), mk_member("b", 1),
+            mk_member("c", 2, phase=PHASE_FAILED,
+                      reason="WidthHarvested: 1 slice(s) for gang hi")]}
+        a = eng.assess("default/ejob", job, pods, None, now=100.0)
+        assert a.transition.kind == KIND_HARVEST
+
+    def test_stale_generation_corpses_do_not_re_shrink(self):
+        eng = ElasticEngine()
+        job = mk_elastic_job(n=3, min_width=2)
+        set_width(job, 2, 1)  # degrade already applied
+        pods = {ReplicaType.WORKER: [
+            mk_member("a", 0, gen=0, phase=PHASE_FAILED, reason="Error")]}
+        a = eng.assess("default/ejob", job, pods, None, now=100.0)
+        assert a.transition is None
+
+    def test_expand_waits_out_warmup_then_fires(self):
+        eng = ElasticEngine(ElasticPolicy(warmup_s=2.0))
+        job = mk_elastic_job(n=3, min_width=2)
+        pods = {ReplicaType.WORKER: [
+            mk_member("a", 0), mk_member("b", 1),
+            mk_member("c", 2, phase=PHASE_FAILED, reason="Error")]}
+        assert eng.assess("k", job, pods, None, now=100.0).transition is not None
+        set_width(job, 2, 1)  # the degrade was applied
+        degraded = {ReplicaType.WORKER: [
+            mk_member("d", 0, gen=1, fit_step=41),
+            mk_member("e", 1, gen=1, fit_step=41)]}
+        mid = eng.assess("k", job, degraded, None, now=101.0)
+        assert mid.transition is None  # hold still open
+        assert mid.requeue_after_s == pytest.approx(1.0, abs=0.01)
+        done = eng.assess("k", job, degraded, None, now=102.5)
+        assert done.transition is not None
+        assert done.transition.kind == KIND_EXPAND
+        assert done.transition.to_width == 3
+        assert done.transition.complete
+
+    def test_expand_requires_the_whole_degraded_gang_running(self):
+        eng = ElasticEngine(ElasticPolicy(warmup_s=0.0, min_degraded_s=0.0))
+        job = mk_elastic_job(n=3, min_width=2)
+        set_width(job, 2, 1)
+        half = {ReplicaType.WORKER: [mk_member("d", 0, gen=1)]}
+        assert eng.assess("k", job, half, None, now=100.0).transition is None
+
+    def test_tpu_expand_gated_on_free_slices(self):
+        class Inv:
+            def __init__(self, free):
+                self.free = free
+
+            def free_slice_count(self, accel=""):
+                return self.free
+
+        eng = ElasticEngine(ElasticPolicy(warmup_s=0.0, min_degraded_s=0.0,
+                                          capacity_poll_s=0.5))
+        job = mk_tpu_elastic_job(num_slices=2, min_width=2)  # width 4
+        set_width(job, 2, 1)
+        degraded = {ReplicaType.TPU: [
+            mk_member("d", 0, gen=1, typ="TPU", fit_step=41),
+            mk_member("e", 1, gen=1, typ="TPU", fit_step=41)]}
+        short = eng.assess("k", job, degraded, None, now=100.0,
+                           inventory=Inv(0))
+        assert short.transition is None
+        assert short.requeue_after_s == 0.5  # capacity poll
+        ok = eng.assess("k", job, degraded, None, now=100.0,
+                        inventory=Inv(1))
+        assert ok.transition is not None
+        assert ok.transition.to_width == 4
+
+    def test_non_elastic_job_returns_none(self):
+        eng = ElasticEngine()
+        job = mk_elastic_job()
+        job.spec.elastic = None
+        assert eng.assess("k", job, {}, None, now=0.0) is None
+
+
+# ---------------------------------------------------------------------------
+# Restart accounting exemption + reshard stall hold
+# ---------------------------------------------------------------------------
+
+class TestHarvestedExemption:
+    def test_width_harvested_failures_are_not_restarts(self):
+        tr = RestartTracker(RestartPolicyConfig(jitter=0.0))
+        job = mk_elastic_job(n=2)
+        pods = {ReplicaType.WORKER: [
+            mk_member("h", 0, phase=PHASE_FAILED,
+                      reason="WidthHarvested: 1 slice(s) for gang hi"),
+            mk_member("x", 1, phase=PHASE_FAILED, reason="Error: exit 1")]}
+        a = tr.assess("default/ejob", job, pods, 0.0)
+        assert a.restarts_for(ReplicaType.WORKER) == 1  # only the crash
+        assert (ReplicaType.WORKER, 0) not in a.decisions
+
+
+class TestReshardStallHold:
+    def test_reshard_phase_holds_frozen_step_deadline(self):
+        from kubeflow_controller_tpu.checker import StallPolicy, StallTracker
+
+        trk = StallTracker(StallPolicy(heartbeat_deadline_s=0.0,
+                                       step_deadline_s=10.0))
+        t0 = 1000.0
+        assert not trk.observe("k", PodProgress(step=50, timestamp=t0), now=t0)
+        # A width transition: the step counter freezes in phase="reshard"
+        # far past the deadline — held, not stalled.
+        assert not trk.observe(
+            "k", PodProgress(step=50, phase="reshard", timestamp=t0 + 30),
+            now=t0 + 30)
+        assert not trk.observe(
+            "k", PodProgress(step=50, phase="reshard", timestamp=t0 + 45),
+            now=t0 + 45)
+        # Training resumes, then freezes WITHOUT the phase: real stall.
+        assert not trk.observe(
+            "k", PodProgress(step=51, phase="fit", timestamp=t0 + 46),
+            now=t0 + 46)
+        assert trk.observe(
+            "k", PodProgress(step=51, phase="fit", timestamp=t0 + 60),
+            now=t0 + 60)
+
+
+# ---------------------------------------------------------------------------
+# Status surface: width rollup + Degraded condition
+# ---------------------------------------------------------------------------
+
+class TestWidthStatus:
+    def _cond(self, st, typ):
+        return next((c for c in st.conditions if c.type == typ), None)
+
+    def test_degraded_condition_while_width_reduced(self):
+        job = mk_elastic_job(n=3, min_width=2)
+        set_width(job, 2, 1)
+        pods = {ReplicaType.WORKER: [mk_member("a", 0, gen=1),
+                                     mk_member("b", 1, gen=1)]}
+        st = compute_status(job, pods)
+        assert st.width is not None
+        assert (st.width.current, st.width.spec, st.width.min) == (2, 3, 2)
+        c = self._cond(st, TFJobConditionType.DEGRADED)
+        assert c.status == "True" and c.reason == "WidthReduced"
+        # Degraded-but-whole: Scheduled/Ready measure the CURRENT width.
+        assert self._cond(st, TFJobConditionType.SCHEDULED).status == "True"
+        assert self._cond(st, TFJobConditionType.READY).status == "True"
+
+    def test_full_width_clears_the_condition(self):
+        job = mk_elastic_job(n=3, min_width=2)
+        pods = {ReplicaType.WORKER: [mk_member(f"p{i}", i)
+                                     for i in range(3)]}
+        st = compute_status(job, pods)
+        assert (st.width.current, st.width.spec) == (3, 3)
+        c = self._cond(st, TFJobConditionType.DEGRADED)
+        assert c.status == "False" and c.reason == "FullWidth"
+
+    def test_non_elastic_jobs_carry_no_width_surface(self):
+        job = mk_elastic_job(n=3)
+        job.spec.elastic = None
+        pods = {ReplicaType.WORKER: [mk_member(f"p{i}", i)
+                                     for i in range(3)]}
+        st = compute_status(job, pods)
+        assert st.width is None
+        assert self._cond(st, TFJobConditionType.DEGRADED) is None
+
+    def test_degraded_gang_succeeds_at_current_width(self):
+        job = mk_elastic_job(n=3, min_width=2)
+        set_width(job, 2, 1)
+        pods = {ReplicaType.WORKER: [
+            mk_member("a", 0, gen=1, phase=PHASE_SUCCEEDED),
+            mk_member("b", 1, gen=1, phase=PHASE_SUCCEEDED)]}
+        st = compute_status(job, pods)
+        assert st.phase == TFJobPhase.SUCCEEDED
+
+
+# ---------------------------------------------------------------------------
+# Scheduler width harvesting + inventory growth
+# ---------------------------------------------------------------------------
+
+class TestSchedulerHarvest:
+    def _rig(self, n_slices=4):
+        from kubeflow_controller_tpu.cluster import TPUInventory, TPUSlice
+        from kubeflow_controller_tpu.scheduler import (
+            GangScheduler,
+            SchedulerPolicy,
+        )
+
+        inv = TPUInventory([TPUSlice(f"s{i}", "v5e-8", num_hosts=2)
+                            for i in range(n_slices)])
+        sched = GangScheduler(inv, SchedulerPolicy())
+        evictions = []
+        sched.set_evictor(lambda keys, reason: evictions.append(
+            (sorted(keys), reason)))
+        return inv, sched, evictions
+
+    def _admit(self, sched, job, n):
+        pods = [make_pod(job, job.spec.tf_replica_specs[0], i)
+                for i in range(n)]
+        for i, p in enumerate(pods):
+            p.metadata.name = f"{job.metadata.name}-{i}"
+        results = [sched.offer(p) for p in pods]
+        sched.pod_started(pods[0])
+        results = [sched.offer(p) for p in pods]
+        return pods, results
+
+    def _preempt_count(self):
+        from kubeflow_controller_tpu.obs.metrics import REGISTRY
+
+        c = REGISTRY.counter("kctpu_sched_preemptions_total", "",
+                             ("priority_class",))
+        with c._lock:
+            return sum(c._values.values())
+
+    def test_blocked_high_gang_harvests_instead_of_preempting(self):
+        inv, sched, evictions = self._rig()
+        low = mk_tpu_elastic_job("low", num_slices=4, min_width=4)
+        low.spec.priority_class_name = "low"
+        self._admit(sched, low, 8)
+        gang_low = "low-rid"
+        assert len(sched.gang_slices(gang_low)) == 4
+        before = self._preempt_count()
+
+        high = mk_tpu_elastic_job("high", num_slices=2, min_width=2)
+        high.spec.elastic = None
+        high.spec.priority_class_name = "high"
+        _, results = self._admit(sched, high, 4)
+        assert any(results)  # the high gang was admitted
+        assert len(sched.gang_slices("high-rid")) == 2
+        # The victim lost exactly its surplus: down to the floor of 2.
+        assert len(sched.gang_slices(gang_low)) == 2
+        # Only the pods on the harvested slices were failed, with the
+        # WidthHarvested reason — zero whole-gang preemptions.
+        assert len(evictions) == 1
+        keys, reason = evictions[0]
+        assert reason.startswith("WidthHarvested")
+        assert len(keys) == 4  # 2 slices x 2 hosts
+        assert self._preempt_count() == before
+
+    def test_non_elastic_victim_is_still_preempted_whole(self):
+        inv, sched, evictions = self._rig(n_slices=2)
+        low = mk_tpu_elastic_job("plain", num_slices=2, min_width=2)
+        low.spec.elastic = None
+        low.spec.priority_class_name = "low"
+        self._admit(sched, low, 4)
+        before = self._preempt_count()
+        high = mk_tpu_elastic_job("urgent", num_slices=2, min_width=2)
+        high.spec.elastic = None
+        high.spec.priority_class_name = "high"
+        self._admit(sched, high, 4)
+        assert self._preempt_count() == before + 1
+        assert any(r.startswith("Preempted") for _, r in evictions)
+
+    def test_release_slices_keeps_the_coordinator_slice(self):
+        inv, sched, _ = self._rig()
+        low = mk_tpu_elastic_job("low2", num_slices=4, min_width=2)
+        self._admit(sched, low, 8)
+        slices = sched.gang_slices("low2-rid")
+        released = inv.release_slices("low2-rid", 99)  # over-ask clamps
+        assert sched.gang_slices("low2-rid") == slices[:1]
+        assert sorted(released) == sorted(slices[1:])
+
+    def test_grow_gang_binds_freed_capacity_back(self):
+        inv, sched, _ = self._rig()
+        low = mk_tpu_elastic_job("low3", num_slices=4, min_width=2)
+        self._admit(sched, low, 8)
+        inv.release_slices("low3-rid", 2)
+        assert inv.free_slice_count("v5e-8") == 2
+        grown = sched.grow_gang("low3-rid", "v5e-8", 2)
+        assert grown is not None and len(grown) == 2
+        assert len(sched.gang_slices("low3-rid")) == 4
+        assert sched.free_slice_count("v5e-8") == 0
+
+
+# ---------------------------------------------------------------------------
+# vet: the gang-width-env rule
+# ---------------------------------------------------------------------------
+
+class TestGangWidthEnvRule:
+    FIXTURES = os.path.join(REPO_ROOT, "tests", "fixtures", "vet",
+                            "workloads")
+
+    def _vet(self, name):
+        from kubeflow_controller_tpu.analysis import vet
+
+        findings = vet.run([os.path.join(self.FIXTURES, name)],
+                           root=REPO_ROOT, skip_catalogue=True)
+        return findings, {f.rule for f in findings}
+
+    def test_bad_fixture_flagged(self):
+        findings, rules = self._vet("bad_widthenv.py")
+        assert rules == {"gang-width-env"}
+        assert len(findings) == 2  # the spec chain + the bare spec read
+        assert all("KCTPU_GANG_WIDTH" in f.message for f in findings)
+
+    def test_good_fixture_clean(self):
+        findings, _ = self._vet("good_widthenv.py")
+        assert findings == []
+
+    def test_rule_is_scoped_to_workloads(self):
+        # The planner legitimately reads spec.replicas — it is what turns
+        # spec width into runtime width.
+        from kubeflow_controller_tpu.analysis import vet
+
+        path = os.path.join(REPO_ROOT, "kubeflow_controller_tpu",
+                            "planner", "plan.py")
+        findings = vet.run([path], root=REPO_ROOT, skip_catalogue=True)
+        assert not [f for f in findings if f.rule == "gang-width-env"]
+
+
+# ---------------------------------------------------------------------------
+# Controller e2e: kill → degraded width → re-expand (simulated)
+# ---------------------------------------------------------------------------
+
+def wait_for(fn, timeout=20.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+@pytest.fixture
+def rig():
+    from kubeflow_controller_tpu.cluster import Cluster, FakeKubelet, PhasePolicy
+    from kubeflow_controller_tpu.controller import Controller
+
+    cluster = Cluster()
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=3.0,
+                                                      heartbeat_s=0.05))
+    ctrl = Controller(cluster, resync_period_s=0.5,
+                      restart_config=RestartPolicyConfig(
+                          initial_backoff_s=0.05, jitter=0.0),
+                      elastic_policy=ElasticPolicy(warmup_s=0.3,
+                                                   min_degraded_s=0.3))
+    kubelet.start()
+    ctrl.run(threadiness=2)
+    yield cluster, ctrl, kubelet
+    ctrl.stop()
+    kubelet.stop()
+
+
+class TestControllerElasticE2E:
+    def test_kill_degrade_reexpand_cycle(self, rig):
+        cluster, ctrl, kubelet = rig
+        job = mk_elastic_job("el", n=3, min_width=2, runtime_id="")
+        cluster.tfjobs.create(job)
+        wait_for(lambda: len([p for p in cluster.pods.list("default")
+                              if p.status.phase == PHASE_RUNNING]) == 3)
+        victim = sorted(cluster.pods.list("default"),
+                        key=lambda p: p.metadata.labels[LABEL_INDEX])[2]
+        kubelet.set_phase("default", victim.metadata.name, PHASE_FAILED,
+                          reason="Error: exit -9: killed")
+
+        # Degrade: width annotation 2, exactly 2 active members at gen 1,
+        # the Degraded condition and the GangDegraded event.
+        def degraded():
+            j = cluster.tfjobs.get("default", "el")
+            if j.metadata.annotations.get(ANNOTATION_GANG_WIDTH) != "2":
+                return None
+            live = [p for p in cluster.pods.list("default")
+                    if p.status.phase == PHASE_RUNNING]
+            return (len(live) == 2 and all(
+                p.metadata.annotations[ANNOTATION_GANG_GENERATION] == "1"
+                for p in live)) or None
+        wait_for(degraded)
+        j = cluster.tfjobs.get("default", "el")
+        assert j.status.width is not None
+        evs = {e.reason for e in ctrl.recorder.events_for("default", "el")}
+        assert "GangDegraded" in evs
+
+        # Re-expand after the warm-up hold: width back to 3, a THIRD
+        # generation of pods, the GangRestored event, Degraded=False.
+        def restored():
+            j = cluster.tfjobs.get("default", "el")
+            if j.metadata.annotations.get(ANNOTATION_GANG_WIDTH) != "3":
+                return None
+            live = [p for p in cluster.pods.list("default")
+                    if p.status.phase in (PHASE_RUNNING, PHASE_SUCCEEDED)]
+            return (len(live) == 3 and all(
+                p.metadata.annotations[ANNOTATION_GANG_GENERATION] == "2"
+                for p in live)) or None
+        wait_for(restored)
+        evs = {e.reason for e in ctrl.recorder.events_for("default", "el")}
+        assert "GangRestored" in evs
+        wait_for(lambda: cluster.tfjobs.get("default", "el").status.phase
+                 == TFJobPhase.SUCCEEDED, timeout=25.0)
+        j = cluster.tfjobs.get("default", "el")
+        cond = next(c for c in j.status.conditions
+                    if c.type == TFJobConditionType.DEGRADED)
+        assert cond.status == "False"
+
+    def test_floor_kill_falls_back_to_whole_gang_recovery(self, rig):
+        cluster, ctrl, kubelet = rig
+        job = mk_elastic_job("fl", n=2, min_width=2, runtime_id="")
+        cluster.tfjobs.create(job)
+        wait_for(lambda: len([p for p in cluster.pods.list("default")
+                              if p.status.phase == PHASE_RUNNING]) == 2)
+        before = {p.metadata.name for p in cluster.pods.list("default")}
+        victim = sorted(before)[0]
+        kubelet.set_phase("default", victim, PHASE_FAILED,
+                          reason="Error: exit -9")
+
+        # Whole-gang replacement at FULL width (no degrade possible).
+        def regenerated():
+            pods = [p for p in cluster.pods.list("default")
+                    if p.metadata.name not in before
+                    and p.status.phase == PHASE_RUNNING]
+            return len(pods) == 2 or None
+        wait_for(regenerated)
+        j = cluster.tfjobs.get("default", "fl")
+        assert j.metadata.annotations.get(ANNOTATION_GANG_WIDTH, "") in ("", "2")
+        evs = {e.reason for e in ctrl.recorder.events_for("default", "fl")}
+        assert "GangDegraded" not in evs
+
+
+# ---------------------------------------------------------------------------
+# Re-shard numerical continuity: degraded batch ≠ divergence
+# ---------------------------------------------------------------------------
+
+class TestReshardNumericalContinuity:
+    def _mk(self, bs):
+        import numpy as np
+
+        from kubeflow_controller_tpu.models import mnist as m
+        from kubeflow_controller_tpu.parallel import (
+            AXIS_DATA,
+            MeshSpec,
+            build_mesh,
+        )
+        from kubeflow_controller_tpu.workloads import data as d
+        from kubeflow_controller_tpu.workloads.trainer import (
+            default_optimizer,
+            global_batches,
+            make_dist_step,
+        )
+
+        mesh = build_mesh(MeshSpec(dp=-1, fsdp=1))
+        opt = default_optimizer(5e-3)
+        step = make_dist_step(lambda p, b: m.mlp_loss(p, b[0], b[1]), opt,
+                              mesh, AXIS_DATA, donate=False)
+        spe = 4
+        x, y = d.synthetic_mnist_np(1, 64)
+        idx = (np.arange(spe)[:, None] * bs
+               + np.arange(bs)[None, :]) % x.shape[0]
+        x_all, y_all = global_batches(
+            mesh, AXIS_DATA, (x[idx], y[idx].astype(np.int32)), bs)
+        return mesh, opt, step, x_all, y_all
+
+    def _fresh(self, mesh, opt):
+        from kubeflow_controller_tpu.models import mnist as m
+        from kubeflow_controller_tpu.workloads.trainer import (
+            numpy_opt_state,
+            replicate_pytree,
+        )
+
+        params = replicate_pytree(mesh, m.mlp_init(0))
+        opt_state = replicate_pytree(
+            mesh, numpy_opt_state(opt, m.mlp_init(0)))
+        return params, opt_state
+
+    def test_kill_degrade_expand_matches_uninterrupted_within_tolerance(
+            self, tmp_path):
+        """Kill at step S → degraded window (smaller global batch — the
+        re-shard analog a 1-device host can express) → re-expand must
+        track the uninterrupted run's loss trajectory within tolerance,
+        and each transition's lost steps stay ≤ the checkpoint
+        interval."""
+        from kubeflow_controller_tpu.workloads.checkpoint import (
+            CheckpointManager,
+        )
+        from kubeflow_controller_tpu.workloads.trainer import (
+            train_step_loop_dist,
+        )
+
+        steps, every, kill_at, expand_at = 30, 5, 12, 22
+        mesh, opt, step_full, x_f, y_f = self._mk(bs=16)
+        _, _, step_deg, x_d, y_d = self._mk(bs=8)
+
+        # Uninterrupted baseline at full width.
+        p0, s0 = self._fresh(mesh, opt)
+        _, _, base_loss = train_step_loop_dist(
+            step_full, p0, s0, x_f, y_f, steps)
+        base_loss = float(base_loss)
+
+        # Interrupted run: full → (kill) → degraded → (expand) → full.
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        mgr.write_width(2)
+        p, s = self._fresh(mesh, opt)
+        train_step_loop_dist(
+            step_full, p, s, x_f, y_f, kill_at, checkpoint_every=every,
+            checkpoint_fn=lambda n, a, b: mgr.save(n, a, b, wait=False))
+        mgr.wait()
+        # Degrade: restore the latest checkpoint, re-shard marker flips.
+        p, s = self._fresh(mesh, opt)
+        p, s, start = mgr.restore(p, s)
+        assert kill_at - start <= every  # lost ≤ interval (transition 1)
+        assert mgr.read_width() == 2
+        mgr.write_width(1)
+        train_step_loop_dist(
+            step_deg, p, s, x_d, y_d, expand_at, start_step=start,
+            checkpoint_every=every,
+            checkpoint_fn=lambda n, a, b: mgr.save(n, a, b, wait=False))
+        mgr.wait()
+        # Expand: resume the degraded run's checkpoint at full width —
+        # never restore-from-scratch.
+        p, s = self._fresh(mesh, opt)
+        p, s, start2 = mgr.restore(p, s)
+        assert start2 > start  # degraded training really progressed
+        assert expand_at - start2 <= every  # lost ≤ interval (transition 2)
+        _, _, loss = train_step_loop_dist(
+            step_full, p, s, x_f, y_f, steps, start_step=start2)
+        loss = float(loss)
+
+        # The re-sharded trajectory lands where the uninterrupted one
+        # does: converging, and within tolerance of the baseline.
+        assert loss < 1.0
+        assert abs(loss - base_loss) < 0.25
