@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all ci test test-fast lint typecheck cov cov-local bench dryrun validate vet race-smoke check-smoke metrics-smoke scale-smoke stall-smoke widejob-smoke churn-smoke store-smoke sched-smoke ttfs-smoke chaos-smoke elastic-smoke ha-smoke
+.PHONY: all ci test test-fast lint typecheck cov cov-local bench dryrun validate vet race-smoke check-smoke metrics-smoke scale-smoke scale10k-smoke stall-smoke widejob-smoke churn-smoke store-smoke sched-smoke ttfs-smoke chaos-smoke elastic-smoke ha-smoke
 
 all: lint vet test race-smoke check-smoke
 
@@ -15,7 +15,7 @@ all: lint vet test race-smoke check-smoke
 # included), then tier-1 under the runtime lock-order detector.  Run
 # without -j: the order is the diagnosis ladder (cheapest, most precise
 # signal first).
-ci: vet race-smoke check-smoke chaos-smoke elastic-smoke ha-smoke
+ci: vet race-smoke check-smoke chaos-smoke elastic-smoke ha-smoke scale10k-smoke
 	KCTPU_LOCKCHECK=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m "not slow"
 
 # Fast/slow split: `test-fast` (-m "not slow") is the quick signal — 214 of
@@ -129,6 +129,27 @@ scale-smoke:
 		print('scale-smoke ok:', d['value'], d['unit'], \
 		      '| syncs/sec', d['details']['syncs_per_sec'], \
 		      '| index hit rate', d['details']['index_hit_rate'])"
+
+# Scale-envelope smoke (the 10k-job / 50k-pod gate, docs/PERF.md "Scale
+# envelope"): the full 10000-job simulated cluster on the event-driven
+# SimKubelet — 1 PS + 4 workers per job, 50k pods, one timer-wheel thread.
+# Gates: time-to-all-Succeeded under a relaxed container-friendly
+# wall-clock bound (measured ~106 s, SCALE_r01.json; 480 s flags an
+# order-of-magnitude regression, not scheduler noise) and peak process
+# thread count <= 32 (simulated mode must stay O(1) threads in pod count
+# — the threaded kubelet would need ~50k).  ~2-4 min wall-clock.
+scale10k-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --scale 10000 --simulated \
+		--pods-per-job 5 --deadline 540 --max-seconds 480 \
+		--max-threads 32 > /tmp/kctpu_scale10k_smoke.json
+	@$(PY) -c "import json; d = json.load(open('/tmp/kctpu_scale10k_smoke.json')); \
+		assert {'metric', 'value', 'unit', 'details'} <= set(d), d; \
+		print('scale10k-smoke ok:', d['value'], d['unit'], \
+		      '| pods', d['details']['pods_total'], \
+		      '| peak threads', d['details']['peak_threads'], \
+		      '| rss', d['details']['rss_mib'], 'MiB', \
+		      '| p99', d['details']['reconcile_p99_ms'], 'ms', \
+		      '| syncs/sec', d['details']['syncs_per_sec'])"
 
 # Wide-job smoke: ONE TFJob with 64 Worker replicas over the pooled REST
 # transport + slow-start batched manage, 5 ms injected RTT (loopback hides

@@ -222,11 +222,22 @@ def run_dist_mnist(trace_dir: str = "") -> dict:
 def run_scale(n_jobs: int, deadline_s: float = 0.0,
               settle_s: float = 2.5, heartbeat_s: float = 0.0,
               store_sharded: bool = True,
-              record_history: bool = False) -> dict:
-    """N concurrent orchestration-bound TFJobs (1 PS + 2 workers each,
-    simulated pod phases) from creation to all-Succeeded.  Uses only the
-    public controller surface so the same file measures older commits;
-    index-hit-rate fields degrade to 0 where the counters don't exist.
+              record_history: bool = False,
+              simulated: bool = False,
+              pods_per_job: int = 3,
+              threadiness: int = 0) -> dict:
+    """N concurrent orchestration-bound TFJobs (1 PS + ``pods_per_job - 1``
+    workers each, simulated pod phases) from creation to all-Succeeded.
+    Uses only the public controller surface so the same file measures older
+    commits; index-hit-rate fields degrade to 0 where the counters don't
+    exist.
+
+    ``simulated=True`` swaps the thread-per-pod FakeKubelet for the
+    event-driven SimKubelet (cluster/simkubelet.py): one timer-wheel
+    thread drives every pod, which is what makes ``--scale 10000`` (50k
+    pods at ``--pods-per-job 5``) runnable at all — ~50k threads
+    otherwise.  The run also reports peak thread count and steady-state
+    RSS, the scale-envelope gates (docs/PERF.md "Scale envelope").
 
     ``heartbeat_s`` > 0 turns on simulated training heartbeats at that
     interval (the progress plane): each beat is a pod-status write that
@@ -245,6 +256,8 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
     model — docs/ANALYSIS.md).  Comparing against a default run measures
     the recording overhead; with the flag OFF the hook costs nothing,
     which is the bench gate the hook ships under."""
+    import threading as _threading
+
     from kubeflow_controller_tpu.api.core import Container, PodTemplateSpec
     from kubeflow_controller_tpu.api.meta import ObjectMeta
     from kubeflow_controller_tpu.api.tfjob import (
@@ -253,19 +266,37 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
         TFJobPhase,
         TFReplicaSpec,
     )
-    from kubeflow_controller_tpu.cluster import Cluster, FakeKubelet, PhasePolicy
+    from kubeflow_controller_tpu.cluster import (
+        Cluster,
+        FakeKubelet,
+        PhasePolicy,
+        SimKubelet,
+    )
     from kubeflow_controller_tpu.cluster.store import ObjectStore
     from kubeflow_controller_tpu.controller import Controller
 
+    workers_per_job = max(1, pods_per_job - 1)
+
     def mk_sim_job(name: str) -> TFJob:
         job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
-        for typ, n in ((ReplicaType.PS, 1), (ReplicaType.WORKER, 2)):
+        for typ, n in ((ReplicaType.PS, 1),
+                       (ReplicaType.WORKER, workers_per_job)):
             t = PodTemplateSpec()
             t.spec.containers.append(Container(name="tensorflow", image="img"))
             t.spec.restart_policy = "OnFailure"
             job.spec.tf_replica_specs.append(
                 TFReplicaSpec(replicas=n, tf_replica_type=typ, template=t))
         return job
+
+    def rss_mib() -> float:
+        try:
+            with open("/proc/self/status") as fh:
+                for line in fh:
+                    if line.startswith("VmRSS:"):
+                        return round(int(line.split()[1]) / 1024.0, 1)
+        except OSError:
+            pass
+        return 0.0
 
     cluster = Cluster(store=ObjectStore(sharded=store_sharded))
     recorder = None
@@ -274,39 +305,65 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
 
         recorder = HistoryRecorder()
         cluster.store.attach_recorder(recorder)
-    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=0.05,
-                                                      heartbeat_s=heartbeat_s))
+    policy = PhasePolicy(run_s=0.05, heartbeat_s=heartbeat_s)
+    kubelet = (SimKubelet(cluster, policy=policy) if simulated
+               else FakeKubelet(cluster, policy=policy))
     ctrl = Controller(cluster, resync_period_s=1.0)
     kubelet.start()
-    ctrl.run(threadiness=2)
+    if not threadiness:
+        threadiness = 4 if n_jobs >= 1000 else 2
+    ctrl.run(threadiness=threadiness)
     if not deadline_s:
         deadline_s = max(120.0, 5.0 * n_jobs)
-    names = [f"scale-{i:04d}" for i in range(n_jobs)]
+    names = [f"scale-{i:05d}" for i in range(n_jobs)]
     try:
+        # Watch-based completion tracking: polling the collection would
+        # deep-copy every job object per poll — O(n) per tick is itself a
+        # scale bottleneck at 10k jobs.  The stream shares store snapshots
+        # zero-copy; a (rare) non-resumable gap falls back to one LIST.
+        done_watch = cluster.store.watch("tfjobs", namespace="default")
         t0 = time.time()
         for n in names:
             cluster.tfjobs.create(mk_sim_job(n))
         pending = set(names)
         failed = []
+        peak_threads = _threading.active_count()
+        seen_gaps = done_watch.gaps
+
+        def note_terminal(job) -> None:
+            name = job.metadata.name
+            if name not in pending:
+                return
+            if job.status.phase == TFJobPhase.SUCCEEDED:
+                pending.discard(name)
+            elif job.status.phase == TFJobPhase.FAILED:
+                pending.discard(name)
+                failed.append(name)
+
         while pending and time.time() < t0 + deadline_s:
-            for j in cluster.tfjobs.list("default"):
-                if j.metadata.name not in pending:
-                    continue
-                if j.status.phase == TFJobPhase.SUCCEEDED:
-                    pending.discard(j.metadata.name)
-                elif j.status.phase == TFJobPhase.FAILED:
-                    pending.discard(j.metadata.name)
-                    failed.append(j.metadata.name)
-            if pending:
-                time.sleep(0.05)
+            for ev in done_watch.next_batch(max_n=1024, timeout=0.2):
+                if ev.type in ("ADDED", "MODIFIED"):
+                    note_terminal(ev.object)
+            if done_watch.gaps != seen_gaps:
+                seen_gaps = done_watch.gaps
+                for j in cluster.tfjobs.list("default"):
+                    note_terminal(j)
+            peak_threads = max(peak_threads, _threading.active_count())
+        done_watch.stop()
         elapsed = time.time() - t0
+        rss_done_mib = rss_mib()
         # Steady-state probe: every job terminal, nothing should be doing
         # full-namespace LISTs anymore — resyncs of settled jobs are
         # skipped, and any sync that does run reads the indices.
         snap_settle0 = ctrl.metrics.snapshot()
         time.sleep(settle_s)
         snap = ctrl.metrics.snapshot()
+        peak_threads = max(peak_threads, _threading.active_count())
         lock_stats = cluster.store.lock_wait_stats()
+        rollup = {"hits": getattr(getattr(ctrl, "rollup_cache", None),
+                                  "hits", 0),
+                  "misses": getattr(getattr(ctrl, "rollup_cache", None),
+                                    "misses", 0)}
     finally:
         ctrl.stop()
         kubelet.stop()
@@ -324,6 +381,13 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
     return {
         "elapsed_s": elapsed,
         "jobs": n_jobs,
+        "pods_per_job": pods_per_job,
+        "pods_total": n_jobs * pods_per_job,
+        "simulated": simulated,
+        "threadiness": threadiness,
+        "peak_threads": peak_threads,
+        "rss_mib": rss_done_mib,
+        "rollup_cache": rollup,
         "history": history,
         "timed_out": sorted(pending),
         "failed": failed,
@@ -2392,7 +2456,9 @@ def scale_main(args) -> int:
     result = run_scale(args.scale, deadline_s=args.deadline,
                        heartbeat_s=args.heartbeat_s,
                        store_sharded=not args.no_shard,
-                       record_history=args.record_history)
+                       record_history=args.record_history,
+                       simulated=args.simulated,
+                       pods_per_job=args.pods_per_job)
     m = result["metrics"]
     elapsed = result["elapsed_s"]
     gathers = m.get("gather_indexed", 0) + m.get("gather_full_lists", 0)
@@ -2402,8 +2468,17 @@ def scale_main(args) -> int:
         "unit": "s",
         "details": {
             "jobs": result["jobs"],
-            "timed_out": result["timed_out"],
-            "failed": result["failed"],
+            "pods_per_job": result["pods_per_job"],
+            "pods_total": result["pods_total"],
+            "simulated": result["simulated"],
+            "threadiness": result["threadiness"],
+            "peak_threads": result["peak_threads"],
+            "rss_mib": result["rss_mib"],
+            "rollup_cache": result["rollup_cache"],
+            "timed_out": result["timed_out"][:20],
+            "timed_out_count": len(result["timed_out"]),
+            "failed": result["failed"][:20],
+            "failed_count": len(result["failed"]),
             "syncs": m["syncs"],
             "sync_errors": m["sync_errors"],
             "syncs_per_sec": round(m["syncs"] / elapsed, 1) if elapsed else 0.0,
@@ -2444,6 +2519,12 @@ def scale_main(args) -> int:
     if args.max_seconds and elapsed > args.max_seconds:
         print(f"scale bench regression: {elapsed:.3f}s > "
               f"--max-seconds {args.max_seconds}", file=sys.stderr)
+        return 1
+    if args.max_threads and result["peak_threads"] > args.max_threads:
+        print(f"scale bench regression: peak thread count "
+              f"{result['peak_threads']} > --max-threads {args.max_threads} "
+              f"(simulated mode must be O(1) threads in pod count)",
+              file=sys.stderr)
         return 1
     return 0
 
@@ -2552,8 +2633,19 @@ def main(argv=None) -> int:
     p.add_argument("--checkpoint-every", type=int, default=40, metavar="N",
                    help="chaos mode: spec.checkpoint_every_steps for the "
                         "jobs (the lost-steps bound)")
+    p.add_argument("--pods-per-job", type=int, default=3, metavar="P",
+                   help="scale mode: pods per job (1 PS + P-1 workers; "
+                        "default 3 — 10000 jobs x 5 = the 50k-pod "
+                        "envelope run)")
+    p.add_argument("--max-threads", type=int, default=0, metavar="N",
+                   help="scale mode: exit nonzero when the process' peak "
+                        "thread count exceeds N (the simulated-mode O(1)-"
+                        "threads gate; 0 = no gate)")
     p.add_argument("--simulated", action="store_true",
-                   help="chaos mode: PhasePolicy-simulated pods instead of "
+                   help="scale mode: drive pods with the event-driven "
+                        "SimKubelet (one timer-wheel thread for every pod) "
+                        "instead of the thread-per-pod FakeKubelet; "
+                        "chaos mode: PhasePolicy-simulated pods instead of "
                         "real training (orchestration-only chaos at scale; "
                         "no lost-steps accounting)")
     p.add_argument("--max-recovery-p99", type=float, default=0.0,

@@ -475,6 +475,47 @@ class ThreadHygieneRule(Rule):
                     f"wedge interpreter shutdown")
 
 
+class SimThreadPerObjectRule(Rule):
+    name = "sim-thread-per-object"
+    doc = ("simulated-path modules (cluster/sim*.py) never spawn a "
+           "threading.Thread outside __init__/start: the event-driven "
+           "kubelet exists to hold thread count O(1) in pod count, and a "
+           "Thread constructed per pod/event regresses straight back to "
+           "the 50k-thread cluster the scale envelope removed")
+
+    #: Methods where a (fixed, per-component) thread is legitimate.
+    _ALLOWED_FUNCS = frozenset({"__init__", "start"})
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        p = ctx.path.replace(os.sep, "/")
+        base = os.path.basename(p)
+        if "cluster/" not in p or "sim" not in base:
+            return  # scoped: the simulated node plane only
+        for func in ast.walk(ctx.tree):
+            if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if func.name in self._ALLOWED_FUNCS:
+                continue
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                is_thread = (
+                    (isinstance(fn, ast.Attribute) and fn.attr == "Thread"
+                     and isinstance(fn.value, ast.Name)
+                     and fn.value.id == "threading")
+                    or (isinstance(fn, ast.Name) and fn.id == "Thread"))
+                if not is_thread or ctx.suppressed(self.name, node.lineno):
+                    continue
+                yield Finding(
+                    ctx.path, node.lineno, node.col_offset, self.name,
+                    f"threading.Thread spawned in {func.name}() of a "
+                    f"simulated-path module: per-object threads are the "
+                    f"exact O(pods) regression the timer-wheel kubelet "
+                    f"removes — drive this through the event loop (fixed "
+                    f"threads belong in __init__/start)")
+
+
 class RawLockRule(Rule):
     name = "raw-lock"
     doc = ("bare threading.Lock()/RLock()/Condition() outside "
@@ -578,12 +619,14 @@ class MetricRules(Rule):
         self.literals: Set[str] = set()
 
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
-        if ctx.path.replace(os.sep, "/").endswith("obs/metrics.py"):
-            return  # the registry itself (generic helpers, validation)
         for node in ast.walk(ctx.tree):
             if (isinstance(node, ast.Constant) and isinstance(node.value, str)
                     and re.match(r"^kctpu_[a-z0-9_]+$", node.value)):
                 self.literals.add(node.value)
+        if ctx.path.replace(os.sep, "/").endswith("obs/metrics.py"):
+            return  # the registry itself: literals counted, rules skipped
+            # (its own instruments — the series-overflow counter — must
+            # still satisfy the two-way catalogue check)
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call) or not node.args:
                 continue
@@ -734,6 +777,7 @@ def all_rules() -> List[Rule]:
         SnapshotMutationRule(),
         TemplateCopyRule(),
         ThreadHygieneRule(),
+        SimThreadPerObjectRule(),
         RawLockRule(),
         FencingTokenRule(),
         GangWidthEnvRule(),

@@ -17,4 +17,5 @@ plug in unchanged (SURVEY.md §7).
 from .store import ObjectStore, WatchEvent, Watcher, APIError, Conflict, NotFound, AlreadyExists  # noqa: F401
 from .client import Cluster, PodClient, ServiceClient, TFJobClient  # noqa: F401
 from .kubelet import FakeKubelet, PhasePolicy  # noqa: F401
+from .simkubelet import SimKubelet  # noqa: F401
 from .tpu import TPUInventory, TPUSlice  # noqa: F401

@@ -1,0 +1,386 @@
+"""Event-driven simulated kubelet: 50k pods on O(1) threads.
+
+``FakeKubelet`` drives every pod with a dedicated thread
+(``_drive_and_reap``): perfect for executed pods (the thread babysits a real
+subprocess) and fine at ``--scale 200``, but a 10k-job / 50k-pod cluster
+simulation would need ~50k OS threads — hundreds of MB of stacks and a GIL
+convoy long before the control plane itself is the bottleneck.
+
+``SimKubelet`` replaces the thread-per-pod model with a **timer wheel**: one
+loop thread owns a heap of ``(due, seq, pod-key, action)`` events and drives
+every simulated pod's Pending → Running → Succeeded/Failed transitions (plus
+coarse progress beats) through it.  Thread count is constant in pod count;
+per-transition cost is O(log pods).
+
+Semantics are the *same* ``PhasePolicy`` contract the threaded kubelet
+implements — pending/run clocks, per-job run overrides, run-forever replica
+types, ``fail_once`` injection, heartbeat beats with ``suspend_heartbeats``
+stall injection, TPU gang admission gating with queue-reason publishing,
+warm/cold gang start costs, injected failures (``chaos_kill`` /
+``fail_slice`` / scheduler evictions), and node-side idle-gang reaping —
+asserted equivalent per scenario by tests/test_simkubelet.py.  Executed
+(subprocess/warm-pool) pods are deliberately out of scope: a pod whose
+container command actually runs needs its babysitter thread, and those paths
+stay on ``FakeKubelet`` untouched.
+
+Selection: ``bench.py --scale N --simulated`` (the scale envelope bench) or
+constructing :class:`SimKubelet` wherever a ``FakeKubelet(execute=False)``
+went.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+from ..api.core import (
+    PHASE_FAILED,
+    PHASE_PENDING,
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+    Pod,
+)
+from ..api.labels import ANNOTATION_GANG_NAME
+from ..obs.metrics import REGISTRY
+from ..utils import locks
+from .client import Cluster
+from .kubelet import PhasePolicy
+from .store import ADDED, APIError, DELETED, MODIFIED, NotFound
+from .tpu import pod_requests_tpu
+
+# Timer actions (one per phase-machine edge).
+_START = "start"        # gate / pending clock -> Running
+_OFFER = "offer"        # retry TPU gang admission
+_WARMUP = "warmup"      # cold/warm start delay elapsed -> pending clock
+_FINISH = "finish"      # run clock elapsed -> terminal phase
+_BEAT = "beat"          # heartbeat tick while Running
+
+# Gang admission poll cadence — matches FakeKubelet._gate_tpu_pod's 5 ms
+# sleep, so queue-wait distributions are comparable across modes.
+_OFFER_TICK_S = 0.005
+# Every Nth failed offer republishes the queue reason (FakeKubelet ticks
+# ticks % 10 == 1 on the same cadence).
+_REASON_EVERY = 10
+# Node-side idle-gang reap cadence (FakeKubelet: 0.5 s).
+_REAP_EVERY_S = 0.5
+
+
+class _SimPod:
+    """Per-pod state the timer events act on."""
+
+    __slots__ = ("pod", "gone", "step", "offer_ticks", "last_reason",
+                 "finish_at", "outcome")
+
+    def __init__(self, pod: Pod):
+        self.pod = pod
+        self.gone = False          # DELETED / deletionTimestamp observed
+        self.step = 0              # heartbeat step counter
+        self.offer_ticks = 0
+        self.last_reason = ""
+        self.finish_at = 0.0       # monotonic deadline of the run clock
+        self.outcome = PHASE_SUCCEEDED  # decided (once) at start time
+
+
+class SimKubelet:
+    """Drives simulated pod phases from one timer-wheel loop.
+
+    Public surface mirrors the ``FakeKubelet`` operations that make sense
+    without subprocesses: ``start``/``stop``, ``set_phase``,
+    ``suspend_heartbeats``/``resume_heartbeats``, ``chaos_kill``,
+    ``fail_slice``, and ``logs`` (always empty — simulated pods produce no
+    output, exactly like FakeKubelet's simulated mode)."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        policy: Optional[PhasePolicy] = None,
+        inventory=None,
+    ):
+        self.cluster = cluster
+        self.policy = policy or PhasePolicy()
+        self.inventory = inventory
+        self._pods: Dict[str, _SimPod] = {}
+        # (due_monotonic, seq, key, action) — the timer wheel.
+        self._timers: List[tuple] = []
+        self._seq = 0
+        self._hb_suspended = False
+        self._injected_failures: Set[str] = set()
+        self._injected_lock = locks.named_lock("simkubelet.injected")
+        self._warm_gangs: Set[str] = set()
+        self._stop = threading.Event()
+        self._watcher = None
+        self._main: Optional[threading.Thread] = None
+        self._c_starts = REGISTRY.counter(
+            "kctpu_pod_starts_total",
+            "Pod process starts by mode (warm = forked from the zygote / "
+            "warm gang readmission; cold = fresh interpreter)", ("mode",))
+        g_pods = REGISTRY.gauge(
+            "kctpu_sim_pods",
+            "Pods currently driven by the event-driven simulated kubelet")
+        g_pods.set_function(lambda: len(self._pods))
+        g_timers = REGISTRY.gauge(
+            "kctpu_sim_timer_depth",
+            "Pending timer-wheel events in the simulated kubelet")
+        g_timers.set_function(lambda: len(self._timers))
+        if inventory is not None and hasattr(inventory, "set_evictor"):
+            inventory.set_evictor(self._evict_pods)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._watcher = self.cluster.pods.watch()
+        for pod in self.cluster.pods.list():
+            self._admit(pod)
+        self._main = threading.Thread(target=self._run, name="sim-kubelet",
+                                      daemon=True)
+        self._main.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watcher:
+            self._watcher.stop()
+
+    def logs(self, namespace: str, name: str, tail_lines: int = 0) -> bytes:
+        return b""  # simulated pods produce no output
+
+    # -- progress plane ------------------------------------------------------
+
+    def suspend_heartbeats(self) -> None:
+        """Stall injection: beats stop publishing while the clock keeps
+        running — from the controller's view, training froze."""
+        self._hb_suspended = True
+
+    def resume_heartbeats(self) -> None:
+        self._hb_suspended = False
+
+    # -- fault injection (chaos / capacity planes) ---------------------------
+
+    def chaos_kill(self, namespace: str, name: str) -> Optional[str]:
+        key = f"{namespace}/{name}"
+        try:
+            pod = self.cluster.pods.get(namespace, name)
+        except NotFound:
+            return None
+        if pod.status.phase in (PHASE_PENDING, PHASE_RUNNING):
+            with self._injected_lock:
+                self._injected_failures.add(key)
+            self.set_phase(namespace, name, PHASE_FAILED,
+                           reason="ChaosKill: injected fault")
+            return "simulated"
+        return None
+
+    def fail_slice(self, slice_name: str, reason: str = "SliceFailed") -> list:
+        if self.inventory is None:
+            return []
+        keys = set(self.inventory.fail_slice(slice_name))
+        failed = []
+        for key in keys:
+            with self._injected_lock:
+                self._injected_failures.add(key)
+            ns, _, name = key.partition("/")
+            self.set_phase(ns, name, PHASE_FAILED, reason=reason)
+            failed.append(name)
+        return failed
+
+    def _evict_pods(self, pod_keys, reason: str) -> None:
+        """Preemption/harvest executor (scheduler-registered): simulated
+        pods just flip to Failed through the injected-failure path."""
+        for key in pod_keys:
+            with self._injected_lock:
+                self._injected_failures.add(key)
+            ns, _, name = key.partition("/")
+            self.set_phase(ns, name, PHASE_FAILED, reason=reason)
+
+    def _consume_injected(self, key: str) -> bool:
+        with self._injected_lock:
+            if key in self._injected_failures:
+                self._injected_failures.discard(key)
+                return True
+            return False
+
+    # -- phase writes --------------------------------------------------------
+
+    def set_phase(self, namespace: str, name: str, phase: str,
+                  reason: str = "") -> None:
+        try:
+            pod = self.cluster.pods.get(namespace, name)
+        except NotFound:
+            return
+        pod.status.phase = phase
+        pod.status.reason = reason
+        # Sole status writer for its pods: last-write-wins, and — node
+        # agent, not a controller sync path — deliberately unfenced.
+        pod.metadata.resource_version = ""
+        try:
+            self.cluster.store.update_status("pods", pod)  # kctpu: vet-ok(fencing-token)
+        except NotFound:
+            pass
+
+    # -- timer wheel ---------------------------------------------------------
+
+    def _arm(self, delay_s: float, key: str, action: str) -> None:
+        self._seq += 1
+        heapq.heappush(self._timers,
+                       (time.monotonic() + max(0.0, delay_s),
+                        self._seq, key, action))
+
+    def _admit(self, pod: Pod) -> None:
+        """A pod appeared: register it and arm its first transition."""
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        if key in self._pods:
+            return
+        sp = _SimPod(pod)
+        self._pods[key] = sp
+        if self.inventory is not None and pod_requests_tpu(pod):
+            self._arm(0.0, key, _OFFER)
+        else:
+            self._arm(self.policy.pending_s, key, _START)
+
+    def _run(self) -> None:
+        """The loop: fire due timers, then drain watch events, sleeping
+        only until the earliest timer (or a short idle tick)."""
+        last_reap = time.monotonic()
+        seen_gaps = getattr(self._watcher, "gaps", 0)
+        while not self._stop.is_set():
+            now = time.monotonic()
+            while self._timers and self._timers[0][0] <= now:
+                _, _, key, action = heapq.heappop(self._timers)
+                sp = self._pods.get(key)
+                if sp is None or sp.gone:
+                    continue
+                self._fire(now, key, sp, action)
+            # Node-side gang reaping (two-process safety net; harmless
+            # redundancy in-process) on the FakeKubelet cadence.
+            if self.inventory is not None and now - last_reap > _REAP_EVERY_S:
+                last_reap = now
+                live = {
+                    k for k, sp in self._pods.items()
+                    if not sp.gone and sp.pod.status.phase
+                    not in (PHASE_SUCCEEDED, PHASE_FAILED)
+                }
+                self.inventory.release_idle_gangs(live)
+            gaps = getattr(self._watcher, "gaps", 0)
+            if gaps != seen_gaps:
+                seen_gaps = gaps
+                for pod in self.cluster.pods.list():
+                    self._admit(pod)
+            timeout = 0.2
+            if self._timers:
+                timeout = min(timeout,
+                              max(0.0, self._timers[0][0] - time.monotonic()))
+            for ev in self._watcher.next_batch(max_n=512, timeout=timeout):
+                self._observe(ev)
+
+    def _observe(self, ev) -> None:
+        if ev.type == ADDED:
+            self._admit(ev.object)
+        elif ev.type == MODIFIED:
+            key = f"{ev.object.metadata.namespace}/{ev.object.metadata.name}"
+            sp = self._pods.get(key)
+            if sp is not None:
+                sp.pod = ev.object  # keep labels/annotations/status current
+                if ev.object.metadata.deletion_timestamp is not None:
+                    self._mark_gone(key, sp)
+        elif ev.type == DELETED:
+            key = f"{ev.object.metadata.namespace}/{ev.object.metadata.name}"
+            sp = self._pods.get(key)
+            if sp is not None:
+                self._mark_gone(key, sp)
+
+    def _mark_gone(self, key: str, sp: _SimPod) -> None:
+        """Deleted (or deleting) pod: timers for it become no-ops; the
+        state entry is dropped immediately — a pod name never re-enters
+        Running after deletion (generateName keeps replacements unique)."""
+        sp.gone = True
+        self._pods.pop(key, None)
+        with self._injected_lock:
+            self._injected_failures.discard(key)
+
+    # -- the phase machine ---------------------------------------------------
+
+    def _fire(self, now: float, key: str, sp: _SimPod, action: str) -> None:
+        if action == _OFFER:
+            self._fire_offer(key, sp)
+        elif action == _WARMUP:
+            self._arm(self.policy.pending_s, key, _START)
+        elif action == _START:
+            self._fire_start(now, key, sp)
+        elif action == _FINISH:
+            self._fire_finish(key, sp)
+        elif action == _BEAT:
+            self._fire_beat(now, key, sp)
+
+    def _fire_offer(self, key: str, sp: _SimPod) -> None:
+        """One gang-admission attempt (the event-driven analog of the
+        threaded gate's poll loop)."""
+        pod = sp.pod
+        if self.inventory.offer(pod):
+            if self._consume_injected(key):
+                return  # failed between admission and start: stay Failed
+            started = getattr(self.inventory, "pod_started", None)
+            if started is not None:
+                started(pod)  # releases the coordinator-first hold
+            gang = pod.metadata.annotations.get(ANNOTATION_GANG_NAME, "") or key
+            warm = gang in self._warm_gangs
+            self._warm_gangs.add(gang)
+            self._c_starts.labels("warm" if warm else "cold").inc()
+            delay = (self.policy.warm_start_s if warm
+                     else self.policy.cold_start_s)
+            self._arm(delay, key, _WARMUP)
+            return
+        sp.offer_ticks += 1
+        queue_info = getattr(self.inventory, "queue_info", None)
+        gang = pod.metadata.annotations.get(ANNOTATION_GANG_NAME, "")
+        if (queue_info is not None and gang
+                and sp.offer_ticks % _REASON_EVERY == 1):
+            reason = queue_info(gang)
+            if reason and reason != sp.last_reason:
+                sp.last_reason = reason
+                self.set_phase(pod.metadata.namespace, pod.metadata.name,
+                               PHASE_PENDING, reason=reason)
+        self._arm(_OFFER_TICK_S, key, _OFFER)
+
+    def _fire_start(self, now: float, key: str, sp: _SimPod) -> None:
+        pod = sp.pod
+        if self._consume_injected(key):
+            return  # injected failure won the race: stay Failed
+        self.set_phase(pod.metadata.namespace, pod.metadata.name,
+                       PHASE_RUNNING)
+        outcome = self.policy.outcome(pod)
+        if outcome is None:
+            return  # runs forever (PS): no beats, no terminal transition
+        run_s = self.policy.run_s_for(pod)
+        sp.finish_at = now + run_s
+        sp.outcome = outcome  # policy.outcome consumed any fail_once entry
+        if self.policy.heartbeat_s > 0:
+            self._arm(min(self.policy.heartbeat_s, run_s), key, _BEAT)
+        self._arm(run_s, key, _FINISH)
+
+    def _fire_beat(self, now: float, key: str, sp: _SimPod) -> None:
+        from ..api.core import PodProgress
+
+        sp.step += 1
+        hb = self.policy.heartbeat_s
+        if not self._hb_suspended:
+            try:
+                self.cluster.pods.update_progress(
+                    sp.pod.metadata.namespace, sp.pod.metadata.name,
+                    PodProgress(
+                        step=sp.step,
+                        examples_per_sec=round(100.0 / hb, 3),
+                        loss=round(1.0 / sp.step, 4),
+                        phase="fit",
+                    ))
+            except APIError:
+                return  # pod deleted mid-beat: no further beats
+        if now + hb < sp.finish_at:
+            self._arm(hb, key, _BEAT)
+
+    def _fire_finish(self, key: str, sp: _SimPod) -> None:
+        if self._consume_injected(key):
+            return  # fail_slice/chaos already marked the pod Failed
+        self.set_phase(sp.pod.metadata.namespace, sp.pod.metadata.name,
+                       sp.outcome)
+        self._pods.pop(key, None)

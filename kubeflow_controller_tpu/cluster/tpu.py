@@ -71,6 +71,16 @@ class TPUInventory:
     def __init__(self, slices: Optional[List[TPUSlice]] = None):
         self._lock = locks.named_lock("tpu.inventory")
         self.slices: Dict[str, TPUSlice] = {s.name: s for s in (slices or [])}
+        # Free-capacity index: accelerator type -> count of free healthy
+        # slices, maintained on every bind/unbind/failure.  The scheduler
+        # (and every gated pod's poll loop) asks "is there capacity" far
+        # more often than it binds — those queries must be O(1), not a
+        # scan of the slice table.
+        self._free_counts: Dict[str, int] = {}
+        for s in self.slices.values():
+            if s.healthy and not s.bound_gang:
+                self._free_counts[s.accelerator_type] = (
+                    self._free_counts.get(s.accelerator_type, 0) + 1)
         self._gangs: Dict[str, _Gang] = {}
         # Gangs seen idle by the last release_idle_gangs scan (two-scan
         # confirmation guards the snapshot race — see release_idle_gangs).
@@ -89,7 +99,13 @@ class TPUInventory:
 
     def add_slice(self, s: TPUSlice) -> None:
         with self._lock:
+            old = self.slices.get(s.name)
+            if old is not None and old.healthy and not old.bound_gang:
+                self._free_counts[old.accelerator_type] -= 1
             self.slices[s.name] = s
+            if s.healthy and not s.bound_gang:
+                self._free_counts[s.accelerator_type] = (
+                    self._free_counts.get(s.accelerator_type, 0) + 1)
             self._version += 1
 
     def offer(self, pod: Pod) -> bool:
@@ -136,6 +152,7 @@ class TPUInventory:
         for sl in found:
             sl.bound_gang = gang.name
             sl.bound_at = now
+            self._free_counts[sl.accelerator_type] -= 1
         # Append (fresh binds start from an empty list): elastic
         # re-expansion grows an admitted gang's binding in place.
         gang.slice_names = gang.slice_names + [sl.name for sl in found]
@@ -144,6 +161,9 @@ class TPUInventory:
     def _unbind_locked(self, sl: TPUSlice) -> None:
         if sl.bound_at:
             self._busy_s += max(0.0, time.time() - sl.bound_at)
+        if sl.bound_gang and sl.healthy:
+            self._free_counts[sl.accelerator_type] = (
+                self._free_counts.get(sl.accelerator_type, 0) + 1)
         sl.bound_gang = ""
         sl.bound_at = 0.0
         self._version += 1
@@ -222,16 +242,16 @@ class TPUInventory:
             return [sl.name for sl in found]
 
     def has_free_slice(self, accelerator_type: str = "") -> bool:
-        with self._lock:
-            return self._find_free_slices(accelerator_type, 1) is not None
+        return self.free_slice_count(accelerator_type) > 0
 
     def free_slice_count(self, accelerator_type: str = "") -> int:
+        """O(1) via the free-capacity index — this is the query every
+        queued pod's admission poll and the scheduler's harvest/preempt
+        sizing hit, so it must not scan the slice table."""
         with self._lock:
-            return sum(
-                1 for s in self.slices.values()
-                if not s.bound_gang and s.healthy
-                and (not accelerator_type or s.accelerator_type == accelerator_type)
-            )
+            if accelerator_type:
+                return self._free_counts.get(accelerator_type, 0)
+            return sum(self._free_counts.values())
 
     def gang_on_slice(self, slice_name: str) -> str:
         with self._lock:
@@ -330,6 +350,8 @@ class TPUInventory:
             sl = self.slices.get(slice_name)
             if sl is None:
                 return []
+            if sl.healthy and not sl.bound_gang:
+                self._free_counts[sl.accelerator_type] -= 1
             sl.healthy = False
             self._version += 1
             if not sl.bound_gang:

@@ -56,7 +56,7 @@ from ..obs.metrics import REGISTRY
 from ..planner import plan_job
 from ..planner.materialize import gang_name, make_pod, make_service
 from ..planner.types import Action
-from ..updater import compute_status, should_update
+from ..updater import RollupCache, compute_status, should_update
 from ..utils import locks, serde
 from ..utils.names import generate_runtime_id
 from ..recovery.policy import (
@@ -195,6 +195,11 @@ class Controller:
             self.queue = RateLimitingQueue(name="tfJobs")
         self.expectations = ControllerExpectations()
         self.metrics = ReconcileMetrics()
+        # Incremental rollup: memoizes compute_status per job, keyed by the
+        # RVs of every input (job, observed pods, recovery verdicts), so a
+        # level-triggered re-pass over an unmoved world skips the rollup
+        # AND the should_update double-serialization (updater/incremental).
+        self.rollup_cache = RollupCache()
         # Prometheus surface: reconcile latency quantiles + op counters land
         # on the process-global registry (served at GET /metrics).
         self.metrics.register()
@@ -276,7 +281,9 @@ class Controller:
                     continue
                 if job.status.progress is None:
                     continue  # never reported: nothing to watch for silence
-                self.queue.add(key_of(job.metadata))
+                # Low tier: a liveness re-check must never queue ahead of
+                # the watch-edge work that actually advances jobs.
+                self.queue.add(key_of(job.metadata), low=True)
 
     def stop(self) -> None:
         self._stop.set()
@@ -368,13 +375,17 @@ class Controller:
         backstop, ref: controller.go:480-484) skip jobs that are settled:
         terminal phase, not deleting, expectations satisfied.  A Succeeded
         job would otherwise be re-gathered every resync period forever —
-        pure churn that scales with completed-job count."""
-        if (
-            old.metadata.resource_version == new.metadata.resource_version
-            and new.status.phase in (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED)
-            and new.metadata.deletion_timestamp is None
-            and self.expectations.satisfied_expectations(key_of(new.metadata))
-        ):
+        pure churn that scales with completed-job count.  Unsettled resyncs
+        ride the workqueue's LOW tier: a periodic backstop pass must never
+        delay the fresh watch edges behind it in a 10k-job storm."""
+        if old.metadata.resource_version == new.metadata.resource_version:
+            if (
+                new.status.phase in (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED)
+                and new.metadata.deletion_timestamp is None
+                and self.expectations.satisfied_expectations(key_of(new.metadata))
+            ):
+                return
+            self.queue.add(key_of(new.metadata), low=True)
             return
         self._enqueue(new)
 
@@ -383,6 +394,7 @@ class Controller:
         self.expectations.delete_expectations(key)
         self.restart_tracker.forget_job(key)
         self.elastic_engine.forget_job(key, job)
+        self.rollup_cache.forget(key)
         self._drop_progress_series(key, job)
         if self.inventory is not None and is_tpu_job(job):
             self.inventory.release_gang(gang_name(job))
@@ -527,14 +539,23 @@ class Controller:
         # Status rollup runs every sync, whether or not we acted.  The
         # stall tracker rides along: Running pods' heartbeats/steps are
         # checked against the deadlines and surface as Degraded health +
-        # stalled progress in the computed status.
-        new_status = compute_status(job, pods_by_type,
-                                    tracker=self.stall_tracker,
-                                    recovery=recovery)
-        self._publish_progress(key, job, new_status)
-        self._publish_gang_state(key, job, pods_by_type)
-        if should_update(job.status, new_status):
-            self._update_status(job, new_status)
+        # stalled progress in the computed status.  The rollup cache skips
+        # the whole pass when every input RV is unchanged since the last
+        # computation (a hit also proves the stored status already matches,
+        # so publication and the status write are skipped with it); jobs
+        # whose pods report progress never hit (stall detection is
+        # wall-clock-driven and must re-run — see updater/incremental.py).
+        fp = RollupCache.fingerprint(job, pods_by_type, recovery)
+        new_status = self.rollup_cache.lookup(key, fp)
+        if new_status is None:
+            new_status = compute_status(job, pods_by_type,
+                                        tracker=self.stall_tracker,
+                                        recovery=recovery)
+            self._publish_progress(key, job, new_status)
+            self._publish_gang_state(key, job, pods_by_type)
+            if should_update(job.status, new_status):
+                self._update_status(job, new_status)
+            self.rollup_cache.store(key, fp, new_status)
 
         # Terminal TPU jobs release their slice once cleanup is planned.
         if (
@@ -681,6 +702,7 @@ class Controller:
         self.expectations.delete_expectations(key)
         self.restart_tracker.forget_job(key)
         self.elastic_engine.forget_job(key, job)
+        self.rollup_cache.forget(key)
 
     def _gather(self, job: TFJob):
         """Claim pods/services once at job scope, then partition by replica

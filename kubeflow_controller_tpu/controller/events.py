@@ -62,6 +62,9 @@ class Event:
     # repeats collapse on (object, reason, dedup_key) even as the message
     # text changes with the restart count.
     dedup_key: str = ""
+    # Process-wide recording order (all_events sorts on it — per-object
+    # rings make plain insertion order meaningless across objects).
+    seq: int = 0
 
     def __post_init__(self):
         if not self.first_timestamp:
@@ -70,17 +73,31 @@ class Event:
 
 class EventRecorder:
     def __init__(self, component: str = "tfjob-controller", max_events: int = 4096,
-                 sink=None):
+                 sink=None, per_object_max: int = 64):
         """``sink``: an events client (cluster.events) — when given, every
         event is ALSO written as a real Event API object, count-aggregated,
         visible via the API the way ``kubectl describe`` shows them (ref:
         broadcaster at pkg/controller/controller.go:107-110).  Best-effort,
-        as in k8s: API failures never break the controller."""
+        as in k8s: API failures never break the controller.
+
+        Retention is a **per-object ring**: each object keeps its newest
+        ``per_object_max`` deduplicated events, and ``max_events`` bounds
+        the total across all rings (whole oldest-touched rings are evicted
+        first).  A 10k-job create storm therefore neither grows event
+        memory without bound NOR flushes every other job's audit trail —
+        the flat-list retention both, before the scale envelope work."""
+        import collections
         import queue
 
         self.component = component
         self._lock = locks.named_lock("events.recorder")
-        self._events: List[Event] = []
+        # object_key -> ring of its newest events, oldest-touched key first
+        # (move_to_end on every record keeps eviction LRU-by-object).
+        self._rings: "collections.OrderedDict[str, collections.deque]" = (
+            collections.OrderedDict())
+        self._per_object_max = max(1, per_object_max)
+        self._total = 0
+        self._seq = 0
         # In-memory aggregation index: (object_key, reason, message) -> its
         # live Event.  Keyed, not last-element-only: interleaved events from
         # different jobs must not defeat dedup (a 20-job controller emits
@@ -109,6 +126,8 @@ class EventRecorder:
         count/backoff in the message, but must still collapse into ONE
         aggregated event per (job, reason, replica) — pass the replica id
         as the dedup key and the live event's message tracks the newest."""
+        import collections
+
         key = f"{obj.metadata.namespace}/{obj.metadata.name}"
         kind = getattr(obj, "kind", type(obj).__name__)
         aggregated = False
@@ -124,19 +143,36 @@ class EventRecorder:
                 live.count += 1
                 live.timestamp = time.time()
                 live.message = message  # newest wording wins under dedup_key
+                if key in self._rings:
+                    self._rings.move_to_end(key)
                 aggregated = True
             else:
+                self._seq += 1
                 ev = Event(kind, key, event_type, reason, message,
-                           dedup_key=dedup_key)
-                self._events.append(ev)
+                           dedup_key=dedup_key, seq=self._seq)
+                ring = self._rings.get(key)
+                if ring is None:
+                    ring = self._rings[key] = collections.deque()
+                else:
+                    self._rings.move_to_end(key)
+                if len(ring) >= self._per_object_max:
+                    self._drop_locked(ring.popleft())
+                ring.append(ev)
+                self._total += 1
                 self._agg[agg_key] = ev
-                if len(self._events) > self._max:
-                    dropped = self._events[: len(self._events) - self._max]
-                    self._events = self._events[-self._max :]
-                    for d in dropped:
-                        k = (d.object_key, d.reason, d.dedup_key or d.message)
-                        if self._agg.get(k) is d:
-                            del self._agg[k]
+                # Global bound: evict whole rings, oldest-touched first —
+                # one noisy job can age out, it cannot flush everyone.
+                while self._total > self._max and self._rings:
+                    old_key, old_ring = next(iter(self._rings.items()))
+                    if old_key == key and len(self._rings) == 1:
+                        self._drop_locked(old_ring.popleft())
+                        if not old_ring:
+                            del self._rings[old_key]
+                        continue
+                    for d in old_ring:
+                        self._drop_locked(d, count=False)
+                    self._total -= len(old_ring)
+                    del self._rings[old_key]
         if not aggregated:
             log = logger.info if event_type == TYPE_NORMAL else logger.warning
             log("event component=%s kind=%s object=%s reason=%s: %s",
@@ -233,11 +269,23 @@ class EventRecorder:
         except APIError:
             pass  # best-effort audit stream
 
+    def _drop_locked(self, d: Event, count: bool = True) -> None:
+        """Forget one evicted event's aggregation entry (caller holds the
+        lock); ``count`` adjusts the cross-ring total for single-event
+        evictions (whole-ring eviction adjusts in bulk)."""
+        k = (d.object_key, d.reason, d.dedup_key or d.message)
+        if self._agg.get(k) is d:
+            del self._agg[k]
+        if count:
+            self._total -= 1
+
     def events_for(self, namespace: str, name: str) -> List[Event]:
         key = f"{namespace}/{name}"
         with self._lock:
-            return [e for e in self._events if e.object_key == key]
+            return list(self._rings.get(key, ()))
 
     def all_events(self) -> List[Event]:
         with self._lock:
-            return list(self._events)
+            out = [e for ring in self._rings.values() for e in ring]
+        out.sort(key=lambda e: e.seq)
+        return out

@@ -199,6 +199,11 @@ class SharedInformer:
         # drops transparently and bumps `gaps` only when the overflow
         # window outran the watch cache — the in-process 410.
         seen_gaps = getattr(self._watcher, "gaps", 0)
+        # Batch drain (store watchers expose next_batch): under a phase
+        # storm the informer takes ONE queue-lock round-trip per batch of
+        # events instead of one per event; transports without it (REST)
+        # keep the single-event pop.
+        next_batch = getattr(self._watcher, "next_batch", None)
         while not self._stop.is_set():
             gaps = getattr(self._watcher, "gaps", 0)
             if gaps != seen_gaps:
@@ -212,29 +217,35 @@ class SharedInformer:
                 while self._watcher.next(timeout=0) is not None:
                     pass
                 self._relist()
-            ev = self._watcher.next(timeout=0.2)
-            if ev is None:
-                continue
-            if ev.type not in (ADDED, MODIFIED, DELETED):
-                continue  # BOOKMARK etc.: transport checkpoints, no cache effect
-            k = key_of(ev.object.metadata)
-            if ev.type == ADDED:
-                with self._lock:
-                    known = k in self._cache
-                    self._cache_set(k, ev.object)
-                if known:
-                    # Already delivered by the initial list: treat as update.
-                    self._dispatch_update(ev.object, ev.object)
-                else:
-                    self._dispatch_add(ev.object)
-            elif ev.type == MODIFIED:
-                with self._lock:
-                    old = self._cache.get(k, ev.object)
-                    self._cache_set(k, ev.object)
-                self._dispatch_update(old, ev.object)
-            elif ev.type == DELETED:
-                self._cache_pop(k)
-                self._dispatch_delete(ev.object)
+            if next_batch is not None:
+                events = next_batch(max_n=256, timeout=0.2)
+            else:
+                ev = self._watcher.next(timeout=0.2)
+                events = (ev,) if ev is not None else ()
+            for ev in events:
+                self._apply_event(ev)
+
+    def _apply_event(self, ev) -> None:
+        if ev.type not in (ADDED, MODIFIED, DELETED):
+            return  # BOOKMARK etc.: transport checkpoints, no cache effect
+        k = key_of(ev.object.metadata)
+        if ev.type == ADDED:
+            with self._lock:
+                known = k in self._cache
+                self._cache_set(k, ev.object)
+            if known:
+                # Already delivered by the initial list: treat as update.
+                self._dispatch_update(ev.object, ev.object)
+            else:
+                self._dispatch_add(ev.object)
+        elif ev.type == MODIFIED:
+            with self._lock:
+                old = self._cache.get(k, ev.object)
+                self._cache_set(k, ev.object)
+            self._dispatch_update(old, ev.object)
+        elif ev.type == DELETED:
+            self._cache_pop(k)
+            self._dispatch_delete(ev.object)
 
     def _relist(self) -> None:
         """Full list + diff against the cache, firing the handlers the lost

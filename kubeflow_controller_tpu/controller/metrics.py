@@ -8,19 +8,67 @@ as a Prometheus summary + counters on the obs registry (``GET /metrics``).
 
 from __future__ import annotations
 
+import collections
+import random
 import threading
-from typing import Dict, List, Optional
+from typing import Deque, Dict, List, Optional
 
 from ..obs import metrics as obs_metrics
 from ..utils import locks
 
 
+class _Reservoir:
+    """Bounded percentile window: a fixed-size uniform reservoir (Vitter's
+    algorithm R) over ALL samples ever recorded, plus a fixed-size ring of
+    the NEWEST samples for windowed queries ("p99 since the storm began").
+
+    Before the scale envelope work the sample buffer grew to 100k floats
+    per metric and truncation past the cap copied the whole list on every
+    append — at 10k jobs that is O(n) per sync and tens of MB of floats.
+    Here every sample costs O(1) time and the memory is a constant
+    ``size + window`` floats regardless of job count.  Percentiles over
+    the reservoir are unbiased estimates of the all-time distribution;
+    windowed percentiles are exact while the queried window fits in the
+    ring (bench storm windows are thousands; the ring holds 16k).
+
+    NOT thread-safe: the owner serializes access (ReconcileMetrics lock)."""
+
+    __slots__ = ("size", "_buf", "_recent", "count", "_rng")
+
+    def __init__(self, size: int = 4096, window: int = 16384, seed: int = 0):
+        self.size = size
+        self._buf: List[float] = []
+        self._recent: Deque[float] = collections.deque(maxlen=window)
+        self.count = 0  # total samples ever offered
+        self._rng = random.Random(seed)  # deterministic: benches reproduce
+
+    def add(self, v: float) -> None:
+        self.count += 1
+        self._recent.append(v)
+        if len(self._buf) < self.size:
+            self._buf.append(v)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.size:
+                self._buf[j] = v
+
+    def sorted_all(self) -> List[float]:
+        return sorted(self._buf)
+
+    def sorted_since(self, start: int) -> List[float]:
+        """Newest ``count - start`` samples (clamped to the ring)."""
+        want = max(0, self.count - start)
+        if want == 0:
+            return []
+        recent = list(self._recent)
+        return sorted(recent[-want:])
+
+
 class ReconcileMetrics:
-    def __init__(self, max_samples: int = 100_000):
+    def __init__(self, max_samples: int = 4096):
         self._lock = locks.named_lock("controller.reconcile-metrics")
-        self._samples: List[float] = []
-        self._max = max_samples
-        self._sum = 0.0  # cumulative, survives sample-window truncation
+        self._samples = _Reservoir(size=max_samples)
+        self._sum = 0.0  # cumulative, survives reservoir replacement
         self.syncs = 0
         self.sync_errors = 0
         self.creates = 0
@@ -34,7 +82,7 @@ class ReconcileMetrics:
         # Per-create API latency samples (pods+services), fed by the
         # Helper: the wide-job and multi-job benches share this one
         # latency vocabulary (create_latency_p50/p99 in snapshots).
-        self._create_samples: List[float] = []
+        self._create_samples = _Reservoir(size=max_samples)
 
     def record_sync(self, duration_s: float, error: bool = False) -> None:
         with self._lock:
@@ -42,9 +90,7 @@ class ReconcileMetrics:
             if error:
                 self.sync_errors += 1
             self._sum += duration_s
-            self._samples.append(duration_s)
-            if len(self._samples) > self._max:
-                self._samples = self._samples[-self._max :]
+            self._samples.add(duration_s)
 
     # Counter increments from concurrent sync workers MUST go through these
     # (bare ``+= 1`` on the attributes is a lost-update race).
@@ -70,37 +116,33 @@ class ReconcileMetrics:
 
     def record_create_latency(self, duration_s: float) -> None:
         with self._lock:
-            self._create_samples.append(duration_s)
-            if len(self._create_samples) > self._max:
-                self._create_samples = self._create_samples[-self._max :]
+            self._create_samples.add(duration_s)
 
     def create_latency_percentile(self, q: float) -> float:
         with self._lock:
-            if not self._create_samples:
-                return 0.0
-            s = sorted(self._create_samples)
-            idx = min(len(s) - 1, int(q / 100.0 * len(s)))
-            return s[idx]
+            s = self._create_samples.sorted_all()
+        if not s:
+            return 0.0
+        return s[min(len(s) - 1, int(q / 100.0 * len(s)))]
 
     def percentile(self, q: float) -> float:
         with self._lock:
-            if not self._samples:
-                return 0.0
-            s = sorted(self._samples)
-            idx = min(len(s) - 1, int(q / 100.0 * len(s)))
-            return s[idx]
+            s = self._samples.sorted_all()
+        if not s:
+            return 0.0
+        return s[min(len(s) - 1, int(q / 100.0 * len(s)))]
 
     # Windowed latency: benches that want "p99 during the storm" snapshot
     # sample_count() at the window start and read percentile_since(q, n).
-    # Valid while the sample buffer hasn't truncated past the snapshot
-    # (max_samples is 100k; bench windows are thousands).
+    # Exact while the window fits in the reservoir's recent ring (16k; bench
+    # storm windows are thousands).
     def sample_count(self) -> int:
         with self._lock:
-            return len(self._samples)
+            return self._samples.count
 
     def percentile_since(self, q: float, start: int) -> float:
         with self._lock:
-            s = sorted(self._samples[start:])
+            s = self._samples.sorted_since(start)
         if not s:
             return 0.0
         return s[min(len(s) - 1, int(q / 100.0 * len(s)))]
@@ -119,12 +161,11 @@ class ReconcileMetrics:
 
     def snapshot(self) -> Dict[str, float]:
         # One lock hold, one sort per sample window: the per-percentile
-        # properties each re-sorted the (up to 100k-entry) window, making a
-        # snapshot 5 sorts — benches snapshot in their measurement loops,
-        # so this path is warm.
+        # properties each re-sorted the window, making a snapshot 5 sorts —
+        # benches snapshot in their measurement loops, so this path is warm.
         with self._lock:
-            samples = sorted(self._samples)
-            creates = sorted(self._create_samples)
+            samples = self._samples.sorted_all()
+            creates = self._create_samples.sorted_all()
             out = {
                 "syncs": self.syncs,
                 "sync_errors": self.sync_errors,
@@ -146,7 +187,7 @@ class ReconcileMetrics:
             "reconcile_p99_s": q(samples, 99),
             "create_latency_p50_s": q(creates, 50),
             "create_latency_p99_s": q(creates, 99),
-            "samples": len(samples),
+            "samples": self._samples.count,
         })
         return out
 
@@ -163,7 +204,7 @@ class ReconcileMetrics:
 
     def _families(self) -> List[obs_metrics.Family]:
         with self._lock:
-            samples = sorted(self._samples)
+            samples = self._samples.sorted_all()
             total = self._sum
             syncs_n = self.syncs
             counters = [

@@ -7,6 +7,13 @@ pkg/controller/controller.go:132, 639):
   *being processed* is re-queued when ``done`` is called (never processed
   concurrently with itself — this is what serializes per-key syncs,
   ref: controller.go:72-76);
+- **priority tiers**: ``add(item, low=True)`` queues into a LOW tier that
+  workers drain only when the fresh tier is empty (with a 1-in-8
+  anti-starvation pop so the low tier always makes progress).  Resyncs and
+  stall-timer re-enqueues ride the low tier: during a 10k-job storm the
+  periodic level-triggered backstop would otherwise interleave with (and
+  at scale, bury) the watch-edge work that actually advances jobs.  A
+  fresh ``add`` of an item sitting in the low tier promotes it;
 - **rate limiting**: ``add_rate_limited`` delays re-adds with per-item
   exponential backoff (base*2^failures up to a cap — the
   ItemExponentialFailureRateLimiter); ``forget`` resets the failure count
@@ -103,6 +110,15 @@ class RateLimitingQueue:
         # FIFO of ready items: deque, so the get() hot path is O(1)
         # popleft instead of list.pop(0)'s O(depth) shift per item.
         self._queue: Deque[str] = collections.deque()
+        # LOW tier (resyncs / stall-timer backstops).  Items present here
+        # are tracked in _low; promotion leaves a stale deque entry behind
+        # that get() skips (lazy deletion — O(1) promote, no deque scan).
+        self._queue_low: Deque[str] = collections.deque()
+        self._low: Set[str] = set()
+        # Items that went dirty *while processing* via a low add: done()
+        # requeues them into the low tier instead of the fresh one.
+        self._low_pending: Set[str] = set()
+        self._gets = 0  # anti-starvation clock for the low tier
         self._dirty: Set[str] = set()
         self._processing: Set[str] = set()
         # Enqueue wall-clock per queued item, for the queue-wait histogram.
@@ -118,36 +134,74 @@ class RateLimitingQueue:
 
     # -- core add/get/done ---------------------------------------------------
 
-    def add(self, item: str) -> None:
+    def add(self, item: str, low: bool = False) -> None:
         with self._cond:
-            if self._shutting_down or item in self._dirty:
+            if self._shutting_down:
+                return
+            if item in self._dirty:
+                if not low and item in self._low:
+                    # Fresh edge for an item parked in the low tier:
+                    # promote (lazy-delete the low entry).
+                    self._low.discard(item)
+                    self._low_pending.discard(item)
+                    if item not in self._processing:
+                        self._queue.append(item)
+                        self._cond.notify()
                 return
             self._dirty.add(item)
             self._metrics.adds.inc()
             if item in self._processing:
+                if low:
+                    self._low_pending.add(item)
                 return  # re-queued by done()
-            self._queue.append(item)
+            if low:
+                self._low.add(item)
+                self._queue_low.append(item)
+            else:
+                self._queue.append(item)
             self._enqueued_at.setdefault(item, time.time())
-            self._metrics.depth.set(len(self._queue))
+            self._metrics.depth.set(self._depth_locked())
             self._cond.notify()
+
+    def _depth_locked(self) -> int:
+        return len(self._queue) + len(self._low)
+
+    def _pop_locked(self) -> Optional[str]:
+        """Next ready item across tiers: fresh first, low when fresh is
+        empty — except every 8th pop prefers low, so a sustained storm of
+        fresh edges cannot starve the level-triggered backstop forever."""
+        self._gets += 1
+        order = ((self._queue_low, self._queue)
+                 if (self._gets & 7) == 0 else (self._queue, self._queue_low))
+        for dq in order:
+            while dq:
+                item = dq.popleft()
+                if dq is self._queue_low:
+                    if item not in self._low:
+                        continue  # promoted or claimed: stale entry
+                    self._low.discard(item)
+                return item
+        return None
 
     def get(self, timeout: Optional[float] = None) -> Optional[str]:
         """Blocks for the next item; None on timeout; raises ShutDown when
         the queue is drained and shutting down."""
         with self._cond:
             deadline = None if timeout is None else time.time() + timeout
-            while not self._queue:
+            while True:
+                item = self._pop_locked()
+                if item is not None:
+                    break
                 if self._shutting_down:
                     raise ShutDown()
                 remaining = None if deadline is None else deadline - time.time()
                 if remaining is not None and remaining <= 0:
                     return None
                 self._cond.wait(timeout=remaining)
-            item = self._queue.popleft()
             self._processing.add(item)
             self._dirty.discard(item)
             t_add = self._enqueued_at.pop(item, None)
-            self._metrics.depth.set(len(self._queue))
+            self._metrics.depth.set(self._depth_locked())
             if t_add is not None:
                 self._metrics.queue_wait.observe(max(0.0, time.time() - t_add))
             return item
@@ -156,9 +210,14 @@ class RateLimitingQueue:
         with self._cond:
             self._processing.discard(item)
             if item in self._dirty:
-                self._queue.append(item)
+                if item in self._low_pending:
+                    self._low_pending.discard(item)
+                    self._low.add(item)
+                    self._queue_low.append(item)
+                else:
+                    self._queue.append(item)
                 self._enqueued_at.setdefault(item, time.time())
-                self._metrics.depth.set(len(self._queue))
+                self._metrics.depth.set(self._depth_locked())
                 self._metrics.requeues.inc()
                 self._cond.notify()
 
@@ -227,7 +286,12 @@ class RateLimitingQueue:
         by waiting out the in-flight syncs before the re-add."""
         with self._cond:
             out = [(item, 0.0) for item in self._queue]
+            out.extend((item, 0.0) for item in self._queue_low
+                       if item in self._low)
             self._queue.clear()
+            self._queue_low.clear()
+            self._low.clear()
+            self._low_pending.clear()
             out.extend((item, ready_at) for ready_at, _, item in self._waiting)
             self._waiting = []
             # Remaining dirty after removing the ready items = items that
@@ -255,4 +319,4 @@ class RateLimitingQueue:
 
     def __len__(self) -> int:
         with self._cond:
-            return len(self._queue)
+            return self._depth_locked()

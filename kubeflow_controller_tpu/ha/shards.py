@@ -133,11 +133,11 @@ class ShardedWorkQueue:
 
     # -- queue surface (controller-facing) -----------------------------------
 
-    def add(self, key: str) -> None:
+    def add(self, key: str, low: bool = False) -> None:
         with self._lock:
             if self._shutting_down:
                 return
-            self._queues[self._route_locked(key)].add(key)
+            self._queues[self._route_locked(key)].add(key, low=low)
 
     def add_after(self, key: str, delay: float) -> None:
         with self._lock:

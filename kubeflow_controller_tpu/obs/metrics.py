@@ -36,6 +36,24 @@ _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
+# Cardinality budget: the most labeled series one instrument may hold.  At
+# 10k jobs / 50k pods, object-scoped gauges (per-job step/rate/lag) would
+# otherwise grow the /metrics page and the instrument dicts without bound
+# if a delete path misses a Gauge.remove.  A new series past the budget is
+# DROPPED (not an error — scrapes must keep working mid-storm) and counted
+# in kctpu_metric_series_dropped_total{metric} so the loss is observable.
+# Existing series keep updating; removes free budget.
+DEFAULT_SERIES_BUDGET = 4096
+
+
+def _series_dropped_counter() -> "Counter":
+    """The overflow counter (one labeled series per *instrument*, so its
+    own cardinality is bounded by the number of registered metrics)."""
+    return REGISTRY.counter(
+        "kctpu_metric_series_dropped_total",
+        "Label series dropped because an instrument hit its series budget "
+        "(cardinality control at scale)", ("metric",))
+
 
 def escape_label_value(v: str) -> str:
     return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
@@ -87,7 +105,8 @@ class Family:
 class _Instrument:
     typ = "untyped"
 
-    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = (),
+                 max_series: Optional[int] = None):
         if not _NAME_RE.match(name):
             raise ValueError(f"invalid metric name {name!r}")
         for ln in labelnames:
@@ -96,7 +115,22 @@ class _Instrument:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
+        self._max_series = (DEFAULT_SERIES_BUDGET if max_series is None
+                            else max_series)
         self._lock = locks.named_lock(f"obs.metric:{name}")
+
+    def _admit(self, table: Dict, key: Tuple[str, ...]) -> bool:
+        """Series-budget check (caller holds ``self._lock``): an existing
+        key always updates; a NEW key is admitted only under budget."""
+        return key in table or len(table) < self._max_series
+
+    def _note_drop(self) -> None:
+        """Count one budget-dropped series.  Called with NO lock held (the
+        overflow counter is its own instrument — nesting its lock under
+        ours would put every instrument pair into one lock-order edge)."""
+        if self.name == "kctpu_metric_series_dropped_total":
+            return  # the overflow counter never recurses into itself
+        _series_dropped_counter().labels(self.name).inc()
 
     def _key(self, labelvalues: Sequence[str], kv: Dict[str, str]) -> Tuple[str, ...]:
         if kv:
@@ -138,8 +172,9 @@ class Counter(_Instrument):
 
     typ = "counter"
 
-    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
-        super().__init__(name, help, labelnames)
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = (),
+                 max_series: Optional[int] = None):
+        super().__init__(name, help, labelnames, max_series)
         self._values: Dict[Tuple[str, ...], float] = {}
         if not self.labelnames:
             self._values[()] = 0.0
@@ -154,7 +189,10 @@ class Counter(_Instrument):
         if amount < 0:
             raise ValueError(f"counter {self.name} cannot decrease (inc {amount})")
         with self._lock:
-            self._values[key] = self._values.get(key, 0.0) + amount
+            if self._admit(self._values, key):
+                self._values[key] = self._values.get(key, 0.0) + amount
+                return
+        self._note_drop()
 
     @property
     def value(self) -> float:
@@ -199,8 +237,9 @@ class Gauge(_Instrument):
 
     typ = "gauge"
 
-    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
-        super().__init__(name, help, labelnames)
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = (),
+                 max_series: Optional[int] = None):
+        super().__init__(name, help, labelnames, max_series)
         self._values: Dict[Tuple[str, ...], float] = {}
         self._fns: Dict[Tuple[str, ...], Callable[[], float]] = {}
         if not self.labelnames:
@@ -223,16 +262,25 @@ class Gauge(_Instrument):
 
     def _set(self, key: Tuple[str, ...], v: float) -> None:
         with self._lock:
-            self._values[key] = float(v)
+            if self._admit(self._values, key):
+                self._values[key] = float(v)
+                return
+        self._note_drop()
 
     def _add(self, key: Tuple[str, ...], amount: float) -> None:
         with self._lock:
-            self._values[key] = self._values.get(key, 0.0) + amount
+            if self._admit(self._values, key):
+                self._values[key] = self._values.get(key, 0.0) + amount
+                return
+        self._note_drop()
 
     def _set_fn(self, key: Tuple[str, ...], fn: Callable[[], float]) -> None:
         with self._lock:
-            self._fns[key] = fn
-            self._values.setdefault(key, 0.0)
+            if self._admit(self._values, key) or key in self._fns:
+                self._fns[key] = fn
+                self._values.setdefault(key, 0.0)
+                return
+        self._note_drop()
 
     def remove(self, *labelvalues, **kv) -> None:
         """Drop one labeled series (no-op if absent).  Object-scoped gauges
@@ -300,8 +348,9 @@ class Histogram(_Instrument):
     typ = "histogram"
 
     def __init__(self, name: str, help: str, labelnames: Sequence[str] = (),
-                 buckets: Sequence[float] = DEFAULT_BUCKETS):
-        super().__init__(name, help, labelnames)
+                 buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 max_series: Optional[int] = None):
+        super().__init__(name, help, labelnames, max_series)
         bs = sorted(float(b) for b in buckets)
         if not bs:
             raise ValueError(f"{name}: need at least one bucket")
@@ -328,10 +377,16 @@ class Histogram(_Instrument):
         with self._lock:
             st = self._states.get(key)
             if st is None:
-                st = self._states[key] = _HistState(len(self.buckets) + 1)
-            st.counts[i] += 1
-            st.sum += v
-            st.count += 1
+                if not self._admit(self._states, key):
+                    st = None
+                else:
+                    st = self._states[key] = _HistState(len(self.buckets) + 1)
+            if st is not None:
+                st.counts[i] += 1
+                st.sum += v
+                st.count += 1
+                return
+        self._note_drop()
 
     @property
     def count(self) -> int:
@@ -383,17 +438,22 @@ class Registry:
             return m
 
     def counter(self, name: str, help: str,
-                labelnames: Sequence[str] = ()) -> Counter:
-        return self._get_or_create(Counter, name, help, labelnames)
+                labelnames: Sequence[str] = (),
+                max_series: Optional[int] = None) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames,
+                                   max_series=max_series)
 
     def gauge(self, name: str, help: str,
-              labelnames: Sequence[str] = ()) -> Gauge:
-        return self._get_or_create(Gauge, name, help, labelnames)
+              labelnames: Sequence[str] = (),
+              max_series: Optional[int] = None) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames,
+                                   max_series=max_series)
 
     def histogram(self, name: str, help: str, labelnames: Sequence[str] = (),
-                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+                  buckets: Sequence[float] = DEFAULT_BUCKETS,
+                  max_series: Optional[int] = None) -> Histogram:
         return self._get_or_create(Histogram, name, help, labelnames,
-                                   buckets=buckets)
+                                   buckets=buckets, max_series=max_series)
 
     # -- collectors ----------------------------------------------------------
 

@@ -37,6 +37,7 @@ Evictions are executed OUTSIDE the lock via the kubelet-registered evictor.
 
 from __future__ import annotations
 
+import heapq
 import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
@@ -96,6 +97,20 @@ class GangScheduler:
         self._idle_candidates: set = set()
         self._dirty = True
         self._seen_version = -1
+        # Queue-head index: per accelerator type, a min-heap of
+        # (-priority, fairness_at, name) over the waiting gangs — finding
+        # (and re-finding, pass after pass) the admission head is O(log n)
+        # instead of sorting the whole queue.  Entries are invalidated
+        # lazily: admission/removal leaves the tuple behind and the peek
+        # loop discards tuples whose gang is gone, admitted, or re-keyed.
+        self._heaps: Dict[str, List[Tuple[int, float, str]]] = {}
+        # Waiting-gang count per priority class, maintained incrementally
+        # (the depth gauge used to rescan every gang per pass).
+        self._depth: Dict[str, int] = dict.fromkeys(PRIORITY_CLASSES, 0)
+        # queue_info position cache: rebuilt only after membership changes.
+        self._pos_cache: Dict[str, int] = {}
+        self._pos_total = 0
+        self._pos_dirty = True
         # Called OUTSIDE the lock with (pod_keys, reason) to fail a started
         # victim gang's pods; registered by the kubelet.
         self._evictor: Optional[Callable[[List[str], str], None]] = None
@@ -190,6 +205,7 @@ class GangScheduler:
                 if not e.queued:
                     e.queued = True
                     e.enqueued_at = now
+                    self._enter_queue_locked(e)
                     self._dirty = True
                 self._schedule_locked(now, evictions)
             admitted = False
@@ -218,31 +234,78 @@ class GangScheduler:
 
     # ------------------------------------------------------- scheduling pass
 
+    # How far past a blocked head the backfill scan looks.  Bounded so a
+    # pass over a 10k-gang queue stays O(log n + K); queues at or under
+    # the bound see exactly the old exhaustive behavior.
+    BACKFILL_SCAN = 64
+
+    def _enter_queue_locked(self, e: GangEntry) -> None:
+        """Index a gang that became waiting (first enqueue, or un-admitted
+        by a mid-admission failure / unstarted preemption)."""
+        heapq.heappush(self._heaps.setdefault(e.accelerator_type, []),
+                       (-e.priority, e.fairness_at, e.name))
+        self._depth[e.priority_class] = self._depth.get(e.priority_class, 0) + 1
+        self._pos_dirty = True
+
+    def _leave_queue_locked(self, e: GangEntry) -> None:
+        """Un-count a gang that stopped waiting (admitted or removed).
+        Its heap tuple stays behind and is lazily discarded."""
+        self._depth[e.priority_class] = max(
+            0, self._depth.get(e.priority_class, 0) - 1)
+        self._pos_dirty = True
+
+    def _forget_entry_locked(self, e: GangEntry) -> None:
+        """Depth bookkeeping for an entry removed outright (release /
+        idle-reap): only a still-waiting entry holds queue depth."""
+        if e.queued and not e.admitted:
+            self._leave_queue_locked(e)
+
+    def _valid_waiting(self, accel: str, key: Tuple[int, float, str]
+                       ) -> Optional[GangEntry]:
+        negp, fairness_at, name = key
+        e = self._gangs.get(name)
+        if (e is None or not e.queued or e.admitted
+                or e.accelerator_type != accel
+                or e.priority != -negp or e.fairness_at != fairness_at):
+            return None  # stale tuple: gang gone, admitted, or re-keyed
+        return e
+
     def _schedule_locked(self, now: float,
                          evictions: List[Tuple[List[str], str]]) -> None:
         if not self._dirty and self.inventory.version == self._seen_version:
             return
         self._dirty = False
-        # blocked head per accelerator type: gangs behind it may only
-        # backfill; everything is re-derived each pass (queue sizes are
-        # small — gangs, not pods).
-        blocked: Dict[str, GangEntry] = {}
-        for e in sorted_waiting(self._gangs.values()):
-            head = blocked.get(e.accelerator_type)
-            if head is None:
+        # Per accelerator type: admit from the heap head until it blocks
+        # (types are independent — they draw on disjoint slice sets, and a
+        # typeless "" gang draws through its own "" bucket exactly as the
+        # full-sort pass ordered it).  Gangs behind a blocked-but-not-yet-
+        # starving head may backfill, scanned in queue order up to
+        # BACKFILL_SCAN candidates.
+        for accel, heap in self._heaps.items():
+            while heap:
+                e = self._valid_waiting(accel, heap[0])
+                if e is None:
+                    heapq.heappop(heap)
+                    continue
                 if self._try_admit_locked(e, now):
+                    heapq.heappop(heap)
                     continue
                 if self.policy.preemption and self._preempt_for_locked(
                         e, now, evictions):
                     if self._try_admit_locked(e, now):
+                        heapq.heappop(heap)
                         continue
-                blocked[e.accelerator_type] = e
-                continue
-            if not self.policy.backfill:
-                continue
-            if now - head.enqueued_at >= self.policy.starvation_s:
-                continue  # head is starving: hold freed slices for it
-            self._try_admit_locked(e, now, backfill=True)
+                # Blocked head: backfill behind it unless it is starving.
+                if (self.policy.backfill
+                        and now - e.enqueued_at < self.policy.starvation_s):
+                    seen = {e.name}
+                    for key in heapq.nsmallest(self.BACKFILL_SCAN, heap):
+                        cand = self._valid_waiting(accel, key)
+                        if cand is None or cand.name in seen:
+                            continue
+                        seen.add(cand.name)
+                        self._try_admit_locked(cand, now, backfill=True)
+                break
         self._seen_version = self.inventory.version
         self._update_depth_locked()
 
@@ -256,6 +319,7 @@ class GangScheduler:
         e.admitted_at = now
         e.slice_names = slices
         e.coordinator_started = False
+        self._leave_queue_locked(e)
         self._h_wait.labels(e.priority_class).observe(
             max(0.0, now - e.enqueued_at))
         self._c_admit.labels(e.priority_class).inc()
@@ -357,6 +421,7 @@ class GangScheduler:
             v.admitted_at = 0.0
             v.slice_names = []
             v.coordinator_started = False
+            self._enter_queue_locked(v)
             return
         # Started gang: the slice processes must die; the controller
         # replaces the whole gang and the replacement pods re-create this
@@ -374,12 +439,8 @@ class GangScheduler:
             self._evictor(keys, reason)
 
     def _update_depth_locked(self) -> None:
-        depth = dict.fromkeys(PRIORITY_CLASSES, 0)
-        for e in self._gangs.values():
-            if e.queued and not e.admitted:
-                depth[e.priority_class] += 1
-        for cls, n in depth.items():
-            self._g_depth.labels(cls).set(n)
+        for cls in PRIORITY_CLASSES:
+            self._g_depth.labels(cls).set(self._depth.get(cls, 0))
 
     # ------------------------------------------------------- queue reporting
 
@@ -397,17 +458,25 @@ class GangScheduler:
                 return ""
             if not e.queued:
                 return ""
-            waiting = sorted_waiting(self._gangs.values())
-            pos = waiting.index(e) + 1
+            if self._pos_dirty:
+                # Rebuilt once per membership change, not per query: at
+                # 10k queued gangs every gated pod asks for its position
+                # on a poll cadence, and a fresh full sort per ask was
+                # O(pods * q log q).
+                waiting = sorted_waiting(self._gangs.values())
+                self._pos_cache = {w.name: i + 1
+                                   for i, w in enumerate(waiting)}
+                self._pos_total = len(waiting)
+                self._pos_dirty = False
+            pos = self._pos_cache.get(e.name, 0)
             free = self.inventory.free_slice_count(e.accelerator_type)
-            return (f"{REASON_QUEUED_PREFIX}: position {pos}/{len(waiting)} "
+            return (f"{REASON_QUEUED_PREFIX}: position {pos}/{self._pos_total} "
                     f"(class {e.priority_class}); needs {e.num_slices} x "
                     f"{e.accelerator_type or 'any'} slice(s), {free} free")
 
     def queue_depth(self) -> int:
         with self._lock:
-            return sum(1 for e in self._gangs.values()
-                       if e.queued and not e.admitted)
+            return sum(self._depth.values())
 
     # -------------------------------------------------- inventory delegation
 
@@ -443,7 +512,9 @@ class GangScheduler:
 
     def release_gang(self, gang_name: str) -> None:
         with self._lock:
-            self._gangs.pop(gang_name, None)
+            e = self._gangs.pop(gang_name, None)
+            if e is not None:
+                self._forget_entry_locked(e)
             self._fairness.pop(gang_name, None)
             self._idle_candidates.discard(gang_name)
             self._dirty = True
@@ -462,7 +533,9 @@ class GangScheduler:
             confirmed = idle & self._idle_candidates
             self._idle_candidates = idle - confirmed
             for n in confirmed:
-                self._gangs.pop(n, None)
+                gone = self._gangs.pop(n, None)
+                if gone is not None:
+                    self._forget_entry_locked(gone)
                 self._fairness.pop(n, None)
             if confirmed:
                 self._dirty = True
@@ -488,7 +561,9 @@ class GangScheduler:
                 e.admitted_at = 0.0
                 e.slice_names = []
                 e.coordinator_started = False
+                self._enter_queue_locked(e)
                 return []
             del self._gangs[e.name]
+            self._forget_entry_locked(e)
             self._idle_candidates.discard(e.name)
             return keys

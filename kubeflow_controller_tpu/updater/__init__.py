@@ -1,3 +1,4 @@
 """Status rollup (ref: pkg/controller/updater/)."""
 
+from .incremental import RollupCache  # noqa: F401
 from .status import compute_status, set_condition, should_update  # noqa: F401

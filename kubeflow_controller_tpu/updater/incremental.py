@@ -1,0 +1,115 @@
+"""Incremental status rollup: skip ``compute_status`` when its inputs
+didn't change.
+
+``compute_status`` is a pure function of (job, observed pods, recovery
+verdicts): deep-copy the old status, rebuild every per-type rollup, run the
+health checker, aggregate progress, recompute conditions, then ``to_dict``
+twice for ``should_update``.  At ``--scale 200`` that is noise; at 10k jobs
+it dominates the sync — and the overwhelming majority of syncs at scale are
+level-triggered re-passes (resyncs, requeues, sibling-event dedup
+collapses) over a world that did not move.
+
+The cache keys each job's last rollup by the **resourceVersions of every
+input**: the job's own RV plus each observed pod's ``(name, rv)``, plus the
+recovery verdicts the rollup consumes (per-type restart totals and
+exhausted index sets).  Any store write to any input bumps an RV and
+misses the cache; a hit PROVES the recompute would reproduce the cached
+result bit-identically — which the equivalence tests assert over the
+existing corpus (tests/test_scale_hotpaths.py).
+
+Two deliberate exclusions keep the proof honest:
+
+- **Progress-bearing jobs are never cached.**  Stall detection is a
+  function of *wall-clock silence* — the exact situation where no RV
+  changes — so a cached verdict could mask a stall until eviction.  Jobs
+  whose pods publish heartbeats churn pod RVs every beat anyway (each beat
+  is an ``update_progress`` write), so the cache would thrash for them;
+  declining to cache costs nothing and keeps ``StallTracker.observe``
+  running on every sync, exactly as before.
+- **A hit implies "no status write needed."**  The previous miss already
+  computed the status and (if it differed) wrote it — and that write
+  bumped the job RV, which would have missed the cache.  So a hit means
+  the stored status equals the rollup, and the controller skips
+  ``should_update``'s double ``to_dict`` too.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..api.core import Pod
+from ..api.tfjob import ReplicaType, TFJob, TFJobStatus
+from ..utils import locks
+
+
+class RollupCache:
+    """Per-job memo of the last computed ``TFJobStatus``, keyed by the
+    fingerprint of every rollup input.  Thread-safe (sync workers of
+    different shards may roll up concurrently); bounded by ``max_jobs``
+    with oldest-inserted eviction as a leak backstop — the real lifecycle
+    is :meth:`forget` on job deletion."""
+
+    def __init__(self, max_jobs: int = 32768):
+        self._lock = locks.named_lock("updater.rollup-cache")
+        self._max = max_jobs
+        # key -> (fingerprint, status); dict order = insertion order.
+        self._entries: Dict[str, Tuple[tuple, TFJobStatus]] = {}
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def fingerprint(
+        job: TFJob,
+        pods_by_type: Dict[ReplicaType, List[Pod]],
+        recovery=None,
+    ) -> Optional[tuple]:
+        """The rollup's input identity, or None when this job is not
+        cacheable (a pod reports progress: see the module docstring)."""
+        pods_fp: List[tuple] = []
+        for typ in sorted(pods_by_type, key=lambda t: t.value):
+            for p in pods_by_type[typ]:
+                if p.status.progress is not None:
+                    return None
+                pods_fp.append((typ.value, p.metadata.name,
+                                p.metadata.resource_version))
+        rec_fp: tuple = ()
+        if recovery is not None:
+            rec_fp = tuple(
+                (s.tf_replica_type.value,
+                 recovery.restarts_for(s.tf_replica_type),
+                 tuple(sorted(recovery.exhausted(s.tf_replica_type))))
+                for s in job.spec.tf_replica_specs)
+        return (job.metadata.resource_version, tuple(pods_fp), rec_fp)
+
+    def lookup(self, key: str, fp: Optional[tuple]) -> Optional[TFJobStatus]:
+        """The cached status for an unchanged input set, else None.  The
+        returned object is the cached instance itself: rollup consumers
+        treat a computed status as read-only after publication, and on a
+        hit nothing downstream writes it (no change → no write)."""
+        if fp is None:
+            return None
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None and entry[0] == fp:
+                self.hits += 1
+                return entry[1]
+            self.misses += 1
+            return None
+
+    def store(self, key: str, fp: Optional[tuple],
+              status: TFJobStatus) -> None:
+        if fp is None:
+            return
+        with self._lock:
+            if key not in self._entries and len(self._entries) >= self._max:
+                # Leak backstop: evict the oldest-inserted entry.
+                self._entries.pop(next(iter(self._entries)))
+            self._entries[key] = (fp, status)
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._entries.pop(key, None)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

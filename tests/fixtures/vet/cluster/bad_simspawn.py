@@ -1,0 +1,27 @@
+"""Fixture: per-object Thread spawn in a simulated-path module (BAD).
+
+The exact regression the `sim-thread-per-object` rule exists to catch: a
+simulated kubelet quietly growing a thread per pod again.
+"""
+
+import threading
+
+
+class BadSimKubelet:
+    def start(self):
+        # Fine: one fixed loop thread for the whole component.
+        self._main = threading.Thread(target=self._run, name="sim-loop",
+                                      daemon=True)
+        self._main.start()
+
+    def _run(self):
+        pass
+
+    def _spawn(self, pod):
+        # BAD: one thread per pod — O(pods) threads.
+        t = threading.Thread(target=self._drive, args=(pod,),
+                             name="sim-pod", daemon=True)
+        t.start()
+
+    def _drive(self, pod):
+        pass

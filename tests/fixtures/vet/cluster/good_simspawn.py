@@ -1,0 +1,25 @@
+"""Fixture: simulated-path module with O(1) threads (GOOD).
+
+Per-pod work is queued onto the component's single loop thread, which is
+created in start() — the shape `sim-thread-per-object` allows.
+"""
+
+import threading
+
+
+class GoodSimKubelet:
+    def __init__(self):
+        self._timers = []
+        self._main = None
+
+    def start(self):
+        self._main = threading.Thread(target=self._run, name="sim-loop",
+                                      daemon=True)
+        self._main.start()
+
+    def _spawn(self, pod):
+        # Per-pod transitions become timer events, not threads.
+        self._timers.append((0.0, pod))
+
+    def _run(self):
+        pass

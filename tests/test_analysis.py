@@ -93,6 +93,28 @@ class TestVetRules:
         findings, _ = vet_rules("good_rawlock.py")
         assert findings == []
 
+    def test_sim_thread_per_object_bad(self):
+        findings, rules = vet_rules("cluster/bad_simspawn.py")
+        assert rules == {"sim-thread-per-object"}
+        # Only the per-pod spawn is flagged; the start() loop thread is
+        # the allowed fixed-thread shape.
+        assert len(findings) == 1
+        assert "_spawn" in findings[0].message
+
+    def test_sim_thread_per_object_good(self):
+        findings, _ = vet_rules("cluster/good_simspawn.py")
+        assert findings == []
+
+    def test_sim_thread_rule_scoped_to_simulated_paths(self):
+        """The threaded FakeKubelet (cluster/kubelet.py) legitimately
+        spawns per-pod threads for executed pods — the rule must not fire
+        outside cluster/sim* modules."""
+        findings = vet.run(
+            [os.path.join(REPO_ROOT, "kubeflow_controller_tpu", "cluster",
+                          "kubelet.py")],
+            root=REPO_ROOT, skip_catalogue=True)
+        assert not [f for f in findings if f.rule == "sim-thread-per-object"]
+
     def test_lockgraph_bad_cycle_and_blocking(self):
         """The whole-program rule: an inversion split across two call
         chains and a blocking call one hop away — each function is

