@@ -72,7 +72,9 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
+from collections import deque
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
@@ -1717,6 +1719,592 @@ def elastic_main(args) -> int:
     return rc
 
 
+# ---------------------------------------------------------------------------
+# --serve: continuous-batching inference + controller-driven autoscaling
+# ---------------------------------------------------------------------------
+
+class _ThrottledBackend:
+    """A LlamaBackend with a fixed per-call device-time floor: the tiny
+    CPU model decodes in ~0.1 ms, far faster than any real accelerator
+    serves a real model, so the autoscale phase throttles each step to a
+    deterministic service rate — the load step then reliably overwhelms
+    one replica regardless of host speed (the reaction-time gate must
+    measure the CONTROLLER, not CPU luck)."""
+
+    def __init__(self, inner, prefill_s: float = 0.008,
+                 decode_s: float = 0.004):
+        self.inner = inner
+        self.prefill_s = prefill_s
+        self.decode_s = decode_s
+
+    def load(self, serve_cfg) -> None:
+        self.inner.load(serve_cfg)
+
+    def prefill(self, tokens_padded, rows, plen):
+        out = self.inner.prefill(tokens_padded, rows, plen)
+        time.sleep(self.prefill_s)
+        return out
+
+    def decode(self, tokens, positions, page_tables):
+        out = self.inner.decode(tokens, positions, page_tables)
+        time.sleep(self.decode_s)
+        return out
+
+    @property
+    def prefill_compiles(self) -> int:
+        return self.inner.prefill_compiles
+
+    @property
+    def compile_sources(self):
+        return self.inner.compile_sources
+
+
+class _ServeReplica:
+    """Bench-side runtime for ONE Running serving pod: a real ServeEngine
+    (tiny Llama over the slot-paged KV cache, AOT prefill buckets shared
+    across replicas through one cache dir) plus the beat loop that
+    publishes its stats to the pod progress subresource — exactly what
+    the executed `workloads.serve` entrypoint does, collapsed in-process
+    so the bench can drive thousands of requests without sockets."""
+
+    def __init__(self, cluster, pod_name: str, cache_dir: str,
+                 cont_batch: bool, router, namespace: str = "default",
+                 slots: int = 8, throttle: bool = False):
+        from kubeflow_controller_tpu.models.llama import LlamaConfig
+        from kubeflow_controller_tpu.workloads.serve import (
+            LlamaBackend,
+            ServeConfig,
+            ServeEngine,
+        )
+
+        self.cluster = cluster
+        self.namespace = namespace
+        self.pod_name = pod_name
+        self.router = router
+        self.created_t = time.monotonic()
+        self.ready_t = 0.0
+        self.backend = LlamaBackend(LlamaConfig.tiny(), cache_dir=cache_dir)
+        backend = _ThrottledBackend(self.backend) if throttle else self.backend
+        self.engine = ServeEngine(
+            backend,
+            ServeConfig(slots=slots, page_size=16, max_len=128,
+                        prefill_buckets=(16, 32, 64),
+                        cont_batch=cont_batch, stats_window_s=4.0))
+        self.engine.start()
+        self._stop = threading.Event()
+        self._drain_started = False
+        self._thread = threading.Thread(
+            target=self._loop, name=f"serve-replica-{pod_name}", daemon=True)
+        self._thread.start()
+
+    @property
+    def load(self) -> int:
+        st = self.engine.stats()
+        return st.queue_depth + st.slots_used
+
+    @property
+    def available(self) -> bool:
+        return (self.engine.ready and not self.engine.draining
+                and not self._stop.is_set())
+
+    def _loop(self) -> None:
+        from kubeflow_controller_tpu.api.core import PodProgress
+        from kubeflow_controller_tpu.api.labels import ANNOTATION_DRAIN
+        from kubeflow_controller_tpu.cluster.store import APIError, NotFound
+
+        while not self._stop.wait(0.12):
+            try:
+                pod = self.cluster.pods.get(self.namespace, self.pod_name)
+            except (NotFound, APIError):
+                break
+            if self.engine.ready and not self.ready_t:
+                self.ready_t = time.monotonic()
+            if (pod.metadata.annotations.get(ANNOTATION_DRAIN)
+                    and not self._drain_started):
+                self._drain_started = True
+                # Stop intake; re-route the unadmitted queue; in-flight
+                # requests finish — the zero-dropped-requests contract.
+                for req in self.engine.drain():
+                    self.router.resubmit(req)
+            st = self.engine.stats()
+            try:
+                self.cluster.pods.update_progress(
+                    self.namespace, self.pod_name,
+                    PodProgress(step=st.step,
+                                examples_per_sec=st.tokens_per_sec,
+                                phase=st.phase, qps=st.qps,
+                                ttft_ms=st.ttft_ms, itl_ms=st.itl_ms,
+                                queue_depth=st.queue_depth,
+                                slots_used=st.slots_used,
+                                slots_total=st.slots_total))
+            except APIError:
+                break
+            if self._drain_started and self.engine.drained:
+                continue  # keep beating zeros until the kubelet completes
+            if pod.status.phase in ("Succeeded", "Failed"):
+                break
+
+    def stop(self) -> None:
+        self._stop.set()
+        if not self.engine.drained:
+            # Detached with work still queued (pod vanished un-drained):
+            # hand the unadmitted queue back to the router rather than
+            # letting engine.stop() count it dropped.
+            for req in self.engine.drain():
+                self.router.resubmit(req)
+        self.engine.stop()
+        self._thread.join(timeout=5.0)
+
+
+class _ServeRouter:
+    """Open-loop front end: requests route to the least-loaded available
+    replica; with none available they wait in a backlog (requests are
+    never dropped by the router — a drained replica's unadmitted queue
+    comes back through :meth:`resubmit`)."""
+
+    def __init__(self):
+        from kubeflow_controller_tpu.utils import locks
+
+        self._lock = locks.named_lock("bench.serve-router")
+        self.replicas: dict = {}          # pod name -> _ServeReplica
+        self.backlog: deque = deque()
+        self.requests: list = []          # every Request ever issued
+        self.resubmissions = 0
+
+    def add_replica(self, r: "_ServeReplica") -> None:
+        with self._lock:
+            self.replicas[r.pod_name] = r
+
+    def drop_replica(self, name: str):
+        with self._lock:
+            return self.replicas.pop(name, None)
+
+    def submit(self, req) -> None:
+        with self._lock:
+            self.requests.append(req)
+            self.backlog.append(req)
+
+    def resubmit(self, old) -> None:
+        """A drained replica handed back an unadmitted request: re-issue
+        it with the ORIGINAL submit time (TTFT accounting stays honest)
+        and swap it into the master list."""
+        from kubeflow_controller_tpu.workloads.serve import Request
+
+        fresh = Request(id=old.id, tokens=list(old.tokens),
+                        max_new_tokens=old.max_new_tokens,
+                        submit_t=old.submit_t)
+        with self._lock:
+            for i, r in enumerate(self.requests):
+                if r is old:
+                    self.requests[i] = fresh
+                    break
+            self.backlog.append(fresh)
+            self.resubmissions += 1
+
+    def pump(self) -> None:
+        """Route as much backlog as the available replicas will take."""
+        while True:
+            with self._lock:
+                if not self.backlog:
+                    return
+                avail = [r for r in self.replicas.values() if r.available]
+                if not avail:
+                    return
+                req = self.backlog.popleft()
+            target = min(avail, key=lambda r: r.load)
+            if not target.engine.submit(req):
+                with self._lock:
+                    self.backlog.appendleft(req)
+                return
+
+    def outcome(self, deadline_s: float):
+        """(completed, dropped) after waiting out in-flight requests."""
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            self.pump()
+            with self._lock:
+                reqs = list(self.requests)
+                backlog = len(self.backlog)
+            pending = [r for r in reqs
+                       if not r.done.is_set() or r.error == "rerouted"]
+            if not pending and not backlog:
+                break
+            time.sleep(0.02)
+        with self._lock:
+            reqs = list(self.requests)
+        completed = [r for r in reqs if r.done.is_set() and not r.error]
+        dropped = [r for r in reqs if r not in completed]
+        return completed, dropped
+
+
+def _serve_percentiles(reqs) -> dict:
+    ttfts = [r.ttft_s for r in reqs]
+    lats = [r.latency_s for r in reqs]
+    return {
+        "ttft_p50_ms": round(_pct(ttfts, 50) * 1e3, 2),
+        "ttft_p99_ms": round(_pct(ttfts, 99) * 1e3, 2),
+        "latency_p50_ms": round(_pct(lats, 50) * 1e3, 2),
+        "latency_p99_ms": round(_pct(lats, 99) * 1e3, 2),
+    }
+
+
+def _serve_requests(rng, n: int, id_prefix: str, new_range=(8, 48)):
+    """Seeded request mix: short-to-medium prompts, varied output lengths
+    (the spread is what makes static batching pad: every batch runs to
+    its longest member)."""
+    from kubeflow_controller_tpu.workloads.serve import Request
+
+    out = []
+    for i in range(n):
+        out.append(Request(
+            id=f"{id_prefix}-{i}",
+            tokens=[rng.randrange(1, 250)
+                    for _ in range(rng.randrange(4, 48))],
+            max_new_tokens=rng.randrange(*new_range)))
+    return out
+
+
+def _serve_cluster(min_replicas: int, max_replicas: int,
+                   target_queue_depth: float, replicas: int = 1,
+                   autoscale: bool = True, stabilization_s: float = 2.0):
+    """One in-process serving deployment: store + kubelet + controller +
+    a Serving TFJob.  Returns (cluster, kubelet, controller, job name)."""
+    from kubeflow_controller_tpu.api.core import (
+        Container,
+        PodSpec,
+        PodTemplateSpec,
+    )
+    from kubeflow_controller_tpu.api.meta import ObjectMeta
+    from kubeflow_controller_tpu.api.tfjob import (
+        AutoscaleSpec,
+        ReplicaType,
+        TFJob,
+        TFJobSpec,
+        TFReplicaSpec,
+    )
+    from kubeflow_controller_tpu.cluster import (
+        Cluster,
+        FakeKubelet,
+        PhasePolicy,
+    )
+    from kubeflow_controller_tpu.controller import Controller
+
+    cluster = Cluster()
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=0.05))
+    ctrl = Controller(cluster, resync_period_s=2.0)
+    kubelet.start()
+    ctrl.run()
+    tmpl = PodTemplateSpec(spec=PodSpec(
+        containers=[Container(name="srv", image="kctpu/serve")],
+        restart_policy="OnFailure"))
+    job = TFJob(
+        metadata=ObjectMeta(name="serve-bench", namespace="default"),
+        spec=TFJobSpec(
+            autoscale=(AutoscaleSpec(
+                min_replicas=min_replicas, max_replicas=max_replicas,
+                target_queue_depth=target_queue_depth,
+                scale_down_stabilization_s=stabilization_s)
+                if autoscale else None),
+            tf_replica_specs=[TFReplicaSpec(
+                replicas=replicas, tf_replica_type=ReplicaType.SERVING,
+                template=tmpl)]))
+    cluster.tfjobs.create(job)
+    return cluster, kubelet, ctrl
+
+
+def _serve_attach_loop(cluster, router, cache_dir: str, cont_batch: bool,
+                       stop: threading.Event, slots: int = 8,
+                       throttle: bool = False) -> None:
+    """Track Running serving pods: attach a replica runtime to each new
+    one, detach gone ones."""
+    from kubeflow_controller_tpu.cluster.store import APIError
+
+    while not stop.wait(0.05):
+        try:
+            pods = cluster.pods.list("default")
+        except APIError:
+            return
+        live = {p.metadata.name for p in pods
+                if p.metadata.labels.get("job_type") == "Serving"
+                and p.status.phase == "Running"
+                and p.metadata.deletion_timestamp is None}
+        for name in list(router.replicas):
+            if name not in live:
+                r = router.drop_replica(name)
+                if r is not None:
+                    r.stop()
+        for name in live - set(router.replicas):
+            router.add_replica(_ServeReplica(
+                cluster, name, cache_dir, cont_batch, router, slots=slots,
+                throttle=throttle))
+        router.pump()
+
+
+def _serve_throughput_phase(cont_batch: bool, n_requests: int, seed: int,
+                            cache_dir: str, deadline_s: float) -> dict:
+    """Saturation throughput at ONE replica: burst-inject the request set
+    and measure makespan — the continuous-vs-static comparison with no
+    arrival-rate tuning (TTFT percentiles expose the queueing delta)."""
+    import random as _random
+
+    cluster, kubelet, ctrl = _serve_cluster(
+        1, 1, 8.0, replicas=1, autoscale=False)
+    router = _ServeRouter()
+    stop = threading.Event()
+    attach = threading.Thread(
+        target=_serve_attach_loop,
+        args=(cluster, router, cache_dir, cont_batch, stop),
+        name="serve-attach", daemon=True)
+    attach.start()
+    try:
+        t0 = time.monotonic()
+        while not any(r.available for r in router.replicas.values()):
+            if time.monotonic() - t0 > deadline_s:
+                raise RuntimeError("serving replica never became ready")
+            time.sleep(0.02)
+        ready_s = time.monotonic() - t0
+        reqs = _serve_requests(_random.Random(seed), n_requests,
+                               "cont" if cont_batch else "static")
+        t1 = time.monotonic()
+        for r in reqs:
+            r.submit_t = time.monotonic()
+            router.submit(r)
+        completed, dropped = router.outcome(deadline_s)
+        makespan = time.monotonic() - t1
+        tokens = sum(len(r.output) for r in completed)
+        st = next(iter(router.replicas.values())).engine.stats()
+        return {
+            "mode": "continuous" if cont_batch else "static",
+            "requests": n_requests,
+            "completed": len(completed),
+            "dropped": len(dropped),
+            "replica_ready_s": round(ready_s, 3),
+            "makespan_s": round(makespan, 3),
+            "throughput_rps": round(len(completed) / makespan, 2),
+            "tokens_per_sec": round(tokens / makespan, 1),
+            "decode_steps": st.step,
+            "prefill_compiles": st.prefill_compiles,
+            **_serve_percentiles(completed),
+        }
+    finally:
+        stop.set()
+        attach.join(timeout=5.0)
+        for r in list(router.replicas.values()):
+            r.stop()
+        ctrl.stop()
+        kubelet.stop()
+
+
+def _serve_autoscale_phase(seed: int, cache_dir: str,
+                           deadline_s: float) -> dict:
+    """Open-loop arrival sweep against autoscale {1..3}: a low warm-up
+    rate, then a load step; measures autoscaler reaction (rate step ->
+    annotation bump -> new replica ready), then a mid-sweep rolling
+    weight update (gang-generation bump) — gated on zero dropped
+    requests end to end."""
+    import random as _random
+
+    from kubeflow_controller_tpu.api.labels import (
+        ANNOTATION_GANG_GENERATION,
+        ANNOTATION_SERVING_REPLICAS,
+    )
+
+    rng = _random.Random(seed)
+    cluster, kubelet, ctrl = _serve_cluster(1, 3, 4.0, replicas=1,
+                                            stabilization_s=2.0)
+    router = _ServeRouter()
+    stop = threading.Event()
+    # Small throttled replicas (2 slots, fixed per-step device time):
+    # one replica's capacity (~10-12 req/s) sits deterministically below
+    # the load step, so the sweep exercises real scaling rather than the
+    # warm tiny model absorbing everything.
+    attach = threading.Thread(
+        target=_serve_attach_loop,
+        args=(cluster, router, cache_dir, True, stop, 2, True),
+        name="serve-attach-auto", daemon=True)
+    attach.start()
+    result: dict = {"reaction_annotation_s": -1.0, "reaction_ready_s": -1.0}
+    try:
+        t0 = time.monotonic()
+        while not any(r.available for r in router.replicas.values()):
+            if time.monotonic() - t0 > deadline_s:
+                raise RuntimeError("serving replica never became ready")
+            time.sleep(0.02)
+
+        def inject(rate_rps: float, duration_s: float, prefix: str):
+            n = max(1, int(rate_rps * duration_s))
+            interval = duration_s / n
+            batch = _serve_requests(rng, n, prefix, new_range=(16, 64))
+            for r in batch:
+                r.submit_t = time.monotonic()
+                router.submit(r)
+                router.pump()
+                time.sleep(interval)
+
+        # Warm-up rate: one replica absorbs it.
+        inject(5.0, 3.0, "warm")
+        # Load step: ~4x one throttled replica's capacity — the
+        # autoscaler must react.
+        stepper = threading.Thread(
+            target=inject, args=(40.0, 6.0, "step"),
+            name="serve-load-step", daemon=True)
+        stepper.start()
+        replicas_seen = 1
+        step_t = time.monotonic()
+        while time.monotonic() - step_t < deadline_s:
+            j = cluster.tfjobs.get("default", "serve-bench")
+            ann = int(j.metadata.annotations.get(
+                ANNOTATION_SERVING_REPLICAS, "1") or "1")
+            if ann > 1 and result["reaction_annotation_s"] < 0:
+                result["reaction_annotation_s"] = round(
+                    time.monotonic() - step_t, 3)
+            ready = sum(1 for r in router.replicas.values() if r.available)
+            replicas_seen = max(replicas_seen, ready)
+            if ready > 1 and result["reaction_ready_s"] < 0:
+                result["reaction_ready_s"] = round(
+                    time.monotonic() - step_t, 3)
+                break
+            time.sleep(0.05)
+        stepper.join()
+        result["max_replicas_reached"] = replicas_seen
+
+        # Mid-sweep rolling weight update under continued load.
+        def bump(m):
+            cur = int(m.annotations.get(ANNOTATION_GANG_GENERATION, "0")
+                      or "0")
+            m.annotations[ANNOTATION_GANG_GENERATION] = str(cur + 1)
+
+        cluster.tfjobs.patch_meta("default", "serve-bench", bump)
+        roll_t = time.monotonic()
+        roller = threading.Thread(
+            target=inject, args=(8.0, 8.0, "roll"),
+            name="serve-roll-load", daemon=True)
+        roller.start()
+        rolled = False
+        while time.monotonic() - roll_t < deadline_s:
+            pods = [p for p in cluster.pods.list("default")
+                    if p.metadata.labels.get("job_type") == "Serving"
+                    and p.status.phase == "Running"
+                    and p.metadata.deletion_timestamp is None]
+            if pods and all(
+                    p.metadata.annotations.get(ANNOTATION_GANG_GENERATION)
+                    == "1" for p in pods):
+                rolled = True
+                break
+            time.sleep(0.05)
+        roller.join()
+        result["rolled"] = rolled
+        result["roll_s"] = round(time.monotonic() - roll_t, 3)
+
+        completed, dropped = router.outcome(deadline_s)
+        result.update({
+            "requests": len(router.requests),
+            "completed": len(completed),
+            "dropped": len(dropped),
+            "dropped_ids": [r.id for r in dropped][:10],
+            "resubmissions": router.resubmissions,
+            **_serve_percentiles(completed),
+        })
+        # Replica cold/warm startup evidence: every replica after the
+        # first should AOT cache-hit its prefill/decode programs.
+        result["replica_ready_s"] = sorted(
+            round(r.ready_t - r.created_t, 3)
+            for r in router.replicas.values() if r.ready_t)
+        result["compile_sources"] = sorted(
+            src for r in router.replicas.values()
+            for src in getattr(r.backend, "compile_sources", []))
+        events = [e.reason
+                  for e in ctrl.recorder.events_for("default", "serve-bench")]
+        result["scale_events"] = {
+            r: events.count(r)
+            for r in ("ServingScaledUp", "ServingScaledDown",
+                      "ServingDraining") if r in events}
+        return result
+    finally:
+        stop.set()
+        attach.join(timeout=5.0)
+        for r in list(router.replicas.values()):
+            r.stop()
+        ctrl.stop()
+        kubelet.stop()
+
+
+def run_serve(n_requests: int = 120, seed: int = 7,
+              deadline_s: float = 120.0, static_only: bool = False) -> dict:
+    import shutil
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="serve-bench-aot-")
+    try:
+        static = _serve_throughput_phase(False, n_requests, seed,
+                                         cache_dir, deadline_s)
+        out = {"static": static}
+        if static_only:
+            return out
+        cont = _serve_throughput_phase(True, n_requests, seed,
+                                       cache_dir, deadline_s)
+        out["continuous"] = cont
+        out["throughput_ratio"] = round(
+            cont["tokens_per_sec"] / max(static["tokens_per_sec"], 1e-9), 3)
+        out["autoscale"] = _serve_autoscale_phase(seed, cache_dir,
+                                                  deadline_s)
+        return out
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
+def serve_main(args) -> int:
+    result = run_serve(n_requests=args.serve_requests, seed=args.seed,
+                       deadline_s=args.deadline or 120.0,
+                       static_only=args.no_cont_batch)
+    if args.no_cont_batch:
+        print(json.dumps({
+            "metric": "serve_static_batch_tokens_per_sec",
+            "value": result["static"]["tokens_per_sec"],
+            "unit": "tokens/s",
+            "details": result,
+        }))
+        return 0
+    ratio = result["throughput_ratio"]
+    print(json.dumps({
+        "metric": "serve_cont_batch_throughput_ratio",
+        "value": ratio,
+        "unit": "x static-batch tokens/sec",
+        "details": result,
+    }))
+    rc = 0
+    cont, static, auto = (result["continuous"], result["static"],
+                          result["autoscale"])
+    if args.min_cont_ratio > 0 and ratio < args.min_cont_ratio:
+        print(f"serve bench regression: continuous batching only {ratio}x "
+              f"static-batch throughput (< {args.min_cont_ratio})",
+              file=sys.stderr)
+        rc = 1
+    if cont["ttft_p99_ms"] > static["ttft_p99_ms"]:
+        print(f"serve bench regression: continuous p99 TTFT "
+              f"{cont['ttft_p99_ms']}ms worse than static "
+              f"{static['ttft_p99_ms']}ms", file=sys.stderr)
+        rc = 1
+    if cont["dropped"] or static["dropped"] or auto["dropped"]:
+        print(f"serve bench regression: dropped requests "
+              f"(static {static['dropped']}, cont {cont['dropped']}, "
+              f"autoscale {auto['dropped']} {auto.get('dropped_ids')})",
+              file=sys.stderr)
+        rc = 1
+    if (args.max_reaction_s > 0
+            and not 0 <= auto["reaction_ready_s"] <= args.max_reaction_s):
+        print(f"serve bench regression: autoscaler reaction "
+              f"{auto['reaction_ready_s']}s (annotation "
+              f"{auto['reaction_annotation_s']}s) outside bound "
+              f"{args.max_reaction_s}s", file=sys.stderr)
+        rc = 1
+    if not auto["rolled"]:
+        print("serve bench regression: rolling weight update did not "
+              "complete", file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def _ttfs_phases(trace_dir: str) -> dict:
     """Per-phase breakdown of one TTFS run from the workers' span dumps:
     worst-across-workers duration per pipeline phase (the job's TTFS is
@@ -2728,6 +3316,26 @@ def main(argv=None) -> int:
                    metavar="X",
                    help="--ha gate: N-shard syncs/sec must be >= X x "
                         "single-controller (0 = no gate; ISSUE 12 gates 1.5)")
+    p.add_argument("--serve", action="store_true",
+                   help="serving plane: continuous-batching throughput vs "
+                        "the --no-cont-batch static baseline at 1 replica "
+                        "(burst saturation, TTFT/latency p50/p99), then an "
+                        "open-loop arrival sweep against autoscale {1..3} "
+                        "measuring reaction time and a mid-sweep rolling "
+                        "weight update (zero dropped requests gated)")
+    p.add_argument("--serve-requests", type=int, default=120, metavar="N",
+                   help="requests per throughput phase (default 120)")
+    p.add_argument("--no-cont-batch", action="store_true",
+                   help="--serve: run ONLY the static-batch baseline "
+                        "(admission at batch boundaries, finished "
+                        "sequences pad to the longest)")
+    p.add_argument("--min-cont-ratio", type=float, default=0.0, metavar="R",
+                   help="--serve gate: continuous/static throughput ratio "
+                        "floor (0 = report only)")
+    p.add_argument("--max-reaction-s", type=float, default=0.0, metavar="S",
+                   help="--serve gate: autoscaler load-step reaction bound "
+                        "(rate step -> second replica ready; 0 = report "
+                        "only)")
     p.add_argument("--record-history", action="store_true",
                    help="scale mode: attach the linearizability checker's "
                         "op recorder to the store and gate cross-kind RV "
@@ -2744,6 +3352,8 @@ def main(argv=None) -> int:
         return scale_main(args)
     if args.replicas:
         return widejob_main(args)
+    if args.serve:
+        return serve_main(args)
     if args.elastic:
         return elastic_main(args)
     if args.chaos:

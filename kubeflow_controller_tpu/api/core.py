@@ -110,6 +110,18 @@ class PodProgress:
     # phase="restore" — what tells the stall detector a step counter that
     # jumped backward is a resume, not a stall.
     resumed_from_step: int = 0
+    # --- serving plane (workloads/serve.py; all 0 for training pods) ---
+    # For a serving replica, ``step`` above counts decode-loop steps (it
+    # freezes when the replica is idle — which is why phase="serving"
+    # holds the frozen-step stall deadline) and ``examples_per_sec`` is
+    # output tokens/sec.  The gauges below are what the controller's
+    # autoscaler and the ServingStatus rollup consume.
+    qps: float = 0.0            # completed requests/sec (rolling window)
+    ttft_ms: float = 0.0        # time-to-first-token p50 over the window
+    itl_ms: float = 0.0         # inter-token latency mean over the window
+    queue_depth: int = 0        # requests waiting for a slot (intake queue)
+    slots_used: int = 0         # sequences currently in the running batch
+    slots_total: int = 0        # batch slots this replica owns
     # Wall-clock of the beat (stamped server-side when the reporter left
     # it 0, so clock-skewed workloads cannot fake liveness).
     timestamp: float = 0.0

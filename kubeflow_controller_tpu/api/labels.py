@@ -51,6 +51,17 @@ ANNOTATION_GANG_WIDTH = f"{DOMAIN}/gang-width"
 # harvesting is slice-granular).
 ANNOTATION_ELASTIC_MIN_WIDTH = f"{DOMAIN}/elastic-min-width"
 ANNOTATION_ELASTIC_MIN_SLICES = f"{DOMAIN}/elastic-min-slices"
+# --- serving plane (net-new) ---
+# Current replica target of the job's Serving set, written on the TFJob by
+# the controller's autoscaler (absent = autoscale.minReplicas, else
+# spec.replicas).  The serving analog of the elastic gang-width: planner,
+# updater and health checker all plan/measure against this one annotation.
+ANNOTATION_SERVING_REPLICAS = f"{DOMAIN}/serving-replicas"
+# Graceful-drain handshake, written on a Serving POD by the controller
+# (planner DrainPod event): the replica must stop intake, finish in-flight
+# requests, and exit 0.  The kubelet SIGTERMs executed pods and completes
+# simulated pods once their beats show an empty queue and empty slots.
+ANNOTATION_DRAIN = f"{DOMAIN}/drain"
 
 
 def selector_for(job_name: str, replica_type: str, runtime_id: str) -> dict:

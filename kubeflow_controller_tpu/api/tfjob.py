@@ -37,12 +37,15 @@ PLURAL = "tfjobs"
 
 
 class ReplicaType(str, enum.Enum):
-    """ref: types.go:66-74 (PS/Worker/Local) + net-new TPU."""
+    """ref: types.go:66-74 (PS/Worker/Local) + net-new TPU + net-new
+    SERVING (long-running continuous-batching inference replicas, never
+    rolled up to Succeeded — the serving plane, docs/SERVING.md)."""
 
     PS = "PS"
     WORKER = "Worker"
     LOCAL = "Local"
     TPU = "TPU"
+    SERVING = "Serving"
 
 
 class TFJobPhase(str, enum.Enum):
@@ -204,6 +207,31 @@ class ElasticSpec:
 
 
 @dataclass
+class AutoscaleSpec:
+    """Net-new (serving plane): horizontal autoscaling bounds for the
+    job's Serving replica set.
+
+    The controller scales the CURRENT replica target (the serving-replicas
+    annotation, the runtime-width analog of the elastic gang-width) on the
+    queue-depth gauges the replicas publish through the progress plane:
+    desired = ceil(current * avg_queue_depth / target_queue_depth), the
+    HPA formula, clamped to [min, max].  ``tolerance`` and
+    ``scale_down_stabilization_s`` are the hysteresis that keeps the
+    target from flapping around ``target_queue_depth`` (serving/
+    autoscale.py; scale-up is immediate, scale-down waits out the
+    stabilization window and drains gracefully)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    # Per-replica intake-queue depth the autoscaler drives toward.
+    target_queue_depth: float = 4.0
+    # No scaling while |avg/target - 1| <= tolerance.
+    tolerance: float = 0.2
+    # Continuous below-threshold time required before scaling down.
+    scale_down_stabilization_s: float = 3.0
+
+
+@dataclass
 class TFReplicaSpec:
     """ref: types.go:58-79."""
 
@@ -258,6 +286,9 @@ class TFJobSpec:
     # gang replica set (None = width is fixed at spec.replicas, every
     # member loss is whole-gang recovery).
     elastic: Optional[ElasticSpec] = None
+    # Net-new (serving plane): autoscaling bounds for the job's Serving
+    # replica set (None = the replica count is fixed at spec.replicas).
+    autoscale: Optional[AutoscaleSpec] = None
     tf_replica_specs: List[TFReplicaSpec] = field(default_factory=list)
 
 
@@ -346,9 +377,29 @@ class JobWidth:
 
 
 @dataclass
+class ServingStatus:
+    """Serving-plane rollup, aggregated from the Serving replicas' beats
+    (None on non-serving jobs so the pre-serving status shape serializes
+    unchanged).  ``replicas`` is the controller's CURRENT scale target;
+    ``ready`` counts replicas past model-load + first decode step
+    (phase="serving")."""
+
+    replicas: int = 0
+    ready: int = 0
+    qps: float = 0.0             # summed across ready replicas
+    ttft_ms: float = 0.0         # worst replica's windowed p50 TTFT
+    itl_ms: float = 0.0          # worst replica's windowed inter-token p50
+    queue_depth: int = 0         # summed intake backlog
+    occupancy: float = 0.0       # mean slots_used/slots_total over ready
+    min_replicas: int = 0        # autoscale bounds (0/0 = fixed scale)
+    max_replicas: int = 0
+    target_queue_depth: float = 0.0
+
+
+@dataclass
 class TFJobStatus:
-    """ref: types.go:92-101 (+ net-new training-plane ``progress`` and
-    elastic-plane ``width``)."""
+    """ref: types.go:92-101 (+ net-new training-plane ``progress``,
+    elastic-plane ``width``, serving-plane ``serving``)."""
 
     phase: TFJobPhase = TFJobPhase.NONE
     reason: str = ""
@@ -356,6 +407,7 @@ class TFJobStatus:
     tf_replica_statuses: List[TFReplicaStatus] = field(default_factory=list)
     progress: Optional[JobProgress] = None
     width: Optional[JobWidth] = None
+    serving: Optional[ServingStatus] = None
 
 
 @dataclass
@@ -431,6 +483,12 @@ def validate_tfjob(job: TFJob) -> None:
                 raise ValidationError("Local jobs must have exactly one replica spec")
             if s.replicas != 1:
                 raise ValidationError("Local jobs must have replicas == 1")
+        if s.tf_replica_type == ReplicaType.SERVING:
+            # A serving replica may pin a slice topology (each replica is
+            # admitted alone onto one slice through the scheduler), but is
+            # never a multi-host gang.
+            if s.tpu is not None:
+                validate_tpu_spec(s.tpu)
         if s.tf_replica_type == ReplicaType.TPU:
             if s.tpu is None:
                 raise ValidationError("TPU replica spec requires .tpu topology")
@@ -475,6 +533,29 @@ def validate_tfjob(job: TFJob) -> None:
                     f"elastic.minWidth {el.min_width} must be a multiple of "
                     f"the slice host count ({per}): TPU width changes are "
                     f"slice-granular")
+    if job.spec.autoscale is not None:
+        a = job.spec.autoscale
+        serving = [s for s in specs if s.tf_replica_type == ReplicaType.SERVING]
+        if len(serving) != 1:
+            raise ValidationError(
+                "spec.autoscale requires exactly one Serving replica set")
+        if a.min_replicas < 1:
+            raise ValidationError("autoscale.minReplicas must be >= 1")
+        if a.max_replicas < a.min_replicas:
+            raise ValidationError(
+                f"autoscale.maxReplicas {a.max_replicas} < minReplicas "
+                f"{a.min_replicas}")
+        if a.target_queue_depth <= 0:
+            raise ValidationError("autoscale.targetQueueDepth must be > 0")
+        if not 0 <= a.tolerance < 1:
+            raise ValidationError("autoscale.tolerance must be in [0, 1)")
+        if a.scale_down_stabilization_s < 0:
+            raise ValidationError(
+                "autoscale.scaleDownStabilizationS must be >= 0")
+        if not a.min_replicas <= serving[0].replicas <= a.max_replicas:
+            raise ValidationError(
+                f"Serving replicas({serving[0].replicas}) outside autoscale "
+                f"range {a.min_replicas}..{a.max_replicas}")
     # Chief termination policy must name an existing replica type/index.
     for s in specs:
         tp = s.termination_policy
@@ -503,6 +584,20 @@ def is_local_job(job: TFJob) -> bool:
 def is_tpu_job(job: TFJob) -> bool:
     """Net-new classifier: any replica spec of type TPU."""
     return any(s.tf_replica_type == ReplicaType.TPU for s in job.spec.tf_replica_specs)
+
+
+def is_serving_job(job: TFJob) -> bool:
+    """Net-new classifier (serving plane): any Serving replica set."""
+    return any(s.tf_replica_type == ReplicaType.SERVING
+               for s in job.spec.tf_replica_specs)
+
+
+def serving_spec(job: TFJob) -> Optional[TFReplicaSpec]:
+    """The job's Serving replica set (validation guarantees at most one)."""
+    for s in job.spec.tf_replica_specs:
+        if s.tf_replica_type == ReplicaType.SERVING:
+            return s
+    return None
 
 
 def elastic_gang_spec(job: TFJob) -> Optional[TFReplicaSpec]:

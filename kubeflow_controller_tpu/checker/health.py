@@ -140,8 +140,14 @@ class StallTracker:
         # the step counter while survivors restore the checkpoint at the
         # new width and rebalance their data shards — long enough, it
         # would otherwise edge-trigger a spurious TrainingStalled.
+        # Serving phases hold the deadline the same way: an
+        # idle-but-healthy serving replica ("serving") freezes its decode
+        # step counter BY DESIGN between requests, "load" is the model
+        # load + AOT warmup window, and "drain" finishes in-flight work
+        # with intake closed.  The heartbeat deadline still applies to
+        # all of them — a dead server stops beating and is flagged.
         held_phase = getattr(progress, "phase", "") in (
-            "compile", "restore", "reshard")
+            "compile", "restore", "reshard", "load", "serving", "drain")
         with self._lock:
             last_step, advanced_at, _, restoring = self._steps.get(
                 key, (None, 0.0, 0.0, False))

@@ -200,6 +200,16 @@ def _progress_cells(j) -> tuple:
     return step, f"{p.examples_per_sec:g}"
 
 
+def _serving_cells(j) -> tuple:
+    """(QPS, TTFT) cells for a job row — serving jobs only, '-' elsewhere
+    (QPS = summed completed requests/sec across ready replicas, TTFT = the
+    worst replica's windowed p50 time-to-first-token)."""
+    sv = j.status.serving
+    if sv is None:
+        return "-", "-"
+    return f"{sv.qps:g}", f"{sv.ttft_ms:g}ms"
+
+
 def _fetch_lease(cluster):
     """The controller leader lease, or None (no HA control plane / server
     unreachable) — what `get`/`describe`/`top` surface leadership from."""
@@ -262,7 +272,8 @@ def cmd_get(args) -> int:
         print("No resources found.")
         return 0
     print(f"{'NAMESPACE':<12} {'NAME':<32} {'PHASE':<12} {'REASON':<28} "
-          f"{'STEP':<10} {'RATE':<10} {'RESTARTS':<9} {'SHARD':<6} REPLICAS")
+          f"{'STEP':<10} {'RATE':<10} {'QPS':<8} {'TTFT':<9} "
+          f"{'RESTARTS':<9} {'SHARD':<6} REPLICAS")
     for j in jobs:
         kinds = ",".join(
             f"{s.tf_replica_type.value}x{s.replicas}" for s in j.spec.tf_replica_specs
@@ -271,6 +282,10 @@ def cmd_get(args) -> int:
         w = j.status.width
         if w is not None and w.current < w.spec:
             kinds += f"[w={w.current}]"
+        # Serving scale, when live: "Servingx1[s=3/3]" (current/ready).
+        sv = j.status.serving
+        if sv is not None and sv.replicas:
+            kinds += f"[s={sv.ready}/{sv.replicas}]"
         # kubectl parity: deletionTimestamp set -> Terminating (a job stays
         # in this state until a running controller processes its finalizer).
         phase = ("Terminating" if j.metadata.deletion_timestamp is not None
@@ -282,11 +297,13 @@ def cmd_get(args) -> int:
         if len(reason) > 27:
             reason = reason[:26] + "…"
         step, rate = _progress_cells(j)
+        qps, ttft = _serving_cells(j)
         # kubectl RESTARTS parity: the recovery plane's monotonic restart
         # total across every replica of the job.
         restarts = sum(rs.restarts for rs in j.status.tf_replica_statuses)
         print(f"{j.metadata.namespace:<12} {j.metadata.name:<32} "
               f"{phase:<12} {reason:<28} {step:<10} {rate:<10} "
+              f"{qps:<8} {ttft:<9} "
               f"{restarts:<9} {_shard_cell(j, lease):<6} {kinds}")
     return 0
 
@@ -324,6 +341,7 @@ def cmd_describe(args) -> int:
         w = j.status.width
         tag = "  DEGRADED (replacement warming)" if w.current < w.spec else ""
         print(f"Width:     {w.current}/{w.spec} (elastic floor {w.min}){tag}")
+    _describe_serving(j)
     if j.status.reason.startswith("GangQueued"):
         print(f"Queue:     {j.status.reason}")
     for c in j.status.conditions:
@@ -354,6 +372,22 @@ def cmd_describe(args) -> int:
             age = _age(now - (e.last_timestamp or e.first_timestamp))
             print(f"  {age:>6}  {e.type:<8} {e.reason:<18} x{e.count}  {e.message}")
     return 0
+
+
+def _describe_serving(j) -> None:
+    """Serving section: replicas ready vs the autoscaler's target, live
+    throughput/latency, batch occupancy, and the autoscale bounds."""
+    sv = j.status.serving
+    if sv is None:
+        return
+    bounds = (f"autoscale {sv.min_replicas}..{sv.max_replicas} "
+              f"@ queue depth {sv.target_queue_depth:g}"
+              if sv.max_replicas else "fixed scale")
+    print(f"Serving:   {sv.ready}/{sv.replicas} replicas ready ({bounds})")
+    if sv.ready:
+        print(f"           qps={sv.qps:g} ttft(p50)={sv.ttft_ms:g}ms "
+              f"itl={sv.itl_ms:g}ms queue={sv.queue_depth} "
+              f"occupancy={sv.occupancy:.0%}")
 
 
 def _describe_compile_cache(j) -> None:
@@ -518,7 +552,8 @@ def cmd_top(args) -> int:
             print(_leader_line(lease))
             _print_shard_depths(cluster, jobs, lease)
         print(f"{'NAMESPACE':<12} {'NAME':<32} {'PHASE':<10} {'STEP':<10} "
-              f"{'RATE':<10} {'LOSS':<10} {'LAG':<6} {'STALLED':<20} "
+              f"{'RATE':<10} {'QPS':<8} {'TTFT':<9} {'OCC':<5} "
+              f"{'LOSS':<10} {'LAG':<6} {'STALLED':<20} "
               f"{'SHARD':<6} BEAT")
         # Stalled jobs surface first (the rows an operator is looking for),
         # then the busiest.
@@ -539,8 +574,12 @@ def cmd_top(args) -> int:
                 stalled = ",".join(p.stalled_replicas) or "no"
                 beat = (_age(now - p.last_heartbeat) if p.last_heartbeat
                         else "never")
+            qps, ttft = _serving_cells(j)
+            sv = j.status.serving
+            occ = f"{sv.occupancy:.0%}" if sv is not None and sv.ready else "-"
             print(f"{j.metadata.namespace:<12} {j.metadata.name:<32} "
                   f"{j.status.phase.value:<10} {step:<10} {rate:<10} "
+                  f"{qps:<8} {ttft:<9} {occ:<5} "
                   f"{loss:<10} {lag:<6} {stalled:<20} "
                   f"{_shard_cell(j, lease):<6} {beat}")
         if not args.watch:

@@ -39,7 +39,7 @@ from ..api.core import (
 from ..api.labels import LABEL_JOB_TYPE
 from ..utils import locks
 from .client import Cluster
-from .store import ADDED, DELETED, NotFound
+from .store import ADDED, DELETED, MODIFIED, NotFound
 from .tpu import TPUInventory, pod_requests_tpu
 
 
@@ -53,8 +53,9 @@ class PhasePolicy:
     # bench run short foreground jobs against a long-running victim
     # (e.g. the elastic harvest probe) under one kubelet.
     run_s_by_job: Dict[str, float] = field(default_factory=dict)
-    # Replica types that never reach a terminal phase on their own.
-    run_forever_types: tuple = ("PS",)
+    # Replica types that never reach a terminal phase on their own
+    # (Serving replicas exit only through the drain protocol).
+    run_forever_types: tuple = ("PS", "Serving")
     # Pod names to fail once (fault injection for recovery tests).
     fail_once: Set[str] = field(default_factory=set)
     # Simulated startup cost for TPU gang pods (the interpreter-import +
@@ -118,6 +119,12 @@ class FakeKubelet:
         # the drive loop must not restart them in place — the slice is
         # gone; replacement is the controller's job.
         self._injected_failures: Set[str] = set()
+        # Serving drain protocol (docs/SERVING.md): pods whose drain
+        # annotation we have acted on.  Executed pods get SIGTERM (their
+        # serve loop closes intake, finishes in-flight and exits 0);
+        # simulated pods are completed by the drive loop once their beats
+        # show an empty queue and empty slots (or they never reported).
+        self._draining: Set[str] = set()
         # Gangs that have run on this node before: their readmission is
         # warm (see PhasePolicy.cold_start_s/warm_start_s).
         self._warm_gangs: Set[str] = set()
@@ -371,11 +378,14 @@ class FakeKubelet:
                 self.inventory.release_idle_gangs(live)
             if not self._hb_suspended:
                 self._ingest_progress()
+            self._check_draining()
             ev = self._watcher.next(timeout=0.2)
             if ev is None:
                 continue
             if ev.type == ADDED:
                 self._spawn(ev.object)
+            elif ev.type == MODIFIED:
+                self._maybe_drain(ev.object)
             elif ev.type == DELETED:
                 key = self._key(ev.object)
                 proc = self._procs.get(key)
@@ -390,6 +400,66 @@ class FakeKubelet:
     @staticmethod
     def _key(pod: Pod) -> str:
         return f"{pod.metadata.namespace}/{pod.metadata.name}"
+
+    # -- serving drain -------------------------------------------------------
+
+    def _maybe_drain(self, pod: Pod) -> None:
+        """React (once) to a pod's drain annotation: SIGTERM the executed
+        process — its serve loop stops intake, finishes in-flight
+        requests and exits 0 (a LONG escalation grace: killing a draining
+        server mid-request is exactly what the protocol exists to avoid)
+        — or queue the simulated pod for beat-gated completion."""
+        from ..api.labels import ANNOTATION_DRAIN
+
+        if not pod.metadata.annotations.get(ANNOTATION_DRAIN):
+            return
+        key = self._key(pod)
+        if key in self._draining:
+            return
+        if pod.status.phase in (PHASE_SUCCEEDED, PHASE_FAILED):
+            return
+        self._draining.add(key)
+        proc = self._procs.get(key)
+        if proc is not None:
+            self._terminate_proc(proc, grace_s=30.0)
+            return
+        warm = self._warm.get(key)
+        if warm is not None and warm.pid:
+            import signal as _signal
+
+            try:
+                os.kill(warm.pid, _signal.SIGTERM)
+            except OSError:
+                pass
+
+    def _check_draining(self) -> None:
+        """Complete simulated draining pods whose beats ACKNOWLEDGE the
+        drain (phase="drain") and show it finished (empty queue, empty
+        batch) — or that never reported at all (pure-simulated pods have
+        no intake to drain).  The acknowledgment is load-bearing: an idle
+        pre-drain beat (queue 0, slots 0) must NOT complete the pod,
+        because a request may be routed in the window before the replica
+        notices its drain annotation and closes intake — completing on a
+        stale idle beat would kill that request mid-flight.  A replica
+        that wedges mid-drain keeps its heartbeat deadline (checker):
+        the stall detector, not this loop, owns that failure mode."""
+        for key in list(self._draining):
+            ns, _, name = key.partition("/")
+            try:
+                pod = self.cluster.pods.get(ns, name)
+            except NotFound:
+                self._draining.discard(key)
+                continue
+            if pod.status.phase in (PHASE_SUCCEEDED, PHASE_FAILED):
+                self._draining.discard(key)
+                continue
+            if key in self._procs or key in self._warm:
+                continue  # executed: the process exits on its own
+            pr = pod.status.progress
+            if pr is None or (pr.phase == "drain" and pr.queue_depth == 0
+                              and pr.slots_used == 0):
+                self._draining.discard(key)
+                self.set_phase(ns, name, PHASE_SUCCEEDED, reason="Drained")
 
     @staticmethod
     def _terminate_proc(proc, grace_s: float = 0.5) -> None:

@@ -67,6 +67,7 @@ from ..recovery.policy import (
     RestartTracker,
 )
 from ..elastic import ElasticEngine, ElasticPolicy
+from ..serving import ServingAutoscaler
 from .events import (
     EventRecorder,
     REASON_BACKOFF_LIMIT_EXCEEDED,
@@ -76,6 +77,9 @@ from .events import (
     REASON_GANG_QUEUED,
     REASON_GANG_RESTORED,
     REASON_REPLICA_RESTARTED,
+    REASON_SERVING_DRAINING,
+    REASON_SERVING_SCALED_DOWN,
+    REASON_SERVING_SCALED_UP,
     REASON_TRAINING_RESUMED,
     REASON_TRAINING_STALLED,
     TYPE_NORMAL,
@@ -161,6 +165,40 @@ class Controller:
         # from the LAST sync, for edge-triggered GangQueued/GangAdmitted/
         # GangPreempted events (shares the stalled lock — same cadence).
         self._gang_state: Dict[str, str] = {}
+        # Serving plane: the queue-depth autoscaler (serving/autoscale.py)
+        # and the per-job set of replica indices whose serving gauge
+        # series are live — scale-down calls Gauge.remove for indices
+        # that left, freeing the metric series budget (shares the
+        # stalled lock — same cadence).
+        self.serving_autoscaler = ServingAutoscaler()
+        self._serving_series: Dict[str, frozenset] = {}
+        self._g_serve_queue = REGISTRY.gauge(
+            "kctpu_serve_queue_depth",
+            "Serving replica intake-queue depth (requests waiting for a "
+            "batch slot)", ("namespace", "tfjob", "replica"))
+        self._g_serve_occ = REGISTRY.gauge(
+            "kctpu_serve_batch_occupancy",
+            "Serving replica batch occupancy (slots in use / slots)",
+            ("namespace", "tfjob", "replica"))
+        self._g_serve_qps = REGISTRY.gauge(
+            "kctpu_serve_qps",
+            "Job-level serving throughput (completed requests/sec summed "
+            "across ready replicas)", ("namespace", "tfjob"))
+        self._g_serve_ttft = REGISTRY.gauge(
+            "kctpu_serve_ttft_ms",
+            "Worst replica's windowed p50 time-to-first-token",
+            ("namespace", "tfjob"))
+        self._g_serve_replicas = REGISTRY.gauge(
+            "kctpu_serve_replicas",
+            "Current Serving replica target (the autoscaler-written "
+            "serving-replicas annotation)", ("namespace", "tfjob"))
+        self._g_serve_ready = REGISTRY.gauge(
+            "kctpu_serve_replicas_ready",
+            "Serving replicas past model load + first decode step",
+            ("namespace", "tfjob"))
+        self._c_serve_scale = REGISTRY.counter(
+            "kctpu_serve_scale_events_total",
+            "Autoscaler target changes by direction", ("direction",))
         # Job-level progress gauges (namespace+job labels; series removed
         # on job deletion — see _drop_progress_series).
         self._g_step = REGISTRY.gauge(
@@ -396,6 +434,7 @@ class Controller:
         self.elastic_engine.forget_job(key, job)
         self.rollup_cache.forget(key)
         self._drop_progress_series(key, job)
+        self._drop_serving_series(key, job)
         if self.inventory is not None and is_tpu_job(job):
             self.inventory.release_gang(gang_name(job))
         self.queue.add(key)  # final sync performs cleanup if needed
@@ -461,7 +500,10 @@ class Controller:
         job = self.tfjob_informer.get(ns, name)
         if job is None:
             # Deleted: expectations cleaned in the delete handler; cascade GC
-            # removes children server-side.
+            # removes children server-side.  Serving gauges drop HERE too —
+            # this sync is per-key-ordered after any publish that raced the
+            # delete handler's first drop.
+            self._drop_serving_series(key)
             self.expectations.delete_expectations(key)
             if self.controller_shards > 1:
                 # Final sync of a dead job, running on its owning shard:
@@ -534,6 +576,9 @@ class Controller:
             key, job, pods_by_type, needs_sync=needs_sync and not deleting)
 
         if needs_sync and not deleting:
+            # Serving plane: consult the autoscaler BEFORE planning, so
+            # this very sync's plan creates/drains toward the new target.
+            job = self._assess_serving(key, job, pods_by_type)
             self._manage(key, job, pods_by_type, services_by_type, recovery)
 
         # Status rollup runs every sync, whether or not we acted.  The
@@ -553,6 +598,7 @@ class Controller:
                                         recovery=recovery)
             self._publish_progress(key, job, new_status)
             self._publish_gang_state(key, job, pods_by_type)
+            self._publish_serving(key, job, pods_by_type, new_status)
             if should_update(job.status, new_status):
                 self._update_status(job, new_status)
             self.rollup_cache.store(key, fp, new_status)
@@ -652,6 +698,103 @@ class Controller:
             self.recorder.event(job, TYPE_WARNING, REASON_GANG_PREEMPTED,
                                 preempt_msg)
 
+    def _assess_serving(self, key: str, job: TFJob, pods_by_type) -> TFJob:
+        """Consult the serving autoscaler; persist a changed target as the
+        serving-replicas annotation (ONE metadata patch, exactly like the
+        elastic width transitions) so this sync's plan executes it —
+        scale-up creates replicas, scale-down drains the highest indices
+        gracefully.  Emits the edge-triggered ServingScaledUp/Down events."""
+        from ..api.labels import ANNOTATION_SERVING_REPLICAS
+        from ..api.tfjob import is_serving_job
+        from ..serving.autoscale import serving_width
+
+        if job.spec.autoscale is None or not is_serving_job(job):
+            return job
+        decision = self.serving_autoscaler.assess(
+            key, job, pods_by_type.get(ReplicaType.SERVING, []), time.time())
+        if decision.requeue_after_s > 0:
+            # A pending scale-down's stabilization window generates no
+            # watch events; look again when it elapses.
+            self.queue.add_after(key, decision.requeue_after_s + 0.02)
+        if decision.target is None:
+            return job
+        current = serving_width(job)
+        if decision.target == current:
+            return job
+        ns, name = job.metadata.namespace, job.metadata.name
+
+        def apply(m):
+            m.annotations[ANNOTATION_SERVING_REPLICAS] = str(decision.target)
+
+        try:
+            job = self.cluster.tfjobs.patch_meta(ns, name, apply)
+        except NotFound:
+            return job
+        msg = (f"serving replicas {current} -> {decision.target}: "
+               f"{decision.reason}")
+        if decision.target > current:
+            self._c_serve_scale.labels("up").inc()
+            self.recorder.event(job, TYPE_NORMAL, REASON_SERVING_SCALED_UP,
+                                msg)
+        else:
+            self._c_serve_scale.labels("down").inc()
+            self.recorder.event(job, TYPE_NORMAL, REASON_SERVING_SCALED_DOWN,
+                                msg)
+        return job
+
+    def _publish_serving(self, key: str, job: TFJob, pods_by_type,
+                         status) -> None:
+        """Serving-plane gauges from this sync's rollup: job-level
+        qps/TTFT/replicas plus one queue-depth + occupancy series per
+        replica index.  Indices that left (scale-down, job shrink) have
+        their series REMOVED — Gauge.remove frees the metric series
+        budget, so an autoscaling job cannot strand one dead series per
+        replica index it ever ran."""
+        sv = getattr(status, "serving", None)
+        if sv is None:
+            return
+        ns, name = job.metadata.namespace, job.metadata.name
+        self._g_serve_qps.labels(ns, name).set(sv.qps)
+        self._g_serve_ttft.labels(ns, name).set(sv.ttft_ms)
+        self._g_serve_replicas.labels(ns, name).set(sv.replicas)
+        self._g_serve_ready.labels(ns, name).set(sv.ready)
+        from ..planner.materialize import pod_index
+
+        live = set()
+        for p in pods_by_type.get(ReplicaType.SERVING, []):
+            pr = p.status.progress
+            idx = pod_index(p)
+            if pr is None or idx is None or not pr.slots_total:
+                continue
+            live.add(str(idx))
+            self._g_serve_queue.labels(ns, name, str(idx)).set(
+                pr.queue_depth)
+            self._g_serve_occ.labels(ns, name, str(idx)).set(
+                pr.slots_used / pr.slots_total)
+        with self._stalled_lock:
+            before = self._serving_series.get(key, frozenset())
+            self._serving_series[key] = frozenset(live)
+        for idx in before - live:
+            self._g_serve_queue.remove(ns, name, idx)
+            self._g_serve_occ.remove(ns, name, idx)
+
+    def _drop_serving_series(self, key: str, job: Optional[TFJob] = None) -> None:
+        """Serving gauge series die with the job.  Called from the delete
+        handler, the finalizer, AND the final ``job is None`` sync: the
+        last call is ordered after any in-flight sync's publish (per-key
+        serialization), so a publish racing the delete handler cannot
+        strand a dead series."""
+        ns, name = split_key(key)
+        with self._stalled_lock:
+            indices = self._serving_series.pop(key, frozenset())
+        for idx in indices:
+            self._g_serve_queue.remove(ns, name, idx)
+            self._g_serve_occ.remove(ns, name, idx)
+        for g in (self._g_serve_qps, self._g_serve_ttft,
+                  self._g_serve_replicas, self._g_serve_ready):
+            g.remove(ns, name)
+        self.serving_autoscaler.forget_job(key)
+
     def _drop_progress_series(self, key: str, job: TFJob) -> None:
         """Per-job gauge series + stall bookkeeping die with the job."""
         from .helper import OWNER_UID_INDEX
@@ -674,6 +817,7 @@ class Controller:
         finalizes (removes) the job once the list empties."""
         ns, name = job.metadata.namespace, job.metadata.name
         self._drop_progress_series(key, job)
+        self._drop_serving_series(key, job)
         if self.inventory is not None and is_tpu_job(job):
             self.inventory.release_gang(gang_name(job))
         if job.spec.runtime_id:  # no children can exist before stamping
@@ -966,14 +1110,41 @@ class Controller:
                     self.metrics.inc_deletes()
                 else:
                     self.expectations.lower_expectations(key, del_delta=1)
+            elif ev.action == Action.DRAIN_POD:
+                self._drain_pod(job, ev)
         except Exception:
             # The watch event will never arrive; decrement so the TTL
             # does not block the next sync (ref: controller.go:381-383).
+            # Drains hold no expectation (their MODIFIED event is not
+            # awaited), so there is nothing to lower.
             if ev.action in (Action.ADD_POD, Action.ADD_SERVICE):
                 self.expectations.lower_expectations(key, add_delta=1)
-            else:
+            elif ev.action != Action.DRAIN_POD:
                 self.expectations.lower_expectations(key, del_delta=1)
             raise
+
+    def _drain_pod(self, job: TFJob, ev) -> None:
+        """Serving graceful drain: stamp the pod's drain annotation (the
+        kubelet SIGTERMs executed replicas / completes simulated ones once
+        their beats show an empty queue) and record the audit event.  The
+        pod's MODIFIED watch event re-enqueues the job, so no expectations
+        entry is needed."""
+        from ..api.labels import ANNOTATION_DRAIN
+
+        def mark(m):
+            m.annotations[ANNOTATION_DRAIN] = ev.reason or "drain"
+
+        try:
+            self.cluster.pods.patch_meta(job.metadata.namespace, ev.name,
+                                         mark)
+        except NotFound:
+            return  # already gone: nothing to drain
+        self.recorder.event(
+            job, TYPE_NORMAL, REASON_SERVING_DRAINING,
+            f"draining serving replica {ev.replica_type.value}-{ev.index} "
+            f"(pod {ev.name}, {ev.reason or 'drain'}): stop intake, "
+            f"finish in-flight, exit",
+            dedup_key=ev.name)
 
     def _manage_executor(self) -> Optional[ThreadPoolExecutor]:
         """The shared bounded manage pool; None selects the serial path."""

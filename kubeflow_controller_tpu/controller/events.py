@@ -40,6 +40,12 @@ REASON_BACKOFF_LIMIT_EXCEEDED = "BackoffLimitExceeded"
 # when the gang returns to full width.
 REASON_GANG_DEGRADED = "GangDegraded"
 REASON_GANG_RESTORED = "GangRestored"
+# Serving-plane reasons (net-new: the queue-depth autoscaler + graceful
+# drain).  Scale events are edge-triggered per target change; one
+# ServingDraining per replica entering its drain.
+REASON_SERVING_SCALED_UP = "ServingScaledUp"
+REASON_SERVING_SCALED_DOWN = "ServingScaledDown"
+REASON_SERVING_DRAINING = "ServingDraining"
 
 TYPE_NORMAL = "Normal"
 TYPE_WARNING = "Warning"

@@ -297,6 +297,183 @@ def forward_with_cache(
     return logits.astype(jnp.float32), new_cache
 
 
+# ---------------------------------------------------------------------------
+# Slot-paged KV cache (serving plane)
+# ---------------------------------------------------------------------------
+#
+# The contiguous [L, B, S, kvH, D] cache above assumes every sequence in the
+# batch shares one start_pos — the batch-decode shape.  Continuous batching
+# (workloads/serve.py) admits and evicts sequences at token boundaries, so
+# each slot sits at its own position and owns its own cache region.  The
+# paged layout is one physical row pool [L, R, kvH, D] (R = num_pages *
+# page_size) plus a host-side page table per slot: logical position j of
+# slot b lives at physical row page_table[b, j // page] * page + j % page.
+# Admission allocates ceil(prompt/page) pages from a free list — O(pages
+# needed), never an O(max_seq * batch) cache reallocation — and a finished
+# sequence's pages return to the pool the moment it vacates its slot.
+#
+# Physical page 0 is reserved as a scratch page: bucket-padded prefill
+# positions past the real prompt length write there, so padding can never
+# corrupt another slot's rows.
+
+# Logical layout of the paged pool; the row axis is deliberately unsharded
+# (rows are scattered/gathered at per-slot dynamic indices).
+PAGED_CACHE_AXES = ("layers", None, "kv_heads", "head_dim")
+
+
+def init_paged_cache(cfg: LlamaConfig, num_pages: int, page_size: int,
+                     rules: ShardingRules = DEFAULT_RULES) -> Cache:
+    """The physical row pool shared by every slot (page 0 = scratch)."""
+    rows = num_pages * page_size
+    shape = (cfg.n_layers, rows, cfg.n_kv_heads, cfg.head_dim)
+    dtype = jnp.dtype(cfg.dtype)
+    return {
+        "k": with_logical_constraint(jnp.zeros(shape, dtype),
+                                     PAGED_CACHE_AXES, rules),
+        "v": with_logical_constraint(jnp.zeros(shape, dtype),
+                                     PAGED_CACHE_AXES, rules),
+    }
+
+
+def _apply_rope_rows(x: jax.Array, angles: jax.Array) -> jax.Array:
+    """Per-row RoPE: x [B, H, D] with angles [B, D//2] (each batch row at
+    its own absolute position — the continuous-batching decode shape)."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos = jnp.cos(angles)[:, None, :]
+    sin = jnp.sin(angles)[:, None, :]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                           axis=-1).astype(x.dtype)
+
+
+def paged_prefill(
+    params,
+    tokens: jax.Array,
+    cache: Cache,
+    rows: jax.Array,
+    plen,
+    cfg: LlamaConfig,
+    rules: ShardingRules = DEFAULT_RULES,
+) -> Tuple[jax.Array, Cache]:
+    """Prefill ONE prompt into its slot's pages.
+
+    ``tokens`` [1, T] is the prompt padded to a bucket length T;
+    ``rows`` [T] maps each prompt position to its physical row (scratch
+    rows for positions >= ``plen``, the real length, traced OK).  Attention
+    is dense causal within the prompt — no cache read, so the compiled
+    program depends only on the bucket shape, never on the live batch.
+    Returns (last real position's logits [vocab] f32, updated cache)."""
+    dtype = jnp.dtype(cfg.dtype)
+    _, T = tokens.shape
+    tbl = with_logical_constraint(params["embed"].astype(dtype),
+                                  (None, None), rules)
+    x = tbl[tokens]
+    positions = jnp.arange(T)
+    angles = rope_freqs(cfg, positions)
+    mask = (positions[None, :] <= positions[:, None])[None, None, :, :]
+    repeats = cfg.n_heads // cfg.n_kv_heads
+
+    def layer(carry, scanned):
+        x, kc_all, vc_all = carry
+        lp, li = scanned
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dtype))
+        k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dtype))
+        v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dtype))
+        q = apply_rope(q, angles)
+        k = apply_rope(k, angles)  # written pre-rotated, like the batch path
+        kc_all = kc_all.at[li, rows].set(k[0].astype(kc_all.dtype))
+        vc_all = vc_all.at[li, rows].set(v[0].astype(vc_all.dtype))
+        kk, vv = k, v
+        if repeats > 1:
+            kk = jnp.repeat(kk, repeats, axis=2)
+            vv = jnp.repeat(vv, repeats, axis=2)
+        attn = _cache_attention_dense(q, kk, vv, mask, rules)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))
+        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + ffn_block(h, lp, cfg, rules)
+        return (x, kc_all, vc_all), None
+
+    l_idx = jnp.arange(cfg.n_layers)
+    (x, k_new, v_new), _ = jax.lax.scan(
+        layer, (x, cache["k"], cache["v"]), (params["layers"], l_idx))
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    last = jax.lax.dynamic_index_in_dim(x, plen - 1, 1, keepdims=False)[0]
+    logits = jnp.einsum("d,dv->v", last, params["lm_head"].astype(dtype))
+    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+
+
+def paged_decode_step(
+    params,
+    tokens: jax.Array,
+    cache: Cache,
+    positions: jax.Array,
+    page_tables: jax.Array,
+    cfg: LlamaConfig,
+    page_size: int,
+    rules: ShardingRules = DEFAULT_RULES,
+) -> Tuple[jax.Array, Cache]:
+    """One decode step for a mixed batch of slots.
+
+    ``tokens`` [B] (last sampled token per slot), ``positions`` [B] (each
+    slot's own absolute position), ``page_tables`` [B, P] (physical page
+    per logical block; unallocated blocks may point anywhere — the length
+    mask never reads past ``positions``).  Shapes are static in (B, P), so
+    ONE compiled step serves every batch composition — admission and
+    eviction never recompile.  Idle slots are computed and masked by the
+    caller (their page 0 scratch rows are harmless to read and write).
+    Returns (logits [B, vocab] f32, updated cache)."""
+    dtype = jnp.dtype(cfg.dtype)
+    B = tokens.shape[0]
+    P = page_tables.shape[1]
+    S = P * page_size
+    repeats = cfg.n_heads // cfg.n_kv_heads
+    tbl = with_logical_constraint(params["embed"].astype(dtype),
+                                  (None, None), rules)
+    x = tbl[tokens][:, None, :]                              # [B, 1, D]
+    angles = rope_freqs(cfg, positions)                      # [B, D//2]
+    # Gather map: logical position j of slot b -> physical row.  Built once
+    # per step, shared by every layer.
+    read_rows = (page_tables[:, :, None] * page_size
+                 + jnp.arange(page_size)[None, None, :]).reshape(B, S)
+    write_rows = (jnp.take_along_axis(
+        page_tables, (positions // page_size)[:, None], axis=1)[:, 0]
+        * page_size + positions % page_size)                 # [B]
+    # Length mask: position j of slot b is live iff j <= positions[b]
+    # (the row being written this step included).
+    live = (jnp.arange(S)[None, :] <= positions[:, None])    # [B, S]
+
+    def layer(carry, scanned):
+        x, kc_all, vc_all = carry
+        lp, li = scanned
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dtype))
+        k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dtype))
+        v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dtype))
+        q = _apply_rope_rows(q[:, 0], angles)[:, None]       # [B,1,H,hd]
+        k = _apply_rope_rows(k[:, 0], angles)                # [B,kvH,hd]
+        kc_all = kc_all.at[li, write_rows].set(k.astype(kc_all.dtype))
+        vc_all = vc_all.at[li, write_rows].set(v[:, 0].astype(vc_all.dtype))
+        # Per-slot cache read through the page table: [B, S, kvH, hd].
+        kk = kc_all[li][read_rows].astype(dtype)
+        vv = vc_all[li][read_rows].astype(dtype)
+        if repeats > 1:
+            kk = jnp.repeat(kk, repeats, axis=2)
+            vv = jnp.repeat(vv, repeats, axis=2)
+        attn = _cache_attention_dense(
+            q, kk, vv, live[:, None, None, :], rules)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))
+        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + ffn_block(h, lp, cfg, rules)
+        return (x, kc_all, vc_all), None
+
+    l_idx = jnp.arange(cfg.n_layers)
+    (x, k_new, v_new), _ = jax.lax.scan(
+        layer, (x, cache["k"], cache["v"]), (params["layers"], l_idx))
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(dtype))
+    return logits[:, 0].astype(jnp.float32), {"k": k_new, "v": v_new}
+
+
 def _sample(logits, key, temperature: float, top_k: Optional[int]):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)

@@ -221,13 +221,19 @@ def gang_width(job: TFJob, spec: TFReplicaSpec) -> int:
     For the job's elastic gang this is the controller-written gang-width
     annotation (bumped in lockstep with the gang generation on every
     re-shard transition), clamped to [elastic.min_width, spec width];
-    for everything else — and for an absent/invalid annotation — it is
-    the spec width.  Planner, materializer, updater and health checker
-    all key off this one function, so a width transition is one
-    annotation write."""
+    for a Serving set it is the autoscaler-written serving-replicas
+    annotation (serving/autoscale.py serving_width — scale is a runtime
+    property exactly like elastic width); for everything else — and for
+    an absent/invalid annotation — it is the spec width.  Planner,
+    materializer, updater and health checker all key off this one
+    function, so a width transition is one annotation write."""
     from ..api.labels import ANNOTATION_GANG_WIDTH
     from ..api.tfjob import elastic_gang_spec
 
+    if spec.tf_replica_type == ReplicaType.SERVING:
+        from ..serving.autoscale import serving_width
+
+        return serving_width(job)
     full = spec_width(spec)
     if elastic_gang_spec(job) is not spec:
         return full
@@ -265,8 +271,68 @@ def make_pod(job: TFJob, spec: TFReplicaSpec, index: int) -> Pod:
             _wire_worker_collectives(job, pod, c, index)
     elif typ == ReplicaType.TPU:
         _wire_tpu_pod(job, spec, pod, index)
+    elif typ == ReplicaType.SERVING:
+        _wire_serving_pod(job, spec, pod, index)
     # Local: no wiring at all (ref: local.go — single pod, no services).
     return pod
+
+
+def serving_port(spec: TFReplicaSpec) -> int:
+    """The replica's request port: the template's first container port,
+    else the serve module default."""
+    from ..workloads.serve import DEFAULT_SERVE_PORT
+
+    if spec.template is not None:
+        for c in spec.template.spec.containers:
+            for p in c.ports:
+                if p.container_port:
+                    return p.container_port
+    return DEFAULT_SERVE_PORT
+
+
+def _wire_serving_pod(job: TFJob, spec: TFReplicaSpec, pod: Pod,
+                      index: int) -> None:
+    """Serving replicas are independent long-running servers, never a
+    collective: no coordinator wiring: each gets its request port, the
+    job's WEIGHTS generation (the gang-generation annotation doubles as
+    the rolling-update version — a generation bump rolls every replica,
+    one at a time, through graceful drain), and — when the spec pins a
+    slice topology — a single-member gang annotation per replica so the
+    PR 7 scheduler admits each replica alone onto one slice (warm-pool
+    readmission and the shared AOT cache make scale-up cache-hit on
+    spawn)."""
+    from ..api.labels import ANNOTATION_GANG_GENERATION
+    from ..workloads.serve import ENV_SERVE_PORT
+
+    c = pod.spec.containers[0]
+    port = serving_port(spec)
+    c.set_env_default(ENV_SERVE_PORT, str(port))
+    if not any(p.container_port == port for p in c.ports):
+        c.ports.append(ContainerPort(name="serve", container_port=port))
+    gen = gang_generation(job)
+    c.set_env(ENV_GANG_GENERATION, str(gen))
+    pod.metadata.annotations = {
+        **pod.metadata.annotations,
+        ANNOTATION_GANG_GENERATION: str(gen),
+    }
+    if spec.tpu is not None:
+        # One slice per replica, admitted through the scheduler: the gang
+        # name is per-INDEX (a width-1 gang), so replicas queue, preempt
+        # and warm-readmit independently of each other.
+        pod.metadata.annotations.update({
+            ANNOTATION_GANG_NAME: f"{gang_name(job)}-serve-{index}",
+            ANNOTATION_GANG_SIZE: "1",
+            ANNOTATION_ACCELERATOR: spec.tpu.accelerator_type,
+            ANNOTATION_NUM_SLICES: "1",
+            ANNOTATION_PRIORITY_CLASS: job.spec.priority_class_name
+            or "default",
+        })
+        c.resources.requests[RESOURCE_TPU] = str(spec.tpu.chips_per_host)
+        c.resources.limits[RESOURCE_TPU] = str(spec.tpu.chips_per_host)
+    if pod.spec.restart_policy == "Always":
+        # Crash recovery is the controller's job (index-preserving
+        # replacement under the restart policy engine), not the node's.
+        pod.spec.restart_policy = "OnFailure"
 
 
 def _wire_worker_collectives(job: TFJob, pod: Pod, c, index: int) -> None:
@@ -415,6 +481,12 @@ def make_service(job: TFJob, spec: TFReplicaSpec, index: int) -> Service:
         port = spec.tpu.coordinator_port if spec.tpu else TF_PORT
         svc.spec.selector = labels_for(job, typ)
         svc.spec.cluster_ip = "None"
+    elif typ == ReplicaType.SERVING:
+        # Per-replica ClusterIP at the request port: the front end routes
+        # requests per replica (least-loaded), so each needs its own
+        # stable name — exactly the PS/Worker shape at a different port.
+        port = serving_port(spec)
+        svc.spec.selector = {**labels_for(job, typ), LABEL_INDEX: str(index)}
     else:
         port = TF_PORT
         svc.spec.selector = {**labels_for(job, typ), LABEL_INDEX: str(index)}

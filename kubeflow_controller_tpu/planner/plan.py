@@ -44,7 +44,8 @@ from .types import Action, Plan, PlanEvent
 
 # Service/pod ordering across types (ref: distributed.go:59-117 emits worker
 # services, PS services, worker pods, PS pods — generalized here).
-_TYPE_ORDER = [ReplicaType.WORKER, ReplicaType.PS, ReplicaType.TPU, ReplicaType.LOCAL]
+_TYPE_ORDER = [ReplicaType.WORKER, ReplicaType.PS, ReplicaType.TPU,
+               ReplicaType.SERVING, ReplicaType.LOCAL]
 
 
 def desired_replicas(spec: TFReplicaSpec) -> int:
@@ -58,10 +59,11 @@ def desired_replicas(spec: TFReplicaSpec) -> int:
 
 def desired_service_indices(spec: TFReplicaSpec, job: TFJob = None) -> range:
     typ = spec.tf_replica_type
-    if typ in (ReplicaType.PS, ReplicaType.WORKER):
-        # Elastic gangs: one service per CURRENT member (extra indices
-        # are scaled down while degraded, re-created on re-expand —
-        # service names are deterministic, so repair is index-exact).
+    if typ in (ReplicaType.PS, ReplicaType.WORKER, ReplicaType.SERVING):
+        # Elastic gangs / autoscaled Serving sets: one service per CURRENT
+        # member (extra indices are scaled down while degraded, re-created
+        # on scale-up — service names are deterministic, so repair is
+        # index-exact).
         n = gang_width(job, spec) if job is not None else desired_replicas(spec)
         return range(n)
     if typ == ReplicaType.TPU:
@@ -140,6 +142,8 @@ def _plan_pods(job: TFJob, spec: TFReplicaSpec, pods: List[Pod],
 
     events: List[PlanEvent] = []
 
+    if typ == ReplicaType.SERVING:
+        return _plan_serving(job, spec, n, by_idx, recovery)
     if is_gang_spec(spec):
         return _plan_gang(job, spec, n, by_idx, replace_on_failure, recovery)
 
@@ -180,6 +184,111 @@ def _plan_pods(job: TFJob, spec: TFReplicaSpec, pods: List[Pod],
                 if is_pod_active(p):
                     events.append(PlanEvent(Action.DELETE_POD, typ, index=i,
                                             name=p.metadata.name, reason="scale-down"))
+    return events
+
+
+def _is_draining(p: Pod) -> bool:
+    from ..api.labels import ANNOTATION_DRAIN
+
+    return bool(p.metadata.annotations.get(ANNOTATION_DRAIN))
+
+
+def _serving_ready(p: Pod) -> bool:
+    from ..api.core import PHASE_RUNNING
+
+    return (p.status.phase == PHASE_RUNNING
+            and p.status.progress is not None
+            and p.status.progress.phase == "serving")
+
+
+def _plan_serving(job: TFJob, spec: TFReplicaSpec, n: int,
+                  by_idx: Dict[int, List[Pod]], recovery=None) -> List[PlanEvent]:
+    """Long-running Serving replicas: keep ``n`` (the autoscaler's current
+    target) servers alive, drain gracefully instead of killing, and roll
+    weight updates one replica at a time.
+
+    - index < n, no active pod: create (a Succeeded record there means the
+      server EXITED — drained by a rollout or crashed clean — and is
+      replaced, unlike batch workers, where Succeeded means done; Failed
+      records go through the restart-policy gate like any replica).
+    - index >= n (scale-down) and active: emit ``DrainPod`` once — the
+      replica stops intake, finishes in-flight requests, and exits; its
+      terminal record is then deleted.  Never a hard delete of a serving
+      pod that hasn't drained.
+    - **rolling update**: an active pod whose gang-generation annotation
+      lags the job's carries the PREVIOUS weights.  Drain AT MOST ONE
+      stale replica at a time, and only while every other in-target
+      replica is ready — zero dropped requests, max-unavailable 1 (the
+      PR 9 gang-generation machinery, reused as the weights version)."""
+    typ = spec.tf_replica_type
+    events: List[PlanEvent] = []
+    expected_gen = gang_generation(job)
+
+    stale_active: List[tuple] = []
+    ready_total = 0  # ready, not draining, in-target — ANY generation
+    draining_count = 0
+    for i, plist in sorted(by_idx.items()):
+        for p in plist:
+            if not is_pod_active(p):
+                continue
+            if _is_draining(p):
+                draining_count += 1
+                continue
+            if i < n and _serving_ready(p):
+                ready_total += 1
+            if i < n and _pod_generation(p) != expected_gen:
+                stale_active.append((i, p))
+
+    for i in range(n):
+        plist = sorted(by_idx.get(i, []),
+                       key=lambda p: p.metadata.creation_timestamp or 0)
+        active = [p for p in plist if is_pod_active(p)]
+        if active:
+            for extra in active[1:]:
+                events.append(PlanEvent(Action.DELETE_POD, typ, index=i,
+                                        name=extra.metadata.name,
+                                        reason="duplicate-index"))
+            continue
+        failed = [p for p in plist if p.status.phase == PHASE_FAILED]
+        if failed:
+            verdict = _gate(recovery, typ, i)
+            if verdict in ("backoff", "exhausted", "never"):
+                continue
+        # Clear terminal records (drained rollout exits and cleared
+        # failures) and re-create at the same index: a serving index is
+        # never "done".
+        for p in plist:
+            events.append(PlanEvent(
+                Action.DELETE_POD, typ, index=i, name=p.metadata.name,
+                reason="replace-failed" if failed else "rollout"))
+        events.append(PlanEvent(Action.ADD_POD, typ, index=i,
+                                reason="replace-failed" if failed else ""))
+
+    # Scale-down: indices beyond the target drain gracefully, then their
+    # terminal records are cleared.
+    for i, plist in sorted(by_idx.items()):
+        if i < n:
+            continue
+        for p in plist:
+            if is_pod_active(p):
+                if not _is_draining(p):
+                    events.append(PlanEvent(Action.DRAIN_POD, typ, index=i,
+                                            name=p.metadata.name,
+                                            reason="scale-down"))
+            else:
+                events.append(PlanEvent(Action.DELETE_POD, typ, index=i,
+                                        name=p.metadata.name,
+                                        reason="scale-down"))
+
+    # Rolling weight update: one stale replica drains only while the whole
+    # target set is ready (old weights serve fine mid-roll) and nothing
+    # else is mid-drain — max-unavailable 1.  With n == 1 the single
+    # replica drains and its replacement follows (a brief intake gap the
+    # front end bridges by queueing; docs/SERVING.md).
+    if stale_active and draining_count == 0 and ready_total >= n:
+        i, p = stale_active[0]
+        events.append(PlanEvent(Action.DRAIN_POD, typ, index=i,
+                                name=p.metadata.name, reason="rollout"))
     return events
 
 

@@ -21,6 +21,12 @@ class Action(str, enum.Enum):
     ADD_SERVICE = "AddService"
     DELETE_POD = "DeletePod"
     DELETE_SERVICE = "DeleteService"
+    # Serving plane: mark a Serving pod draining (stop intake -> finish
+    # in-flight -> exit) instead of deleting it outright.  Executed as a
+    # pod metadata patch; generates a MODIFIED watch event, so it needs no
+    # expectations entry (unlike creates/deletes, whose events may never
+    # arrive on failure).
+    DRAIN_POD = "DrainPod"
 
 
 @dataclass

@@ -1,0 +1,10 @@
+"""Serving plane: controller-side autoscaling for continuous-batching
+inference replicas (docs/SERVING.md).
+
+The replica runtime lives in workloads/serve.py; this package is the
+control-plane half — the hysteresis autoscaler the controller consults
+every sync of a serving job."""
+
+from .autoscale import AutoscaleDecision, ServingAutoscaler, serving_width
+
+__all__ = ["AutoscaleDecision", "ServingAutoscaler", "serving_width"]

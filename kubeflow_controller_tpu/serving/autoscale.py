@@ -1,0 +1,164 @@
+"""Queue-depth autoscaler for Serving replica sets, with hysteresis.
+
+Scaling signal: the per-replica intake-queue depth the replicas publish
+through the progress plane (PodProgress.queue_depth).  The HPA formula
+over the spec's ``autoscale.target_queue_depth``::
+
+    desired = ceil(current * avg_queue_depth / target_queue_depth)
+
+clamped to [min_replicas, max_replicas], with three hysteresis guards so
+the target cannot flap around the setpoint (the failure mode the serving
+tests gate):
+
+- **tolerance band**: no scaling while |avg/target - 1| <= tolerance;
+- **scale-up gating on readiness**: while previously-requested replicas
+  are still warming (ready < current), the queue backlog they will absorb
+  is already provisioned — requesting more would double-count it;
+- **scale-down stabilization**: the signal must sit below the band
+  CONTINUOUSLY for ``scale_down_stabilization_s`` before any replica is
+  drained (a single quiet scrape never sheds capacity).
+
+The autoscaler only picks the target; the planner executes it — scale-up
+admits new replicas (warm pools + the AOT'd compile cache make them
+cache-hit on spawn), scale-down drains the highest indices gracefully
+(docs/SERVING.md "Scale-down and drain").
+
+Deliberately assessment-driven (the controller calls :meth:`assess` from
+its sync loop) and clock-injected, so hysteresis is unit-testable without
+sleeping.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..api.core import PHASE_RUNNING, Pod
+from ..api.labels import ANNOTATION_SERVING_REPLICAS
+from ..api.tfjob import ReplicaType, TFJob, serving_spec
+from ..utils import locks
+
+
+def serving_width(job: TFJob) -> int:
+    """The Serving set's CURRENT replica target: the controller-written
+    serving-replicas annotation, else autoscale.minReplicas, else
+    spec.replicas — clamped to the autoscale bounds when present.  The
+    planner, updater, health checker and CLI all key off this one
+    function (the serving analog of the elastic gang_width)."""
+    spec = serving_spec(job)
+    if spec is None:
+        return 0
+    a = job.spec.autoscale
+    default = a.min_replicas if a is not None else spec.replicas
+    try:
+        w = int(job.metadata.annotations.get(ANNOTATION_SERVING_REPLICAS, "")
+                or default)
+    except ValueError:
+        return default
+    if a is not None:
+        return max(a.min_replicas, min(w, a.max_replicas))
+    return max(0, w)
+
+
+def replica_ready(pod: Pod) -> bool:
+    """Serving readiness: Running AND past model load + first decode step
+    (the replica beats phase="serving" only then)."""
+    return (pod.status.phase == PHASE_RUNNING
+            and pod.status.progress is not None
+            and pod.status.progress.phase == "serving")
+
+
+@dataclass
+class AutoscaleDecision:
+    """One assessment's outcome.  ``target`` is None when no change is
+    wanted; ``requeue_after_s`` > 0 asks the controller to look again
+    (a pending scale-down's stabilization window emits no watch events)."""
+
+    target: Optional[int] = None
+    reason: str = ""
+    requeue_after_s: float = 0.0
+
+
+class ServingAutoscaler:
+    """Per-job scale assessment with the stabilization memory that makes
+    scale-down deliberate.  Thread-safe: sync workers of different shards
+    may assess different jobs concurrently."""
+
+    def __init__(self):
+        self._lock = locks.named_lock("serving.autoscaler")
+        # job key -> wall clock when the signal first dropped below the
+        # scale-down band (cleared whenever it rises back).
+        self._below_since: Dict[str, float] = {}
+
+    def forget_job(self, key: str) -> None:
+        with self._lock:
+            self._below_since.pop(key, None)
+
+    def assess(self, key: str, job: TFJob, serving_pods: List[Pod],
+               now: Optional[float] = None) -> AutoscaleDecision:
+        a = job.spec.autoscale
+        if a is None:
+            return AutoscaleDecision()
+        t = now if now is not None else time.time()
+        current = serving_width(job)
+        ready = [p for p in serving_pods if replica_ready(p)]
+        if not ready:
+            # Nothing reporting yet (cold start): hold at the current
+            # target — there is no signal to scale on.
+            with self._lock:
+                self._below_since.pop(key, None)
+            return AutoscaleDecision()
+        total_depth = sum(p.status.progress.queue_depth for p in ready)
+        avg = total_depth / len(ready)
+        ratio = avg / a.target_queue_depth
+        desired = max(a.min_replicas,
+                      min(a.max_replicas,
+                          math.ceil(current * ratio) if ratio > 0
+                          else a.min_replicas))
+
+        if ratio > 1.0 + a.tolerance and desired > current:
+            with self._lock:
+                self._below_since.pop(key, None)
+            if len(ready) < current:
+                # Requested capacity still warming: the backlog is already
+                # provisioned for — asking again would overshoot.
+                return AutoscaleDecision(
+                    reason=f"holding at {current}: {len(ready)} ready, "
+                           f"scale-up in progress")
+            return AutoscaleDecision(
+                target=desired,
+                reason=f"queue depth avg {avg:.1f} > target "
+                       f"{a.target_queue_depth:g} (x{ratio:.2f}): "
+                       f"{current} -> {desired}")
+
+        if ratio < 1.0 - a.tolerance and current > a.min_replicas:
+            with self._lock:
+                since = self._below_since.setdefault(key, t)
+            waited = t - since
+            if waited < a.scale_down_stabilization_s:
+                return AutoscaleDecision(
+                    requeue_after_s=a.scale_down_stabilization_s - waited,
+                    reason=f"below target for {waited:.1f}s; stabilizing")
+            with self._lock:
+                self._below_since.pop(key, None)
+            target = max(desired, a.min_replicas)
+            if target >= current:
+                return AutoscaleDecision()
+            return AutoscaleDecision(
+                target=target,
+                reason=f"queue depth avg {avg:.1f} < target "
+                       f"{a.target_queue_depth:g} for "
+                       f"{a.scale_down_stabilization_s:g}s: "
+                       f"{current} -> {target}")
+
+        # Inside the tolerance band (or already at a bound): steady.
+        with self._lock:
+            if ratio >= 1.0 - a.tolerance:
+                self._below_since.pop(key, None)
+        return AutoscaleDecision()
+
+
+def serving_pods_of(pods_by_type) -> List[Pod]:
+    return pods_by_type.get(ReplicaType.SERVING, [])

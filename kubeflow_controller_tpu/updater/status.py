@@ -161,6 +161,44 @@ def compute_progress(
     )
 
 
+def compute_serving(
+    job: TFJob,
+    pods_by_type: Dict[ReplicaType, List[Pod]],
+):
+    """Serving-plane rollup from the Serving replicas' beats (None on
+    non-serving jobs): current scale target, ready count, summed qps and
+    queue depth, the WORST replica's windowed TTFT/ITL p50 (the operator
+    cares about the slowest replica, not a flattering mean), mean batch
+    occupancy, and the autoscale bounds for `kctpu describe`."""
+    from ..api.tfjob import ServingStatus, serving_spec
+    from ..serving.autoscale import serving_width
+
+    spec = serving_spec(job)
+    if spec is None:
+        return None
+    pods = pods_by_type.get(ReplicaType.SERVING, [])
+    beats = [p.status.progress for p in pods
+             if p.status.phase == PHASE_RUNNING
+             and p.status.progress is not None
+             and p.status.progress.phase == "serving"]
+    a = job.spec.autoscale
+    st = ServingStatus(
+        replicas=serving_width(job),
+        ready=len(beats),
+        min_replicas=a.min_replicas if a else 0,
+        max_replicas=a.max_replicas if a else 0,
+        target_queue_depth=a.target_queue_depth if a else 0.0,
+    )
+    if beats:
+        st.qps = round(sum(b.qps for b in beats), 3)
+        st.ttft_ms = round(max(b.ttft_ms for b in beats), 3)
+        st.itl_ms = round(max(b.itl_ms for b in beats), 3)
+        st.queue_depth = sum(b.queue_depth for b in beats)
+        occ = [b.slots_used / b.slots_total for b in beats if b.slots_total]
+        st.occupancy = round(sum(occ) / len(occ), 4) if occ else 0.0
+    return st
+
+
 def compute_status(
     job: TFJob,
     pods_by_type: Dict[ReplicaType, List[Pod]],
@@ -205,7 +243,7 @@ def compute_status(
         restart = spec.template.spec.restart_policy if spec.template else "OnFailure"
         replace_on_failure = restart in ("OnFailure", "Always")
 
-        if typ == ReplicaType.TPU:
+        if typ in (ReplicaType.TPU, ReplicaType.SERVING):
             for p in pods:
                 r = p.status.reason or ""
                 if p.status.phase == PHASE_PENDING and r.startswith("GangQueued"):
@@ -252,7 +290,15 @@ def compute_status(
                 recovering = True
             if not plist:
                 scheduled = False
-            if not any(p.status.phase == PHASE_RUNNING for p in plist) and i not in done:
+            if typ == ReplicaType.SERVING:
+                # Serving readiness = model loaded + first decode step:
+                # the replica beats phase="serving" only past both.
+                if not any(p.status.phase == PHASE_RUNNING
+                           and p.status.progress is not None
+                           and p.status.progress.phase == "serving"
+                           for p in plist):
+                    ready = False
+            elif not any(p.status.phase == PHASE_RUNNING for p in plist) and i not in done:
                 ready = False
         index_done[typ] = done
 
@@ -282,9 +328,13 @@ def compute_status(
     else:
         # Default rule: the job succeeds when every *deciding* replica index
         # succeeded.  PS replicas never decide (they run forever — ref:
-        # distributed.go:51-55, mnist_replica.py:121-122).
+        # distributed.go:51-55, mnist_replica.py:121-122); Serving replicas
+        # never decide either — a serving job is long-running by contract
+        # and never rolls up to Succeeded (a drained replica's Succeeded
+        # exit is a rollout/scale-down artifact, not completion).
         deciding = [
-            s for s in job.spec.tf_replica_specs if s.tf_replica_type != ReplicaType.PS
+            s for s in job.spec.tf_replica_specs
+            if s.tf_replica_type not in (ReplicaType.PS, ReplicaType.SERVING)
         ]
         if any_terminal_failure:
             phase = TFJobPhase.FAILED
@@ -387,6 +437,9 @@ def compute_status(
     # Only elastic jobs carry the width status + Degraded condition, so
     # the pre-elastic status shape serializes unchanged for everyone else.
     from ..api.tfjob import JobWidth, elastic_gang_spec
+
+    # -- serving rollup (net-new; serving plane) --
+    status.serving = compute_serving(job, pods_by_type)
 
     el_spec = elastic_gang_spec(job)
     if el_spec is not None:

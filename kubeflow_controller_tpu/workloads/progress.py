@@ -77,10 +77,13 @@ class ProgressReporter:
              loss: Optional[float] = None,
              phase: Optional[str] = None,
              compile_source: Optional[str] = None,
-             resumed_from_step: Optional[int] = None) -> None:
+             resumed_from_step: Optional[int] = None,
+             serving: Optional[Dict] = None) -> None:
         """Publish one heartbeat; None fields carry the previous value.
         The beat time is stamped server-side (store.update_progress), so
-        ``timestamp`` stays 0 on the wire."""
+        ``timestamp`` stays 0 on the wire.  ``serving`` carries the
+        serving-plane gauges (qps/ttft_ms/itl_ms/queue_depth/slots_used/
+        slots_total — workloads/serve.py ServeStats.as_beat)."""
         if not self.enabled:
             return
         with self._lock:
@@ -99,6 +102,11 @@ class ProgressReporter:
                 # the recovery plane can compute lost steps from any later
                 # beat (a merge field like the others).
                 self._last["resumedFromStep"] = int(resumed_from_step)
+            if serving:
+                from ..utils.serde import camel
+
+                for snake, value in serving.items():
+                    self._last[camel(snake)] = value
             body = dict(self._last)
         self._publish(body)
 

@@ -1,0 +1,801 @@
+"""Continuous-batching inference replica — the serving-plane runtime.
+
+One replica owns a fixed number of batch **slots** over a slot-paged KV
+cache (models/generate.py ``init_paged_cache``/``paged_prefill``/
+``paged_decode_step``) and runs ONE decode loop:
+
+- new requests join the running batch at token boundaries — admission is
+  "allocate ceil(prompt/page) pages + prefill into them", O(pages needed),
+  never a cache reshape or a recompile;
+- a finished sequence vacates its slot and frees its pages immediately,
+  so the next queued request starts decoding on the very next step — no
+  padding to the longest request in the batch (the static-batch baseline
+  keeps exactly that padding, for the bench's before/after);
+- prefill shapes are **bucketed** to a small fixed set and AOT-cached
+  through the PR 8 ``compile_cache`` layer.  The fingerprint keys on the
+  *bucket*, never the raw prompt length: a 100-request sweep of novel
+  lengths compiles at most ``len(prefill_buckets)`` prefill programs
+  (tests/test_serving.py gates this — the hot path must not recompile).
+
+The loop follows the Podracer/Sebulba split (PAPERS.md): request ingest
+(submit/drain, any thread) is decoupled from the accelerator loop (one
+thread), which never blocks on the network while it has live slots.
+
+Replica -> control plane: ``ServeStats`` publishes qps / TTFT / inter-token
+latency / queue depth / batch occupancy through the PR 3 progress plane
+(phase="load" while the model loads and compiles, "serving" after the
+first decode step, "drain" while finishing in-flight requests).  The
+controller autoscales on the aggregated queue-depth gauges and drains
+replicas through the pod drain annotation (docs/SERVING.md).
+
+``python -m kubeflow_controller_tpu.workloads.serve`` is the executed-pod
+entry: a JSON-lines TCP front end plus a SIGTERM handler implementing
+stop-intake -> finish-in-flight -> exit 0 (graceful drain under the
+kubelet's termination flow).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socketserver
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..utils import locks
+
+# Coarse workload phases a serving replica reports (checker.StallTracker
+# holds the frozen-step deadline for all three: an idle-but-healthy or
+# draining server freezes its step counter ON PURPOSE).
+PHASE_LOAD = "load"
+PHASE_SERVING = "serving"
+PHASE_DRAIN = "drain"
+
+# Env contract for the executed entrypoint (planner/materialize.py wires
+# the spec side; the kubelet injects the progress transport).
+ENV_SERVE_PORT = "KCTPU_SERVE_PORT"
+ENV_SERVE_SLOTS = "KCTPU_SERVE_SLOTS"
+ENV_SERVE_MAX_LEN = "KCTPU_SERVE_MAX_LEN"
+
+DEFAULT_SERVE_PORT = 8500
+
+
+@dataclass
+class ServeConfig:
+    """Engine shape.  ``prefill_buckets`` is the closed set of compiled
+    prefill shapes — THE serving-plane compile-cache contract: every
+    prompt is padded up to the smallest bucket that holds it, and the AOT
+    fingerprint keys on the bucket."""
+
+    slots: int = 8
+    page_size: int = 16
+    max_len: int = 256            # prompt + output ceiling per request
+    prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128)
+    # False = static batching baseline: admission only at batch
+    # boundaries (all current sequences finished), finished sequences pad
+    # until the whole batch completes.
+    cont_batch: bool = True
+    # Rolling window for qps/TTFT/ITL stats.
+    stats_window_s: float = 5.0
+
+    def bucket_for(self, prompt_len: int) -> int:
+        """Smallest configured bucket holding ``prompt_len`` (the largest
+        bucket for oversized prompts — they are truncated to it)."""
+        for b in sorted(self.prefill_buckets):
+            if prompt_len <= b:
+                return b
+        return max(self.prefill_buckets)
+
+    def pages_per_slot(self) -> int:
+        return -(-self.max_len // self.page_size)
+
+
+@dataclass
+class Request:
+    """One generation request.  ``tokens`` is the prompt; the engine
+    appends generated ids to ``output``.  ``done`` fires when the request
+    completes (or is rejected: ``error`` set)."""
+
+    id: str
+    tokens: List[int]
+    max_new_tokens: int
+    submit_t: float = 0.0
+    first_token_t: float = 0.0    # TTFT = first_token_t - submit_t
+    finish_t: float = 0.0
+    output: List[int] = field(default_factory=list)
+    error: str = ""
+    done: threading.Event = field(default_factory=threading.Event)
+
+    @property
+    def ttft_s(self) -> float:
+        return max(0.0, self.first_token_t - self.submit_t)
+
+    @property
+    def latency_s(self) -> float:
+        return max(0.0, self.finish_t - self.submit_t)
+
+
+@dataclass
+class ServeStats:
+    """One stats snapshot — the beat payload shape."""
+
+    step: int = 0                  # decode-loop steps executed
+    completed: int = 0
+    dropped: int = 0
+    tokens_out: int = 0
+    qps: float = 0.0
+    tokens_per_sec: float = 0.0
+    ttft_ms: float = 0.0           # p50 over the window
+    ttft_p99_ms: float = 0.0
+    itl_ms: float = 0.0
+    queue_depth: int = 0
+    slots_used: int = 0
+    slots_total: int = 0
+    phase: str = PHASE_LOAD
+    prefill_compiles: int = 0
+
+    @property
+    def occupancy(self) -> float:
+        return self.slots_used / self.slots_total if self.slots_total else 0.0
+
+    def as_beat(self) -> Dict:
+        """The serving dict ProgressReporter.beat(serving=...) publishes
+        (PodProgress field names, snake_case)."""
+        return {
+            "qps": round(self.qps, 3),
+            "ttft_ms": round(self.ttft_ms, 3),
+            "itl_ms": round(self.itl_ms, 3),
+            "queue_depth": self.queue_depth,
+            "slots_used": self.slots_used,
+            "slots_total": self.slots_total,
+        }
+
+
+def _pct(sorted_vals: Sequence[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+# ---------------------------------------------------------------------------
+# Model backends
+# ---------------------------------------------------------------------------
+
+class LlamaBackend:
+    """The real model: tiny-to-7B Llama over the slot-paged KV cache.
+
+    Holds the physical page pool as functional state; ``prefill`` and
+    ``decode`` swap the updated cache back in.  The decode step is ONE
+    jitted program (static in [slots, pages]); prefill is one jitted
+    program per bucket, AOT-cached through workloads/compile_cache with a
+    fingerprint keyed on the BUCKETED shape — not the per-request length
+    (the PR 8 cache would otherwise miss on every novel prompt length and
+    recompile on the serving hot path)."""
+
+    def __init__(self, cfg=None, seed: int = 0, cache_dir: str = ""):
+        from ..models.llama import LlamaConfig
+
+        self.cfg = cfg or LlamaConfig.tiny()
+        self.seed = seed
+        self.cache_dir = cache_dir
+        self.prefill_compiles = 0   # distinct prefill programs built/loaded
+        self.compile_sources: List[str] = []  # AOT provenance per program
+        self._prefill_fns: Dict[int, object] = {}
+        self._decode_fn = None
+        self._params = None
+        self._cache = None
+        self._serve_cfg: Optional[ServeConfig] = None
+
+    def load(self, serve_cfg: ServeConfig) -> None:
+        import jax
+
+        from ..models.generate import init_paged_cache
+        from ..models.llama import llama_init
+        from .compile_cache import enable_persistent_cache
+
+        enable_persistent_cache(self.cache_dir)
+        self._serve_cfg = serve_cfg
+        self._params = llama_init(jax.random.PRNGKey(self.seed), self.cfg)
+        num_pages = 1 + serve_cfg.slots * serve_cfg.pages_per_slot()
+        self._cache = init_paged_cache(self.cfg, num_pages,
+                                       serve_cfg.page_size)
+        self._num_pages = num_pages
+
+    def _fingerprint(self, what: str, bucket: int = 0) -> str:
+        from .compile_cache import fingerprint
+
+        sc = self._serve_cfg
+        return fingerprint(
+            what=what,
+            model=(self.cfg.vocab_size, self.cfg.dim, self.cfg.n_layers,
+                   self.cfg.n_heads, self.cfg.n_kv_heads,
+                   self.cfg.intermediate, self.cfg.dtype),
+            # The BUCKET is the shape key (0 for the decode step, whose
+            # shape is [slots, pages]); raw request lengths never reach
+            # the fingerprint.
+            bucket=bucket,
+            slots=sc.slots, page_size=sc.page_size,
+            num_pages=self._num_pages)
+
+    def _build_prefill(self, bucket: int):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.generate import paged_prefill
+        from .compile_cache import aot_compile
+
+        cfg = self.cfg
+
+        def fn(params, tokens, cache, rows, plen):
+            return paged_prefill(params, tokens, cache, rows, plen, cfg)
+
+        jitted = jax.jit(fn)
+        abstract = (
+            jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                self._params),
+            jax.ShapeDtypeStruct((1, bucket), jnp.int32),
+            jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                self._cache),
+            jax.ShapeDtypeStruct((bucket,), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+        )
+        res = aot_compile(jitted, abstract,
+                          key=self._fingerprint("prefill", bucket),
+                          cache_dir=self.cache_dir,
+                          what="serve-prefill", donated=False)
+        self.prefill_compiles += 1
+        self.compile_sources.append(res.source)
+        return res.compiled
+
+    def _build_decode(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.generate import paged_decode_step
+        from .compile_cache import aot_compile
+
+        cfg, sc = self.cfg, self._serve_cfg
+        page = sc.page_size
+
+        def fn(params, tokens, cache, positions, page_tables):
+            return paged_decode_step(params, tokens, cache, positions,
+                                     page_tables, cfg, page)
+
+        jitted = jax.jit(fn)
+        abstract = (
+            jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                self._params),
+            jax.ShapeDtypeStruct((sc.slots,), jnp.int32),
+            jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                self._cache),
+            jax.ShapeDtypeStruct((sc.slots,), jnp.int32),
+            jax.ShapeDtypeStruct((sc.slots, sc.pages_per_slot()), jnp.int32),
+        )
+        res = aot_compile(jitted, abstract,
+                          key=self._fingerprint("decode"),
+                          cache_dir=self.cache_dir,
+                          what="serve-decode", donated=False)
+        self.compile_sources.append(res.source)
+        return res.compiled
+
+    def prefill(self, tokens_padded, rows, plen: int) -> int:
+        """-> first sampled token (greedy)."""
+        import jax.numpy as jnp
+
+        bucket = tokens_padded.shape[1]
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            fn = self._prefill_fns[bucket] = self._build_prefill(bucket)
+        logits, self._cache = fn(self._params, tokens_padded, self._cache,
+                                 rows, jnp.int32(plen))
+        return int(jnp.argmax(logits))
+
+    def decode(self, tokens, positions, page_tables) -> List[int]:
+        """One step over the full slot batch -> next token per slot."""
+        import jax.numpy as jnp
+
+        if self._decode_fn is None:
+            self._decode_fn = self._build_decode()
+        logits, self._cache = self._decode_fn(
+            self._params, tokens, self._cache, positions, page_tables)
+        return [int(t) for t in jnp.argmax(logits, axis=-1)]
+
+
+class SyntheticBackend:
+    """Deterministic no-model backend for unit tests and control-plane
+    benches: the next token is a pure function of (last token, position),
+    with an optional per-step delay standing in for device time."""
+
+    def __init__(self, step_s: float = 0.0, vocab: int = 256):
+        self.step_s = step_s
+        self.vocab = vocab
+        self.prefill_compiles = 0
+        self._buckets: set = set()
+
+    def load(self, serve_cfg: ServeConfig) -> None:
+        self._serve_cfg = serve_cfg
+
+    def prefill(self, tokens_padded, rows, plen: int) -> int:
+        bucket = tokens_padded.shape[1]
+        if bucket not in self._buckets:
+            self._buckets.add(bucket)
+            self.prefill_compiles += 1
+        if self.step_s:
+            time.sleep(self.step_s)
+        return (int(tokens_padded[0][plen - 1]) + plen) % self.vocab
+
+    def decode(self, tokens, positions, page_tables) -> List[int]:
+        if self.step_s:
+            time.sleep(self.step_s)
+        return [(int(t) + int(p)) % self.vocab
+                for t, p in zip(tokens, positions)]
+
+
+# ---------------------------------------------------------------------------
+# The engine
+# ---------------------------------------------------------------------------
+
+class _Slot:
+    __slots__ = ("req", "position", "pages", "last_token", "last_token_t")
+
+    def __init__(self, req: Request, pages: List[int], position: int,
+                 last_token: int):
+        self.req = req
+        self.pages = pages            # physical pages, logical-block order
+        self.position = position      # absolute position of last_token
+        self.last_token = last_token
+        self.last_token_t = time.monotonic()
+
+
+class ServeEngine:
+    """Request queue + slot/page bookkeeping + the decode loop thread.
+
+    Thread-safety: ``submit``/``drain``/``stats`` may be called from any
+    thread; the decode loop is the only writer of slot state.  The intake
+    lock guards only queues and counters — never held across a model
+    call."""
+
+    def __init__(self, backend, config: Optional[ServeConfig] = None,
+                 on_ready: Optional[Callable[[], None]] = None):
+        self.backend = backend
+        self.config = config or ServeConfig()
+        self.on_ready = on_ready
+        self._lock = locks.named_lock("serve.engine")
+        self._wake = locks.named_condition("serve.engine-wake", self._lock)
+        self._queue: deque = deque()        # admitted-pending requests
+        self._slots: List[Optional[_Slot]] = [None] * self.config.slots
+        # Physical free-page list; page 0 is the shared scratch page.
+        total_pages = 1 + self.config.slots * self.config.pages_per_slot()
+        self._free_pages: List[int] = list(range(1, total_pages))
+        self._draining = False
+        self._stopped = False
+        self._ready = threading.Event()
+        self._drained = threading.Event()
+        # Static-batch baseline bookkeeping: admission is open from a batch
+        # boundary (all slots empty) until the first decode step runs.
+        self._batch_open = True
+        self._start_t = time.monotonic()
+        self._steps = 0
+        self._completed = 0
+        self._dropped = 0
+        self._tokens_out = 0
+        # (finish_t, ttft_s, latency_s, n_tokens) per completed request.
+        self._window: deque = deque()
+        self._itl: deque = deque(maxlen=2048)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="serve-engine",
+                                        daemon=True)
+        self._thread.start()
+
+    def wait_ready(self, timeout: float = 60.0) -> bool:
+        return self._ready.wait(timeout)
+
+    @property
+    def ready(self) -> bool:
+        return self._ready.is_set()
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    @property
+    def drained(self) -> bool:
+        return self._drained.is_set()
+
+    def submit(self, req: Request) -> bool:
+        """Enqueue a request; False when intake is closed (draining/
+        stopped) — the request is untouched so the caller can re-route it
+        to another replica."""
+        req.submit_t = req.submit_t or time.monotonic()
+        if len(req.tokens) > self.config.max_len - 1:
+            req.tokens = req.tokens[: self.config.max_len - 1]
+        with self._lock:
+            if self._draining or self._stopped:
+                return False
+            self._queue.append(req)
+            self._wake.notify()
+        return True
+
+    def drain(self) -> List[Request]:
+        """Stop intake; return the not-yet-admitted queue (for the caller
+        to re-route).  In-flight sequences finish; ``drained`` fires once
+        the last slot empties."""
+        with self._lock:
+            self._draining = True
+            pending = list(self._queue)
+            self._queue.clear()
+            self._wake.notify()
+        for req in pending:
+            req.error = "rerouted"
+            req.done.set()
+        return pending
+
+    def stop(self) -> None:
+        """Hard stop: abandon everything (tests/teardown only — in-flight
+        requests are counted dropped)."""
+        with self._lock:
+            self._stopped = True
+            self._draining = True
+            aborted = list(self._queue)
+            self._queue.clear()
+            aborted += [s.req for s in self._slots if s is not None]
+            self._dropped += len(aborted)
+            self._wake.notify()
+        for req in aborted:
+            if not req.done.is_set():
+                req.error = "stopped"
+                req.done.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    # -- stats --------------------------------------------------------------
+
+    def stats(self) -> ServeStats:
+        now = time.monotonic()
+        with self._lock:
+            cutoff = now - self.config.stats_window_s
+            while self._window and self._window[0][0] < cutoff:
+                self._window.popleft()
+            window = list(self._window)
+            itl = sorted(self._itl)
+            used = sum(1 for s in self._slots if s is not None)
+            depth = len(self._queue)
+            # Early in the replica's life the window hasn't filled yet:
+            # rate over the elapsed span, not the configured window.
+            span = max(0.25, min(self.config.stats_window_s,
+                                 now - self._start_t))
+            phase = (PHASE_DRAIN if self._draining
+                     else PHASE_SERVING if self._ready.is_set()
+                     else PHASE_LOAD)
+            st = ServeStats(
+                step=self._steps,
+                completed=self._completed,
+                dropped=self._dropped,
+                tokens_out=self._tokens_out,
+                qps=round(len(window) / span, 3),
+                tokens_per_sec=round(
+                    sum(w[3] for w in window) / span, 3),
+                ttft_ms=round(
+                    _pct(sorted(w[1] for w in window), 0.5) * 1e3, 3),
+                ttft_p99_ms=round(
+                    _pct(sorted(w[1] for w in window), 0.99) * 1e3, 3),
+                itl_ms=round(_pct(itl, 0.5) * 1e3, 3),
+                queue_depth=depth,
+                slots_used=used,
+                slots_total=self.config.slots,
+                phase=phase,
+                prefill_compiles=getattr(self.backend,
+                                         "prefill_compiles", 0),
+            )
+        return st
+
+    # -- decode loop --------------------------------------------------------
+
+    def _run(self) -> None:
+        import numpy as np
+
+        self.backend.load(self.config)
+        # First-decode-step readiness probe: one warmup request through
+        # prefill + a decode step would need a real prompt; instead the
+        # engine is "ready" the moment the backend finished loading AND the
+        # first real decode step has run — but an idle replica must also
+        # become ready, so readiness = model loaded + decode program built
+        # via a scratch warmup sequence.
+        self._warmup(np)
+        self._ready.set()
+        if self.on_ready is not None:
+            try:
+                self.on_ready()
+            except Exception:  # noqa: BLE001 - readiness hook is advisory
+                pass
+        while True:
+            with self._lock:
+                if self._stopped:
+                    break
+                have_work = (any(s is not None for s in self._slots)
+                             or bool(self._queue))
+                if not have_work:
+                    if self._draining:
+                        break
+                    self._wake.wait(timeout=0.05)
+                    continue
+            self._admit(np)
+            self._step(np)
+        self._drained.set()
+
+    def _warmup(self, np) -> None:
+        """Build (or cache-hit) the decode program and the smallest
+        prefill bucket before declaring ready, so the first real request
+        never pays a compile: readiness == model loaded + first decode
+        step executed (the ISSUE's serving-readiness contract)."""
+        cfg = self.config
+        bucket = min(cfg.prefill_buckets)
+        pages = [self._free_pages.pop()]
+        rows = np.zeros(bucket, np.int32)
+        rows[0] = pages[0] * cfg.page_size
+        tok = self.backend.prefill(
+            np.zeros((1, bucket), np.int32), rows, 1)
+        tokens = np.zeros(cfg.slots, np.int32)
+        tokens[0] = tok
+        positions = np.zeros(cfg.slots, np.int32)
+        positions[0] = 1
+        tables = np.zeros((cfg.slots, cfg.pages_per_slot()), np.int32)
+        tables[0, 0] = pages[0]
+        self.backend.decode(tokens, positions, tables)
+        self._steps += 1
+        self._free_pages.append(pages[0])
+
+    def _admit(self, np) -> None:
+        """Move queued requests into free slots (continuous mode: any
+        step; static mode: only when the batch is empty — then fill it)."""
+        cfg = self.config
+        while True:
+            with self._lock:
+                free = [i for i, s in enumerate(self._slots) if s is None]
+                if not self._queue or not free:
+                    return
+                if not cfg.cont_batch and not self._batch_open:
+                    return  # static: admission closed until the batch ends
+                req = self._queue.popleft()
+            # Oversized prompts truncate to the largest bucket (the
+            # compiled shape set is closed; max_len bounds output room).
+            bucket = cfg.bucket_for(len(req.tokens))
+            plen = max(1, min(len(req.tokens), bucket))
+            need = -(-plen // cfg.page_size)
+            with self._lock:
+                if len(self._free_pages) < need:
+                    # Admission is O(free pages): not enough — requeue at
+                    # the head and retry after evictions free pages.
+                    self._queue.appendleft(req)
+                    return
+                pages = [self._free_pages.pop() for _ in range(need)]
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :plen] = np.asarray(req.tokens[:plen], np.int32)
+            rows = np.zeros(bucket, np.int32)
+            for j in range(bucket):
+                if j < plen:
+                    rows[j] = (pages[j // cfg.page_size] * cfg.page_size
+                               + j % cfg.page_size)
+                # else: row 0 — the scratch page
+            first = self.backend.prefill(toks, rows, plen)
+            now = time.monotonic()
+            req.first_token_t = now
+            req.output.append(first)
+            self._tokens_out += 1
+            slot = _Slot(req, pages, plen, first)
+            slot.last_token_t = now
+            if req.max_new_tokens <= 1:
+                self._finish(slot, now)
+                continue
+            with self._lock:
+                idx = next(i for i, s in enumerate(self._slots) if s is None)
+                self._slots[idx] = slot
+
+    def _step(self, np) -> None:
+        cfg = self.config
+        with self._lock:
+            active = [(i, s) for i, s in enumerate(self._slots)
+                      if s is not None]
+        if not active:
+            return
+        tokens = np.zeros(cfg.slots, np.int32)
+        positions = np.zeros(cfg.slots, np.int32)
+        tables = np.zeros((cfg.slots, cfg.pages_per_slot()), np.int32)
+        stepped = []
+        for i, s in active:
+            # Appending at position p needs block p//page allocated.
+            blk = s.position // cfg.page_size
+            if blk >= len(s.pages):
+                with self._lock:
+                    if not self._free_pages:
+                        continue  # out of pages: this slot skips the step
+                    s.pages.append(self._free_pages.pop())
+            tokens[i] = s.last_token
+            positions[i] = s.position
+            for b, pg in enumerate(s.pages):
+                tables[i, b] = pg
+            stepped.append((i, s))
+        if not stepped:
+            return
+        nxt = self.backend.decode(tokens, positions, tables)
+        now = time.monotonic()
+        with self._lock:
+            self._steps += 1
+            self._batch_open = False
+        for i, s in stepped:
+            tok = nxt[i]
+            s.req.output.append(tok)
+            self._tokens_out += 1
+            self._itl.append(now - s.last_token_t)
+            s.last_token_t = now
+            s.last_token = tok
+            s.position += 1
+            if len(s.req.output) >= s.req.max_new_tokens:
+                if cfg.cont_batch:
+                    # Vacate immediately: pages back to the pool, slot
+                    # free for the next queued request on the NEXT step.
+                    self._finish(s, now, slot_index=i)
+                else:
+                    # Static baseline: mark done but HOLD the slot (pad to
+                    # the longest request); release at the batch boundary.
+                    if not s.req.done.is_set():
+                        s.req.finish_t = now
+                        with self._lock:
+                            self._completed += 1
+                            self._window.append(
+                                (now, s.req.ttft_s, s.req.latency_s,
+                                 len(s.req.output)))
+                        s.req.done.set()
+        if not cfg.cont_batch:
+            with self._lock:
+                live = [s for s in self._slots if s is not None]
+                if live and all(s.req.done.is_set() for s in live):
+                    for i, s in enumerate(self._slots):
+                        if s is not None:
+                            self._free_pages.extend(s.pages)
+                            self._slots[i] = None
+                    self._batch_open = True
+
+    def _finish(self, slot: _Slot, now: float,
+                slot_index: Optional[int] = None) -> None:
+        slot.req.finish_t = now
+        with self._lock:
+            self._completed += 1
+            self._window.append((now, slot.req.ttft_s, slot.req.latency_s,
+                                 len(slot.req.output)))
+            self._free_pages.extend(slot.pages)
+            if slot_index is not None:
+                self._slots[slot_index] = None
+        slot.req.done.set()
+
+
+# ---------------------------------------------------------------------------
+# Executed-pod entrypoint
+# ---------------------------------------------------------------------------
+
+def _beat_loop(engine: ServeEngine, stop: threading.Event,
+               interval_s: float = 0.25) -> None:
+    from .progress import reporter
+
+    rep = reporter()
+    while not stop.wait(interval_s):
+        st = engine.stats()
+        rep.beat(step=st.step, examples_per_sec=st.tokens_per_sec,
+                 phase=st.phase, serving=st.as_beat())
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """JSON-lines TCP server over one ServeEngine.
+
+    Request:  {"id": "r1", "prompt": [1,2,3], "max_new": 16}
+    Response: {"id": "r1", "tokens": [...], "ttft_ms": ..., "error": ""}
+
+    SIGTERM (the kubelet's drain/termination signal) closes intake,
+    finishes in-flight requests, then exits 0 — the graceful-drain
+    contract scale-down and rolling updates rely on."""
+    import argparse
+
+    from ..models.llama import LlamaConfig
+    from .progress import reporter
+
+    p = argparse.ArgumentParser(prog="kctpu-serve")
+    p.add_argument("--port", type=int,
+                   default=int(os.environ.get(ENV_SERVE_PORT,
+                                              DEFAULT_SERVE_PORT)))
+    p.add_argument("--slots", type=int,
+                   default=int(os.environ.get(ENV_SERVE_SLOTS, "8")))
+    p.add_argument("--max-len", type=int,
+                   default=int(os.environ.get(ENV_SERVE_MAX_LEN, "256")))
+    p.add_argument("--no-cont-batch", action="store_true")
+    p.add_argument("--synthetic", action="store_true",
+                   help="synthetic backend (no jax) — wiring tests")
+    args = p.parse_args(argv)
+
+    cfg = ServeConfig(slots=args.slots, max_len=args.max_len,
+                      cont_batch=not args.no_cont_batch)
+    backend = (SyntheticBackend() if args.synthetic
+               else LlamaBackend(LlamaConfig.tiny()))
+    rep = reporter()
+    rep.beat(step=0, phase=PHASE_LOAD)
+    engine = ServeEngine(backend, cfg)
+    engine.start()
+
+    stop = threading.Event()
+    beats = threading.Thread(target=_beat_loop, args=(engine, stop),
+                             name="serve-beats", daemon=True)
+    beats.start()
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            for line in self.rfile:
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    continue
+                req = Request(id=str(msg.get("id", "")),
+                              tokens=list(msg.get("prompt", [0])),
+                              max_new_tokens=int(msg.get("max_new", 8)))
+                accepted = engine.submit(req)
+                if accepted:
+                    req.done.wait()
+                else:
+                    req.error = "draining"
+                out = {"id": req.id, "tokens": req.output,
+                       "ttft_ms": round(req.ttft_s * 1e3, 3),
+                       "error": req.error}
+                self.wfile.write(json.dumps(out).encode() + b"\n")
+                self.wfile.flush()
+
+    class Server(socketserver.ThreadingTCPServer):
+        daemon_threads = True
+        allow_reuse_address = True
+
+    srv = Server(("127.0.0.1", args.port), Handler)
+
+    def on_term(signum, frame):
+        # stop intake -> finish in-flight -> exit 0 (graceful drain).
+        engine.drain()
+
+        def _finish():
+            engine._drained.wait(timeout=60.0)
+            st = engine.stats()
+            rep.beat(step=st.step, phase=PHASE_DRAIN, serving=st.as_beat())
+            stop.set()
+            srv.shutdown()
+
+        t = threading.Thread(target=_finish, name="serve-drain-exit",
+                             daemon=True)
+        t.start()
+
+    signal.signal(signal.SIGTERM, on_term)
+    engine.wait_ready()
+    st = engine.stats()
+    rep.beat(step=st.step, phase=st.phase, serving=st.as_beat())
+    print(f"serving on 127.0.0.1:{srv.server_address[1]} "
+          f"(slots={cfg.slots}, cont_batch={cfg.cont_batch})", flush=True)
+    try:
+        srv.serve_forever(poll_interval=0.1)
+    finally:
+        stop.set()
+        engine.stop()
+        srv.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

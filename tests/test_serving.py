@@ -1,0 +1,876 @@
+"""Serving plane: continuous-batching engine, slot-paged KV cache,
+queue-depth autoscaler hysteresis, graceful drain, rolling updates, and
+the bucketed-prefill compile-cache contract (docs/SERVING.md)."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import (
+    PHASE_FAILED,
+    PHASE_PENDING,
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+    Container,
+    Pod,
+    PodProgress,
+    PodTemplateSpec,
+)
+from kubeflow_controller_tpu.api.labels import (
+    ANNOTATION_DRAIN,
+    ANNOTATION_GANG_GENERATION,
+    ANNOTATION_SERVING_REPLICAS,
+    LABEL_INDEX,
+)
+from kubeflow_controller_tpu.api.meta import ObjectMeta
+from kubeflow_controller_tpu.api.tfjob import (
+    AutoscaleSpec,
+    ReplicaType,
+    TFJob,
+    TFJobPhase,
+    TFReplicaSpec,
+    ValidationError,
+    is_serving_job,
+    serving_spec,
+    validate_tfjob,
+)
+from kubeflow_controller_tpu.checker import StallPolicy, StallTracker
+from kubeflow_controller_tpu.planner import Action, make_pod, make_service, plan_job
+from kubeflow_controller_tpu.serving.autoscale import (
+    ServingAutoscaler,
+    serving_width,
+)
+from kubeflow_controller_tpu.updater import compute_status
+from kubeflow_controller_tpu.workloads.serve import (
+    Request,
+    ServeConfig,
+    ServeEngine,
+    SyntheticBackend,
+)
+
+
+def mk_template():
+    t = PodTemplateSpec()
+    t.spec.containers.append(Container(name="srv", image="img"))
+    t.spec.restart_policy = "OnFailure"
+    return t
+
+
+def mk_serving_job(replicas=1, min_r=1, max_r=3, target=4.0,
+                   autoscale=True, stabilization=3.0, tolerance=0.2):
+    job = TFJob(metadata=ObjectMeta(name="svc", namespace="default",
+                                    uid="u-svc"))
+    job.spec.runtime_id = "rid42"
+    if autoscale:
+        job.spec.autoscale = AutoscaleSpec(
+            min_replicas=min_r, max_replicas=max_r,
+            target_queue_depth=target, tolerance=tolerance,
+            scale_down_stabilization_s=stabilization)
+    job.spec.tf_replica_specs.append(TFReplicaSpec(
+        replicas=replicas, tf_replica_type=ReplicaType.SERVING,
+        template=mk_template()))
+    return job
+
+
+def mk_serving_pod(job, index, phase=PHASE_RUNNING, ready=True,
+                   queue_depth=0, generation=None, draining=False,
+                   name=None, ts=1.0):
+    spec = serving_spec(job)
+    p = make_pod(job, spec, index)
+    p.metadata.name = name or f"svc-serving-{index}-x{int(ts)}"
+    p.metadata.creation_timestamp = ts
+    p.status.phase = phase
+    if generation is not None:
+        p.metadata.annotations[ANNOTATION_GANG_GENERATION] = str(generation)
+    if draining:
+        p.metadata.annotations[ANNOTATION_DRAIN] = "scale-down"
+    if ready and phase == PHASE_RUNNING:
+        p.status.progress = PodProgress(
+            step=10, phase="serving", qps=2.0, ttft_ms=5.0, itl_ms=1.0,
+            queue_depth=queue_depth, slots_used=2, slots_total=4,
+            timestamp=time.time())
+    return p
+
+
+def set_width(job, n):
+    job.metadata.annotations[ANNOTATION_SERVING_REPLICAS] = str(n)
+
+
+# ---------------------------------------------------------------------------
+# API + validation
+# ---------------------------------------------------------------------------
+
+class TestServingAPI:
+    def test_classifiers(self):
+        job = mk_serving_job()
+        assert is_serving_job(job)
+        assert serving_spec(job) is job.spec.tf_replica_specs[0]
+
+    def test_valid_spec(self):
+        validate_tfjob(mk_serving_job())
+
+    def test_autoscale_requires_serving_set(self):
+        job = mk_serving_job()
+        job.spec.tf_replica_specs[0].tf_replica_type = ReplicaType.WORKER
+        with pytest.raises(ValidationError):
+            validate_tfjob(job)
+
+    def test_autoscale_bounds_validated(self):
+        with pytest.raises(ValidationError):
+            validate_tfjob(mk_serving_job(min_r=0))
+        with pytest.raises(ValidationError):
+            validate_tfjob(mk_serving_job(min_r=3, max_r=2, replicas=3))
+        with pytest.raises(ValidationError):
+            validate_tfjob(mk_serving_job(target=0.0))
+        with pytest.raises(ValidationError):
+            validate_tfjob(mk_serving_job(replicas=5, max_r=3))
+
+    def test_serving_width_annotation_clamped(self):
+        job = mk_serving_job(min_r=1, max_r=3)
+        assert serving_width(job) == 1  # default = minReplicas
+        set_width(job, 2)
+        assert serving_width(job) == 2
+        set_width(job, 9)
+        assert serving_width(job) == 3  # clamped to maxReplicas
+        job.metadata.annotations[ANNOTATION_SERVING_REPLICAS] = "junk"
+        assert serving_width(job) == 1
+
+    def test_serving_width_without_autoscale(self):
+        job = mk_serving_job(replicas=2, autoscale=False)
+        assert serving_width(job) == 2
+
+
+# ---------------------------------------------------------------------------
+# Engine: slot accounting, continuous vs static, drain
+# ---------------------------------------------------------------------------
+
+def mk_engine(slots=4, page_size=8, max_len=64, cont=True, backend=None):
+    eng = ServeEngine(
+        backend or SyntheticBackend(),
+        ServeConfig(slots=slots, page_size=page_size, max_len=max_len,
+                    prefill_buckets=(8, 16, 32), cont_batch=cont,
+                    stats_window_s=2.0))
+    eng.start()
+    assert eng.wait_ready(30)
+    return eng
+
+
+class TestServeEngine:
+    def test_all_requests_complete_exact_lengths(self):
+        eng = mk_engine()
+        rng = random.Random(3)
+        reqs = [Request(id=str(i), tokens=[1 + i % 40] * rng.randrange(1, 30),
+                        max_new_tokens=rng.randrange(1, 10))
+                for i in range(25)]
+        for r in reqs:
+            assert eng.submit(r)
+        for r in reqs:
+            assert r.done.wait(30), r.id
+            assert len(r.output) == r.max_new_tokens
+        st = eng.stats()
+        assert st.completed == 25 and st.dropped == 0
+        assert st.slots_used == 0 and st.queue_depth == 0
+        eng.stop()
+
+    def test_slot_and_page_accounting_under_concurrent_admit_evict(self):
+        """Hammer submits from several threads while the decode loop
+        admits and evicts; every page must come home and the slot table
+        must empty."""
+        eng = mk_engine(slots=3, page_size=8, max_len=48)
+        total_pages = 3 * (48 // 8)
+        rng = random.Random(11)
+        reqs = []
+        errs = []
+
+        def feeder(tid):
+            local = random.Random(100 + tid)
+            for i in range(30):
+                r = Request(id=f"{tid}-{i}",
+                            tokens=[1] * local.randrange(1, 40),
+                            max_new_tokens=local.randrange(1, 12))
+                reqs.append(r)
+                if not eng.submit(r):
+                    errs.append(r.id)
+                time.sleep(local.random() * 0.002)
+
+        threads = [threading.Thread(target=feeder, args=(t,),
+                                    name=f"serve-feeder-{t}", daemon=True)
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for r in reqs:
+            assert r.done.wait(60), r.id
+            assert len(r.output) == r.max_new_tokens
+        assert not errs
+        # Decode loop idle: pages all free, slots all empty.
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            st = eng.stats()
+            if st.slots_used == 0:
+                break
+            time.sleep(0.01)
+        assert eng.stats().slots_used == 0
+        with eng._lock:
+            assert sorted(eng._free_pages) == list(range(1, total_pages + 1))
+            assert all(s is None for s in eng._slots)
+        eng.stop()
+
+    def test_continuous_beats_static_on_mixed_lengths(self):
+        """Same request set, same backend cost model: continuous batching
+        must finish the burst in fewer decode steps than the padding
+        static baseline (steps are the device-time proxy)."""
+        def burst(cont):
+            eng = mk_engine(slots=4, cont=cont)
+            rng = random.Random(5)
+            reqs = [Request(id=str(i), tokens=[2] * 4,
+                            max_new_tokens=rng.choice([2, 4, 8, 24]))
+                    for i in range(24)]
+            for r in reqs:
+                eng.submit(r)
+            for r in reqs:
+                assert r.done.wait(30)
+            steps = eng.stats().step
+            eng.stop()
+            return steps
+
+        static_steps = burst(False)
+        cont_steps = burst(True)
+        assert cont_steps < static_steps / 1.5, (cont_steps, static_steps)
+
+    def test_drain_stops_intake_finishes_inflight(self):
+        backend = SyntheticBackend(step_s=0.005)
+        eng = mk_engine(slots=2, backend=backend)
+        inflight = [Request(id=f"in-{i}", tokens=[1, 2],
+                            max_new_tokens=20) for i in range(2)]
+        queued = [Request(id=f"q-{i}", tokens=[1], max_new_tokens=4)
+                  for i in range(3)]
+        for r in inflight + queued:
+            eng.submit(r)
+        # Let the two in-flight requests admit (slots=2).
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and eng.stats().slots_used < 2:
+            time.sleep(0.005)
+        handed_back = eng.drain()
+        # Unadmitted queue handed back for re-routing; intake closed.
+        assert {r.id for r in handed_back} <= {r.id for r in queued}
+        late = Request(id="late", tokens=[1], max_new_tokens=1)
+        assert not eng.submit(late)
+        assert not late.done.is_set()  # untouched: caller re-routes
+        # In-flight requests complete in full.
+        for r in inflight:
+            assert r.done.wait(30), r.id
+            assert len(r.output) == r.max_new_tokens and not r.error
+        assert eng._drained.wait(10)
+        assert eng.stats().phase == "drain"
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# Bucketed-prefill compile contract (the PR 8 cache fix)
+# ---------------------------------------------------------------------------
+
+class TestPrefillBuckets:
+    def test_bucket_for(self):
+        cfg = ServeConfig(prefill_buckets=(8, 16, 32))
+        assert cfg.bucket_for(1) == 8
+        assert cfg.bucket_for(8) == 8
+        assert cfg.bucket_for(9) == 16
+        assert cfg.bucket_for(33) == 32  # oversized: largest bucket
+
+    def test_100_request_sweep_bounded_compiles(self):
+        """The regression the fingerprint fix exists for: 100 requests of
+        novel lengths must compile at most len(buckets) prefill
+        programs — keying on raw lengths would compile ~one per length
+        on the serving hot path."""
+        eng = mk_engine(slots=4)
+        rng = random.Random(17)
+        reqs = [Request(id=str(i), tokens=[1] * rng.randrange(1, 33),
+                        max_new_tokens=2) for i in range(100)]
+        for r in reqs:
+            eng.submit(r)
+        for r in reqs:
+            assert r.done.wait(60)
+        assert eng.stats().prefill_compiles <= 3
+        eng.stop()
+
+    def test_fingerprint_keys_on_bucket_not_length(self):
+        """LlamaBackend's AOT fingerprint is a pure function of the
+        BUCKETED shape set (jax-free check: the fingerprint is computed
+        before any compile)."""
+        from kubeflow_controller_tpu.models.llama import LlamaConfig
+        from kubeflow_controller_tpu.workloads.serve import LlamaBackend
+
+        cfg = ServeConfig(slots=2, page_size=8, max_len=64,
+                          prefill_buckets=(8, 16))
+        b = LlamaBackend(LlamaConfig.tiny())
+        b._serve_cfg = cfg
+        b._num_pages = 1 + cfg.slots * cfg.pages_per_slot()
+        # Lengths 3 and 7 share bucket 8 -> identical fingerprints.
+        assert (b._fingerprint("prefill", cfg.bucket_for(3))
+                == b._fingerprint("prefill", cfg.bucket_for(7)))
+        # Different buckets -> different programs.
+        assert (b._fingerprint("prefill", cfg.bucket_for(3))
+                != b._fingerprint("prefill", cfg.bucket_for(9)))
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler hysteresis
+# ---------------------------------------------------------------------------
+
+class TestAutoscalerHysteresis:
+    def assess(self, a, job, depths, now, ready=True):
+        pods = [mk_serving_pod(job, i, queue_depth=d, ready=ready)
+                for i, d in enumerate(depths)]
+        return a.assess("default/svc", job, pods, now=now)
+
+    def test_scale_up_immediate(self):
+        job = mk_serving_job(target=4.0)
+        a = ServingAutoscaler()
+        d = self.assess(a, job, [12], now=100.0)
+        assert d.target == 3  # ceil(1 * 12/4) = 3, clamped to max 3
+
+    def test_no_flapping_inside_tolerance(self):
+        """Depths oscillating around the setpoint (within the band) must
+        produce ZERO scale decisions over many assessments."""
+        job = mk_serving_job(target=4.0, tolerance=0.25)
+        set_width(job, 2)
+        a = ServingAutoscaler()
+        for i, d in enumerate([4, 5, 3, 4, 5, 3, 4] * 5):
+            dec = self.assess(a, job, [d, d], now=100.0 + i)
+            assert dec.target is None, (i, d, dec)
+
+    def test_scale_down_waits_out_stabilization(self):
+        job = mk_serving_job(target=4.0, stabilization=5.0)
+        set_width(job, 3)
+        a = ServingAutoscaler()
+        d = self.assess(a, job, [0, 0, 0], now=100.0)
+        assert d.target is None and d.requeue_after_s > 0
+        d = self.assess(a, job, [0, 0, 0], now=103.0)
+        assert d.target is None  # still inside the window
+        d = self.assess(a, job, [0, 0, 0], now=105.5)
+        assert d.target == 1
+
+    def test_burst_resets_scale_down_window(self):
+        job = mk_serving_job(target=4.0, stabilization=5.0)
+        set_width(job, 3)
+        a = ServingAutoscaler()
+        self.assess(a, job, [0, 0, 0], now=100.0)
+        # Load returns mid-window: the clock must reset.
+        self.assess(a, job, [5, 5, 5], now=103.0)
+        d = self.assess(a, job, [0, 0, 0], now=106.0)
+        assert d.target is None  # a fresh window started at 106
+        d = self.assess(a, job, [0, 0, 0], now=111.5)
+        assert d.target == 1
+
+    def test_scale_up_held_while_replicas_warm(self):
+        """ready < current: the requested capacity hasn't materialized;
+        asking again would double-provision the same backlog."""
+        job = mk_serving_job(target=4.0)
+        set_width(job, 2)
+        a = ServingAutoscaler()
+        pods = [mk_serving_pod(job, 0, queue_depth=40),
+                mk_serving_pod(job, 1, ready=False)]
+        d = a.assess("default/svc", job, pods, now=100.0)
+        assert d.target is None
+
+    def test_no_signal_no_action(self):
+        job = mk_serving_job()
+        a = ServingAutoscaler()
+        d = a.assess("default/svc", job,
+                     [mk_serving_pod(job, 0, ready=False)], now=100.0)
+        assert d.target is None and d.requeue_after_s == 0
+
+
+# ---------------------------------------------------------------------------
+# Planner: serving plans (create / drain / rolling update)
+# ---------------------------------------------------------------------------
+
+POD_ACTIONS = (Action.ADD_POD, Action.DELETE_POD, Action.DRAIN_POD)
+
+
+def actions(plan):
+    return [(e.action, e.index) for e in plan.events
+            if e.replica_type == ReplicaType.SERVING
+            and e.action in POD_ACTIONS]
+
+
+class TestServingPlanner:
+    def plan(self, job, pods):
+        return plan_job(job, {ReplicaType.SERVING: pods},
+                        {ReplicaType.SERVING: []})
+
+    def test_creates_to_target(self):
+        job = mk_serving_job()
+        set_width(job, 2)
+        plan = self.plan(job, [])
+        assert (Action.ADD_POD, 0) in actions(plan)
+        assert (Action.ADD_POD, 1) in actions(plan)
+
+    def test_scale_down_drains_not_deletes(self):
+        job = mk_serving_job()
+        set_width(job, 1)
+        pods = [mk_serving_pod(job, 0), mk_serving_pod(job, 1),
+                mk_serving_pod(job, 2)]
+        acts = actions(self.plan(job, pods))
+        assert (Action.DRAIN_POD, 1) in acts
+        assert (Action.DRAIN_POD, 2) in acts
+        assert not any(a == Action.DELETE_POD for a, _ in acts)
+
+    def test_draining_pod_not_redrained(self):
+        job = mk_serving_job()
+        set_width(job, 1)
+        pods = [mk_serving_pod(job, 0), mk_serving_pod(job, 1, draining=True)]
+        assert actions(self.plan(job, pods)) == []
+
+    def test_drained_record_cleared(self):
+        job = mk_serving_job()
+        set_width(job, 1)
+        pods = [mk_serving_pod(job, 0),
+                mk_serving_pod(job, 1, phase=PHASE_SUCCEEDED, ready=False)]
+        acts = actions(self.plan(job, pods))
+        assert (Action.DELETE_POD, 1) in acts
+        assert (Action.ADD_POD, 1) not in acts
+
+    def test_exited_server_at_in_target_index_recreated(self):
+        """A serving index is never 'done': a Succeeded exit below the
+        target is replaced (unlike batch workers)."""
+        job = mk_serving_job()
+        set_width(job, 1)
+        pods = [mk_serving_pod(job, 0, phase=PHASE_SUCCEEDED, ready=False)]
+        acts = actions(self.plan(job, pods))
+        assert (Action.DELETE_POD, 0) in acts
+        assert (Action.ADD_POD, 0) in acts
+
+    def test_rolling_update_one_at_a_time(self):
+        job = mk_serving_job()
+        set_width(job, 3)
+        job.metadata.annotations[ANNOTATION_GANG_GENERATION] = "1"
+        pods = [mk_serving_pod(job, i, generation=0) for i in range(3)]
+        acts = actions(self.plan(job, pods))
+        drains = [i for a, i in acts if a == Action.DRAIN_POD]
+        assert drains == [0]  # exactly one stale replica drains
+
+    def test_rolling_waits_for_replacement_ready(self):
+        job = mk_serving_job()
+        set_width(job, 3)
+        job.metadata.annotations[ANNOTATION_GANG_GENERATION] = "1"
+        pods = [mk_serving_pod(job, 0, generation=1, ready=False),  # warming
+                mk_serving_pod(job, 1, generation=0),
+                mk_serving_pod(job, 2, generation=0)]
+        acts = actions(self.plan(job, pods))
+        assert not any(a == Action.DRAIN_POD for a, _ in acts)
+
+    def test_rolling_waits_while_draining(self):
+        job = mk_serving_job()
+        set_width(job, 3)
+        job.metadata.annotations[ANNOTATION_GANG_GENERATION] = "1"
+        pods = [mk_serving_pod(job, 0, generation=0, draining=True),
+                mk_serving_pod(job, 1, generation=0),
+                mk_serving_pod(job, 2, generation=0)]
+        acts = actions(self.plan(job, pods))
+        assert not any(a == Action.DRAIN_POD for a, _ in acts)
+
+    def test_fresh_generation_plan_is_stable(self):
+        job = mk_serving_job()
+        set_width(job, 2)
+        pods = [mk_serving_pod(job, 0, generation=0),
+                mk_serving_pod(job, 1, generation=0)]
+        assert actions(self.plan(job, pods)) == []
+
+    def test_serving_service_per_replica(self):
+        job = mk_serving_job()
+        set_width(job, 2)
+        plan = self.plan(job, [])
+        svc_adds = [e for e in plan.events
+                    if e.action == Action.ADD_SERVICE
+                    and e.replica_type == ReplicaType.SERVING]
+        assert [e.index for e in svc_adds] == [0, 1]
+        svc = make_service(job, serving_spec(job), 0)
+        assert svc.spec.ports[0].port == 8500
+        assert svc.spec.selector[LABEL_INDEX] == "0"
+
+
+# ---------------------------------------------------------------------------
+# Updater: serving rollup + long-running phase semantics
+# ---------------------------------------------------------------------------
+
+class TestServingStatus:
+    def test_serving_job_never_succeeds(self):
+        job = mk_serving_job()
+        set_width(job, 1)
+        pods = [mk_serving_pod(job, 0, phase=PHASE_SUCCEEDED, ready=False)]
+        st = compute_status(job, {ReplicaType.SERVING: pods})
+        assert st.phase != TFJobPhase.SUCCEEDED
+
+    def test_running_and_rollup(self):
+        job = mk_serving_job()
+        set_width(job, 2)
+        pods = [mk_serving_pod(job, 0, queue_depth=3),
+                mk_serving_pod(job, 1, queue_depth=5)]
+        st = compute_status(job, {ReplicaType.SERVING: pods})
+        assert st.phase == TFJobPhase.RUNNING
+        assert st.serving is not None
+        assert st.serving.replicas == 2 and st.serving.ready == 2
+        assert st.serving.queue_depth == 8
+        assert st.serving.qps == 4.0
+        assert st.serving.occupancy == 0.5
+        assert st.serving.min_replicas == 1 and st.serving.max_replicas == 3
+
+    def test_ready_requires_first_decode_step(self):
+        job = mk_serving_job()
+        set_width(job, 1)
+        loading = mk_serving_pod(job, 0, ready=False)
+        loading.status.progress = PodProgress(phase="load",
+                                              timestamp=time.time())
+        st = compute_status(job, {ReplicaType.SERVING: [loading]})
+        ready = next(c for c in st.conditions if c.type.value == "Ready")
+        assert ready.status == "False"
+        assert st.serving.ready == 0
+        st = compute_status(job,
+                            {ReplicaType.SERVING: [mk_serving_pod(job, 0)]})
+        ready = next(c for c in st.conditions if c.type.value == "Ready")
+        assert ready.status == "True"
+
+    def test_non_serving_job_has_no_serving_status(self):
+        job = TFJob(metadata=ObjectMeta(name="j", namespace="default"))
+        job.spec.runtime_id = "r1"
+        job.spec.tf_replica_specs.append(TFReplicaSpec(
+            replicas=1, tf_replica_type=ReplicaType.WORKER,
+            template=mk_template()))
+        st = compute_status(job, {ReplicaType.WORKER: []})
+        assert st.serving is None
+
+
+# ---------------------------------------------------------------------------
+# Stall semantics: serving phases hold the frozen-step deadline
+# ---------------------------------------------------------------------------
+
+class TestServingStallHold:
+    def mk_beat(self, step, phase, t):
+        return PodProgress(step=step, phase=phase, timestamp=t)
+
+    def test_idle_serving_replica_not_stalled(self):
+        """Step counter frozen for far past the step deadline while
+        phase="serving": held (idle servers are healthy); a fresh
+        heartbeat keeps the liveness clock green."""
+        tr = StallTracker(StallPolicy(heartbeat_deadline_s=30.0,
+                                      step_deadline_s=10.0))
+        t0 = 1000.0
+        for dt in (0.0, 5.0, 11.0, 60.0, 300.0):
+            assert not tr.observe("ns/p", self.mk_beat(7, "serving", t0 + dt),
+                                  now=t0 + dt)
+
+    def test_load_and_drain_held_too(self):
+        for phase in ("load", "drain"):
+            tr = StallTracker(StallPolicy(heartbeat_deadline_s=30.0,
+                                          step_deadline_s=10.0))
+            t0 = 2000.0
+            for dt in (0.0, 15.0, 45.0):
+                assert not tr.observe(f"ns/{phase}",
+                                      self.mk_beat(0, phase, t0 + dt),
+                                      now=t0 + dt)
+
+    def test_dead_server_still_flagged_by_heartbeat(self):
+        tr = StallTracker(StallPolicy(heartbeat_deadline_s=30.0,
+                                      step_deadline_s=10.0))
+        t0 = 3000.0
+        assert not tr.observe("ns/dead", self.mk_beat(7, "serving", t0),
+                              now=t0)
+        # Beats STOP: the stale timestamp trips the heartbeat deadline.
+        assert tr.observe("ns/dead", self.mk_beat(7, "serving", t0),
+                          now=t0 + 31.0)
+
+
+# ---------------------------------------------------------------------------
+# E2E: controller + kubelet (scale up / drain down / roll / gauges)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def serving_cluster():
+    from kubeflow_controller_tpu.cluster import (
+        Cluster,
+        FakeKubelet,
+        PhasePolicy,
+    )
+    from kubeflow_controller_tpu.controller import Controller
+
+    cluster = Cluster()
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=0.05))
+    ctrl = Controller(cluster, resync_period_s=2.0)
+    kubelet.start()
+    ctrl.run()
+    yield cluster, kubelet, ctrl
+    ctrl.stop()
+    kubelet.stop()
+
+
+def serving_pods(cluster, phase=None):
+    out = [p for p in cluster.pods.list("default")
+           if p.metadata.labels.get("job_type") == "Serving"]
+    if phase:
+        out = [p for p in out if p.status.phase == phase]
+    return out
+
+
+def beat_pod(cluster, p, depth):
+    """What a live replica publishes: serving beats under load, a
+    drain-ACK beat (phase="drain", empty) once it sees its annotation."""
+    draining = bool(p.metadata.annotations.get(ANNOTATION_DRAIN))
+    cluster.pods.update_progress("default", p.metadata.name, PodProgress(
+        step=10, phase="drain" if draining else "serving",
+        qps=2.0, ttft_ms=4.0, itl_ms=1.0,
+        queue_depth=0 if draining else depth,
+        slots_used=0 if draining else 2, slots_total=4))
+
+
+def pump_until(cluster, depth, cond, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for p in serving_pods(cluster, PHASE_RUNNING):
+            beat_pod(cluster, p, depth)
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.mark.slow
+class TestServingE2E:
+    def test_scale_up_drain_down_roll_and_gauge_cleanup(self, serving_cluster):
+        import re
+
+        from kubeflow_controller_tpu.obs.metrics import REGISTRY
+
+        cluster, kubelet, ctrl = serving_cluster
+        job = mk_serving_job(stabilization=1.0)
+        cluster.tfjobs.create(job)
+
+        assert pump_until(cluster, 0, lambda: len(
+            serving_pods(cluster, PHASE_RUNNING)) == 1)
+
+        # Load: queue depth far past target -> scale to max.
+        assert pump_until(cluster, 12, lambda: len(
+            serving_pods(cluster, PHASE_RUNNING)) == 3)
+        j = cluster.tfjobs.get("default", "svc")
+        assert j.metadata.annotations[ANNOTATION_SERVING_REPLICAS] == "3"
+
+        # Quiet: graceful drain back to min (1); drained records cleared.
+        assert pump_until(cluster, 0, lambda: len(
+            serving_pods(cluster, PHASE_RUNNING)) == 1, timeout=30.0)
+
+        # Per-replica gauge series freed on scale-down (Gauge.remove).
+        def live_series():
+            return re.findall(r'kctpu_serve_queue_depth\{[^}]*tfjob="svc"[^}]*\}',
+                              REGISTRY.render())
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(live_series()) > 1:
+            for p in serving_pods(cluster, PHASE_RUNNING):
+                beat_pod(cluster, p, 0)
+            time.sleep(0.05)
+        assert len(live_series()) <= 1
+
+        # Rolling weight update: generation bump replaces the replica
+        # through drain, zero hard deletes of a live server.
+        def bump(m):
+            m.annotations[ANNOTATION_GANG_GENERATION] = "1"
+
+        cluster.tfjobs.patch_meta("default", "svc", bump)
+
+        def rolled():
+            r = serving_pods(cluster, PHASE_RUNNING)
+            return bool(r) and all(
+                p.metadata.annotations.get(ANNOTATION_GANG_GENERATION) == "1"
+                for p in r)
+
+        assert pump_until(cluster, 0, rolled, timeout=30.0)
+        reasons = [e.reason for e in ctrl.recorder.events_for("default", "svc")]
+        assert "ServingScaledUp" in reasons
+        assert "ServingScaledDown" in reasons
+        assert "ServingDraining" in reasons
+
+        # Job deletion drops every serving series (deletion syncs are
+        # async: wait for the final job-gone sync's drop to land).
+        cluster.tfjobs.delete("default", "svc")
+
+        def any_svc_series():
+            page = REGISTRY.render()
+            return (live_series()
+                    or 'kctpu_serve_qps{namespace="default",tfjob="svc"}'
+                    in page)
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and any_svc_series():
+            time.sleep(0.05)
+        assert not any_svc_series()
+
+
+# ---------------------------------------------------------------------------
+# Executed entrypoint: SIGTERM = stop intake -> finish -> exit 0
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestServeMainDrain:
+    def test_sigterm_graceful_exit(self, tmp_path):
+        import json
+        import os
+        import signal
+        import socket
+        import subprocess
+        import sys
+
+        port = _free_port()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kubeflow_controller_tpu.workloads.serve",
+             "--synthetic", "--port", str(port), "--slots", "2"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env)
+        try:
+            deadline = time.monotonic() + 30
+            sock = None
+            while time.monotonic() < deadline:
+                try:
+                    sock = socket.create_connection(("127.0.0.1", port),
+                                                    timeout=0.2)
+                    break
+                except OSError:
+                    time.sleep(0.1)
+            assert sock is not None, proc.stderr.peek()[:500]
+            f = sock.makefile("rwb")
+            f.write(json.dumps({"id": "r1", "prompt": [1, 2, 3],
+                                "max_new": 4}).encode() + b"\n")
+            f.flush()
+            resp = json.loads(f.readline())
+            assert resp["id"] == "r1" and len(resp["tokens"]) == 4
+            # SIGTERM mid-request: the in-flight request must complete
+            # and the process must exit 0.
+            f.write(json.dumps({"id": "r2", "prompt": [5],
+                                "max_new": 50}).encode() + b"\n")
+            f.flush()
+            time.sleep(0.05)
+            proc.send_signal(signal.SIGTERM)
+            resp2 = json.loads(f.readline())
+            assert resp2["id"] == "r2"
+            assert len(resp2["tokens"]) == 50 and not resp2["error"]
+            sock.close()
+            assert proc.wait(timeout=30) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# Paged KV cache vs the dense oracle (models/generate.py)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestPagedCache:
+    def test_paged_decode_matches_generate(self):
+        """Two staggered slots decoded through the paged pool reproduce
+        the contiguous-cache generate() exactly (greedy)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kubeflow_controller_tpu.models.generate import (
+            generate,
+            init_paged_cache,
+            paged_decode_step,
+            paged_prefill,
+        )
+        from kubeflow_controller_tpu.models.llama import (
+            LlamaConfig,
+            llama_init,
+        )
+
+        cfg = LlamaConfig.tiny()
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        page = 8
+        cache = init_paged_cache(cfg, num_pages=17, page_size=page)
+        prompts = [[7, 3, 9, 11, 2], [5, 1, 4, 1, 5, 9, 2, 6, 5]]
+        new_tokens = 6
+
+        # Host-side page tables: slot 0 -> pages 1..8, slot 1 -> 9..16.
+        tables = np.zeros((2, 8), np.int32)
+        tables[0] = np.arange(1, 9)
+        tables[1] = np.arange(9, 17)
+        outs = [[], []]
+        positions = []
+        for b, prompt in enumerate(prompts):
+            plen = len(prompt)
+            bucket = 16
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :plen] = prompt
+            rows = np.zeros(bucket, np.int32)
+            for j in range(bucket):
+                if j < plen:
+                    rows[j] = tables[b, j // page] * page + j % page
+            logits, cache = paged_prefill(params, jnp.asarray(toks), cache,
+                                          jnp.asarray(rows), plen, cfg)
+            outs[b].append(int(jnp.argmax(logits)))
+            positions.append(plen)
+        for _ in range(new_tokens - 1):
+            toks = jnp.asarray([outs[0][-1], outs[1][-1]], jnp.int32)
+            logits, cache = paged_decode_step(
+                params, toks, cache, jnp.asarray(positions, jnp.int32),
+                jnp.asarray(tables), cfg, page)
+            nxt = jnp.argmax(logits, axis=-1)
+            for b in range(2):
+                outs[b].append(int(nxt[b]))
+                positions[b] += 1
+
+        for b, prompt in enumerate(prompts):
+            oracle = np.asarray(generate(
+                params, jnp.asarray([prompt]), cfg,
+                max_new_tokens=new_tokens))[0, len(prompt):]
+            assert outs[b] == [int(x) for x in oracle], b
+
+    def test_engine_matches_generate_oracle(self):
+        """The full engine (admission, paging, bucketing) is greedy-exact
+        against generate() for a batch of concurrent requests."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kubeflow_controller_tpu.models.generate import generate
+        from kubeflow_controller_tpu.models.llama import (
+            LlamaConfig,
+            llama_init,
+        )
+        from kubeflow_controller_tpu.workloads.serve import LlamaBackend
+
+        cfg = LlamaConfig.tiny()
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        eng = mk_engine(slots=3, page_size=8, max_len=64,
+                        backend=LlamaBackend(cfg, seed=0))
+        rng = random.Random(23)
+        reqs = [Request(id=str(i),
+                        tokens=[rng.randrange(1, 250)
+                                for _ in range(rng.randrange(2, 20))],
+                        max_new_tokens=5) for i in range(7)]
+        for r in reqs:
+            eng.submit(r)
+        for r in reqs:
+            assert r.done.wait(120), r.id
+        eng.stop()
+        for r in reqs:
+            oracle = np.asarray(generate(
+                params, jnp.asarray([r.tokens]), cfg,
+                max_new_tokens=5))[0, len(r.tokens):]
+            assert r.output == [int(x) for x in oracle], r.id
