@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all ci test test-fast lint typecheck cov cov-local bench dryrun validate vet race-smoke check-smoke metrics-smoke scale-smoke scale10k-smoke stall-smoke widejob-smoke churn-smoke store-smoke sched-smoke ttfs-smoke chaos-smoke elastic-smoke ha-smoke serve-smoke
+.PHONY: all ci test test-fast lint typecheck cov cov-local bench dryrun validate vet race-smoke check-smoke metrics-smoke scale-smoke scale10k-smoke stall-smoke widejob-smoke churn-smoke store-smoke sched-smoke ttfs-smoke chaos-smoke elastic-smoke ha-smoke serve-smoke slo-smoke
 
 all: lint vet test race-smoke check-smoke
 
@@ -15,7 +15,7 @@ all: lint vet test race-smoke check-smoke
 # included), then tier-1 under the runtime lock-order detector.  Run
 # without -j: the order is the diagnosis ladder (cheapest, most precise
 # signal first).
-ci: vet race-smoke check-smoke chaos-smoke elastic-smoke serve-smoke ha-smoke scale10k-smoke
+ci: vet race-smoke check-smoke chaos-smoke elastic-smoke serve-smoke ha-smoke slo-smoke scale10k-smoke
 	KCTPU_LOCKCHECK=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m "not slow"
 
 # Fast/slow split: `test-fast` (-m "not slow") is the quick signal — 214 of
@@ -110,6 +110,18 @@ bench:
 # (docs/OBSERVABILITY.md has the metric catalogue).
 metrics-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m kubeflow_controller_tpu.obs.smoke
+
+# SLO smoke (the observability plane's standing gate, docs/OBSERVABILITY.md
+# "SLO catalogue"): one Serving job whose replica beats a throttled p99
+# TTFT (2.5x over the 2s objective) through the REAL pipeline — beat ->
+# rollup -> gauge -> TSDB sample -> multi-window burn eval.  Gates:
+# EXACTLY ONE Warning SLOBurn fires (edge-triggered, no flapping) and
+# resolves to Normal SLORecovered when the replica recovers, with
+# kctpu_slo_alert_active 1 -> 0 on GET /metrics; plus the trace-continuity
+# gate — the job's causal trace exists, shares one trace_id, and has ZERO
+# orphan spans (every parent_id resolves).  ~5-10 s wall-clock.
+slo-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m kubeflow_controller_tpu.obs.slo_smoke
 
 # Stall smoke: simulated training run, heartbeats killed mid-flight; fails
 # unless Warning TrainingStalled fires and kctpu_job_stalled=1 appears on

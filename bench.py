@@ -227,7 +227,8 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
               record_history: bool = False,
               simulated: bool = False,
               pods_per_job: int = 3,
-              threadiness: int = 0) -> dict:
+              threadiness: int = 0,
+              obs: bool = False) -> dict:
     """N concurrent orchestration-bound TFJobs (1 PS + ``pods_per_job - 1``
     workers each, simulated pod phases) from creation to all-Succeeded.
     Uses only the public controller surface so the same file measures older
@@ -257,7 +258,13 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
     finalizer-gated deletes the sequential spec deliberately doesn't
     model — docs/ANALYSIS.md).  Comparing against a default run measures
     the recording overhead; with the flag OFF the hook costs nothing,
-    which is the bench gate the hook ships under."""
+    which is the bench gate the hook ships under.
+
+    ``obs=True`` runs with the full observability plane on — causal
+    trace spans recording, TSDB sampling /metrics every second, SLO burn
+    evaluation riding each sample pass (``Controller.start_obs_plane``).
+    Comparing against a default run measures the plane's overhead on the
+    orchestration path (docs/PERF.md gates it at <10%)."""
     import threading as _threading
 
     from kubeflow_controller_tpu.api.core import Container, PodTemplateSpec
@@ -311,6 +318,8 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
     kubelet = (SimKubelet(cluster, policy=policy) if simulated
                else FakeKubelet(cluster, policy=policy))
     ctrl = Controller(cluster, resync_period_s=1.0)
+    if obs:
+        ctrl.start_obs_plane(interval_s=1.0)
     kubelet.start()
     if not threadiness:
         threadiness = 4 if n_jobs >= 1000 else 2
@@ -386,6 +395,7 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
         "pods_per_job": pods_per_job,
         "pods_total": n_jobs * pods_per_job,
         "simulated": simulated,
+        "obs": obs,
         "threadiness": threadiness,
         "peak_threads": peak_threads,
         "rss_mib": rss_done_mib,
@@ -3046,7 +3056,8 @@ def scale_main(args) -> int:
                        store_sharded=not args.no_shard,
                        record_history=args.record_history,
                        simulated=args.simulated,
-                       pods_per_job=args.pods_per_job)
+                       pods_per_job=args.pods_per_job,
+                       obs=args.obs)
     m = result["metrics"]
     elapsed = result["elapsed_s"]
     gathers = m.get("gather_indexed", 0) + m.get("gather_full_lists", 0)
@@ -3059,6 +3070,7 @@ def scale_main(args) -> int:
             "pods_per_job": result["pods_per_job"],
             "pods_total": result["pods_total"],
             "simulated": result["simulated"],
+            "obs": result["obs"],
             "threadiness": result["threadiness"],
             "peak_threads": result["peak_threads"],
             "rss_mib": result["rss_mib"],
@@ -3229,6 +3241,12 @@ def main(argv=None) -> int:
                    help="scale mode: exit nonzero when the process' peak "
                         "thread count exceeds N (the simulated-mode O(1)-"
                         "threads gate; 0 = no gate)")
+    p.add_argument("--obs", action="store_true",
+                   help="run --scale with the full observability plane on "
+                        "(causal trace spans, 1s TSDB sampling, SLO burn "
+                        "evaluation); compare against a default run to "
+                        "measure the plane's orchestration overhead "
+                        "(docs/PERF.md gates it at <10%%)")
     p.add_argument("--simulated", action="store_true",
                    help="scale mode: drive pods with the event-driven "
                         "SimKubelet (one timer-wheel thread for every pod) "

@@ -118,6 +118,7 @@ class PodProgress:
     # autoscaler and the ServingStatus rollup consume.
     qps: float = 0.0            # completed requests/sec (rolling window)
     ttft_ms: float = 0.0        # time-to-first-token p50 over the window
+    ttft_p99_ms: float = 0.0    # time-to-first-token p99 over the window
     itl_ms: float = 0.0         # inter-token latency mean over the window
     queue_depth: int = 0        # requests waiting for a slot (intake queue)
     slots_used: int = 0         # sequences currently in the running batch

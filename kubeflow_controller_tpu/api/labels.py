@@ -62,6 +62,13 @@ ANNOTATION_SERVING_REPLICAS = f"{DOMAIN}/serving-replicas"
 # requests, and exit 0.  The kubelet SIGTERMs executed pods and completes
 # simulated pods once their beats show an empty queue and empty slots.
 ANNOTATION_DRAIN = f"{DOMAIN}/drain"
+# --- observability plane (net-new) ---
+# Causal trace context (obs/trace.py TraceContext.encode — the job's
+# deterministic trace id + root span id + sampling flag).  Stamped on the
+# TFJob by the controller's first sync and on every pod by the planner;
+# the kubelet injects it into workload env as $KCTPU_TRACE_CONTEXT so
+# spans from every process of a job join ONE causal tree.
+ANNOTATION_TRACE_CONTEXT = f"{DOMAIN}/trace-context"
 
 
 def selector_for(job_name: str, replica_type: str, runtime_id: str) -> dict:

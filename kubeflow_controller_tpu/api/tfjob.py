@@ -388,6 +388,7 @@ class ServingStatus:
     ready: int = 0
     qps: float = 0.0             # summed across ready replicas
     ttft_ms: float = 0.0         # worst replica's windowed p50 TTFT
+    ttft_p99_ms: float = 0.0     # worst replica's windowed p99 TTFT
     itl_ms: float = 0.0          # worst replica's windowed inter-token p50
     queue_depth: int = 0         # summed intake backlog
     occupancy: float = 0.0       # mean slots_used/slots_total over ready

@@ -202,12 +202,36 @@ def _progress_cells(j) -> tuple:
 
 def _serving_cells(j) -> tuple:
     """(QPS, TTFT) cells for a job row — serving jobs only, '-' elsewhere
-    (QPS = summed completed requests/sec across ready replicas, TTFT = the
-    worst replica's windowed p50 time-to-first-token)."""
+    (QPS = summed completed requests/sec across ready replicas; TTFT is
+    the worst replica's windowed p50/p99 pair — the p99 half is the
+    histogram-derived quantile the serving-ttft-p99 SLO burns against)."""
     sv = j.status.serving
     if sv is None:
         return "-", "-"
+    if sv.ttft_p99_ms:
+        return f"{sv.qps:g}", f"{sv.ttft_ms:g}/{sv.ttft_p99_ms:g}ms"
     return f"{sv.qps:g}", f"{sv.ttft_ms:g}ms"
+
+
+def _alert_banner(cluster) -> str:
+    """One-line firing-SLO summary for the ``get`` header ('' when quiet
+    or the server has no SLO surface)."""
+    try:
+        doc = cluster.debug_slos()
+    except (APIError, AttributeError):
+        return ""
+    active = [a for a in doc.get("alerts", []) if a.get("active")]
+    if not active:
+        return ""
+    parts = []
+    for a in active[:4]:
+        labels = a.get("labels") or {}
+        subj = (labels.get("tfjob")
+                or ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+                or "cluster")
+        parts.append(f"{a['slo']}({subj}) {a.get('burn_fast', 0):g}x")
+    more = f" +{len(active) - 4} more" if len(active) > 4 else ""
+    return f"SLO BURN: {', '.join(parts)}{more}  (kctpu alerts)"
 
 
 def _fetch_lease(cluster):
@@ -268,6 +292,9 @@ def cmd_get(args) -> int:
     lease = _fetch_lease(cluster)
     if lease is not None:
         print(_leader_line(lease))
+    banner = _alert_banner(cluster)
+    if banner:
+        print(banner)
     if not jobs:
         print("No resources found.")
         return 0
@@ -634,8 +661,17 @@ def cmd_metrics(args) -> int:
 
 def cmd_trace(args) -> int:
     """Chrome trace dump (load in chrome://tracing or ui.perfetto.dev):
-    the API server's span buffer in REST mode, the local tracer otherwise."""
-    if args.kubeconfig or args.master:
+    the API server's span buffer in REST mode, the local tracer otherwise.
+    With ``--job J``, reconstructs the job's cross-process causal timeline
+    (submit -> queued -> admitted -> kubelet start -> rendezvous -> compile
+    -> first step; serving: request ingest -> queue -> prefill -> decode)
+    from the span ids instead of dumping raw JSON."""
+    if args.input:
+        from ..obs import load_trace_events
+
+        events = load_trace_events(args.input)
+        doc = {"traceEvents": events}
+    elif args.kubeconfig or args.master:
         cluster = _rest_cluster_or_die(args, probe=False)
         if cluster is None:
             return 2
@@ -645,9 +681,15 @@ def cmd_trace(args) -> int:
             print(f"error talking to API server: {e}", file=sys.stderr)
             return 2
     else:
-        from ..obs import TRACER
+        from ..obs import TRACER, merge_trace_dir
 
-        doc = TRACER.chrome_trace()
+        trace_dir = os.environ.get("KCTPU_TRACE_DIR", "")
+        if trace_dir and os.path.isdir(trace_dir):
+            doc = merge_trace_dir(trace_dir, tracer=TRACER)
+        else:
+            doc = TRACER.chrome_trace()
+    if args.job:
+        return _print_causal_trace(doc.get("traceEvents", []), args.job)
     out = json.dumps(doc)
     if args.dump and args.dump != "-":
         with open(args.dump, "w") as fh:
@@ -655,6 +697,190 @@ def cmd_trace(args) -> int:
         print(f"wrote {len(doc.get('traceEvents', []))} spans to {args.dump}")
     else:
         sys.stdout.write(out + "\n")
+    return 0
+
+
+def _print_causal_trace(events, job: str) -> int:
+    """Render one job's causal tree.  The trace id comes from the job's
+    root span (``job/submit`` carries ``args.job``), so this needs no API
+    access — any merged trace document is enough."""
+    from ..obs.trace import (
+        event_ids, events_for_trace, orphan_events, render_timeline)
+
+    trace_id = ""
+    for e in events:
+        a = e.get("args") or {}
+        if a.get("job") == job and event_ids(e)[0]:
+            trace_id = event_ids(e)[0]
+            break
+    if not trace_id:
+        print(f"no trace found for job {job!r} "
+              f"(was the controller tracing this job?)", file=sys.stderr)
+        return 1
+    mine = events_for_trace(events, trace_id)
+    orphans = orphan_events(mine)
+    pids = {e.get("pid") for e in mine}
+    print(f"trace {trace_id} job={job}: {len(mine)} spans across "
+          f"{len(pids)} process(es), {len(orphans)} orphan(s)")
+    for line in render_timeline(mine):
+        print(f"  {line}")
+    return 0
+
+
+def cmd_query(args) -> int:
+    """Windowed queries over the retained-series store: the API server's
+    /debug/query in REST mode, the local process TSDB otherwise."""
+    params = {"op": args.op, "name": args.name}
+    if args.labels:
+        # Flag form is k=v,k=v; the query surface takes a JSON object.
+        try:
+            pairs = dict(kv.split("=", 1) for kv in args.labels.split(","))
+        except ValueError:
+            print(f"error: bad --labels {args.labels!r} (want K=V,K=V)",
+                  file=sys.stderr)
+            return 2
+        params["labels"] = json.dumps(pairs)
+    if args.window:
+        params["window"] = str(args.window)
+    if args.q is not None:
+        params["q"] = str(args.q)
+    if args.kubeconfig or args.master:
+        cluster = _rest_cluster_or_die(args, probe=False)
+        if cluster is None:
+            return 2
+        try:
+            doc = cluster.debug_query(params)
+        except APIError as e:
+            print(f"error talking to API server: {e}", file=sys.stderr)
+            return 2
+    else:
+        from ..obs.tsdb import default_tsdb
+
+        doc = default_tsdb().query(params)
+    print(json.dumps(doc, indent=1, sort_keys=True))
+    return 1 if doc.get("error") else 0
+
+
+def cmd_alerts(args) -> int:
+    """SLO burn-rate alert states (obs/slo.py): the API server's
+    /debug/slos in REST mode, the local engine otherwise."""
+    if args.kubeconfig or args.master:
+        cluster = _rest_cluster_or_die(args, probe=False)
+        if cluster is None:
+            return 2
+        try:
+            doc = cluster.debug_slos()
+        except APIError as e:
+            print(f"error talking to API server: {e}", file=sys.stderr)
+            return 2
+    else:
+        from ..obs.slo import default_slo_engine
+
+        doc = default_slo_engine().state()
+    alerts = doc.get("alerts", [])
+    if not args.all:
+        alerts = [a for a in alerts if a.get("active")]
+    if not alerts:
+        n = len(doc.get("objectives", []))
+        print(f"no firing alerts ({n} objective(s) evaluated; "
+              f"--all shows quiet ones)")
+        return 0
+    print(f"{'SLO':<20} {'SERIES':<36} {'STATE':<9} {'VALUE':<12} "
+          f"{'BURN(fast/slow)':<16} SINCE")
+    now = time.time()
+    for a in alerts:
+        labels = a.get("labels") or {}
+        series = (",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+                  or "_cluster")
+        state = "FIRING" if a.get("active") else "ok"
+        since = _age(max(0.0, now - a["since"])) if a.get("since") else "-"
+        print(f"{a['slo']:<20} {series:<36} {state:<9} "
+              f"{a.get('value', 0):<12g} "
+              f"{a.get('burn_fast', 0):g}/{a.get('burn_slow', 0):<10g} "
+              f"{since}")
+    return 0
+
+
+def cmd_debug(args) -> int:
+    """Flight-recorder surface.  ``debug dump JOB`` captures a postmortem
+    bundle for a live job by assembling the same artefacts the controller
+    captures on terminal failure — job status + events + pod progress over
+    REST, plus the reachable trace spans — into $KCTPU_DEBUG_DIR."""
+    from ..cluster.store import NotFound
+    from ..obs import flight
+
+    if args.debug_cmd != "dump":
+        print("usage: kctpu debug dump JOB [-n NS] [--out DIR]",
+              file=sys.stderr)
+        return 2
+    out_dir = args.out or flight.debug_dir()
+    if not out_dir:
+        print("error: set $KCTPU_DEBUG_DIR or pass --out DIR",
+              file=sys.stderr)
+        return 2
+    cluster = _rest_cluster_or_die(args, probe=False)
+    if cluster is None:
+        return 2
+    ns = args.namespace or "default"
+    try:
+        j = cluster.tfjobs.get(ns, args.name)
+    except NotFound:
+        print(f"tfjob {ns}/{args.name} not found", file=sys.stderr)
+        return 1
+    except APIError as e:
+        print(f"error talking to API server: {e}", file=sys.stderr)
+        return 2
+    from ..api.labels import ANNOTATION_TRACE_CONTEXT
+    from ..obs.trace import TraceContext
+    from ..utils import serde
+
+    ctx = TraceContext.decode(
+        j.metadata.annotations.get(ANNOTATION_TRACE_CONTEXT, ""))
+    if ctx is None and j.metadata.uid:
+        ctx = TraceContext.for_job(j.metadata.uid)
+    events = []
+    try:
+        for ev in cluster.events.list(ns):
+            if ev.involved_object.name == args.name:
+                events.append({
+                    "type": ev.type, "reason": ev.reason,
+                    "message": ev.message, "count": ev.count,
+                    "timestamp": ev.last_timestamp,
+                    "firstTimestamp": ev.first_timestamp})
+    except APIError:
+        pass
+    progress = {}
+    try:
+        for p in cluster.pods.list(ns):
+            ref = p.metadata.owner_references
+            if (p.status.progress is not None and ref
+                    and ref[0].name == args.name):
+                progress[p.metadata.name] = serde.to_dict(p.status.progress)
+    except APIError:
+        pass
+    # The API server's span buffer holds what the controller and kubelets
+    # emitted; local spans + $KCTPU_TRACE_DIR are folded in by record_flight.
+    server_spans = []
+    try:
+        server_spans = cluster.trace_events().get("traceEvents", [])
+    except APIError:
+        pass
+    path = flight.record_flight(
+        ns, args.name, reason="OnDemand",
+        trace_id=ctx.trace_id if ctx else "",
+        events=events, progress=progress,
+        status=serde.to_dict(j.status),
+        extra_trace_events=server_spans,
+        out_dir=out_dir)
+    if path is None:
+        print("error: could not write the bundle", file=sys.stderr)
+        return 1
+    bundle = flight.read_bundle(path)
+    manifest = bundle.get("manifest.json", {})
+    print(f"wrote {path}")
+    print(f"  trace spans: {manifest.get('trace_spans', 0)}  "
+          f"events: {manifest.get('events', 0)}  "
+          f"progress pods: {len(progress)}")
     return 0
 
 
@@ -719,6 +945,11 @@ def cmd_run(args) -> int:
                       resync_period_s=args.resync_period,
                       manage_workers=args.manage_workers,
                       controller_shards=max(1, args.controller_shards))
+    if args.obs:
+        # Retained-series sampling + SLO burn-rate evaluation
+        # (docs/OBSERVABILITY.md); alerts land in the event stream and
+        # `kctpu alerts` / the `kctpu get` banner.
+        ctrl.start_obs_plane(interval_s=args.obs_interval)
     if kubelet is not None:
         kubelet.start()
     ctrl.run(threadiness=args.threadiness)
@@ -857,9 +1088,49 @@ def build_parser() -> argparse.ArgumentParser:
                                    "(REST mode scrapes the server's /metrics)")
 
     tr = sub.add_parser("trace", help="dump recorded spans as Chrome trace "
-                                      "JSON (REST mode reads /debug/traces)")
+                                      "JSON (REST mode reads /debug/traces); "
+                                      "--job renders one causal timeline")
     tr.add_argument("--dump", default="-", metavar="PATH",
                     help="output file (default: stdout)")
+    tr.add_argument("--job", default="", metavar="NAME",
+                    help="reconstruct NAME's cross-process causal timeline "
+                         "(submit -> queue -> admit -> kubelet -> first step) "
+                         "instead of dumping raw JSON")
+    tr.add_argument("--input", default="", metavar="FILE",
+                    help="read spans from a merged trace file (e.g. the one "
+                         "`run --trace-out` wrote) instead of a live source")
+
+    q = sub.add_parser("query", help="windowed query over the retained-"
+                                     "series store (REST mode reads "
+                                     "/debug/query; docs/OBSERVABILITY.md)")
+    q.add_argument("name", nargs="?", default="",
+                   help="metric name, e.g. kctpu_tfjobs (not needed for "
+                        "--op series)")
+    q.add_argument("--op", default="range",
+                   choices=["latest", "range", "rate", "avg_over_time",
+                            "quantile", "series"],
+                   help="query operator (default: range)")
+    q.add_argument("--labels", default="", metavar="K=V,K=V",
+                   help="label matchers, comma-separated")
+    q.add_argument("--window", type=float, default=0.0, metavar="S",
+                   help="lookback window in seconds (default: raw retention)")
+    q.add_argument("--q", type=float, default=None, metavar="Q",
+                   help="quantile in [0,1] for --op quantile")
+
+    al = sub.add_parser("alerts", help="SLO burn-rate alert states "
+                                       "(REST mode reads /debug/slos)")
+    al.add_argument("--all", action="store_true",
+                    help="include quiet objectives, not just firing alerts")
+
+    db = sub.add_parser("debug", help="flight-recorder surface")
+    dbs = db.add_subparsers(dest="debug_cmd")
+    dd = dbs.add_parser("dump", help="capture a postmortem bundle for a "
+                                     "live job into $KCTPU_DEBUG_DIR "
+                                     "(REST mode: pass -master)")
+    dd.add_argument("name")
+    dd.add_argument("-n", "--namespace", default="default")
+    dd.add_argument("--out", default="", metavar="DIR",
+                    help="bundle root (default: $KCTPU_DEBUG_DIR)")
 
     vt = sub.add_parser(
         "vet", add_help=False,
@@ -889,6 +1160,12 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--trace-out", default="", metavar="PATH",
                    help="write a merged Chrome trace (controller + executed "
                         "pods) to PATH at exit")
+    r.add_argument("--obs", action="store_true",
+                   help="start the obs plane: retained-series sampling "
+                        "(kctpu query) + SLO burn-rate alerting "
+                        "(kctpu alerts; docs/OBSERVABILITY.md)")
+    r.add_argument("--obs-interval", type=float, default=1.0, metavar="S",
+                   help="TSDB sampling cadence when --obs is on")
     r.add_argument("--threadiness", type=int, default=2, help="sync workers (ref: 2)")
     r.add_argument("--controller-shards", type=int, default=1, metavar="N",
                    help="consistent-hash shard workers over job UIDs "
@@ -966,6 +1243,12 @@ def _main(argv=None) -> int:
         return cmd_metrics(args)
     if args.cmd == "trace":
         return cmd_trace(args)
+    if args.cmd == "query":
+        return cmd_query(args)
+    if args.cmd == "alerts":
+        return cmd_alerts(args)
+    if args.cmd == "debug":
+        return cmd_debug(args)
     if args.cmd == "vet":
         from ..analysis import vet
 

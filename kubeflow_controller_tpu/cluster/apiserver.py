@@ -273,6 +273,12 @@ class FakeAPIServer:
                 if u.path == "/debug/traces" and method == "GET":
                     self._send(200, outer.trace_dump())
                     return
+                if u.path == "/debug/query" and method == "GET":
+                    self._send(200, outer.debug_query(u.query))
+                    return
+                if u.path == "/debug/slos" and method == "GET":
+                    self._send(200, outer.debug_slos())
+                    return
                 try:
                     r = _route(u.path, u.query)
                 except APIError as e:
@@ -357,6 +363,25 @@ class FakeAPIServer:
         from ..obs.trace import TRACER
 
         return TRACER.chrome_trace()
+
+    def debug_query(self, query: str) -> dict:
+        """Windowed TSDB queries (obs/tsdb.py) for ``kctpu query``:
+        ?op=latest|range|rate|avg_over_time|quantile|series&name=...
+        &labels={"k":"v"}&window=60&q=0.99 — always a JSON object, errors
+        as {"error": ...}."""
+        from urllib.parse import parse_qs
+
+        from ..obs.tsdb import default_tsdb
+
+        params = {k: v[0] for k, v in parse_qs(query or "").items()}
+        return default_tsdb().query(params)
+
+    def debug_slos(self) -> dict:
+        """The SLO engine's objectives + live alert states (obs/slo.py)
+        for ``kctpu alerts`` and the ``kctpu get`` banner."""
+        from ..obs.slo import default_slo_engine
+
+        return default_slo_engine().state()
 
     # -- request handling ------------------------------------------------------
 

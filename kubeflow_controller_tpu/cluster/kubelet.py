@@ -36,7 +36,8 @@ from ..api.core import (
     PHASE_SUCCEEDED,
     Pod,
 )
-from ..api.labels import LABEL_JOB_TYPE
+from ..api.labels import ANNOTATION_TRACE_CONTEXT, LABEL_JOB_TYPE
+from ..obs import trace
 from ..utils import locks
 from .client import Cluster
 from .store import ADDED, DELETED, MODIFIED, NotFound
@@ -528,6 +529,11 @@ class FakeKubelet:
     def _drive(self, pod: Pod) -> None:
         ns, name = pod.metadata.namespace, pod.metadata.name
         key = self._key(pod)
+        # Node-agent leg of the causal trace: gate+start, attached to the
+        # owning job's trace via the planner-stamped pod annotation.
+        ctx = trace.TraceContext.decode(
+            pod.metadata.annotations.get(ANNOTATION_TRACE_CONTEXT, ""))
+        start = time.time()
         # TPU pods wait in Pending for gang admission.  With a scheduler
         # as the inventory, the wait is queue-ordered and the queue state
         # is published as the pod's Pending reason (so the controller and
@@ -553,6 +559,10 @@ class FakeKubelet:
             self._injected_failures.discard(key)
             return
         self.set_phase(ns, name, PHASE_RUNNING)
+        if ctx is not None:
+            now = time.time()
+            trace.add_span("kubelet/start", start, max(0.0, now - start),
+                           ctx=ctx, pod=name, namespace=ns)
         if self.execute and pod.spec.containers and (
             pod.spec.containers[0].command or pod.spec.containers[0].args
         ):

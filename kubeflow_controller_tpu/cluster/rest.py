@@ -881,6 +881,18 @@ class RestCluster:
         Chrome trace_event JSON document."""
         return self.transport._request("GET", "/debug/traces")
 
+    def debug_query(self, params: Dict[str, str]) -> dict:
+        """GET /debug/query — windowed queries over the server process's
+        retained-series store (obs/tsdb.py)."""
+        from urllib.parse import urlencode
+
+        return self.transport._request(
+            "GET", f"/debug/query?{urlencode(params)}")
+
+    def debug_slos(self) -> dict:
+        """GET /debug/slos — SLO objectives + live burn-alert states."""
+        return self.transport._request("GET", "/debug/slos")
+
     @staticmethod
     def from_flags(kubeconfig: str, master: str = "") -> "RestCluster":
         """BuildConfigFromFlags parity (ref: cmd/controller/main.go:47-60)."""

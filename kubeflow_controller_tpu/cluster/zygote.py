@@ -103,6 +103,16 @@ def _child(req: dict) -> None:
         traceback.print_exc()
         code = 1
     finally:
+        # os._exit skips atexit, so the tracer's $KCTPU_TRACE_DIR dump
+        # (obs/trace.py) would be lost for every warm-forked pod.  Dump
+        # explicitly — but only when the workload actually imported the
+        # tracer; don't pull obs into processes that never traced.
+        tr = sys.modules.get("kubeflow_controller_tpu.obs.trace")
+        if tr is not None:
+            try:
+                tr.dump_to_env_dir()
+            except Exception:  # noqa: BLE001 - never block the exit path
+                pass
         sys.stdout.flush()
         sys.stderr.flush()
         os._exit(code)

@@ -52,9 +52,10 @@ from ..cluster.client import Cluster
 from ..cluster.store import Conflict, NotFound
 from ..cluster.tpu import TPUInventory
 from ..obs import trace
+from ..obs.lifecycle import job_lifecycle
 from ..obs.metrics import REGISTRY
 from ..planner import plan_job
-from ..planner.materialize import gang_name, make_pod, make_service
+from ..planner.materialize import gang_name, make_pod, make_service, trace_context_for
 from ..planner.types import Action
 from ..updater import RollupCache, compute_status, should_update
 from ..utils import locks, serde
@@ -80,6 +81,8 @@ from .events import (
     REASON_SERVING_DRAINING,
     REASON_SERVING_SCALED_DOWN,
     REASON_SERVING_SCALED_UP,
+    REASON_SLO_BURN,
+    REASON_SLO_RECOVERED,
     REASON_TRAINING_RESUMED,
     REASON_TRAINING_STALLED,
     TYPE_NORMAL,
@@ -188,6 +191,10 @@ class Controller:
             "kctpu_serve_ttft_ms",
             "Worst replica's windowed p50 time-to-first-token",
             ("namespace", "tfjob"))
+        self._g_serve_ttft_p99 = REGISTRY.gauge(
+            "kctpu_serve_ttft_p99_ms",
+            "Worst replica's windowed p99 time-to-first-token (what the "
+            "serving-ttft-p99 SLO burns against)", ("namespace", "tfjob"))
         self._g_serve_replicas = REGISTRY.gauge(
             "kctpu_serve_replicas",
             "Current Serving replica target (the autoscaler-written "
@@ -275,6 +282,14 @@ class Controller:
 
         self._workers: List[threading.Thread] = []
         self._stop = threading.Event()
+        # Observability plane (started on demand by start_obs_plane):
+        # TSDB sampler + SLO burn evaluation + flight recorder.  The
+        # once-per-key guard keeps a Failed job that keeps resyncing from
+        # cutting a new postmortem bundle every pass.
+        self._tsdb = None
+        self._slo_engine = None
+        self._flight_cut: set = set()
+        self._flight_lock = locks.named_lock("controller.flight")
 
     # ------------------------------------------------------------------ run
 
@@ -323,8 +338,61 @@ class Controller:
                 # the watch-edge work that actually advances jobs.
                 self.queue.add(key_of(job.metadata), low=True)
 
+    def start_obs_plane(self, interval_s: float = 1.0) -> None:
+        """Host the cluster observability plane in this controller: start
+        the process-global TSDB sampling /metrics on a cadence, hang the
+        SLO engine off its sampler (burn evaluation rides every sample
+        pass), and route burn edges to the event recorder as
+        ``Warning SLOBurn`` / ``Normal SLORecovered`` on the breaching
+        job.  Idempotent; opt-in because tests and small tools that build
+        a Controller shouldn't pay for a sampler thread."""
+        from ..obs.slo import default_slo_engine
+        from ..obs.tsdb import default_tsdb
+
+        if self._tsdb is not None:
+            return
+        self._tsdb = default_tsdb()
+        self._tsdb.interval_s = interval_s
+        self._slo_engine = default_slo_engine()
+        self._slo_engine.set_notifier(self._notify_slo)
+        self._tsdb.add_listener(self._slo_engine.evaluate_once)
+        self._tsdb.start()
+
+    def _notify_slo(self, state, fired: bool) -> None:
+        """Burn edge -> Event on the job the breaching series belongs to
+        (cluster-scoped objectives fall back to a pseudo-object so the
+        edge still lands in the audit stream)."""
+        labels = state.labels
+        ns, name = labels.get("namespace", ""), labels.get("tfjob", "")
+        obj = self.tfjob_informer.get(ns, name) if ns and name else None
+        if obj is None:
+            from ..api.meta import ObjectMeta
+
+            class _ClusterSLO:
+                kind = "SLO"
+                metadata = ObjectMeta(namespace=ns or "cluster",
+                                      name=name or state.objective.name)
+            obj = _ClusterSLO()
+        o = state.objective
+        if fired:
+            self.recorder.event(
+                obj, TYPE_WARNING, REASON_SLO_BURN,
+                f"SLO {o.name} burning: {o.metric}={state.value:.4g} vs "
+                f"threshold {o.threshold:g} (fast burn "
+                f"{state.burn_fast:.1f}x, slow {state.burn_slow:.1f}x "
+                f">= {o.burn_threshold:g}x budget)")
+        else:
+            self.recorder.event(
+                obj, TYPE_NORMAL, REASON_SLO_RECOVERED,
+                f"SLO {o.name} recovered: fast-window burn "
+                f"{state.burn_fast:.1f}x back under {o.burn_threshold:g}x")
+
     def stop(self) -> None:
         self._stop.set()
+        if self._tsdb is not None:
+            self._tsdb.stop()
+        if self._slo_engine is not None:
+            self._slo_engine.set_notifier(None)
         self.queue.shut_down()
         for inf in (self.tfjob_informer, self.pod_informer, self.service_informer):
             inf.stop()
@@ -515,6 +583,14 @@ class Controller:
         # objects — the shared-template bug class).
         job = serde.deep_copy(job)
 
+        # Causal trace: every span this sync records (gather, manage,
+        # slow-start batches, status write) joins the job's trace — the
+        # context is deterministic from the job UID, so controller spans
+        # and workload spans agree on the trace id with no handshake.
+        with trace.context(trace_context_for(job)):
+            self._sync_job(key, ns, name, job)
+
+    def _sync_job(self, key: str, ns: str, name: str, job: TFJob) -> None:
         deleting = job.metadata.deletion_timestamp is not None
 
         # Finalizer-based cleanup, replacing reliance on server-side cascade
@@ -537,9 +613,19 @@ class Controller:
             return  # do not requeue: the spec must change first
 
         if FINALIZER not in job.metadata.finalizers:
+            from ..api.labels import ANNOTATION_TRACE_CONTEXT
+
+            # Piggyback the trace-context annotation on the finalizer
+            # patch (one write, not two): from here on every pod the
+            # planner stamps and every CLI read shares the job's trace id.
+            ctx = trace.current_context()
+            encoded = ctx.encode() if ctx is not None else ""
+
             def add_finalizer(m):
                 if FINALIZER not in m.finalizers and m.deletion_timestamp is None:
                     m.finalizers.append(FINALIZER)
+                if encoded and ANNOTATION_TRACE_CONTEXT not in m.annotations:
+                    m.annotations[ANNOTATION_TRACE_CONTEXT] = encoded
 
             try:
                 # Continue the sync with the patched object: its bumped
@@ -548,6 +634,15 @@ class Controller:
                 job = self.cluster.tfjobs.patch_meta(ns, name, add_finalizer)
             except NotFound:
                 return
+            if ctx is not None:
+                # Root of the causal tree: the submit->first-sync interval,
+                # emitted exactly once (first sync stamps the finalizer).
+                now = time.time()
+                created = job.metadata.creation_timestamp or now
+                trace.add_span("job/submit", created, max(0.0, now - created),
+                               ctx=ctx, span_id=ctx.span_id,
+                               namespace=ns, job=name,
+                               uid=job.metadata.uid)
 
         # Persist the runtime ID once, before any replica exists (fixes the
         # per-sync in-memory stamping of local.go:79-84).
@@ -610,6 +705,19 @@ class Controller:
             and new_status.phase.value in ("Succeeded", "Failed")
         ):
             self.inventory.release_gang(gang_name(job))
+
+        # Flight recorder: the first sync that computes this job Failed
+        # cuts a postmortem bundle (trace + events + progress + status
+        # history + TSDB windows).  Gated on $KCTPU_DEBUG_DIR inside
+        # record_flight; once-per-key so a Failed job resyncing forever
+        # doesn't re-cut bundles.
+        if new_status.phase.value == "Failed":
+            with self._flight_lock:
+                fresh = key not in self._flight_cut
+                self._flight_cut.add(key)
+            if fresh:
+                self._record_flight(key, job, pods_by_type, new_status,
+                                    reason="JobFailed")
 
     def _publish_progress(self, key: str, job: TFJob, status) -> None:
         """Training-plane outputs of a sync: the per-job progress gauges on
@@ -756,6 +864,7 @@ class Controller:
         ns, name = job.metadata.namespace, job.metadata.name
         self._g_serve_qps.labels(ns, name).set(sv.qps)
         self._g_serve_ttft.labels(ns, name).set(sv.ttft_ms)
+        self._g_serve_ttft_p99.labels(ns, name).set(sv.ttft_p99_ms)
         self._g_serve_replicas.labels(ns, name).set(sv.replicas)
         self._g_serve_ready.labels(ns, name).set(sv.ready)
         from ..planner.materialize import pod_index
@@ -778,6 +887,48 @@ class Controller:
             self._g_serve_queue.remove(ns, name, idx)
             self._g_serve_occ.remove(ns, name, idx)
 
+    def _record_flight(self, key: str, job: TFJob, pods_by_type,
+                       status, reason: str) -> Optional[str]:
+        """Capture the postmortem bundle for ``job`` (obs/flight.py).
+        Returns the bundle path, or None when flight recording is off."""
+        from ..obs import flight
+
+        ns, name = job.metadata.namespace, job.metadata.name
+        ctx = trace_context_for(job)
+        progress = {}
+        for typ, pods in (pods_by_type or {}).items():
+            for p in pods:
+                if p.status.progress is not None:
+                    progress[p.metadata.name] = serde.to_dict(
+                        p.status.progress)
+        path = flight.record_flight(
+            ns, name, reason=reason,
+            trace_id=ctx.trace_id if ctx else "",
+            events=[{"type": e.type, "reason": e.reason,
+                     "message": e.message, "count": e.count,
+                     "timestamp": e.timestamp,
+                     "firstTimestamp": e.first_timestamp}
+                    for e in self.recorder.events_for(ns, name)],
+            progress=progress,
+            status_history=job_lifecycle().history(job.metadata.uid),
+            status=serde.to_dict(status),
+            tsdb=self._tsdb)
+        if path:
+            logger.info("flight recorder: wrote %s for %s (%s)",
+                        path, key, reason)
+        return path
+
+    def flight_dump(self, namespace: str, name: str,
+                    reason: str = "OnDemand") -> Optional[str]:
+        """On-demand postmortem capture (``kctpu debug dump JOB``) — same
+        bundle the Failed edge cuts, for a live job."""
+        job = self.tfjob_informer.get(namespace, name)
+        if job is None:
+            return None
+        pods_by_type, _ = self._gather(job)
+        return self._record_flight(key_of(job.metadata), job, pods_by_type,
+                                   job.status, reason=reason)
+
     def _drop_serving_series(self, key: str, job: Optional[TFJob] = None) -> None:
         """Serving gauge series die with the job.  Called from the delete
         handler, the finalizer, AND the final ``job is None`` sync: the
@@ -791,6 +942,7 @@ class Controller:
             self._g_serve_queue.remove(ns, name, idx)
             self._g_serve_occ.remove(ns, name, idx)
         for g in (self._g_serve_qps, self._g_serve_ttft,
+                  self._g_serve_ttft_p99,
                   self._g_serve_replicas, self._g_serve_ready):
             g.remove(ns, name)
         self.serving_autoscaler.forget_job(key)
@@ -847,6 +999,10 @@ class Controller:
         self.restart_tracker.forget_job(key)
         self.elastic_engine.forget_job(key, job)
         self.rollup_cache.forget(key)
+        if job.metadata.uid:
+            job_lifecycle().forget(job.metadata.uid)
+        with self._flight_lock:
+            self._flight_cut.discard(key)
 
     def _gather(self, job: TFJob):
         """Claim pods/services once at job scope, then partition by replica

@@ -46,6 +46,11 @@ REASON_GANG_RESTORED = "GangRestored"
 REASON_SERVING_SCALED_UP = "ServingScaledUp"
 REASON_SERVING_SCALED_DOWN = "ServingScaledDown"
 REASON_SERVING_DRAINING = "ServingDraining"
+# Observability-plane reasons (net-new: the SLO burn-rate engine) — edge-
+# triggered: one SLOBurn when both burn windows cross the threshold, one
+# SLORecovered when the fast window falls back under it.
+REASON_SLO_BURN = "SLOBurn"
+REASON_SLO_RECOVERED = "SLORecovered"
 
 TYPE_NORMAL = "Normal"
 TYPE_WARNING = "Warning"

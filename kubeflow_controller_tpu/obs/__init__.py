@@ -11,7 +11,14 @@ measurement substrate the ROADMAP's perf work reports against:
   renders everything in Prometheus text exposition format (served as
   ``GET /metrics`` by the in-process API server);
 - :mod:`.lifecycle` — per-job phase-transition histograms
-  (Pending→Running→Succeeded), fed by the status updater.
+  (Pending→Running→Succeeded), fed by the status updater;
+- :mod:`.tsdb` — in-process retained-series store sampling the registry
+  on a cadence, with windowed queries (rate/avg/quantile) behind
+  ``GET /debug/query`` and ``kctpu query``;
+- :mod:`.slo` — declarative objectives evaluated over TSDB windows with
+  multi-window burn-rate alerting (``kctpu alerts``);
+- :mod:`.flight` — postmortem bundles (trace + events + progress +
+  status history + TSDB windows) cut on terminal job failure.
 
 Everything is stdlib-only and safe to import from any layer (no imports
 back into controller/cluster/workloads).
@@ -27,12 +34,23 @@ from .metrics import (  # noqa: F401
 )
 from .trace import (  # noqa: F401
     Span,
+    TraceContext,
     Tracer,
     TRACER,
+    TRACE_CONTEXT_ENV,
     TRACE_DIR_ENV,
+    TRACE_SAMPLE_ENV,
+    causal_tree,
+    context,
+    context_from_env,
     dump_to_env_dir,
     load_trace_events,
     merge_trace_dir,
+    orphan_events,
+    render_timeline,
     span,
 )
 from .lifecycle import JobLifecycle, job_lifecycle  # noqa: F401
+from .tsdb import TSDB, default_tsdb  # noqa: F401
+from .slo import Objective, SLOEngine, default_objectives, default_slo_engine  # noqa: F401
+from .flight import DEBUG_DIR_ENV, read_bundle, record_flight  # noqa: F401

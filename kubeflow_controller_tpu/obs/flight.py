@@ -1,0 +1,142 @@
+"""Flight recorder: postmortem bundles for failed jobs.
+
+When a job reaches a terminal failure (or on demand via ``kctpu debug
+dump JOB``) the controller captures everything the obs plane knows about
+it into one directory — so the debugging artefacts survive the process
+that produced them:
+
+    $KCTPU_DEBUG_DIR/<namespace>-<name>-<ts>/
+        manifest.json   what's here + why the bundle was cut
+        trace.json      the job's causal trace (Chrome trace_event format,
+                        merged across processes, filtered to its trace_id)
+        events.json     the recorder's event ring for the job
+        progress.json   last progress beats per pod
+        status.json     phase-transition history (obs/lifecycle.py ring)
+        tsdb.json       relevant retained-series windows (obs/tsdb.py)
+
+Everything is passed IN by the caller (controller/controller.py) —
+obs/ stays a leaf package with no imports from the control plane.
+Bundle writing is best-effort: any OSError is swallowed and reported as
+None, because postmortem capture must never make a failing job fail
+harder."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from . import trace as trace_mod
+from .tsdb import TSDB
+
+# Bundles land under this directory; unset = flight recording disabled.
+DEBUG_DIR_ENV = "KCTPU_DEBUG_DIR"
+
+# How much retained history the bundle folds in per series.
+DEFAULT_TSDB_WINDOW_S = 600.0
+
+
+def debug_dir(env: Optional[Dict[str, str]] = None) -> str:
+    e = os.environ if env is None else env
+    return e.get(DEBUG_DIR_ENV, "")
+
+
+def collect_trace_events(
+        trace_id: str,
+        extra: Optional[List[Dict[str, Any]]] = None,
+) -> List[Dict[str, Any]]:
+    """The job's causal trace: in-process spans plus everything workload
+    processes dumped to ``$KCTPU_TRACE_DIR`` (plus any ``extra`` events a
+    remote caller fetched, e.g. the API server's span buffer over REST),
+    filtered to ``trace_id`` and deduplicated by span id."""
+    events: List[Dict[str, Any]] = [
+        s.to_event() for s in trace_mod.TRACER.spans()]
+    d = os.environ.get(trace_mod.TRACE_DIR_ENV, "")
+    if d and os.path.isdir(d):
+        events.extend(trace_mod.merge_trace_dir(d))
+    if extra:
+        events.extend(extra)
+    if trace_id:
+        events = trace_mod.events_for_trace(events, trace_id)
+    seen = set()
+    deduped = []
+    for e in events:
+        _, span_id, _ = trace_mod.event_ids(e)
+        key = span_id or id(e)
+        if key in seen:
+            continue
+        seen.add(key)
+        deduped.append(e)
+    deduped.sort(key=lambda e: e.get("ts", 0))
+    return deduped
+
+
+def record_flight(namespace: str, name: str, *,
+                  reason: str = "",
+                  trace_id: str = "",
+                  events: Optional[List[Dict[str, Any]]] = None,
+                  progress: Optional[Dict[str, Any]] = None,
+                  status_history: Optional[List[Dict[str, Any]]] = None,
+                  status: Optional[Dict[str, Any]] = None,
+                  tsdb: Optional[TSDB] = None,
+                  tsdb_window_s: float = DEFAULT_TSDB_WINDOW_S,
+                  extra_trace_events: Optional[List[Dict[str, Any]]] = None,
+                  out_dir: Optional[str] = None,
+                  now: Optional[float] = None) -> Optional[str]:
+    """Write one postmortem bundle; returns its path, or None when flight
+    recording is disabled (no ``$KCTPU_DEBUG_DIR``) or the write failed."""
+    base = out_dir if out_dir is not None else debug_dir()
+    if not base:
+        return None
+    t = time.time() if now is None else now
+    bundle = os.path.join(base, f"{namespace}-{name}-{int(t)}")
+    try:
+        os.makedirs(bundle, exist_ok=True)
+        trace_events = collect_trace_events(trace_id, extra_trace_events)
+        _write_json(bundle, "trace.json", {"traceEvents": trace_events})
+        _write_json(bundle, "events.json", events or [])
+        _write_json(bundle, "progress.json", progress or {})
+        _write_json(bundle, "status.json", {
+            "status": status or {},
+            "history": status_history or [],
+        })
+        _write_json(bundle, "tsdb.json",
+                    tsdb.dump_window(tsdb_window_s, now=t) if tsdb else {})
+        _write_json(bundle, "manifest.json", {
+            "namespace": namespace, "name": name, "reason": reason,
+            "trace_id": trace_id, "captured_at": t,
+            "trace_spans": len(trace_events),
+            "events": len(events or []),
+            "status_transitions": len(status_history or []),
+            "tsdb_window_s": tsdb_window_s,
+            "files": ["manifest.json", "trace.json", "events.json",
+                      "progress.json", "status.json", "tsdb.json"],
+        })
+        return bundle
+    except OSError:
+        return None
+
+
+def _write_json(bundle: str, fname: str, obj: Any) -> None:
+    with open(os.path.join(bundle, fname), "w", encoding="utf-8") as f:
+        json.dump(obj, f, indent=1, sort_keys=True, default=str)
+
+
+def read_bundle(bundle: str) -> Dict[str, Any]:
+    """Load a bundle back as {filename: parsed json} (damaged files skipped)
+    — what ``kctpu debug show`` and the completeness tests consume."""
+    out: Dict[str, Any] = {}
+    try:
+        names = sorted(os.listdir(bundle))
+    except OSError:
+        return out
+    for fname in names:
+        if not fname.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(bundle, fname), encoding="utf-8") as f:
+                out[fname] = json.load(f)
+        except (OSError, ValueError):
+            continue
+    return out

@@ -18,13 +18,17 @@ churns jobs forever cannot grow it without bound.
 
 from __future__ import annotations
 
+import collections
 import time
-from typing import Dict, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 from .metrics import REGISTRY, Registry
 from ..utils import locks
 
 TERMINAL_PHASES = ("Succeeded", "Failed")
+
+# Transitions kept per job for the flight recorder's status history.
+HISTORY_DEPTH = 32
 
 # Job lifetimes span ms (simulated pods) to hours (real training):
 # wider-than-default top end.
@@ -48,6 +52,11 @@ class JobLifecycle:
         self._max = max_jobs
         # uid -> (current phase, entered-at wall clock)
         self._since: Dict[str, Tuple[str, float]] = {}
+        # uid -> ring of {from, to, at, dwell_s}: the status history the
+        # flight recorder (obs/flight.py) folds into postmortem bundles.
+        # Kept past terminal transitions (the bundle is written AFTER the
+        # job fails), bounded by the same eviction budget as _since.
+        self._history: Dict[str, Deque[Dict[str, object]]] = {}
 
     def observe(self, uid: str, prev_phase: str, new_phase: str,
                 now: Optional[float] = None,
@@ -73,8 +82,28 @@ class JobLifecycle:
                     # Bounded: evict the oldest entry (insertion order).
                     self._since.pop(next(iter(self._since)))
                 self._since[uid] = (new_phase, t)
+            ring = self._history.get(uid)
+            if ring is None:
+                if len(self._history) >= self._max:
+                    self._history.pop(next(iter(self._history)))
+                ring = self._history[uid] = collections.deque(
+                    maxlen=HISTORY_DEPTH)
+            ring.append({"from": phase, "to": new_phase, "at": t,
+                         "dwell_s": round(dwell, 3)})
         self._hist.labels(from_phase=phase, to_phase=new_phase).observe(dwell)
         self._transitions.labels(from_phase=phase, to_phase=new_phase).inc()
+
+    def history(self, uid: str) -> List[Dict[str, object]]:
+        """Recent phase transitions of ``uid``, oldest first."""
+        with self._lock:
+            ring = self._history.get(uid)
+            return [dict(h) for h in ring] if ring else []
+
+    def forget(self, uid: str) -> None:
+        """Drop all state for ``uid`` (job object deleted)."""
+        with self._lock:
+            self._since.pop(uid, None)
+            self._history.pop(uid, None)
 
     def tracked(self) -> int:
         with self._lock:

@@ -486,6 +486,41 @@ class Registry:
     def render(self) -> str:
         return "\n".join(f.render() for f in self.families()) + "\n"
 
+    def histogram_quantile(self, name: str, labels: Dict[str, str],
+                           q: float) -> float:
+        """Scrape-time quantile from a live histogram's cumulative buckets
+        (``bucket_quantile`` estimate, all-time distribution).  ``labels``
+        selects one series, ``le`` excluded; 0.0 when the family or series
+        does not exist — callers can fall back to a raw gauge."""
+        fam = None
+        for f in self.families():
+            if f.name == name and f.typ == "histogram":
+                fam = f
+                break
+        if fam is None:
+            return 0.0
+        want = dict(labels)
+        uppers: List[float] = []
+        cumulative: List[float] = []
+        for s in fam.samples:
+            if s.suffix != "_bucket":
+                continue
+            have = {k: v for k, v in s.labels.items() if k != "le"}
+            if have != want:
+                continue
+            le = s.labels.get("le", "")
+            if le == "+Inf":
+                cumulative.append(s.value)
+            else:
+                uppers.append(float(le))
+                cumulative.append(s.value)
+        if not uppers or len(cumulative) != len(uppers) + 1:
+            return 0.0
+        counts = [cumulative[0]] + [
+            max(0.0, cumulative[i] - cumulative[i - 1])
+            for i in range(1, len(cumulative))]
+        return bucket_quantile(uppers, counts, q)
+
 
 def bucket_quantile(uppers: Sequence[float], counts: Sequence[int],
                     q: float) -> float:

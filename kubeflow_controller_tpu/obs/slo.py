@@ -1,0 +1,286 @@
+"""SLO engine: declarative objectives + multi-window burn-rate alerting.
+
+An objective names a retained series (or histogram family) in the TSDB
+(obs/tsdb.py), a violation threshold, and an error budget — "serving p99
+TTFT stays under 2 s, with at most 5% of samples over".  Evaluation is
+the Google-SRE multi-window burn rate:
+
+- **burn** of a window = (fraction of the window's samples violating the
+  threshold) / error budget, so burn 1.0 consumes budget exactly at the
+  allowed pace and burn 20 eats a 5%-budget objective 20x too fast;
+- an alert **fires** when BOTH the fast and the slow window burn at or
+  above ``burn_threshold`` (fast = reacts quickly, slow = proves it is
+  not a blip), and **resolves** when the fast window falls back under —
+  edge-triggered, exactly one notification per transition.
+
+State lands in three places: ``kctpu_slo_burn_rate`` /
+``kctpu_slo_alert_active`` gauges on the registry, edge-triggered
+``Warning SLOBurn`` / ``Normal SLORecovered`` events (via the notifier
+the controller installs), and the queryable :meth:`SLOEngine.state`
+served at ``GET /debug/slos`` for ``kctpu alerts`` and the ``kctpu get``
+banner.
+
+Objectives over *labeled* series fan out per label set (one alert per
+job), so the notifier can attach events to the job that breached.
+
+Like the rest of obs/, this imports nothing above obs/: the controller
+hands in its recorder via a notifier callback, and evaluation is driven
+either by the TSDB's sampler (:meth:`TSDB.add_listener`) or explicitly
+(``evaluate_once(now)`` — the testable unit)."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils import locks
+from .metrics import REGISTRY, Registry
+from .tsdb import TSDB
+
+# Objective kinds.
+KIND_GAUGE = "gauge"                    # violating-sample fraction of a series
+KIND_HISTOGRAM_QUANTILE = "histogram_quantile"  # windowed quantile vs threshold
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One declarative SLO."""
+
+    name: str                  # slug: "serving-ttft-p99"
+    description: str
+    metric: str                # series name (gauge) or histogram family
+    threshold: float           # a sample/quantile above this is a violation
+    kind: str = KIND_GAUGE
+    q: float = 0.99            # histogram_quantile only
+    error_budget: float = 0.05  # allowed violating fraction
+    fast_window_s: float = 30.0
+    slow_window_s: float = 120.0
+    burn_threshold: float = 2.0
+    # Label keys identifying who breached (event routing); objectives fan
+    # out over every label set the TSDB retains for ``metric``.
+    subject_labels: Tuple[str, ...] = ("namespace", "tfjob")
+
+
+def default_objectives() -> List[Objective]:
+    """The catalogue (docs/OBSERVABILITY.md "SLO catalogue"): serving p99
+    TTFT, job time-to-first-step, training stall rate, failover (gang
+    replacement) time, and scheduler queue wait."""
+    return [
+        Objective(
+            name="serving-ttft-p99",
+            description="worst-replica p99 time-to-first-token <= 2s",
+            metric="kctpu_serve_ttft_p99_ms", threshold=2000.0,
+            error_budget=0.05),
+        Objective(
+            name="job-ttfs",
+            description="p99 job time-to-first-step (Created->Running) <= 120s",
+            metric="kctpu_job_phase_transition_seconds", threshold=120.0,
+            kind=KIND_HISTOGRAM_QUANTILE, q=0.99, error_budget=0.05,
+            subject_labels=("from_phase", "to_phase")),
+        Objective(
+            name="job-stall-rate",
+            description="no job stalls for a sustained window",
+            metric="kctpu_job_stalled", threshold=0.5, error_budget=0.2),
+        Objective(
+            name="failover-time",
+            description="p99 gang failover (replacement rendezvous) <= 60s",
+            metric="kctpu_restart_latency_seconds", threshold=60.0,
+            kind=KIND_HISTOGRAM_QUANTILE, q=0.99, error_budget=0.05,
+            subject_labels=()),
+        Objective(
+            name="sched-queue-wait",
+            description="p99 scheduler queue wait <= 300s",
+            metric="kctpu_sched_queue_wait_seconds", threshold=300.0,
+            kind=KIND_HISTOGRAM_QUANTILE, q=0.99, error_budget=0.05,
+            subject_labels=()),
+    ]
+
+
+@dataclass
+class AlertState:
+    """Live evaluation state of (objective, label set)."""
+
+    objective: Objective
+    labels: Dict[str, str]
+    burn_fast: float = 0.0
+    burn_slow: float = 0.0
+    value: float = 0.0          # latest evaluated value (quantile/sample)
+    active: bool = False
+    since: float = 0.0          # when the current active state began
+    transitions: int = 0        # fire edges seen (tests assert exactness)
+
+    def series_label(self) -> str:
+        if not self.labels:
+            return "_cluster"
+        return ",".join(f"{k}={v}" for k, v in sorted(self.labels.items()))
+
+    def as_dict(self) -> Dict[str, Any]:
+        o = self.objective
+        return {
+            "slo": o.name, "description": o.description,
+            "metric": o.metric, "threshold": o.threshold,
+            "labels": dict(self.labels), "value": self.value,
+            "burn_fast": round(self.burn_fast, 4),
+            "burn_slow": round(self.burn_slow, 4),
+            "burn_threshold": o.burn_threshold,
+            "active": self.active, "since": self.since,
+            "transitions": self.transitions,
+        }
+
+
+#: notifier(state, fired): fired=True on a burn edge, False on recovery.
+Notifier = Callable[[AlertState, bool], None]
+
+
+class SLOEngine:
+    def __init__(self, tsdb: TSDB, objectives: Optional[List[Objective]] = None,
+                 registry: Optional[Registry] = None,
+                 notifier: Optional[Notifier] = None):
+        self.tsdb = tsdb
+        self.objectives = (default_objectives() if objectives is None
+                           else list(objectives))
+        self.registry = REGISTRY if registry is None else registry
+        self._notifier = notifier
+        self._lock = locks.named_lock("obs.slo")
+        self._states: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                           AlertState] = {}
+        self._g_burn = self.registry.gauge(
+            "kctpu_slo_burn_rate",
+            "Fast-window error-budget burn rate per objective "
+            "(1.0 = burning exactly at budget)", ("slo", "series"))
+        self._g_active = self.registry.gauge(
+            "kctpu_slo_alert_active",
+            "1 while an objective's multi-window burn alert is firing",
+            ("slo", "series"))
+
+    def set_notifier(self, notifier: Optional[Notifier]) -> None:
+        self._notifier = notifier
+
+    def set_objectives(self, objectives: List[Objective]) -> None:
+        """Replace the evaluated objective catalogue and drop all alert
+        state (smokes compress the windows; operators narrow the set).
+        Existing gauge series for dropped states are zeroed, not removed
+        — an alert that vanishes mid-flight must read 0, not stale 1."""
+        with self._lock:
+            for st in self._states.values():
+                series = st.series_label()
+                self._g_burn.labels(st.objective.name, series).set(0.0)
+                self._g_active.labels(st.objective.name, series).set(0.0)
+            self._states.clear()
+            self.objectives = list(objectives)
+
+    # -- evaluation ----------------------------------------------------------
+
+    def evaluate_once(self, now: Optional[float] = None) -> List[AlertState]:
+        """Evaluate every objective over the TSDB; returns the states that
+        TRANSITIONED this pass (fired or resolved)."""
+        now = time.time() if now is None else now
+        edges: List[AlertState] = []
+        for obj in self.objectives:
+            for labels in self._label_sets(obj):
+                st = self._evaluate(obj, labels, now)
+                if st is not None:
+                    edges.append(st)
+        return edges
+
+    def _label_sets(self, obj: Objective) -> List[Dict[str, str]]:
+        if obj.kind == KIND_HISTOGRAM_QUANTILE:
+            sets = self.tsdb.label_sets(f"{obj.metric}_bucket",
+                                        without=("le",))
+        else:
+            sets = self.tsdb.label_sets(obj.metric)
+        return sets or []
+
+    def _evaluate(self, obj: Objective, labels: Dict[str, str],
+                  now: float) -> Optional[AlertState]:
+        key = (obj.name, tuple(sorted(labels.items())))
+        with self._lock:
+            st = self._states.get(key)
+            if st is None:
+                st = self._states[key] = AlertState(objective=obj,
+                                                    labels=dict(labels))
+        st.burn_fast, value_fast = self._window_burn(
+            obj, labels, obj.fast_window_s, now)
+        st.burn_slow, _ = self._window_burn(
+            obj, labels, obj.slow_window_s, now)
+        st.value = value_fast
+        series = st.series_label()
+        self._g_burn.labels(obj.name, series).set(round(st.burn_fast, 4))
+        edge: Optional[bool] = None
+        with self._lock:
+            if (not st.active and st.burn_fast >= obj.burn_threshold
+                    and st.burn_slow >= obj.burn_threshold):
+                st.active = True
+                st.since = now
+                st.transitions += 1
+                edge = True
+            elif st.active and st.burn_fast < obj.burn_threshold:
+                st.active = False
+                st.since = now
+                edge = False
+        self._g_active.labels(obj.name, series).set(1.0 if st.active else 0.0)
+        if edge is None:
+            return None
+        if self._notifier is not None:
+            try:
+                self._notifier(st, edge)
+            except Exception:  # noqa: BLE001 — notification must not kill eval
+                pass
+        return st
+
+    def _window_burn(self, obj: Objective, labels: Dict[str, str],
+                     window_s: float, now: float) -> Tuple[float, float]:
+        """(burn, evaluated value) for one window."""
+        budget = max(1e-6, obj.error_budget)
+        if obj.kind == KIND_HISTOGRAM_QUANTILE:
+            value = self.tsdb.quantile_from_histogram(
+                obj.metric, labels, obj.q, window_s, now)
+            violating = 1.0 if value > obj.threshold else 0.0
+            return violating / budget, value
+        pts = self.tsdb.points(obj.metric, labels, now - window_s, now)
+        if not pts:
+            return 0.0, 0.0
+        bad = sum(1 for _, v in pts if v > obj.threshold)
+        return (bad / len(pts)) / budget, pts[-1][1]
+
+    # -- query surface -------------------------------------------------------
+
+    def alerts(self, active_only: bool = True) -> List[Dict[str, Any]]:
+        with self._lock:
+            states = list(self._states.values())
+        out = [s.as_dict() for s in states if s.active or not active_only]
+        out.sort(key=lambda d: (not d["active"], d["slo"], d["labels"].items()
+                                and sorted(d["labels"].items())))
+        return out
+
+    def state(self) -> Dict[str, Any]:
+        """The ``GET /debug/slos`` document."""
+        return {
+            "objectives": [
+                {"slo": o.name, "description": o.description,
+                 "metric": o.metric, "threshold": o.threshold,
+                 "kind": o.kind, "error_budget": o.error_budget,
+                 "fast_window_s": o.fast_window_s,
+                 "slow_window_s": o.slow_window_s,
+                 "burn_threshold": o.burn_threshold}
+                for o in self.objectives
+            ],
+            "alerts": self.alerts(active_only=False),
+        }
+
+
+_DEFAULT: Optional[SLOEngine] = None
+_DEFAULT_LOCK = locks.named_lock("obs.slo-default")
+
+
+def default_slo_engine() -> SLOEngine:
+    """Process-global engine over the process-global TSDB (what
+    ``/debug/slos`` serves and the controller's obs plane drives)."""
+    from .tsdb import default_tsdb
+
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = SLOEngine(default_tsdb())
+        return _DEFAULT

@@ -1,0 +1,189 @@
+"""`make slo-smoke`: the SLO burn-rate pipeline end-to-end, plus the
+trace-continuity gate.
+
+Part A — burn fire -> resolve, through the REAL pipeline (no shortcuts:
+beats -> status rollup -> registry gauge -> TSDB sample -> burn eval ->
+event + alert gauges):
+
+1. boot the in-process cluster + controller, start the obs plane with a
+   compressed serving-ttft-p99 objective (sub-second windows);
+2. run one Serving job; its replica beats a throttled p99 TTFT (5s,
+   2.5x over the 2s threshold) — within a few window lengths EXACTLY ONE
+   ``Warning SLOBurn`` must fire, with ``kctpu_slo_alert_active=1`` on
+   ``GET /metrics`` and an active alert on ``GET /debug/slos``;
+3. the replica recovers (80 ms TTFT) — ``Normal SLORecovered`` must
+   follow, the gauge must drop to 0, and the engine must have seen
+   exactly one fire edge (no flapping).
+
+Part B — trace continuity: the job's causal trace (obs/trace.py) must
+exist, carry a single trace_id, span the submit->sync->kubelet chain,
+and contain ZERO orphan spans (every parent_id resolves).
+
+Exit 0 = burn alerting is edge-exact and causal traces are connected.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+import time
+import urllib.request
+
+
+def _scrape_alert_active(url: str) -> float:
+    with urllib.request.urlopen(f"{url}/metrics", timeout=10) as resp:
+        text = resp.read().decode()
+    pat = re.compile(
+        r'^kctpu_slo_alert_active\{[^}]*slo="serving-ttft-p99"[^}]*\} (\S+)$',
+        re.M)
+    vals = [float(m.group(1)) for m in pat.finditer(text)]
+    return max(vals) if vals else -1.0
+
+
+def main() -> int:
+    from ..api.core import Container, PodProgress, PodTemplateSpec
+    from ..api.meta import ObjectMeta
+    from ..api.tfjob import ReplicaType, TFJob, TFReplicaSpec
+    from ..cluster import Cluster, FakeKubelet, PhasePolicy
+    from ..cluster.apiserver import FakeAPIServer
+    from ..controller import Controller
+    from . import trace
+    from .slo import Objective, default_slo_engine
+
+    # Compressed objective: same shape as the catalogue's serving-ttft-p99
+    # (docs/OBSERVABILITY.md), windows shrunk so the smoke runs in seconds.
+    default_slo_engine().set_objectives([Objective(
+        name="serving-ttft-p99",
+        description="worst-replica p99 time-to-first-token <= 2s",
+        metric="kctpu_serve_ttft_p99_ms", threshold=2000.0,
+        error_budget=0.05, fast_window_s=0.6, slow_window_s=1.5,
+        burn_threshold=2.0)])
+
+    cluster = Cluster()
+    server = FakeAPIServer(cluster.store)
+    url = server.start()
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=300.0))
+    ctrl = Controller(cluster, resync_period_s=5.0)
+    ctrl.start_obs_plane(interval_s=0.1)
+    kubelet.start()
+    ctrl.run(threadiness=2)
+    rc = 1
+    try:
+        job = TFJob(metadata=ObjectMeta(name="slo-svc", namespace="default"))
+        t = PodTemplateSpec()
+        t.spec.containers.append(Container(name="srv", image="img"))
+        t.spec.restart_policy = "OnFailure"
+        job.spec.tf_replica_specs.append(TFReplicaSpec(
+            replicas=1, tf_replica_type=ReplicaType.SERVING, template=t))
+        cluster.tfjobs.create(job)
+
+        def wait_for(cond, what: str, timeout: float = 20.0) -> bool:
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if cond():
+                    return True
+                time.sleep(0.05)
+            print(f"slo-smoke: timed out waiting for {what}", file=sys.stderr)
+            return False
+
+        def serving_pod():
+            for p in cluster.pods.list("default"):
+                if (p.metadata.name.startswith("slo-svc-serving-")
+                        and p.status.phase == "Running"):
+                    return p
+            return None
+
+        def has_event(reason: str) -> int:
+            return sum(1 for e in ctrl.recorder.events_for("default", "slo-svc")
+                       if e.reason == reason)
+
+        if not wait_for(lambda: serving_pod() is not None,
+                        "the serving replica to reach Running"):
+            return 1
+        pod_name = serving_pod().metadata.name
+
+        # Throttled replica: p99 TTFT 2.5x over threshold, beating steadily.
+        stop_beats = [False]
+        ttft = [5000.0]
+
+        import threading
+
+        def beater():
+            while not stop_beats[0]:
+                cluster.pods.update_progress(
+                    "default", pod_name,
+                    PodProgress(step=10, phase="serving", qps=2.0,
+                                ttft_ms=ttft[0] / 10, ttft_p99_ms=ttft[0],
+                                slots_used=2, slots_total=4))
+                time.sleep(0.05)
+
+        th = threading.Thread(target=beater, name="slo-smoke-beater",
+                              daemon=True)
+        th.start()
+
+        if not wait_for(lambda: has_event("SLOBurn") >= 1,
+                        "Warning SLOBurn event"):
+            return 1
+        if not wait_for(lambda: _scrape_alert_active(url) == 1.0,
+                        "kctpu_slo_alert_active=1 on /metrics"):
+            return 1
+
+        # Recovery: the replica gets fast again.
+        ttft[0] = 80.0
+        if not wait_for(lambda: has_event("SLORecovered") >= 1,
+                        "Normal SLORecovered event"):
+            return 1
+        if not wait_for(lambda: _scrape_alert_active(url) == 0.0,
+                        "kctpu_slo_alert_active=0 on /metrics"):
+            return 1
+        stop_beats[0] = True
+        th.join(timeout=2)
+
+        # Edge exactness: exactly one fire, one recovery, one transition.
+        burns, recovers = has_event("SLOBurn"), has_event("SLORecovered")
+        if burns != 1 or recovers != 1:
+            print(f"slo-smoke: expected exactly 1 fire + 1 resolve, got "
+                  f"{burns} SLOBurn / {recovers} SLORecovered",
+                  file=sys.stderr)
+            return 1
+        states = default_slo_engine().alerts(active_only=False)
+        mine = [s for s in states if s["slo"] == "serving-ttft-p99"
+                and s["labels"].get("tfjob") == "slo-svc"]
+        if not mine or mine[0]["transitions"] != 1:
+            print(f"slo-smoke: expected exactly 1 engine fire edge, "
+                  f"state={mine}", file=sys.stderr)
+            return 1
+
+        # Part B: trace continuity.  The job's causal tree must exist,
+        # share one trace_id, and resolve every parent edge.
+        events = [s.to_event() for s in trace.TRACER.spans()]
+        root_trace = ""
+        for e in events:
+            a = e.get("args") or {}
+            if a.get("job") == "slo-svc" and trace.event_ids(e)[0]:
+                root_trace = trace.event_ids(e)[0]
+                break
+        if not root_trace:
+            print("slo-smoke: no causal trace recorded for the job",
+                  file=sys.stderr)
+            return 1
+        mine_events = trace.events_for_trace(events, root_trace)
+        orphans = trace.orphan_events(mine_events)
+        if len(mine_events) < 3 or orphans:
+            print(f"slo-smoke: broken causal trace — {len(mine_events)} "
+                  f"spans, {len(orphans)} orphan(s)", file=sys.stderr)
+            return 1
+
+        print(f"slo-smoke: 1 SLOBurn -> 1 SLORecovered (edge-exact), "
+              f"alert gauge 1 -> 0 | trace {root_trace}: "
+              f"{len(mine_events)} spans, 0 orphans")
+        rc = 0
+    finally:
+        ctrl.stop()
+        kubelet.stop()
+        server.stop()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

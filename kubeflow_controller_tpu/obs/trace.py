@@ -1,4 +1,4 @@
-"""Lightweight span tracer with Chrome ``trace_event`` export.
+"""Causal span tracer with Chrome ``trace_event`` export.
 
 Answers the question round 5 spent a whole cycle bisecting by hand
 (BASELINE.md's ~1s rendezvous stall): *where* does a slow reconcile or a
@@ -6,6 +6,25 @@ bimodal job start spend its time?  Spans are recorded into a thread-safe
 ring buffer (old spans fall off; tracing never grows unbounded), are
 queryable by tests (:meth:`Tracer.spans`), and dump as Chrome
 ``chrome://tracing`` / Perfetto-loadable JSON.
+
+**Causal context (PR 16).**  Every span carries ``trace_id`` /
+``span_id`` / ``parent_id``.  Parenting is id-based (a thread-local stack
+of live Span objects), never name-based — two concurrent same-named spans
+on different threads can no longer adopt each other's children.  A
+:class:`TraceContext` crosses process boundaries as one string
+(``trace:span:flags``) carried on TFJob/Pod annotations and injected into
+workload env as ``$KCTPU_TRACE_CONTEXT``; a span recorded with no
+enclosing local span parents to the propagated context, so the merged
+timeline of controller, scheduler, kubelet and workload processes is one
+connected causal tree per job.  The trace id is *derived
+deterministically from the job uid* (:meth:`TraceContext.for_job`), so
+processes that never exchanged the context string still agree on it.
+
+**Sampling** is head-based per trace id (``$KCTPU_TRACE_SAMPLE``,
+default 1.0): the keep/drop decision is a pure function of the trace id,
+so every process makes the same call and a kept trace is complete.
+Context-less spans (the controller's own sync spans) are always kept —
+sampling exists to bound the per-job span volume at ``--scale 10000``.
 
 Cross-process collection: workload processes (pods) dump their spans to
 ``$KCTPU_TRACE_DIR/trace-<pid>-<nonce>.json`` — explicitly via
@@ -19,6 +38,7 @@ own spans into one timeline (wall-clock timestamps align processes).
 from __future__ import annotations
 
 import atexit
+import hashlib
 import json
 import os
 import threading
@@ -27,11 +47,116 @@ import uuid
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from ..utils import locks
 
 TRACE_DIR_ENV = "KCTPU_TRACE_DIR"
+#: Cross-process causal context (``TraceContext.encode()`` string),
+#: stamped on pods by the planner and injected by the kubelet.
+TRACE_CONTEXT_ENV = "KCTPU_TRACE_CONTEXT"
+#: Head-based sampling rate in [0, 1]; default 1.0 (keep everything).
+TRACE_SAMPLE_ENV = "KCTPU_TRACE_SAMPLE"
+
+
+def _hash16(text: str) -> str:
+    return hashlib.sha1(text.encode()).hexdigest()[:16]
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def sample_rate(env: Optional[Dict[str, str]] = None) -> float:
+    """The configured head-sampling rate, clamped to [0, 1]."""
+    e = os.environ if env is None else env
+    try:
+        rate = float(e.get(TRACE_SAMPLE_ENV, "") or 1.0)
+    except ValueError:
+        return 1.0
+    return min(1.0, max(0.0, rate))
+
+
+def trace_sampled(trace_id: str, rate: Optional[float] = None) -> bool:
+    """Deterministic head-based keep/drop for a trace id: a pure function
+    of the id, so every process (controller, kubelet, workload) makes the
+    SAME decision and a sampled trace is never partial."""
+    if rate is None:
+        rate = sample_rate()
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    try:
+        bucket = int(trace_id[:8] or "0", 16) % 1000000
+    except ValueError:
+        bucket = 0
+    return bucket < rate * 1000000
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """The portable half of a causal trace: which trace, and which span
+    new work should parent to.  Encodes as ``trace_id:span_id:flags``."""
+
+    trace_id: str
+    span_id: str = ""
+    sampled: bool = True
+
+    def encode(self) -> str:
+        return f"{self.trace_id}:{self.span_id}:{'01' if self.sampled else '00'}"
+
+    @staticmethod
+    def decode(value: str) -> Optional["TraceContext"]:
+        """Parse an encoded context (None on any damage — a torn
+        annotation must never break a sync)."""
+        if not value:
+            return None
+        parts = value.strip().split(":")
+        if len(parts) < 2 or not parts[0]:
+            return None
+        sampled = parts[2] != "00" if len(parts) > 2 else True
+        return TraceContext(trace_id=parts[0], span_id=parts[1],
+                            sampled=sampled)
+
+    @staticmethod
+    def for_job(uid: str, rate: Optional[float] = None) -> "TraceContext":
+        """The job's canonical context, derived deterministically from its
+        uid: trace id, root span id, and the head-sampling decision.  Any
+        process holding the uid reconstructs the identical context."""
+        trace_id = _hash16(f"kctpu-trace:{uid}")
+        return TraceContext(
+            trace_id=trace_id,
+            span_id=_hash16(f"kctpu-root:{uid}"),
+            sampled=trace_sampled(trace_id, rate),
+        )
+
+    def child(self, span_id: str) -> "TraceContext":
+        """The context a downstream hop should parent under."""
+        return TraceContext(self.trace_id, span_id, self.sampled)
+
+
+def context_from_env(env: Optional[Dict[str, str]] = None) -> Optional[TraceContext]:
+    e = os.environ if env is None else env
+    return TraceContext.decode(e.get(TRACE_CONTEXT_ENV, ""))
+
+
+_PROCESS_CTX: Optional[TraceContext] = None
+_PROCESS_CTX_LOADED = False
+_PROCESS_CTX_LOCK = locks.named_lock("obs.trace-process-ctx")
+
+
+def process_context() -> Optional[TraceContext]:
+    """The context this whole PROCESS runs under (``$KCTPU_TRACE_CONTEXT``,
+    injected by the kubelet for pod processes), parsed once.  Workload
+    spans with no enclosing span attach here automatically."""
+    global _PROCESS_CTX, _PROCESS_CTX_LOADED
+    if not _PROCESS_CTX_LOADED:
+        with _PROCESS_CTX_LOCK:
+            if not _PROCESS_CTX_LOADED:
+                _PROCESS_CTX = context_from_env()
+                _PROCESS_CTX_LOADED = True
+    return _PROCESS_CTX
 
 
 @dataclass
@@ -43,11 +168,18 @@ class Span:
     dur: float = 0.0           # seconds (perf_counter delta)
     pid: int = 0
     tid: int = 0
-    parent: str = ""           # enclosing span's name ("" at top level)
+    parent: str = ""           # enclosing span's NAME (display only)
     args: Dict[str, Any] = field(default_factory=dict)
+    trace_id: str = ""         # causal identity ("" = context-less span)
+    span_id: str = ""
+    parent_id: str = ""        # causal parent (id-based, unambiguous)
 
     def to_event(self) -> Dict[str, Any]:
-        """Chrome trace_event "complete" (ph=X) event, microseconds."""
+        """Chrome trace_event "complete" (ph=X) event, microseconds.
+
+        The pre-PR16 shape (name/ph/ts/dur/pid/tid/cat + ``args.parent``
+        as the enclosing NAME) is preserved byte-for-byte; the causal ids
+        ride as extra args keys."""
         ev = {
             "name": self.name,
             "ph": "X",
@@ -60,6 +192,12 @@ class Span:
         args = dict(self.args)
         if self.parent:
             args["parent"] = self.parent
+        if self.trace_id:
+            args["trace_id"] = self.trace_id
+        if self.span_id:
+            args["span_id"] = self.span_id
+        if self.parent_id:
+            args["parent_id"] = self.parent_id
         if args:
             ev["args"] = args
         return ev
@@ -75,11 +213,30 @@ class Tracer:
     def capacity(self) -> int:
         return self._spans.maxlen
 
-    def _stack(self) -> List[str]:
+    def _stack(self) -> List[Span]:
         st = getattr(self._local, "stack", None)
         if st is None:
             st = self._local.stack = []
         return st
+
+    # -- causal context ------------------------------------------------------
+
+    def current_context(self) -> Optional[TraceContext]:
+        """The active context: a thread-local one (``with tracer.context``)
+        wins over the process-level env context."""
+        ctx = getattr(self._local, "ctx", None)
+        return ctx if ctx is not None else process_context()
+
+    @contextmanager
+    def context(self, ctx: Optional[TraceContext]) -> Iterator[Optional[TraceContext]]:
+        """Attach spans recorded in this block (this thread) to ``ctx``.
+        ``None`` is a no-op passthrough so call sites need no branching."""
+        prev = getattr(self._local, "ctx", None)
+        self._local.ctx = ctx if ctx is not None else prev
+        try:
+            yield ctx
+        finally:
+            self._local.ctx = prev
 
     @contextmanager
     def span(self, name: str, **args) -> Iterator[Span]:
@@ -87,18 +244,61 @@ class Tracer:
         its ``dur`` is final after the block exits, and extra attributes can
         be added to ``span.args`` from inside the block."""
         stack = self._stack()
+        ctx = self.current_context()
         sp = Span(name=name, ts=time.time(), pid=os.getpid(),
                   tid=threading.get_ident(),
-                  parent=stack[-1] if stack else "", args=args)
-        stack.append(name)
+                  parent=stack[-1].name if stack else "", args=args,
+                  span_id=new_span_id())
+        if ctx is not None:
+            sp.trace_id = ctx.trace_id
+            # Parent to the nearest enclosing span of the SAME trace; a
+            # context activated under unrelated (context-less) outer spans
+            # parents to the propagated remote span instead — that is the
+            # cross-process edge.
+            for enclosing in reversed(stack):
+                if enclosing.trace_id == ctx.trace_id:
+                    sp.parent_id = enclosing.span_id
+                    break
+            else:
+                sp.parent_id = ctx.span_id
+        elif stack:
+            sp.parent_id = stack[-1].span_id
+            sp.trace_id = stack[-1].trace_id
+        stack.append(sp)
         t0 = time.perf_counter()
         try:
             yield sp
         finally:
             sp.dur = time.perf_counter() - t0
             stack.pop()
-            with self._lock:
-                self._spans.append(sp)
+            # Head-based sampling drops only CONTEXT spans; the tracer's
+            # own context-less spans always record (tests and `kctpu
+            # trace` rely on them).
+            if ctx is None or ctx.sampled:
+                with self._lock:
+                    self._spans.append(sp)
+
+    def add_span(self, name: str, ts: float, dur: float, *,
+                 ctx: Optional[TraceContext] = None, parent_id: str = "",
+                 span_id: str = "", **args) -> Optional[Span]:
+        """Record an already-timed span (synthetic timestamps): the shape
+        queue-wait and other measured-after-the-fact intervals take.
+        Returns None (recording nothing) for an unsampled context."""
+        if ctx is not None and not ctx.sampled:
+            return None
+        sp = Span(name=name, ts=ts, dur=max(0.0, dur), pid=os.getpid(),
+                  tid=threading.get_ident(), args=args,
+                  span_id=span_id or new_span_id(), parent_id=parent_id)
+        if ctx is not None:
+            sp.trace_id = ctx.trace_id
+            # Default the causal edge to the context's root — unless this
+            # IS the root span (span_id == ctx.span_id), which must stay
+            # parentless or the tree walk would loop on a self-edge.
+            if not parent_id and sp.span_id != ctx.span_id:
+                sp.parent_id = ctx.span_id
+        with self._lock:
+            self._spans.append(sp)
+        return sp
 
     # -- queries -------------------------------------------------------------
 
@@ -143,6 +343,26 @@ def span(name: str, **args) -> Iterator[Span]:
         yield sp
 
 
+@contextmanager
+def context(ctx: Optional[TraceContext]) -> Iterator[Optional[TraceContext]]:
+    """``with trace.context(ctx): ...`` on the global tracer."""
+    with TRACER.context(ctx) as c:
+        yield c
+
+
+def add_span(name: str, ts: float, dur: float, *,
+             ctx: Optional[TraceContext] = None, parent_id: str = "",
+             span_id: str = "", **args) -> Optional[Span]:
+    return TRACER.add_span(name, ts, dur, ctx=ctx, parent_id=parent_id,
+                           span_id=span_id, **args)
+
+
+def current_context() -> Optional[TraceContext]:
+    """The global tracer's active context (thread-local, falling back to
+    the process context from ``$KCTPU_TRACE_CONTEXT``)."""
+    return TRACER.current_context()
+
+
 # ---------------------------------------------------------------------------
 # Cross-process dump/merge
 # ---------------------------------------------------------------------------
@@ -181,7 +401,10 @@ def load_trace_events(path: str) -> List[Dict[str, Any]]:
 def merge_trace_dir(trace_dir: str,
                     tracer: Optional[Tracer] = None) -> Dict[str, Any]:
     """One Chrome trace document from every per-process dump in
-    ``trace_dir`` plus (optionally) a live tracer's spans."""
+    ``trace_dir`` plus (optionally) a live tracer's spans.  Deduplicated
+    by span id: a process may dump more than once (explicit end-of-main
+    dump + the zygote/atexit safety net), and the same span must not
+    appear twice in the merged tree."""
     events: List[Dict[str, Any]] = []
     if trace_dir and os.path.isdir(trace_dir):
         for name in sorted(os.listdir(trace_dir)):
@@ -189,8 +412,98 @@ def merge_trace_dir(trace_dir: str,
                 events.extend(load_trace_events(os.path.join(trace_dir, name)))
     if tracer is not None:
         events.extend(s.to_event() for s in tracer.spans())
-    events.sort(key=lambda e: e.get("ts", 0))
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+    seen: set = set()
+    deduped: List[Dict[str, Any]] = []
+    for e in events:
+        span_id = event_ids(e)[1]
+        key = span_id if span_id else id(e)
+        if key in seen:
+            continue
+        seen.add(key)
+        deduped.append(e)
+    deduped.sort(key=lambda e: e.get("ts", 0))
+    return {"traceEvents": deduped, "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# Causal-tree analysis (over merged Chrome events)
+# ---------------------------------------------------------------------------
+
+def event_ids(event: Dict[str, Any]) -> Tuple[str, str, str]:
+    """(trace_id, span_id, parent_id) of a Chrome event ("" when absent)."""
+    args = event.get("args") or {}
+    if not isinstance(args, dict):
+        return "", "", ""
+    return (str(args.get("trace_id", "") or ""),
+            str(args.get("span_id", "") or ""),
+            str(args.get("parent_id", "") or ""))
+
+
+def events_for_trace(events: List[Dict[str, Any]],
+                     trace_id: str) -> List[Dict[str, Any]]:
+    return [e for e in events if event_ids(e)[0] == trace_id]
+
+
+def orphan_events(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Events whose parent_id names a span NOT present in the set — the
+    broken-edge detector the continuity gate asserts is empty.  Roots
+    (empty parent_id) are never orphans."""
+    present = {event_ids(e)[1] for e in events}
+    out = []
+    for e in events:
+        _, _, parent_id = event_ids(e)
+        if parent_id and parent_id not in present:
+            out.append(e)
+    return out
+
+
+def causal_tree(events: List[Dict[str, Any]]) -> Tuple[
+        List[Dict[str, Any]], Dict[str, List[Dict[str, Any]]]]:
+    """(roots, children-by-span_id), children in start-time order.  An
+    orphan (missing parent) surfaces as a root so nothing disappears."""
+    present = {event_ids(e)[1] for e in events}
+    roots: List[Dict[str, Any]] = []
+    children: Dict[str, List[Dict[str, Any]]] = {}
+    for e in sorted(events, key=lambda ev: ev.get("ts", 0)):
+        _, span_id, parent_id = event_ids(e)
+        # A self-edge (parent_id == span_id) is a damaged root, not a
+        # cycle — walk it as a root so the tree render terminates.
+        if parent_id and parent_id != span_id and parent_id in present:
+            children.setdefault(parent_id, []).append(e)
+        else:
+            roots.append(e)
+    return roots, children
+
+
+def render_timeline(events: List[Dict[str, Any]]) -> List[str]:
+    """Human-readable causal timeline: one indented line per span with
+    offset from the trace start and duration — what ``kctpu trace --job``
+    prints."""
+    if not events:
+        return []
+    t0 = min(e.get("ts", 0) for e in events)
+    roots, children = causal_tree(events)
+    lines: List[str] = []
+
+    def walk(ev: Dict[str, Any], depth: int) -> None:
+        off_ms = (ev.get("ts", 0) - t0) / 1000.0
+        dur_ms = ev.get("dur", 0) / 1000.0
+        args = ev.get("args") or {}
+        extra = ""
+        for k in ("key", "pod", "gang", "request"):
+            if k in args:
+                extra = f"  [{k}={args[k]}]"
+                break
+        lines.append(f"{'  ' * depth}{ev.get('name', '?'):<32s} "
+                     f"+{off_ms:10.3f}ms  {dur_ms:10.3f}ms"
+                     f"  pid={ev.get('pid', 0)}{extra}")
+        _, span_id, _ = event_ids(ev)
+        for child in children.get(span_id, []):
+            walk(child, depth + 1)
+
+    for root in roots:
+        walk(root, 0)
+    return lines
 
 
 def _atexit_dump() -> None:  # pragma: no cover - exercised in subprocesses

@@ -1,0 +1,389 @@
+"""In-process time-series store: metrics *history* for the SLO plane.
+
+``GET /metrics`` is an instantaneous scrape — by the time someone asks
+"when did TTFT regress", the evidence is gone.  The TSDB samples the
+existing registry (:meth:`~.metrics.Registry.families`) on a cadence and
+retains each series as a bounded ring:
+
+- a **raw** ring at the sampling interval for the recent window, and
+- a **coarse** ring past the raw horizon (one point per
+  ``coarse_step_s``, newest sample in the step wins), so an hour of
+  history costs ~120 points per series instead of 3600.
+
+Series count is bounded by the PR-14 budget (``DEFAULT_SERIES_BUDGET``);
+overflow drops new series and counts them (``kctpu_tsdb_series_dropped_
+total``), exactly the registry's own cardinality-control posture.
+
+Windowed queries (``rate``, ``avg_over_time``, ``quantile_from_
+histogram``, ``latest``, ``range``) are served at ``GET /debug/query``
+(cluster/apiserver.py) and ``kctpu query``; the SLO engine (obs/slo.py)
+evaluates its burn windows against them via :meth:`TSDB.add_listener`.
+
+Everything here is stdlib-only and imports nothing above obs/ —
+consumers hand in the registry and drive the clock (``sample_once(now)``
+is the testable unit; :meth:`start` merely wraps it in a daemon thread).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils import locks
+from .metrics import DEFAULT_SERIES_BUDGET, REGISTRY, Registry, bucket_quantile
+
+SeriesKey = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+def series_key(name: str, labels: Dict[str, str]) -> SeriesKey:
+    return name, tuple(sorted(labels.items()))
+
+
+class _Series:
+    __slots__ = ("name", "labels", "typ", "raw", "coarse")
+
+    def __init__(self, name: str, labels: Dict[str, str], typ: str):
+        self.name = name
+        self.labels = dict(labels)
+        self.typ = typ
+        self.raw: deque = deque()      # (ts, value) at the sample cadence
+        self.coarse: deque = deque()   # (step_ts, value), newest-in-step
+
+    def points(self, start: float, end: float) -> List[Tuple[float, float]]:
+        out = [p for p in self.coarse if start <= p[0] <= end]
+        out.extend(p for p in self.raw if start <= p[0] <= end)
+        return out
+
+
+class TSDB:
+    """Retained-series sampler over one registry.  Thread-safe; the
+    sampling clock is injectable (``sample_once(now=...)``) so retention,
+    downsampling and burn-window tests run on synthetic time."""
+
+    def __init__(self, registry: Optional[Registry] = None,
+                 interval_s: float = 1.0,
+                 retention_s: float = 300.0,
+                 coarse_step_s: float = 30.0,
+                 coarse_retention_s: float = 3600.0,
+                 max_series: int = DEFAULT_SERIES_BUDGET):
+        self.registry = REGISTRY if registry is None else registry
+        self.interval_s = max(0.05, interval_s)
+        self.retention_s = retention_s
+        self.coarse_step_s = max(self.interval_s, coarse_step_s)
+        self.coarse_retention_s = max(retention_s, coarse_retention_s)
+        self.max_series = max_series
+        self._lock = locks.named_lock("obs.tsdb")
+        self._series: Dict[SeriesKey, _Series] = {}
+        self._listeners: List[Callable[[float], None]] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
+        # Self-telemetry on the sampled registry (the catalogue rows the
+        # metric-catalogue vet rule checks).
+        self._g_series = self.registry.gauge(
+            "kctpu_tsdb_series", "Series currently retained by the TSDB")
+        self._c_samples = self.registry.counter(
+            "kctpu_tsdb_samples_total", "Points appended by the TSDB sampler")
+        self._c_dropped = self.registry.counter(
+            "kctpu_tsdb_series_dropped_total",
+            "New series dropped because the TSDB hit its series budget")
+
+    # -- sampling ------------------------------------------------------------
+
+    def sample_once(self, now: Optional[float] = None) -> int:
+        """One sampling pass over the registry; returns points appended."""
+        now = time.time() if now is None else now
+        appended = 0
+        dropped = 0
+        for fam in self.registry.families():
+            for s in fam.samples:
+                key = series_key(fam.name + s.suffix, s.labels)
+                with self._lock:
+                    series = self._series.get(key)
+                    if series is None:
+                        if len(self._series) >= self.max_series:
+                            dropped += 1
+                            continue
+                        series = self._series[key] = _Series(
+                            key[0], s.labels, fam.typ)
+                    self._append_locked(series, now, s.value)
+                appended += 1
+        if appended:
+            self._c_samples.inc(appended)
+        if dropped:
+            self._c_dropped.inc(dropped)
+        with self._lock:
+            self._g_series.set(float(len(self._series)))
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(now)
+            except Exception:  # noqa: BLE001 — a listener never kills sampling
+                pass
+        return appended
+
+    def _append_locked(self, series: _Series, now: float, value: float) -> None:
+        series.raw.append((now, value))
+        horizon = now - self.retention_s
+        while series.raw and series.raw[0][0] < horizon:
+            ts, v = series.raw.popleft()
+            # Downsample past the raw horizon: one point per coarse step,
+            # the newest sample in the step winning (right for monotonic
+            # counters; a defensible "last observation" for gauges).
+            step = ts - (ts % self.coarse_step_s)
+            if series.coarse and series.coarse[-1][0] == step:
+                series.coarse[-1] = (step, v)
+            else:
+                series.coarse.append((step, v))
+        coarse_horizon = now - self.coarse_retention_s
+        while series.coarse and series.coarse[0][0] < coarse_horizon:
+            series.coarse.popleft()
+
+    def add_listener(self, fn: Callable[[float], None]) -> None:
+        """Call ``fn(sample_time)`` after every sampling pass (the SLO
+        engine's evaluation hook)."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def start(self) -> None:
+        """Background sampling at ``interval_s`` (idempotent)."""
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._loop, name="tsdb-sampler", daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            thread, self._thread = self._thread, None
+            stop, self._stop = self._stop, None
+        if stop is not None:
+            stop.set()
+        if thread is not None:
+            thread.join(timeout=2.0)
+
+    @property
+    def running(self) -> bool:
+        with self._lock:
+            return self._thread is not None
+
+    def _loop(self) -> None:
+        stop = self._stop
+        while stop is not None and not stop.wait(self.interval_s):
+            try:
+                self.sample_once()
+            except Exception:  # noqa: BLE001 — sampling must never die
+                pass
+
+    # -- queries -------------------------------------------------------------
+
+    def series_count(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+    def series_names(self, prefix: str = "") -> List[str]:
+        with self._lock:
+            names = {s.name for s in self._series.values()}
+        return sorted(n for n in names if n.startswith(prefix))
+
+    def _get(self, name: str, labels: Dict[str, str]) -> Optional[_Series]:
+        with self._lock:
+            return self._series.get(series_key(name, labels))
+
+    def points(self, name: str, labels: Dict[str, str],
+               start: float, end: float) -> List[Tuple[float, float]]:
+        s = self._get(name, labels)
+        if s is None:
+            return []
+        with self._lock:
+            return s.points(start, end)
+
+    def latest(self, name: str,
+               labels: Dict[str, str]) -> Optional[Tuple[float, float]]:
+        s = self._get(name, labels)
+        if s is None:
+            return None
+        with self._lock:
+            if s.raw:
+                return s.raw[-1]
+            return s.coarse[-1] if s.coarse else None
+
+    def rate(self, name: str, labels: Dict[str, str], window_s: float,
+             now: Optional[float] = None) -> float:
+        """Per-second increase of a counter over the window (0.0 when
+        fewer than two points; counter resets clamp to 0)."""
+        now = time.time() if now is None else now
+        pts = self.points(name, labels, now - window_s, now)
+        if len(pts) < 2:
+            return 0.0
+        (t0, v0), (t1, v1) = pts[0], pts[-1]
+        if t1 <= t0:
+            return 0.0
+        return max(0.0, v1 - v0) / (t1 - t0)
+
+    def avg_over_time(self, name: str, labels: Dict[str, str],
+                      window_s: float, now: Optional[float] = None) -> float:
+        now = time.time() if now is None else now
+        pts = self.points(name, labels, now - window_s, now)
+        if not pts:
+            return 0.0
+        return sum(v for _, v in pts) / len(pts)
+
+    def label_sets(self, name: str,
+                   without: Tuple[str, ...] = ()) -> List[Dict[str, str]]:
+        """Distinct label sets stored for ``name`` (minus ``without`` keys)
+        — how per-job SLO objectives enumerate their series."""
+        with self._lock:
+            series = [s for s in self._series.values() if s.name == name]
+        out: List[Dict[str, str]] = []
+        seen = set()
+        for s in series:
+            ls = {k: v for k, v in s.labels.items() if k not in without}
+            key = tuple(sorted(ls.items()))
+            if key not in seen:
+                seen.add(key)
+                out.append(ls)
+        return out
+
+    def quantile_from_histogram(self, name: str, labels: Dict[str, str],
+                                q: float, window_s: Optional[float] = None,
+                                now: Optional[float] = None) -> float:
+        """Quantile estimate from a histogram family's retained ``_bucket``
+        series: windowed (bucket increase over ``window_s``) when a window
+        is given, else over the histogram's whole lifetime (latest
+        cumulative counts).  ``labels`` are the family's labels without
+        ``le``."""
+        now = time.time() if now is None else now
+        with self._lock:
+            buckets = [
+                s for s in self._series.values()
+                if s.name == f"{name}_bucket"
+                and {k: v for k, v in s.labels.items() if k != "le"} == labels
+            ]
+        per_le: List[Tuple[float, float]] = []  # (upper, cumulative count)
+        for s in buckets:
+            le = s.labels.get("le", "")
+            upper = math.inf if le == "+Inf" else _parse_float(le)
+            if upper is None:
+                continue
+            with self._lock:
+                if window_s is None:
+                    pts = s.points(now - self.coarse_retention_s, now)
+                    cum = pts[-1][1] if pts else 0.0
+                else:
+                    pts = s.points(now - window_s, now)
+                    cum = (pts[-1][1] - pts[0][1]) if len(pts) >= 2 else (
+                        pts[-1][1] if pts else 0.0)
+            per_le.append((upper, max(0.0, cum)))
+        if not per_le:
+            return 0.0
+        per_le.sort(key=lambda t: t[0])
+        uppers = [u for u, _ in per_le if not math.isinf(u)]
+        if not uppers:
+            return 0.0
+        # Cumulative -> per-bucket, with the +Inf overflow as the last slot
+        # (bucket_quantile's contract: len(uppers) + 1 counts).
+        cums = [c for _, c in per_le]
+        total = cums[-1] if math.isinf(per_le[-1][0]) else cums[-1]
+        noncum: List[float] = []
+        prev = 0.0
+        for u, c in per_le:
+            if math.isinf(u):
+                continue
+            noncum.append(max(0.0, c - prev))
+            prev = c
+        overflow = max(0.0, total - prev)
+        return bucket_quantile(uppers, noncum + [overflow], q)
+
+    # -- the /debug/query surface -------------------------------------------
+
+    def query(self, params: Dict[str, str]) -> Dict[str, Any]:
+        """Evaluate one query described by string params (the HTTP query
+        string of ``GET /debug/query`` and the flags of ``kctpu query``):
+
+        ``op``      latest | range | rate | avg_over_time | quantile
+        ``name``    series (or histogram family, for ``quantile``) name
+        ``labels``  JSON object of label matchers (default ``{}``)
+        ``window``  window seconds (rate/avg/quantile; range span)
+        ``q``       quantile in [0,1] (``quantile`` only)
+
+        Unknown ops or damaged params return ``{"error": ...}`` rather
+        than raising — this is a debug surface, never a crash vector."""
+        op = params.get("op", "latest")
+        name = params.get("name", "")
+        if not name and op != "series":
+            return {"error": "missing ?name="}
+        try:
+            labels = json.loads(params.get("labels", "") or "{}")
+            if not isinstance(labels, dict):
+                raise ValueError("labels must be a JSON object")
+            labels = {str(k): str(v) for k, v in labels.items()}
+        except ValueError as e:
+            return {"error": f"bad labels: {e}"}
+        window = _parse_float(params.get("window", "")) or 60.0
+        now = time.time()
+        base = {"op": op, "name": name, "labels": labels, "window": window}
+        if op == "series":
+            return {"op": "series",
+                    "series": self.series_names(params.get("name", ""))}
+        if op == "latest":
+            pt = self.latest(name, labels)
+            return {**base, "point": list(pt) if pt else None}
+        if op == "range":
+            pts = self.points(name, labels, now - window, now)
+            return {**base, "points": [list(p) for p in pts]}
+        if op == "rate":
+            return {**base, "value": self.rate(name, labels, window, now)}
+        if op == "avg_over_time":
+            return {**base,
+                    "value": self.avg_over_time(name, labels, window, now)}
+        if op == "quantile":
+            q = _parse_float(params.get("q", "")) or 0.99
+            return {**base, "q": q,
+                    "value": self.quantile_from_histogram(
+                        name, labels, q, window, now)}
+        return {"error": f"unknown op {op!r}"}
+
+    def dump_window(self, window_s: float, prefix: str = "kctpu_",
+                    now: Optional[float] = None) -> Dict[str, Any]:
+        """Recent points for every retained series under ``prefix`` — the
+        flight recorder's metrics-history section."""
+        now = time.time() if now is None else now
+        with self._lock:
+            series = [s for s in self._series.values()
+                      if s.name.startswith(prefix)]
+            out = []
+            for s in series:
+                pts = s.points(now - window_s, now)
+                if pts:
+                    out.append({"name": s.name, "labels": s.labels,
+                                "points": [list(p) for p in pts]})
+        return {"window_s": window_s, "end": now, "series": out}
+
+
+def _parse_float(text: str) -> Optional[float]:
+    try:
+        return float(text)
+    except (TypeError, ValueError):
+        return None
+
+
+_DEFAULT: Optional[TSDB] = None
+_DEFAULT_LOCK = locks.named_lock("obs.tsdb-default")
+
+
+def default_tsdb() -> TSDB:
+    """The process-global TSDB over the process-global registry — what the
+    API server's ``/debug/query`` route and the controller's obs plane
+    share (the REGISTRY/TRACER singleton pattern)."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = TSDB()
+        return _DEFAULT

@@ -79,6 +79,21 @@ ENV_CHECKPOINT_EVERY = "KCTPU_CHECKPOINT_EVERY"
 ENV_GANG_WIDTH = "KCTPU_GANG_WIDTH"
 
 
+def trace_context_for(job: TFJob):
+    """The job's causal :class:`~..obs.trace.TraceContext`: the TFJob
+    annotation when the controller stamped one (authoritative — it fixes
+    the sampling decision), else derived deterministically from the uid,
+    so planner and controller agree even before the first status write."""
+    from ..api.labels import ANNOTATION_TRACE_CONTEXT
+    from ..obs.trace import TraceContext
+
+    ctx = TraceContext.decode(
+        job.metadata.annotations.get(ANNOTATION_TRACE_CONTEXT, ""))
+    if ctx is not None:
+        return ctx
+    return TraceContext.for_job(job.metadata.uid) if job.metadata.uid else None
+
+
 def labels_for(job: TFJob, typ: ReplicaType) -> Dict[str, str]:
     """The 4-label replica selector (ref: getLabels, distributed.go:224-231)."""
     return selector_for(job.metadata.name, typ.value, job.spec.runtime_id)
@@ -262,6 +277,7 @@ def make_pod(job: TFJob, spec: TFReplicaSpec, index: int) -> Pod:
     c = pod.spec.containers[0]
     for name, value in _dir_env(job).items():
         c.set_env(name, value)
+    _stamp_trace_context(job, pod, c)
 
     if typ in (ReplicaType.PS, ReplicaType.WORKER):
         c.args = list(c.args) + tf_cluster_args(job, typ, index)
@@ -275,6 +291,25 @@ def make_pod(job: TFJob, spec: TFReplicaSpec, index: int) -> Pod:
         _wire_serving_pod(job, spec, pod, index)
     # Local: no wiring at all (ref: local.go — single pod, no services).
     return pod
+
+
+def _stamp_trace_context(job: TFJob, pod: Pod, c) -> None:
+    """Causal-context plumbing (obs plane): the pod annotation lets the
+    scheduler/kubelet attach their spans to the job's trace, and the env
+    var hands the context to the workload process — every replica of a
+    job shares ONE trace id."""
+    from ..api.labels import ANNOTATION_TRACE_CONTEXT
+    from ..obs.trace import TRACE_CONTEXT_ENV
+
+    ctx = trace_context_for(job)
+    if ctx is None:
+        return
+    encoded = ctx.encode()
+    c.set_env_default(TRACE_CONTEXT_ENV, encoded)
+    pod.metadata.annotations = {
+        **pod.metadata.annotations,
+        ANNOTATION_TRACE_CONTEXT: encoded,
+    }
 
 
 def serving_port(spec: TFReplicaSpec) -> int:

@@ -51,7 +51,9 @@ from ..api.labels import (
     ANNOTATION_NUM_SLICES,
     ANNOTATION_PRIORITY_CLASS,
     ANNOTATION_SLICE_INDEX,
+    ANNOTATION_TRACE_CONTEXT,
 )
+from ..obs import trace
 from ..obs.metrics import REGISTRY
 from ..planner.materialize import pod_index
 from .queue import GangEntry, PRIORITY_CLASSES, normalize_class, priority_for, sorted_waiting
@@ -325,7 +327,28 @@ class GangScheduler:
         self._c_admit.labels(e.priority_class).inc()
         if backfill:
             self._c_backfill.inc()
+        self._trace_admission(e, now, backfill)
         return True
+
+    def _trace_admission(self, e: GangEntry, now: float,
+                         backfill: bool) -> None:
+        """Queue-wait as a causal span on the owning job's trace: the
+        context rides every member pod's annotation (planner-stamped), so
+        the scheduler needs no job lookup to join the tree."""
+        ctx = None
+        for pod in e.pods.values():
+            ctx = trace.TraceContext.decode(
+                getattr(pod.metadata, "annotations", {}).get(
+                    ANNOTATION_TRACE_CONTEXT, ""))
+            if ctx is not None:
+                break
+        if ctx is None:
+            return
+        start = e.enqueued_at or now
+        trace.add_span("sched/queue_wait", start, max(0.0, now - start),
+                       ctx=ctx, gang=e.name,
+                       priority_class=e.priority_class,
+                       slices=",".join(e.slice_names), backfill=backfill)
 
     def _harvest_for_locked(self, e: GangEntry, now: float,
                             evictions: List[Tuple[List[str], str]]) -> int:

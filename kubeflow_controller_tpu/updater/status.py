@@ -192,6 +192,7 @@ def compute_serving(
     if beats:
         st.qps = round(sum(b.qps for b in beats), 3)
         st.ttft_ms = round(max(b.ttft_ms for b in beats), 3)
+        st.ttft_p99_ms = round(max(b.ttft_p99_ms for b in beats), 3)
         st.itl_ms = round(max(b.itl_ms for b in beats), 3)
         st.queue_depth = sum(b.queue_depth for b in beats)
         occ = [b.slots_used / b.slots_total for b in beats if b.slots_total]

@@ -66,6 +66,15 @@ def main(argv=None) -> int:
     loss = float(loss)
     elapsed = time.time() - start
 
+    # Join the job's causal trace ($KCTPU_TRACE_CONTEXT, injected by the
+    # planner): one span for the whole compiled run, dumped explicitly
+    # because warm-forked pods exit through os._exit (no atexit).
+    from ..obs import trace as obs_trace
+
+    obs_trace.add_span("workload/train", start, elapsed,
+                       ctx=obs_trace.current_context(), steps=args.steps)
+    obs_trace.dump_to_env_dir()
+
     acc = float(m.mlp_accuracy(params, ex, ey, apply_fn=apply_fn))
     # Same sign-off line format as the reference workload
     # (ref: examples/workdir/mnist_replica.py:263 "Training elapsed time").

@@ -86,8 +86,11 @@ class ProgressReporter:
         slots_total — workloads/serve.py ServeStats.as_beat)."""
         if not self.enabled:
             return
+        first_step = False
         with self._lock:
             if step is not None:
+                if int(step) >= 1 and self._last.get("step", 0) < 1:
+                    first_step = True
                 self._last["step"] = int(step)
             if examples_per_sec is not None:
                 self._last["examplesPerSec"] = float(examples_per_sec)
@@ -108,6 +111,20 @@ class ProgressReporter:
                 for snake, value in serving.items():
                     self._last[camel(snake)] = value
             body = dict(self._last)
+        if first_step:
+            # Terminal leg of the job's causal timeline: the first step
+            # completing in this workload process (the context arrived via
+            # $KCTPU_TRACE_CONTEXT, so this joins the controller's tree).
+            import time as _time
+
+            from ..obs import trace
+
+            ctx = trace.TRACER.current_context()
+            if ctx is not None:
+                trace.add_span("workload/first_step", _time.time(), 0.0,
+                               ctx=ctx, pod=self.name,
+                               namespace=self.namespace,
+                               step=int(body.get("step", 1)))
         self._publish(body)
 
     def compiling(self, interval_s: float = 2.0):

@@ -47,6 +47,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..obs import trace
 from ..utils import locks
 
 # Coarse workload phases a serving replica reports (checker.StallTracker
@@ -105,6 +106,7 @@ class Request:
     tokens: List[int]
     max_new_tokens: int
     submit_t: float = 0.0
+    admit_t: float = 0.0          # queue wait = admit_t - submit_t
     first_token_t: float = 0.0    # TTFT = first_token_t - submit_t
     finish_t: float = 0.0
     output: List[int] = field(default_factory=list)
@@ -149,6 +151,7 @@ class ServeStats:
         return {
             "qps": round(self.qps, 3),
             "ttft_ms": round(self.ttft_ms, 3),
+            "ttft_p99_ms": round(self.ttft_p99_ms, 3),
             "itl_ms": round(self.itl_ms, 3),
             "queue_depth": self.queue_depth,
             "slots_used": self.slots_used,
@@ -393,6 +396,10 @@ class ServeEngine:
         self._window: deque = deque()
         self._itl: deque = deque(maxlen=2048)
         self._thread: Optional[threading.Thread] = None
+        # Causal trace: when this replica runs under a job's trace context
+        # ($KCTPU_TRACE_CONTEXT via the planner), every completed request
+        # emits its ingest->queue->prefill->decode->finish span chain.
+        self._trace_ctx = trace.TRACER.current_context()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -584,6 +591,7 @@ class ServeEngine:
                     self._queue.appendleft(req)
                     return
                 pages = [self._free_pages.pop() for _ in range(need)]
+            req.admit_t = time.monotonic()
             toks = np.zeros((1, bucket), np.int32)
             toks[0, :plen] = np.asarray(req.tokens[:plen], np.int32)
             rows = np.zeros(bucket, np.int32)
@@ -681,7 +689,31 @@ class ServeEngine:
             self._free_pages.extend(slot.pages)
             if slot_index is not None:
                 self._slots[slot_index] = None
+        self._trace_request(slot.req)
         slot.req.done.set()
+
+    def _trace_request(self, req: Request) -> None:
+        """Emit the request's causal span chain (request envelope with
+        queue-wait/prefill/decode children) onto the job trace.  Request
+        clocks are monotonic; the offset to wall time is taken once here
+        so the spans line up with the cross-process timeline."""
+        ctx = self._trace_ctx
+        if ctx is None:
+            return
+        off = time.time() - time.monotonic()
+        parent = trace.add_span(
+            "serve/request", req.submit_t + off,
+            max(0.0, req.finish_t - req.submit_t), ctx=ctx,
+            request=req.id, tokens_out=len(req.output))
+        if parent is None:
+            return  # trace unsampled
+        admit = req.admit_t or req.first_token_t or req.finish_t
+        first = req.first_token_t or req.finish_t
+        for name, t0, t1 in (("serve/queue_wait", req.submit_t, admit),
+                             ("serve/prefill", admit, first),
+                             ("serve/decode", first, req.finish_t)):
+            trace.add_span(name, t0 + off, max(0.0, t1 - t0), ctx=ctx,
+                           parent_id=parent.span_id, request=req.id)
 
 
 # ---------------------------------------------------------------------------

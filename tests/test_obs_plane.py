@@ -1,0 +1,486 @@
+"""Observability-plane tests: causal trace context (propagation, sampling,
+cross-process reassembly), the retained-series TSDB (retention, downsample,
+series budget, query surface), SLO burn-rate edge exactness, scrape-time
+histogram quantiles, and flight-recorder bundle completeness on a chaos
+kill (ISSUE 16; docs/OBSERVABILITY.md)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kubeflow_controller_tpu.obs import flight
+from kubeflow_controller_tpu.obs import trace as trace_mod
+from kubeflow_controller_tpu.obs.metrics import Registry
+from kubeflow_controller_tpu.obs.slo import (
+    KIND_HISTOGRAM_QUANTILE,
+    Objective,
+    SLOEngine,
+    default_objectives,
+)
+from kubeflow_controller_tpu.obs.trace import (
+    TRACE_CONTEXT_ENV,
+    TRACE_DIR_ENV,
+    TRACE_SAMPLE_ENV,
+    TraceContext,
+    Tracer,
+    causal_tree,
+    event_ids,
+    events_for_trace,
+    merge_trace_dir,
+    orphan_events,
+)
+from kubeflow_controller_tpu.obs.tsdb import TSDB
+
+
+# ---------------------------------------------------------------------------
+# Trace context
+# ---------------------------------------------------------------------------
+
+class TestTraceContext:
+    def test_encode_decode_roundtrip(self):
+        ctx = TraceContext.for_job("uid-123")
+        back = TraceContext.decode(ctx.encode())
+        assert back is not None
+        assert back.trace_id == ctx.trace_id
+        assert back.span_id == ctx.span_id
+
+    def test_for_job_is_deterministic(self):
+        a, b = TraceContext.for_job("uid-x"), TraceContext.for_job("uid-x")
+        assert a.trace_id == b.trace_id and a.span_id == b.span_id
+        assert TraceContext.for_job("uid-y").trace_id != a.trace_id
+
+    @pytest.mark.parametrize("junk", ["", "abc", ":b:01", "::", "x" * 200])
+    def test_decode_damaged_returns_none(self, junk):
+        assert TraceContext.decode(junk) is None
+
+    def test_root_span_has_no_self_edge(self):
+        """Emitting the root span (span_id == ctx.span_id) must not default
+        a parent edge onto itself — the tree walk would loop."""
+        t = Tracer()
+        ctx = TraceContext.for_job("uid-root")
+        sp = t.add_span("job/submit", 1.0, 0.5, ctx=ctx, span_id=ctx.span_id)
+        assert sp is not None
+        assert sp.span_id == ctx.span_id
+        assert sp.parent_id == ""
+
+    def test_ctx_spans_parent_to_context_root(self):
+        t = Tracer()
+        ctx = TraceContext.for_job("uid-p")
+        sp = t.add_span("sched/queue_wait", 1.0, 0.1, ctx=ctx)
+        assert sp.trace_id == ctx.trace_id
+        assert sp.parent_id == ctx.span_id
+
+    def test_causal_tree_tolerates_self_edge(self):
+        """A damaged event whose parent_id == span_id is treated as a root,
+        not an infinite loop."""
+        evs = [{"name": "broken", "ts": 0, "args": {
+            "trace_id": "t1", "span_id": "s1", "parent_id": "s1"}}]
+        roots, children = causal_tree(evs)
+        assert len(roots) == 1 and not children.get("s1")
+
+    def test_sampling_drops_ctx_spans_only(self, monkeypatch):
+        monkeypatch.setenv(TRACE_SAMPLE_ENV, "0.0")
+        t = Tracer()
+        ctx = TraceContext.for_job("uid-sampled-out")
+        assert t.add_span("dropped", 1.0, 0.1, ctx=ctx) is None
+        assert t.add_span("kept", 1.0, 0.1) is not None
+        names = [s.name for s in t.spans()]
+        assert names == ["kept"]
+
+    def test_sample_rate_one_keeps_everything(self, monkeypatch):
+        monkeypatch.setenv(TRACE_SAMPLE_ENV, "1.0")
+        t = Tracer()
+        ctx = TraceContext.for_job("uid-kept")
+        assert t.add_span("kept", 1.0, 0.1, ctx=ctx) is not None
+
+
+class TestCrossProcessReassembly:
+    def test_subprocess_spans_join_one_connected_tree(self, tmp_path):
+        """The e2e contract: a workload process that inherits
+        $KCTPU_TRACE_CONTEXT emits spans, dumps them to $KCTPU_TRACE_DIR,
+        and the merged document is ONE connected tree — single trace_id,
+        two pids, zero orphans."""
+        ctx = TraceContext.for_job("uid-e2e")
+        parent = Tracer()
+        parent.add_span("job/submit", time.time(), 0.01,
+                        ctx=ctx, span_id=ctx.span_id, job="e2e")
+
+        child_code = (
+            "import time\n"
+            "from kubeflow_controller_tpu.obs import trace\n"
+            "ctx = trace.process_context()\n"
+            "assert ctx is not None, 'context not inherited from env'\n"
+            "sp = trace.add_span('workload/first_step', time.time(), 0.01,\n"
+            "                    ctx=ctx)\n"
+            "trace.add_span('workload/io', time.time(), 0.005, ctx=ctx,\n"
+            "               parent_id=sp.span_id)\n"
+            "path = trace.dump_to_env_dir()\n"
+            "assert path, 'dump_to_env_dir wrote nothing'\n"
+        )
+        env = dict(os.environ)
+        env[TRACE_CONTEXT_ENV] = ctx.encode()
+        env[TRACE_DIR_ENV] = str(tmp_path)
+        env.pop(TRACE_SAMPLE_ENV, None)
+        subprocess.run([sys.executable, "-c", child_code], env=env,
+                       check=True, timeout=60)
+
+        doc = merge_trace_dir(str(tmp_path), tracer=parent)
+        evs = events_for_trace(doc["traceEvents"], ctx.trace_id)
+        assert len(evs) == 3
+        assert len({e["pid"] for e in evs}) == 2
+        assert orphan_events(evs) == []
+        by_name = {e["name"]: e for e in evs}
+        # The child's top span hangs off the job root; its sub-span off it.
+        assert event_ids(by_name["workload/first_step"])[2] == ctx.span_id
+        assert (event_ids(by_name["workload/io"])[2]
+                == event_ids(by_name["workload/first_step"])[1])
+
+    def test_merge_dedups_double_dumps(self, tmp_path):
+        """A process may dump twice (explicit end-of-main + the zygote
+        safety net); the merged tree must carry each span once."""
+        t = Tracer()
+        ctx = TraceContext.for_job("uid-dup")
+        t.add_span("work", time.time(), 0.01, ctx=ctx, span_id=ctx.span_id)
+        os.environ[TRACE_DIR_ENV] = str(tmp_path)
+        try:
+            assert trace_mod.dump_to_env_dir(t)
+            assert trace_mod.dump_to_env_dir(t)
+        finally:
+            del os.environ[TRACE_DIR_ENV]
+        evs = merge_trace_dir(str(tmp_path))["traceEvents"]
+        assert len(evs) == 1
+
+
+# ---------------------------------------------------------------------------
+# TSDB
+# ---------------------------------------------------------------------------
+
+def mk_tsdb(**kw):
+    reg = Registry()
+    g = reg.gauge("kctpu_x", "test gauge", ("job",))
+    kw.setdefault("retention_s", 10.0)
+    kw.setdefault("coarse_step_s", 5.0)
+    kw.setdefault("coarse_retention_s", 60.0)
+    return reg, g, TSDB(registry=reg, **kw)
+
+
+class TestTSDB:
+    def test_raw_points_within_retention(self):
+        reg, g, db = mk_tsdb()
+        for i in range(5):
+            g.labels("a").set(float(i))
+            db.sample_once(1000.0 + i)
+        pts = db.points("kctpu_x", {"job": "a"}, 1000.0, 1004.0)
+        assert [v for _, v in pts] == [0.0, 1.0, 2.0, 3.0, 4.0]
+
+    def test_downsample_past_raw_horizon(self):
+        """Points aging out of the raw ring land in the coarse ring — ONE
+        point per coarse step, the newest sample in the step winning."""
+        reg, g, db = mk_tsdb()  # retention 10s, coarse step 5s
+        for i in range(30):
+            g.labels("a").set(float(i))
+            db.sample_once(1000.0 + i)
+        # Raw ring holds only the last 10s.
+        s = db._get("kctpu_x", {"job": "a"})
+        assert all(ts >= 1029.0 - 10.0 for ts, _ in s.raw)
+        # Aged points collapsed to one per 5s step, newest-in-step value.
+        steps = [ts for ts, _ in s.coarse]
+        assert steps == sorted(set(steps)), "one point per coarse step"
+        by_step = dict(s.coarse)
+        assert by_step[1000.0] == 4.0  # samples 1000-1004 -> newest (value 4)
+
+    def test_coarse_retention_evicts(self):
+        reg, g, db = mk_tsdb(coarse_retention_s=20.0)
+        for i in range(100):
+            g.labels("a").set(float(i))
+            db.sample_once(1000.0 + i)
+        s = db._get("kctpu_x", {"job": "a"})
+        assert all(ts >= 1099.0 - 20.0 for ts, _ in s.coarse)
+
+    def test_series_budget_drops_overflow(self):
+        reg, g, db = mk_tsdb(max_series=4)
+        for i in range(10):
+            g.labels(f"job-{i}").set(1.0)
+        db.sample_once(1000.0)
+        assert db.series_count() == 4
+        # The drop counter is part of the sampled registry's catalogue.
+        fams = {f.name: f for f in reg.families()}
+        assert fams["kctpu_tsdb_series_dropped_total"].samples[0].value > 0
+
+    def test_rate_over_window(self):
+        reg, g, db = mk_tsdb(retention_s=100.0)
+        for i in range(11):
+            g.labels("a").set(float(i * 10))  # +10/s
+            db.sample_once(1000.0 + i)
+        r = db.rate("kctpu_x", {"job": "a"}, 10.0, now=1010.0)
+        assert r == pytest.approx(10.0, rel=1e-6)
+
+    def test_query_surface(self):
+        reg, g, db = mk_tsdb(retention_s=100.0)
+        g.labels("a").set(7.0)
+        db.sample_once(1000.0)
+        out = db.query({"op": "latest", "name": "kctpu_x",
+                        "labels": json.dumps({"job": "a"})})
+        assert out["point"][1] == 7.0
+        assert "error" in db.query({"op": "nope", "name": "kctpu_x"})
+        assert "error" in db.query({"op": "latest", "name": ""})
+        assert "error" in db.query({"op": "latest", "name": "kctpu_x",
+                                    "labels": "[1,2]"})
+        names = db.query({"op": "series"})["series"]
+        assert "kctpu_x" in names
+
+    def test_avg_over_time(self):
+        reg, g, db = mk_tsdb(retention_s=100.0)
+        for i, v in enumerate([1.0, 2.0, 3.0, 4.0]):
+            g.labels("a").set(v)
+            db.sample_once(1000.0 + i)
+        avg = db.avg_over_time("kctpu_x", {"job": "a"}, 10.0, now=1003.0)
+        assert avg == pytest.approx(2.5)
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate engine
+# ---------------------------------------------------------------------------
+
+def mk_slo_rig(objective=None):
+    reg = Registry()
+    g = reg.gauge("kctpu_serve_ttft_p99_ms", "test", ("namespace", "tfjob"))
+    db = TSDB(registry=reg, retention_s=300.0)
+    obj = objective or Objective(
+        name="ttft", description="p99 ttft <= 2s",
+        metric="kctpu_serve_ttft_p99_ms", threshold=2000.0,
+        error_budget=0.05, fast_window_s=10.0, slow_window_s=30.0,
+        burn_threshold=2.0)
+    edges = []
+    eng = SLOEngine(db, objectives=[obj], registry=reg,
+                    notifier=lambda st, fired: edges.append(
+                        (fired, st.series_label())))
+    return g, db, eng, edges
+
+
+class TestSLOBurn:
+    def drive(self, g, db, eng, t0, n, value):
+        for i in range(n):
+            g.labels("default", "j").set(value)
+            db.sample_once(t0 + i)
+            eng.evaluate_once(t0 + i)
+        return t0 + n
+
+    def test_fire_and_resolve_edges_are_exact(self):
+        g, db, eng, edges = mk_slo_rig()
+        t = self.drive(g, db, eng, 1000.0, 30, 100.0)   # healthy
+        assert edges == []
+        t = self.drive(g, db, eng, t, 40, 5000.0)        # sustained breach
+        assert edges == [(True, "namespace=default,tfjob=j")]
+        t = self.drive(g, db, eng, t, 40, 100.0)         # recovery
+        assert edges == [(True, "namespace=default,tfjob=j"),
+                         (False, "namespace=default,tfjob=j")]
+        st = [s for s in eng.alerts(active_only=False) if s["slo"] == "ttft"]
+        assert st and st[0]["transitions"] == 1 and not st[0]["active"]
+
+    def test_blip_does_not_fire(self):
+        """One violating sample trips the fast window but not the slow one
+        — the multi-window rule holds the alert back."""
+        g, db, eng, edges = mk_slo_rig()
+        t = self.drive(g, db, eng, 1000.0, 30, 100.0)
+        t = self.drive(g, db, eng, t, 1, 5000.0)   # 1/31 in slow window
+        self.drive(g, db, eng, t, 5, 100.0)
+        assert edges == []
+
+    def test_no_refire_while_active(self):
+        g, db, eng, edges = mk_slo_rig()
+        t = self.drive(g, db, eng, 1000.0, 10, 5000.0)
+        self.drive(g, db, eng, t, 100, 5000.0)  # stays bad for a long time
+        assert [f for f, _ in edges] == [True]
+
+    def test_alert_gauges_follow_state(self):
+        g, db, eng, edges = mk_slo_rig()
+        t = self.drive(g, db, eng, 1000.0, 40, 5000.0)
+        fams = {f.name: f for f in eng.registry.families()}
+        active = {tuple(sorted(s.labels.items())): s.value
+                  for s in fams["kctpu_slo_alert_active"].samples}
+        key = (("series", "namespace=default,tfjob=j"), ("slo", "ttft"))
+        assert active[key] == 1.0
+        self.drive(g, db, eng, t, 40, 100.0)
+        fams = {f.name: f for f in eng.registry.families()}
+        active = {tuple(sorted(s.labels.items())): s.value
+                  for s in fams["kctpu_slo_alert_active"].samples}
+        assert active[key] == 0.0
+
+    def test_histogram_quantile_objective(self):
+        reg = Registry()
+        h = reg.histogram("kctpu_lat_seconds", "test", ("tfjob",),
+                          buckets=(0.1, 1.0, 10.0))
+        db = TSDB(registry=reg, retention_s=300.0)
+        obj = Objective(
+            name="lat-p99", description="p99 <= 1s",
+            metric="kctpu_lat_seconds", threshold=1.0,
+            kind=KIND_HISTOGRAM_QUANTILE, q=0.99,
+            error_budget=0.05, fast_window_s=10.0, slow_window_s=30.0,
+            burn_threshold=2.0, subject_labels=("tfjob",))
+        edges = []
+        eng = SLOEngine(db, objectives=[obj], registry=reg,
+                        notifier=lambda st, f: edges.append(f))
+        for i in range(40):
+            h.labels("j").observe(5.0)   # p99 lands in the 10s bucket
+            db.sample_once(1000.0 + i)
+            eng.evaluate_once(1000.0 + i)
+        assert edges == [True]
+
+    def test_set_objectives_resets_state(self):
+        g, db, eng, edges = mk_slo_rig()
+        self.drive(g, db, eng, 1000.0, 40, 5000.0)
+        assert [f for f, _ in edges] == [True]
+        eng.set_objectives([])
+        assert eng.alerts(active_only=False) == []
+
+    def test_default_catalogue_shape(self):
+        objs = {o.name for o in default_objectives()}
+        assert {"serving-ttft-p99", "job-ttfs", "job-stall-rate",
+                "failover-time", "sched-queue-wait"} <= objs
+
+
+# ---------------------------------------------------------------------------
+# Scrape-time histogram quantiles (Registry.histogram_quantile)
+# ---------------------------------------------------------------------------
+
+class TestScrapeTimeQuantiles:
+    def test_quantile_from_live_histogram(self):
+        reg = Registry()
+        h = reg.histogram("kctpu_d_seconds", "test", ("job",),
+                          buckets=(0.1, 1.0, 10.0))
+        for _ in range(9):
+            h.labels("a").observe(0.05)
+        h.labels("a").observe(5.0)  # rank q*10=9.9 -> the 10s bucket
+        p50 = reg.histogram_quantile("kctpu_d_seconds", {"job": "a"}, 0.5)
+        p99 = reg.histogram_quantile("kctpu_d_seconds", {"job": "a"}, 0.99)
+        assert p50 <= 0.1
+        assert 1.0 < p99 <= 10.0
+
+    def test_quantile_missing_family_is_zero(self):
+        reg = Registry()
+        assert reg.histogram_quantile("nope", {}, 0.99) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_disabled_without_dir(self, monkeypatch):
+        monkeypatch.delenv(flight.DEBUG_DIR_ENV, raising=False)
+        assert flight.record_flight("default", "j") is None
+
+    def test_bundle_completeness(self, tmp_path):
+        reg = Registry()
+        g = reg.gauge("kctpu_y", "test")
+        db = TSDB(registry=reg, retention_s=300.0)
+        g.set(3.0)
+        db.sample_once(1000.0)
+        path = flight.record_flight(
+            "default", "j", reason="Test", trace_id="",
+            events=[{"type": "Warning", "reason": "X", "message": "m"}],
+            progress={"p0": {"step": 7}},
+            status_history=[{"from": "Created", "to": "Running", "at": 1.0}],
+            status={"phase": "Failed"},
+            tsdb=db, out_dir=str(tmp_path), now=1000.0)
+        assert path is not None
+        bundle = flight.read_bundle(path)
+        assert set(bundle) == {"manifest.json", "trace.json", "events.json",
+                               "progress.json", "status.json", "tsdb.json"}
+        m = bundle["manifest.json"]
+        assert m["reason"] == "Test" and m["events"] == 1
+        assert bundle["status.json"]["history"][0]["to"] == "Running"
+        assert bundle["progress.json"]["p0"]["step"] == 7
+        tsdb_names = {s["name"] for s in bundle["tsdb.json"]["series"]}
+        assert "kctpu_y" in tsdb_names
+
+    def test_read_bundle_skips_damage(self, tmp_path):
+        (tmp_path / "good.json").write_text('{"a": 1}')
+        (tmp_path / "bad.json").write_text("{nope")
+        out = flight.read_bundle(str(tmp_path))
+        assert out == {"good.json": {"a": 1}}
+
+
+@pytest.mark.slow
+class TestFlightRecorderE2E:
+    def test_chaos_kill_cuts_complete_bundle(self, tmp_path, monkeypatch):
+        """A restart_policy Never job chaos-killed mid-run must leave a
+        postmortem bundle: causal trace, event ring, status history."""
+        from kubeflow_controller_tpu.api.core import (
+            Container, PodTemplateSpec)
+        from kubeflow_controller_tpu.api.meta import ObjectMeta
+        from kubeflow_controller_tpu.api.tfjob import (
+            ReplicaType, TFJob, TFJobPhase, TFReplicaSpec)
+        from kubeflow_controller_tpu.cluster import (
+            Cluster, FakeKubelet, PhasePolicy)
+        from kubeflow_controller_tpu.controller import Controller
+
+        monkeypatch.setenv(flight.DEBUG_DIR_ENV, str(tmp_path))
+        cluster = Cluster()
+        kubelet = FakeKubelet(cluster, policy=PhasePolicy(run_s=60.0))
+        ctrl = Controller(cluster, resync_period_s=0.5)
+        kubelet.start()
+        ctrl.run(threadiness=2)
+        try:
+            t = PodTemplateSpec()
+            t.spec.containers.append(Container(name="w", image="img"))
+            t.spec.restart_policy = "Never"
+            job = TFJob(metadata=ObjectMeta(name="doomed",
+                                            namespace="default"))
+            job.spec.tf_replica_specs.append(TFReplicaSpec(
+                replicas=1, tf_replica_type=ReplicaType.WORKER, template=t))
+            cluster.tfjobs.create(job)
+
+            def wait_for(cond, timeout=15.0):
+                deadline = time.time() + timeout
+                while time.time() < deadline:
+                    if cond():
+                        return True
+                    time.sleep(0.05)
+                return False
+
+            def running_pod():
+                for p in cluster.pods.list("default"):
+                    if (p.metadata.name.startswith("doomed-")
+                            and p.status.phase == "Running"):
+                        return p
+                return None
+
+            assert wait_for(lambda: running_pod() is not None)
+            victim = running_pod().metadata.name
+            assert kubelet.chaos_kill("default", victim) == "simulated"
+            assert wait_for(
+                lambda: cluster.tfjobs.get("default", "doomed").status.phase
+                == TFJobPhase.FAILED)
+
+            def bundle_dir():
+                return [d for d in os.listdir(str(tmp_path))
+                        if d.startswith("default-doomed-")]
+
+            assert wait_for(lambda: bool(bundle_dir()))
+            bundle = flight.read_bundle(
+                os.path.join(str(tmp_path), bundle_dir()[0]))
+            assert {"manifest.json", "trace.json", "events.json",
+                    "progress.json", "status.json",
+                    "tsdb.json"} <= set(bundle)
+            m = bundle["manifest.json"]
+            assert m["reason"] == "JobFailed"
+            assert m["trace_id"], "bundle must name the job's trace"
+            # The causal trace made it into the bundle and is connected.
+            evs = bundle["trace.json"]["traceEvents"]
+            assert evs and orphan_events(evs) == []
+            assert all(event_ids(e)[0] == m["trace_id"] for e in evs)
+            # Event ring captured the lifecycle (SuccessfulCreate at least).
+            assert any(e["reason"] == "SuccessfulCreate"
+                       for e in bundle["events.json"])
+            # Status history recorded the terminal transition.
+            hist = bundle["status.json"]["history"]
+            assert any(h["to"] == "Failed" for h in hist)
+        finally:
+            ctrl.stop()
+            kubelet.stop()
