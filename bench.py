@@ -2315,6 +2315,384 @@ def serve_main(args) -> int:
     return rc
 
 
+# ---------------------------------------------------------------------------
+# Gateway bench (serving front door, gateway/ — GATEWAY_r01.json)
+# ---------------------------------------------------------------------------
+
+class _PacedBackend:
+    """SyntheticBackend with accelerator-shaped costs: prefill/extend time
+    scales with the tokens actually COMPUTED, decode is per engine step —
+    so prefix-cache affinity shows up as wall-clock (an extend of the
+    divergent tail skips the shared span's prefill work, which is exactly
+    the term the gateway's affinity routing is buying)."""
+
+    def __init__(self, inner, token_s: float = 0.0006,
+                 decode_s: float = 0.002):
+        self.inner = inner
+        self.token_s = token_s
+        self.decode_s = decode_s
+
+    def prefill(self, tokens_padded, rows, plen):
+        out = self.inner.prefill(tokens_padded, rows, plen)
+        time.sleep(self.token_s * plen)
+        return out
+
+    def extend(self, tokens_padded, write_rows, read_rows, start_pos, plen):
+        out = self.inner.extend(tokens_padded, write_rows, read_rows,
+                                start_pos, plen)
+        time.sleep(self.token_s * plen)
+        return out
+
+    def decode(self, tokens, positions, page_tables):
+        out = self.inner.decode(tokens, positions, page_tables)
+        time.sleep(self.decode_s)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def _gateway_engines(n: int, slots: int = 4, token_s: float = 0.0006,
+                     decode_s: float = 0.002, prefix: int = 0):
+    """n paced in-process serve engines with the prefix cache on, named
+    r{prefix}..; returns [(name, engine)] once all are ready."""
+    from kubeflow_controller_tpu.workloads.serve import (
+        ServeConfig,
+        ServeEngine,
+        SyntheticBackend,
+    )
+
+    engines = []
+    for i in range(n):
+        eng = ServeEngine(
+            _PacedBackend(SyntheticBackend(), token_s, decode_s),
+            ServeConfig(slots=slots, page_size=16, max_len=256,
+                        prefill_buckets=(16, 32, 64, 128),
+                        cont_batch=True, prefix_cache=True,
+                        stats_window_s=8.0))
+        eng.start()
+        engines.append((f"r{prefix + i}", eng))
+    for _, e in engines:
+        if not e.wait_ready(30.0):
+            raise RuntimeError("gateway bench replica never became ready")
+    return engines
+
+
+def _gateway_multiturn(route, sessions: int, turns: int, seed: int,
+                       deadline_s: float, max_new: int = 8,
+                       turn_gap_s: float = 0.0,
+                       stagger_s: float = 0.0) -> dict:
+    """Multi-turn conversational load: each session's turn-t prompt is the
+    full history (prior prompt + prior output + a few fresh user tokens),
+    issued strictly after turn t-1 completes — the traffic shape where
+    cross-request prefix sharing pays.  ``route(req)`` dispatches; the
+    caller waits on ``req.done``.  The synthetic model is a pure function
+    of the tokens, so two arms fed the same seed see IDENTICAL load."""
+    import random as _random
+
+    from kubeflow_controller_tpu.workloads.serve import Request
+
+    from kubeflow_controller_tpu.utils import locks
+
+    reqs: list = []
+    lock = locks.named_lock("bench.gw-multiturn")
+
+    def run_session(sid: int) -> None:
+        rng = _random.Random(seed * 1000 + sid)
+        if stagger_s:
+            # Ramp the sessions in: an all-at-once cold burst (every turn
+            # 0 a full prefill, no affinity advantage possible) would set
+            # BOTH arms' tail latency and hide the routing difference.
+            time.sleep(sid * stagger_s)
+        history = [rng.randrange(1, 250) for _ in range(24)]
+        for t in range(turns):
+            req = Request(id=f"s{sid}-t{t}", tokens=list(history),
+                          max_new_tokens=max_new, session=f"s{sid}")
+            req.submit_t = time.monotonic()
+            with lock:
+                reqs.append(req)
+            route(req)
+            if not req.done.wait(deadline_s) or req.error:
+                return
+            history += list(req.output)
+            history += [rng.randrange(1, 250) for _ in range(4)]
+            if turn_gap_s:
+                time.sleep(turn_gap_s)  # user think time (paces the sweep)
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=run_session, args=(i,),
+                                name=f"gw-session-{i}", daemon=True)
+               for i in range(sessions)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(deadline_s)
+    makespan = max(time.monotonic() - t0, 1e-9)
+    completed = [r for r in reqs if r.done.is_set() and not r.error]
+    tokens = sum(len(r.output) for r in completed)
+    return {
+        "requests": sessions * turns,
+        "completed": len(completed),
+        "makespan_s": round(makespan, 3),
+        "tokens_per_sec": round(tokens / makespan, 1),
+        **_serve_percentiles(completed),
+    }
+
+
+def _gateway_routing_phase(sessions: int, turns: int, seed: int,
+                           deadline_s: float) -> dict:
+    """Affinity routing vs round-robin direct at equal load: the same
+    multi-turn session traffic over 3 identical prefix-caching replicas,
+    once through the gateway (least-loaded + session affinity) and once
+    round-robin — RR scatters a session's turns, so the replica holding
+    the conversation's KV pages rarely sees the follow-up."""
+    from kubeflow_controller_tpu.gateway import (
+        Gateway,
+        GatewayConfig,
+        engine_replica,
+    )
+
+    def hit_ratio(engines) -> float:
+        st = [e.stats() for _, e in engines]
+        hits = sum(s.prefix_hits for s in st)
+        return round(hits / max(1, hits + sum(s.prefix_misses for s in st)),
+                     4)
+
+    out: dict = {}
+    engines = _gateway_engines(3)
+    gw = Gateway(GatewayConfig(slo_ttft_ms=2000.0))
+    for name, eng in engines:
+        gw.register(engine_replica(name, eng))
+    gw.start()
+    try:
+        out["gateway"] = _gateway_multiturn(gw.route, sessions, turns, seed,
+                                            deadline_s, stagger_s=0.02)
+        out["gateway"]["prefix_hit_ratio"] = hit_ratio(engines)
+        st = gw.stats()
+        out["gateway"]["affinity_hits"] = st.affinity_hits
+        out["gateway"]["weights"] = st.weights
+    finally:
+        gw.stop()
+        for _, eng in engines:
+            eng.stop()
+
+    engines = _gateway_engines(3)
+    from kubeflow_controller_tpu.utils import locks
+
+    rr_state = {"i": 0}
+    rr_lock = locks.named_lock("bench.gw-roundrobin")
+
+    def rr_route(req) -> None:
+        for _ in range(len(engines)):
+            with rr_lock:
+                name, eng = engines[rr_state["i"] % len(engines)]
+                rr_state["i"] += 1
+            if eng.submit(req):
+                return
+        req.error = "refused"
+        req.done.set()
+
+    try:
+        out["round_robin"] = _gateway_multiturn(rr_route, sessions, turns,
+                                                seed, deadline_s,
+                                                stagger_s=0.02)
+        out["round_robin"]["prefix_hit_ratio"] = hit_ratio(engines)
+    finally:
+        for _, eng in engines:
+            eng.stop()
+    out["throughput_ratio"] = round(
+        out["gateway"]["tokens_per_sec"]
+        / max(out["round_robin"]["tokens_per_sec"], 1e-9), 3)
+    return out
+
+
+def _gateway_tier_phase(seed: int, deadline_s: float,
+                        slo_ttft_ms: float = 1500.0) -> dict:
+    """SLO-aware tiered admission at 2x overload: an open-loop mixed
+    interactive/batch stream at ~2x one paced replica's capacity — the
+    gateway must shed batch (pressure crosses its shed band) while the
+    interactive tier, which alone fits in capacity, keeps its p99 TTFT
+    inside the SLO and is never shed."""
+    import random as _random
+
+    from kubeflow_controller_tpu.gateway import (
+        Gateway,
+        GatewayConfig,
+        engine_replica,
+    )
+    from kubeflow_controller_tpu.workloads.serve import Request
+
+    rng = _random.Random(seed)
+    engines = _gateway_engines(1, slots=4, decode_s=0.005)
+    gw = Gateway(GatewayConfig(slo_ttft_ms=slo_ttft_ms))
+    gw.register(engine_replica(*engines[0]))
+    gw.start()
+    reqs = []
+    try:
+        # One 4-slot replica at 5 ms/step and 16-token outputs serves
+        # ~40-50 req/s; 90 req/s offered is a solid 2x overload.
+        rate, dur = 90.0, 4.0
+        n = int(rate * dur)
+        for i in range(n):
+            tier = "interactive" if rng.random() < 0.4 else "batch"
+            req = Request(id=f"t{i}",
+                          tokens=[rng.randrange(1, 250) for _ in range(12)],
+                          max_new_tokens=16, tier=tier)
+            req.submit_t = time.monotonic()
+            reqs.append(req)
+            gw.route(req)
+            time.sleep(dur / n)
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            if all(r.done.is_set() for r in reqs):
+                break
+            time.sleep(0.02)
+        st = gw.stats()
+        shed = dict(st.shed)
+
+        def tier_row(tier: str) -> dict:
+            mine = [r for r in reqs if r.tier == tier]
+            done = [r for r in mine if r.done.is_set() and not r.error]
+            return {"requests": len(mine), "completed": len(done),
+                    "shed": shed.get(tier, 0),
+                    **_serve_percentiles(done)}
+
+        return {
+            "offered_rps": rate,
+            "duration_s": dur,
+            "slo_ttft_ms": slo_ttft_ms,
+            "interactive": tier_row("interactive"),
+            "batch": tier_row("batch"),
+            "pressure_final": st.pressure,
+        }
+    finally:
+        gw.stop()
+        engines[0][1].stop()
+
+
+def _gateway_rolling_phase(seed: int, deadline_s: float) -> dict:
+    """Zero-downtime drain: multi-turn traffic over 2 replicas; mid-sweep
+    r0 drains (stop intake, unadmitted re-routed, in-flight finishes) and
+    a replacement registers — the rolling-update shape.  Gated on zero
+    dropped requests and r0 actually leaving the routing set (affinity
+    re-homes; no request ever waits on a corpse)."""
+    from kubeflow_controller_tpu.gateway import (
+        Gateway,
+        GatewayConfig,
+        engine_replica,
+    )
+
+    engines = _gateway_engines(2)
+    gw = Gateway(GatewayConfig(slo_ttft_ms=2000.0))
+    for name, eng in engines:
+        gw.register(engine_replica(name, eng))
+    gw.start()
+    result: dict = {}
+
+    def runner() -> None:
+        result.update(_gateway_multiturn(gw.route, 6, 10, seed, deadline_s,
+                                         turn_gap_s=0.05))
+
+    th = threading.Thread(target=runner, name="gw-roll-traffic", daemon=True)
+    replacement = None
+    try:
+        th.start()
+        time.sleep(0.3)  # mid-sweep
+        old_name, old_eng = engines[0]
+        old_eng.drain()  # unadmitted come back done+rerouted -> re-dispatch
+        t0 = time.monotonic()
+        while (not old_eng.drained
+               and time.monotonic() - t0 < deadline_s):
+            time.sleep(0.01)
+        result["drain_s"] = round(time.monotonic() - t0, 3)
+        replacement = _gateway_engines(1, prefix=2)[0]
+        gw.register(engine_replica(*replacement))
+        th.join(deadline_s)
+        st = gw.stats()
+        result["rerouted"] = st.rerouted
+        result["dropped"] = result["requests"] - result["completed"]
+        result["drained_left_routing_set"] = (
+            old_name not in gw.replica_names())
+        result["replacement_weight"] = round(
+            st.weights.get(replacement[0], 0.0), 4)
+        return result
+    finally:
+        gw.stop()
+        for _, eng in engines:
+            eng.stop()
+        if replacement is not None:
+            replacement[1].stop()
+
+
+def run_gateway(seed: int = 7, deadline_s: float = 60.0,
+                sessions: int = 12, turns: int = 8) -> dict:
+    return {
+        "routing": _gateway_routing_phase(sessions, turns, seed, deadline_s),
+        "tiers": _gateway_tier_phase(seed, deadline_s),
+        "rolling": _gateway_rolling_phase(seed, deadline_s),
+    }
+
+
+def gateway_main(args) -> int:
+    result = run_gateway(seed=args.seed, deadline_s=args.deadline or 60.0)
+    routing, tiers, rolling = (result["routing"], result["tiers"],
+                               result["rolling"])
+    ratio = routing["throughput_ratio"]
+    print(json.dumps({
+        "metric": "gateway_affinity_throughput_ratio",
+        "value": ratio,
+        "unit": "x round-robin tokens/sec",
+        "details": result,
+    }))
+    rc = 0
+    gwr, rr = routing["gateway"], routing["round_robin"]
+    if args.min_gateway_ratio > 0 and ratio < args.min_gateway_ratio:
+        print(f"gateway bench regression: affinity routing only {ratio}x "
+              f"round-robin throughput (< {args.min_gateway_ratio})",
+              file=sys.stderr)
+        rc = 1
+    if gwr["ttft_p99_ms"] > rr["ttft_p99_ms"]:
+        print(f"gateway bench regression: gateway p99 TTFT "
+              f"{gwr['ttft_p99_ms']}ms worse than round-robin "
+              f"{rr['ttft_p99_ms']}ms", file=sys.stderr)
+        rc = 1
+    if args.min_prefix_hit > 0 and gwr["prefix_hit_ratio"] < args.min_prefix_hit:
+        print(f"gateway bench regression: prefix-hit ratio "
+              f"{gwr['prefix_hit_ratio']} < {args.min_prefix_hit} on "
+              f"multi-turn traffic", file=sys.stderr)
+        rc = 1
+    if gwr["completed"] != gwr["requests"] or rr["completed"] != rr["requests"]:
+        print(f"gateway bench regression: routing phase dropped requests "
+              f"(gateway {gwr['completed']}/{gwr['requests']}, "
+              f"round-robin {rr['completed']}/{rr['requests']})",
+              file=sys.stderr)
+        rc = 1
+    inter, batch = tiers["interactive"], tiers["batch"]
+    if inter["ttft_p99_ms"] > tiers["slo_ttft_ms"]:
+        print(f"gateway bench regression: interactive p99 TTFT "
+              f"{inter['ttft_p99_ms']}ms burned the "
+              f"{tiers['slo_ttft_ms']}ms SLO under overload",
+              file=sys.stderr)
+        rc = 1
+    if batch["shed"] == 0:
+        print("gateway bench regression: batch tier never shed at 2x "
+              "overload (admission control inert)", file=sys.stderr)
+        rc = 1
+    if inter["shed"]:
+        print(f"gateway bench regression: {inter['shed']} interactive "
+              f"requests shed (low tiers must shed first)", file=sys.stderr)
+        rc = 1
+    if rolling["dropped"]:
+        print(f"gateway bench regression: {rolling['dropped']} requests "
+              f"dropped across the mid-sweep drain", file=sys.stderr)
+        rc = 1
+    if not rolling["drained_left_routing_set"]:
+        print("gateway bench regression: drained replica still in the "
+              "routing set", file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def _ttfs_phases(trace_dir: str) -> dict:
     """Per-phase breakdown of one TTFS run from the workers' span dumps:
     worst-across-workers duration per pipeline phase (the job's TTFS is
@@ -3354,6 +3732,21 @@ def main(argv=None) -> int:
                    help="--serve gate: autoscaler load-step reaction bound "
                         "(rate step -> second replica ready; 0 = report "
                         "only)")
+    p.add_argument("--gateway", action="store_true",
+                   help="serving front door: multi-turn session traffic "
+                        "through the request gateway (least-loaded + "
+                        "prefix-cache affinity) vs round-robin direct at "
+                        "equal load, tiered SLO-aware admission at 2x "
+                        "overload (batch sheds, interactive holds its "
+                        "TTFT SLO), and a mid-sweep replica drain gated "
+                        "on zero dropped requests")
+    p.add_argument("--min-gateway-ratio", type=float, default=0.0,
+                   metavar="R",
+                   help="--gateway gate: affinity/round-robin tokens-per-"
+                        "sec ratio floor (0 = report only)")
+    p.add_argument("--min-prefix-hit", type=float, default=0.0, metavar="H",
+                   help="--gateway gate: prefix-cache hit-ratio floor on "
+                        "the multi-turn phase (0 = report only)")
     p.add_argument("--record-history", action="store_true",
                    help="scale mode: attach the linearizability checker's "
                         "op recorder to the store and gate cross-kind RV "
@@ -3370,6 +3763,8 @@ def main(argv=None) -> int:
         return scale_main(args)
     if args.replicas:
         return widejob_main(args)
+    if args.gateway:
+        return gateway_main(args)
     if args.serve:
         return serve_main(args)
     if args.elastic:

@@ -123,6 +123,10 @@ class PodProgress:
     queue_depth: int = 0        # requests waiting for a slot (intake queue)
     slots_used: int = 0         # sequences currently in the running batch
     slots_total: int = 0        # batch slots this replica owns
+    # Fraction of admissions that reused resident prefix pages (0.0 when
+    # the replica runs without the prefix cache) — the gateway's affinity
+    # payoff gauge.
+    prefix_hit_ratio: float = 0.0
     # Wall-clock of the beat (stamped server-side when the reporter left
     # it 0, so clock-skewed workloads cannot fake liveness).
     timestamp: float = 0.0

@@ -69,6 +69,14 @@ ANNOTATION_DRAIN = f"{DOMAIN}/drain"
 # the kubelet injects it into workload env as $KCTPU_TRACE_CONTEXT so
 # spans from every process of a job join ONE causal tree.
 ANNOTATION_TRACE_CONTEXT = f"{DOMAIN}/trace-context"
+# --- serving front door (gateway/) ---
+# Gateway data-plane snapshot, written on the Serving TFJob by the
+# request gateway (JSON: routed qps, gateway-queued depth, shed counts
+# per tier + shed rate, prefix-hit ratio, per-replica routing weights,
+# wall-clock ts).  The autoscaler folds queued+shed into its scale
+# signal (shedding must not mask a needed scale-up) and the CLI surfaces
+# it in get/top/describe.
+ANNOTATION_GATEWAY_STATS = f"{DOMAIN}/gateway-stats"
 
 
 def selector_for(job_name: str, replica_type: str, runtime_id: str) -> dict:

@@ -213,6 +213,33 @@ def _serving_cells(j) -> tuple:
     return f"{sv.qps:g}", f"{sv.ttft_ms:g}ms"
 
 
+def _gateway_stats(j) -> dict:
+    """The gateway's published data-plane snapshot off the job's
+    gateway-stats annotation ({} when absent or unparseable) — the same
+    payload the autoscaler folds into its scale signal."""
+    from ..api.labels import ANNOTATION_GATEWAY_STATS
+
+    raw = j.metadata.annotations.get(ANNOTATION_GATEWAY_STATS, "")
+    if not raw:
+        return {}
+    try:
+        d = json.loads(raw)
+    except ValueError:
+        return {}
+    return d if isinstance(d, dict) else {}
+
+
+def _gateway_cells(j) -> tuple:
+    """(GWQPS, HIT) cells for a `top` row: the gateway's routed QPS and
+    the routed-weighted prefix-cache hit ratio ('-' without a gateway)."""
+    d = _gateway_stats(j)
+    if not d:
+        return "-", "-"
+    qps = f"{float(d.get('qps', 0.0) or 0.0):g}"
+    hit = f"{float(d.get('prefix_hit_ratio', 0.0) or 0.0):.0%}"
+    return qps, hit
+
+
 def _alert_banner(cluster) -> str:
     """One-line firing-SLO summary for the ``get`` header ('' when quiet
     or the server has no SLO surface)."""
@@ -313,6 +340,14 @@ def cmd_get(args) -> int:
         sv = j.status.serving
         if sv is not None and sv.replicas:
             kinds += f"[s={sv.ready}/{sv.replicas}]"
+        # Gateway front door, when publishing: routed QPS, prefix-cache
+        # hit ratio, and total sheds (the overload tell).
+        gw = _gateway_stats(j)
+        if gw:
+            shed = sum(int(v) for v in (gw.get("shed") or {}).values())
+            kinds += (f"[gw={float(gw.get('qps', 0) or 0):g}qps "
+                      f"hit={float(gw.get('prefix_hit_ratio', 0) or 0):.0%}"
+                      + (f" shed={shed}" if shed else "") + "]")
         # kubectl parity: deletionTimestamp set -> Terminating (a job stays
         # in this state until a running controller processes its finalizer).
         phase = ("Terminating" if j.metadata.deletion_timestamp is not None
@@ -369,6 +404,7 @@ def cmd_describe(args) -> int:
         tag = "  DEGRADED (replacement warming)" if w.current < w.spec else ""
         print(f"Width:     {w.current}/{w.spec} (elastic floor {w.min}){tag}")
     _describe_serving(j)
+    _describe_gateway(j)
     if j.status.reason.startswith("GangQueued"):
         print(f"Queue:     {j.status.reason}")
     for c in j.status.conditions:
@@ -415,6 +451,36 @@ def _describe_serving(j) -> None:
         print(f"           qps={sv.qps:g} ttft(p50)={sv.ttft_ms:g}ms "
               f"itl={sv.itl_ms:g}ms queue={sv.queue_depth} "
               f"occupancy={sv.occupancy:.0%}")
+
+
+def _describe_gateway(j) -> None:
+    """Gateway front-door section off the gateway-stats annotation:
+    routed QPS + end-to-end p99 TTFT, admission pressure, shed counts per
+    tier, prefix-cache hit ratio, and per-replica routing weights (what
+    'least-loaded with affinity' actually converged to)."""
+    d = _gateway_stats(j)
+    if not d:
+        return
+    print(f"Gateway:   qps={float(d.get('qps', 0) or 0):g} "
+          f"ttft(p99)={float(d.get('ttft_p99_ms', 0) or 0):g}ms "
+          f"queued={int(d.get('queued', 0) or 0)} "
+          f"pressure={float(d.get('pressure', 0) or 0):.2f} "
+          f"prefix-hit={float(d.get('prefix_hit_ratio', 0) or 0):.0%}")
+    shed = d.get("shed") or {}
+    rerouted = int(d.get("rerouted", 0) or 0)
+    if shed or rerouted:
+        cells = " ".join(f"{t}={shed[t]}" for t in sorted(shed))
+        line = f"           shed: {cells or 'none'}"
+        if float(d.get("shed_rps", 0) or 0):
+            line += f" ({float(d['shed_rps']):g}/s)"
+        if rerouted:
+            line += f"  rerouted={rerouted} (drain re-homes)"
+        print(line)
+    weights = d.get("weights") or {}
+    if weights:
+        cells = " ".join(f"{name}={float(weights[name]):.0%}"
+                         for name in sorted(weights))
+        print(f"           weights: {cells}")
 
 
 def _describe_compile_cache(j) -> None:
@@ -580,6 +646,7 @@ def cmd_top(args) -> int:
             _print_shard_depths(cluster, jobs, lease)
         print(f"{'NAMESPACE':<12} {'NAME':<32} {'PHASE':<10} {'STEP':<10} "
               f"{'RATE':<10} {'QPS':<8} {'TTFT':<9} {'OCC':<5} "
+              f"{'GWQPS':<7} {'HIT':<5} "
               f"{'LOSS':<10} {'LAG':<6} {'STALLED':<20} "
               f"{'SHARD':<6} BEAT")
         # Stalled jobs surface first (the rows an operator is looking for),
@@ -604,9 +671,11 @@ def cmd_top(args) -> int:
             qps, ttft = _serving_cells(j)
             sv = j.status.serving
             occ = f"{sv.occupancy:.0%}" if sv is not None and sv.ready else "-"
+            gwqps, hit = _gateway_cells(j)
             print(f"{j.metadata.namespace:<12} {j.metadata.name:<32} "
                   f"{j.status.phase.value:<10} {step:<10} {rate:<10} "
                   f"{qps:<8} {ttft:<9} {occ:<5} "
+                  f"{gwqps:<7} {hit:<5} "
                   f"{loss:<10} {lag:<6} {stalled:<20} "
                   f"{_shard_cell(j, lease):<6} {beat}")
         if not args.watch:

@@ -1,0 +1,31 @@
+"""Serving front door: request-routing gateway (docs/SERVING.md).
+
+One cluster-level data plane in front of the serving replicas: discovery
+via the informer's routable index, least-loaded routing on the progress
+plane's live gauges, session/prefix affinity onto the replica whose
+paged KV cache already holds the conversation, and SLO-aware tiered
+admission that queues/sheds low tiers before p99 TTFT burns the
+``serving-ttft-p99`` objective.
+"""
+
+from .gateway import (  # noqa: F401
+    DECISION_ADMIT,
+    DECISION_QUEUE,
+    DECISION_SHED,
+    GW_ROUTABLE_INDEX,
+    Gateway,
+    GatewayConfig,
+    GatewayStats,
+    InformerDiscovery,
+    Replica,
+    TIER_BATCH,
+    TIER_INTERACTIVE,
+    TIER_STANDARD,
+    TIERS,
+    Ticket,
+    add_routable_index,
+    engine_replica,
+    job_stats_publisher,
+    routable_pod,
+    tcp_replica,
+)

@@ -1,0 +1,86 @@
+"""Executed gateway front door: JSON-lines TCP over a static replica set.
+
+    python -m kubeflow_controller_tpu.gateway \
+        --port 8600 --replica r0=127.0.0.1:8500 --replica r1=127.0.0.1:8501
+
+Request:  {"id": "r1", "prompt": [1,2,3], "max_new": 16,
+           "session": "conv-7", "tier": "interactive"}
+Response: {"id": "r1", "tokens": [...], "ttft_ms": ..., "error": "",
+           "replica": "r0", "decision": "admitted"}
+
+The in-cluster path wires discovery through the pod informer instead
+(gateway.InformerDiscovery); this entrypoint is the standalone front
+door for smoke tests and single-host deployments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socketserver
+import sys
+from typing import List, Optional
+
+from ..workloads.serve import Request
+from .gateway import Gateway, GatewayConfig, tcp_replica
+
+ENV_GW_PORT = "KCTPU_GW_PORT"
+DEFAULT_GW_PORT = 8600
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="kctpu-gateway")
+    p.add_argument("--port", type=int,
+                   default=int(os.environ.get(ENV_GW_PORT, DEFAULT_GW_PORT)))
+    p.add_argument("--replica", action="append", default=[],
+                   metavar="NAME=HOST:PORT",
+                   help="backend serve replica (repeatable)")
+    p.add_argument("--slo-ttft-ms", type=float, default=2000.0)
+    args = p.parse_args(argv)
+
+    gw = Gateway(GatewayConfig(slo_ttft_ms=args.slo_ttft_ms))
+    for spec in args.replica:
+        name, _, addr = spec.partition("=")
+        host, _, port = addr.partition(":")
+        gw.register(tcp_replica(name, host or "127.0.0.1", int(port)))
+    gw.start()
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            for line in self.rfile:
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    continue
+                req = Request(id=str(msg.get("id", "")),
+                              tokens=list(msg.get("prompt", [0])),
+                              max_new_tokens=int(msg.get("max_new", 8)),
+                              session=str(msg.get("session", "")),
+                              tier=str(msg.get("tier", "standard")))
+                ticket = gw.route(req)
+                req.done.wait()
+                out = {"id": req.id, "tokens": req.output,
+                       "ttft_ms": round(req.ttft_s * 1e3, 3),
+                       "error": req.error, "replica": ticket.replica,
+                       "decision": ticket.decision}
+                self.wfile.write(json.dumps(out).encode() + b"\n")
+                self.wfile.flush()
+
+    class Server(socketserver.ThreadingTCPServer):
+        daemon_threads = True
+        allow_reuse_address = True
+
+    srv = Server(("127.0.0.1", args.port), Handler)
+    print(f"gateway on 127.0.0.1:{srv.server_address[1]} "
+          f"({len(gw.replica_names())} replicas)", flush=True)
+    try:
+        srv.serve_forever(poll_interval=0.1)
+    finally:
+        gw.stop()
+        srv.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,698 @@
+"""Request-routing gateway: the serving plane's cluster-level data plane.
+
+"Heavy traffic from millions of users" (PAPER.md) enters here instead of
+per-replica sockets.  The gateway promotes Sebulba's ingest/accelerator
+decoupling (PAPERS.md, Podracer) from the process level — where
+workloads/serve.py already splits intake from the decode loop — to the
+cluster level: one front door that knows every replica's live load and
+every conversation's cache residency.
+
+Four cooperating pieces, one pump thread:
+
+- **Discovery** — the routing set mirrors the pod informer's routable
+  index (:data:`GW_ROUTABLE_INDEX`): Serving pods that are Running, not
+  deleting, and NOT drain-annotated.  A draining replica therefore
+  leaves the routing set the moment the controller stamps the
+  annotation — before the replica even sees it, and long before its
+  DRAIN-ACK — so rolling updates never route onto a dying backend.
+- **Routing** — least-loaded over the progress plane's queue-depth /
+  occupancy gauges plus the gateway's own not-yet-visible in-flight
+  count; session affinity pins a conversation to the replica whose
+  slot-paged KV cache holds its prefix (workloads/serve.py
+  ``prefix_cache``), and re-homes when that replica drains.
+- **Admission** — priority tiers with an SLO-aware state machine
+  (ADMIT -> QUEUE -> SHED per tier): pressure is the max of live
+  demand/capacity and windowed end-to-end p99 TTFT against the
+  ``serving-ttft-p99`` objective threshold (obs/slo.py), so low tiers
+  queue and then shed BEFORE the high tier's latency burns the error
+  budget.
+- **Signal** — a stats snapshot (routed qps, gateway-queued depth, shed
+  rate per tier, prefix-hit ratio, per-replica weights) published as the
+  Serving TFJob's gateway-stats annotation; the autoscaler folds
+  queued + shed into its scale signal so shedding cannot mask a needed
+  scale-up, and ``kctpu get/top/describe`` render it.
+
+Every routed request joins the job's causal trace: the gateway allocates
+the ``gw/route`` span id up front and hands it to the replica as the
+request's ``trace_parent``, so ``gw/route`` -> ``serve/request`` -> the
+queue/prefill/decode children form ONE connected tree.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api.labels import (ANNOTATION_DRAIN, LABEL_JOB_NAME, LABEL_JOB_TYPE)
+from ..obs import trace
+from ..obs.metrics import REGISTRY
+from ..utils import locks
+from ..workloads.serve import Request, SubmitResult, _pct
+
+# Priority tiers, highest first.  Unknown tier names route as standard.
+TIER_INTERACTIVE = "interactive"
+TIER_STANDARD = "standard"
+TIER_BATCH = "batch"
+TIERS: Tuple[str, ...] = (TIER_INTERACTIVE, TIER_STANDARD, TIER_BATCH)
+
+# Admission outcomes (Ticket.decision).
+DECISION_ADMIT = "admitted"
+DECISION_QUEUE = "queued"
+DECISION_SHED = "shed"
+
+# Engine-side errors that mean "this replica is gone, re-route the
+# request NOW" (zero drops across a drain: the sequence was never
+# started, so a fresh clone on a sibling loses nothing).
+_REROUTABLE = frozenset({"rerouted", "draining", "stopped"})
+
+#: Informer index of routable serving pods (see :func:`routable_pod`).
+GW_ROUTABLE_INDEX = "gateway-routable"
+
+
+@dataclass
+class GatewayConfig:
+    # serving-ttft-p99 objective threshold (obs/slo.py default catalogue).
+    slo_ttft_ms: float = 2000.0
+    # Rolling window for observed TTFT / qps / shed-rate.
+    window_s: float = 5.0
+    # Gateway holding-queue bound; overflow sheds the lowest tier first.
+    max_queue: int = 512
+    # Per-tier pressure thresholds (pressure = max(demand/capacity,
+    # p99_ttft/slo)): at queue_at the tier stops routing and holds in the
+    # gateway queue; at shed_at it is refused outright.  The high tier's
+    # thresholds are far above any survivable overload on purpose — it
+    # sheds only when the plane has collapsed.
+    queue_at: Dict[str, float] = field(default_factory=lambda: {
+        TIER_INTERACTIVE: 4.0, TIER_STANDARD: 1.6, TIER_BATCH: 0.95})
+    shed_at: Dict[str, float] = field(default_factory=lambda: {
+        TIER_INTERACTIVE: 8.0, TIER_STANDARD: 3.0, TIER_BATCH: 1.3})
+    # Session -> replica affinity (prefix-cache locality).  Falls back to
+    # least-loaded when the pinned replica is gone, draining, or hotter
+    # than the coldest replica by more than the spill margin.
+    affinity: bool = True
+    affinity_spill: float = 2.0   # pinned.load > coldest.load + spill => spill
+    # Pump cadence (dispatch + completion scan + gauge refresh).
+    tick_s: float = 0.002
+    # Stats-annotation publish cadence.
+    publish_s: float = 0.5
+
+
+def _tier_of(name: str) -> str:
+    return name if name in TIERS else TIER_STANDARD
+
+
+class Replica:
+    """One routable backend: a submit callable plus a live-gauges callable
+    (progress-plane beat fields).  ``pending`` is the gateway's own
+    routed-but-unfinished count — it covers the beat-interval blind spot
+    where a burst routed this tick is not yet in any published gauge."""
+
+    def __init__(self, name: str,
+                 submit: Callable[[Request], SubmitResult],
+                 gauges: Optional[Callable[[], Dict]] = None):
+        self.name = name
+        self._submit = submit
+        self._gauges = gauges or (lambda: {})
+        self.pending = 0
+        self.routed_total = 0
+        self.draining = False
+
+    def submit(self, req: Request) -> SubmitResult:
+        return self._submit(req)
+
+    def gauges(self) -> Dict:
+        try:
+            return self._gauges() or {}
+        except Exception:  # noqa: BLE001 - a dead gauge must not stop routing
+            return {}
+
+    def load(self) -> float:
+        g = self.gauges()
+        cap = max(1, int(g.get("slots_total", 1) or 1))
+        return (int(g.get("queue_depth", 0)) + int(g.get("slots_used", 0))
+                + self.pending) / cap
+
+
+def engine_replica(name: str, engine) -> Replica:
+    """In-process replica handle over a workloads.serve.ServeEngine
+    (benches/tests — the executed path uses :func:`tcp_replica`)."""
+    return Replica(name, engine.submit, lambda: engine.stats().as_beat())
+
+
+def tcp_replica(name: str, host: str, port: int,
+                gauges: Optional[Callable[[], Dict]] = None,
+                timeout_s: float = 60.0) -> Replica:
+    """Replica handle over a serve replica's JSON-lines TCP socket.  The
+    submit is asynchronous (one connection thread per request); transport
+    failure surfaces as a ``draining`` refusal so the pump re-routes."""
+    import socket
+
+    def submit(req: Request) -> SubmitResult:
+        def worker():
+            try:
+                with socket.create_connection((host, port),
+                                              timeout=timeout_s) as sock:
+                    msg = {"id": req.id, "prompt": req.tokens,
+                           "max_new": req.max_new_tokens,
+                           "session": req.session, "tier": req.tier,
+                           "trace_parent": req.trace_parent}
+                    sock.sendall(json.dumps(msg).encode() + b"\n")
+                    buf = b""
+                    sock.settimeout(timeout_s)
+                    while not buf.endswith(b"\n"):
+                        chunk = sock.recv(65536)
+                        if not chunk:
+                            break
+                        buf += chunk
+                out = json.loads(buf) if buf.strip() else {}
+                req.output.extend(out.get("tokens", ()))
+                req.error = str(out.get("error", "") or "")
+                if not req.error:
+                    req.first_token_t = (req.submit_t
+                                         + out.get("ttft_ms", 0.0) / 1e3)
+            except (OSError, ValueError):
+                req.error = "draining"   # transport loss: re-route now
+            req.finish_t = req.finish_t or time.monotonic()
+            req.done.set()
+
+        threading.Thread(target=worker, name=f"gw-fwd-{name}",
+                         daemon=True).start()
+        return SubmitResult(True)
+
+    return Replica(name, submit, gauges)
+
+
+@dataclass
+class GatewayStats:
+    """One gateway snapshot — the annotation payload and CLI surface."""
+
+    routed_total: int = 0
+    routed_qps: float = 0.0
+    queued: int = 0
+    shed: Dict[str, int] = field(default_factory=dict)   # per tier, total
+    shed_rps: float = 0.0          # sheds/sec over the window
+    rerouted: int = 0              # drain re-homes (zero-drop machinery)
+    affinity_hits: int = 0
+    affinity_misses: int = 0
+    prefix_hit_ratio: float = 0.0  # routed-weighted mean over replicas
+    ttft_p99_ms: float = 0.0       # end-to-end, through the gateway
+    replicas: int = 0
+    weights: Dict[str, float] = field(default_factory=dict)
+    pressure: float = 0.0
+    ts: float = 0.0                # wall clock of the snapshot
+
+    def as_annotation(self) -> str:
+        return json.dumps({
+            "qps": round(self.routed_qps, 3),
+            "queued": self.queued,
+            "shed": dict(self.shed),
+            "shed_rps": round(self.shed_rps, 3),
+            "rerouted": self.rerouted,
+            "prefix_hit_ratio": round(self.prefix_hit_ratio, 4),
+            "ttft_p99_ms": round(self.ttft_p99_ms, 3),
+            "replicas": self.replicas,
+            "weights": {k: round(v, 4) for k, v in self.weights.items()},
+            "pressure": round(self.pressure, 4),
+            "ts": round(self.ts, 3),
+        }, sort_keys=True)
+
+
+@dataclass
+class Ticket:
+    """The caller's handle for one routed request: wait on
+    ``request.done``, then read ``replica``/``decision``."""
+
+    request: Request
+    decision: str
+    tier: str
+    replica: str = ""
+    attempts: int = 0
+
+
+class _Flight:
+    __slots__ = ("ticket", "eng_req", "replica", "span_id", "route_t",
+                 "route_wall")
+
+    def __init__(self, ticket: Ticket, eng_req: Request, replica: Replica,
+                 span_id: str, route_t: float):
+        self.ticket = ticket
+        self.eng_req = eng_req
+        self.replica = replica
+        self.span_id = span_id
+        self.route_t = route_t
+        self.route_wall = time.time()
+
+
+class Gateway:
+    """The front door.  ``route()`` may be called from any thread; one
+    pump thread owns dispatch of queued tickets, completion accounting,
+    re-routing off drained replicas, and stats publication."""
+
+    def __init__(self, config: Optional[GatewayConfig] = None,
+                 publisher: Optional[Callable[[str], None]] = None):
+        self.config = config or GatewayConfig()
+        self._publisher = publisher
+        self._lock = locks.named_lock("gateway.core")
+        self._replicas: Dict[str, Replica] = {}
+        self._affinity: Dict[str, str] = {}        # session -> replica name
+        self._queue: List[Tuple[Ticket, float]] = []   # (ticket, enq_t)
+        self._flights: List[_Flight] = []
+        self._routed_total = 0
+        self._rerouted = 0
+        self._affinity_hits = 0
+        self._affinity_misses = 0
+        self._shed: Dict[str, int] = {}
+        # (t, ttft_s) of completions / (t,) of sheds — pressure inputs.
+        self._ttft_window: List[Tuple[float, float]] = []
+        self._shed_window: List[float] = []
+        self._done_window: List[float] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_publish = 0.0
+        self._trace_ctx = trace.TRACER.current_context()
+        self._m_routed = REGISTRY.counter(
+            "kctpu_gw_routed_total",
+            "Requests routed to a serving replica, by admission tier",
+            ("tier",))
+        self._m_shed = REGISTRY.counter(
+            "kctpu_gw_shed_total",
+            "Requests shed by SLO-aware admission, by tier", ("tier",))
+        self._m_rerouted = REGISTRY.counter(
+            "kctpu_gw_rerouted_total",
+            "Requests re-routed off a draining replica (zero-drop drain)")
+        self._m_queued = REGISTRY.gauge(
+            "kctpu_gw_queued",
+            "Requests held in the gateway's admission queue")
+        self._m_replicas = REGISTRY.gauge(
+            "kctpu_gw_replicas", "Replicas in the routing set")
+        self._m_aff_hit = REGISTRY.counter(
+            "kctpu_gw_affinity_hits_total",
+            "Session-affinity routes that landed on the pinned replica")
+        self._m_aff_miss = REGISTRY.counter(
+            "kctpu_gw_affinity_misses_total",
+            "Session routes that re-homed (cold, drained, or spilled)")
+        self._m_prefix = REGISTRY.gauge(
+            "kctpu_gw_prefix_hit_ratio",
+            "Routed-weighted mean prefix-cache hit ratio over the "
+            "routing set")
+        self._m_ttft = REGISTRY.histogram(
+            "kctpu_gw_ttft_seconds",
+            "End-to-end time-to-first-token through the gateway, by tier",
+            ("tier",))
+        self._m_queued.set_function(lambda: len(self._queue))
+        self._m_replicas.set_function(lambda: len(self._replicas))
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._pump, name="gw-pump",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    # -- routing set --------------------------------------------------------
+
+    def register(self, replica: Replica) -> None:
+        with self._lock:
+            self._replicas[replica.name] = replica
+
+    def deregister(self, name: str) -> None:
+        """Remove a replica from the routing set (drain/deletion).  Its
+        sessions re-home: the next request of each pinned conversation
+        falls back to least-loaded and re-pins there."""
+        with self._lock:
+            self._replicas.pop(name, None)
+            for sess in [s for s, r in self._affinity.items() if r == name]:
+                del self._affinity[sess]
+
+    def set_draining(self, name: str, draining: bool = True) -> None:
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is not None:
+                r.draining = draining
+
+    def replica_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    # -- admission + routing ------------------------------------------------
+
+    def route(self, req: Request, tier: Optional[str] = None) -> Ticket:
+        """Admit one request: SHED (done fires immediately, error
+        ``shed``), QUEUE (held until pressure drops), or ADMIT (dispatched
+        now).  The caller waits on ``ticket.request.done``."""
+        req.submit_t = req.submit_t or time.monotonic()
+        req.tier = _tier_of(tier or req.tier)
+        p = self.pressure()
+        cfg = self.config
+        ticket = Ticket(req, DECISION_ADMIT, req.tier)
+        if p >= cfg.shed_at.get(req.tier, 3.0):
+            self._shed_one(ticket)
+            return ticket
+        if p >= cfg.queue_at.get(req.tier, 1.6):
+            return self._enqueue(ticket)
+        if not self._dispatch(ticket):
+            return self._enqueue(ticket)
+        return ticket
+
+    def _enqueue(self, ticket: Ticket) -> Ticket:
+        ticket.decision = DECISION_QUEUE
+        with self._lock:
+            self._queue.append((ticket, time.monotonic()))
+            if len(self._queue) > self.config.max_queue:
+                # Overflow: shed the youngest request of the LOWEST tier.
+                victim_i = max(
+                    range(len(self._queue)),
+                    key=lambda i: (TIERS.index(self._queue[i][0].tier),
+                                   self._queue[i][1]))
+                victim, _ = self._queue.pop(victim_i)
+            else:
+                victim = None
+        if victim is not None:
+            self._shed_one(victim)
+        return ticket
+
+    def _shed_one(self, ticket: Ticket) -> None:
+        ticket.decision = DECISION_SHED
+        with self._lock:
+            self._shed[ticket.tier] = self._shed.get(ticket.tier, 0) + 1
+            self._shed_window.append(time.monotonic())
+        self._m_shed.labels(ticket.tier).inc()
+        ticket.request.error = "shed"
+        ticket.request.finish_t = time.monotonic()
+        ticket.request.done.set()
+
+    def _pick(self, req: Request) -> Optional[Replica]:
+        """Least-loaded routable replica, with session affinity: a pinned
+        conversation re-hits the replica holding its prefix pages unless
+        that replica drained or is hotter than the coldest by the spill
+        margin (cache locality must not defeat load balance)."""
+        cfg = self.config
+        with self._lock:
+            live = [r for r in self._replicas.values() if not r.draining]
+            if not live:
+                return None
+            coldest = min(live, key=lambda r: (r.load(), r.name))
+            chosen = coldest
+            if cfg.affinity and req.session:
+                pinned = self._replicas.get(
+                    self._affinity.get(req.session, ""))
+                if (pinned is not None and not pinned.draining
+                        and pinned.load() <= coldest.load()
+                        + cfg.affinity_spill):
+                    chosen = pinned
+                    self._affinity_hits += 1
+                    self._m_aff_hit.inc()
+                else:
+                    self._affinity[req.session] = chosen.name
+                    self._affinity_misses += 1
+                    self._m_aff_miss.inc()
+            chosen.pending += 1
+            chosen.routed_total += 1
+        return chosen
+
+    def _dispatch(self, ticket: Ticket) -> bool:
+        """Try every routable replica once; False = nothing accepted (the
+        ticket belongs in the gateway queue)."""
+        req = ticket.request
+        for _ in range(max(1, len(self._replicas))):
+            replica = self._pick(req)
+            if replica is None:
+                return False
+            span_id = trace.new_span_id() if self._trace_ctx else ""
+            eng_req = Request(
+                id=req.id, tokens=list(req.tokens),
+                max_new_tokens=req.max_new_tokens,
+                submit_t=req.submit_t, session=req.session,
+                tier=req.tier, trace_parent=span_id)
+            res = replica.submit(eng_req)
+            ticket.attempts += 1
+            if res:
+                flight = _Flight(ticket, eng_req, replica, span_id,
+                                 time.monotonic())
+                with self._lock:
+                    self._routed_total += 1
+                    self._flights.append(flight)
+                self._m_routed.labels(ticket.tier).inc()
+                ticket.replica = replica.name
+                ticket.decision = DECISION_ADMIT
+                return True
+            with self._lock:
+                replica.pending -= 1
+            if res.reason == "draining":
+                # The replica refused before its DRAIN-ACK: it leaves the
+                # routing set NOW (sessions re-home) and the request
+                # retries the next replica immediately.
+                self.set_draining(replica.name)
+                self.deregister(replica.name)
+                continue
+            # overloaded: back off into the gateway queue, don't hammer.
+            return False
+        return False
+
+    # -- the pump -----------------------------------------------------------
+
+    def _pump(self) -> None:
+        while not self._stop.wait(self.config.tick_s):
+            self._scan_flights()
+            self._drain_queue()
+            self._refresh_gauges()
+            self._maybe_publish()
+        self._scan_flights()
+
+    def _scan_flights(self) -> None:
+        with self._lock:
+            done = [f for f in self._flights if f.eng_req.done.is_set()]
+            if done:
+                self._flights = [f for f in self._flights
+                                 if not f.eng_req.done.is_set()]
+        for f in done:
+            with self._lock:
+                f.replica.pending = max(0, f.replica.pending - 1)
+            if f.eng_req.error in _REROUTABLE:
+                # Drained out from under us before admission: the
+                # sequence never started, so re-dispatch a fresh clone —
+                # in-flight work finishes on the old replica, queued work
+                # re-homes here.  Zero drops across a rolling update.
+                self.set_draining(f.replica.name)
+                self.deregister(f.replica.name)
+                with self._lock:
+                    self._rerouted += 1
+                self._m_rerouted.inc()
+                if not self._dispatch(f.ticket):
+                    self._enqueue(f.ticket)
+                continue
+            self._finalize(f)
+
+    def _finalize(self, f: _Flight) -> None:
+        req, eng = f.ticket.request, f.eng_req
+        req.output[:] = eng.output
+        req.error = eng.error
+        req.admit_t = eng.admit_t
+        req.first_token_t = eng.first_token_t
+        req.finish_t = eng.finish_t or time.monotonic()
+        now = time.monotonic()
+        ttft = max(0.0, (eng.first_token_t or req.finish_t) - req.submit_t)
+        with self._lock:
+            self._ttft_window.append((now, ttft))
+            self._done_window.append(now)
+        if not eng.error:
+            self._m_ttft.labels(f.ticket.tier).observe(ttft)
+        if self._trace_ctx is not None and f.span_id:
+            trace.add_span(
+                "gw/route", f.route_wall,
+                max(0.0, req.finish_t - f.route_t), ctx=self._trace_ctx,
+                span_id=f.span_id, request=req.id, replica=f.replica.name,
+                tier=f.ticket.tier, outcome=req.error or "ok")
+        req.done.set()
+
+    def _drain_queue(self) -> None:
+        """Promote queued tickets whose tier's pressure band allows
+        routing again, highest tier first / FIFO within a tier; shed the
+        ones whose tier crossed its shed threshold while waiting."""
+        cfg = self.config
+        p = self.pressure()
+        with self._lock:
+            if not self._queue:
+                return
+            ordered = sorted(self._queue,
+                             key=lambda it: (TIERS.index(it[0].tier), it[1]))
+            self._queue = []
+        requeue: List[Tuple[Ticket, float]] = []
+        for ticket, enq_t in ordered:
+            if p >= cfg.shed_at.get(ticket.tier, 3.0):
+                self._shed_one(ticket)
+            elif p >= cfg.queue_at.get(ticket.tier, 1.6):
+                requeue.append((ticket, enq_t))
+            elif not self._dispatch(ticket):
+                requeue.append((ticket, enq_t))
+        if requeue:
+            with self._lock:
+                self._queue = requeue + self._queue
+
+    def _refresh_gauges(self) -> None:
+        with self._lock:
+            reps = list(self._replicas.values())
+        routed = sum(r.routed_total for r in reps) or 1
+        ratio = sum(float(r.gauges().get("prefix_hit_ratio", 0.0))
+                    * r.routed_total for r in reps) / routed
+        self._m_prefix.set(ratio)
+
+    def _maybe_publish(self) -> None:
+        if self._publisher is None:
+            return
+        now = time.monotonic()
+        if now - self._last_publish < self.config.publish_s:
+            return
+        self._last_publish = now
+        try:
+            self._publisher(self.stats().as_annotation())
+        except Exception:  # noqa: BLE001 - publishing is advisory
+            pass
+
+    # -- pressure + stats ---------------------------------------------------
+
+    def _trim_windows_locked(self, now: float) -> None:
+        cutoff = now - self.config.window_s
+        self._ttft_window = [w for w in self._ttft_window if w[0] >= cutoff]
+        self._shed_window = [t for t in self._shed_window if t >= cutoff]
+        self._done_window = [t for t in self._done_window if t >= cutoff]
+
+    def pressure(self) -> float:
+        """max(live demand / capacity, windowed p99 TTFT / SLO) — the
+        admission state machine's one input."""
+        now = time.monotonic()
+        with self._lock:
+            self._trim_windows_locked(now)
+            ttfts = sorted(t for _, t in self._ttft_window)
+            reps = [r for r in self._replicas.values() if not r.draining]
+            queued = len(self._queue)
+        cap = sum(max(1, int(r.gauges().get("slots_total", 1) or 1))
+                  for r in reps)
+        demand = queued + sum(
+            int(r.gauges().get("queue_depth", 0))
+            + int(r.gauges().get("slots_used", 0)) + r.pending
+            for r in reps)
+        load_p = demand / cap if cap else (2.0 if queued else 0.0)
+        slo_p = (_pct(ttfts, 0.99) * 1e3 / self.config.slo_ttft_ms
+                 if ttfts else 0.0)
+        return max(load_p, slo_p)
+
+    def stats(self) -> GatewayStats:
+        now = time.monotonic()
+        pressure = self.pressure()
+        with self._lock:
+            self._trim_windows_locked(now)
+            ttfts = sorted(t for _, t in self._ttft_window)
+            span = max(0.25, self.config.window_s)
+            reps = list(self._replicas.values())
+            routed = sum(r.routed_total for r in reps)
+            weights = {}
+            if routed:
+                weights = {r.name: r.routed_total / routed for r in reps}
+            hit_w = sum(float(r.gauges().get("prefix_hit_ratio", 0.0))
+                        * r.routed_total for r in reps) / max(1, routed)
+            return GatewayStats(
+                routed_total=self._routed_total,
+                routed_qps=round(len(self._done_window) / span, 3),
+                queued=len(self._queue),
+                shed=dict(self._shed),
+                shed_rps=round(len(self._shed_window) / span, 3),
+                rerouted=self._rerouted,
+                affinity_hits=self._affinity_hits,
+                affinity_misses=self._affinity_misses,
+                prefix_hit_ratio=round(hit_w, 4),
+                ttft_p99_ms=round(_pct(ttfts, 0.99) * 1e3, 3),
+                replicas=len(reps),
+                weights=weights,
+                pressure=round(pressure, 4),
+                ts=time.time(),
+            )
+
+
+# ---------------------------------------------------------------------------
+# Informer-driven discovery
+# ---------------------------------------------------------------------------
+
+def routable_pod(pod) -> bool:
+    """A pod the gateway may route to: a Serving replica that is Running,
+    not terminating, and not drain-annotated — the drain annotation pulls
+    it from the routing set BEFORE the replica acks the drain."""
+    meta = pod.metadata
+    return (meta.labels.get(LABEL_JOB_TYPE) == "Serving"
+            and pod.status.phase == "Running"
+            and meta.deletion_timestamp is None
+            and ANNOTATION_DRAIN not in meta.annotations)
+
+
+def add_routable_index(informer) -> None:
+    """Register :data:`GW_ROUTABLE_INDEX` on a pod informer: routable
+    serving pods keyed by owning job ``namespace/tf_job_name``."""
+
+    def fn(pod) -> List[str]:
+        if not routable_pod(pod):
+            return []
+        job = pod.metadata.labels.get(LABEL_JOB_NAME, "")
+        return [f"{pod.metadata.namespace}/{job}"] if job else []
+
+    informer.add_indexer(GW_ROUTABLE_INDEX, fn)
+
+
+class InformerDiscovery:
+    """Mirrors one job's routable index into a gateway's routing set.
+    ``factory(pod) -> Replica`` builds the transport handle (tcp_replica
+    for executed pods, engine_replica in benches)."""
+
+    def __init__(self, gateway: Gateway, informer, namespace: str,
+                 job: str, factory: Callable[[object], Replica]):
+        self.gateway = gateway
+        self.informer = informer
+        self.key = f"{namespace}/{job}"
+        self.factory = factory
+        if GW_ROUTABLE_INDEX not in getattr(informer, "_indexers", {}):
+            add_routable_index(informer)
+        informer.add_event_handler(
+            on_add=lambda obj: self.sync(),
+            on_update=lambda old, new: self.sync(),
+            on_delete=lambda obj: self.sync())
+        self.sync()
+
+    def sync(self) -> None:
+        want = {p.metadata.name: p
+                for p in self.informer.by_index(GW_ROUTABLE_INDEX, self.key)}
+        have = set(self.gateway.replica_names())
+        for name in have - set(want):
+            # Left the index: deleted, drain-annotated, or no longer
+            # Running.  Mark draining so in-flight accounting still
+            # resolves, then pull it from the routing set (sessions
+            # re-home on their next request).
+            self.gateway.set_draining(name)
+            self.gateway.deregister(name)
+        for name in set(want) - have:
+            self.gateway.register(self.factory(want[name]))
+
+
+def job_stats_publisher(cluster, namespace: str, job: str,
+                        ) -> Callable[[str], None]:
+    """Publisher writing the gateway snapshot to the Serving TFJob's
+    gateway-stats annotation (the autoscaler's shed-aware signal and the
+    CLI's gateway surface)."""
+    from ..api.labels import ANNOTATION_GATEWAY_STATS
+
+    def publish(payload: str) -> None:
+        def setter(meta):
+            meta.annotations[ANNOTATION_GATEWAY_STATS] = payload
+
+        try:
+            cluster.tfjobs.patch_meta(namespace, job, setter)
+        except Exception:  # noqa: BLE001 - stats are advisory, never fatal
+            pass
+
+    return publish

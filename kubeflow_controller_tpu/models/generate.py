@@ -402,6 +402,95 @@ def paged_prefill(
     return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
 
 
+def copy_cache_rows(cache: Cache, src_rows: jax.Array,
+                    dst_rows: jax.Array) -> Cache:
+    """Copy physical rows ``src_rows`` -> ``dst_rows`` in the paged pool —
+    the copy-on-write primitive behind cross-request prefix sharing: a new
+    request whose prompt diverges mid-page gets the shared page's matched
+    rows copied into a private page, then prefills only the divergent
+    tail.  K rows are written pre-rotated at absolute positions and V rows
+    are position-independent, so a row copy is exact for any destination
+    page holding the same logical positions.  Shapes are static in the row
+    count (callers pad with scratch row 0 -> 0, a harmless self-copy), so
+    one compiled program serves every copy."""
+    return {name: arr.at[:, dst_rows].set(arr[:, src_rows])
+            for name, arr in cache.items()}
+
+
+def paged_extend(
+    params,
+    tokens: jax.Array,
+    cache: Cache,
+    write_rows: jax.Array,
+    read_rows: jax.Array,
+    start_pos,
+    plen,
+    cfg: LlamaConfig,
+    rules: ShardingRules = DEFAULT_RULES,
+) -> Tuple[jax.Array, Cache]:
+    """Prefill ONE prompt's divergent TAIL on top of a shared prefix.
+
+    The prefix-sharing admission path (workloads/serve.py): the slot's
+    first ``start_pos`` positions are already resident in the pool (shared
+    refcounted pages + an optional copy-on-write page), so only the tail
+    is computed.  ``tokens`` [1, T] is the tail padded to a bucket length;
+    ``write_rows`` [T] maps tail position j (absolute ``start_pos + j``)
+    to its physical row (scratch row 0 for padding positions >= ``plen``,
+    the real tail length); ``read_rows`` [S] maps every logical position
+    of the slot to its physical row through the page table, scratch for
+    unallocated blocks — the causal length mask never reads those.  Each
+    layer writes the tail's K/V first, then attends through ``read_rows``
+    against prefix + tail together (write-then-gather keeps the in-flight
+    tail bit-identical to the unshared dense-prefill path when the cache
+    dtype equals the compute dtype).  The compiled program is static in
+    (bucket, S): one program per tail bucket, shared by every prefix
+    split.  Returns (last real tail position's logits [vocab] f32,
+    updated cache)."""
+    dtype = jnp.dtype(cfg.dtype)
+    _, T = tokens.shape
+    S = read_rows.shape[0]
+    repeats = cfg.n_heads // cfg.n_kv_heads
+    tbl = with_logical_constraint(params["embed"].astype(dtype),
+                                  (None, None), rules)
+    x = tbl[tokens]
+    q_pos = start_pos + jnp.arange(T)                        # [T]
+    angles = rope_freqs(cfg, q_pos)
+    # Causal over LOGICAL positions: tail position start+j attends to
+    # logical positions <= start+j (prefix + the tail up to itself).
+    mask = (jnp.arange(S)[None, :] <= q_pos[:, None])[None, None, :, :]
+
+    def layer(carry, scanned):
+        x, kc_all, vc_all = carry
+        lp, li = scanned
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dtype))
+        k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dtype))
+        v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dtype))
+        q = apply_rope(q, angles)
+        k = apply_rope(k, angles)
+        kc_all = kc_all.at[li, write_rows].set(k[0].astype(kc_all.dtype))
+        vc_all = vc_all.at[li, write_rows].set(v[0].astype(vc_all.dtype))
+        # Read prefix + just-written tail through the page table.
+        kk = kc_all[li][read_rows][None].astype(dtype)       # [1,S,kvH,hd]
+        vv = vc_all[li][read_rows][None].astype(dtype)
+        if repeats > 1:
+            kk = jnp.repeat(kk, repeats, axis=2)
+            vv = jnp.repeat(vv, repeats, axis=2)
+        attn = _cache_attention_dense(q, kk, vv, mask, rules)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"].astype(dtype))
+        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + ffn_block(h, lp, cfg, rules)
+        return (x, kc_all, vc_all), None
+
+    l_idx = jnp.arange(cfg.n_layers)
+    (x, k_new, v_new), _ = jax.lax.scan(
+        layer, (x, cache["k"], cache["v"]), (params["layers"], l_idx))
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    last = jax.lax.dynamic_index_in_dim(x, plen - 1, 1, keepdims=False)[0]
+    logits = jnp.einsum("d,dv->v", last, params["lm_head"].astype(dtype))
+    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+
+
 def paged_decode_step(
     params,
     tokens: jax.Array,

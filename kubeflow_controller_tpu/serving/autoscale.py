@@ -30,15 +30,45 @@ sleeping.
 
 from __future__ import annotations
 
+import json
 import math
 import time
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..api.core import PHASE_RUNNING, Pod
-from ..api.labels import ANNOTATION_SERVING_REPLICAS
+from ..api.labels import (ANNOTATION_GATEWAY_STATS,
+                          ANNOTATION_SERVING_REPLICAS)
 from ..api.tfjob import ReplicaType, TFJob, serving_spec
 from ..utils import locks
+
+# A gateway-stats annotation older than this is ignored: a dead gateway
+# must not pin the scale signal to its last (possibly panicked) snapshot.
+GATEWAY_STATS_STALE_S = 10.0
+
+
+def gateway_signal(job: TFJob, now: float) -> Tuple[float, str]:
+    """Demand the replicas never see, in queue-depth units: requests held
+    in the gateway's admission queue plus one second's worth of sheds.
+    Raw replica queue depth UNDER-counts once the gateway sheds — the
+    shed traffic left no backlog anywhere — so without this term a
+    shedding gateway masks exactly the overload that needs a scale-up."""
+    raw = job.metadata.annotations.get(ANNOTATION_GATEWAY_STATS, "")
+    if not raw:
+        return 0.0, ""
+    try:
+        d = json.loads(raw)
+    except ValueError:
+        return 0.0, ""
+    ts = float(d.get("ts", 0.0) or 0.0)
+    if ts and now - ts > GATEWAY_STATS_STALE_S:
+        return 0.0, ""
+    queued = max(0, int(d.get("queued", 0) or 0))
+    shed_rps = max(0.0, float(d.get("shed_rps", 0.0) or 0.0))
+    extra = queued + shed_rps
+    if not extra:
+        return 0.0, ""
+    return extra, f"gateway queued {queued} + shed {shed_rps:g}/s"
 
 
 def serving_width(job: TFJob) -> int:
@@ -111,6 +141,8 @@ class ServingAutoscaler:
                 self._below_since.pop(key, None)
             return AutoscaleDecision()
         total_depth = sum(p.status.progress.queue_depth for p in ready)
+        gw_extra, gw_why = gateway_signal(job, t)
+        total_depth += gw_extra
         avg = total_depth / len(ready)
         ratio = avg / a.target_queue_depth
         desired = max(a.min_replicas,
@@ -130,7 +162,8 @@ class ServingAutoscaler:
             return AutoscaleDecision(
                 target=desired,
                 reason=f"queue depth avg {avg:.1f} > target "
-                       f"{a.target_queue_depth:g} (x{ratio:.2f}): "
+                       f"{a.target_queue_depth:g} (x{ratio:.2f}"
+                       + (f"; {gw_why}" if gw_why else "") + f"): "
                        f"{current} -> {desired}")
 
         if ratio < 1.0 - a.tolerance and current > a.min_replicas:

@@ -62,6 +62,7 @@ PHASE_DRAIN = "drain"
 ENV_SERVE_PORT = "KCTPU_SERVE_PORT"
 ENV_SERVE_SLOTS = "KCTPU_SERVE_SLOTS"
 ENV_SERVE_MAX_LEN = "KCTPU_SERVE_MAX_LEN"
+ENV_SERVE_PREFIX_CACHE = "KCTPU_SERVE_PREFIX_CACHE"
 
 DEFAULT_SERVE_PORT = 8500
 
@@ -83,6 +84,16 @@ class ServeConfig:
     cont_batch: bool = True
     # Rolling window for qps/TTFT/ITL stats.
     stats_window_s: float = 5.0
+    # Cross-request prefix page sharing: finished sequences retain their
+    # full KV pages in a page-granular trie; admission of a known prefix
+    # refcount-shares the resident pages and prefills only the divergent
+    # tail (copy-on-write for a mid-page divergence).  Off by default —
+    # retention changes the free-page accounting the static baselines
+    # assert on.
+    prefix_cache: bool = False
+    # Intake bound: submit() refuses (overloaded) once the unadmitted
+    # queue reaches this depth.  0 = unbounded.
+    max_queue: int = 0
 
     def bucket_for(self, prompt_len: int) -> int:
         """Smallest configured bucket holding ``prompt_len`` (the largest
@@ -96,6 +107,31 @@ class ServeConfig:
         return -(-self.max_len // self.page_size)
 
 
+class SubmitResult:
+    """Typed intake verdict.  Truthiness == accepted, so existing
+    ``if engine.submit(req)`` call sites keep working; refusals carry a
+    ``reason`` the gateway uses to pick a recovery: ``draining`` means
+    "retry another replica NOW", ``overloaded`` means "back off"."""
+
+    __slots__ = ("accepted", "reason")
+
+    def __init__(self, accepted: bool, reason: str = ""):
+        self.accepted = accepted
+        self.reason = reason
+
+    def __bool__(self) -> bool:
+        return self.accepted
+
+    def __repr__(self) -> str:
+        return (f"SubmitResult(accepted={self.accepted}"
+                + (f", reason={self.reason!r})" if self.reason else ")"))
+
+
+SUBMIT_OK = SubmitResult(True)
+REFUSED_DRAINING = SubmitResult(False, "draining")
+REFUSED_OVERLOADED = SubmitResult(False, "overloaded")
+
+
 @dataclass
 class Request:
     """One generation request.  ``tokens`` is the prompt; the engine
@@ -105,6 +141,9 @@ class Request:
     id: str
     tokens: List[int]
     max_new_tokens: int
+    session: str = ""             # affinity key (gateway re-homes on drain)
+    tier: str = "standard"        # admission tier (gateway sheds low first)
+    trace_parent: str = ""        # gw/route span id -> serve/request parent
     submit_t: float = 0.0
     admit_t: float = 0.0          # queue wait = admit_t - submit_t
     first_token_t: float = 0.0    # TTFT = first_token_t - submit_t
@@ -140,10 +179,21 @@ class ServeStats:
     slots_total: int = 0
     phase: str = PHASE_LOAD
     prefill_compiles: int = 0
+    # Prefix-cache effectiveness (all zero when prefix_cache is off).
+    prefix_hits: int = 0
+    prefix_misses: int = 0
+    prefix_reused_tokens: int = 0
+    cow_copies: int = 0
+    prefix_pages: int = 0          # pages resident in the trie
 
     @property
     def occupancy(self) -> float:
         return self.slots_used / self.slots_total if self.slots_total else 0.0
+
+    @property
+    def prefix_hit_ratio(self) -> float:
+        n = self.prefix_hits + self.prefix_misses
+        return self.prefix_hits / n if n else 0.0
 
     def as_beat(self) -> Dict:
         """The serving dict ProgressReporter.beat(serving=...) publishes
@@ -156,6 +206,7 @@ class ServeStats:
             "queue_depth": self.queue_depth,
             "slots_used": self.slots_used,
             "slots_total": self.slots_total,
+            "prefix_hit_ratio": round(self.prefix_hit_ratio, 4),
         }
 
 
@@ -188,8 +239,11 @@ class LlamaBackend:
         self.seed = seed
         self.cache_dir = cache_dir
         self.prefill_compiles = 0   # distinct prefill programs built/loaded
+        self.extend_compiles = 0    # distinct tail-extend programs
         self.compile_sources: List[str] = []  # AOT provenance per program
         self._prefill_fns: Dict[int, object] = {}
+        self._extend_fns: Dict[int, object] = {}
+        self._copy_fn = None
         self._decode_fn = None
         self._params = None
         self._cache = None
@@ -313,6 +367,74 @@ class LlamaBackend:
             self._params, tokens, self._cache, positions, page_tables)
         return [int(t) for t in jnp.argmax(logits, axis=-1)]
 
+    def _build_extend(self, bucket: int):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.generate import paged_extend
+        from .compile_cache import aot_compile
+
+        cfg, sc = self.cfg, self._serve_cfg
+        span = sc.pages_per_slot() * sc.page_size
+
+        def fn(params, tokens, cache, write_rows, read_rows, start_pos,
+               plen):
+            return paged_extend(params, tokens, cache, write_rows,
+                                read_rows, start_pos, plen, cfg)
+
+        jitted = jax.jit(fn)
+        abstract = (
+            jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                self._params),
+            jax.ShapeDtypeStruct((1, bucket), jnp.int32),
+            jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                self._cache),
+            jax.ShapeDtypeStruct((bucket,), jnp.int32),
+            jax.ShapeDtypeStruct((span,), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+        )
+        res = aot_compile(jitted, abstract,
+                          key=self._fingerprint("extend", bucket),
+                          cache_dir=self.cache_dir,
+                          what="serve-extend", donated=False)
+        self.extend_compiles += 1
+        self.compile_sources.append(res.source)
+        return res.compiled
+
+    def extend(self, tokens_padded, write_rows, read_rows,
+               start_pos: int, plen: int) -> int:
+        """Prefill a prompt's divergent TAIL over shared prefix pages ->
+        first sampled token.  ``write_rows`` places the tail, ``read_rows``
+        gathers the slot's FULL logical page span (prefix + tail)."""
+        import jax.numpy as jnp
+
+        bucket = tokens_padded.shape[1]
+        fn = self._extend_fns.get(bucket)
+        if fn is None:
+            fn = self._extend_fns[bucket] = self._build_extend(bucket)
+        logits, self._cache = fn(self._params, tokens_padded, self._cache,
+                                 write_rows, read_rows,
+                                 jnp.int32(start_pos), jnp.int32(plen))
+        return int(jnp.argmax(logits))
+
+    def copy_page(self, src_page: int, dst_page: int) -> None:
+        """Copy-on-write: duplicate one physical page before the new
+        sequence overwrites its divergent suffix rows."""
+        import jax
+        import numpy as np
+
+        from ..models.generate import copy_cache_rows
+
+        if self._copy_fn is None:
+            self._copy_fn = jax.jit(copy_cache_rows)
+        ps = self._serve_cfg.page_size
+        src = (src_page * ps + np.arange(ps)).astype(np.int32)
+        dst = (dst_page * ps + np.arange(ps)).astype(np.int32)
+        self._cache = self._copy_fn(self._cache, src, dst)
+
 
 class SyntheticBackend:
     """Deterministic no-model backend for unit tests and control-plane
@@ -323,6 +445,7 @@ class SyntheticBackend:
         self.step_s = step_s
         self.vocab = vocab
         self.prefill_compiles = 0
+        self.extend_compiles = 0
         self._buckets: set = set()
 
     def load(self, serve_cfg: ServeConfig) -> None:
@@ -337,6 +460,22 @@ class SyntheticBackend:
             time.sleep(self.step_s)
         return (int(tokens_padded[0][plen - 1]) + plen) % self.vocab
 
+    def extend(self, tokens_padded, write_rows, read_rows,
+               start_pos: int, plen: int) -> int:
+        # Matches prefill's pure function of (last token, total length):
+        # a shared-prefix admission is token-identical to a cold one.
+        key = ("extend", tokens_padded.shape[1])
+        if key not in self._buckets:
+            self._buckets.add(key)
+            self.extend_compiles += 1
+        if self.step_s:
+            time.sleep(self.step_s)
+        return ((int(tokens_padded[0][plen - 1]) + int(start_pos) + plen)
+                % self.vocab)
+
+    def copy_page(self, src_page: int, dst_page: int) -> None:
+        pass  # no physical cache to copy
+
     def decode(self, tokens, positions, page_tables) -> List[int]:
         if self.step_s:
             time.sleep(self.step_s)
@@ -349,7 +488,8 @@ class SyntheticBackend:
 # ---------------------------------------------------------------------------
 
 class _Slot:
-    __slots__ = ("req", "position", "pages", "last_token", "last_token_t")
+    __slots__ = ("req", "position", "pages", "last_token", "last_token_t",
+                 "prompt_tokens")
 
     def __init__(self, req: Request, pages: List[int], position: int,
                  last_token: int):
@@ -358,6 +498,25 @@ class _Slot:
         self.position = position      # absolute position of last_token
         self.last_token = last_token
         self.last_token_t = time.monotonic()
+        # Tokens actually resident in the cache (prefix-cache retention
+        # needs the page content keys; None when prefix_cache is off).
+        self.prompt_tokens: Optional[List[int]] = None
+
+
+class _PrefixNode:
+    """One retained KV page in the prefix trie, keyed by the page's token
+    content under its parent.  ``page`` holds one trie ref in the engine's
+    refcount map for as long as the node lives."""
+
+    __slots__ = ("key", "page", "children", "parent", "last_used")
+
+    def __init__(self, key: Tuple[int, ...], page: int, last_used: int,
+                 parent: Optional["_PrefixNode"] = None):
+        self.key = key
+        self.page = page
+        self.children: Dict[Tuple[int, ...], "_PrefixNode"] = {}
+        self.parent = parent
+        self.last_used = last_used
 
 
 class ServeEngine:
@@ -380,6 +539,19 @@ class ServeEngine:
         # Physical free-page list; page 0 is the shared scratch page.
         total_pages = 1 + self.config.slots * self.config.pages_per_slot()
         self._free_pages: List[int] = list(range(1, total_pages))
+        # page -> refcount for every NON-free page: one ref per slot whose
+        # table maps it + one ref while the prefix trie retains it.  A
+        # page returns to _free_pages only at refcount zero, so eviction
+        # can never free a page another slot still reads through.
+        self._page_refs: Dict[int, int] = {}
+        # Prefix trie roots (first-page keys).  Decode thread only.
+        self._prefix_children: Dict[Tuple[int, ...], _PrefixNode] = {}
+        self._prefix_nodes = 0
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._prefix_reused_tokens = 0
+        self._cow_copies = 0
+        self._prefix_evictions = 0
         self._draining = False
         self._stopped = False
         self._ready = threading.Event()
@@ -424,19 +596,23 @@ class ServeEngine:
     def drained(self) -> bool:
         return self._drained.is_set()
 
-    def submit(self, req: Request) -> bool:
-        """Enqueue a request; False when intake is closed (draining/
-        stopped) — the request is untouched so the caller can re-route it
-        to another replica."""
+    def submit(self, req: Request) -> SubmitResult:
+        """Enqueue a request.  The result is falsy when intake is closed —
+        ``REFUSED_DRAINING`` (this replica is going away: retry another
+        one now) or ``REFUSED_OVERLOADED`` (queue at ``max_queue``: back
+        off).  The request is untouched on refusal so the caller can
+        re-route it."""
         req.submit_t = req.submit_t or time.monotonic()
         if len(req.tokens) > self.config.max_len - 1:
             req.tokens = req.tokens[: self.config.max_len - 1]
         with self._lock:
             if self._draining or self._stopped:
-                return False
+                return REFUSED_DRAINING
+            if 0 < self.config.max_queue <= len(self._queue):
+                return REFUSED_OVERLOADED
             self._queue.append(req)
             self._wake.notify()
-        return True
+        return SUBMIT_OK
 
     def drain(self) -> List[Request]:
         """Stop intake; return the not-yet-admitted queue (for the caller
@@ -508,6 +684,11 @@ class ServeEngine:
                 phase=phase,
                 prefill_compiles=getattr(self.backend,
                                          "prefill_compiles", 0),
+                prefix_hits=self._prefix_hits,
+                prefix_misses=self._prefix_misses,
+                prefix_reused_tokens=self._prefix_reused_tokens,
+                cow_copies=self._cow_copies,
+                prefix_pages=self._prefix_nodes,
             )
         return st
 
@@ -567,6 +748,94 @@ class ServeEngine:
         self._steps += 1
         self._free_pages.append(pages[0])
 
+    # -- page refcounting (lock held) ---------------------------------------
+
+    def _alloc_pages_locked(self, n: int) -> Optional[List[int]]:
+        """Pop ``n`` pages at refcount 1, evicting trie-only pages if the
+        free list runs short; None when even eviction can't cover it."""
+        if len(self._free_pages) < n and self.config.prefix_cache:
+            self._evict_prefix_locked(n - len(self._free_pages))
+        if len(self._free_pages) < n:
+            return None
+        pages = [self._free_pages.pop() for _ in range(n)]
+        for p in pages:
+            self._page_refs[p] = 1
+        return pages
+
+    def _unref_page_locked(self, page: int) -> None:
+        r = self._page_refs.get(page, 1) - 1
+        if r <= 0:
+            self._page_refs.pop(page, None)
+            self._free_pages.append(page)
+        else:
+            self._page_refs[page] = r
+
+    def _evict_prefix_locked(self, shortfall: int) -> int:
+        """Free up to ``shortfall`` trie-retained pages, oldest leaves
+        first.  Only refcount-1 (trie-only) leaves are candidates — a
+        page a live slot still maps is pinned by its extra ref, so this
+        can never free memory out from under a running sequence.  Evicting
+        a leaf may expose its parent as the next round's candidate."""
+        freed = 0
+        while freed < shortfall:
+            leaves: List[_PrefixNode] = []
+            stack = list(self._prefix_children.values())
+            while stack:
+                nd = stack.pop()
+                if nd.children:
+                    stack.extend(nd.children.values())
+                elif self._page_refs.get(nd.page, 0) == 1:
+                    leaves.append(nd)
+            if not leaves:
+                break
+            leaves.sort(key=lambda nd: nd.last_used)
+            progressed = False
+            for nd in leaves:
+                if freed >= shortfall:
+                    break
+                owner = (nd.parent.children if nd.parent is not None
+                         else self._prefix_children)
+                owner.pop(nd.key, None)
+                self._prefix_nodes -= 1
+                self._prefix_evictions += 1
+                self._unref_page_locked(nd.page)
+                freed += 1
+                progressed = True
+            if not progressed:
+                break
+        return freed
+
+    def _release_slot_pages_locked(self, slot: _Slot) -> None:
+        """Return a finished slot's pages: with prefix_cache on, full
+        pages are RETAINED into the trie (the slot's ref transfers to the
+        trie node, deduped against pages already there); everything else
+        drops its ref."""
+        cfg = self.config
+        if not cfg.prefix_cache or slot.prompt_tokens is None:
+            for p in slot.pages:
+                self._unref_page_locked(p)
+            return
+        ps = cfg.page_size
+        seq = list(slot.prompt_tokens) + list(slot.req.output)
+        written = min(slot.position, len(seq))  # rows actually in cache
+        full = min(written // ps, len(slot.pages))
+        children = self._prefix_children
+        parent: Optional[_PrefixNode] = None
+        for i in range(full):
+            key = tuple(seq[i * ps:(i + 1) * ps])
+            node = children.get(key)
+            if node is None:
+                node = _PrefixNode(key, slot.pages[i], self._steps, parent)
+                children[key] = node
+                self._prefix_nodes += 1
+                # slot ref transfers to the trie: no unref
+            else:
+                node.last_used = self._steps
+                self._unref_page_locked(slot.pages[i])
+            parent, children = node, node.children
+        for p in slot.pages[full:]:
+            self._unref_page_locked(p)
+
     def _admit(self, np) -> None:
         """Move queued requests into free slots (continuous mode: any
         step; static mode: only when the batch is empty — then fill it)."""
@@ -579,40 +848,115 @@ class ServeEngine:
                 if not cfg.cont_batch and not self._batch_open:
                     return  # static: admission closed until the batch ends
                 req = self._queue.popleft()
-            # Oversized prompts truncate to the largest bucket (the
-            # compiled shape set is closed; max_len bounds output room).
-            bucket = cfg.bucket_for(len(req.tokens))
-            plen = max(1, min(len(req.tokens), bucket))
-            need = -(-plen // cfg.page_size)
+            if not self._admit_one(np, req):
+                return
+
+    def _admit_one(self, np, req: Request) -> bool:
+        """Admit one request: trie-match its prefix (prefix_cache only),
+        allocate pages for the divergent tail, prefill/extend.  False =
+        out of pages — the request went back to the queue head."""
+        cfg = self.config
+        ps = cfg.page_size
+        t = req.tokens
+        # Trie walk over full-page keys.  Cap the match at plen-1: the
+        # final prompt token is never shared, so prefill always has >= 1
+        # tail token to produce the first-token logits from.
+        m = 0            # page-aligned shared prefix length
+        k = 0            # extra tokens matched inside the next page (CoW)
+        shared: List[_PrefixNode] = []
+        cow_src: Optional[_PrefixNode] = None
+        if cfg.prefix_cache:
+            matchable = max(0, len(t) - 1)
+            children = self._prefix_children
+            while m + ps <= matchable:
+                node = children.get(tuple(t[m:m + ps]))
+                if node is None:
+                    break
+                shared.append(node)
+                m += ps
+                children = node.children
+            limit = min(ps, matchable - m)
+            for key, child in children.items():
+                c = 0
+                while c < limit and key[c] == t[m + c]:
+                    c += 1
+                if c > k:
+                    k, cow_src = c, child
+        # Oversized tails truncate to the largest bucket (the compiled
+        # shape set is closed; max_len bounds output room).
+        bucket = cfg.bucket_for(len(t) - m - k if len(t) > m + k else 1)
+        tail = max(1, min(len(t) - m - k, bucket))
+        eff = m + k + tail           # effective prompt length in cache
+        first_block = m // ps
+        need = (eff - 1) // ps - first_block + 1
+        with self._lock:
+            # Pin matched pages BEFORE allocating: the allocator may evict
+            # refcount-1 trie leaves, which the matched nodes could be.
+            pinned = [nd.page for nd in shared]
+            if cow_src is not None:
+                pinned.append(cow_src.page)
+            for p in pinned:
+                self._page_refs[p] += 1
+            for nd in shared:
+                nd.last_used = self._steps
+            pages_new = self._alloc_pages_locked(need)
+            if pages_new is None:
+                # Admission is O(free pages): not enough — requeue at
+                # the head and retry after evictions free pages.
+                for p in pinned:
+                    self._unref_page_locked(p)
+                self._queue.appendleft(req)
+                return False
+        req.admit_t = time.monotonic()
+        if k > 0:
+            # Mid-page divergence: copy the whole matched page, then the
+            # extend overwrites rows >= k with the divergent tail.
+            self.backend.copy_page(cow_src.page, pages_new[0])
+            cow_src.last_used = self._steps
             with self._lock:
-                if len(self._free_pages) < need:
-                    # Admission is O(free pages): not enough — requeue at
-                    # the head and retry after evictions free pages.
-                    self._queue.appendleft(req)
-                    return
-                pages = [self._free_pages.pop() for _ in range(need)]
-            req.admit_t = time.monotonic()
-            toks = np.zeros((1, bucket), np.int32)
-            toks[0, :plen] = np.asarray(req.tokens[:plen], np.int32)
-            rows = np.zeros(bucket, np.int32)
-            for j in range(bucket):
-                if j < plen:
-                    rows[j] = (pages[j // cfg.page_size] * cfg.page_size
-                               + j % cfg.page_size)
-                # else: row 0 — the scratch page
-            first = self.backend.prefill(toks, rows, plen)
-            now = time.monotonic()
-            req.first_token_t = now
-            req.output.append(first)
-            self._tokens_out += 1
-            slot = _Slot(req, pages, plen, first)
-            slot.last_token_t = now
-            if req.max_new_tokens <= 1:
-                self._finish(slot, now)
-                continue
-            with self._lock:
-                idx = next(i for i, s in enumerate(self._slots) if s is None)
-                self._slots[idx] = slot
+                self._cow_copies += 1
+                self._unref_page_locked(cow_src.page)  # copy pin released
+        pages = [nd.page for nd in shared] + pages_new
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :tail] = np.asarray(t[m + k:eff], np.int32)
+        write_rows = np.zeros(bucket, np.int32)
+        for j in range(tail):
+            pos = m + k + j
+            write_rows[j] = pages[pos // ps] * ps + pos % ps
+            # padding rows stay 0 — the scratch page
+        if m + k == 0:
+            first = self.backend.prefill(toks, write_rows, tail)
+        else:
+            # Gather through the slot's FULL logical span: shared prefix
+            # pages + the fresh tail pages (unmapped blocks read scratch
+            # row 0, masked out by the causal mask).
+            read_rows = np.zeros(cfg.pages_per_slot() * ps, np.int32)
+            for b, pg in enumerate(pages):
+                read_rows[b * ps:(b + 1) * ps] = pg * ps + np.arange(ps)
+            first = self.backend.extend(toks, write_rows, read_rows,
+                                        m + k, tail)
+        now = time.monotonic()
+        with self._lock:
+            if cfg.prefix_cache:
+                if m + k:
+                    self._prefix_hits += 1
+                    self._prefix_reused_tokens += m + k
+                else:
+                    self._prefix_misses += 1
+        req.first_token_t = now
+        req.output.append(first)
+        self._tokens_out += 1
+        slot = _Slot(req, pages, eff, first)
+        slot.last_token_t = now
+        if cfg.prefix_cache:
+            slot.prompt_tokens = list(t[:eff])
+        if req.max_new_tokens <= 1:
+            self._finish(slot, now)
+            return True
+        with self._lock:
+            idx = next(i for i, s in enumerate(self._slots) if s is None)
+            self._slots[idx] = slot
+        return True
 
     def _step(self, np) -> None:
         cfg = self.config
@@ -630,9 +974,10 @@ class ServeEngine:
             blk = s.position // cfg.page_size
             if blk >= len(s.pages):
                 with self._lock:
-                    if not self._free_pages:
+                    got = self._alloc_pages_locked(1)
+                    if got is None:
                         continue  # out of pages: this slot skips the step
-                    s.pages.append(self._free_pages.pop())
+                    s.pages.append(got[0])
             tokens[i] = s.last_token
             positions[i] = s.position
             for b, pg in enumerate(s.pages):
@@ -675,7 +1020,7 @@ class ServeEngine:
                 if live and all(s.req.done.is_set() for s in live):
                     for i, s in enumerate(self._slots):
                         if s is not None:
-                            self._free_pages.extend(s.pages)
+                            self._release_slot_pages_locked(s)
                             self._slots[i] = None
                     self._batch_open = True
 
@@ -686,7 +1031,7 @@ class ServeEngine:
             self._completed += 1
             self._window.append((now, slot.req.ttft_s, slot.req.latency_s,
                                  len(slot.req.output)))
-            self._free_pages.extend(slot.pages)
+            self._release_slot_pages_locked(slot)
             if slot_index is not None:
                 self._slots[slot_index] = None
         self._trace_request(slot.req)
@@ -701,9 +1046,12 @@ class ServeEngine:
         if ctx is None:
             return
         off = time.time() - time.monotonic()
+        # A gateway-routed request carries the gw/route span id: parenting
+        # under it joins the route and the serve work into ONE tree.
         parent = trace.add_span(
             "serve/request", req.submit_t + off,
             max(0.0, req.finish_t - req.submit_t), ctx=ctx,
+            parent_id=req.trace_parent,
             request=req.id, tokens_out=len(req.output))
         if parent is None:
             return  # trace unsampled
@@ -754,12 +1102,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--max-len", type=int,
                    default=int(os.environ.get(ENV_SERVE_MAX_LEN, "256")))
     p.add_argument("--no-cont-batch", action="store_true")
+    p.add_argument("--prefix-cache", action="store_true",
+                   default=os.environ.get(ENV_SERVE_PREFIX_CACHE) == "1",
+                   help="cross-request prefix page sharing")
     p.add_argument("--synthetic", action="store_true",
                    help="synthetic backend (no jax) — wiring tests")
     args = p.parse_args(argv)
 
     cfg = ServeConfig(slots=args.slots, max_len=args.max_len,
-                      cont_batch=not args.no_cont_batch)
+                      cont_batch=not args.no_cont_batch,
+                      prefix_cache=args.prefix_cache)
     backend = (SyntheticBackend() if args.synthetic
                else LlamaBackend(LlamaConfig.tiny()))
     rep = reporter()
@@ -781,12 +1133,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     continue
                 req = Request(id=str(msg.get("id", "")),
                               tokens=list(msg.get("prompt", [0])),
-                              max_new_tokens=int(msg.get("max_new", 8)))
-                accepted = engine.submit(req)
-                if accepted:
+                              max_new_tokens=int(msg.get("max_new", 8)),
+                              session=str(msg.get("session", "")),
+                              tier=str(msg.get("tier", "standard")),
+                              trace_parent=str(msg.get("trace_parent", "")))
+                res = engine.submit(req)
+                if res:
                     req.done.wait()
                 else:
-                    req.error = "draining"
+                    req.error = res.reason or "draining"
                 out = {"id": req.id, "tokens": req.output,
                        "ttft_ms": round(req.ttft_s * 1e3, 3),
                        "error": req.error}

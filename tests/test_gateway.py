@@ -1,0 +1,550 @@
+"""Serving front door (gateway/): least-loaded routing, session
+affinity, SLO-aware tiered admission, zero-drop drain re-homing,
+informer-driven discovery, the shed-aware autoscale signal, and the
+``gw/route`` -> ``serve/request`` causal trace edge.
+
+Fake replicas (a submit callable + a gauges callable) drive the
+admission/routing state machine deterministically; the drain and trace
+tests run real ServeEngines over the SyntheticBackend.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import PHASE_RUNNING, Pod
+from kubeflow_controller_tpu.api.labels import (
+    ANNOTATION_DRAIN,
+    ANNOTATION_GATEWAY_STATS,
+    LABEL_JOB_NAME,
+    LABEL_JOB_TYPE,
+)
+from kubeflow_controller_tpu.api.meta import ObjectMeta
+from kubeflow_controller_tpu.cluster import Cluster
+from kubeflow_controller_tpu.controller import SharedInformer
+from kubeflow_controller_tpu.gateway import (
+    DECISION_ADMIT,
+    DECISION_QUEUE,
+    DECISION_SHED,
+    GW_ROUTABLE_INDEX,
+    Gateway,
+    GatewayConfig,
+    InformerDiscovery,
+    Replica,
+    engine_replica,
+    job_stats_publisher,
+    routable_pod,
+)
+from kubeflow_controller_tpu.obs import trace
+from kubeflow_controller_tpu.serving.autoscale import gateway_signal
+from kubeflow_controller_tpu.workloads.serve import (
+    REFUSED_DRAINING,
+    REFUSED_OVERLOADED,
+    SUBMIT_OK,
+    Request,
+    ServeConfig,
+    ServeEngine,
+    SyntheticBackend,
+)
+
+
+def wait_for(fn, timeout=10.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+def instant_replica(name, gauges=None, refuse=None, log=None):
+    """A replica whose submit completes the request immediately (or
+    refuses with ``refuse``); ``log`` collects (replica, request id)."""
+
+    def submit(req):
+        refusal = refuse() if refuse is not None else None
+        if refusal is not None:
+            return refusal
+        if log is not None:
+            log.append((name, req.id))
+        now = time.monotonic()
+        req.admit_t = req.first_token_t = req.finish_t = now
+        req.output[:] = [1]
+        req.done.set()
+        return SUBMIT_OK
+
+    return Replica(name, submit,
+                   gauges or (lambda: {"slots_total": 4}))
+
+
+def mk_engine(slots=4, page_size=8, max_len=64, step_s=0.0):
+    eng = ServeEngine(
+        SyntheticBackend(step_s=step_s),
+        ServeConfig(slots=slots, page_size=page_size, max_len=max_len,
+                    prefill_buckets=(8, 16, 32), cont_batch=True,
+                    prefix_cache=True, stats_window_s=2.0))
+    eng.start()
+    assert eng.wait_ready(30)
+    return eng
+
+
+def route_wait(gw, req, timeout=30.0):
+    t = gw.route(req)
+    assert req.done.wait(timeout), req.id
+    return t
+
+
+# ---------------------------------------------------------------------------
+# Routing: least-loaded + session affinity
+# ---------------------------------------------------------------------------
+
+class TestRouting:
+    def test_least_loaded_pick(self):
+        log = []
+        gw = Gateway(GatewayConfig())
+        gw.register(instant_replica(
+            "hot", gauges=lambda: {"queue_depth": 8, "slots_total": 4},
+            log=log))
+        gw.register(instant_replica(
+            "cold", gauges=lambda: {"queue_depth": 0, "slots_total": 4},
+            log=log))
+        gw.start()
+        try:
+            for i in range(3):
+                t = route_wait(gw, Request(id=f"r{i}", tokens=[1],
+                                           max_new_tokens=1))
+                assert t.decision == DECISION_ADMIT
+            assert [n for n, _ in log] == ["cold", "cold", "cold"]
+        finally:
+            gw.stop()
+
+    def test_session_affinity_pins_then_rehomes_on_deregister(self):
+        log = []
+        gw = Gateway(GatewayConfig())
+        gw.register(instant_replica("a", log=log))
+        gw.register(instant_replica("b", log=log))
+        gw.start()
+        try:
+            for i in range(3):
+                route_wait(gw, Request(id=f"r{i}", tokens=[1],
+                                       max_new_tokens=1, session="conv"))
+            pinned = log[0][0]
+            assert [n for n, _ in log] == [pinned] * 3
+            assert gw.stats().affinity_hits == 2  # first route pins (miss)
+            gw.deregister(pinned)
+            route_wait(gw, Request(id="r3", tokens=[1], max_new_tokens=1,
+                                   session="conv"))
+            other = {"a": "b", "b": "a"}[pinned]
+            assert log[-1][0] == other
+            # ...and the session is now pinned THERE.
+            route_wait(gw, Request(id="r4", tokens=[1], max_new_tokens=1,
+                                   session="conv"))
+            assert log[-1][0] == other
+        finally:
+            gw.stop()
+
+    def test_affinity_spills_off_overloaded_pin(self):
+        """Cache locality must not defeat load balance: a pinned replica
+        hotter than the coldest by more than the spill margin loses the
+        session."""
+        log = []
+        load = {"a": 0}
+        gw = Gateway(GatewayConfig(affinity_spill=2.0))
+        gw.register(instant_replica(
+            "a", gauges=lambda: {"queue_depth": load["a"],
+                                 "slots_total": 4}, log=log))
+        gw.register(instant_replica("b", log=log))
+        gw.start()
+        try:
+            route_wait(gw, Request(id="r0", tokens=[1], max_new_tokens=1,
+                                   session="conv"))
+            if log[0][0] != "a":  # pin deterministically onto "a"
+                gw.deregister("b")
+                gw.register(instant_replica("b", log=log))
+                log.clear()
+                route_wait(gw, Request(id="r0b", tokens=[1],
+                                       max_new_tokens=1, session="conv"))
+            assert log[-1][0] == "a"
+            # 3.0 load vs 0: past the 2.0 spill margin (but gateway-wide
+            # pressure 12/8 = 1.5 stays under the standard queue band).
+            load["a"] = 12
+            route_wait(gw, Request(id="r1", tokens=[1], max_new_tokens=1,
+                                   session="conv"))
+            assert log[-1][0] == "b"
+        finally:
+            gw.stop()
+
+    def test_draining_refusal_deregisters_and_retries(self):
+        """REFUSED_DRAINING before the DRAIN-ACK: the replica leaves the
+        routing set immediately and the request retries a sibling — the
+        caller sees one admitted ticket, no error."""
+        log = []
+        gw = Gateway(GatewayConfig())
+        gw.register(instant_replica(
+            "a", refuse=lambda: REFUSED_DRAINING, log=log))
+        gw.register(instant_replica("b", log=log))
+        gw.start()
+        try:
+            t = route_wait(gw, Request(id="r0", tokens=[1],
+                                       max_new_tokens=1))
+            assert t.decision == DECISION_ADMIT and t.replica == "b"
+            assert not t.request.error
+            assert gw.replica_names() == ["b"]
+        finally:
+            gw.stop()
+
+    def test_overloaded_refusal_queues_until_capacity(self):
+        """REFUSED_OVERLOADED backs off into the gateway queue (no
+        hammering); the pump dispatches once the replica accepts."""
+        state = {"full": True}
+        log = []
+        gw = Gateway(GatewayConfig())
+        gw.register(instant_replica(
+            "a", refuse=lambda: REFUSED_OVERLOADED if state["full"] else None,
+            log=log))
+        gw.start()
+        try:
+            req = Request(id="r0", tokens=[1], max_new_tokens=1)
+            t = gw.route(req)
+            assert t.decision == DECISION_QUEUE
+            assert not req.done.wait(0.05)
+            state["full"] = False
+            assert req.done.wait(10)
+            assert not req.error and log == [("a", "r0")]
+        finally:
+            gw.stop()
+
+
+# ---------------------------------------------------------------------------
+# Admission: SLO-aware tier state machine
+# ---------------------------------------------------------------------------
+
+class TestAdmission:
+    def overloaded_gateway(self, depth=8):
+        """One replica whose published gauges put pressure at depth/4 —
+        above batch's shed band, inside standard's queue band, below
+        interactive's."""
+        gw = Gateway(GatewayConfig())
+        gw.register(instant_replica(
+            "a", gauges=lambda: {"queue_depth": depth, "slots_total": 4}))
+        return gw
+
+    def test_tiers_shed_lowest_first(self):
+        gw = self.overloaded_gateway(depth=8)  # pressure 2.0
+        gw.start()
+        try:
+            batch = Request(id="b", tokens=[1], max_new_tokens=1,
+                            tier="batch")
+            tb = gw.route(batch)
+            assert tb.decision == DECISION_SHED
+            assert batch.done.is_set() and batch.error == "shed"
+            ts = gw.route(Request(id="s", tokens=[1], max_new_tokens=1,
+                                  tier="standard"))
+            assert ts.decision == DECISION_QUEUE
+            ti = route_wait(gw, Request(id="i", tokens=[1],
+                                        max_new_tokens=1,
+                                        tier="interactive"))
+            assert ti.decision == DECISION_ADMIT
+            st = gw.stats()
+            assert st.shed == {"batch": 1}
+        finally:
+            gw.stop()
+
+    def test_unknown_tier_routes_as_standard(self):
+        gw = self.overloaded_gateway(depth=8)
+        try:
+            t = gw.route(Request(id="x", tokens=[1], max_new_tokens=1,
+                                 tier="platinum"))
+            assert t.tier == "standard" and t.decision == DECISION_QUEUE
+        finally:
+            gw.stop()
+
+    def test_queue_overflow_sheds_youngest_lowest_tier(self):
+        gw = Gateway(GatewayConfig(max_queue=2))
+        gw.register(instant_replica(
+            "a", gauges=lambda: {"queue_depth": 7, "slots_total": 4}))
+        # pressure 1.75: standard queues (>=1.6), batch sheds at >=1.3 —
+        # so queue a standard pair, then overflow with a third standard.
+        reqs = [Request(id=f"s{i}", tokens=[1], max_new_tokens=1,
+                        tier="standard") for i in range(3)]
+        try:
+            tickets = [gw.route(r) for r in reqs]
+            assert [t.decision for t in tickets[:2]] == [DECISION_QUEUE] * 2
+            # Overflow shed the YOUNGEST of the lowest queued tier.
+            assert tickets[2].decision == DECISION_SHED
+            assert reqs[2].error == "shed"
+            assert not reqs[0].done.is_set()
+        finally:
+            gw.stop()
+
+    def test_slo_burn_sheds_batch_before_interactive(self):
+        """The pressure signal's second term: even with idle replicas, a
+        windowed p99 TTFT past the objective sheds the low tier — the
+        admission control the serving-ttft-p99 SLO feeds."""
+        slow = {"on": True}
+
+        def submit(req):
+            now = time.monotonic()
+            req.admit_t = now
+            # 10 s observed TTFT while "slow": 5x the 2 s objective.
+            req.first_token_t = (req.submit_t + 10.0 if slow["on"]
+                                 else now)
+            req.finish_t = now
+            req.output[:] = [1]
+            req.done.set()
+            return SUBMIT_OK
+
+        gw = Gateway(GatewayConfig(slo_ttft_ms=2000.0))
+        gw.register(Replica("a", submit, lambda: {"slots_total": 4}))
+        gw.start()
+        try:
+            for i in range(3):
+                route_wait(gw, Request(id=f"w{i}", tokens=[1],
+                                       max_new_tokens=1))
+            wait_for(lambda: gw.pressure() >= 4.9)
+            t = gw.route(Request(id="b", tokens=[1], max_new_tokens=1,
+                                 tier="batch"))
+            assert t.decision == DECISION_SHED
+            ti = route_wait(gw, Request(id="i", tokens=[1],
+                                        max_new_tokens=1,
+                                        tier="interactive"))
+            assert ti.decision == DECISION_ADMIT
+        finally:
+            gw.stop()
+
+
+# ---------------------------------------------------------------------------
+# Drain: zero drops, sessions re-home
+# ---------------------------------------------------------------------------
+
+class TestDrainRehome:
+    def test_engine_drain_reroutes_queued_zero_drops(self):
+        """Mid-burst drain of one of two real engines: unadmitted queue
+        re-dispatches onto the survivor, in-flight finishes on the
+        drained engine, every caller request completes clean, and the
+        drained engine leaves the routing set."""
+        e0 = mk_engine(slots=2, step_s=0.003)
+        e1 = mk_engine(slots=2, step_s=0.003)
+        # Admission bands off: this test is about drain re-homing, so
+        # every request must dispatch straight into an engine's own
+        # intake queue — the thing drain() hands back as "rerouted".
+        wide = {t: 1e9 for t in ("interactive", "standard", "batch")}
+        gw = Gateway(GatewayConfig(queue_at=dict(wide), shed_at=dict(wide)))
+        gw.register(engine_replica("r0", e0))
+        gw.register(engine_replica("r1", e1))
+        gw.start()
+        reqs = [Request(id=f"q{i}", tokens=[1 + i], max_new_tokens=6,
+                        session=f"s{i % 4}") for i in range(12)]
+        try:
+            for r in reqs:
+                gw.route(r)
+            e0.drain()  # queued clones come back error=rerouted
+            for r in reqs:
+                assert r.done.wait(30), r.id
+                assert not r.error, (r.id, r.error)
+                assert len(r.output) == r.max_new_tokens
+            wait_for(lambda: gw.replica_names() == ["r1"])
+        finally:
+            gw.stop()
+            e0.stop()
+            e1.stop()
+
+
+# ---------------------------------------------------------------------------
+# Informer-driven discovery
+# ---------------------------------------------------------------------------
+
+def mk_serving_pod(name, job="svc", ns="default", phase=PHASE_RUNNING):
+    p = Pod(metadata=ObjectMeta(
+        name=name, namespace=ns,
+        labels={LABEL_JOB_TYPE: "Serving", LABEL_JOB_NAME: job}))
+    p.status.phase = phase
+    return p
+
+
+class TestDiscovery:
+    def test_routable_pod_predicate(self):
+        p = mk_serving_pod("s0")
+        assert routable_pod(p)
+        drained = mk_serving_pod("s1")
+        drained.metadata.annotations[ANNOTATION_DRAIN] = "1"
+        assert not routable_pod(drained)
+        pending = mk_serving_pod("s2", phase="Pending")
+        assert not routable_pod(pending)
+        deleting = mk_serving_pod("s3")
+        deleting.metadata.deletion_timestamp = time.time()
+        assert not routable_pod(deleting)
+        trainer = mk_serving_pod("s4")
+        trainer.metadata.labels[LABEL_JOB_TYPE] = "Worker"
+        assert not routable_pod(trainer)
+
+    def test_discovery_mirrors_routable_index(self):
+        """Pods entering/leaving the routable index register/deregister;
+        the DRAIN ANNOTATION alone pulls a replica from the routing set —
+        before the replica ever acks."""
+        c = Cluster()
+        inf = SharedInformer(c.pods, resync_period_s=0, name="pods")
+        inf.start()
+        gw = Gateway(GatewayConfig())
+        try:
+            c.pods.create(mk_serving_pod("s0"))
+            c.pods.create(mk_serving_pod("s1"))
+            c.pods.create(mk_serving_pod("other", job="not-svc"))
+            InformerDiscovery(gw, inf, "default", "svc",
+                              lambda pod: instant_replica(pod.metadata.name))
+            wait_for(lambda: gw.replica_names() == ["s0", "s1"])
+            # Controller stamps the drain annotation -> leaves routing set.
+            c.pods.patch_meta(
+                "default", "s0",
+                lambda m: m.annotations.update({ANNOTATION_DRAIN: "1"}))
+            wait_for(lambda: gw.replica_names() == ["s1"])
+            # A replacement appears -> joins.
+            c.pods.create(mk_serving_pod("s2"))
+            wait_for(lambda: gw.replica_names() == ["s1", "s2"])
+            c.pods.delete("default", "s1")
+            wait_for(lambda: gw.replica_names() == ["s2"])
+        finally:
+            inf.stop()
+
+
+# ---------------------------------------------------------------------------
+# Stats publication + the shed-aware autoscale signal
+# ---------------------------------------------------------------------------
+
+class TestStatsSignal:
+    def test_stats_annotation_round_trip(self):
+        gw = Gateway(GatewayConfig())
+        gw.register(instant_replica("a"))
+        gw.start()
+        try:
+            route_wait(gw, Request(id="r0", tokens=[1], max_new_tokens=1))
+            doc = json.loads(gw.stats().as_annotation())
+            assert doc["replicas"] == 1
+            assert doc["weights"] == {"a": 1.0}
+            assert doc["ts"] > 0
+        finally:
+            gw.stop()
+
+    def test_publisher_writes_job_annotation(self):
+        c = Cluster()
+        from kubeflow_controller_tpu.api.tfjob import TFJob
+
+        c.tfjobs.create(TFJob(metadata=ObjectMeta(name="svc",
+                                                  namespace="default")))
+        gw = Gateway(GatewayConfig(publish_s=0.01),
+                     publisher=job_stats_publisher(c, "default", "svc"))
+        gw.register(instant_replica("a"))
+        gw.start()
+        try:
+            route_wait(gw, Request(id="r0", tokens=[1], max_new_tokens=1))
+
+            def published():
+                j = c.tfjobs.get("default", "svc")
+                return j.metadata.annotations.get(ANNOTATION_GATEWAY_STATS)
+
+            raw = wait_for(published)
+            assert json.loads(raw)["replicas"] == 1
+        finally:
+            gw.stop()
+
+    def test_gateway_signal_parses_queued_plus_shed(self):
+        from kubeflow_controller_tpu.api.tfjob import TFJob
+
+        job = TFJob(metadata=ObjectMeta(name="svc", namespace="default"))
+        now = time.time()
+        job.metadata.annotations[ANNOTATION_GATEWAY_STATS] = json.dumps(
+            {"queued": 6, "shed_rps": 30.0, "ts": now})
+        extra, why = gateway_signal(job, now)
+        assert extra == 36.0 and "queued 6" in why and "30" in why
+
+    def test_gateway_signal_ignores_stale_and_garbage(self):
+        from kubeflow_controller_tpu.api.tfjob import TFJob
+
+        job = TFJob(metadata=ObjectMeta(name="svc", namespace="default"))
+        now = time.time()
+        job.metadata.annotations[ANNOTATION_GATEWAY_STATS] = json.dumps(
+            {"queued": 6, "shed_rps": 30.0, "ts": now - 60.0})
+        assert gateway_signal(job, now) == (0.0, "")  # dead gateway
+        job.metadata.annotations[ANNOTATION_GATEWAY_STATS] = "{not json"
+        assert gateway_signal(job, now) == (0.0, "")
+
+    def test_shedding_does_not_mask_scale_up(self):
+        """The masking regression: a shedding gateway leaves replica
+        queues EMPTY (the overload never reached them), so queue depth
+        alone says "idle" at exactly the moment capacity is most needed.
+        The gateway-queued + shed-rate term must force the scale-up."""
+        from kubeflow_controller_tpu.api.core import (
+            Container, PodProgress, PodTemplateSpec)
+        from kubeflow_controller_tpu.api.tfjob import (
+            AutoscaleSpec, ReplicaType, TFJob, TFReplicaSpec)
+        from kubeflow_controller_tpu.serving.autoscale import (
+            ServingAutoscaler)
+
+        job = TFJob(metadata=ObjectMeta(name="svc", namespace="default",
+                                        uid="u-svc"))
+        job.spec.autoscale = AutoscaleSpec(
+            min_replicas=1, max_replicas=4, target_queue_depth=4.0,
+            tolerance=0.2, scale_down_stabilization_s=3.0)
+        tmpl = PodTemplateSpec()
+        tmpl.spec.containers.append(Container(name="srv", image="img"))
+        job.spec.tf_replica_specs.append(TFReplicaSpec(
+            replicas=1, tf_replica_type=ReplicaType.SERVING,
+            template=tmpl))
+
+        pod = Pod(metadata=ObjectMeta(name="svc-serving-0",
+                                      namespace="default"))
+        pod.status.phase = PHASE_RUNNING
+        pod.status.progress = PodProgress(
+            step=10, phase="serving", queue_depth=0, slots_used=0,
+            slots_total=4, timestamp=time.time())
+
+        now = time.time()
+        a = ServingAutoscaler()
+        # Control: no gateway stats, idle replica -> steady at min.
+        d = a.assess("default/svc", job, [pod], now=now)
+        assert d.target is None
+        # Shedding gateway: queued 6 + 30/s shed = 36 depth-equivalents.
+        job.metadata.annotations[ANNOTATION_GATEWAY_STATS] = json.dumps(
+            {"queued": 6, "shed_rps": 30.0, "ts": now})
+        d = a.assess("default/svc", job, [pod], now=now)
+        assert d.target == 4  # ratio 9.0, clamped to max_replicas
+        assert "gateway queued 6" in d.reason
+
+
+# ---------------------------------------------------------------------------
+# Causal trace: gw/route parents serve/request
+# ---------------------------------------------------------------------------
+
+class TestTraceEdge:
+    def test_route_span_parents_serve_request(self):
+        """One connected tree per request: the gateway's gw/route span is
+        the causal parent of the engine's serve/request span, both on the
+        caller's trace."""
+        from kubeflow_controller_tpu.obs.trace import TRACER, TraceContext
+
+        TRACER.clear()
+        ctx = TraceContext(trace_id="t-front-door", span_id="root-span")
+        with TRACER.context(ctx):
+            eng = mk_engine(slots=2)   # engines capture ctx at construction
+            gw = Gateway(GatewayConfig())
+        gw.register(engine_replica("r0", eng))
+        gw.start()
+        try:
+            route_wait(gw, Request(id="q0", tokens=[1, 2, 3],
+                                   max_new_tokens=2))
+            gw_span = wait_for(
+                lambda: TRACER.spans(prefix="gw/route"))[0]
+            srv_span = wait_for(
+                lambda: TRACER.spans(prefix="serve/request"))[0]
+            assert gw_span.trace_id == "t-front-door"
+            assert gw_span.parent_id == "root-span"
+            assert srv_span.trace_id == "t-front-door"
+            assert srv_span.parent_id == gw_span.span_id
+            assert gw_span.span_id and srv_span.span_id
+        finally:
+            gw.stop()
+            eng.stop()
+            TRACER.clear()

@@ -44,6 +44,9 @@ from kubeflow_controller_tpu.serving.autoscale import (
 )
 from kubeflow_controller_tpu.updater import compute_status
 from kubeflow_controller_tpu.workloads.serve import (
+    REFUSED_DRAINING,
+    REFUSED_OVERLOADED,
+    SUBMIT_OK,
     Request,
     ServeConfig,
     ServeEngine,
@@ -874,3 +877,237 @@ class TestPagedCache:
                 params, jnp.asarray([r.tokens]), cfg,
                 max_new_tokens=5))[0, len(r.tokens):]
             assert r.output == [int(x) for x in oracle], r.id
+
+
+# ---------------------------------------------------------------------------
+# Typed intake verdicts (the gateway's routing contract)
+# ---------------------------------------------------------------------------
+
+class TestSubmitResult:
+    def test_truthiness_and_reasons(self):
+        """Truthiness == accepted, so pre-gateway ``if eng.submit(r)``
+        call sites keep working; the reason tells the gateway whether to
+        retry NOW (draining) or back off (overloaded)."""
+        assert SUBMIT_OK and SUBMIT_OK.accepted
+        assert not REFUSED_DRAINING
+        assert REFUSED_DRAINING.reason == "draining"
+        assert not REFUSED_OVERLOADED
+        assert REFUSED_OVERLOADED.reason == "overloaded"
+
+    def test_draining_and_stopped_refuse_with_draining_reason(self):
+        eng = mk_engine(slots=1)
+        eng.drain()
+        res = eng.submit(Request(id="late", tokens=[1], max_new_tokens=1))
+        assert not res and res.reason == "draining"
+        eng.stop()
+        res = eng.submit(Request(id="later", tokens=[1], max_new_tokens=1))
+        assert not res and res.reason == "draining"
+
+    def test_overloaded_refusal_at_max_queue(self):
+        # Unstarted engine: intake is the only actor, so the max_queue
+        # bound is exact and the test is race-free.
+        eng = ServeEngine(SyntheticBackend(), ServeConfig(
+            slots=1, page_size=8, max_len=32, prefill_buckets=(8, 16),
+            max_queue=2, stats_window_s=2.0))
+        reqs = [Request(id=str(i), tokens=[1], max_new_tokens=1)
+                for i in range(3)]
+        assert eng.submit(reqs[0])
+        assert eng.submit(reqs[1])
+        res = eng.submit(reqs[2])
+        assert not res and res.reason == "overloaded"
+        # The refused request is untouched: re-routable elsewhere.
+        assert not reqs[2].done.is_set() and not reqs[2].error
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# Cross-request prefix page sharing (refcounts + copy-on-write)
+# ---------------------------------------------------------------------------
+
+class TestPrefixSharing:
+    def mk_prefix_engine(self, slots=3, page_size=8, max_len=64,
+                         prefix=True, backend=None):
+        eng = ServeEngine(
+            backend or SyntheticBackend(),
+            ServeConfig(slots=slots, page_size=page_size, max_len=max_len,
+                        prefill_buckets=(8, 16, 32), cont_batch=True,
+                        prefix_cache=prefix, stats_window_s=2.0))
+        eng.start()
+        assert eng.wait_ready(30)
+        return eng
+
+    def run_multiturn(self, eng, sessions=3, turns=4, seed=5):
+        """Synchronous multi-turn conversations; each turn's prompt is the
+        prior history (a known prefix) plus a few fresh tokens.  Prompts
+        stay under the largest prefill bucket (32): past it the cold path
+        truncates to the bucket while the prefix path extends the full
+        tail, so identity is only promised inside the compiled shape set."""
+        rng = random.Random(seed)
+        hist = {s: [rng.randrange(1, 99) for _ in range(12)]
+                for s in range(sessions)}
+        outputs = {}
+        for t in range(turns):
+            batch = []
+            for s in range(sessions):
+                r = Request(id=f"s{s}-t{t}", tokens=list(hist[s]),
+                            max_new_tokens=3, session=f"s{s}")
+                assert eng.submit(r)
+                batch.append((s, r))
+            for s, r in batch:
+                assert r.done.wait(30), r.id
+                assert not r.error, (r.id, r.error)
+                outputs[r.id] = list(r.output)
+                hist[s] += r.output + [rng.randrange(1, 99)
+                                       for _ in range(2)]
+        return outputs
+
+    def pool_size(self, eng):
+        return eng.config.slots * eng.config.pages_per_slot()
+
+    def assert_conserved(self, eng):
+        """Every physical page is either free or refcounted — never both,
+        never neither, no page leaked or double-freed."""
+        with eng._lock:
+            free = list(eng._free_pages)
+            refs = dict(eng._page_refs)
+        assert len(free) + len(refs) == self.pool_size(eng)
+        assert not set(free) & set(refs)
+        assert sorted(set(free) | set(refs)) == list(
+            range(1, self.pool_size(eng) + 1))
+        assert all(r >= 1 for r in refs.values())
+
+    def test_sharing_is_token_identical_with_cache_off(self):
+        """CoW + tail-extend over shared pages must be invisible in the
+        outputs: the same multi-turn traffic through a prefix-cache
+        engine and a cache-off engine decodes identical tokens."""
+        on = self.mk_prefix_engine(prefix=True)
+        off = self.mk_prefix_engine(prefix=False)
+        try:
+            got_on = self.run_multiturn(on, seed=5)
+            got_off = self.run_multiturn(off, seed=5)
+            assert got_on == got_off
+            st = on.stats()
+            assert st.prefix_hits > 0
+            assert st.prefix_reused_tokens > 0
+            assert off.stats().prefix_hits == 0
+        finally:
+            on.stop()
+            off.stop()
+
+    def test_refcount_conservation_under_concurrent_sessions(self):
+        """Concurrent admit/evict/share churn on a small pool: after the
+        dust settles every page must come home to exactly one owner."""
+        eng = self.mk_prefix_engine(slots=3, page_size=8, max_len=48)
+        errs = []
+
+        def feeder(tid):
+            rng = random.Random(200 + tid)
+            hist = [tid + 1] * 14  # shared per-thread prefix
+            for i in range(12):
+                r = Request(id=f"{tid}-{i}", tokens=list(hist),
+                            max_new_tokens=rng.randrange(1, 6),
+                            session=f"t{tid}")
+                if not eng.submit(r):
+                    errs.append(r.id)
+                    continue
+                if not r.done.wait(30) or r.error:
+                    errs.append((r.id, r.error))
+                    continue
+                hist += r.output + [rng.randrange(1, 99)]
+                if len(hist) > 40:
+                    hist = hist[:14]
+                time.sleep(rng.random() * 0.002)
+
+        threads = [threading.Thread(target=feeder, args=(t,))
+                   for t in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        try:
+            assert not errs, errs
+            self.assert_conserved(eng)
+            st = eng.stats()
+            assert st.prefix_hits > 0  # sharing actually happened
+            assert st.slots_used == 0 and st.queue_depth == 0
+        finally:
+            eng.stop()
+
+    def test_eviction_never_frees_page_a_slot_still_maps(self):
+        """Force a full trie eviction sweep while a live slot shares
+        retained pages: the shared pages are pinned by the slot's ref and
+        must survive; only trie-only (refcount-1) pages may free."""
+        eng = self.mk_prefix_engine(slots=2, page_size=8, max_len=32,
+                                    backend=SyntheticBackend(step_s=0.01))
+        try:
+            warm = Request(id="warm", tokens=[7] * 15, max_new_tokens=1,
+                           session="a")
+            assert eng.submit(warm)
+            assert warm.done.wait(30) and not warm.error
+            # Follow-up shares the retained pages and HOLDS the slot
+            # (slow backend) while we run the eviction sweep.
+            follow = Request(id="follow", tokens=[7] * 15 + [9, 9],
+                             max_new_tokens=8, session="a")
+            assert eng.submit(follow)
+
+            def slot_pages():
+                with eng._lock:
+                    for s in eng._slots:
+                        if s is not None and s.req.id == "follow":
+                            return list(s.pages)
+                return None
+
+            deadline = time.monotonic() + 10
+            pages = None
+            while pages is None and time.monotonic() < deadline:
+                pages = slot_pages()
+                time.sleep(0.002)
+            assert pages, "follow-up never admitted"
+            with eng._lock:
+                eng._evict_prefix_locked(shortfall=10 ** 6)
+                free = set(eng._free_pages)
+                refs = dict(eng._page_refs)
+            assert not set(pages) & free, "evicted a live slot's page"
+            assert all(refs.get(p, 0) >= 1 for p in pages)
+            assert follow.done.wait(30) and not follow.error
+            assert len(follow.output) == 8
+            self.assert_conserved(eng)
+        finally:
+            eng.stop()
+
+    @pytest.mark.slow
+    def test_cow_divergent_tail_bit_exact_llama(self):
+        """Mid-page divergence on a real model: request 2 shares request
+        1's first page, CoW-copies the partially-matched second page, and
+        decodes bit-exactly what a cache-off engine produces."""
+        from kubeflow_controller_tpu.models.llama import LlamaConfig
+        from kubeflow_controller_tpu.workloads.serve import LlamaBackend
+
+        cfg = LlamaConfig.tiny()
+        base = [11, 23, 5, 42, 77, 102, 9, 61, 88, 14, 3, 250]
+        prompts = [base + [33, 71, 6, 120],          # fills 2 pages
+                   base[:10] + [200, 201, 202, 203]]  # diverges mid-page-2
+
+        def run(prefix_on):
+            eng = self.mk_prefix_engine(
+                slots=2, page_size=8, max_len=64, prefix=prefix_on,
+                backend=LlamaBackend(cfg, seed=0))
+            outs = []
+            try:
+                for i, toks in enumerate(prompts):
+                    r = Request(id=f"p{i}", tokens=list(toks),
+                                max_new_tokens=5)
+                    assert eng.submit(r)
+                    assert r.done.wait(120) and not r.error, r.id
+                    outs.append(list(r.output))
+                st = eng.stats()
+            finally:
+                eng.stop()
+            return outs, st
+
+        got_on, st_on = run(True)
+        got_off, st_off = run(False)
+        assert got_on == got_off
+        assert st_on.prefix_hits >= 1
+        assert st_on.cow_copies >= 1
+        assert st_off.cow_copies == 0
