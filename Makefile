@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all ci test test-fast lint typecheck cov cov-local bench dryrun validate vet race-smoke check-smoke metrics-smoke scale-smoke scale10k-smoke stall-smoke widejob-smoke churn-smoke store-smoke sched-smoke ttfs-smoke chaos-smoke elastic-smoke ha-smoke serve-smoke gateway-smoke slo-smoke
+.PHONY: all ci test test-fast lint typecheck cov cov-local bench dryrun validate vet race-smoke check-smoke metrics-smoke scale-smoke scale10k-smoke stall-smoke widejob-smoke churn-smoke store-smoke sched-smoke ttfs-smoke chaos-smoke elastic-smoke goodput-smoke ha-smoke serve-smoke gateway-smoke slo-smoke
 
 all: lint vet test race-smoke check-smoke
 
@@ -15,7 +15,7 @@ all: lint vet test race-smoke check-smoke
 # included), then tier-1 under the runtime lock-order detector.  Run
 # without -j: the order is the diagnosis ladder (cheapest, most precise
 # signal first).
-ci: vet race-smoke check-smoke chaos-smoke elastic-smoke serve-smoke gateway-smoke ha-smoke slo-smoke scale10k-smoke
+ci: vet race-smoke check-smoke chaos-smoke elastic-smoke goodput-smoke serve-smoke gateway-smoke ha-smoke slo-smoke scale10k-smoke
 	KCTPU_LOCKCHECK=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m "not slow"
 
 # Fast/slow split: `test-fast` (-m "not slow") is the quick signal — 214 of
@@ -300,6 +300,28 @@ elastic-smoke:
 		      '| t-restored', d['details']['time_to_restored_s'], 's', \
 		      '| lost', d['details']['lost_steps'], '/', d['details']['checkpoint_every'], \
 		      '| harvest', d['details']['harvest']['counters'].get('harvested_slices', {}))"
+
+# Goodput smoke (the time-accounting ledger's standing gate,
+# docs/OBSERVABILITY.md "Goodput ledger"): a compressed chaos-kill +
+# warm-restore + compile-cache + width-harvest scenario through the REAL
+# controller ledger (obs/goodput.py).  Gates (GOODPUT_r01.json): every
+# replica's attributed time sums to 100% of its wall time (zero
+# unattributed/overlapping intervals), the injected kill's badput lands
+# in restore+stalled, harvest badput lands in reshard (+harvested tail),
+# a compile-cache-warm rerun shows compile badput shrinking >= 2x vs
+# cold, status/CLI surfaces carry the rollup, and the ledger's --scale
+# orchestration overhead stays < 10% (min of 5 interleaved on/off
+# pairs, docs/PERF.md "Goodput ledger overhead").  ~30-45 s.
+goodput-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --goodput \
+		> /tmp/kctpu_goodput_smoke.json
+	@$(PY) -c "import json; d = json.load(open('/tmp/kctpu_goodput_smoke.json')); \
+		assert {'metric', 'value', 'unit', 'details'} <= set(d), d; \
+		g = d['details']['gates']; \
+		assert all(g.values()), {k: v for k, v in g.items() if not v}; \
+		print('goodput-smoke ok: scenario ratio', d['value'], \
+		      '| badput', d['details']['badput_seconds_by_bucket'], \
+		      '| overhead', d['details']['scale']['ledger_overhead_pct'], '%')"
 
 # Serving smoke (the serving plane's standing gate, docs/SERVING.md):
 # real tiny-Llama replicas over the slot-paged KV cache, three phases —

@@ -228,7 +228,8 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
               simulated: bool = False,
               pods_per_job: int = 3,
               threadiness: int = 0,
-              obs: bool = False) -> dict:
+              obs: bool = False,
+              goodput: bool = True) -> dict:
     """N concurrent orchestration-bound TFJobs (1 PS + ``pods_per_job - 1``
     workers each, simulated pod phases) from creation to all-Succeeded.
     Uses only the public controller surface so the same file measures older
@@ -318,6 +319,10 @@ def run_scale(n_jobs: int, deadline_s: float = 0.0,
     kubelet = (SimKubelet(cluster, policy=policy) if simulated
                else FakeKubelet(cluster, policy=policy))
     ctrl = Controller(cluster, resync_period_s=1.0)
+    if not goodput:
+        # Ledger-off baseline for the goodput-overhead comparison
+        # (bench.py --goodput; docs/PERF.md "Goodput ledger overhead").
+        ctrl.goodput_tracker = None
     if obs:
         ctrl.start_obs_plane(interval_s=1.0)
     kubelet.start()
@@ -1726,6 +1731,402 @@ def elastic_main(args) -> int:
         print(f"elastic bench regression: harvested victim did not "
               f"survive + re-expand: {h}", file=sys.stderr)
         rc = 1
+    return rc
+
+
+# ---------------------------------------------------------------------------
+# --goodput: phase-attributed time accounting (obs/goodput.py ledger)
+# ---------------------------------------------------------------------------
+
+def run_goodput(scale_jobs: int = 150, deadline_s: float = 120.0) -> dict:
+    """Goodput-ledger bench (GOODPUT_r01.json / make goodput-smoke).
+
+    Replays a compressed chaos+preemption+elastic scenario against the
+    REAL controller ledger (simulated TPU gang pods, scripted progress
+    beats through the public ``update_progress`` surface) and gates the
+    attribution invariants:
+
+    - ``gp-cold``: cold-start gang -> rendezvous -> unresolved compile
+      (resolves "compiled": stays ``compile_miss``) -> fit -> step-frozen
+      stall -> chaos kill -> warm replacement gang restores and finishes.
+      Gates: the kill's badput lands in ``restore`` + ``stalled``, the
+      cold AND warm starting buckets both accrue, and every replica's
+      attributed time sums to its wall time (no gaps, no double-count).
+    - ``gp-warm``: identical compile window but the beat resolves
+      "cache-hit", so the accrued unresolved compile time re-attributes
+      to ``compile_cached``.  Gate: warm ``compile_miss`` <= 0.5x cold.
+    - ``gp-harvest``/``gp-high``: a 4-slice elastic victim harvested down
+      by a blocked high-priority gang.  Gates: the harvested pods' tail
+      lands in ``harvested`` and the survivors' width transition in
+      ``reshard``.
+
+    Then the overhead probe (docs/PERF.md "Goodput ledger overhead"):
+    the gate measures the ledger path's own time directly (fraction of
+    the ledger-on runs spent inside ``Controller._observe_goodput``,
+    gated < 10%); interleaved on/off ``run_scale`` pairs
+    (``Controller.goodput_tracker = None``, median of 5 each — the PR 16
+    obs-plane discipline) ride along as the end-to-end A/B row."""
+    from kubeflow_controller_tpu.api.core import (
+        Container,
+        PodProgress,
+        PodTemplateSpec,
+    )
+    from kubeflow_controller_tpu.api.meta import ObjectMeta
+    from kubeflow_controller_tpu.api.tfjob import (
+        ElasticSpec,
+        ReplicaType,
+        TFJob,
+        TFJobPhase,
+        TFReplicaSpec,
+        TPUSpec,
+    )
+    from kubeflow_controller_tpu.checker import StallPolicy
+    from kubeflow_controller_tpu.cluster import (
+        Cluster,
+        FakeKubelet,
+        PhasePolicy,
+        TPUInventory,
+        TPUSlice,
+    )
+    from kubeflow_controller_tpu.cluster.store import NotFound
+    from kubeflow_controller_tpu.controller import Controller
+    from kubeflow_controller_tpu.elastic import ElasticPolicy
+    from kubeflow_controller_tpu.obs.phases import (
+        GOODPUT_BUCKETS,
+        NON_OCCUPIED_BUCKETS,
+    )
+    from kubeflow_controller_tpu.scheduler import GangScheduler, SchedulerPolicy
+
+    ns = "default"
+    cluster = Cluster()
+    inv = TPUInventory([TPUSlice(f"slice-{i}", "v5e-8", num_hosts=2)
+                        for i in range(4)])
+    sched = GangScheduler(inv, SchedulerPolicy())
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(
+        run_s=600.0, cold_start_s=0.4, warm_start_s=0.1), inventory=sched)
+    ctrl = Controller(cluster, inventory=sched, resync_period_s=0.3,
+                      stall_policy=StallPolicy(heartbeat_deadline_s=6.0,
+                                               step_deadline_s=0.4,
+                                               check_interval_s=0.1),
+                      elastic_policy=ElasticPolicy(warmup_s=0.2,
+                                                   min_degraded_s=0.2,
+                                                   capacity_poll_s=0.1))
+    ctrl.goodput_status_interval_s = 0.2
+    kubelet.start()
+    ctrl.run(threadiness=2)
+
+    def mk_tpu_job(name: str, num_slices: int, elastic_min: int = 0,
+                   cls: str = "") -> TFJob:
+        job = TFJob(metadata=ObjectMeta(name=name, namespace=ns))
+        if cls:
+            job.spec.priority_class_name = cls
+        if elastic_min:
+            job.spec.elastic = ElasticSpec(min_width=elastic_min)
+        t = PodTemplateSpec()
+        t.spec.containers.append(Container(name="tensorflow", image="img"))
+        t.spec.restart_policy = "OnFailure"
+        job.spec.tf_replica_specs = [TFReplicaSpec(
+            replicas=2 * num_slices, tf_replica_type=ReplicaType.TPU,
+            template=t, gang_restart=True,
+            tpu=TPUSpec(accelerator_type="v5e-8", num_hosts=2,
+                        num_slices=num_slices))]
+        return job
+
+    def pods_of(name: str, phase: str = "Running"):
+        return [p for p in cluster.pods.list(ns)
+                if p.metadata.labels.get("tf_job_name") == name
+                and p.status.phase == phase]
+
+    def wait_until(cond, what: str, timeout: float = 30.0):
+        end = time.time() + timeout
+        while time.time() < end:
+            v = cond()
+            if v:
+                return v
+            time.sleep(0.02)
+        raise RuntimeError(f"goodput bench: timed out waiting for {what}")
+
+    def beat(pod_name: str, **kw) -> None:
+        try:
+            cluster.pods.update_progress(ns, pod_name, PodProgress(**kw))
+        except NotFound:
+            pass  # pod replaced mid-script: the ledger retired it
+
+    def beat_all(names, hold_s: float, **kw) -> None:
+        end = time.time() + hold_s
+        while time.time() < end:
+            for n in names:
+                beat(n, **kw)
+            time.sleep(0.05)
+
+    def job_summary(name: str) -> dict:
+        s = ctrl.goodput_tracker.summary(ns, name, time.time())
+        return {"ratio": round(s.ratio, 4),
+                "goodput_s": round(s.goodput_s, 3),
+                "occupied_s": round(s.occupied_s, 3),
+                "wall_s": round(s.wall_s, 3),
+                "replicas": s.replicas,
+                "buckets": {b: round(v, 3) for b, v in s.buckets.items()}}
+
+    def attribution_errors(name: str) -> list:
+        """Per-replica |sum(buckets) - wall| — the 100%-of-wall gate."""
+        snap = ctrl.goodput_tracker.snapshot(ns, name, time.time())
+        bad = []
+        for pname, pd in (snap.get("pods") or {}).items():
+            attributed = sum(pd["buckets"].values())
+            if abs(attributed - pd["wall_s"]) > 0.05:
+                bad.append({"pod": pname, "attributed_s": attributed,
+                            "wall_s": pd["wall_s"]})
+        return bad
+
+    jobs: dict = {}
+    attribution_bad: list = []
+    try:
+        # ---- gp-cold: cold start, compile miss, stall, kill, restore ----
+        cluster.tfjobs.create(mk_tpu_job("gp-cold", 1))
+        pods = wait_until(lambda: (p := pods_of("gp-cold"))
+                          and len(p) >= 2 and p,
+                          "gp-cold gang Running")
+        names0 = sorted(p.metadata.name for p in pods)
+        time.sleep(0.25)  # starting_cold: Running, no beat yet
+        beat_all(names0, 0.2, phase="rendezvous")
+        beat_all(names0, 0.45, step=0, phase="compile")  # unresolved
+        beat_all(names0, 0.1, step=0, phase="compile",
+                 compile_source="compiled")  # resolves: STAYS compile_miss
+        for s in range(1, 7):
+            for n in names0:
+                beat(n, step=s, phase="fit", examples_per_sec=100.0,
+                     compile_source="compiled")
+            time.sleep(0.05)
+
+        def stalled_replicas() -> set:
+            pr = cluster.tfjobs.get(ns, "gp-cold").status.progress
+            return set(pr.stalled_replicas) if pr is not None else set()
+
+        # Step freezes (beats keep arriving): the stall detector must fire,
+        # and the ledger must override the beat bucket with ``stalled``.
+        end = time.time() + 15
+        while time.time() < end and not stalled_replicas():
+            for n in names0:
+                beat(n, step=6, phase="fit", compile_source="compiled")
+            time.sleep(0.05)
+        if not stalled_replicas():
+            raise RuntimeError("goodput bench: stall never detected")
+        beat_all(names0, 0.3, step=6, phase="fit", compile_source="compiled")
+        # Chaos kill: one member fails, recovery replaces the WHOLE gang;
+        # the readmitted gang is warm (kubelet warm-pool semantics).
+        kubelet.set_phase(ns, names0[0], "Failed",
+                          reason="Error: injected kill (goodput bench)")
+        repl = wait_until(
+            lambda: (p := pods_of("gp-cold")) and len(p) >= 2
+            and all(q.metadata.name not in names0 for q in p) and p,
+            "gp-cold replacement gang Running")
+        names1 = sorted(p.metadata.name for p in repl)
+        time.sleep(0.15)  # starting_warm window
+        beat_all(names1, 0.35, step=4, phase="restore", resumed_from_step=4,
+                 compile_source="cache-hit")
+        for s in range(5, 11):
+            for n in names1:
+                beat(n, step=s, phase="fit", examples_per_sec=100.0)
+            time.sleep(0.05)
+        for n in names1:
+            kubelet.set_phase(ns, n, "Succeeded")
+        wait_until(lambda: cluster.tfjobs.get(ns, "gp-cold").status.phase
+                   == TFJobPhase.SUCCEEDED, "gp-cold Succeeded")
+        time.sleep(0.3)  # terminal sync: status.goodput attach + retire
+        jobs["gp-cold"] = job_summary("gp-cold")
+        attribution_bad += attribution_errors("gp-cold")
+        status_goodput = cluster.tfjobs.get(ns, "gp-cold").status.goodput
+
+        # ---- gp-warm: same compile window, resolves cache-hit ----------
+        cluster.tfjobs.create(mk_tpu_job("gp-warm", 1))
+        pods = wait_until(lambda: (p := pods_of("gp-warm"))
+                          and len(p) >= 2 and p,
+                          "gp-warm gang Running")
+        namesB = sorted(p.metadata.name for p in pods)
+        time.sleep(0.25)
+        beat_all(namesB, 0.2, phase="rendezvous")
+        beat_all(namesB, 0.45, step=0, phase="compile")  # unresolved
+        beat_all(namesB, 0.1, step=0, phase="compile",
+                 compile_source="cache-hit")  # re-attributes to cached
+        for s in range(1, 7):
+            for n in namesB:
+                beat(n, step=s, phase="fit", examples_per_sec=100.0,
+                     compile_source="cache-hit")
+            time.sleep(0.05)
+        for n in namesB:
+            kubelet.set_phase(ns, n, "Succeeded")
+        wait_until(lambda: cluster.tfjobs.get(ns, "gp-warm").status.phase
+                   == TFJobPhase.SUCCEEDED, "gp-warm Succeeded")
+        time.sleep(0.3)
+        jobs["gp-warm"] = job_summary("gp-warm")
+        attribution_bad += attribution_errors("gp-warm")
+
+        # ---- gp-harvest: width harvest -> harvested + reshard ----------
+        cluster.tfjobs.create(mk_tpu_job("gp-harvest", 4, elastic_min=4,
+                                         cls="low"))
+        pods = wait_until(lambda: (p := pods_of("gp-harvest"))
+                          and len(p) >= 8 and p,
+                          "gp-harvest gang Running", timeout=60.0)
+        namesC = sorted(p.metadata.name for p in pods)
+        beat_all(namesC, 0.3, step=1, phase="fit", examples_per_sec=50.0)
+        cluster.tfjobs.create(mk_tpu_job("gp-high", 2, cls="high"))
+        # The harvested pods fail with a WidthHarvested reason and are
+        # replaced within milliseconds (event-driven syncs), so polling
+        # the pod store races; the LEDGER is the surface under test and
+        # it observes the Failed window — wait on its bucket directly.
+        wait_until(
+            lambda: ctrl.goodput_tracker.summary(
+                ns, "gp-harvest", time.time()).buckets.get(
+                "harvested", 0.0) > 0.0,
+            "harvest badput in the ledger", timeout=60.0)
+        # The width engine re-shards the gang down; beat the reshard
+        # window on whichever generation is Running (a survivor being
+        # replaced mid-beat just retires with its reshard accrual).
+        survivors = wait_until(
+            lambda: (p := pods_of("gp-harvest")) and len(p) == 4
+            and [q.metadata.name for q in p],
+            "gp-harvest re-sharded to 4 pods", timeout=60.0)
+        beat_all(survivors, 0.35, step=1, phase="reshard")
+        beat_all(survivors, 0.2, step=2, phase="fit", examples_per_sec=50.0)
+        jobs["gp-harvest"] = job_summary("gp-harvest")
+        # Unrounded buckets for the gates: the harvested window is the
+        # Failed->deletion tail and can be a handful of milliseconds.
+        raw_harvest = dict(ctrl.goodput_tracker.summary(
+            ns, "gp-harvest", time.time()).buckets)
+        attribution_bad += attribution_errors("gp-harvest")
+        if ctrl.goodput_tracker.has_job(ns, "gp-high"):
+            jobs["gp-high"] = job_summary("gp-high")
+        cluster_ratio = ctrl.goodput_tracker.cluster_ratio()
+    finally:
+        ctrl.stop()
+        kubelet.stop()
+
+    a, b = jobs["gp-cold"]["buckets"], jobs["gp-warm"]["buckets"]
+    tot_good = sum(j["goodput_s"] for j in jobs.values())
+    tot_occ = sum(j["occupied_s"] for j in jobs.values())
+    gates = {
+        "attribution_sums_to_wall": not attribution_bad,
+        "kill_badput_in_restore_and_stalled": (
+            a.get("restore", 0.0) > 0.0 and a.get("stalled", 0.0) > 0.0),
+        "cold_and_warm_starts_attributed": (
+            a.get("starting_cold", 0.0) > 0.0
+            and a.get("starting_warm", 0.0) > 0.0),
+        "warm_compile_badput_halved": (
+            b.get("compile_miss", 0.0) * 2.0 <= a.get("compile_miss", 0.0)
+            and b.get("compile_cached", 0.0) > 0.0
+            and a.get("compile_miss", 0.0) > 0.0),
+        "harvest_badput_in_reshard": (
+            raw_harvest.get("reshard", 0.0) > 0.0
+            and raw_harvest.get("harvested", 0.0) > 0.0),
+        "status_surface_attached": (
+            status_goodput is not None
+            and 0.0 <= status_goodput.ratio <= 1.0
+            and status_goodput.wall_s > 0),
+        "cluster_ratio_sane": 0.0 <= cluster_ratio <= 1.0,
+    }
+
+    # ---- overhead probe: run_scale with the ledger on vs off ----------
+    # Two measurements (docs/PERF.md "Goodput ledger overhead"):
+    #
+    # 1. DIRECT (the gate): wall-clock spent inside the controller's
+    #    ledger adapter (`_observe_goodput`: build observations, fold
+    #    into the tracker, quantized rollup+publish) summed over every
+    #    sync of the ledger-on runs, as a fraction of those runs'
+    #    elapsed.  Deterministic to ~1%, which is what a CI gate needs.
+    # 2. PAIRED A/B (the PERF.md row): interleaved on/off wall-clock
+    #    pairs, medians — the PR 16 obs-plane discipline.  The scale
+    #    bench is scheduler-bound and single runs swing ±20%, so this
+    #    cannot resolve a few-percent effect reliably enough to gate on;
+    #    it rides along as the end-to-end sanity number.
+    from kubeflow_controller_tpu.controller.controller import (
+        Controller as _Ctrl)
+
+    ledger_s = [0.0]
+    orig_observe = _Ctrl._observe_goodput
+
+    def timed_observe(self, *a, **kw):
+        t0 = time.perf_counter()
+        try:
+            return orig_observe(self, *a, **kw)
+        finally:
+            ledger_s[0] += time.perf_counter() - t0
+
+    def scale_once(goodput_on: bool) -> float:
+        r = run_scale(scale_jobs, deadline_s=deadline_s, simulated=True,
+                      goodput=goodput_on)
+        if r["timed_out"] or r["failed"]:
+            raise RuntimeError(
+                f"goodput bench: scale probe (goodput={goodput_on}) "
+                f"did not converge: {r['timed_out'][:5]} {r['failed'][:5]}")
+        return r["elapsed_s"]
+
+    def median(vals) -> float:
+        vals = sorted(vals)
+        mid = len(vals) // 2
+        return (vals[mid] if len(vals) % 2
+                else (vals[mid - 1] + vals[mid]) / 2.0)
+
+    run_scale(10, simulated=True)  # warm the code paths off the clock
+    samples_off, samples_on = [], []
+    ledger_on_s = 0.0
+    _Ctrl._observe_goodput = timed_observe
+    try:
+        for _ in range(5):
+            samples_off.append(scale_once(False))
+            ledger_s[0] = 0.0
+            samples_on.append(scale_once(True))
+            ledger_on_s += ledger_s[0]
+    finally:
+        _Ctrl._observe_goodput = orig_observe
+    elapsed_off = median(samples_off)
+    elapsed_on = median(samples_on)
+    direct_pct = round(100.0 * ledger_on_s / sum(samples_on), 2)
+    paired_pct = round(
+        max(0.0, 100.0 * (elapsed_on - elapsed_off) / elapsed_off), 2)
+    gates["ledger_overhead_under_10pct"] = direct_pct < 10.0
+
+    badput_total: dict = {}
+    for j in jobs.values():
+        for bkt, v in j["buckets"].items():
+            if bkt not in GOODPUT_BUCKETS and bkt not in NON_OCCUPIED_BUCKETS:
+                badput_total[bkt] = round(badput_total.get(bkt, 0.0) + v, 3)
+    return {
+        "goodput_ratio": round(tot_good / tot_occ, 4) if tot_occ else 1.0,
+        "cluster_ratio_live": round(cluster_ratio, 4),
+        "badput_seconds_by_bucket": dict(sorted(badput_total.items())),
+        "jobs": jobs,
+        "gates": gates,
+        "attribution_errors": attribution_bad,
+        "scale": {"jobs": scale_jobs,
+                  "ledger_overhead_pct": direct_pct,
+                  "ledger_time_s": round(ledger_on_s, 3),
+                  "paired_overhead_pct": paired_pct,
+                  "elapsed_on_s": round(elapsed_on, 3),
+                  "elapsed_off_s": round(elapsed_off, 3),
+                  "samples_on_s": [round(v, 3) for v in samples_on],
+                  "samples_off_s": [round(v, 3) for v in samples_off],
+                  "aggregation": ("gate: direct ledger-path time over "
+                                  "the on-runs; row: median of 5 "
+                                  "interleaved on/off pairs")},
+    }
+
+
+def goodput_main(args) -> int:
+    result = run_goodput(scale_jobs=args.goodput_scale or 150,
+                         deadline_s=args.deadline or 120.0)
+    print(json.dumps({
+        "metric": "goodput_scenario_ratio",
+        "value": result["goodput_ratio"],
+        "unit": "ratio",
+        "details": result,
+    }))
+    rc = 0
+    for gate, ok in result["gates"].items():
+        if not ok:
+            print(f"goodput bench regression: gate {gate} failed "
+                  f"(details in the JSON doc)", file=sys.stderr)
+            rc = 1
     return rc
 
 
@@ -3604,6 +4005,18 @@ def main(argv=None) -> int:
                         "gang admitted by harvesting width, zero "
                         "whole-gang preemptions of elastic victims) — "
                         "ELASTIC_r01.json / make elastic-smoke")
+    p.add_argument("--goodput", action="store_true",
+                   help="goodput-ledger bench (observability plane): replay "
+                        "a chaos-kill + warm-restore + compile-cache + "
+                        "width-harvest scenario against the controller's "
+                        "time-accounting ledger (obs/goodput.py) and gate "
+                        "per-replica attribution summing to 100% of wall "
+                        "time, badput landing in the right buckets, and the "
+                        "--scale ledger overhead < 10% — GOODPUT_r01.json / "
+                        "make goodput-smoke")
+    p.add_argument("--goodput-scale", type=int, default=0, metavar="N",
+                   help="goodput mode: jobs for the ledger-overhead scale "
+                        "probe (default 150)")
     p.add_argument("--kills", type=int, default=2, metavar="K",
                    help="chaos mode: pods to kill (spread over the jobs)")
     p.add_argument("--seed", type=int, default=7, metavar="S",
@@ -3767,6 +4180,8 @@ def main(argv=None) -> int:
         return gateway_main(args)
     if args.serve:
         return serve_main(args)
+    if args.goodput:
+        return goodput_main(args)
     if args.elastic:
         return elastic_main(args)
     if args.chaos:

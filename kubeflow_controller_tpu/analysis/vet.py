@@ -22,7 +22,10 @@ concurrency/controller invariants that actually bite this codebase
 - ``metric-prefix`` / ``metric-catalogue`` — registered metric names carry
   the ``kctpu_`` prefix and stay in sync with docs/OBSERVABILITY.md;
 - ``event-reason-style``  — event reasons are CamelCase literals (dynamic
-  reasons defeat the recorder's dedup keys).
+  reasons defeat the recorder's dedup keys);
+- ``phase-registry``      — beat/PodProgress phase literals come from the
+  shared registry (obs/phases.py KNOWN_PHASES) so the stall detector's
+  hold list and the goodput ledger's bucket map stay exhaustive.
 
 Zero third-party dependencies: stdlib ``ast`` only.  Suppress a finding
 with an inline ``# kctpu: vet-ok(<rule>)`` marker on the offending line
@@ -768,6 +771,48 @@ class EventReasonRule(Rule):
                     "CamelCase literals/constants so dedup keys stay stable")
 
 
+class PhaseRegistryRule(Rule):
+    name = "phase-registry"
+    doc = ("beat/PodProgress phase literals come from the shared phase "
+           "registry (obs/phases.py KNOWN_PHASES): a phase the stall "
+           "detector and goodput ledger have never heard of silently "
+           "defeats the StallTracker hold list and lands in the wrong "
+           "goodput bucket")
+
+    #: Call shapes that carry a workload phase: reporter.beat(phase=...)
+    #: and PodProgress(phase=...) constructions.
+    _PHASE_CALLS = frozenset({"beat", "PodProgress"})
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        from ..obs.phases import KNOWN_PHASES  # lazy: obs is a leaf, cheap
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _tail_name(node.func) not in self._PHASE_CALLS:
+                continue
+            for kw in node.keywords:
+                if kw.arg != "phase":
+                    continue
+                v = kw.value
+                # Names/attributes (PHASE_* constants, variables) pass:
+                # only a literal can introduce a brand-new phase here.
+                if not (isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    continue
+                if v.value in KNOWN_PHASES:
+                    continue
+                if ctx.suppressed(self.name, node.lineno):
+                    continue
+                yield Finding(
+                    ctx.path, v.lineno, v.col_offset, self.name,
+                    f"beat phase {v.value!r} is not in the shared phase "
+                    f"registry (obs/phases.py KNOWN_PHASES): add it there "
+                    f"— with a goodput bucket and, if the phase freezes "
+                    f"the step counter on purpose, a STALL_HOLD_PHASES "
+                    f"entry — or use an existing phase")
+
+
 def all_rules() -> List[Rule]:
     from .lockgraph import LockGraphRule  # lazy: lockgraph imports vet
 
@@ -783,6 +828,7 @@ def all_rules() -> List[Rule]:
         GangWidthEnvRule(),
         MetricRules(),
         EventReasonRule(),
+        PhaseRegistryRule(),
         LockGraphRule(),
     ]
 

@@ -69,6 +69,13 @@ ANNOTATION_DRAIN = f"{DOMAIN}/drain"
 # the kubelet injects it into workload env as $KCTPU_TRACE_CONTEXT so
 # spans from every process of a job join ONE causal tree.
 ANNOTATION_TRACE_CONTEXT = f"{DOMAIN}/trace-context"
+# How this pod's process came up: "warm" (zygote readmission / warm pool)
+# or "cold" (full boot).  Stamped by the kubelet at spawn so the goodput
+# ledger (obs/goodput.py, which restates the literal to stay a leaf) can
+# split starting time into starting_warm / starting_cold.
+ANNOTATION_START_MODE = f"{DOMAIN}/start-mode"
+START_MODE_WARM = "warm"
+START_MODE_COLD = "cold"
 # --- serving front door (gateway/) ---
 # Gateway data-plane snapshot, written on the Serving TFJob by the
 # request gateway (JSON: routed qps, gateway-queued depth, shed counts

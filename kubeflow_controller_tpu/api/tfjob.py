@@ -398,9 +398,29 @@ class ServingStatus:
 
 
 @dataclass
+class JobGoodput:
+    """Goodput-ledger rollup on the status surface (obs/goodput.py):
+    where this job's accelerator-occupied time went, quantized to whole
+    seconds (and the ratio to 0.01) so periodic re-publication doesn't
+    churn status writes.  Doubles as the ledger's journal checkpoint:
+    after controller failover the new leader seeds its ledger from the
+    last persisted ``buckets``, making attribution exact-once across
+    failover (None until the job has attributed time)."""
+
+    goodput_s: int = 0     # seconds in goodput buckets (train/serving)
+    occupied_s: int = 0    # wall minus queue/scheduling/terminal time
+    wall_s: int = 0        # total attributed seconds across replicas
+    ratio: float = 0.0     # goodput_s / occupied_s, quantized to 0.01
+    # Per-bucket attributed seconds (nonzero buckets only; the closed
+    # taxonomy lives in obs/phases.py ALL_BUCKETS).
+    buckets: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
 class TFJobStatus:
     """ref: types.go:92-101 (+ net-new training-plane ``progress``,
-    elastic-plane ``width``, serving-plane ``serving``)."""
+    elastic-plane ``width``, serving-plane ``serving``, obs-plane
+    ``goodput``)."""
 
     phase: TFJobPhase = TFJobPhase.NONE
     reason: str = ""
@@ -409,6 +429,7 @@ class TFJobStatus:
     progress: Optional[JobProgress] = None
     width: Optional[JobWidth] = None
     serving: Optional[ServingStatus] = None
+    goodput: Optional[JobGoodput] = None
 
 
 @dataclass

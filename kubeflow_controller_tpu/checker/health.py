@@ -24,6 +24,7 @@ from ..api.core import (
     is_pod_active,
 )
 from ..api.tfjob import ReplicaType, TFJob
+from ..obs.phases import STALL_HOLD_PHASES
 from ..utils import locks
 from ..planner.materialize import gang_width, pod_index, pods_by_index
 
@@ -146,8 +147,12 @@ class StallTracker:
         # load + AOT warmup window, and "drain" finishes in-flight work
         # with intake closed.  The heartbeat deadline still applies to
         # all of them — a dead server stops beating and is flagged.
-        held_phase = getattr(progress, "phase", "") in (
-            "compile", "restore", "reshard", "load", "serving", "drain")
+        # The hold list is the shared registry's STALL_HOLD_PHASES
+        # (obs/phases.py): one vocabulary for the stall detector, the
+        # goodput ledger, and the phase-registry vet rule — a phase
+        # typo'd at a beat site is flagged instead of silently losing
+        # stall protection.
+        held_phase = getattr(progress, "phase", "") in STALL_HOLD_PHASES
         with self._lock:
             last_step, advanced_at, _, restoring = self._steps.get(
                 key, (None, 0.0, 0.0, False))

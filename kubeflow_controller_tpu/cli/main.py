@@ -340,6 +340,13 @@ def cmd_get(args) -> int:
         sv = j.status.serving
         if sv is not None and sv.replicas:
             kinds += f"[s={sv.ready}/{sv.replicas}]"
+        # Goodput ratio for running training jobs: "Workerx2[good=85%]"
+        # (share of occupied time spent training; obs/goodput.py ledger).
+        gp = j.status.goodput
+        if (gp is not None and gp.occupied_s > 0
+                and j.status.phase.value == "Running"
+                and j.status.progress is not None):
+            kinds += f"[good={gp.ratio:.0%}]"
         # Gateway front door, when publishing: routed QPS, prefix-cache
         # hit ratio, and total sheds (the overload tell).
         gw = _gateway_stats(j)
@@ -420,6 +427,7 @@ def cmd_describe(args) -> int:
     _describe_health(cluster, j, ns)
     _describe_compile_cache(j)
     _describe_progress(j)
+    _describe_goodput(j)
     try:
         events = [e for e in cluster.events.list(ns)
                   if e.involved_object.name == args.name]
@@ -537,6 +545,31 @@ def _describe_progress(j) -> None:
               f"phase={r.phase or '-'}{src}{res} beat {beat}{mark}")
 
 
+def _describe_goodput(j) -> None:
+    """Goodput section off status.goodput (obs/goodput.py ledger rollup):
+    the headline ratio plus where the badput went, bucket by bucket —
+    'where did my accelerator-hours go' without a live TSDB."""
+    from ..obs.phases import GOODPUT_BUCKETS, NON_OCCUPIED_BUCKETS
+
+    gp = j.status.goodput
+    if gp is None or gp.wall_s <= 0:
+        return
+    print(f"Goodput:   {gp.ratio:.0%} — {gp.goodput_s}s good of "
+          f"{gp.occupied_s}s occupied (wall {gp.wall_s}s)")
+    badput = {b: s for b, s in sorted(gp.buckets.items())
+              if b not in GOODPUT_BUCKETS and b not in NON_OCCUPIED_BUCKETS
+              and s > 0}
+    if badput:
+        print("  Badput:  "
+              + " ".join(f"{b}={s}s" for b, s in
+                         sorted(badput.items(), key=lambda kv: -kv[1])))
+    waiting = {b: s for b, s in sorted(gp.buckets.items())
+               if b in NON_OCCUPIED_BUCKETS and s > 0}
+    if waiting:
+        print("  Waiting: " + " ".join(f"{b}={s}s"
+                                       for b, s in sorted(waiting.items())))
+
+
 def _describe_health(cluster, job, ns: str) -> None:
     """Per-replica/per-slice health (checker/health.py) from the job's
     live pods — the slice is the TPU failure domain, so a gang with any
@@ -646,7 +679,7 @@ def cmd_top(args) -> int:
             _print_shard_depths(cluster, jobs, lease)
         print(f"{'NAMESPACE':<12} {'NAME':<32} {'PHASE':<10} {'STEP':<10} "
               f"{'RATE':<10} {'QPS':<8} {'TTFT':<9} {'OCC':<5} "
-              f"{'GWQPS':<7} {'HIT':<5} "
+              f"{'GWQPS':<7} {'HIT':<5} {'GOODPUT':<8} "
               f"{'LOSS':<10} {'LAG':<6} {'STALLED':<20} "
               f"{'SHARD':<6} BEAT")
         # Stalled jobs surface first (the rows an operator is looking for),
@@ -672,10 +705,13 @@ def cmd_top(args) -> int:
             sv = j.status.serving
             occ = f"{sv.occupancy:.0%}" if sv is not None and sv.ready else "-"
             gwqps, hit = _gateway_cells(j)
+            gp = j.status.goodput
+            good = (f"{gp.ratio:.0%}"
+                    if gp is not None and gp.occupied_s > 0 else "-")
             print(f"{j.metadata.namespace:<12} {j.metadata.name:<32} "
                   f"{j.status.phase.value:<10} {step:<10} {rate:<10} "
                   f"{qps:<8} {ttft:<9} {occ:<5} "
-                  f"{gwqps:<7} {hit:<5} "
+                  f"{gwqps:<7} {hit:<5} {good:<8} "
                   f"{loss:<10} {lag:<6} {stalled:<20} "
                   f"{_shard_cell(j, lease):<6} {beat}")
         if not args.watch:
@@ -685,6 +721,70 @@ def cmd_top(args) -> int:
         except KeyboardInterrupt:
             return 0
         print()
+
+
+def cmd_goodput(args) -> int:
+    """Time-accounting table off each job's status.goodput (the controller
+    ledger's per-job rollup): headline ratio, goodput/occupied/wall
+    seconds, dominant badput bucket — plus an occupied-weighted cluster
+    rollup.  ``--job`` drills into one job's full bucket breakdown."""
+    from ..obs.phases import GOODPUT_BUCKETS, NON_OCCUPIED_BUCKETS
+
+    cluster = _rest_cluster_or_die(args, probe=False)
+    if cluster is None:
+        return 2
+    try:
+        jobs = cluster.tfjobs.list(args.namespace or None)
+    except APIError as e:
+        print(f"error talking to API server: {e}", file=sys.stderr)
+        return 2
+    if args.job:
+        matches = [j for j in jobs if j.metadata.name == args.job]
+        if not matches:
+            print(f"tfjob {args.job} not found", file=sys.stderr)
+            return 1
+        j = matches[0]
+        gp = j.status.goodput
+        if gp is None or gp.wall_s <= 0:
+            print(f"{j.metadata.namespace}/{j.metadata.name}: no goodput "
+                  f"ledger yet (job too young, or controller not running)")
+            return 0
+        print(f"{j.metadata.namespace}/{j.metadata.name}: "
+              f"goodput {gp.ratio:.0%} "
+              f"({gp.goodput_s}s of {gp.occupied_s}s occupied; "
+              f"wall {gp.wall_s}s)")
+        print(f"{'BUCKET':<16} {'SECONDS':>8}  CLASS")
+        for b, s in sorted(gp.buckets.items(), key=lambda kv: -kv[1]):
+            cls = ("goodput" if b in GOODPUT_BUCKETS
+                   else "waiting" if b in NON_OCCUPIED_BUCKETS
+                   else "badput")
+            print(f"{b:<16} {s:>8}  {cls}")
+        return 0
+    rows = [(j, j.status.goodput) for j in jobs
+            if j.status.goodput is not None and j.status.goodput.wall_s > 0]
+    if not rows:
+        print("No goodput ledgers found (controller attaches status."
+              "goodput once jobs have run for a few seconds).")
+        return 0
+    print(f"{'NAMESPACE':<12} {'NAME':<32} {'GOODPUT':<8} {'GOOD_S':>8} "
+          f"{'OCC_S':>8} {'WALL_S':>8}  TOP-BADPUT")
+    tot_good = tot_occ = 0
+    for j, gp in sorted(rows, key=lambda r: r[1].ratio):
+        badput = {b: s for b, s in gp.buckets.items()
+                  if b not in GOODPUT_BUCKETS
+                  and b not in NON_OCCUPIED_BUCKETS and s > 0}
+        top = (max(badput.items(), key=lambda kv: kv[1])
+               if badput else None)
+        top_cell = f"{top[0]}={top[1]}s" if top else "-"
+        print(f"{j.metadata.namespace:<12} {j.metadata.name:<32} "
+              f"{gp.ratio:<8.0%} {gp.goodput_s:>8} "
+              f"{gp.occupied_s:>8} {gp.wall_s:>8}  {top_cell}")
+        tot_good += gp.goodput_s
+        tot_occ += gp.occupied_s
+    ratio = tot_good / tot_occ if tot_occ else 1.0
+    print(f"cluster: goodput {ratio:.0%} "
+          f"({tot_good}s of {tot_occ}s occupied, {len(rows)} job(s))")
+    return 0
 
 
 def cmd_delete(args) -> int:
@@ -1149,6 +1249,14 @@ def build_parser() -> argparse.ArgumentParser:
     tp.add_argument("-w", "--watch", type=float, default=0.0, metavar="S",
                     help="re-render every S seconds until interrupted")
 
+    gp = sub.add_parser("goodput", help="phase-attributed time accounting "
+                                        "per TFJob + cluster rollup "
+                                        "(obs/goodput.py ledger)")
+    gp.add_argument("-n", "--namespace", default="")
+    gp.add_argument("--job", default="", metavar="NAME",
+                    help="per-bucket breakdown for one job instead of the "
+                         "fleet table")
+
     de = sub.add_parser("delete", help="delete a TFJob (REST mode: pass -master)")
     de.add_argument("name")
     de.add_argument("-n", "--namespace", default="default")
@@ -1306,6 +1414,8 @@ def _main(argv=None) -> int:
         return cmd_logs(args)
     if args.cmd == "top":
         return cmd_top(args)
+    if args.cmd == "goodput":
+        return cmd_goodput(args)
     if args.cmd == "delete":
         return cmd_delete(args)
     if args.cmd == "metrics":

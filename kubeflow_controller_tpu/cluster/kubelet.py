@@ -526,6 +526,22 @@ class FakeKubelet:
         except NotFound:
             pass
 
+    def _stamp_start_mode(self, namespace: str, name: str, warm: bool) -> None:
+        """Record warm/cold on the pod at spawn (best-effort) so the
+        goodput ledger can attribute starting time to the right bucket."""
+        from ..api.labels import (
+            ANNOTATION_START_MODE, START_MODE_COLD, START_MODE_WARM)
+
+        mode = START_MODE_WARM if warm else START_MODE_COLD
+
+        def apply(meta):
+            meta.annotations[ANNOTATION_START_MODE] = mode
+
+        try:
+            self.cluster.pods.patch_meta(namespace, name, apply)
+        except NotFound:
+            pass
+
     def _drive(self, pod: Pod) -> None:
         ns, name = pod.metadata.namespace, pod.metadata.name
         key = self._key(pod)
@@ -610,6 +626,7 @@ class FakeKubelet:
         gang = pod.metadata.annotations.get(ANNOTATION_GANG_NAME, "") or self._key(pod)
         warm = gang in self._warm_gangs
         self._c_starts.labels("warm" if warm else "cold").inc()
+        self._stamp_start_mode(ns, name, warm)
         delay = self.policy.warm_start_s if warm else self.policy.cold_start_s
         deadline = time.monotonic() + delay
         while delay > 0 and not self._stop.is_set():
@@ -903,6 +920,8 @@ class FakeKubelet:
                     self.set_phase(ns, name, PHASE_FAILED, reason=f"StartError: {e}")
                     return
                 self._c_starts.labels("cold").inc()
+                if restarts == 0:
+                    self._stamp_start_mode(ns, name, warm=False)
                 self._procs[self._key(pod)] = proc
                 proc.wait()
             finally:
@@ -960,6 +979,8 @@ class FakeKubelet:
                     self.set_phase(ns, name, PHASE_FAILED, reason=f"StartError: {e}")
                     return
                 self._c_starts.labels("warm").inc()
+                if restarts == 0:
+                    self._stamp_start_mode(ns, name, warm=True)
                 self._warm[key] = proc
                 # Register the pool's files as this pod's logs.
                 self._log_paths.setdefault(key, []).extend(

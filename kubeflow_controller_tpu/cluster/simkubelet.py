@@ -218,6 +218,22 @@ class SimKubelet:
         except NotFound:
             pass
 
+    def _stamp_start_mode(self, namespace: str, name: str, warm: bool) -> None:
+        """Record warm/cold on the pod at admission (best-effort) so the
+        goodput ledger can attribute starting time to the right bucket."""
+        from ..api.labels import (
+            ANNOTATION_START_MODE, START_MODE_COLD, START_MODE_WARM)
+
+        mode = START_MODE_WARM if warm else START_MODE_COLD
+
+        def apply(meta):
+            meta.annotations[ANNOTATION_START_MODE] = mode
+
+        try:
+            self.cluster.pods.patch_meta(namespace, name, apply)
+        except NotFound:
+            pass
+
     # -- timer wheel ---------------------------------------------------------
 
     def _arm(self, delay_s: float, key: str, action: str) -> None:
@@ -326,6 +342,8 @@ class SimKubelet:
             warm = gang in self._warm_gangs
             self._warm_gangs.add(gang)
             self._c_starts.labels("warm" if warm else "cold").inc()
+            self._stamp_start_mode(pod.metadata.namespace,
+                                   pod.metadata.name, warm)
             delay = (self.policy.warm_start_s if warm
                      else self.policy.cold_start_s)
             self._arm(delay, key, _WARMUP)

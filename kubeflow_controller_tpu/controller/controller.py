@@ -39,6 +39,7 @@ from ..api.labels import LABEL_JOB_TYPE, job_selector
 from ..api.meta import get_controller_of, key_of, split_key
 from ..api.tfjob import (
     KIND,
+    JobGoodput,
     ReplicaType,
     TFJob,
     TFJobPhase,
@@ -52,10 +53,25 @@ from ..cluster.client import Cluster
 from ..cluster.store import Conflict, NotFound
 from ..cluster.tpu import TPUInventory
 from ..obs import trace
+from ..obs.goodput import (
+    ANNOTATION_START_MODE,
+    GoodputTracker,
+    PodObservation,
+)
 from ..obs.lifecycle import job_lifecycle
 from ..obs.metrics import REGISTRY
+from ..obs.phases import (
+    POD_REASON_PREEMPTED_PREFIX,
+    POD_REASON_QUEUED_PREFIX,
+)
 from ..planner import plan_job
-from ..planner.materialize import gang_name, make_pod, make_service, trace_context_for
+from ..planner.materialize import (
+    gang_name,
+    make_pod,
+    make_service,
+    pod_index,
+    trace_context_for,
+)
 from ..planner.types import Action
 from ..updater import RollupCache, compute_status, should_update
 from ..utils import locks, serde
@@ -168,6 +184,15 @@ class Controller:
         # from the LAST sync, for edge-triggered GangQueued/GangAdmitted/
         # GangPreempted events (shares the stalled lock — same cadence).
         self._gang_state: Dict[str, str] = {}
+        # Goodput ledger (obs/goodput.py): every sync's observed pods are
+        # folded into per-job phase-attributed time accounting; the
+        # quantized rollup lands on status.goodput at most once per
+        # ``goodput_status_interval_s`` (plus the terminal edge) so the
+        # ticking seconds don't force a status write per sync.  The
+        # per-key last-attach time shares the stalled lock (same cadence).
+        self.goodput_tracker = GoodputTracker()
+        self.goodput_status_interval_s = 15.0
+        self._goodput_pub: Dict[str, float] = {}
         # Serving plane: the queue-depth autoscaler (serving/autoscale.py)
         # and the per-job set of replica indices whose serving gauge
         # series are live — scale-down calls Gauge.remove for indices
@@ -503,6 +528,7 @@ class Controller:
         self.rollup_cache.forget(key)
         self._drop_progress_series(key, job)
         self._drop_serving_series(key, job)
+        self._drop_goodput(key)
         if self.inventory is not None and is_tpu_job(job):
             self.inventory.release_gang(gang_name(job))
         self.queue.add(key)  # final sync performs cleanup if needed
@@ -572,6 +598,7 @@ class Controller:
             # this sync is per-key-ordered after any publish that raced the
             # delete handler's first drop.
             self._drop_serving_series(key)
+            self._drop_goodput(key)
             self.expectations.delete_expectations(key)
             if self.controller_shards > 1:
                 # Final sync of a dead job, running on its owning shard:
@@ -694,6 +721,7 @@ class Controller:
             self._publish_progress(key, job, new_status)
             self._publish_gang_state(key, job, pods_by_type)
             self._publish_serving(key, job, pods_by_type, new_status)
+            self._observe_goodput(key, job, pods_by_type, new_status)
             if should_update(job.status, new_status):
                 self._update_status(job, new_status)
             self.rollup_cache.store(key, fp, new_status)
@@ -777,11 +805,13 @@ class Controller:
         queue_msg = next(
             (p.status.reason for p in pods
              if p.status.phase == PHASE_PENDING
-             and (p.status.reason or "").startswith("GangQueued")), "")
+             and (p.status.reason or "").startswith(
+                 POD_REASON_QUEUED_PREFIX)), "")
         preempt_msg = next(
             (p.status.reason for p in pods
              if p.status.phase == PHASE_FAILED
-             and (p.status.reason or "").startswith("Preempted")), "")
+             and (p.status.reason or "").startswith(
+                 POD_REASON_PREEMPTED_PREFIX)), "")
         running = sum(1 for p in pods if p.status.phase == PHASE_RUNNING)
         if preempt_msg:
             state = "preempted"
@@ -867,8 +897,6 @@ class Controller:
         self._g_serve_ttft_p99.labels(ns, name).set(sv.ttft_p99_ms)
         self._g_serve_replicas.labels(ns, name).set(sv.replicas)
         self._g_serve_ready.labels(ns, name).set(sv.ready)
-        from ..planner.materialize import pod_index
-
         live = set()
         for p in pods_by_type.get(ReplicaType.SERVING, []):
             pr = p.status.progress
@@ -886,6 +914,81 @@ class Controller:
         for idx in before - live:
             self._g_serve_queue.remove(ns, name, idx)
             self._g_serve_occ.remove(ns, name, idx)
+
+    def _observe_goodput(self, key: str, job: TFJob, pods_by_type,
+                         status) -> None:
+        """Fold this sync's observed pods into the goodput ledger
+        (obs/goodput.py) and surface the rollup.
+
+        Runs on every rollup-cache miss — the only syncs where a bucket
+        can have changed, since every bucket input (pod phase, beat,
+        stall verdict) either bumps a pod RV or defeats the cache
+        (progress-bearing jobs never hit).  The ledger's open intervals
+        accrue wall-clock time regardless of sync cadence.  The
+        quantized ``status.goodput`` checkpoint is attached at most once
+        per ``goodput_status_interval_s`` (plus ONE terminal-edge
+        flush); between attachments the previously persisted value is
+        carried so ``should_update`` sees no goodput-only churn."""
+        if self.goodput_tracker is None:
+            # Ledger disabled (bench.py --scale overhead comparison).
+            status.goodput = job.status.goodput
+            return
+        ns, name = job.metadata.namespace, job.metadata.name
+        now = time.time()
+        if (job.status.goodput is not None
+                and not self.goodput_tracker.has_job(ns, name)):
+            # Controller failover: adopt the bucket totals the previous
+            # leader persisted, then account forward from here.
+            self.goodput_tracker.bootstrap(
+                ns, name, dict(job.status.goodput.buckets))
+        stalled = (set(status.progress.stalled_replicas)
+                   if status.progress is not None else set())
+        observations = []
+        for typ, pods in (pods_by_type or {}).items():
+            for p in pods:
+                pr = p.status.progress
+                idx = pod_index(p)
+                rname = f"{typ.value}-{idx}" if idx is not None else ""
+                observations.append(PodObservation(
+                    name=p.metadata.name,
+                    pod_phase=p.status.phase,
+                    reason=p.status.reason or "",
+                    start_mode=p.metadata.annotations.get(
+                        ANNOTATION_START_MODE, ""),
+                    beat_phase=pr.phase if pr is not None else None,
+                    compile_source=pr.compile_source if pr is not None else "",
+                    stalled=rname in stalled,
+                ))
+        self.goodput_tracker.observe(ns, name, observations, now)
+        terminal = status.phase in (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED)
+        with self._stalled_lock:
+            last = self._goodput_pub.get(key, 0.0)
+            # The terminal edge flushes ONCE (sentinel inf): a finished
+            # job keeps syncing while its pods drain, and re-attaching a
+            # still-growing rollup each time would churn status forever.
+            due = ((terminal and last != float("inf"))
+                   or now - last >= self.goodput_status_interval_s)
+            if due:
+                self._goodput_pub[key] = float("inf") if terminal else now
+        if not due:
+            # Off the publish edge the ledger only accrues — the rollup
+            # walk and metric push wait for the quantized interval (this
+            # keeps the per-sync ledger cost flat; bench --goodput gates
+            # the --scale overhead on it).
+            status.goodput = job.status.goodput
+            return
+        summary = self.goodput_tracker.summary(ns, name, now)
+        self.goodput_tracker.publish(ns, name, now)
+        if summary is not None and summary.wall_s >= 1.0:
+            status.goodput = JobGoodput(
+                goodput_s=int(summary.goodput_s),
+                occupied_s=int(summary.occupied_s),
+                wall_s=int(summary.wall_s),
+                ratio=round(summary.ratio, 2),
+                buckets={b: int(v) for b, v in summary.buckets.items()
+                         if int(v) > 0})
+        else:
+            status.goodput = job.status.goodput
 
     def _record_flight(self, key: str, job: TFJob, pods_by_type,
                        status, reason: str) -> Optional[str]:
@@ -912,7 +1015,9 @@ class Controller:
             progress=progress,
             status_history=job_lifecycle().history(job.metadata.uid),
             status=serde.to_dict(status),
-            tsdb=self._tsdb)
+            tsdb=self._tsdb,
+            goodput=(self.goodput_tracker.snapshot(ns, name, time.time())
+                     if self.goodput_tracker is not None else None))
         if path:
             logger.info("flight recorder: wrote %s for %s (%s)",
                         path, key, reason)
@@ -947,6 +1052,16 @@ class Controller:
             g.remove(ns, name)
         self.serving_autoscaler.forget_job(key)
 
+    def _drop_goodput(self, key: str) -> None:
+        """Goodput series + ledger state die with the job (same triple
+        call-site discipline as _drop_serving_series)."""
+        if self.goodput_tracker is None:
+            return
+        ns, name = split_key(key)
+        self.goodput_tracker.drop(ns, name)
+        with self._stalled_lock:
+            self._goodput_pub.pop(key, None)
+
     def _drop_progress_series(self, key: str, job: TFJob) -> None:
         """Per-job gauge series + stall bookkeeping die with the job."""
         from .helper import OWNER_UID_INDEX
@@ -970,6 +1085,7 @@ class Controller:
         ns, name = job.metadata.namespace, job.metadata.name
         self._drop_progress_series(key, job)
         self._drop_serving_series(key, job)
+        self._drop_goodput(key)
         if self.inventory is not None and is_tpu_job(job):
             self.inventory.release_gang(gang_name(job))
         if job.spec.runtime_id:  # no children can exist before stamping

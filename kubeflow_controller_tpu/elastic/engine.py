@@ -55,7 +55,11 @@ KIND_EXPAND = "expand"
 
 # Pod failure-reason prefix the scheduler's harvest pass stamps; exempt
 # from restart accounting (recovery/policy.py) exactly like "Preempted".
-REASON_HARVESTED_PREFIX = "WidthHarvested"
+# One literal, shared with the scheduler and the goodput ledger's
+# "harvested" bucket (obs/phases.py).
+from ..obs.phases import (
+    POD_REASON_HARVESTED_PREFIX as REASON_HARVESTED_PREFIX,
+)
 
 
 @dataclass

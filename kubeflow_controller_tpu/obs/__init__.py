@@ -18,7 +18,12 @@ measurement substrate the ROADMAP's perf work reports against:
 - :mod:`.slo` — declarative objectives evaluated over TSDB windows with
   multi-window burn-rate alerting (``kctpu alerts``);
 - :mod:`.flight` — postmortem bundles (trace + events + progress +
-  status history + TSDB windows) cut on terminal job failure.
+  status history + TSDB windows + goodput ledger) cut on terminal job
+  failure;
+- :mod:`.phases` — the shared phase/bucket vocabulary (beat phases,
+  stall-hold set, ledger taxonomy, pod-reason prefixes);
+- :mod:`.goodput` — the goodput ledger: per-job phase-attributed time
+  accounting from queue to step (``kctpu goodput``).
 
 Everything is stdlib-only and safe to import from any layer (no imports
 back into controller/cluster/workloads).
@@ -54,3 +59,15 @@ from .lifecycle import JobLifecycle, job_lifecycle  # noqa: F401
 from .tsdb import TSDB, default_tsdb  # noqa: F401
 from .slo import Objective, SLOEngine, default_objectives, default_slo_engine  # noqa: F401
 from .flight import DEBUG_DIR_ENV, read_bundle, record_flight  # noqa: F401
+from .phases import (  # noqa: F401
+    ALL_BUCKETS,
+    GOODPUT_BUCKETS,
+    KNOWN_PHASES,
+    STALL_HOLD_PHASES,
+    bucket_for_beat_phase,
+)
+from .goodput import (  # noqa: F401
+    GoodputTracker,
+    JobGoodputSummary,
+    PodObservation,
+)

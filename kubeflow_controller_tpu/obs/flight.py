@@ -13,6 +13,8 @@ that produced them:
         progress.json   last progress beats per pod
         status.json     phase-transition history (obs/lifecycle.py ring)
         tsdb.json       relevant retained-series windows (obs/tsdb.py)
+        goodput.json    the job's goodput-ledger snapshot (obs/goodput.py)
+                        — "where did the time go" without a live TSDB
 
 Everything is passed IN by the caller (controller/controller.py) —
 obs/ stays a leaf package with no imports from the control plane.
@@ -79,6 +81,7 @@ def record_flight(namespace: str, name: str, *,
                   progress: Optional[Dict[str, Any]] = None,
                   status_history: Optional[List[Dict[str, Any]]] = None,
                   status: Optional[Dict[str, Any]] = None,
+                  goodput: Optional[Dict[str, Any]] = None,
                   tsdb: Optional[TSDB] = None,
                   tsdb_window_s: float = DEFAULT_TSDB_WINDOW_S,
                   extra_trace_events: Optional[List[Dict[str, Any]]] = None,
@@ -103,6 +106,7 @@ def record_flight(namespace: str, name: str, *,
         })
         _write_json(bundle, "tsdb.json",
                     tsdb.dump_window(tsdb_window_s, now=t) if tsdb else {})
+        _write_json(bundle, "goodput.json", goodput or {})
         _write_json(bundle, "manifest.json", {
             "namespace": namespace, "name": name, "reason": reason,
             "trace_id": trace_id, "captured_at": t,
@@ -111,7 +115,8 @@ def record_flight(namespace: str, name: str, *,
             "status_transitions": len(status_history or []),
             "tsdb_window_s": tsdb_window_s,
             "files": ["manifest.json", "trace.json", "events.json",
-                      "progress.json", "status.json", "tsdb.json"],
+                      "progress.json", "status.json", "tsdb.json",
+                      "goodput.json"],
         })
         return bundle
     except OSError:

@@ -1,0 +1,472 @@
+"""Goodput ledger: phase-attributed time accounting, queue to step.
+
+The progress plane knows *what* every replica is doing (beats carry a
+phase); the control plane knows *whether* it is scheduled, running,
+preempted.  This module folds both observation streams into the number a
+TPU fleet is actually run on — **goodput**, the fraction of
+accelerator-occupied time spent on useful steps — by attributing every
+second of each replica's lifetime to exactly one bucket of the closed
+taxonomy in :mod:`obs.phases` (``ALL_BUCKETS``).
+
+Design rules:
+
+- **Contiguous by construction.**  Each pod ledger holds one open
+  interval (current bucket + since-timestamp); an observation closes it
+  at ``now`` and opens the next at the same instant.  Summed buckets
+  therefore equal wall-time since first observation exactly — no gaps,
+  no double-count at transitions (bench ``--goodput`` still verifies).
+- **Leaf purity.**  obs/ imports nothing above it; the controller adapts
+  its pods into plain :class:`PodObservation` records.
+- **Exact-once across failover.**  The per-job rollup persisted into
+  ``TFJobStatus.goodput`` is the ledger's journal checkpoint: a new
+  controller seeds :meth:`GoodputTracker.bootstrap` with the carried
+  totals from the last written status and accounts forward from its own
+  first observation, so a failover coarsens attribution by at most one
+  status-publish interval and never double-counts.
+- **Series-budget aware.**  One ``kctpu_goodput_ratio`` gauge series and
+  up to ``len(ALL_BUCKETS)`` ``kctpu_badput_seconds_total`` counter
+  series per job, all removed on job delete.
+
+Attribution at the tricky boundaries (the full table is in
+docs/OBSERVABILITY.md):
+
+- compile time accrues as ``compile_miss`` until the beat's
+  ``compile_source`` resolves; ``"cache-hit"`` re-attributes the accrued
+  episode to ``compile_cached`` (provenance arrives only when the
+  compile does).
+- the stall detector's verdict overrides the beat bucket: a replica
+  beating ``fit`` with a frozen step past deadline is ``stalled``, not
+  ``train``.
+- ``Failed`` pods with the scheduler's ``Preempted``/``WidthHarvested``
+  reason accrue ``preempted``/``harvested`` until the controller
+  replaces them — the recovery tail a kill costs; all other terminal
+  pods accrue ``terminal`` (excluded from the ratio denominator).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..utils import locks
+from . import metrics as metrics_mod
+from .phases import (
+    ALL_BUCKETS,
+    BUCKET_HARVESTED,
+    BUCKET_PREEMPTED,
+    BUCKET_QUEUED,
+    BUCKET_SCHEDULING,
+    BUCKET_STARTING_COLD,
+    BUCKET_STARTING_WARM,
+    BUCKET_STALLED,
+    BUCKET_TERMINAL,
+    COMPILE_SOURCE_CACHE_HIT,
+    BUCKET_COMPILE_CACHED,
+    BUCKET_COMPILE_MISS,
+    GOODPUT_BUCKETS,
+    NON_OCCUPIED_BUCKETS,
+    POD_REASON_HARVESTED_PREFIX,
+    POD_REASON_PREEMPTED_PREFIX,
+    POD_REASON_QUEUED_PREFIX,
+    bucket_for_beat_phase,
+)
+
+# Pod phases, restated to keep obs/ a leaf (api/core.py defines the same
+# literals; serde stability there is a tier-1 invariant).
+_POD_PENDING = "Pending"
+_POD_RUNNING = "Running"
+_POD_SUCCEEDED = "Succeeded"
+_POD_FAILED = "Failed"
+
+# The ratio is meaningless over a cold few seconds (everything is
+# rendezvous/compile); gauges publish once a job has this much
+# accelerator-occupied time on the books.
+RATIO_WARMUP_S = 5.0
+
+# Retired (disappeared) pod ledgers retained per job before the oldest
+# are folded into the job's carried totals — bounds memory for a
+# crash-looping job that churns replicas forever.
+MAX_RETIRED_PODS = 64
+
+# Start-mode annotation the kubelet stamps on pods it admitted from the
+# warm pool ("warm") vs cold-booted ("cold"); absent = cold.  Restates
+# api.labels.ANNOTATION_START_MODE — obs/ is a leaf package.
+ANNOTATION_START_MODE = "kubeflow.caicloud.io/start-mode"
+START_MODE_WARM = "warm"
+START_MODE_COLD = "cold"
+
+
+@dataclass
+class PodObservation:
+    """One pod as the ledger sees it — the controller's adapter output.
+
+    ``beat_phase`` is None when the pod has never beat (starting), else
+    the beat's phase string ("" included)."""
+
+    name: str = ""
+    pod_phase: str = _POD_PENDING
+    reason: str = ""
+    start_mode: str = ""              # "" | "cold" | "warm" (annotation)
+    beat_phase: Optional[str] = None  # None = no beat yet
+    compile_source: str = ""
+    stalled: bool = False
+
+
+@dataclass
+class JobGoodputSummary:
+    """The per-job rollup: what status/CLI/flight bundles consume."""
+
+    goodput_s: float = 0.0     # time in GOODPUT_BUCKETS
+    occupied_s: float = 0.0    # wall minus NON_OCCUPIED_BUCKETS
+    wall_s: float = 0.0        # total attributed time
+    ratio: float = 0.0         # goodput_s / occupied_s (0 when unoccupied)
+    buckets: Dict[str, float] = field(default_factory=dict)  # nonzero only
+    replicas: int = 0          # pod ledgers folded in (live + retired)
+
+
+def bucket_for(obs: PodObservation) -> Optional[str]:
+    """The taxonomy decision: one bucket per observation, or None to
+    hold the current interval open (indeterminate pod phase)."""
+    ph = obs.pod_phase
+    if ph == _POD_PENDING:
+        if obs.reason.startswith(POD_REASON_QUEUED_PREFIX):
+            return BUCKET_QUEUED
+        return BUCKET_SCHEDULING
+    if ph == _POD_FAILED:
+        if obs.reason.startswith(POD_REASON_PREEMPTED_PREFIX):
+            return BUCKET_PREEMPTED
+        if obs.reason.startswith(POD_REASON_HARVESTED_PREFIX):
+            return BUCKET_HARVESTED
+        return BUCKET_TERMINAL
+    if ph == _POD_SUCCEEDED:
+        return BUCKET_TERMINAL
+    if ph == _POD_RUNNING:
+        if obs.stalled:
+            return BUCKET_STALLED
+        if obs.beat_phase is None:
+            return (BUCKET_STARTING_WARM if obs.start_mode == START_MODE_WARM
+                    else BUCKET_STARTING_COLD)
+        return bucket_for_beat_phase(obs.beat_phase, obs.compile_source)
+    return None  # Unknown: hold the last attribution
+
+
+class PodLedger:
+    """One replica's attributed lifetime: an open interval plus totals.
+
+    Not thread-safe on its own — the owning :class:`GoodputTracker`
+    serializes access."""
+
+    __slots__ = ("first_seen", "bucket", "since", "totals",
+                 "_unresolved_compile_s", "_compile_resolved", "retired_at")
+
+    def __init__(self, now: float) -> None:
+        self.first_seen = now
+        self.bucket: Optional[str] = None
+        self.since = now
+        self.totals: Dict[str, float] = {}
+        # compile_miss seconds accrued while provenance was still
+        # unreported — moved to compile_cached if it resolves cache-hit.
+        self._unresolved_compile_s = 0.0
+        # Whether compile provenance was known as of the LAST observation
+        # — the open interval accrues under that knowledge, not the next
+        # observation's (which is what closes it).
+        self._compile_resolved = False
+        self.retired_at: Optional[float] = None
+
+    def observe(self, obs: PodObservation, now: float) -> None:
+        if self.retired_at is not None:
+            return
+        now = max(now, self.since)  # clock must not run backward
+        nxt = bucket_for(obs)
+        self._accrue(now)
+        if obs.compile_source and not self._compile_resolved:
+            # Provenance just resolved: re-attribute the accrued episode.
+            if (obs.compile_source == COMPILE_SOURCE_CACHE_HIT
+                    and self._unresolved_compile_s > 0.0):
+                moved = min(self._unresolved_compile_s,
+                            self.totals.get(BUCKET_COMPILE_MISS, 0.0))
+                if moved > 0.0:
+                    self.totals[BUCKET_COMPILE_MISS] -= moved
+                    self.totals[BUCKET_COMPILE_CACHED] = (
+                        self.totals.get(BUCKET_COMPILE_CACHED, 0.0) + moved)
+            self._unresolved_compile_s = 0.0
+        self._compile_resolved = bool(obs.compile_source)
+        if not self._compile_resolved and nxt != BUCKET_COMPILE_MISS:
+            # Not compiling and no provenance pending: a later compile
+            # episode starts its own unresolved accrual from zero.
+            self._unresolved_compile_s = 0.0
+        if nxt is not None and nxt != self.bucket:
+            self.bucket = nxt
+
+    def retire(self, now: float) -> None:
+        """Close the books: the pod disappeared (deleted/replaced)."""
+        if self.retired_at is not None:
+            return
+        now = max(now, self.since)
+        self._accrue(now)
+        self.retired_at = now
+        self.bucket = None
+
+    def _accrue(self, now: float) -> None:
+        """Close the open interval at ``now`` into totals, reopening at
+        the same instant — the no-gap/no-double-count invariant."""
+        if self.bucket is not None:
+            dt = now - self.since
+            if dt > 0.0:
+                self.totals[self.bucket] = (
+                    self.totals.get(self.bucket, 0.0) + dt)
+                if (self.bucket == BUCKET_COMPILE_MISS
+                        and not self._compile_resolved):
+                    self._unresolved_compile_s += dt
+        self.since = now
+
+    def wall_s(self, now: float) -> float:
+        end = self.retired_at if self.retired_at is not None else max(
+            now, self.since)
+        return end - self.first_seen
+
+    def attributed_s(self, now: float) -> float:
+        """Totals plus the open interval — equals :meth:`wall_s` always;
+        bench --goodput gates on exactly that."""
+        open_s = 0.0
+        if self.retired_at is None and self.bucket is not None:
+            open_s = max(0.0, now - self.since)
+        return sum(self.totals.values()) + open_s
+
+    def snapshot(self, now: float) -> Dict[str, float]:
+        """Totals including the open interval, without mutating state."""
+        out = dict(self.totals)
+        if self.retired_at is None and self.bucket is not None:
+            dt = max(0.0, now - self.since)
+            if dt > 0.0:
+                out[self.bucket] = out.get(self.bucket, 0.0) + dt
+        return out
+
+
+class JobLedger:
+    """All of one job's pod ledgers plus carried totals from before this
+    controller's first observation (failover bootstrap, retired-pod
+    folding)."""
+
+    __slots__ = ("pods", "carried", "retired_order")
+
+    def __init__(self) -> None:
+        self.pods: Dict[str, PodLedger] = {}
+        self.carried: Dict[str, float] = {}
+        self.retired_order: List[str] = []
+
+    def observe(self, observations: Iterable[PodObservation],
+                now: float) -> None:
+        seen = set()
+        for obs in observations:
+            if not obs.name:
+                continue
+            seen.add(obs.name)
+            led = self.pods.get(obs.name)
+            if led is None:
+                led = self.pods[obs.name] = PodLedger(now)
+            led.observe(obs, now)
+        for name, led in self.pods.items():
+            if name not in seen and led.retired_at is None:
+                led.retire(now)
+                self.retired_order.append(name)
+        while len(self.retired_order) > MAX_RETIRED_PODS:
+            oldest = self.retired_order.pop(0)
+            led = self.pods.pop(oldest, None)
+            if led is not None:
+                for b, s in led.totals.items():
+                    self.carried[b] = self.carried.get(b, 0.0) + s
+
+    def bucket_totals(self, now: float) -> Dict[str, float]:
+        out = dict(self.carried)
+        for led in self.pods.values():
+            for b, s in led.snapshot(now).items():
+                out[b] = out.get(b, 0.0) + s
+        return out
+
+    def summary(self, now: float) -> JobGoodputSummary:
+        totals = self.bucket_totals(now)
+        wall = sum(totals.values())
+        good = sum(totals.get(b, 0.0) for b in GOODPUT_BUCKETS)
+        occupied = wall - sum(
+            totals.get(b, 0.0) for b in NON_OCCUPIED_BUCKETS)
+        ratio = (good / occupied) if occupied > 0.0 else 0.0
+        return JobGoodputSummary(
+            goodput_s=good, occupied_s=max(0.0, occupied), wall_s=wall,
+            ratio=min(1.0, max(0.0, ratio)),
+            buckets={b: s for b, s in sorted(totals.items()) if s > 0.0},
+            replicas=len(self.pods))
+
+
+class GoodputTracker:
+    """The controller-facing facade: per-job ledgers keyed ``ns/name``,
+    metric publication, cluster rollup.
+
+    Metrics published (catalogued in OBSERVABILITY.md):
+
+    - ``kctpu_goodput_ratio{namespace,tfjob}`` gauge — after warmup;
+    - ``kctpu_badput_seconds_total{namespace,tfjob,bucket}`` counter —
+      cumulative non-goodput occupied seconds per bucket (monotonic:
+      published as increments over the last published value);
+    - ``kctpu_cluster_goodput_ratio`` gauge — scrape-time callback over
+      every live ledger (``Gauge.set_function``), no per-job fan-out.
+    """
+
+    def __init__(self, registry: Optional[metrics_mod.Registry] = None
+                 ) -> None:
+        reg = registry if registry is not None else metrics_mod.REGISTRY
+        self._lock = locks.named_lock("obs.goodput")
+        self._jobs: Dict[str, JobLedger] = {}
+        # Last cumulative badput published per (key, bucket): the delta
+        # source for the monotonic counter.
+        self._published: Dict[Tuple[str, str], float] = {}
+        self._g_ratio = reg.gauge(
+            "kctpu_goodput_ratio",
+            "Fraction of accelerator-occupied time spent on useful steps",
+            ("namespace", "tfjob"))
+        self._c_badput = reg.counter(
+            "kctpu_badput_seconds_total",
+            "Occupied time attributed to non-goodput buckets",
+            ("namespace", "tfjob", "bucket"))
+        self._g_cluster = reg.gauge(
+            "kctpu_cluster_goodput_ratio",
+            "Cluster-wide goodput ratio over all live job ledgers")
+        self._g_cluster.set_function(self.cluster_ratio)
+
+    # -- observation ------------------------------------------------------
+
+    def observe(self, namespace: str, name: str,
+                observations: Iterable[PodObservation],
+                now: float) -> None:
+        """Fold one sync's pod observations into the job's ledger.
+
+        Deliberately returns nothing: the rollup (:meth:`summary`) walks
+        every pod ledger, and the sync loop only needs it on the
+        quantized status-publish edge — computing it here would put that
+        walk on EVERY sync's critical path (the bench --goodput overhead
+        gate is exactly this)."""
+        key = f"{namespace}/{name}"
+        with self._lock:
+            job = self._jobs.get(key)
+            if job is None:
+                job = self._jobs[key] = JobLedger()
+            job.observe(observations, now)
+
+    def bootstrap(self, namespace: str, name: str,
+                  carried: Dict[str, float]) -> None:
+        """Failover seed: adopt the bucket totals the PREVIOUS controller
+        persisted into status.goodput, once, before first observation —
+        the recompute-from-status journal ride that makes the ledger
+        exact-once across failover (coarsened by at most one
+        status-publish interval)."""
+        key = f"{namespace}/{name}"
+        with self._lock:
+            if key in self._jobs:
+                return  # already observing; the seed would double-count
+            job = self._jobs[key] = JobLedger()
+            job.carried = {
+                b: float(s) for b, s in (carried or {}).items()
+                if b in ALL_BUCKETS and float(s) > 0.0}
+
+    def has_job(self, namespace: str, name: str) -> bool:
+        with self._lock:
+            return f"{namespace}/{name}" in self._jobs
+
+    # -- rollups ----------------------------------------------------------
+
+    def summary(self, namespace: str, name: str,
+                now: float) -> Optional[JobGoodputSummary]:
+        with self._lock:
+            job = self._jobs.get(f"{namespace}/{name}")
+            return job.summary(now) if job is not None else None
+
+    def snapshot(self, namespace: str, name: str,
+                 now: float) -> Dict[str, object]:
+        """Flight-recorder shape: the job rollup plus per-pod books."""
+        with self._lock:
+            job = self._jobs.get(f"{namespace}/{name}")
+            if job is None:
+                return {}
+            s = job.summary(now)
+            return {
+                "captured_at": now,
+                "goodput_s": round(s.goodput_s, 3),
+                "occupied_s": round(s.occupied_s, 3),
+                "wall_s": round(s.wall_s, 3),
+                "ratio": round(s.ratio, 4),
+                "buckets": {b: round(v, 3) for b, v in s.buckets.items()},
+                "carried": {b: round(v, 3)
+                            for b, v in sorted(job.carried.items())},
+                "pods": {
+                    pname: {
+                        "bucket": led.bucket or "",
+                        "retired": led.retired_at is not None,
+                        "wall_s": round(led.wall_s(now), 3),
+                        "buckets": {b: round(v, 3)
+                                    for b, v in sorted(
+                                        led.snapshot(now).items())},
+                    }
+                    for pname, led in sorted(job.pods.items())
+                },
+            }
+
+    def cluster_ratio(self) -> float:
+        """Occupied-time-weighted goodput over every live ledger — the
+        ``kctpu_cluster_goodput_ratio`` scrape callback and the
+        cluster-goodput SLO's input.  1.0 when nothing is occupied yet
+        (an empty cluster is not burning badput)."""
+        import time as _t
+        now = _t.time()
+        good = occupied = 0.0
+        with self._lock:
+            for job in self._jobs.values():
+                s = job.summary(now)
+                good += s.goodput_s
+                occupied += s.occupied_s
+        if occupied < RATIO_WARMUP_S:
+            return 1.0
+        return min(1.0, max(0.0, good / occupied))
+
+    # -- metric publication ----------------------------------------------
+
+    def publish(self, namespace: str, name: str, now: float) -> None:
+        """Push the job's gauge/counter series — called from the sync
+        loop after :meth:`observe`.  Counter increments are the delta
+        over the last published cumulative value, so the exposition
+        stays monotonic whatever the sync cadence."""
+        key = f"{namespace}/{name}"
+        deltas = []
+        with self._lock:
+            job = self._jobs.get(key)
+            if job is None:
+                return
+            totals = job.bucket_totals(now)
+            for b, cum in totals.items():
+                if b in GOODPUT_BUCKETS or b in NON_OCCUPIED_BUCKETS:
+                    continue
+                last = self._published.get((key, b), 0.0)
+                if cum > last:
+                    deltas.append((b, cum - last))
+                    self._published[(key, b)] = cum
+        good = sum(totals.get(b, 0.0) for b in GOODPUT_BUCKETS)
+        occupied = sum(totals.values()) - sum(
+            totals.get(b, 0.0) for b in NON_OCCUPIED_BUCKETS)
+        # Metric writes outside our lock: instrument locks never nest
+        # under obs.goodput.
+        if occupied >= RATIO_WARMUP_S:
+            self._g_ratio.labels(namespace, name).set(
+                round(min(1.0, max(0.0, good / occupied)), 4))
+        for b, d in deltas:
+            self._c_badput.labels(namespace, name, b).inc(d)
+
+    def drop(self, namespace: str, name: str) -> None:
+        """Series + state die with the job (delete handler/finalizer)."""
+        key = f"{namespace}/{name}"
+        with self._lock:
+            self._jobs.pop(key, None)
+            stale = [k for k in self._published if k[0] == key]
+            for k in stale:
+                del self._published[k]
+        self._g_ratio.remove(namespace, name)
+        for b in ALL_BUCKETS:
+            self._c_badput.remove(namespace, name, b)

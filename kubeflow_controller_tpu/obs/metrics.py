@@ -194,6 +194,17 @@ class Counter(_Instrument):
                 return
         self._note_drop()
 
+    def remove(self, *labelvalues, **kv) -> None:
+        """Drop one labeled series (no-op if absent) — the counter twin of
+        ``Gauge.remove``.  Object-scoped counters (e.g. the goodput
+        ledger's per-job badput buckets) call this on object delete so
+        the exposition page doesn't strand dead series; scrapers must
+        treat the disappearance like a counter reset (rate() already
+        clamps resets to zero, obs/tsdb.py)."""
+        key = self._key(labelvalues, kv)
+        with self._lock:
+            self._values.pop(key, None)
+
     @property
     def value(self) -> float:
         with self._lock:

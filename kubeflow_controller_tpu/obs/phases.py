@@ -1,0 +1,150 @@
+"""The phase vocabulary, in one place.
+
+Two consumers grew the same vocabulary piecemeal across PRs and this
+module is their single source of truth:
+
+1. **Beat phases** — the coarse workload phase a ``PodProgress`` beat
+   carries (``workloads/progress.py``).  The stall detector
+   (``checker/health.py``) holds the frozen-step deadline for a subset
+   of them (a long XLA compile or checkpoint restore beats with a frozen
+   step counter on purpose); before this registry the hold list was a
+   hardcoded tuple that silently lost protection on a typo'd phase.
+   The ``phase-registry`` vet rule (analysis/vet.py) now flags any
+   ``phase="..."`` literal unknown to :data:`KNOWN_PHASES`.
+
+2. **Ledger buckets** — the closed taxonomy the goodput ledger
+   (``obs/goodput.py``) attributes every second of a replica's lifetime
+   to.  Beat phases map into buckets via :func:`bucket_for_beat_phase`;
+   control-plane states (queue-wait, scheduling, preemption, terminal)
+   have buckets of their own with no beat-phase counterpart.
+
+The pod-reason prefixes the capacity plane stamps on Pending/Failed
+pods (scheduler, elastic engine) also live here: the ledger, the status
+updater, the CLI, and the recovery policy all sniff them, and obs/ is
+the one leaf package everything above may import.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+# ---------------------------------------------------------------------------
+# Beat phases (PodProgress.phase) — what a workload says it is doing.
+# ---------------------------------------------------------------------------
+
+PHASE_RENDEZVOUS = "rendezvous"   # jax.distributed barrier / gang join
+PHASE_INIT = "init"               # pre-step setup after rendezvous
+PHASE_COMPILE = "compile"         # XLA compile (TTFS pipeline)
+PHASE_FIT = "fit"                 # training step loop — THE goodput phase
+PHASE_RESTORE = "restore"         # checkpoint restore on (re)start
+PHASE_RESHARD = "reshard"         # elastic width transition
+PHASE_LOAD = "load"               # serving model load
+PHASE_SERVING = "serving"         # serving decode loop — serving goodput
+PHASE_DRAIN = "drain"             # serving graceful drain
+
+# Every phase a beat may carry ("" = reporter did not say; treated as
+# fit-adjacent by consumers that must pick something).
+KNOWN_PHASES = frozenset({
+    PHASE_RENDEZVOUS, PHASE_INIT, PHASE_COMPILE, PHASE_FIT, PHASE_RESTORE,
+    PHASE_RESHARD, PHASE_LOAD, PHASE_SERVING, PHASE_DRAIN, "",
+})
+
+# Phases that hold the stall detector's frozen-step deadline: the step
+# counter legitimately freezes while these run (the heartbeat deadline
+# always applies regardless).  Grown across PRs 8/9/13/15; now the
+# StallTracker imports this instead of a private tuple.
+STALL_HOLD_PHASES = frozenset({
+    PHASE_COMPILE, PHASE_RESTORE, PHASE_RESHARD, PHASE_LOAD,
+    PHASE_SERVING, PHASE_DRAIN,
+})
+
+# ---------------------------------------------------------------------------
+# Pod-reason prefixes — the capacity plane's verdicts, stamped as pod
+# status reasons so they work in any deployment shape.  Stampers:
+# scheduler/scheduler.py, elastic/engine.py.  Sniffers: updater/status.py,
+# controller/controller.py, recovery/policy.py, cli/main.py, obs/goodput.py.
+# ---------------------------------------------------------------------------
+
+POD_REASON_QUEUED_PREFIX = "GangQueued"        # Pending: gang waiting in queue
+POD_REASON_PREEMPTED_PREFIX = "Preempted"      # Failed: higher class took slices
+POD_REASON_HARVESTED_PREFIX = "WidthHarvested"  # Failed: elastic width harvest
+
+# ---------------------------------------------------------------------------
+# Ledger buckets (obs/goodput.py) — the closed attribution taxonomy.
+# Every second of a replica's lifetime lands in exactly one of these.
+# ---------------------------------------------------------------------------
+
+BUCKET_QUEUED = "queued"               # gang waiting for slices (scheduler queue)
+BUCKET_SCHEDULING = "scheduling"       # Pending, not queue-blocked (bind/admit)
+BUCKET_STARTING_COLD = "starting_cold"  # Running, pre-first-beat, cold start
+BUCKET_STARTING_WARM = "starting_warm"  # Running, pre-first-beat, warm readmit
+BUCKET_RENDEZVOUS = "rendezvous"       # gang join + init
+BUCKET_COMPILE_CACHED = "compile_cached"  # compile resolved from the cache
+BUCKET_COMPILE_MISS = "compile_miss"   # compile actually compiled
+BUCKET_RESTORE = "restore"             # checkpoint restore
+BUCKET_TRAIN = "train"                 # step loop — training goodput
+BUCKET_SERVING = "serving"             # decode loop — serving goodput
+BUCKET_STALLED = "stalled"             # stall detector's verdict overrides beats
+BUCKET_RESHARD = "reshard"             # elastic width transition
+BUCKET_PREEMPTED = "preempted"         # killed by a higher priority class
+BUCKET_HARVESTED = "harvested"         # width harvested by the scheduler
+BUCKET_DRAIN = "drain"                 # serving graceful drain
+BUCKET_TERMINAL = "terminal"           # Succeeded/Failed tail until observed
+
+ALL_BUCKETS: Tuple[str, ...] = (
+    BUCKET_QUEUED, BUCKET_SCHEDULING, BUCKET_STARTING_COLD,
+    BUCKET_STARTING_WARM, BUCKET_RENDEZVOUS, BUCKET_COMPILE_CACHED,
+    BUCKET_COMPILE_MISS, BUCKET_RESTORE, BUCKET_TRAIN, BUCKET_SERVING,
+    BUCKET_STALLED, BUCKET_RESHARD, BUCKET_PREEMPTED, BUCKET_HARVESTED,
+    BUCKET_DRAIN, BUCKET_TERMINAL,
+)
+
+# The only buckets that count as goodput.  Everything else is badput —
+# except the non-occupied buckets below, which are excluded from the
+# ratio's denominator entirely (queue-wait is the scheduler's debt, not
+# the job's, and it would drown the signal for a long-queued job).
+GOODPUT_BUCKETS: Tuple[str, ...] = (BUCKET_TRAIN, BUCKET_SERVING)
+
+# Buckets excluded from the goodput ratio denominator: the replica is
+# not occupying accelerator resources (or is past caring).
+NON_OCCUPIED_BUCKETS: Tuple[str, ...] = (
+    BUCKET_QUEUED, BUCKET_SCHEDULING, BUCKET_TERMINAL,
+)
+
+# Beat phase -> ledger bucket for a Running replica that is beating.
+_BEAT_BUCKET = {
+    PHASE_RENDEZVOUS: BUCKET_RENDEZVOUS,
+    PHASE_INIT: BUCKET_RENDEZVOUS,
+    PHASE_COMPILE: BUCKET_COMPILE_MISS,   # re-attributed on cache-hit, see below
+    PHASE_FIT: BUCKET_TRAIN,
+    PHASE_RESTORE: BUCKET_RESTORE,
+    PHASE_RESHARD: BUCKET_RESHARD,
+    PHASE_LOAD: BUCKET_RESTORE,           # model load = restore-shaped badput
+    PHASE_SERVING: BUCKET_SERVING,
+    PHASE_DRAIN: BUCKET_DRAIN,
+}
+
+# compile_source value that marks a cache-served executable
+# (workloads/progress.py TTFS pipeline).
+COMPILE_SOURCE_CACHE_HIT = "cache-hit"
+COMPILE_SOURCE_COMPILED = "compiled"
+
+
+def bucket_for_beat_phase(phase: str, compile_source: str = "") -> str:
+    """Ledger bucket for a Running, beating replica.
+
+    Attribution rules at the boundaries (documented in OBSERVABILITY.md):
+
+    - ``compile`` accrues into ``compile_miss`` while in flight; once the
+      beat reports ``compile_source == "cache-hit"`` the ledger
+      re-attributes the accrued compile time to ``compile_cached`` (the
+      provenance only resolves when the compile phase does).
+    - ``load`` (serving model load) lands in ``restore`` — same shape of
+      badput: reading bytes before useful work.
+    - An empty/unknown phase on a beating replica counts as ``train``
+      (serving replicas always report a phase, so unknown == training
+      step loop that predates phase reporting).
+    """
+    if phase == PHASE_COMPILE and compile_source == COMPILE_SOURCE_CACHE_HIT:
+        return BUCKET_COMPILE_CACHED
+    return _BEAT_BUCKET.get(phase, BUCKET_TRAIN)

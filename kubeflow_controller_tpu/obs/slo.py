@@ -42,6 +42,10 @@ from .tsdb import TSDB
 KIND_GAUGE = "gauge"                    # violating-sample fraction of a series
 KIND_HISTOGRAM_QUANTILE = "histogram_quantile"  # windowed quantile vs threshold
 
+# Violation directions.
+DIRECTION_ABOVE = "above"  # value > threshold violates (latency, depth)
+DIRECTION_BELOW = "below"  # value < threshold violates (goodput ratio)
+
 
 @dataclass(frozen=True)
 class Objective:
@@ -50,16 +54,25 @@ class Objective:
     name: str                  # slug: "serving-ttft-p99"
     description: str
     metric: str                # series name (gauge) or histogram family
-    threshold: float           # a sample/quantile above this is a violation
+    threshold: float           # a sample/quantile past this is a violation
     kind: str = KIND_GAUGE
     q: float = 0.99            # histogram_quantile only
     error_budget: float = 0.05  # allowed violating fraction
     fast_window_s: float = 30.0
     slow_window_s: float = 120.0
     burn_threshold: float = 2.0
+    # Which side of ``threshold`` violates: "above" (latency-style, the
+    # default) or "below" (ratio-style — the goodput objectives fire when
+    # the ratio DROPS under the floor).
+    direction: str = DIRECTION_ABOVE
     # Label keys identifying who breached (event routing); objectives fan
     # out over every label set the TSDB retains for ``metric``.
     subject_labels: Tuple[str, ...] = ("namespace", "tfjob")
+
+    def violates(self, value: float) -> bool:
+        if self.direction == DIRECTION_BELOW:
+            return value < self.threshold
+        return value > self.threshold
 
 
 def default_objectives() -> List[Objective]:
@@ -94,6 +107,19 @@ def default_objectives() -> List[Objective]:
             metric="kctpu_sched_queue_wait_seconds", threshold=300.0,
             kind=KIND_HISTOGRAM_QUANTILE, q=0.99, error_budget=0.05,
             subject_labels=()),
+        Objective(
+            name="cluster-goodput",
+            description="cluster goodput ratio stays >= 0.5",
+            metric="kctpu_cluster_goodput_ratio", threshold=0.5,
+            direction=DIRECTION_BELOW, error_budget=0.2,
+            subject_labels=()),
+        Objective(
+            name="badput-budget",
+            description="per-job goodput ratio stays >= 0.25 (a "
+                        "crash-looping or perpetually-compiling job burns "
+                        "this without ever failing)",
+            metric="kctpu_goodput_ratio", threshold=0.25,
+            direction=DIRECTION_BELOW, error_budget=0.2),
     ]
 
 
@@ -236,12 +262,12 @@ class SLOEngine:
         if obj.kind == KIND_HISTOGRAM_QUANTILE:
             value = self.tsdb.quantile_from_histogram(
                 obj.metric, labels, obj.q, window_s, now)
-            violating = 1.0 if value > obj.threshold else 0.0
+            violating = 1.0 if obj.violates(value) else 0.0
             return violating / budget, value
         pts = self.tsdb.points(obj.metric, labels, now - window_s, now)
         if not pts:
             return 0.0, 0.0
-        bad = sum(1 for _, v in pts if v > obj.threshold)
+        bad = sum(1 for _, v in pts if obj.violates(v))
         return (bad / len(pts)) / budget, pts[-1][1]
 
     # -- query surface -------------------------------------------------------
@@ -260,7 +286,8 @@ class SLOEngine:
             "objectives": [
                 {"slo": o.name, "description": o.description,
                  "metric": o.metric, "threshold": o.threshold,
-                 "kind": o.kind, "error_budget": o.error_budget,
+                 "kind": o.kind, "direction": o.direction,
+                 "error_budget": o.error_budget,
                  "fast_window_s": o.fast_window_s,
                  "slow_window_s": o.slow_window_s,
                  "burn_threshold": o.burn_threshold}

@@ -39,6 +39,10 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..api.core import PHASE_FAILED, PHASE_RUNNING, PHASE_SUCCEEDED, is_pod_active
 from ..api.tfjob import ReplicaType, TFJob
+from ..obs.phases import (
+    POD_REASON_HARVESTED_PREFIX,
+    POD_REASON_PREEMPTED_PREFIX,
+)
 from ..utils import locks
 from ..planner.materialize import pods_by_index
 from ..planner.plan import desired_replicas
@@ -197,7 +201,8 @@ class RestartTracker:
                     failed = [p for p in plist
                               if p.status.phase == PHASE_FAILED
                               and not (p.status.reason or "").startswith(
-                                  ("Preempted", "WidthHarvested"))]
+                                  (POD_REASON_PREEMPTED_PREFIX,
+                                   POD_REASON_HARVESTED_PREFIX))]
                     fresh = [p for p in failed
                              if st is None
                              or p.metadata.name not in st.failed_pods]

@@ -60,14 +60,18 @@ from .queue import GangEntry, PRIORITY_CLASSES, normalize_class, priority_for, s
 
 # Pod failure-reason prefixes the updater/controller key off (the pod status
 # is the channel that carries queue state to a controller in another
-# process, exactly as pod phase already does).
-REASON_QUEUED_PREFIX = "GangQueued"
-REASON_PREEMPTED_PREFIX = "Preempted"
-# Elastic plane: pods failed because their slices were HARVESTED (not
-# preempted) — the controller's width engine re-shards the gang down
-# instead of replacing it whole, and the recovery policy exempts the
-# reason from restart accounting exactly like Preempted.
-REASON_HARVESTED_PREFIX = "WidthHarvested"
+# process, exactly as pod phase already does).  The literals live in the
+# shared vocabulary (obs/phases.py) next to the ledger buckets they map
+# into; these module aliases are the scheduler's public names for them.
+# "WidthHarvested" (elastic plane): pods failed because their slices were
+# HARVESTED (not preempted) — the controller's width engine re-shards the
+# gang down instead of replacing it whole, and the recovery policy exempts
+# the reason from restart accounting exactly like Preempted.
+from ..obs.phases import (
+    POD_REASON_HARVESTED_PREFIX as REASON_HARVESTED_PREFIX,
+    POD_REASON_PREEMPTED_PREFIX as REASON_PREEMPTED_PREFIX,
+    POD_REASON_QUEUED_PREFIX as REASON_QUEUED_PREFIX,
+)
 
 
 @dataclass

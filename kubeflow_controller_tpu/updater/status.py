@@ -47,6 +47,10 @@ from ..api.tfjob import (
     TFReplicaState,
     TFReplicaStatus,
 )
+from ..obs.phases import (
+    POD_REASON_PREEMPTED_PREFIX,
+    POD_REASON_QUEUED_PREFIX,
+)
 from ..planner.materialize import gang_width, pod_index, pods_by_index, spec_width
 from ..utils import serde
 
@@ -247,9 +251,11 @@ def compute_status(
         if typ in (ReplicaType.TPU, ReplicaType.SERVING):
             for p in pods:
                 r = p.status.reason or ""
-                if p.status.phase == PHASE_PENDING and r.startswith("GangQueued"):
+                if (p.status.phase == PHASE_PENDING
+                        and r.startswith(POD_REASON_QUEUED_PREFIX)):
                     gang_queue_msg = r
-                elif p.status.phase == PHASE_FAILED and r.startswith("Preempted"):
+                elif (p.status.phase == PHASE_FAILED
+                        and r.startswith(POD_REASON_PREEMPTED_PREFIX)):
                     gang_preempt_msg = r
 
         hist: Dict[TFReplicaState, int] = {}

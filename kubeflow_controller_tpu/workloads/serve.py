@@ -52,10 +52,10 @@ from ..utils import locks
 
 # Coarse workload phases a serving replica reports (checker.StallTracker
 # holds the frozen-step deadline for all three: an idle-but-healthy or
-# draining server freezes its step counter ON PURPOSE).
-PHASE_LOAD = "load"
-PHASE_SERVING = "serving"
-PHASE_DRAIN = "drain"
+# draining server freezes its step counter ON PURPOSE).  Re-exported from
+# the shared phase registry (obs/phases.py) so the vocabulary has one home.
+from ..obs.phases import (  # noqa: E402  (grouped with the phase comment)
+    PHASE_DRAIN, PHASE_LOAD, PHASE_SERVING)
 
 # Env contract for the executed entrypoint (planner/materialize.py wires
 # the spec side; the kubelet injects the progress transport).

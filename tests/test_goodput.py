@@ -1,0 +1,615 @@
+"""Goodput-ledger tests (ISSUE 18; obs/goodput.py + its surfaces).
+
+Covers the taxonomy decision (bucket_for), the pod ledger's
+contiguous-interval invariant (sum of buckets == wall-time, always),
+compile re-attribution on late provenance, retired-pod folding, the
+failover bootstrap's exact-once seed, metric series lifecycle
+(publish deltas stay monotonic, drop removes every series), the
+DIRECTION_BELOW burn-rate objectives, the phase-registry vet rule,
+status serde, and the CLI surfaces (`get` good= suffix, `top` GOODPUT
+column, `kctpu goodput`).  The end-to-end attribution gates live in
+bench.py --goodput (`make goodput-smoke`)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from kubeflow_controller_tpu.analysis import vet
+from kubeflow_controller_tpu.api.core import Container, PodTemplateSpec
+from kubeflow_controller_tpu.api.meta import ObjectMeta
+from kubeflow_controller_tpu.api.tfjob import (
+    JobGoodput,
+    JobProgress,
+    ReplicaProgress,
+    ReplicaType,
+    TFJob,
+    TFJobPhase,
+    TFJobStatus,
+    TFReplicaSpec,
+)
+from kubeflow_controller_tpu.cluster import Cluster
+from kubeflow_controller_tpu.cluster.apiserver import FakeAPIServer
+from kubeflow_controller_tpu.obs import phases as P
+from kubeflow_controller_tpu.obs.goodput import (
+    MAX_RETIRED_PODS,
+    GoodputTracker,
+    JobLedger,
+    PodLedger,
+    PodObservation,
+    bucket_for,
+)
+from kubeflow_controller_tpu.obs.metrics import Registry
+from kubeflow_controller_tpu.obs.slo import (
+    DIRECTION_ABOVE,
+    DIRECTION_BELOW,
+    Objective,
+    SLOEngine,
+    default_objectives,
+)
+from kubeflow_controller_tpu.obs.tsdb import TSDB
+from kubeflow_controller_tpu.utils import serde
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_obs(pod_phase="Running", reason="", start_mode="", beat_phase=None,
+            compile_source="", stalled=False, name="p0"):
+    return PodObservation(name=name, pod_phase=pod_phase, reason=reason,
+                          start_mode=start_mode, beat_phase=beat_phase,
+                          compile_source=compile_source, stalled=stalled)
+
+
+# ---------------------------------------------------------------------------
+# The taxonomy decision
+# ---------------------------------------------------------------------------
+
+class TestBucketFor:
+    @pytest.mark.parametrize("obs,bucket", [
+        # Control-plane states.
+        (run_obs("Pending", reason="GangQueued: position 2/5"),
+         P.BUCKET_QUEUED),
+        (run_obs("Pending"), P.BUCKET_SCHEDULING),
+        (run_obs("Failed", reason="Preempted: 2 slice(s) to gang x"),
+         P.BUCKET_PREEMPTED),
+        (run_obs("Failed", reason="WidthHarvested: 1 slice(s) harvested"),
+         P.BUCKET_HARVESTED),
+        (run_obs("Failed", reason="Error: OOM"), P.BUCKET_TERMINAL),
+        (run_obs("Succeeded"), P.BUCKET_TERMINAL),
+        # Running, pre-first-beat: the start-mode annotation decides.
+        (run_obs(beat_phase=None), P.BUCKET_STARTING_COLD),
+        (run_obs(beat_phase=None, start_mode="cold"),
+         P.BUCKET_STARTING_COLD),
+        (run_obs(beat_phase=None, start_mode="warm"),
+         P.BUCKET_STARTING_WARM),
+        # Running + beating: the beat phase maps through obs/phases.py.
+        (run_obs(beat_phase=P.PHASE_FIT), P.BUCKET_TRAIN),
+        (run_obs(beat_phase=P.PHASE_SERVING), P.BUCKET_SERVING),
+        (run_obs(beat_phase=P.PHASE_RENDEZVOUS), P.BUCKET_RENDEZVOUS),
+        (run_obs(beat_phase=P.PHASE_INIT), P.BUCKET_RENDEZVOUS),
+        (run_obs(beat_phase=P.PHASE_COMPILE), P.BUCKET_COMPILE_MISS),
+        (run_obs(beat_phase=P.PHASE_COMPILE, compile_source="cache-hit"),
+         P.BUCKET_COMPILE_CACHED),
+        (run_obs(beat_phase=P.PHASE_RESTORE), P.BUCKET_RESTORE),
+        (run_obs(beat_phase=P.PHASE_LOAD), P.BUCKET_RESTORE),
+        (run_obs(beat_phase=P.PHASE_RESHARD), P.BUCKET_RESHARD),
+        (run_obs(beat_phase=P.PHASE_DRAIN), P.BUCKET_DRAIN),
+        # Empty/unknown phase on a beating replica counts as train.
+        (run_obs(beat_phase=""), P.BUCKET_TRAIN),
+        (run_obs(beat_phase="no-such-phase"), P.BUCKET_TRAIN),
+    ])
+    def test_taxonomy(self, obs, bucket):
+        assert bucket_for(obs) == bucket
+
+    def test_stall_verdict_overrides_beat(self):
+        obs = run_obs(beat_phase=P.PHASE_FIT, stalled=True)
+        assert bucket_for(obs) == P.BUCKET_STALLED
+
+    def test_unknown_pod_phase_holds_interval_open(self):
+        assert bucket_for(run_obs(pod_phase="Unknown")) is None
+
+    def test_every_decision_lands_in_the_closed_taxonomy(self):
+        cases = [
+            run_obs("Pending", reason="GangQueued: q"), run_obs("Pending"),
+            run_obs("Failed", reason="Preempted: x"),
+            run_obs("Failed", reason="WidthHarvested: x"),
+            run_obs("Failed"), run_obs("Succeeded"),
+            run_obs(beat_phase=None, start_mode="warm"),
+            run_obs(beat_phase=None),
+            run_obs(stalled=True, beat_phase=P.PHASE_FIT),
+        ] + [run_obs(beat_phase=ph) for ph in sorted(P.KNOWN_PHASES)]
+        for obs in cases:
+            assert bucket_for(obs) in P.ALL_BUCKETS
+
+
+# ---------------------------------------------------------------------------
+# PodLedger: the contiguous-interval invariant
+# ---------------------------------------------------------------------------
+
+class TestPodLedger:
+    def test_attributed_equals_wall_across_transitions(self):
+        led = PodLedger(100.0)
+        script = [
+            (100.0, run_obs("Pending", reason="GangQueued: q")),
+            (103.0, run_obs("Pending")),
+            (104.0, run_obs(beat_phase=None)),
+            (105.5, run_obs(beat_phase=P.PHASE_RENDEZVOUS)),
+            (107.0, run_obs(beat_phase=P.PHASE_COMPILE)),
+            (111.0, run_obs(beat_phase=P.PHASE_FIT,
+                            compile_source="compiled")),
+            (120.0, run_obs(beat_phase=P.PHASE_FIT, stalled=True)),
+            (121.0, run_obs("Succeeded")),
+        ]
+        for now, obs in script:
+            led.observe(obs, now)
+            assert led.attributed_s(now) == pytest.approx(led.wall_s(now))
+        t = led.snapshot(125.0)
+        assert led.attributed_s(125.0) == pytest.approx(led.wall_s(125.0))
+        assert sum(t.values()) == pytest.approx(25.0)  # 100.0 -> 125.0
+        assert t[P.BUCKET_QUEUED] == pytest.approx(3.0)
+        assert t[P.BUCKET_SCHEDULING] == pytest.approx(1.0)
+        assert t[P.BUCKET_STARTING_COLD] == pytest.approx(1.5)
+        assert t[P.BUCKET_RENDEZVOUS] == pytest.approx(1.5)
+        assert t[P.BUCKET_COMPILE_MISS] == pytest.approx(4.0)
+        assert t[P.BUCKET_TRAIN] == pytest.approx(9.0)
+        assert t[P.BUCKET_STALLED] == pytest.approx(1.0)
+        # Succeeded keeps accruing terminal until retired/observed away.
+        assert t[P.BUCKET_TERMINAL] == pytest.approx(4.0)
+
+    def test_retire_freezes_the_books(self):
+        led = PodLedger(0.0)
+        led.observe(run_obs(beat_phase=P.PHASE_FIT), 0.0)
+        led.retire(10.0)
+        assert led.snapshot(50.0) == {P.BUCKET_TRAIN: pytest.approx(10.0)}
+        assert led.wall_s(50.0) == pytest.approx(10.0)
+        # Further observes/retires are no-ops once the books are closed.
+        led.observe(run_obs(beat_phase=P.PHASE_SERVING), 60.0)
+        led.retire(70.0)
+        assert led.snapshot(80.0) == {P.BUCKET_TRAIN: pytest.approx(10.0)}
+
+    def test_clock_running_backward_never_negates(self):
+        led = PodLedger(100.0)
+        led.observe(run_obs(beat_phase=P.PHASE_FIT), 100.0)
+        led.observe(run_obs(beat_phase=P.PHASE_RENDEZVOUS), 95.0)  # skewed
+        t = led.snapshot(101.0)
+        assert all(v >= 0.0 for v in t.values())
+        assert led.attributed_s(101.0) == pytest.approx(led.wall_s(101.0))
+
+    def test_cache_hit_reattributes_accrued_compile_time(self):
+        led = PodLedger(0.0)
+        led.observe(run_obs(beat_phase=P.PHASE_COMPILE), 0.0)
+        led.observe(run_obs(beat_phase=P.PHASE_COMPILE), 4.0)
+        # Provenance arrives WITH the transition out of compile — the
+        # whole accrued episode moves to compile_cached.
+        led.observe(run_obs(beat_phase=P.PHASE_FIT,
+                            compile_source="cache-hit"), 5.0)
+        t = led.snapshot(5.0)
+        assert t.get(P.BUCKET_COMPILE_MISS, 0.0) == pytest.approx(0.0)
+        assert t[P.BUCKET_COMPILE_CACHED] == pytest.approx(5.0)
+        assert sum(t.values()) == pytest.approx(led.wall_s(5.0))
+
+    def test_compiled_provenance_stays_compile_miss(self):
+        led = PodLedger(0.0)
+        led.observe(run_obs(beat_phase=P.PHASE_COMPILE), 0.0)
+        led.observe(run_obs(beat_phase=P.PHASE_FIT,
+                            compile_source="compiled"), 5.0)
+        t = led.snapshot(5.0)
+        assert t[P.BUCKET_COMPILE_MISS] == pytest.approx(5.0)
+        assert P.BUCKET_COMPILE_CACHED not in t
+
+    def test_abandoned_compile_episode_does_not_transfer_later(self):
+        led = PodLedger(0.0)
+        led.observe(run_obs(beat_phase=P.PHASE_COMPILE), 0.0)
+        # Left compile with NO provenance: the unresolved accrual resets,
+        # so a much later cache-hit beat cannot re-attribute it.
+        led.observe(run_obs(beat_phase=P.PHASE_FIT), 3.0)
+        led.observe(run_obs(beat_phase=P.PHASE_FIT,
+                            compile_source="cache-hit"), 10.0)
+        t = led.snapshot(10.0)
+        assert t[P.BUCKET_COMPILE_MISS] == pytest.approx(3.0)
+        assert P.BUCKET_COMPILE_CACHED not in t
+
+
+# ---------------------------------------------------------------------------
+# JobLedger: vanish-retire + bounded retired set
+# ---------------------------------------------------------------------------
+
+class TestJobLedger:
+    def test_vanished_pod_is_retired(self):
+        jl = JobLedger()
+        jl.observe([run_obs(name="a", beat_phase=P.PHASE_FIT),
+                    run_obs(name="b", beat_phase=P.PHASE_FIT)], 0.0)
+        jl.observe([run_obs(name="b", beat_phase=P.PHASE_FIT)], 4.0)
+        assert jl.pods["a"].retired_at == 4.0
+        assert jl.pods["b"].retired_at is None
+        # Retired wall is frozen; the survivor keeps accruing.
+        t = jl.bucket_totals(10.0)
+        assert t[P.BUCKET_TRAIN] == pytest.approx(4.0 + 10.0)
+
+    def test_retired_overflow_folds_into_carried(self):
+        jl = JobLedger()
+        n = MAX_RETIRED_PODS + 6
+        t = 0.0
+        for i in range(n):
+            jl.observe([run_obs(name=f"p{i}", beat_phase=P.PHASE_FIT)], t)
+            t += 1.0
+        jl.observe([], t)  # retire the last one too
+        assert len(jl.retired_order) == MAX_RETIRED_PODS
+        assert len(jl.pods) == MAX_RETIRED_PODS
+        # Nothing lost in the fold: every second is still on the books.
+        totals = jl.bucket_totals(t)
+        assert totals[P.BUCKET_TRAIN] == pytest.approx(float(n))
+        assert jl.carried[P.BUCKET_TRAIN] == pytest.approx(
+            float(n - MAX_RETIRED_PODS))
+
+    def test_summary_ratio_and_occupancy(self):
+        jl = JobLedger()
+        jl.observe([run_obs(name="a",
+                            pod_phase="Pending",
+                            reason="GangQueued: q")], 0.0)
+        jl.observe([run_obs(name="a", beat_phase=P.PHASE_RENDEZVOUS)], 10.0)
+        jl.observe([run_obs(name="a", beat_phase=P.PHASE_FIT)], 14.0)
+        s = jl.summary(26.0)
+        assert s.wall_s == pytest.approx(26.0)
+        # Queue time is excluded from the denominator.
+        assert s.occupied_s == pytest.approx(16.0)
+        assert s.goodput_s == pytest.approx(12.0)
+        assert s.ratio == pytest.approx(0.75)
+        assert s.replicas == 1
+
+
+# ---------------------------------------------------------------------------
+# GoodputTracker: bootstrap, metric lifecycle, cluster rollup
+# ---------------------------------------------------------------------------
+
+def badput_samples(reg, ns="default", job="j"):
+    fams = {f.name: f for f in reg.families()}
+    fam = fams.get("kctpu_badput_seconds_total")
+    if fam is None:
+        return {}
+    return {s.labels["bucket"]: s.value for s in fam.samples
+            if s.labels.get("namespace") == ns and s.labels.get("tfjob") == job}
+
+
+def ratio_samples(reg):
+    fams = {f.name: f for f in reg.families()}
+    fam = fams.get("kctpu_goodput_ratio")
+    return {} if fam is None else {
+        (s.labels["namespace"], s.labels["tfjob"]): s.value
+        for s in fam.samples}
+
+
+class TestGoodputTracker:
+    def test_bootstrap_seeds_carried_totals_once(self):
+        tr = GoodputTracker(registry=Registry())
+        tr.bootstrap("default", "j", {
+            "train": 30, "rendezvous": 10.0,
+            "no-such-bucket": 7.0, "queued": 0.0})
+        s = tr.summary("default", "j", 1000.0)
+        assert s is not None
+        assert s.wall_s == pytest.approx(40.0)   # junk + zero filtered
+        assert s.goodput_s == pytest.approx(30.0)
+        assert s.ratio == pytest.approx(0.75)
+        # A second seed would double-count — it must be a no-op.
+        tr.bootstrap("default", "j", {"train": 999.0})
+        assert tr.summary("default", "j", 1000.0).wall_s == pytest.approx(40.0)
+
+    def test_bootstrap_after_observe_is_noop(self):
+        tr = GoodputTracker(registry=Registry())
+        tr.observe("default", "j",
+                   [run_obs(beat_phase=P.PHASE_FIT)], 0.0)
+        tr.bootstrap("default", "j", {"train": 500.0})
+        assert tr.summary("default", "j", 10.0).wall_s == pytest.approx(10.0)
+
+    def test_failover_is_exact_once(self):
+        """Controller A's persisted rollup seeds controller B: the union
+        accounts every second exactly once."""
+        a = GoodputTracker(registry=Registry())
+        a.observe("default", "j", [run_obs(beat_phase=P.PHASE_RENDEZVOUS)],
+                  0.0)
+        a.observe("default", "j", [run_obs(beat_phase=P.PHASE_FIT)], 6.0)
+        handoff = a.summary("default", "j", 20.0)   # what status.goodput held
+        b = GoodputTracker(registry=Registry())
+        b.bootstrap("default", "j", dict(handoff.buckets))
+        b.observe("default", "j", [run_obs(beat_phase=P.PHASE_FIT)], 20.0)
+        s = b.summary("default", "j", 30.0)
+        assert s.wall_s == pytest.approx(30.0)
+        assert s.buckets[P.BUCKET_RENDEZVOUS] == pytest.approx(6.0)
+        assert s.goodput_s == pytest.approx(24.0)
+
+    def test_publish_counter_stays_monotonic(self):
+        reg = Registry()
+        tr = GoodputTracker(registry=reg)
+        tr.observe("default", "j", [run_obs(beat_phase=P.PHASE_RENDEZVOUS)],
+                   0.0)
+        tr.observe("default", "j", [run_obs(beat_phase=P.PHASE_FIT)], 6.0)
+        tr.publish("default", "j", 6.0)
+        assert badput_samples(reg)["rendezvous"] == pytest.approx(6.0)
+        # Re-publishing with no new badput must not re-add the cumulative.
+        tr.publish("default", "j", 6.0)
+        tr.publish("default", "j", 12.0)
+        assert badput_samples(reg)["rendezvous"] == pytest.approx(6.0)
+        # Goodput/non-occupied buckets never become counter series.
+        assert set(badput_samples(reg)) == {"rendezvous"}
+        assert ratio_samples(reg)[("default", "j")] == pytest.approx(0.5)
+
+    def test_ratio_gauge_waits_for_warmup(self):
+        reg = Registry()
+        tr = GoodputTracker(registry=reg)
+        tr.observe("default", "j", [run_obs(beat_phase=P.PHASE_FIT)], 0.0)
+        tr.publish("default", "j", 2.0)  # occupied 2s < RATIO_WARMUP_S
+        assert ("default", "j") not in ratio_samples(reg)
+        tr.publish("default", "j", 30.0)
+        assert ratio_samples(reg)[("default", "j")] == pytest.approx(1.0)
+
+    def test_drop_removes_state_and_every_series(self):
+        reg = Registry()
+        tr = GoodputTracker(registry=reg)
+        tr.observe("default", "j", [run_obs(beat_phase=P.PHASE_RENDEZVOUS)],
+                   0.0)
+        tr.observe("default", "j", [run_obs(beat_phase=P.PHASE_FIT)], 6.0)
+        tr.publish("default", "j", 10.0)
+        assert badput_samples(reg) and ratio_samples(reg)
+        tr.drop("default", "j")
+        assert tr.summary("default", "j", 20.0) is None
+        assert not tr.has_job("default", "j")
+        assert badput_samples(reg) == {}
+        assert ratio_samples(reg) == {}
+
+    def test_cluster_ratio_warmup_is_one(self):
+        tr = GoodputTracker(registry=Registry())
+        assert tr.cluster_ratio() == 1.0  # empty cluster burns no badput
+        tr.observe("default", "j", [run_obs(beat_phase=P.PHASE_FIT)],
+                   time.time() - 1.0)
+        assert tr.cluster_ratio() == 1.0  # under RATIO_WARMUP_S occupied
+
+    def test_cluster_ratio_weights_by_occupied_time(self):
+        tr = GoodputTracker(registry=Registry())
+        t0 = time.time() - 20.0
+        tr.observe("default", "good",
+                   [run_obs(name="a", beat_phase=P.PHASE_FIT)], t0)
+        tr.observe("default", "bad",
+                   [run_obs(name="b", beat_phase=P.PHASE_RENDEZVOUS)], t0)
+        # ~20s train vs ~20s rendezvous -> ratio ~0.5.
+        assert 0.4 < tr.cluster_ratio() < 0.6
+
+    def test_snapshot_is_flight_bundle_shaped(self):
+        tr = GoodputTracker(registry=Registry())
+        tr.observe("default", "j", [run_obs(beat_phase=P.PHASE_FIT)], 0.0)
+        snap = tr.snapshot("default", "j", 8.0)
+        assert snap["wall_s"] == pytest.approx(8.0)
+        assert snap["buckets"] == {P.BUCKET_TRAIN: pytest.approx(8.0)}
+        assert snap["pods"]["p0"]["bucket"] == P.BUCKET_TRAIN
+        assert not snap["pods"]["p0"]["retired"]
+        assert json.dumps(snap)  # must serialize into goodput.json as-is
+        assert tr.snapshot("default", "nope", 8.0) == {}
+
+
+# ---------------------------------------------------------------------------
+# Status surface serde
+# ---------------------------------------------------------------------------
+
+class TestGoodputStatusSerde:
+    def test_round_trip(self):
+        st = TFJobStatus(phase=TFJobPhase.RUNNING)
+        st.goodput = JobGoodput(goodput_s=80, occupied_s=100, wall_s=130,
+                                ratio=0.8,
+                                buckets={"train": 80, "rendezvous": 12,
+                                         "queued": 30})
+        wire = json.loads(json.dumps(serde.to_dict(st)))
+        back = serde.from_dict(TFJobStatus, wire)
+        assert back.goodput == st.goodput
+
+    def test_absent_stays_none(self):
+        wire = json.loads(json.dumps(serde.to_dict(TFJobStatus())))
+        assert serde.from_dict(TFJobStatus, wire).goodput is None
+
+
+# ---------------------------------------------------------------------------
+# DIRECTION_BELOW objectives (the goodput SLOs)
+# ---------------------------------------------------------------------------
+
+def mk_ratio_rig():
+    reg = Registry()
+    g = reg.gauge("kctpu_cluster_goodput_ratio", "test")
+    db = TSDB(registry=reg, retention_s=300.0)
+    obj = Objective(
+        name="cluster-goodput", description="cluster goodput >= 0.5",
+        metric="kctpu_cluster_goodput_ratio", threshold=0.5,
+        direction=DIRECTION_BELOW, error_budget=0.2,
+        fast_window_s=10.0, slow_window_s=30.0, burn_threshold=2.0,
+        subject_labels=())
+    edges = []
+    eng = SLOEngine(db, objectives=[obj], registry=reg,
+                    notifier=lambda st, fired: edges.append(fired))
+    return g, db, eng, edges
+
+
+class TestGoodputSLO:
+    def test_violates_respects_direction(self):
+        below = Objective(name="x", description="", metric="m",
+                          threshold=0.5, direction=DIRECTION_BELOW)
+        above = Objective(name="y", description="", metric="m",
+                          threshold=0.5, direction=DIRECTION_ABOVE)
+        assert below.violates(0.4) and not below.violates(0.6)
+        assert above.violates(0.6) and not above.violates(0.4)
+
+    def test_ratio_drop_fires_and_recovery_resolves(self):
+        g, db, eng, edges = mk_ratio_rig()
+
+        def drive(t0, n, value):
+            for i in range(n):
+                g.set(value)
+                db.sample_once(t0 + i)
+                eng.evaluate_once(t0 + i)
+            return t0 + n
+
+        t = drive(1000.0, 30, 0.9)    # healthy ratio
+        assert edges == []
+        t = drive(t, 40, 0.1)         # sustained collapse under the floor
+        assert edges == [True]
+        drive(t, 40, 0.9)             # recovery
+        assert edges == [True, False]
+
+    def test_default_catalogue_has_goodput_objectives(self):
+        objs = {o.name: o for o in default_objectives()}
+        assert objs["cluster-goodput"].direction == DIRECTION_BELOW
+        assert objs["cluster-goodput"].metric == "kctpu_cluster_goodput_ratio"
+        assert objs["cluster-goodput"].subject_labels == ()
+        assert objs["badput-budget"].direction == DIRECTION_BELOW
+        assert objs["badput-budget"].metric == "kctpu_goodput_ratio"
+
+
+# ---------------------------------------------------------------------------
+# phase-registry vet rule
+# ---------------------------------------------------------------------------
+
+class TestPhaseRegistryVet:
+    def run_vet(self, tmp_path, src):
+        mod = tmp_path / "phasey.py"
+        mod.write_text(src)
+        return vet.run([str(mod)], root=REPO_ROOT, skip_catalogue=True)
+
+    def test_unknown_beat_phase_literal_flagged(self, tmp_path):
+        findings = self.run_vet(
+            tmp_path,
+            "def report(rep):\n"
+            "    rep.beat(step=1, phase='warmup')\n")
+        assert [f.rule for f in findings] == ["phase-registry"]
+        assert "'warmup'" in findings[0].message
+
+    def test_unknown_podprogress_phase_flagged(self, tmp_path):
+        findings = self.run_vet(
+            tmp_path,
+            "from kubeflow_controller_tpu.api.core import PodProgress\n"
+            "def mk():\n"
+            "    return PodProgress(step=3, phase='prefetch')\n")
+        assert [f.rule for f in findings] == ["phase-registry"]
+
+    def test_known_phases_and_constants_pass(self, tmp_path):
+        findings = self.run_vet(
+            tmp_path,
+            "from kubeflow_controller_tpu.obs.phases import PHASE_FIT\n"
+            "def report(rep, ph):\n"
+            "    rep.beat(step=1, phase='fit')\n"
+            "    rep.beat(step=2, phase=PHASE_FIT)\n"
+            "    rep.beat(step=3, phase=ph)\n"   # dynamic: not a new literal
+            "    rep.beat(step=4, phase='')\n")
+        assert findings == []
+
+    def test_inline_suppression(self, tmp_path):
+        findings = self.run_vet(
+            tmp_path,
+            "def report(rep):\n"
+            "    rep.beat(step=1, phase='bogus')"
+            "  # kctpu: vet-ok(phase-registry) - test literal\n")
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces: get suffix, top column, kctpu goodput
+# ---------------------------------------------------------------------------
+
+def mk_running_job(cluster, name, goodput=None):
+    t = PodTemplateSpec()
+    t.spec.containers.append(Container(name="w", image="img"))
+    job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+    job.spec.tf_replica_specs.append(TFReplicaSpec(
+        replicas=2, tf_replica_type=ReplicaType.WORKER, template=t))
+    cluster.tfjobs.create(job)
+    j = cluster.tfjobs.get("default", name)
+    j.status.phase = TFJobPhase.RUNNING
+    j.status.progress = JobProgress(
+        step=10, max_step=10, examples_per_sec=50.0, reporting=2,
+        last_heartbeat=time.time(),
+        replicas=[ReplicaProgress(type=ReplicaType.WORKER, index=0, step=10,
+                                  phase="fit",
+                                  last_heartbeat=time.time())])
+    j.status.goodput = goodput
+    cluster.tfjobs.update_status(j)
+
+
+class TestCLIGoodput:
+    @pytest.fixture
+    def served(self):
+        cluster = Cluster()
+        srv = FakeAPIServer(cluster.store)
+        url = srv.start()
+        mk_running_job(cluster, "trainer", goodput=JobGoodput(
+            goodput_s=80, occupied_s=100, wall_s=130, ratio=0.8,
+            buckets={"train": 80, "rendezvous": 12, "compile_miss": 8,
+                     "queued": 30}))
+        mk_running_job(cluster, "plain")  # no ledger yet
+        yield url
+        srv.stop()
+
+    def row(self, out, name):
+        hdr = next(ln for ln in out.splitlines() if ln.startswith("NAMESPACE"))
+        row = next(ln for ln in out.splitlines()
+                   if ln.startswith("default") and f" {name} " in f"{ln} ")
+        return hdr, row
+
+    def test_get_appends_good_suffix_without_shifting_columns(self, served,
+                                                              capsys):
+        from kubeflow_controller_tpu.cli.main import main
+
+        assert main(["-master", served, "get"]) == 0
+        out = capsys.readouterr().out
+        hdr, row = self.row(out, "trainer")
+        # The ratio rides the REPLICAS cell (the row's last, free-width
+        # column) so every fixed-width column stays put.
+        at = hdr.index("REPLICAS")
+        assert row[at:] == "Workerx2[good=80%]"
+        assert row[hdr.index("RESTARTS"):at].split() == ["0", "-"]
+        _, plain = self.row(out, "plain")
+        assert plain[at:] == "Workerx2"   # no ledger -> no suffix
+
+    def test_top_has_goodput_column(self, served, capsys):
+        from kubeflow_controller_tpu.cli.main import main
+
+        assert main(["-master", served, "top"]) == 0
+        out = capsys.readouterr().out
+        hdr, row = self.row(out, "trainer")
+        at = hdr.index("GOODPUT")
+        assert row[at:at + 8].strip() == "80%"
+        _, plain = self.row(out, "plain")
+        assert plain[at:at + 8].strip() == "-"
+
+    def test_goodput_fleet_table_and_cluster_rollup(self, served, capsys):
+        from kubeflow_controller_tpu.cli.main import main
+
+        assert main(["-master", served, "goodput"]) == 0
+        out = capsys.readouterr().out
+        hdr, row = self.row(out, "trainer")
+        assert "TOP-BADPUT" in hdr
+        assert row[hdr.index("GOODPUT"):].split()[0] == "80%"
+        assert "rendezvous=12s" in row     # dominant badput bucket
+        assert "plain" not in out          # ledgerless jobs are filtered
+        assert "cluster: goodput 80% (80s of 100s occupied, 1 job(s))" in out
+
+    def test_goodput_job_drilldown_classifies_buckets(self, served, capsys):
+        from kubeflow_controller_tpu.cli.main import main
+
+        assert main(["-master", served, "goodput", "--job", "trainer"]) == 0
+        out = capsys.readouterr().out
+        assert "goodput 80% (80s of 100s occupied; wall 130s)" in out
+        rows = {ln.split()[0]: ln.split()[-1] for ln in out.splitlines()
+                if ln and ln.split()[0] in P.ALL_BUCKETS}
+        assert rows["train"] == "goodput"
+        assert rows["rendezvous"] == "badput"
+        assert rows["compile_miss"] == "badput"
+        assert rows["queued"] == "waiting"
+
+    def test_goodput_job_without_ledger_says_so(self, served, capsys):
+        from kubeflow_controller_tpu.cli.main import main
+
+        assert main(["-master", served, "goodput", "--job", "plain"]) == 0
+        assert "no goodput ledger yet" in capsys.readouterr().out
+
+    def test_describe_has_badput_section(self, served, capsys):
+        from kubeflow_controller_tpu.cli.main import main
+
+        assert main(["-master", served, "describe", "trainer"]) == 0
+        out = capsys.readouterr().out
+        assert "Goodput:   80%" in out

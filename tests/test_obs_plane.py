@@ -241,6 +241,44 @@ class TestTSDB:
         avg = db.avg_over_time("kctpu_x", {"job": "a"}, 10.0, now=1003.0)
         assert avg == pytest.approx(2.5)
 
+    def test_rate_counter_reset_clamps_to_zero(self):
+        """A counter reset (process restart: cumulative value drops) must
+        not read as a huge negative rate — the goodput badput counters
+        feed burn-rate SLOs through exactly this path."""
+        reg, g, db = mk_tsdb(retention_s=100.0)
+        for i in range(6):
+            g.labels("a").set(float(i * 10))   # climbs to 50
+            db.sample_once(1000.0 + i)
+        g.labels("a").set(5.0)                  # restart: 50 -> 5
+        db.sample_once(1006.0)
+        # Window [1003, 1006]: 30 -> 5 across the reset.
+        r = db.rate("kctpu_x", {"job": "a"}, 3.0, now=1006.0)
+        assert r == 0.0                         # clamped, never negative
+
+    def test_rate_after_reset_resumes(self):
+        """Once the window no longer straddles the reset, the rate is the
+        honest post-restart slope again."""
+        reg, g, db = mk_tsdb(retention_s=100.0)
+        g.labels("a").set(50.0)
+        db.sample_once(1000.0)
+        for i in range(11):
+            g.labels("a").set(float(i * 2))     # reset, then +2/s
+            db.sample_once(1001.0 + i)
+        r = db.rate("kctpu_x", {"job": "a"}, 10.0, now=1011.0)
+        assert r == pytest.approx(2.0, rel=1e-6)
+
+    def test_avg_over_time_spans_reset_without_poisoning(self):
+        """avg_over_time is a plain mean of window points — a counter
+        reset inside the window lowers it but can never make it negative
+        or blow it up (what the DIRECTION_BELOW goodput SLOs consume)."""
+        reg, g, db = mk_tsdb(retention_s=100.0)
+        for i, v in enumerate([0.9, 0.9, 0.1, 0.1]):   # ratio collapse
+            g.labels("a").set(v)
+            db.sample_once(1000.0 + i)
+        avg = db.avg_over_time("kctpu_x", {"job": "a"}, 10.0, now=1003.0)
+        assert avg == pytest.approx(0.5)
+        assert 0.0 <= avg <= 1.0
+
 
 # ---------------------------------------------------------------------------
 # SLO burn-rate engine
@@ -387,15 +425,19 @@ class TestFlightRecorder:
             progress={"p0": {"step": 7}},
             status_history=[{"from": "Created", "to": "Running", "at": 1.0}],
             status={"phase": "Failed"},
+            goodput={"ratio": 0.8, "buckets": {"train": 80.0}},
             tsdb=db, out_dir=str(tmp_path), now=1000.0)
         assert path is not None
         bundle = flight.read_bundle(path)
         assert set(bundle) == {"manifest.json", "trace.json", "events.json",
-                               "progress.json", "status.json", "tsdb.json"}
+                               "progress.json", "status.json", "tsdb.json",
+                               "goodput.json"}
         m = bundle["manifest.json"]
         assert m["reason"] == "Test" and m["events"] == 1
+        assert set(m["files"]) == set(bundle)
         assert bundle["status.json"]["history"][0]["to"] == "Running"
         assert bundle["progress.json"]["p0"]["step"] == 7
+        assert bundle["goodput.json"]["buckets"]["train"] == 80.0
         tsdb_names = {s["name"] for s in bundle["tsdb.json"]["series"]}
         assert "kctpu_y" in tsdb_names
 
