@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all ci test test-fast lint typecheck cov cov-local bench dryrun validate vet race-smoke check-smoke metrics-smoke scale-smoke scale10k-smoke stall-smoke widejob-smoke churn-smoke store-smoke sched-smoke ttfs-smoke chaos-smoke elastic-smoke goodput-smoke ha-smoke serve-smoke gateway-smoke slo-smoke
+.PHONY: all ci test test-fast lint typecheck cov cov-local bench dryrun validate vet race-smoke check-smoke metrics-smoke scale-smoke scale10k-smoke stall-smoke widejob-smoke churn-smoke store-smoke sched-smoke ttfs-smoke chaos-smoke elastic-smoke multislice-smoke goodput-smoke ha-smoke serve-smoke gateway-smoke slo-smoke
 
 all: lint vet test race-smoke check-smoke
 
@@ -15,7 +15,7 @@ all: lint vet test race-smoke check-smoke
 # included), then tier-1 under the runtime lock-order detector.  Run
 # without -j: the order is the diagnosis ladder (cheapest, most precise
 # signal first).
-ci: vet race-smoke check-smoke chaos-smoke elastic-smoke goodput-smoke serve-smoke gateway-smoke ha-smoke slo-smoke scale10k-smoke
+ci: vet race-smoke check-smoke chaos-smoke elastic-smoke multislice-smoke goodput-smoke serve-smoke gateway-smoke ha-smoke slo-smoke scale10k-smoke
 	KCTPU_LOCKCHECK=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m "not slow"
 
 # Fast/slow split: `test-fast` (-m "not slow") is the quick signal — 214 of
@@ -300,6 +300,29 @@ elastic-smoke:
 		      '| t-restored', d['details']['time_to_restored_s'], 's', \
 		      '| lost', d['details']['lost_steps'], '/', d['details']['checkpoint_every'], \
 		      '| harvest', d['details']['harvest']['counters'].get('harvested_slices', {}))"
+
+# Multi-slice placement smoke (MULTISLICE_r01.json's standing gate,
+# docs/PERF.md "Multi-slice placement").  Three probes: (1) adjacency-
+# scored vs random gang placement on identical fragmented pools —
+# adjacency must strictly beat random on mean rendezvous AND step time
+# under the DCN cost model; (2) a REAL tiny-LLaMA pretrain building its
+# mesh from $KCTPU_MESH while the CLI flags lie (the env contract the
+# mesh-env vet rule enforces statically); (3) a mid-run member kill on a
+# pp=2 x dp=2 gang over 4 simulated slices — the gang must degrade by
+# EXACTLY one inter-slice dp replica (width 8 -> 4, never 6), keep
+# training through the window with a pp-preserving mesh, and restore.
+# ~30-60 s (dominated by the real pretrain subprocess).
+multislice-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --multislice --trials 24 --seed 7 \
+		> /tmp/kctpu_multislice_smoke.json
+	@$(PY) -c "import json; d = json.load(open('/tmp/kctpu_multislice_smoke.json')); \
+		assert {'metric', 'value', 'unit', 'details'} <= set(d), d; \
+		pl = d['details']['placement']; k = d['details']['kill']; \
+		print('multislice-smoke ok: rendezvous speedup', d['value'], 'x', \
+		      '| domains', pl['adjacency']['mean_domains'], 'vs', pl['random']['mean_domains'], \
+		      '| degraded width', k['degraded_width'], \
+		      '| degraded steps/s', k['degraded_steps_per_sec'], \
+		      '| restored', k['restored'])"
 
 # Goodput smoke (the time-accounting ledger's standing gate,
 # docs/OBSERVABILITY.md "Goodput ledger"): a compressed chaos-kill +

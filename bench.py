@@ -1735,6 +1735,358 @@ def elastic_main(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# --multislice: topology-aware placement + mesh-integrity elastic degrade
+# ---------------------------------------------------------------------------
+
+# DCN cost model for the placement trials (docs/PERF.md "Multi-slice
+# placement").  Cross-slice collectives pay a per-DCN-domain cost twice:
+# once at gang rendezvous (each extra aggregation layer adds barrier
+# setup) and once per training step (the inter-slice pp/dp collective
+# traverses the extra hop every step).  The trials measure the DOMAINS
+# each policy's binding spans on identical fragmented pools; the model
+# maps domains to time so the gate is expressed in the units operators
+# care about.
+MS_RDZV_BASE_S = 2.0          # single-domain gang rendezvous
+MS_RDZV_PER_DOMAIN_S = 1.5    # per additional DCN domain spanned
+MS_STEP_BASE_S = 0.30         # single-domain per-step time
+MS_STEP_PER_DOMAIN_S = 0.12   # per additional domain, per step
+
+
+def _ms_costs(n_domains: int):
+    extra = max(0, n_domains - 1)
+    return (MS_RDZV_BASE_S + MS_RDZV_PER_DOMAIN_S * extra,
+            MS_STEP_BASE_S + MS_STEP_PER_DOMAIN_S * extra)
+
+
+def _run_placement_trials(trials: int = 24, gang_slices: int = 4,
+                          seed: int = 11) -> dict:
+    """Probe 1: adjacency-scored vs random placement on identical
+    fragmented pools.  Each trial builds a 12-slice / 6-superblock
+    inventory, pre-binds a seeded random subset (the fragmentation an
+    elastic cluster accretes), asks each arm to bind one 4-slice gang,
+    and scores the DCN domains the binding spans."""
+    import random as _random
+
+    from kubeflow_controller_tpu.cluster import TPUInventory, TPUSlice
+
+    rng = _random.Random(seed)
+    arms = {"adjacency": [], "random": []}
+    for t in range(trials):
+        n_frag = rng.randint(2, 5)
+        frag = set(rng.sample(range(12), n_frag))
+        for arm, recs in arms.items():
+            slices = [
+                TPUSlice(f"slice-{i:02d}", "v5e-8", num_hosts=2,
+                         pod_id=f"sb{i // 2}", pod_pos=i % 2,
+                         bound_gang="frag" if i in frag else "")
+                for i in range(12)
+            ]
+            inv = TPUInventory(slices, placement=arm,
+                               seed=seed * 1009 + t)
+            bound = inv.bind_gang(f"gang-{t}", "v5e-8",
+                                  n_slices=gang_slices)
+            if bound is None:  # >= 7 slices free by construction
+                raise RuntimeError("placement trial could not bind")
+            pl = inv.placement_of(f"gang-{t}")
+            rdzv, step = _ms_costs(len(pl["domains"]))
+            recs.append({"domains": len(pl["domains"]),
+                         "score": pl["score"],
+                         "rendezvous_s": round(rdzv, 3),
+                         "step_s": round(step, 3)})
+
+    def mean(vals):
+        return round(sum(vals) / len(vals), 4) if vals else 0.0
+
+    out = {"trials": trials, "gang_slices": gang_slices,
+           "pool": {"slices": 12, "superblocks": 6},
+           "cost_model": {"rendezvous_base_s": MS_RDZV_BASE_S,
+                          "rendezvous_per_domain_s": MS_RDZV_PER_DOMAIN_S,
+                          "step_base_s": MS_STEP_BASE_S,
+                          "step_per_domain_s": MS_STEP_PER_DOMAIN_S}}
+    for arm, recs in arms.items():
+        out[arm] = {
+            "mean_domains": mean([r["domains"] for r in recs]),
+            "mean_score": mean([r["score"] for r in recs]),
+            "mean_rendezvous_s": mean([r["rendezvous_s"] for r in recs]),
+            "mean_step_s": mean([r["step_s"] for r in recs]),
+            "max_domains": max(r["domains"] for r in recs),
+        }
+    return out
+
+
+def _run_mesh_env_probe(deadline_s: float = 300.0) -> dict:
+    """Probe 2: the planner's env contract drives a REAL mesh.  Runs
+    tiny-LLaMA pretrain as a subprocess with $KCTPU_MESH set to the
+    dp=2 x fsdp=4 plan while the CLI flags say dp=8 x fsdp=1 — the
+    training process must build the env mesh (the shape the scheduler
+    placed), proving workloads never recompute topology from the spec
+    (the `mesh-env` vet rule's runtime half).  dp x fsdp rather than a
+    pp mesh: pp>1 needs a partial-manual shard_map region, which the
+    compat layer gates off on old jax (parallel/compat.py) — the pp
+    mesh-integrity half is covered by the simulated kill probe."""
+    import subprocess
+
+    planned = {"dp": 2, "fsdp": 4}
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "KCTPU_MESH": json.dumps(planned, sort_keys=True),
+    })
+    cmd = [sys.executable, "-m",
+           "kubeflow_controller_tpu.workloads.llama_pretrain",
+           "--preset", "tiny", "--steps", "2", "--batch-size", "4",
+           "--seq-len", "64",
+           # Deliberately wrong CLI shape: the env contract must win.
+           "--dp", "8", "--fsdp", "1", "--pp", "1"]
+    t0 = time.time()
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=deadline_s)
+    wall = round(time.time() - t0, 3)
+    mesh_line = next((ln for ln in proc.stdout.splitlines()
+                      if ln.startswith("Mesh:")), "")
+    mesh_shape = {}
+    if "{" in mesh_line and "}" in mesh_line:
+        frag = mesh_line[mesh_line.index("{"):mesh_line.index("}") + 1]
+        try:
+            mesh_shape = json.loads(frag.replace("'", '"'))
+        except ValueError:
+            mesh_shape = {}
+    return {
+        "planned_mesh": planned,
+        "built_mesh": mesh_shape,
+        "mesh_line": mesh_line.strip(),
+        "mesh_matches_env": all(
+            int(mesh_shape.get(k, 0)) == v
+            for k, v in planned.items() if v > 1),
+        "returncode": proc.returncode,
+        "wall_s": wall,
+        "stderr_tail": proc.stderr[-400:] if proc.returncode else "",
+    }
+
+
+def _ms_slice_rollup(job, per_slice: int = 2) -> dict:
+    """Per-slice progress rollup: group the progress plane's replica
+    entries by slice (index // hosts-per-slice), min step per slice."""
+    p = job.status.progress
+    out: dict = {}
+    for r in (p.replicas if p is not None else []):
+        s = r.index // per_slice
+        cur = out.setdefault(f"slice{s}", {"replicas": 0, "min_step": -1})
+        cur["replicas"] += 1
+        cur["min_step"] = (r.step if cur["min_step"] < 0
+                           else min(cur["min_step"], r.step))
+    return dict(sorted(out.items()))
+
+
+def _run_multislice_kill_probe(seed: int = 3,
+                               deadline_s: float = 90.0) -> dict:
+    """Probe 3: mesh-integrity-aware degrade on 4 simulated slices.  A
+    pp=2 x dp=2 gang spans 4 slices — 2 inter-slice dp replicas of 2
+    pipeline slices each.  Killing one member mid-run must degrade the
+    gang by EXACTLY one inter-slice dp replica (width 8 -> 4, never 6:
+    a 3-slice width would orphan half a pipeline), keep training at the
+    reduced width with a pp-preserving mesh, then restore."""
+    from kubeflow_controller_tpu.api.core import Container, PodTemplateSpec
+    from kubeflow_controller_tpu.api.labels import ANNOTATION_PLACEMENT
+    from kubeflow_controller_tpu.api.meta import ObjectMeta
+    from kubeflow_controller_tpu.api.tfjob import (
+        ElasticSpec,
+        ReplicaType,
+        TFJob,
+        TFReplicaSpec,
+        TPUSpec,
+    )
+    from kubeflow_controller_tpu.cluster import (
+        Cluster,
+        FakeKubelet,
+        PhasePolicy,
+        TPUInventory,
+        TPUSlice,
+    )
+    from kubeflow_controller_tpu.controller import Controller
+    from kubeflow_controller_tpu.elastic import ElasticPolicy
+    from kubeflow_controller_tpu.planner.materialize import ENV_MESH
+    from kubeflow_controller_tpu.recovery.chaos import ChaosMonkey
+    from kubeflow_controller_tpu.scheduler import GangScheduler, SchedulerPolicy
+
+    cluster = Cluster()
+    inv = TPUInventory([
+        TPUSlice(f"slice-{i}", "v5e-8", num_hosts=2,
+                 pod_id=f"sb{i // 2}", pod_pos=i % 2)
+        for i in range(4)])
+    sched = GangScheduler(inv, SchedulerPolicy())
+    kubelet = FakeKubelet(cluster, policy=PhasePolicy(
+        run_s=120.0, heartbeat_s=0.05), inventory=sched)
+    ctrl = Controller(cluster, inventory=sched, resync_period_s=0.5,
+                      elastic_policy=ElasticPolicy(warmup_s=0.2,
+                                                   min_degraded_s=0.3,
+                                                   capacity_poll_s=0.1))
+    kubelet.start()
+    ctrl.run(threadiness=2)
+
+    job = TFJob(metadata=ObjectMeta(name="ms-pretrain",
+                                    namespace="default"))
+    job.spec.elastic = ElasticSpec(min_width=4)
+    t = PodTemplateSpec()
+    t.spec.containers.append(Container(name="tensorflow", image="img"))
+    t.spec.restart_policy = "OnFailure"
+    job.spec.tf_replica_specs = [TFReplicaSpec(
+        replicas=8, tf_replica_type=ReplicaType.TPU, template=t,
+        tpu=TPUSpec(accelerator_type="v5e-8", num_hosts=2, num_slices=4,
+                    mesh={"pp": 2, "dp": 2, "fsdp": 4}))]
+
+    def job_pods(phase: str = "Running"):
+        return [p for p in cluster.pods.list("default")
+                if p.metadata.labels.get("tf_job_name") == "ms-pretrain"
+                and (not phase or p.status.phase == phase)]
+
+    def width_now():
+        w = cluster.tfjobs.get("default", "ms-pretrain").status.width
+        return w.current if w is not None else None
+
+    def pod_mesh_env():
+        for p in job_pods():
+            for c in p.spec.containers:
+                for ev in c.env:
+                    if ev.name == ENV_MESH:
+                        try:
+                            return json.loads(ev.value)
+                        except ValueError:
+                            return {}
+        return {}
+
+    out = {"kill_executed": False, "degraded": False,
+           "degraded_width": 0, "degraded_steps_per_sec": 0.0,
+           "restored": False, "placement": {}, "rollup_full": {},
+           "rollup_degraded": {}, "full_mesh_env": {},
+           "degraded_mesh_env": {}}
+    try:
+        cluster.tfjobs.create(job)
+        end = time.time() + 30
+        while time.time() < end and len(job_pods()) < 8:
+            time.sleep(0.02)
+        j = cluster.tfjobs.get("default", "ms-pretrain")
+        raw = j.metadata.annotations.get(ANNOTATION_PLACEMENT, "")
+        try:
+            out["placement"] = json.loads(raw) if raw else {}
+        except ValueError:
+            out["placement"] = {}
+        out["full_mesh_env"] = pod_mesh_env()
+
+        monkey = ChaosMonkey(cluster, kubelet, seed=seed)
+        rec = monkey.kill_at_step("default", "ms-pretrain", min_step=3,
+                                  deadline_s=30.0)
+        out["kill_executed"] = rec is not None
+        if rec is None:
+            return out
+        out["step_at_kill"] = rec.step_at_kill
+        out["rollup_full"] = _ms_slice_rollup(
+            cluster.tfjobs.get("default", "ms-pretrain"))
+
+        # Snapshot the degraded generation mid-window (the timeline
+        # record below runs through restore, after which the degraded
+        # pods are gone): width down + survivors reporting.
+        end = time.time() + 30
+        while time.time() < end:
+            w = width_now()
+            j = cluster.tfjobs.get("default", "ms-pretrain")
+            p = j.status.progress
+            if (w is not None and w < 8 and p is not None
+                    and p.reporting > 0):
+                out["rollup_degraded"] = _ms_slice_rollup(j)
+                out["degraded_mesh_env"] = pod_mesh_env()
+                break
+            time.sleep(0.02)
+
+        er = monkey.await_elastic("default", rec, spec_width=8,
+                                  deadline_s=deadline_s)
+        out.update({
+            "degraded": er.degraded,
+            "degraded_width": er.degraded_width,
+            "degraded_steps_per_sec": er.degraded_steps_per_sec,
+            "time_to_degraded_s": round(er.time_to_degraded_s, 3),
+            "restored": er.restored,
+            "time_to_restored_s": round(er.time_to_restored_s, 3),
+        })
+    finally:
+        ctrl.stop()
+        kubelet.stop()
+    return out
+
+
+def run_multislice(trials: int = 24, seed: int = 7) -> dict:
+    placement = _run_placement_trials(trials=trials, seed=seed + 4)
+    mesh_env = _run_mesh_env_probe()
+    kill = _run_multislice_kill_probe(seed=seed)
+    return {"placement": placement, "mesh_env": mesh_env, "kill": kill}
+
+
+def multislice_main(args) -> int:
+    result = run_multislice(trials=args.trials, seed=args.seed)
+    pl = result["placement"]
+    adj, rnd = pl["adjacency"], pl["random"]
+    speedup = (round(rnd["mean_rendezvous_s"] / adj["mean_rendezvous_s"],
+                     3) if adj["mean_rendezvous_s"] else 0.0)
+    print(json.dumps({
+        "metric": "multislice_rendezvous_speedup",
+        "value": speedup,
+        "unit": "x",
+        "details": result,
+    }))
+    rc = 0
+    if not adj["mean_rendezvous_s"] < rnd["mean_rendezvous_s"]:
+        print(f"multislice regression: adjacency placement does not beat "
+              f"random on rendezvous time ({adj['mean_rendezvous_s']}s vs "
+              f"{rnd['mean_rendezvous_s']}s)", file=sys.stderr)
+        rc = 1
+    if not adj["mean_step_s"] < rnd["mean_step_s"]:
+        print(f"multislice regression: adjacency placement does not beat "
+              f"random on step time ({adj['mean_step_s']}s vs "
+              f"{rnd['mean_step_s']}s)", file=sys.stderr)
+        rc = 1
+    me = result["mesh_env"]
+    if me["returncode"] != 0:
+        print(f"multislice regression: mesh-from-env pretrain exited "
+              f"{me['returncode']}: {me['stderr_tail']}", file=sys.stderr)
+        rc = 1
+    elif not me["mesh_matches_env"]:
+        print(f"multislice regression: training built "
+              f"{me['built_mesh']} instead of the placed mesh "
+              f"{me['planned_mesh']} ($KCTPU_MESH ignored)",
+              file=sys.stderr)
+        rc = 1
+    k = result["kill"]
+    if not k["kill_executed"]:
+        print("multislice regression: no kill was executed (job ended "
+              "before the trigger)", file=sys.stderr)
+        rc = 1
+    else:
+        if not k["degraded"] or k["degraded_steps_per_sec"] <= 0.0:
+            print(f"multislice regression: gang did not keep training "
+                  f"through the degraded window: {k}", file=sys.stderr)
+            rc = 1
+        if k["degraded_width"] != 4:
+            print(f"multislice regression: degraded width "
+                  f"{k['degraded_width']} != 4 — the gang must degrade "
+                  f"by exactly one inter-slice dp replica (pp=2 slices), "
+                  f"never mid-pipeline", file=sys.stderr)
+            rc = 1
+        dm = k["degraded_mesh_env"]
+        if dm.get("pp") != 2 or dm.get("dp") != 1:
+            print(f"multislice regression: degraded generation's mesh "
+                  f"env {dm} does not preserve the pipeline (want pp=2, "
+                  f"dp=1)", file=sys.stderr)
+            rc = 1
+        if not k["restored"]:
+            print(f"multislice regression: gang did not re-expand to "
+                  f"full width after the degraded window: {k}",
+                  file=sys.stderr)
+            rc = 1
+    return rc
+
+
+# ---------------------------------------------------------------------------
 # --goodput: phase-attributed time accounting (obs/goodput.py ledger)
 # ---------------------------------------------------------------------------
 
@@ -4005,6 +4357,19 @@ def main(argv=None) -> int:
                         "gang admitted by harvesting width, zero "
                         "whole-gang preemptions of elastic victims) — "
                         "ELASTIC_r01.json / make elastic-smoke")
+    p.add_argument("--multislice", action="store_true",
+                   help="multi-slice placement bench (capacity plane): "
+                        "adjacency-scored vs random gang placement on "
+                        "identical fragmented pools (rendezvous/step time "
+                        "via the DCN cost model), a real tiny-LLaMA "
+                        "pretrain building its mesh from $KCTPU_MESH, and "
+                        "a mid-run kill on a pp=2 x dp=2 gang over 4 "
+                        "simulated slices gated on degrading by exactly "
+                        "one inter-slice dp replica — MULTISLICE_r01.json "
+                        "/ make multislice-smoke")
+    p.add_argument("--trials", type=int, default=24, metavar="N",
+                   help="multislice mode: seeded placement trials per "
+                        "arm (default 24)")
     p.add_argument("--goodput", action="store_true",
                    help="goodput-ledger bench (observability plane): replay "
                         "a chaos-kill + warm-restore + compile-cache + "
@@ -4182,6 +4547,8 @@ def main(argv=None) -> int:
         return serve_main(args)
     if args.goodput:
         return goodput_main(args)
+    if args.multislice:
+        return multislice_main(args)
     if args.elastic:
         return elastic_main(args)
     if args.chaos:

@@ -718,6 +718,48 @@ class GangWidthEnvRule(Rule):
                 "and the spec width is wrong while degraded")
 
 
+class MeshEnvRule(Rule):
+    name = "mesh-env"
+    doc = ("workload code reads its slice id / slice count / mesh shape "
+           "from the runtime env ($MEGASCALE_SLICE_ID, "
+           "$MEGASCALE_NUM_SLICES, $KCTPU_MESH / JobRuntime), never "
+           "recomputed from spec.replicas or spec topology: the slice set "
+           "a degraded gang actually spans differs from its spec per "
+           "generation, so a spec-derived mesh builds a different shape "
+           "than the scheduler placed")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        # Scoped like gang-width-env: only the workload layer; the
+        # control plane is the thing that turns spec topology into the
+        # runtime env in the first place.
+        if "workloads/" not in ctx.path.replace(os.sep, "/"):
+            return
+        for node in ast.walk(ctx.tree):
+            if (not isinstance(node, ast.Attribute)
+                    or node.attr not in ("num_slices", "slice_id")):
+                continue
+            chain = _chain_attrs(node)
+            root = (_root_name(node) or "").lower()
+            # JobRuntime's own fields (self.num_slices, rt.num_slices) ARE
+            # the env-derived values — only spec-shaped access chains are
+            # recomputation (job.spec.tpu.num_slices, spec.tpu.num_slices,
+            # tpu.num_slices where tpu came off a spec).
+            spec_ish = ("spec" in chain[:-1]
+                        or "tpu" in chain[:-1]
+                        or "tf_replica_specs" in chain[:-1]
+                        or "spec" in root or root in ("job", "tpu"))
+            if not spec_ish:
+                continue
+            if ctx.suppressed(self.name, node.lineno):
+                continue
+            yield Finding(
+                ctx.path, node.lineno, node.col_offset, self.name,
+                f"workload reads {node.attr} from the job spec: use "
+                f"$MEGASCALE_SLICE_ID / $MEGASCALE_NUM_SLICES / "
+                f"$KCTPU_MESH via JobRuntime — the slice set of a "
+                f"degraded gang differs from its spec per generation")
+
+
 _CAMEL_RE = re.compile(r"^[A-Z][A-Za-z0-9]*$")
 
 
@@ -826,6 +868,7 @@ def all_rules() -> List[Rule]:
         RawLockRule(),
         FencingTokenRule(),
         GangWidthEnvRule(),
+        MeshEnvRule(),
         MetricRules(),
         EventReasonRule(),
         PhaseRegistryRule(),

@@ -51,6 +51,16 @@ ANNOTATION_GANG_WIDTH = f"{DOMAIN}/gang-width"
 # harvesting is slice-granular).
 ANNOTATION_ELASTIC_MIN_WIDTH = f"{DOMAIN}/elastic-min-width"
 ANNOTATION_ELASTIC_MIN_SLICES = f"{DOMAIN}/elastic-min-slices"
+# Slices one pipeline replica spans (mesh.pp; absent/1 = no pipeline),
+# stamped per pod so the scheduler harvests in whole-pipeline-replica
+# multiples without controller round-trips — taking fewer slices would
+# orphan a pipeline stage and stall the whole gang.
+ANNOTATION_MESH_PP = f"{DOMAIN}/mesh-pp-span"
+# Placement record, written on the TFJob by the controller when the gang
+# is admitted (JSON: bound slice names, DCN domains spanned, adjacency
+# score, mesh axis -> scope map).  ``kctpu describe`` renders it as the
+# Placement section; ``kctpu get`` shows the slice count.
+ANNOTATION_PLACEMENT = f"{DOMAIN}/placement"
 # --- serving plane (net-new) ---
 # Current replica target of the job's Serving set, written on the TFJob by
 # the controller's autoscaler (absent = autoscale.minReplicas, else

@@ -120,6 +120,13 @@ class TPUSpec:
     # across — the standard layout is dp across slices.  The gang scheduler
     # binds this many slices atomically.
     num_slices: int = 1
+    # Declared parallelism axes (e.g. {"pp": 2, "dp": 4, "fsdp": 8}) the
+    # planner splits into inter-slice (pp, then the DCN share of dp) ×
+    # intra-slice (fsdp/tp/sp and the ICI share of dp) factors.  pp is the
+    # only axis allowed to span slices besides dp: it must divide
+    # num_slices, and dp must be divisible by its inter-slice share.
+    # Empty = flat data-parallel across slices (the pre-mesh behavior).
+    mesh: Dict[str, int] = field(default_factory=dict)
 
 
 # chips per slice for known accelerator types: "<family>-<chips>".
@@ -154,6 +161,20 @@ def tpu_slice_chips(spec: TPUSpec) -> int:
     return tpu_slice_hosts(spec) * (spec.chips_per_host or 4)
 
 
+# Axes a mesh may declare.  pp and the inter-slice share of dp ride the
+# DCN (slice-count-granular); the rest live on ICI inside one slice.
+MESH_AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
+
+
+def mesh_pp_span(spec: Optional[TPUSpec]) -> int:
+    """Slices one pipeline replica spans (1 = no pipeline / no mesh).
+    Width changes and harvesting must move in multiples of this many
+    slices or a pipeline stage would be orphaned."""
+    if spec is None or not spec.mesh:
+        return 1
+    return max(1, int(spec.mesh.get("pp", 1) or 1))
+
+
 def validate_tpu_spec(spec: TPUSpec) -> None:
     """Reject topologies where hosts x chips/host contradicts the slice size."""
     if spec.coordinator_port <= 0 or spec.coordinator_port > 65535:
@@ -162,6 +183,26 @@ def validate_tpu_spec(spec: TPUSpec) -> None:
         raise ValidationError("numHosts must be >= 0 and chipsPerHost > 0")
     if spec.num_slices < 1:
         raise ValidationError("numSlices must be >= 1")
+    if spec.mesh:
+        for axis, size in spec.mesh.items():
+            if axis not in MESH_AXES:
+                raise ValidationError(
+                    f"unknown mesh axis {axis!r} (want one of "
+                    f"{', '.join(MESH_AXES)})")
+            if not isinstance(size, int) or isinstance(size, bool) or size < 1:
+                raise ValidationError(f"mesh.{axis} must be an integer >= 1")
+        pp = spec.mesh.get("pp", 1)
+        if spec.num_slices % pp != 0:
+            raise ValidationError(
+                f"mesh.pp ({pp}) must divide numSlices "
+                f"({spec.num_slices}): pipeline stages are slice-granular")
+        dp_inter = spec.num_slices // pp
+        dp = spec.mesh.get("dp", 1)
+        if dp_inter > 1 and dp % dp_inter != 0:
+            raise ValidationError(
+                f"mesh.dp ({dp}) must be divisible by the inter-slice "
+                f"share numSlices/pp ({dp_inter}): dp is the only axis "
+                f"besides pp that may span the DCN")
     m = _ACCEL_RE.match(spec.accelerator_type)
     if m:
         chips = int(m.group(3))
@@ -550,7 +591,15 @@ def validate_tfjob(job: TFJob) -> None:
                 f"{el.min_width}..{full} (0 = spec width)")
         if g.tf_replica_type == ReplicaType.TPU and g.tpu is not None:
             per = tpu_slice_hosts(g.tpu)
-            if el.min_width % per != 0:
+            pp = mesh_pp_span(g.tpu)
+            unit = per * pp
+            if el.min_width % unit != 0:
+                if pp > 1:
+                    raise ValidationError(
+                        f"elastic.minWidth {el.min_width} must be a multiple "
+                        f"of hosts-per-slice x mesh.pp ({per} x {pp} = "
+                        f"{unit}): width changes move by whole pipeline "
+                        f"replicas")
                 raise ValidationError(
                     f"elastic.minWidth {el.min_width} must be a multiple of "
                     f"the slice host count ({per}): TPU width changes are "

@@ -240,6 +240,22 @@ def _gateway_cells(j) -> tuple:
     return qps, hit
 
 
+def _placement(j) -> dict:
+    """The scheduler's placement record off the job's placement annotation
+    ({} when absent or unparseable): bound slices, DCN domains spanned,
+    adjacency score, mesh axis -> scope map."""
+    from ..api.labels import ANNOTATION_PLACEMENT
+
+    raw = j.metadata.annotations.get(ANNOTATION_PLACEMENT, "")
+    if not raw:
+        return {}
+    try:
+        d = json.loads(raw)
+    except ValueError:
+        return {}
+    return d if isinstance(d, dict) else {}
+
+
 def _alert_banner(cluster) -> str:
     """One-line firing-SLO summary for the ``get`` header ('' when quiet
     or the server has no SLO surface)."""
@@ -336,6 +352,10 @@ def cmd_get(args) -> int:
         w = j.status.width
         if w is not None and w.current < w.spec:
             kinds += f"[w={w.current}]"
+        # Multislice placement, when bound: "TPUx8[slices=4]".
+        pl = _placement(j)
+        if pl.get("slices"):
+            kinds += f"[slices={len(pl['slices'])}]"
         # Serving scale, when live: "Servingx1[s=3/3]" (current/ready).
         sv = j.status.serving
         if sv is not None and sv.replicas:
@@ -410,6 +430,7 @@ def cmd_describe(args) -> int:
         w = j.status.width
         tag = "  DEGRADED (replacement warming)" if w.current < w.spec else ""
         print(f"Width:     {w.current}/{w.spec} (elastic floor {w.min}){tag}")
+    _describe_placement(j)
     _describe_serving(j)
     _describe_gateway(j)
     if j.status.reason.startswith("GangQueued"):
@@ -443,6 +464,27 @@ def cmd_describe(args) -> int:
             age = _age(now - (e.last_timestamp or e.first_timestamp))
             print(f"  {age:>6}  {e.type:<8} {e.reason:<18} x{e.count}  {e.message}")
     return 0
+
+
+def _describe_placement(j) -> None:
+    """Placement section off the placement annotation: the bound slice
+    set, the DCN domains it spans (with the adjacency score — 1.0 means
+    one domain), and where each mesh axis lives (dcn vs ici)."""
+    d = _placement(j)
+    if not d.get("slices"):
+        return
+    slices = d["slices"]
+    domains = d.get("domains") or []
+    score = float(d.get("score", 1.0) or 1.0)
+    print(f"Placement: {len(slices)} slice(s) across "
+          f"{len(domains) or 1} DCN domain(s), adjacency={score:g}")
+    print(f"           slices: {', '.join(slices)}")
+    if domains:
+        print(f"           domains: {', '.join(domains)}")
+    mesh = d.get("mesh") or {}
+    if mesh:
+        cells = " ".join(f"{axis}->{mesh[axis]}" for axis in sorted(mesh))
+        print(f"           mesh: {cells}")
 
 
 def _describe_serving(j) -> None:

@@ -5,14 +5,27 @@ slices"): all pods of one slice are admitted atomically onto one free slice
 or not at all, and the whole slice is a single failure domain.  The real
 counterpart is GKE's TPU slice scheduling; tests fake it here the same way
 the reference fakes its cluster (SURVEY.md §4).
+
+Topology (multi-slice placement): slices live in a physical adjacency
+structure — a *DCN domain* (``TPUSlice.pod_id``: the pod/superblock whose
+slices share a data-center-network aggregation layer).  Cross-slice
+collectives pay per-domain setup and per-step latency, so a gang spanning
+fewer domains rendezvouses and steps faster.  ``_find_free_slices`` scores
+candidate sets by :func:`adjacency_score` (1.0 = one domain, 0.0 = every
+slice its own domain) and binds the set spanning the fewest domains;
+``release_slices`` keeps the surviving set contiguous by releasing the
+slices that break the fewest domains (and never the coordinator's).  A
+slice with no ``pod_id`` is its own domain — the flat pre-topology
+behavior, bit-identical to first-fit.
 """
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional
 
 from ..api.core import Pod, RESOURCE_TPU
 from ..utils import locks
@@ -38,6 +51,26 @@ class TPUSlice:
     # Wall-clock of the current binding (0 = free); feeds the utilization
     # accounting the contention bench and kctpu_slice_utilization read.
     bound_at: float = 0.0
+    # Topology coordinates: the pod/superblock whose slices share a DCN
+    # aggregation layer ("" = no topology info: the slice is its own
+    # domain), and the slice's position within it.
+    pod_id: str = ""
+    pod_pos: int = 0
+
+
+def dcn_domain(s: TPUSlice) -> str:
+    """The DCN adjacency domain a slice belongs to.  A slice without
+    topology coordinates is its own domain, which makes every adjacency
+    computation degenerate to the flat pre-topology behavior."""
+    return s.pod_id or s.name
+
+
+def adjacency_score(n_slices: int, n_domains: int) -> float:
+    """1.0 when the gang sits in a single DCN domain, 0.0 when every
+    slice is in its own; linear in the number of domain crossings."""
+    if n_slices <= 1:
+        return 1.0
+    return (n_slices - n_domains) / (n_slices - 1)
 
 
 @dataclass
@@ -68,7 +101,15 @@ def pod_requests_tpu(pod: Pod) -> bool:
 class TPUInventory:
     """Tracks slices and gangs; admits gangs all-or-nothing."""
 
-    def __init__(self, slices: Optional[List[TPUSlice]] = None):
+    def __init__(self, slices: Optional[List[TPUSlice]] = None,
+                 placement: str = "adjacency", seed: int = 0):
+        if placement not in ("adjacency", "random"):
+            raise ValueError(f"unknown placement mode {placement!r}")
+        # "adjacency" (default) picks free-slice sets spanning the fewest
+        # DCN domains; "random" shuffles the candidates — the placement
+        # baseline the multislice bench compares against.
+        self._placement = placement
+        self._rng = random.Random(seed)
         self._lock = locks.named_lock("tpu.inventory")
         self.slices: Dict[str, TPUSlice] = {s.name: s for s in (slices or [])}
         # Free-capacity index: accelerator type -> count of free healthy
@@ -133,7 +174,8 @@ class TPUInventory:
                     # slices than the (harvested/degraded) binding — grow
                     # it in place, all-or-nothing, before anyone starts.
                     extra = self._find_free_slices(
-                        accel, n_slices - len(gang.slice_names))
+                        accel, n_slices - len(gang.slice_names),
+                        prefer_domains=self._gang_domains_locked(gang))
                     if extra is None:
                         return False  # capacity not back yet: hold
                     self._bind_locked(gang, extra)
@@ -204,10 +246,16 @@ class TPUInventory:
                 g.pods[f"{pod.metadata.namespace}/{pod.metadata.name}"] = pod
 
     def release_slices(self, gang_name: str, n_release: int) -> List[str]:
-        """Partial release (elastic width harvesting): unbind the gang's
-        LAST ``n_release`` bound slices and return their names.  Bind
-        order is slice-index order, so the coordinator's slice (index 0)
-        is always kept — at least one slice survives."""
+        """Partial release (elastic width harvesting): unbind ``n_release``
+        of the gang's bound slices and return their names.  The released
+        set is chosen to break the FEWEST adjacency domains: the
+        coordinator's slice (bind position 0) is always kept, the
+        coordinator's domain is preferred whole, and remaining keeps fill
+        from the largest surviving domain groups — so the surviving set
+        stays as contiguous as the binding allows.  At least one slice
+        survives.  With no topology info (every slice its own domain) this
+        reduces to releasing the LAST ``n_release`` slices, the historical
+        behavior harvest callers rely on."""
         with self._lock:
             g = self._gangs.get(gang_name)
             if g is None or n_release <= 0:
@@ -215,10 +263,32 @@ class TPUInventory:
             n_release = min(n_release, max(0, len(g.slice_names) - 1))
             if n_release <= 0:
                 return []
-            keep = len(g.slice_names) - n_release
-            released = g.slice_names[keep:]
-            g.slice_names = g.slice_names[:keep]
-            g.num_slices = keep
+            names = list(g.slice_names)
+            keep_n = len(names) - n_release
+            # Group bind positions 1.. by domain (dict order = first
+            # occurrence); position 0 (coordinator) is always kept.
+            def dom_of(pos: int) -> str:
+                sl = self.slices.get(names[pos])
+                return dcn_domain(sl) if sl is not None else names[pos]
+            coord_dom = dom_of(0)
+            groups: Dict[str, List[int]] = {}
+            for pos in range(1, len(names)):
+                groups.setdefault(dom_of(pos), []).append(pos)
+            ordered = sorted(
+                groups.items(),
+                key=lambda kv: (kv[0] != coord_dom, -len(kv[1])))
+            kept = {0}
+            for _dom, positions in ordered:
+                for pos in positions:
+                    if len(kept) == keep_n:
+                        break
+                    kept.add(pos)
+                if len(kept) == keep_n:
+                    break
+            released = [names[pos] for pos in range(len(names))
+                        if pos not in kept]
+            g.slice_names = [names[pos] for pos in sorted(kept)]
+            g.num_slices = keep_n
             for name in released:
                 sl = self.slices.get(name)
                 if sl is not None:
@@ -234,12 +304,40 @@ class TPUInventory:
             g = self._gangs.get(gang_name)
             if g is None or n_extra <= 0:
                 return None
-            found = self._find_free_slices(accelerator_type, n_extra)
+            found = self._find_free_slices(
+                accelerator_type, n_extra,
+                prefer_domains=self._gang_domains_locked(g))
             if found is None:
                 return None
             self._bind_locked(g, found)
             g.num_slices = len(g.slice_names)
             return [sl.name for sl in found]
+
+    def _gang_domains_locked(self, g: _Gang) -> List[str]:
+        """Distinct DCN domains of the gang's bound slices, in bind order."""
+        out: List[str] = []
+        for name in g.slice_names:
+            sl = self.slices.get(name)
+            dom = dcn_domain(sl) if sl is not None else name
+            if dom not in out:
+                out.append(dom)
+        return out
+
+    def placement_of(self, gang_name: str) -> Optional[Dict[str, object]]:
+        """Topology view of an admitted gang's binding: slice names, the
+        DCN domains they span, and the adjacency score — what the
+        scheduler's placement metrics and ``kctpu describe`` surface."""
+        with self._lock:
+            g = self._gangs.get(gang_name)
+            if g is None or not g.slice_names:
+                return None
+            domains = self._gang_domains_locked(g)
+            return {
+                "slices": list(g.slice_names),
+                "domains": domains,
+                "score": round(
+                    adjacency_score(len(g.slice_names), len(domains)), 4),
+            }
 
     def has_free_slice(self, accelerator_type: str = "") -> bool:
         return self.free_slice_count(accelerator_type) > 0
@@ -277,19 +375,43 @@ class TPUInventory:
                 return 0.0
             return sum(1 for s in healthy if s.bound_gang) / len(healthy)
 
-    def _find_free_slices(self, accelerator_type: str,
-                          n: int) -> Optional[List[TPUSlice]]:
-        """n free healthy slices of the type, or None if fewer exist."""
-        out = []
-        for s in self.slices.values():
-            if s.bound_gang or not s.healthy:
-                continue
-            if accelerator_type and s.accelerator_type != accelerator_type:
-                continue
-            out.append(s)
-            if len(out) == n:
-                return out
-        return None
+    def _find_free_slices(self, accelerator_type: str, n: int,
+                          prefer_domains: Iterable[str] = (),
+                          ) -> Optional[List[TPUSlice]]:
+        """n free healthy slices of the type, or None if fewer exist.
+
+        Adjacency-scored: candidates are grouped by DCN domain and taken
+        largest-group-first, so the returned set spans the fewest domains
+        reachable from the current free pool (greedy largest-first is
+        optimal for "cover n items with fewest groups").  ``prefer_domains``
+        biases toward domains the gang already occupies — elastic
+        re-expansion stays adjacent to the surviving binding.  Ties keep
+        slice-table insertion order, so topology-free inventories (every
+        slice its own domain) behave exactly like the old first-fit scan.
+        """
+        free = [s for s in self.slices.values()
+                if not s.bound_gang and s.healthy
+                and (not accelerator_type
+                     or s.accelerator_type == accelerator_type)]
+        if len(free) < n:
+            return None
+        if self._placement == "random":
+            self._rng.shuffle(free)
+            return free[:n]
+        prefer = set(prefer_domains)
+        groups: Dict[str, List[TPUSlice]] = {}
+        for s in free:
+            groups.setdefault(dcn_domain(s), []).append(s)
+        ordered = sorted(
+            groups.items(),
+            key=lambda kv: (kv[0] not in prefer, -len(kv[1])))
+        out: List[TPUSlice] = []
+        for _dom, members in ordered:
+            for s in members:
+                out.append(s)
+                if len(out) == n:
+                    return out
+        return None  # unreachable: len(free) >= n
 
     def gang_slice(self, gang_name: str) -> str:
         with self._lock:

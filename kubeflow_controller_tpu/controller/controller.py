@@ -832,9 +832,47 @@ class Controller:
                 job, TYPE_NORMAL, REASON_GANG_ADMITTED,
                 f"gang {gang_name(job)} admitted: {running} pods running "
                 f"on slices {self.inventory.gang_slices(gang_name(job)) if self.inventory else '?'}")
+            self._stamp_placement(job)
         else:
             self.recorder.event(job, TYPE_WARNING, REASON_GANG_PREEMPTED,
                                 preempt_msg)
+
+    def _stamp_placement(self, job: TFJob) -> None:
+        """Persist the admitted gang's placement (slices, DCN domains,
+        adjacency score, mesh axis -> scope map) as ONE annotation on the
+        TFJob — what `kctpu describe` renders as the Placement section.
+        Best-effort: an inventory without topology support just skips."""
+        import json
+
+        from ..api.labels import ANNOTATION_PLACEMENT
+        from ..api.tfjob import replica_spec_for
+
+        if self.inventory is None:
+            return
+        placement_of = getattr(self.inventory, "placement_of", None)
+        if placement_of is None:
+            return
+        placement = placement_of(gang_name(job))
+        if placement is None:
+            return
+        spec = replica_spec_for(job, ReplicaType.TPU)
+        if spec is not None and spec.tpu is not None and spec.tpu.mesh:
+            from ..planner.meshmap import plan_mesh_slices
+
+            try:
+                placement["mesh"] = plan_mesh_slices(
+                    spec.tpu, len(placement["slices"])).axis_scope()
+            except Exception:
+                pass  # an undividable degraded width never blocks the stamp
+        def apply(m):
+            m.annotations[ANNOTATION_PLACEMENT] = json.dumps(
+                placement, sort_keys=True)
+
+        try:
+            self.cluster.tfjobs.patch_meta(
+                job.metadata.namespace, job.metadata.name, apply)
+        except NotFound:
+            pass
 
     def _assess_serving(self, key: str, job: TFJob, pods_by_type) -> TFJob:
         """Consult the serving autoscaler; persist a changed target as the

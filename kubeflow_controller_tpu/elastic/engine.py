@@ -43,7 +43,14 @@ from typing import Dict, Optional
 from ..api.core import PHASE_FAILED, PHASE_RUNNING, PHASE_SUCCEEDED, is_pod_active
 from ..api.tfjob import ReplicaType, TFJob, TFJobPhase, elastic_gang_spec, tpu_slice_hosts
 from ..obs.metrics import REGISTRY
-from ..planner.materialize import gang_generation, gang_width, pods_by_index, spec_width
+from ..planner.materialize import (
+    gang_generation,
+    gang_name,
+    gang_width,
+    pods_by_index,
+    spec_width,
+)
+from ..planner.meshmap import mesh_slice_unit
 from ..planner.plan import _pod_generation
 from ..recovery.policy import ACTION_BACKOFF, ACTION_EXHAUSTED
 from ..utils import locks
@@ -207,9 +214,11 @@ class ElasticEngine:
         if typ == ReplicaType.TPU and spec.tpu is not None:
             # TPU width is slice-granular: one dead host voids its whole
             # slice (the failure domain), so round the survivors down to
-            # whole slices.
-            per = tpu_slice_hosts(spec.tpu)
-            target = (target // per) * per
+            # whole slices — and with a pipelined mesh, to whole
+            # inter-slice dp replicas (pp slices each): degrading
+            # mid-pipeline would orphan a stage and stall every replica.
+            unit = mesh_slice_unit(spec.tpu)
+            target = (target // unit) * unit
         # The degraded window must outlast the failed indices' remaining
         # backoff (the replacement cannot come sooner) and the modeled
         # warm-up — captured NOW, because the re-shard deletes the failed
@@ -302,11 +311,22 @@ class ElasticEngine:
             # Harvested/lost width is re-granted as contention clears:
             # grow slice-granularly into whatever is free now, up to the
             # target — and keep polling while short (freed slices emit no
-            # watch event on this job).
+            # watch event on this job).  With a pipelined mesh, expansion
+            # moves by whole inter-slice dp replicas (pp slices), same as
+            # shrink: a partial pipeline replica cannot join the mesh.
             per = tpu_slice_hosts(spec.tpu)
+            unit = mesh_slice_unit(spec.tpu)
             free = inventory.free_slice_count(spec.tpu.accelerator_type)
-            grantable = w + free * per
-            target = min(target_full, (grantable // per) * per)
+            # A crash-degraded gang KEEPS its binding (only harvest
+            # releases slices), so width still bound to the gang is
+            # grantable alongside free capacity — without it a degraded
+            # gang holding its full slice set could never re-expand.
+            bound = 0
+            slices_of = getattr(inventory, "gang_slices", None)
+            if slices_of is not None:
+                bound = len(slices_of(gang_name(job))) * per
+            grantable = max(w, bound) + free * per
+            target = min(target_full, (grantable // unit) * unit)
             if target <= w:
                 out.requeue_after_s = self.policy.capacity_poll_s
                 return out

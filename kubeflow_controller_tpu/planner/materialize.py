@@ -62,6 +62,16 @@ ENV_TPU_ACCELERATOR = "TPU_ACCELERATOR_TYPE"
 # Multislice (DCN) contract — the names GKE multislice / megascale use.
 ENV_NUM_SLICES = "MEGASCALE_NUM_SLICES"
 ENV_SLICE_ID = "MEGASCALE_SLICE_ID"
+# Slice-local coordinator (host 0 of THIS pod's slice): intra-slice
+# rendezvous / per-slice rollup target, vs ENV_COORDINATOR which is the
+# one global jax.distributed coordinator on slice 0.
+ENV_SLICE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"
+# Mesh-to-slice plan (planner/meshmap.py): JSON of the GLOBAL mesh axes
+# at the gang's current width, e.g. {"dp": 2, "fsdp": 4, "pp": 2}.
+# Workloads build their device mesh from this — the shape the scheduler
+# actually placed — never by re-deriving axis sizes from spec.replicas
+# (the `kctpu vet` mesh-env rule).
+ENV_MESH = "KCTPU_MESH"
 # Per-job persistent compile cache (workloads/compile_cache.py): rides the
 # pod spec like the *Dir fields, so replacements and warm readmissions of
 # the gang land on the SAME populated cache and skip trace+XLA entirely.
@@ -477,8 +487,13 @@ def _wire_tpu_pod(job: TFJob, spec: TFReplicaSpec, pod: Pod, index: int) -> None
     num_slices_now = max(1, -(-total // per_slice))
     c.set_env(ENV_NUM_SLICES, str(num_slices_now))
     c.set_env(ENV_SLICE_ID, str(slice_idx))
+    # Slice-local coordinator: host 0 of this pod's slice (per-slice
+    # rendezvous / rollup), distinct from the global coordinator above.
+    c.set_env(ENV_SLICE_COORDINATOR,
+              f"{tpu_host_dns(job, slice_idx * per_slice)}:"
+              f"{tpu.coordinator_port}")
     # Recovery plane: generation-keyed rendezvous + guard identity.
-    from ..api.labels import ANNOTATION_GANG_GENERATION
+    from ..api.labels import ANNOTATION_GANG_GENERATION, ANNOTATION_MESH_PP
 
     gen = gang_generation(job)
     c.set_env(ENV_GANG_GENERATION, str(gen))
@@ -496,6 +511,17 @@ def _wire_tpu_pod(job: TFJob, spec: TFReplicaSpec, pod: Pod, index: int) -> None
         ANNOTATION_PRIORITY_CLASS: job.spec.priority_class_name or "default",
         ANNOTATION_GANG_GENERATION: str(gen),
     }
+    if tpu.mesh:
+        # Mesh-to-slice plan at the CURRENT width: the workload builds
+        # exactly this global mesh (meshmap factors dp over DCN x ICI and
+        # pins pp/dp_inter to the slice set the scheduler bound).
+        import json
+
+        from .meshmap import plan_mesh_slices
+
+        mplan = plan_mesh_slices(tpu, num_slices_now)
+        c.set_env(ENV_MESH, json.dumps(mplan.axes, sort_keys=True))
+        pod.metadata.annotations[ANNOTATION_MESH_PP] = str(mplan.pp_span)
     _stamp_elastic(job, spec, pod, c)
     if pod.spec.restart_policy == "Always":
         # A slice process that dies must fail the pod so the whole gang is

@@ -1,0 +1,132 @@
+"""Mesh-to-slice mapper: split declared parallelism axes across the
+slice topology the scheduler placed.
+
+A ``TPUSpec.mesh`` names logical axes (pp/dp/fsdp/tp/sp/ep) without
+saying where they live.  The physics decide (PAPERS.md — the pod-scale
+decompositions): pipeline (pp) and data (dp) parallelism tolerate the
+DCN's latency because they exchange small activations/gradients on a
+coarse cadence, while fsdp/tp/sp shuffle whole parameter shards every
+layer and must stay on ICI inside one slice.  So the mapper factors the
+mesh as
+
+    inter-slice (DCN):  pp  ×  dp_inter  (= num_slices / pp)
+    intra-slice (ICI):  dp_intra (= dp / dp_inter) × fsdp × tp × sp × ep
+
+and recomputes the DCN share at the gang's *current* width — elastic
+degrade removes whole inter-slice dp replicas (never a pipeline stage),
+so ``dp`` shrinks by exactly ``dp_intra`` per released pipeline span
+while every other axis is untouched.  The materializer serializes the
+current-width axes into ``$KCTPU_MESH`` so the workload builds the same
+global mesh the scheduler placed, instead of re-deriving shape from
+spec.replicas.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..api.tfjob import (
+    TPUSpec,
+    ValidationError,
+    mesh_pp_span,
+    tpu_slice_hosts,
+)
+
+# Axes that must stay inside one slice (ICI-hungry: per-layer collectives).
+ICI_AXES = ("fsdp", "tp", "sp", "ep")
+
+
+@dataclass(frozen=True)
+class MeshSlicePlan:
+    """The factored mesh at a concrete slice count."""
+
+    # Global mesh axes at the current width — what $KCTPU_MESH carries
+    # and the workload hands to build_mesh.
+    axes: Dict[str, int] = field(default_factory=dict)
+    # DCN factors: {"pp": ..., "dp": dp_inter} — axes (shares) that span
+    # slices.  ICI factors: everything that stays inside one slice,
+    # including dp's intra-slice share.
+    inter: Dict[str, int] = field(default_factory=dict)
+    intra: Dict[str, int] = field(default_factory=dict)
+    num_slices: int = 1
+    # Slices one pipeline replica spans == the slice-granularity of any
+    # width change (the mesh-integrity unit, in slices).
+    pp_span: int = 1
+    dp_inter: int = 1
+    dp_intra: int = 1
+
+    def axis_scope(self) -> Dict[str, str]:
+        """axis -> "dcn" | "ici" | "dcn x ici" — the describe view."""
+        out: Dict[str, str] = {}
+        for axis in self.axes:
+            if axis == "pp":
+                out[axis] = "dcn" if self.num_slices > 1 else "ici"
+            elif axis == "dp":
+                if self.dp_inter > 1 and self.dp_intra > 1:
+                    out[axis] = "dcn x ici"
+                elif self.dp_inter > 1:
+                    out[axis] = "dcn"
+                else:
+                    out[axis] = "ici"
+            else:
+                out[axis] = "ici"
+        return out
+
+
+def mesh_slice_unit(tpu: Optional[TPUSpec]) -> int:
+    """Width-change granularity in HOSTS: hosts-per-slice x pp.  One
+    inter-slice dp replica spans pp slices; degrading by anything finer
+    would orphan a pipeline stage."""
+    if tpu is None:
+        return 1
+    return tpu_slice_hosts(tpu) * mesh_pp_span(tpu)
+
+
+def plan_mesh_slices(tpu: TPUSpec,
+                     num_slices_now: Optional[int] = None) -> MeshSlicePlan:
+    """Factor ``tpu.mesh`` across ``num_slices_now`` slices (default: the
+    spec's full slice count).  Raises ValidationError when the mesh does
+    not divide — full-width divisibility is also enforced at admission by
+    :func:`~..api.tfjob.validate_tpu_spec`."""
+    full = max(1, tpu.num_slices)
+    now = full if num_slices_now is None else max(1, num_slices_now)
+    if not tpu.mesh:
+        return MeshSlicePlan(axes={}, inter={}, intra={}, num_slices=now)
+    pp = mesh_pp_span(tpu)
+    if full % pp != 0:
+        raise ValidationError(
+            f"mesh.pp ({pp}) must divide numSlices ({full})")
+    # A degraded width that is not a whole number of pipeline replicas
+    # cannot host the mesh; use the largest width that is.  The elastic
+    # engine rounds targets to this unit so in practice now == effective.
+    effective = max(pp, (now // pp) * pp)
+    dp_inter_full = full // pp
+    dp_full = int(tpu.mesh.get("dp", 1) or 1)
+    if dp_inter_full > 1 and dp_full % dp_inter_full != 0:
+        raise ValidationError(
+            f"mesh.dp ({dp_full}) must be divisible by the inter-slice "
+            f"share numSlices/pp ({dp_inter_full})")
+    dp_intra = dp_full // dp_inter_full if dp_inter_full > 1 else dp_full
+    if dp_inter_full == 1:
+        # All of dp fits in one slice-span; nothing to shrink over DCN.
+        dp_intra = dp_full
+    dp_inter_now = effective // pp
+    dp_now = dp_intra * dp_inter_now if dp_inter_full > 1 else dp_full
+    axes = {k: int(v) for k, v in tpu.mesh.items()}
+    axes["dp"] = dp_now
+    if pp > 1 or "pp" in tpu.mesh:
+        axes["pp"] = pp
+    intra = {"dp": dp_intra}
+    for axis in ICI_AXES:
+        if axis in axes:
+            intra[axis] = axes[axis]
+    return MeshSlicePlan(
+        axes=axes,
+        inter={"pp": pp, "dp": dp_inter_now},
+        intra=intra,
+        num_slices=effective,
+        pp_span=pp,
+        dp_inter=dp_inter_now,
+        dp_intra=dp_intra,
+    )

@@ -54,6 +54,10 @@ class GangEntry:
     # binding may be HARVESTED by a blocked higher-priority gang instead
     # of preempting it whole (scheduler._harvest_for_locked).
     min_slices: int = 0
+    # Slices one pipeline replica spans (mesh.pp; 1 = no pipeline):
+    # harvesting must take multiples of this or a pipeline stage would
+    # be orphaned and the whole victim gang would stall.
+    pp_span: int = 1
     # True once any member pod passed the admission gate (left Pending):
     # an admitted-but-unstarted gang can be requeued silently, a started
     # one must be evicted pod-by-pod.

@@ -48,6 +48,7 @@ from ..api.labels import (
     ANNOTATION_ELASTIC_MIN_SLICES,
     ANNOTATION_GANG_NAME,
     ANNOTATION_GANG_SIZE,
+    ANNOTATION_MESH_PP,
     ANNOTATION_NUM_SLICES,
     ANNOTATION_PRIORITY_CLASS,
     ANNOTATION_SLICE_INDEX,
@@ -142,6 +143,16 @@ class GangScheduler:
             "kctpu_sched_harvested_slices_total",
             "Slices harvested from running elastic gangs instead of "
             "whole-gang preemption (victim's class)", ("priority_class",))
+        self._h_domains = REGISTRY.histogram(
+            "kctpu_sched_dcn_domains_per_gang",
+            "DCN adjacency domains a gang's binding spans at admission "
+            "(1 = fully contiguous)",
+            buckets=(1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0))
+        self._h_adjacency = REGISTRY.histogram(
+            "kctpu_sched_adjacency_score",
+            "Adjacency score of a gang's binding at admission "
+            "(1.0 = one DCN domain, 0.0 = every slice its own)",
+            buckets=(0.0, 0.2, 0.4, 0.6, 0.8, 0.9, 1.0))
         g_util = REGISTRY.gauge(
             "kctpu_slice_utilization",
             "Bound fraction of healthy TPU slices (scrape-time)")
@@ -184,9 +195,11 @@ class GangScheduler:
                 self._gangs[gang_name] = e
             e.pods[key] = pod
             # Elastic floor rides the pods (refreshed every offer: a new
-            # generation may carry a new width/floor).
+            # generation may carry a new width/floor).  The pipeline span
+            # rides along: harvest granularity for mesh-integrity.
             e.min_slices = int(
                 ann.get(ANNOTATION_ELASTIC_MIN_SLICES, "0") or "0")
+            e.pp_span = max(1, int(ann.get(ANNOTATION_MESH_PP, "1") or "1"))
             if e.admitted:
                 # Keep the bound inventory gang's member map current: a
                 # re-shard replaces every pod without rebinding, and the
@@ -329,6 +342,12 @@ class GangScheduler:
         self._h_wait.labels(e.priority_class).observe(
             max(0.0, now - e.enqueued_at))
         self._c_admit.labels(e.priority_class).inc()
+        # Placement quality of the binding just made (inventory lock is a
+        # leaf under the scheduler lock, so the nested query is safe).
+        placement = self.inventory.placement_of(e.name)
+        if placement is not None:
+            self._h_domains.observe(float(len(placement["domains"])))
+            self._h_adjacency.observe(float(placement["score"]))
         if backfill:
             self._c_backfill.inc()
         self._trace_admission(e, now, backfill)
@@ -379,13 +398,30 @@ class GangScheduler:
                 break
             surplus = len(v.slice_names) - v.min_slices
             take = min(surplus, need - free - gained)
+            # Mesh integrity: a pipelined victim (pp_span > 1) loses whole
+            # inter-slice dp replicas or nothing — taking a partial span
+            # would orphan a pipeline stage and stall the ENTIRE victim,
+            # worse than not harvesting it.  Round the take UP to a whole
+            # span when the surplus allows (over-taking a rounded-down
+            # need is fine: the extra slices end up free), else down.
+            unit = max(1, v.pp_span)
+            if take % unit != 0:
+                up = -(-take // unit) * unit
+                take = up if up <= surplus else (take // unit) * unit
+            if take <= 0:
+                continue
+            before = list(v.slice_names)
             released = self.inventory.release_slices(v.name, take)
             if not released:
                 continue
             gained += len(released)
-            kept = len(v.slice_names) - len(released)
-            v.slice_names = v.slice_names[:kept]
-            v.num_slices = kept
+            # The inventory chose WHICH slices break the fewest adjacency
+            # domains — generally not the tail — so map the released
+            # names back to their bind positions to find the member pods.
+            rel = set(released)
+            released_pos = {i for i, nm in enumerate(before) if nm in rel}
+            v.slice_names = [nm for nm in before if nm not in rel]
+            v.num_slices = len(v.slice_names)
             self._c_harvest.labels(v.priority_class).inc(len(released))
             self._dirty = True
             # Fail exactly the members on the released slices; survivors
@@ -401,7 +437,7 @@ class GangScheduler:
                         ANNOTATION_SLICE_INDEX, "0") or "0")
                 except ValueError:
                     si = 0
-                if si >= kept:
+                if si in released_pos:
                     victim_keys.append(k)
                     v.pods.pop(k, None)
             if victim_keys:
@@ -536,6 +572,12 @@ class GangScheduler:
 
     def gang_slices(self, gang_name: str) -> List[str]:
         return self.inventory.gang_slices(gang_name)
+
+    def placement_of(self, gang_name: str):
+        """Topology view of an admitted gang's binding (slices, DCN
+        domains, adjacency score) — the controller stamps this onto the
+        TFJob as the placement annotation."""
+        return self.inventory.placement_of(gang_name)
 
     def release_gang(self, gang_name: str) -> None:
         with self._lock:

@@ -134,10 +134,21 @@ def main(argv=None) -> int:
         import dataclasses
 
         cfg = dataclasses.replace(cfg, **overrides)
-    if args.pp > 1 and cfg.n_layers % args.pp:
-        p.error(f"--pp {args.pp} does not divide n_layers {cfg.n_layers}")
-    mesh = build_mesh(MeshSpec(dp=args.dp, fsdp=args.fsdp, tp=args.tp,
-                               sp=args.sp, pp=args.pp, ep=args.ep))
+    # Mesh axes: CLI flags are the default; when the controller planned a
+    # mesh-to-slice mapping ($KCTPU_MESH, already recomputed for the
+    # gang's CURRENT width), that is the authoritative shape — the axes
+    # the scheduler actually placed.  Never re-derive axis sizes from the
+    # replica count (`kctpu vet` mesh-env rule).
+    axes = {"dp": args.dp, "fsdp": args.fsdp, "tp": args.tp,
+            "sp": args.sp, "pp": args.pp, "ep": args.ep}
+    if rt.mesh:
+        axes.update({k: v for k, v in rt.mesh.items() if k in axes})
+    pp = axes["pp"]
+    if pp > 1 and cfg.n_layers % pp:
+        p.error(f"pp {pp} does not divide n_layers {cfg.n_layers}")
+    mesh = build_mesh(MeshSpec(dp=axes["dp"], fsdp=axes["fsdp"],
+                               tp=axes["tp"], sp=axes["sp"],
+                               pp=pp, ep=axes["ep"]))
     pspecs = llama_param_pspecs(cfg)
 
     with compat_set_mesh(mesh):
@@ -163,7 +174,7 @@ def main(argv=None) -> int:
         batch_spec = logical_to_pspec(("batch", "seq"))
         batch_sharding = NamedSharding(mesh, batch_spec)
 
-        if args.pp > 1:
+        if pp > 1:
             # 1F1B fused forward/backward pipeline schedule — activations
             # ring-buffered per stage, so peak memory is independent of the
             # microbatch count (parallel/pipeline.py:pipeline_1f1b).  MoE
@@ -195,7 +206,7 @@ def main(argv=None) -> int:
         from ..parallel.mesh import data_parallel_size
 
         dp_size = data_parallel_size(mesh)
-        unit = dp_size * args.microbatches if args.pp > 1 else dp_size
+        unit = dp_size * args.microbatches if pp > 1 else dp_size
         bs = max(unit, args.batch_size - args.batch_size % unit)
         tokens_all = d.synthetic_tokens(
             jax.random.PRNGKey(1), max(64, 2 * bs), args.seq_len, cfg.vocab_size

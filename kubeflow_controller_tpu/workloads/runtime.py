@@ -17,9 +17,11 @@ from typing import Any, Callable, Dict, List, Optional
 from ..planner.materialize import (
     ENV_COORDINATOR,
     ENV_GANG_WIDTH,
+    ENV_MESH,
     ENV_NUM_PROCESSES,
     ENV_NUM_SLICES,
     ENV_PROCESS_ID,
+    ENV_SLICE_COORDINATOR,
     ENV_SLICE_ID,
     ENV_TPU_ACCELERATOR,
     ENV_TPU_WORKER_HOSTNAMES,
@@ -37,6 +39,28 @@ ENV_RENDEZVOUS_DIR = "KCTPU_RENDEZVOUS_DIR"
 # leftover readiness drop can never convince a new peer that a coordinator
 # which no longer exists is about to bind.
 ENV_GANG_GENERATION = "KCTPU_GANG_GENERATION"
+
+
+def _parse_mesh(raw: str) -> Dict[str, int]:
+    """$KCTPU_MESH JSON -> {axis: size}; tolerant of absence/garbage (a
+    workload outside the controller contract just uses its CLI flags)."""
+    if not raw:
+        return {}
+    import json
+
+    try:
+        obj = json.loads(raw)
+    except ValueError:
+        return {}
+    if not isinstance(obj, dict):
+        return {}
+    out: Dict[str, int] = {}
+    for k, v in obj.items():
+        try:
+            out[str(k)] = max(1, int(v))
+        except (TypeError, ValueError):
+            return {}
+    return out
 
 
 def _ready_filename(coordinator: str, generation: int = 0) -> str:
@@ -108,6 +132,15 @@ class JobRuntime:
     # inside a slice), e.g. MeshSpec(dp=num_slices, ...).
     num_slices: int = 1
     slice_id: int = 0
+    # Slice-local coordinator (host 0 of this process's slice), for
+    # per-slice rendezvous/rollup; empty outside the controller contract.
+    slice_coordinator: str = ""
+    # Mesh-to-slice plan ($KCTPU_MESH, planner/meshmap.py): the GLOBAL
+    # mesh axes at the gang's current width, e.g. {"dp": 2, "pp": 2,
+    # "fsdp": 4}.  Workloads build their device mesh from THIS — the
+    # shape the scheduler actually placed — overriding any CLI axis
+    # flags; empty = no mesh declared (flat dp across slices).
+    mesh: Dict[str, int] = field(default_factory=dict)
     # Recovery plane: which gang generation this process belongs to (0 =
     # first incarnation).  Bumped by the controller on gang replacement;
     # keys the readiness drops below so generations never cross-talk.
@@ -136,6 +169,8 @@ class JobRuntime:
             worker_hostnames=hostnames,
             num_slices=int(e.get(ENV_NUM_SLICES, "1") or "1"),
             slice_id=int(e.get(ENV_SLICE_ID, "0") or "0"),
+            slice_coordinator=e.get(ENV_SLICE_COORDINATOR, ""),
+            mesh=_parse_mesh(e.get(ENV_MESH, "")),
             gang_generation=int(e.get(ENV_GANG_GENERATION, "0") or "0"),
             gang_width=(int(e.get(ENV_GANG_WIDTH, "0") or "0")
                         or int(e.get(ENV_NUM_PROCESSES, "1") or "1")),

@@ -1,0 +1,20 @@
+"""Fixture: workload recomputing its slice identity / mesh shape from the
+SPEC — the exact bug the mesh-env rule exists for.  The slice set a
+degraded gang actually spans differs from spec.tpu per generation
+(elastic degrade removes whole pipeline replicas), so a spec-derived mesh
+builds a different shape than the scheduler placed.  Path contains
+'workloads/' so the rule applies."""
+
+
+def build_axes(job):
+    # BAD: slice count off the spec topology — the full count, not this
+    # generation's; a degraded 2-of-4-slice gang would build a dp=4 mesh.
+    n = job.spec.tf_replica_specs[0].tpu.num_slices
+    return {"dp": n, "fsdp": 8}
+
+
+def my_slice(spec, process_id, per_slice):
+    # BAD: bare spec-shaped reads of the slice identity.
+    if spec.tpu.num_slices > 1:
+        return process_id // per_slice
+    return spec.tpu.slice_id

@@ -1,0 +1,22 @@
+"""Fixture: workload reading slice identity / mesh shape from the runtime
+env contract — what mesh-env requires.  $MEGASCALE_SLICE_ID /
+$MEGASCALE_NUM_SLICES / $KCTPU_MESH (JobRuntime.slice_id / .num_slices /
+.mesh) are stamped per generation by the materializer, already recomputed
+for the gang's current width."""
+
+import json
+import os
+
+
+def build_axes(rt):
+    # GOOD: the mesh the scheduler actually placed, at the current width.
+    if rt.mesh:
+        return dict(rt.mesh)
+    raw = os.environ.get("KCTPU_MESH", "")
+    return json.loads(raw) if raw else {"dp": rt.num_slices}
+
+
+def my_slice(rt):
+    # GOOD: JobRuntime's fields ARE the env-derived values.
+    n = int(os.environ.get("MEGASCALE_NUM_SLICES", "1"))
+    return rt.slice_id if n > 1 else 0

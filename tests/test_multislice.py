@@ -1,0 +1,626 @@
+"""Multi-slice topology-aware placement (ISSUE 19): DCN-adjacency slice
+scoring in the inventory (bind / keep-greedy release / prefer-domain
+re-expansion), the mesh-to-slice planner (planner/meshmap.py), the
+materializer's mesh env contract at full and degraded widths, the
+elastic engine's mesh-integrity unit rounding (whole inter-slice dp
+replicas, never mid-pipeline), pp-granular scheduler harvesting, the
+mesh-env vet rule, and the CLI placement surfaces.  The end-to-end
+gates (adjacency vs random, mid-run kill degrading by exactly one dp
+replica) live in bench.py --multislice (`make multislice-smoke`)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import (
+    PHASE_FAILED,
+    Container,
+    PodTemplateSpec,
+)
+from kubeflow_controller_tpu.api.labels import (
+    ANNOTATION_MESH_PP,
+    ANNOTATION_PLACEMENT,
+    ANNOTATION_SLICE_INDEX,
+)
+from kubeflow_controller_tpu.api.meta import ObjectMeta
+from kubeflow_controller_tpu.api.tfjob import (
+    ElasticSpec,
+    ReplicaType,
+    TFJob,
+    TFJobPhase,
+    TFReplicaSpec,
+    TPUSpec,
+    ValidationError,
+    mesh_pp_span,
+    validate_tfjob,
+    validate_tpu_spec,
+)
+from kubeflow_controller_tpu.cluster import TPUInventory, TPUSlice
+from kubeflow_controller_tpu.cluster.tpu import adjacency_score, dcn_domain
+from kubeflow_controller_tpu.elastic import (
+    KIND_DEGRADE,
+    KIND_EXPAND,
+    ElasticEngine,
+    ElasticPolicy,
+)
+from kubeflow_controller_tpu.planner.materialize import (
+    ENV_MESH,
+    ENV_NUM_SLICES,
+    ENV_SLICE_COORDINATOR,
+    ENV_SLICE_ID,
+    make_pod,
+)
+from kubeflow_controller_tpu.planner.meshmap import (
+    MeshSlicePlan,
+    mesh_slice_unit,
+    plan_mesh_slices,
+)
+
+from test_elastic import mk_member, mk_tpu_elastic_job, set_width
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def sb_slices(n=8, per_block=2, accel="v5e-8"):
+    """n slices across n/per_block superblocks: s0,s1 in sb0; s2,s3 in
+    sb1; ..."""
+    return [TPUSlice(f"s{i}", accel, num_hosts=2,
+                     pod_id=f"sb{i // per_block}", pod_pos=i % per_block)
+            for i in range(n)]
+
+
+def env_of(pod) -> dict:
+    return {e.name: e.value for e in pod.spec.containers[0].env}
+
+
+# ---------------------------------------------------------------------------
+# Inventory: adjacency-scored bind / keep-greedy release
+# ---------------------------------------------------------------------------
+
+class TestAdjacencyInventory:
+    def test_score_and_domain_defaults(self):
+        assert adjacency_score(1, 1) == 1.0
+        assert adjacency_score(4, 1) == 1.0
+        assert adjacency_score(4, 4) == 0.0
+        assert adjacency_score(4, 2) == pytest.approx(2 / 3)
+        # No topology coordinates: the slice is its own domain.
+        assert dcn_domain(TPUSlice("lonely")) == "lonely"
+        assert dcn_domain(TPUSlice("s", pod_id="sbX")) == "sbX"
+
+    def test_bind_prefers_fewest_domains_over_first_fit(self):
+        # sb0 is fragmented (s0 bound); first-fit would take s1 (sb0) +
+        # s2 (sb1) and span 2 domains — adjacency takes the intact sb1.
+        slices = sb_slices(6)
+        slices[0].bound_gang = "other"
+        inv = TPUInventory(slices)
+        bound = inv.bind_gang("g", "v5e-8", n_slices=2)
+        assert bound == ["s2", "s3"]
+        assert inv.placement_of("g") == {
+            "slices": ["s2", "s3"], "domains": ["sb1"], "score": 1.0}
+
+    def test_bind_spans_minimum_domains_when_no_block_is_whole(self):
+        slices = sb_slices(8)
+        for i in (0, 3, 5, 7):  # one free slice per superblock
+            slices[i].bound_gang = "other"
+        inv = TPUInventory(slices)
+        inv.bind_gang("g", "v5e-8", n_slices=3)
+        pl = inv.placement_of("g")
+        assert len(pl["domains"]) == 3  # one per block: can't do better
+        assert pl["score"] == 0.0
+
+    def test_random_placement_is_seeded_and_valid(self):
+        a = TPUInventory(sb_slices(8), placement="random", seed=5)
+        b = TPUInventory(sb_slices(8), placement="random", seed=5)
+        assert a.bind_gang("g", "v5e-8", n_slices=4) == \
+            b.bind_gang("g", "v5e-8", n_slices=4)
+        with pytest.raises(ValueError):
+            TPUInventory([], placement="topological")
+
+    def test_flat_inventory_binds_in_table_order(self):
+        # No pod_id: every slice its own domain — bit-identical to the
+        # old first-fit scan (ties keep insertion order).
+        inv = TPUInventory([TPUSlice(f"s{i}", "v5e-8") for i in range(4)])
+        assert inv.bind_gang("g", "v5e-8", n_slices=2) == ["s0", "s1"]
+
+    def test_release_keeps_coordinator_domain_whole(self):
+        # Bind takes sb0 whole plus one sb2 slice ([s0, s1, s4]); grow
+        # biases back into the gang's own domains ([s5], not the free
+        # s2 in untouched sb1).  Releasing 2 must then drop the sb2
+        # block whole — never the coordinator's block, never position 0.
+        slices = sb_slices(6)
+        slices[3].bound_gang = "other"  # fragment sb1
+        inv = TPUInventory(slices)
+        assert inv.bind_gang("g", "v5e-8", n_slices=3) == ["s0", "s1", "s4"]
+        assert inv.placement_of("g")["score"] == 0.5
+        assert inv.grow_gang("g", "v5e-8", 1) == ["s5"]
+        assert inv.release_slices("g", 2) == ["s4", "s5"]
+        assert inv.gang_slices("g") == ["s0", "s1"]
+        assert inv.placement_of("g") == {
+            "slices": ["s0", "s1"], "domains": ["sb0"], "score": 1.0}
+
+    def test_release_non_tail_when_coordinator_domain_rebound_late(self):
+        # Keep-greedy is position-aware, not tail-biased: a gang that
+        # re-expanded back INTO its coordinator's domain releases the
+        # foreign MIDDLE slice, not the newest one.
+        mk = lambda name, dom, pos: TPUSlice(
+            name, "v5e-8", num_hosts=2, pod_id=dom, pod_pos=pos)
+        slices = [mk("a0", "A", 0), mk("a1", "A", 1), mk("a2", "A", 2),
+                  mk("b0", "B", 0)]
+        slices[2].bound_gang = "other"  # only a0, a1 free in A initially
+        inv = TPUInventory(slices)
+        assert inv.bind_gang("g", "v5e-8", n_slices=3) == ["a0", "a1", "b0"]
+        inv.add_slice(mk("a2", "A", 2))  # A's third slice frees up
+        assert inv.grow_gang("g", "v5e-8", 1) == ["a2"]  # prefers A
+        # slice_names is now [a0, a1, b0, a2]: b0 sits mid-list.
+        assert inv.release_slices("g", 1) == ["b0"]
+        assert inv.gang_slices("g") == ["a0", "a1", "a2"]
+        assert inv.placement_of("g")["score"] == 1.0
+
+    def test_flat_release_is_the_historical_tail_release(self):
+        inv = TPUInventory([TPUSlice(f"s{i}", "v5e-8") for i in range(4)])
+        inv.bind_gang("g", "v5e-8", n_slices=4)
+        assert inv.release_slices("g", 2) == ["s2", "s3"]
+        assert inv.gang_slices("g") == ["s0", "s1"]
+
+    def test_regrow_prefers_the_gangs_existing_domains(self):
+        slices = sb_slices(8)
+        inv = TPUInventory(slices)
+        inv.bind_gang("g", "v5e-8", n_slices=2)       # sb0 whole
+        inv.bind_gang("other", "v5e-8", n_slices=2)   # sb1 whole
+        inv.release_slices("g", 1)                    # s1 freed
+        inv.release_gang("other")                     # sb1 free again
+        # Without the prefer-domains bias the largest free group (sb1,
+        # also sb2/sb3: all size 2 vs sb0's 1) would win the tie.
+        assert inv.grow_gang("g", "v5e-8", 1) == ["s1"]
+
+
+# ---------------------------------------------------------------------------
+# planner/meshmap.py: mesh-to-slice factoring
+# ---------------------------------------------------------------------------
+
+def mk_tpu(mesh, num_slices=4, num_hosts=2):
+    return TPUSpec(accelerator_type="v5e-8", num_hosts=num_hosts,
+                   num_slices=num_slices, mesh=mesh)
+
+
+class TestMeshSlicePlan:
+    def test_full_width_pp_dp_factoring(self):
+        p = plan_mesh_slices(mk_tpu({"pp": 2, "dp": 2, "fsdp": 4}))
+        assert isinstance(p, MeshSlicePlan)
+        assert p.axes == {"dp": 2, "fsdp": 4, "pp": 2}
+        assert (p.pp_span, p.dp_inter, p.dp_intra) == (2, 2, 1)
+        scope = p.axis_scope()
+        assert scope["pp"] == "dcn" and scope["fsdp"] == "ici"
+
+    def test_degraded_width_sheds_whole_dp_replicas(self):
+        tpu = mk_tpu({"pp": 2, "dp": 2, "fsdp": 4})
+        p = plan_mesh_slices(tpu, num_slices_now=2)
+        assert p.axes == {"dp": 1, "fsdp": 4, "pp": 2}
+
+    def test_non_divisible_width_rounds_down_to_whole_pipelines(self):
+        tpu = mk_tpu({"pp": 2, "dp": 2, "fsdp": 4})
+        # 3 slices cannot host 1.5 pipelines: plan as 2 (one dp replica).
+        p = plan_mesh_slices(tpu, num_slices_now=3)
+        assert p.num_slices == 2
+        assert p.axes["dp"] == 1
+
+    def test_dp_only_mesh_spreads_over_dcn_and_ici(self):
+        p = plan_mesh_slices(mk_tpu({"dp": 8, "fsdp": 1}, num_slices=4))
+        assert p.axes["dp"] == 8
+        assert (p.dp_inter, p.dp_intra) == (4, 2)
+        assert p.axis_scope()["dp"] == "dcn x ici"
+
+    def test_empty_mesh_plans_empty(self):
+        p = plan_mesh_slices(mk_tpu({}))
+        assert p.axes == {}
+        assert p.pp_span == 1
+
+    def test_unit_is_hosts_times_pp_span(self):
+        assert mesh_slice_unit(mk_tpu({"pp": 2, "dp": 2})) == 4
+        assert mesh_slice_unit(mk_tpu({"dp": 4})) == 2
+        assert mesh_slice_unit(None) == 1
+
+    def test_validation_rejects_non_slice_granular_pipelines(self):
+        with pytest.raises(ValidationError, match="slice-granular"):
+            validate_tpu_spec(mk_tpu({"pp": 3}, num_slices=4))
+        with pytest.raises(ValidationError, match="unknown mesh axis"):
+            validate_tpu_spec(mk_tpu({"warp": 2}))
+        with pytest.raises(ValidationError, match="integer >= 1"):
+            validate_tpu_spec(mk_tpu({"dp": 0}))
+        validate_tpu_spec(mk_tpu({"pp": 2, "dp": 2, "fsdp": 4}))
+
+    def test_elastic_floor_must_be_whole_dp_replicas(self):
+        job = mk_tpu_elastic_job("mj", num_slices=4, min_width=2)
+        job.spec.tf_replica_specs[0].tpu.mesh = {"pp": 2, "dp": 2}
+        with pytest.raises(ValidationError, match="pipeline"):
+            validate_tfjob(job)
+        job.spec.elastic = ElasticSpec(min_width=4)
+        validate_tfjob(job)
+        assert mesh_pp_span(job.spec.tf_replica_specs[0].tpu) == 2
+
+
+# ---------------------------------------------------------------------------
+# Materializer: the mesh env contract at full and degraded widths
+# ---------------------------------------------------------------------------
+
+class TestMaterializeMeshEnv:
+    def _job(self, mesh={"pp": 2, "dp": 2, "fsdp": 4}):
+        job = mk_tpu_elastic_job("mmat", num_slices=4, min_width=4)
+        job.spec.tf_replica_specs[0].tpu.mesh = dict(mesh)
+        return job
+
+    def test_full_width_stamps_mesh_env_and_pp_annotation(self):
+        job = self._job()
+        pod = make_pod(job, job.spec.tf_replica_specs[0], 3)
+        env = env_of(pod)
+        assert json.loads(env[ENV_MESH]) == {"dp": 2, "fsdp": 4, "pp": 2}
+        assert env[ENV_NUM_SLICES] == "4"
+        assert env[ENV_SLICE_ID] == "1"          # index 3 // 2 hosts
+        assert env[ENV_SLICE_COORDINATOR].startswith("host-2.")
+        assert pod.metadata.annotations[ANNOTATION_MESH_PP] == "2"
+        assert pod.metadata.annotations[ANNOTATION_SLICE_INDEX] == "1"
+
+    def test_degraded_width_replans_the_mesh(self):
+        job = self._job()
+        set_width(job, 4, 1)
+        pod = make_pod(job, job.spec.tf_replica_specs[0], 3)
+        env = env_of(pod)
+        assert json.loads(env[ENV_MESH]) == {"dp": 1, "fsdp": 4, "pp": 2}
+        assert env[ENV_NUM_SLICES] == "2"
+
+    def test_non_divisible_width_edge(self):
+        # Width 3 on 2-host slices: ceil(3/2)=2 slices — the slice/local
+        # math stays consistent and the plan rounds to whole pipelines.
+        job = self._job()
+        set_width(job, 3, 1)
+        pod = make_pod(job, job.spec.tf_replica_specs[0], 2)
+        env = env_of(pod)
+        assert (env[ENV_SLICE_ID], env[ENV_NUM_SLICES]) == ("1", "2")
+        assert json.loads(env[ENV_MESH])["dp"] == 1
+
+    def test_width_change_mid_generation_rematerializes_consistently(self):
+        # The pod is a pure function of (job, index): the same index
+        # materialized before and after a width patch carries each
+        # width's mesh — no stale-env replica can join the new world.
+        job = self._job()
+        before = env_of(make_pod(job, job.spec.tf_replica_specs[0], 1))
+        set_width(job, 4, 1)
+        after = env_of(make_pod(job, job.spec.tf_replica_specs[0], 1))
+        assert json.loads(before[ENV_MESH])["dp"] == 2
+        assert json.loads(after[ENV_MESH])["dp"] == 1
+        assert (before[ENV_NUM_SLICES], after[ENV_NUM_SLICES]) == ("4", "2")
+
+    def test_meshless_tpu_pod_has_no_mesh_env(self):
+        job = mk_tpu_elastic_job("plain", num_slices=2, min_width=2)
+        pod = make_pod(job, job.spec.tf_replica_specs[0], 0)
+        env = env_of(pod)
+        assert ENV_MESH not in env
+        assert ANNOTATION_MESH_PP not in pod.metadata.annotations
+        assert env[ENV_SLICE_COORDINATOR].startswith("host-0.")
+
+
+# ---------------------------------------------------------------------------
+# Elastic engine: shrink/expand by whole inter-slice dp replicas
+# ---------------------------------------------------------------------------
+
+def tpu_members(n, gen=0, failed=(), fit_step=None, job="tjob"):
+    return {ReplicaType.TPU: [
+        mk_member(f"m{i}", i, gen=gen, typ="TPU", job=job,
+                  phase=PHASE_FAILED if i in failed else "Running",
+                  reason="Error: exit -9" if i in failed else "",
+                  fit_step=fit_step)
+        for i in range(n)]}
+
+
+class TestEngineMeshUnits:
+    def _job(self):
+        job = mk_tpu_elastic_job("tjob", num_slices=4, min_width=4)
+        job.spec.tf_replica_specs[0].tpu.mesh = {"pp": 2, "dp": 2,
+                                                 "fsdp": 4}
+        return job
+
+    def test_one_death_degrades_by_a_whole_dp_replica(self):
+        eng = ElasticEngine(ElasticPolicy(warmup_s=1.0))
+        a = eng.assess("default/tjob", self._job(),
+                       tpu_members(8, failed=(5,)), None, now=100.0)
+        assert a.transition is not None
+        assert a.transition.kind == KIND_DEGRADE
+        # 7 survivors would split a pipeline (3.5 slices): round to 4,
+        # never 6 (6 = 3 slices = 1.5 pipelines).
+        assert (a.transition.from_width, a.transition.to_width) == (8, 4)
+
+    def test_degrade_below_a_whole_replica_defers_to_recovery(self):
+        eng = ElasticEngine(ElasticPolicy(warmup_s=1.0))
+        job = self._job()
+        set_width(job, 4, 1)
+        a = eng.assess("default/tjob", job,
+                       tpu_members(4, gen=1, failed=(1,)), None, now=100.0)
+        assert a.transition is None  # next unit (0) is under the floor
+
+    def test_expand_counts_the_gangs_still_bound_slices(self):
+        class Inv:
+            def __init__(self, free, bound):
+                self.free, self.bound = free, bound
+
+            def free_slice_count(self, accel=""):
+                return self.free
+
+            def gang_slices(self, name):
+                assert name == "tjob-rid"
+                return [f"s{i}" for i in range(self.bound)]
+
+        eng = ElasticEngine(ElasticPolicy(warmup_s=0.0, min_degraded_s=0.0,
+                                          progress_grace_s=0.0))
+        job = self._job()
+        set_width(job, 4, 1)
+        members = tpu_members(4, gen=1, fit_step=9)
+        # Crash-degraded gang: zero free slices but all 4 still bound —
+        # re-expansion must not wait for capacity it already holds.
+        a = eng.assess("k", job, members, None, now=100.0,
+                       inventory=Inv(free=0, bound=4))
+        assert a.transition is not None and a.transition.kind == KIND_EXPAND
+        assert a.transition.to_width == 8
+        # Harvested gang: binding shrunk to 2, nothing free -> hold.
+        b = eng.assess("k2", job, members, None, now=100.0,
+                       inventory=Inv(free=0, bound=2))
+        assert b.transition is None
+
+    def test_partial_capacity_expands_by_whole_dp_replicas_only(self):
+        class Inv:
+            def free_slice_count(self, accel=""):
+                return 1  # half a pipeline replica
+
+            def gang_slices(self, name):
+                return ["s0", "s1"]
+
+        eng = ElasticEngine(ElasticPolicy(warmup_s=0.0, min_degraded_s=0.0,
+                                          progress_grace_s=0.0))
+        job = self._job()
+        set_width(job, 4, 1)
+        a = eng.assess("k", job, tpu_members(4, gen=1, fit_step=9), None,
+                       now=100.0, inventory=Inv())
+        assert a.transition is None  # 4+2=6 rounds down to 4: no expand
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: pp-granular width harvesting
+# ---------------------------------------------------------------------------
+
+class TestPpGranularHarvest:
+    def _rig(self, n_slices=4):
+        from kubeflow_controller_tpu.scheduler import (
+            GangScheduler,
+            SchedulerPolicy,
+        )
+
+        inv = TPUInventory([TPUSlice(f"s{i}", "v5e-8", num_hosts=2)
+                            for i in range(n_slices)])
+        sched = GangScheduler(inv, SchedulerPolicy())
+        evictions = []
+        sched.set_evictor(lambda keys, reason: evictions.append(
+            (sorted(keys), reason)))
+        return inv, sched, evictions
+
+    def _admit(self, sched, job, n):
+        pods = [make_pod(job, job.spec.tf_replica_specs[0], i)
+                for i in range(n)]
+        for i, p in enumerate(pods):
+            p.metadata.name = f"{job.metadata.name}-{i}"
+        [sched.offer(p) for p in pods]
+        sched.pod_started(pods[0])
+        results = [sched.offer(p) for p in pods]
+        return pods, results
+
+    def _mesh_job(self, name, num_slices, min_width, cls="low"):
+        job = mk_tpu_elastic_job(name, num_slices=num_slices,
+                                 min_width=min_width)
+        job.spec.tf_replica_specs[0].tpu.mesh = {"pp": 2, "dp": 2}
+        job.spec.priority_class_name = cls
+        return job
+
+    def test_harvest_rounds_up_to_whole_pipeline_replicas(self):
+        inv, sched, evictions = self._rig()
+        low = self._mesh_job("low", 4, min_width=4)
+        self._admit(sched, low, 8)
+        high = mk_tpu_elastic_job("high", num_slices=1, min_width=2)
+        high.spec.elastic = None
+        high.spec.priority_class_name = "high"
+        _, results = self._admit(sched, high, 2)
+        assert any(results)
+        # High needed 1 slice; the victim lost 2 (one whole pp replica),
+        # never 1 — a 3-slice binding would orphan half a pipeline.
+        assert len(sched.gang_slices("low-rid")) == 2
+        keys, reason = evictions[0]
+        assert reason.startswith("WidthHarvested")
+        assert len(keys) == 4  # 2 slices x 2 hosts
+        assert {k.rsplit("-", 1)[1] for k in keys} == {"4", "5", "6", "7"}
+
+    def test_harvest_skips_victims_that_cannot_shed_a_whole_replica(self):
+        inv, sched, evictions = self._rig()
+        # Floor 6 -> min 3 slices: surplus is 1 slice, but the pp unit
+        # is 2 — a 1-slice harvest would orphan half a pipeline, so the
+        # victim is skipped and admission falls back to WHOLE
+        # preemption.  Mid-pipeline theft never happens.
+        low = self._mesh_job("low", 4, min_width=6)
+        self._admit(sched, low, 8)
+        high = mk_tpu_elastic_job("high", num_slices=1, min_width=2)
+        high.spec.elastic = None
+        high.spec.priority_class_name = "high"
+        _, results = self._admit(sched, high, 2)
+        assert any(results)
+        assert not [r for _, r in evictions
+                    if r.startswith("WidthHarvested")]
+        keys, reason = next((k, r) for k, r in evictions
+                            if r.startswith("Preempted"))
+        assert len(keys) == 8  # the whole gang, not a partial span
+        assert sched.gang_slices("low-rid") == []
+
+    def test_placement_of_delegates_through_the_scheduler(self):
+        from kubeflow_controller_tpu.scheduler import (
+            GangScheduler,
+            SchedulerPolicy,
+        )
+
+        inv = TPUInventory(sb_slices(4))
+        sched = GangScheduler(inv, SchedulerPolicy())
+        low = self._mesh_job("pl", 4, min_width=4)
+        pods = [make_pod(low, low.spec.tf_replica_specs[0], i)
+                for i in range(8)]
+        for i, p in enumerate(pods):
+            p.metadata.name = f"pl-{i}"
+            sched.offer(p)
+        pl = sched.placement_of("pl-rid")
+        assert pl is not None
+        assert pl["domains"] == ["sb0", "sb1"]
+        assert pl["score"] == pytest.approx(2 / 3, abs=1e-3)
+        assert sched.placement_of("nope") is None
+
+
+# ---------------------------------------------------------------------------
+# vet: the mesh-env rule
+# ---------------------------------------------------------------------------
+
+class TestMeshEnvRule:
+    FIXTURES = os.path.join(REPO_ROOT, "tests", "fixtures", "vet",
+                            "workloads")
+
+    def _vet(self, name):
+        from kubeflow_controller_tpu.analysis import vet
+
+        findings = vet.run([os.path.join(self.FIXTURES, name)],
+                           root=REPO_ROOT, skip_catalogue=True)
+        return findings, {f.rule for f in findings}
+
+    def test_bad_fixture_flagged(self):
+        findings, rules = self._vet("bad_meshenv.py")
+        assert rules == {"mesh-env"}
+        assert len(findings) == 3  # spec chain + bare num_slices + slice_id
+        assert all("MEGASCALE" in f.message for f in findings)
+
+    def test_good_fixture_clean(self):
+        findings, _ = self._vet("good_meshenv.py")
+        assert findings == []
+
+    def test_rule_is_scoped_to_workloads(self):
+        # The planner legitimately reads tpu.num_slices — it is what
+        # turns spec topology into the per-generation env contract.
+        from kubeflow_controller_tpu.analysis import vet
+
+        path = os.path.join(REPO_ROOT, "kubeflow_controller_tpu",
+                            "planner", "materialize.py")
+        findings = vet.run([path], root=REPO_ROOT, skip_catalogue=True)
+        assert not [f for f in findings if f.rule == "mesh-env"]
+
+
+# ---------------------------------------------------------------------------
+# CLI: the placement surfaces
+# ---------------------------------------------------------------------------
+
+PLACEMENT = {
+    "slices": ["slice-0", "slice-1", "slice-2", "slice-3"],
+    "domains": ["sb0", "sb1"],
+    "score": 0.6667,
+    "mesh": {"dp": "dcn", "fsdp": "ici", "pp": "dcn"},
+}
+
+
+class TestCLIPlacement:
+    @pytest.fixture
+    def served(self):
+        from kubeflow_controller_tpu.cluster import Cluster
+        from kubeflow_controller_tpu.cluster.apiserver import FakeAPIServer
+
+        cluster = Cluster()
+        srv = FakeAPIServer(cluster.store)
+        url = srv.start()
+        for name, placed in (("placed", True), ("plain", False)):
+            job = TFJob(metadata=ObjectMeta(name=name, namespace="default"))
+            t = PodTemplateSpec()
+            t.spec.containers.append(Container(name="c", image="img"))
+            job.spec.tf_replica_specs = [TFReplicaSpec(
+                replicas=8, tf_replica_type=ReplicaType.TPU, template=t,
+                tpu=TPUSpec(accelerator_type="v5e-8", num_hosts=2,
+                            num_slices=4))]
+            if placed:
+                job.metadata.annotations[ANNOTATION_PLACEMENT] = (
+                    json.dumps(PLACEMENT, sort_keys=True))
+            cluster.tfjobs.create(job)
+            j = cluster.tfjobs.get("default", name)
+            j.status.phase = TFJobPhase.RUNNING
+            cluster.tfjobs.update_status(j)
+        yield url
+        srv.stop()
+
+    def row(self, out, name):
+        hdr = next(ln for ln in out.splitlines()
+                   if ln.startswith("NAMESPACE"))
+        row = next(ln for ln in out.splitlines()
+                   if ln.startswith("default") and f" {name} " in f"{ln} ")
+        return hdr, row
+
+    def test_get_appends_slices_marker_without_shifting_columns(
+            self, served, capsys):
+        from kubeflow_controller_tpu.cli.main import main
+
+        assert main(["-master", served, "get"]) == 0
+        out = capsys.readouterr().out
+        hdr, row = self.row(out, "placed")
+        # The marker rides the REPLICAS cell (the row's last, free-width
+        # column) so every fixed-width column stays put.
+        at = hdr.index("REPLICAS")
+        assert row[at:] == "TPUx8[slices=4]"
+        _, plain = self.row(out, "plain")
+        assert plain[at:] == "TPUx8"  # unplaced -> no marker
+
+    def test_describe_prints_the_placement_section(self, served, capsys):
+        from kubeflow_controller_tpu.cli.main import main
+
+        assert main(["-master", served, "describe", "placed"]) == 0
+        out = capsys.readouterr().out
+        assert ("Placement: 4 slice(s) across 2 DCN domain(s), "
+                "adjacency=0.6667") in out
+        assert "slices: slice-0, slice-1, slice-2, slice-3" in out
+        assert "domains: sb0, sb1" in out
+        assert "mesh: dp->dcn fsdp->ici pp->dcn" in out
+
+    def test_describe_without_placement_has_no_section(self, served,
+                                                       capsys):
+        from kubeflow_controller_tpu.cli.main import main
+
+        assert main(["-master", served, "describe", "plain"]) == 0
+        assert "Placement:" not in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# Workload runtime: the $KCTPU_MESH consumer
+# ---------------------------------------------------------------------------
+
+class TestRuntimeMeshEnv:
+    def test_from_env_parses_the_planner_mesh(self, monkeypatch):
+        from kubeflow_controller_tpu.workloads.runtime import JobRuntime
+
+        monkeypatch.setenv("KCTPU_MESH",
+                           '{"dp": 1, "fsdp": 4, "pp": 2}')
+        monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+        monkeypatch.setenv("MEGASCALE_SLICE_ID", "1")
+        monkeypatch.setenv("MEGASCALE_COORDINATOR_ADDRESS",
+                           "host-2.svc:8476")
+        rt = JobRuntime.from_env()
+        assert rt.mesh == {"dp": 1, "fsdp": 4, "pp": 2}
+        assert (rt.num_slices, rt.slice_id) == (2, 1)
+        assert rt.slice_coordinator == "host-2.svc:8476"
+
+    def test_garbage_mesh_env_degrades_to_empty(self, monkeypatch):
+        from kubeflow_controller_tpu.workloads.runtime import JobRuntime
+
+        monkeypatch.setenv("KCTPU_MESH", "{not json")
+        assert JobRuntime.from_env().mesh == {}
+        # A single bad axis discards the whole dict: half a mesh plan
+        # is worse than falling back to the CLI flags.
+        monkeypatch.setenv("KCTPU_MESH", '{"dp": 2, "pp": "x"}')
+        assert JobRuntime.from_env().mesh == {}
+        # Sizes clamp to >= 1.
+        monkeypatch.setenv("KCTPU_MESH", '{"dp": 0, "pp": 2}')
+        assert JobRuntime.from_env().mesh == {"dp": 1, "pp": 2}
