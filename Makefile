@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all ci test test-fast lint typecheck cov cov-local bench dryrun validate vet race-smoke check-smoke metrics-smoke scale-smoke scale10k-smoke stall-smoke widejob-smoke churn-smoke store-smoke sched-smoke ttfs-smoke chaos-smoke elastic-smoke multislice-smoke goodput-smoke ha-smoke serve-smoke gateway-smoke slo-smoke
+.PHONY: all ci test test-fast lint typecheck cov cov-local bench dryrun validate vet race-smoke check-smoke metrics-smoke scale-smoke scale10k-smoke stall-smoke widejob-smoke churn-smoke store-smoke sched-smoke ttfs-smoke chaos-smoke elastic-smoke multislice-smoke goodput-smoke tenants-smoke ha-smoke serve-smoke gateway-smoke slo-smoke
 
 all: lint vet test race-smoke check-smoke
 
@@ -15,7 +15,7 @@ all: lint vet test race-smoke check-smoke
 # included), then tier-1 under the runtime lock-order detector.  Run
 # without -j: the order is the diagnosis ladder (cheapest, most precise
 # signal first).
-ci: vet race-smoke check-smoke chaos-smoke elastic-smoke multislice-smoke goodput-smoke serve-smoke gateway-smoke ha-smoke slo-smoke scale10k-smoke
+ci: vet race-smoke check-smoke chaos-smoke elastic-smoke multislice-smoke goodput-smoke tenants-smoke serve-smoke gateway-smoke ha-smoke slo-smoke scale10k-smoke
 	KCTPU_LOCKCHECK=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m "not slow"
 
 # Fast/slow split: `test-fast` (-m "not slow") is the quick signal — 214 of
@@ -345,6 +345,33 @@ goodput-smoke:
 		print('goodput-smoke ok: scenario ratio', d['value'], \
 		      '| badput', d['details']['badput_seconds_by_bucket'], \
 		      '| overhead', d['details']['scale']['ledger_overhead_pct'], '%')"
+
+# Multi-tenant fair-share smoke (the tenancy plane's standing gate,
+# docs/PERF.md "Multi-tenant contention"): 4 tenants at weights 4:2:1:1.
+# Gates (TENANT_r01.json): (1) the two-level DRF queue converges each
+# backlogged tenant's slice share to within 10% of its weight share
+# (measured: exact); (2) an elastic borrower at 2x quota is width-
+# harvested down to its floor by an entitled claimant — zero whole-gang
+# preemptions, every slice conserved across the round trip; (3) a victim
+# tenant's paced GET+status-PUT ops keep p99 <= 1.5x the quiet baseline
+# while another tenant offers a ~10x write storm into the per-tenant
+# apiserver token buckets (victim throttled 0 times, the storm 429'd).
+# ~20 s.
+tenants-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --tenants \
+		> /tmp/kctpu_tenant_smoke.json
+	@$(PY) -c "import json; d = json.load(open('/tmp/kctpu_tenant_smoke.json')); \
+		assert {'metric', 'value', 'unit', 'details'} <= set(d), d; \
+		g = d['details']['gates']; \
+		assert all(g.values()), {k: v for k, v in g.items() if not v}; \
+		s = d['details']['storm']; \
+		print('tenants-smoke ok: max share err', d['value'], \
+		      '| shares', {t: v['measured'] for t, v in sorted(d['details']['share'].items())}, \
+		      '| reclaim', d['details']['reclaim']['harvested_slices'], 'slices in', \
+		      d['details']['reclaim']['latency_ms'], 'ms,', \
+		      d['details']['reclaim']['whole_gang_preemptions'], 'preemptions', \
+		      '| storm p99 ratio', s['p99_ratio'], 'at', \
+		      s['storm_multiple_of_victim'], 'x')"
 
 # Serving smoke (the serving plane's standing gate, docs/SERVING.md):
 # real tiny-Llama replicas over the slot-paged KV cache, three phases —

@@ -25,7 +25,10 @@ concurrency/controller invariants that actually bite this codebase
   reasons defeat the recorder's dedup keys);
 - ``phase-registry``      — beat/PodProgress phase literals come from the
   shared registry (obs/phases.py KNOWN_PHASES) so the stall detector's
-  hold list and the goodput ledger's bucket map stay exhaustive.
+  hold list and the goodput ledger's bucket map stay exhaustive;
+- ``tenant-label``        — tenancy resolves through ``api.tenant.tenant_of``
+  / ``tenant_of_pod`` only, never a raw ``labels["tenant"]`` read (every
+  consumer must agree on the label-override -> namespace-default chain).
 
 Zero third-party dependencies: stdlib ``ast`` only.  Suppress a finding
 with an inline ``# kctpu: vet-ok(<rule>)`` marker on the offending line
@@ -855,6 +858,62 @@ class PhaseRegistryRule(Rule):
                     f"entry — or use an existing phase")
 
 
+class TenantLabelRule(Rule):
+    name = "tenant-label"
+    doc = ("tenancy resolves through api.tenant.tenant_of / tenant_of_pod "
+           "only: a raw read of the 'tenant' label or tenant annotation "
+           "re-derives identity and silently skips the label-override -> "
+           "namespace-default chain, so the scheduler, apiserver throttle "
+           "and goodput rollup could each bill the same job to different "
+           "tenants")
+
+    #: The resolver itself and the admission-time validator may touch the
+    #: raw label; everything else goes through them.
+    _ALLOWED = ("api/tenant.py", "api/tfjob.py")
+
+    @staticmethod
+    def _unwrap(node: ast.AST) -> ast.AST:
+        """See through ``(x.labels or {})`` guards."""
+        if isinstance(node, ast.BoolOp) and node.values:
+            return node.values[0]
+        return node
+
+    @staticmethod
+    def _tenant_key(key: ast.AST) -> bool:
+        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+            return key.value == "tenant" or key.value.endswith("/tenant")
+        return _tail_name(key) in ("LABEL_TENANT", "ANNOTATION_TENANT")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        path = ctx.path.replace(os.sep, "/")
+        if path.endswith(self._ALLOWED):
+            return
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get" and node.args):
+                container = _tail_name(self._unwrap(node.func.value))
+                key = node.args[0]
+            elif (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Load)):
+                container = _tail_name(self._unwrap(node.value))
+                key = node.slice
+            else:
+                continue
+            if container not in ("labels", "annotations"):
+                continue
+            if not self._tenant_key(key):
+                continue
+            if ctx.suppressed(self.name, node.lineno):
+                continue
+            yield Finding(
+                ctx.path, node.lineno, node.col_offset, self.name,
+                "raw tenant label/annotation read: resolve tenancy via "
+                "api.tenant.tenant_of(job) / tenant_of_pod(pod) — the only "
+                "functions that apply the label-override -> namespace "
+                "defaulting every tenancy consumer must agree on")
+
+
 def all_rules() -> List[Rule]:
     from .lockgraph import LockGraphRule  # lazy: lockgraph imports vet
 
@@ -872,6 +931,7 @@ def all_rules() -> List[Rule]:
         MetricRules(),
         EventReasonRule(),
         PhaseRegistryRule(),
+        TenantLabelRule(),
         LockGraphRule(),
     ]
 

@@ -241,6 +241,37 @@ class Lease:
     spec: LeaseSpec = field(default_factory=LeaseSpec)
 
 
+@dataclass
+class TenantQuotaSpec:
+    """Net-new: one tenant's fair-share contract (docs/PERF.md).
+
+    ``weight`` scales the tenant's DRF dominant share (scheduler/
+    tenants.py); ``slices`` caps concurrently bound training slices and
+    ``serving_replicas`` caps concurrently admitted serving replicas —
+    together the two DRF resource axes.  0 on either axis means
+    "entitled to nothing, borrow only".  ``borrowable`` lets the tenant
+    expand into idle capacity beyond its quota; borrowed slices are the
+    first reclaimed (width-harvest, whole-gang preemption only as
+    fallback) when an under-quota tenant goes wanting."""
+
+    weight: float = 1.0
+    slices: int = 0
+    serving_replicas: int = 0
+    borrowable: bool = True
+
+
+@dataclass
+class TenantQuota:
+    """Stored/watched like Lease: namespaced under the tenant's name so
+    the typed-client and apiserver routing stay uniform; the scheduler's
+    ledger keys on ``metadata.name`` (the tenant)."""
+
+    api_version: str = "kubeflow.caicloud.io/v1alpha1"
+    kind: str = "TenantQuota"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TenantQuotaSpec = field(default_factory=TenantQuotaSpec)
+
+
 def is_pod_active(pod: Pod) -> bool:
     """active = not Succeeded, not Failed, not being deleted
     (ref: IsPodActive at vendor/.../controller_utils.go:832-840)."""

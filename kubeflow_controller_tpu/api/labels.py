@@ -86,6 +86,17 @@ ANNOTATION_TRACE_CONTEXT = f"{DOMAIN}/trace-context"
 ANNOTATION_START_MODE = f"{DOMAIN}/start-mode"
 START_MODE_WARM = "warm"
 START_MODE_COLD = "cold"
+# --- multi-tenant plane (net-new) ---
+# Tenant identity override on the TFJob: by default a job's tenant IS its
+# namespace; this plain label (validated DNS-1123 in api/tfjob.py) lets one
+# namespace host jobs billed to different tenants.  Resolution goes through
+# api/tenant.py tenant_of() ONLY — the vet rule ``tenant-label`` rejects
+# direct reads so scheduler/planner/updater can never disagree on identity.
+LABEL_TENANT = "tenant"
+# Resolved tenant, stamped on every member pod by the planner so the gang
+# scheduler and apiserver accounting read tenancy without a TFJob lookup
+# (api/tenant.py tenant_of_pod()).
+ANNOTATION_TENANT = f"{DOMAIN}/tenant"
 # --- serving front door (gateway/) ---
 # Gateway data-plane snapshot, written on the Serving TFJob by the
 # request gateway (JSON: routed qps, gateway-queued depth, shed counts

@@ -1,0 +1,48 @@
+"""Tenant identity resolution — the ONE place tenancy is derived.
+
+"Millions of users" (PAPER.md) are many tenants, not three priority
+bands.  A job's tenant is its namespace unless the ``tenant`` label
+overrides it (validated DNS-1123 in api/tfjob.py); the planner stamps the
+resolved identity onto every member pod as ``ANNOTATION_TENANT`` so the
+scheduler and apiserver accounting never need a TFJob lookup.
+
+Every consumer — scheduler, planner, updater, controller, CLI — resolves
+tenancy through :func:`tenant_of` / :func:`tenant_of_pod`.  Reading the
+label or falling back to the namespace anywhere else is a vet finding
+(``tenant-label``, docs/ANALYSIS.md): two call sites with subtly
+different fallback rules would split one tenant's usage across two
+ledgers, and DRF shares computed over a split ledger are garbage.
+"""
+
+from __future__ import annotations
+
+from .labels import ANNOTATION_TENANT, LABEL_TENANT
+
+#: Tenant charged when neither label nor namespace names one (cluster-
+#: scoped callers, bare pods in tests).
+DEFAULT_TENANT = "default"
+
+
+def tenant_of(job) -> str:
+    """The tenant a TFJob belongs to: the ``tenant`` label if present,
+    else its namespace.  Works for any object carrying ObjectMeta."""
+    meta = getattr(job, "metadata", None)
+    if meta is None:
+        return DEFAULT_TENANT
+    label = (meta.labels or {}).get(LABEL_TENANT, "")
+    if label:
+        return label
+    return meta.namespace or DEFAULT_TENANT
+
+
+def tenant_of_pod(pod) -> str:
+    """The tenant a member pod belongs to: the planner-stamped
+    ``ANNOTATION_TENANT`` if present, else the same label/namespace
+    resolution as the owning job (pods inherit the job's labels)."""
+    meta = getattr(pod, "metadata", None)
+    if meta is None:
+        return DEFAULT_TENANT
+    ann = (meta.annotations or {}).get(ANNOTATION_TENANT, "")
+    if ann:
+        return ann
+    return tenant_of(pod)

@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from .core import PodTemplateSpec, RESOURCE_TPU
+from .labels import LABEL_TENANT
 from .meta import ObjectMeta
 
 GROUP = "kubeflow.caicloud.io"
@@ -515,6 +516,18 @@ def validate_tfjob(job: TFJob) -> None:
     gn = job.metadata.generate_name
     if gn and not re.match(r"^[a-z0-9]([-a-z0-9]*)?$", gn):
         raise ValidationError(f"metadata.generateName {gn!r} is not a DNS-1123 prefix")
+    # Tenant override label (api/tenant.py resolves it; validated here so
+    # a bad identity is rejected at admission, not discovered when the
+    # scheduler ledger keys on it).  Raw label read is legitimate only
+    # here and in api/tenant.py.
+    tenant_label = (job.metadata.labels or {}).get(LABEL_TENANT, "")  # kctpu: vet-ok(tenant-label) - validation is the admission gate for the raw label
+    if tenant_label:
+        if not _DNS1123.match(tenant_label):
+            raise ValidationError(
+                f"labels.tenant {tenant_label!r} is not DNS-1123")
+        if len(tenant_label) > 63:
+            raise ValidationError(
+                "labels.tenant exceeds the 63-char DNS-1123 limit")
     if job.spec.priority_class_name not in ("", "low", "default", "high"):
         raise ValidationError(
             f"unknown priorityClassName {job.spec.priority_class_name!r} "

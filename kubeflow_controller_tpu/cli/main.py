@@ -36,6 +36,7 @@ from typing import List
 import yaml
 
 from .. import GIT_SHA, __version__
+from ..api.tenant import tenant_of
 from ..api.tfjob import TFJob, TFJobPhase, validate_tfjob, ValidationError
 from ..cluster import Cluster, FakeKubelet, PhasePolicy, TPUInventory, TPUSlice
 from ..cluster.store import APIError
@@ -338,11 +339,15 @@ def cmd_get(args) -> int:
     banner = _alert_banner(cluster)
     if banner:
         print(banner)
+    # Tenancy filter resolves through tenant_of (label override, then
+    # namespace) — the same identity the scheduler queues by.
+    if getattr(args, "tenant", ""):
+        jobs = [j for j in jobs if tenant_of(j) == args.tenant]
     if not jobs:
         print("No resources found.")
         return 0
-    print(f"{'NAMESPACE':<12} {'NAME':<32} {'PHASE':<12} {'REASON':<28} "
-          f"{'STEP':<10} {'RATE':<10} {'QPS':<8} {'TTFT':<9} "
+    print(f"{'NAMESPACE':<12} {'TENANT':<12} {'NAME':<32} {'PHASE':<12} "
+          f"{'REASON':<28} {'STEP':<10} {'RATE':<10} {'QPS':<8} {'TTFT':<9} "
           f"{'RESTARTS':<9} {'SHARD':<6} REPLICAS")
     for j in jobs:
         kinds = ",".join(
@@ -390,7 +395,8 @@ def cmd_get(args) -> int:
         # kubectl RESTARTS parity: the recovery plane's monotonic restart
         # total across every replica of the job.
         restarts = sum(rs.restarts for rs in j.status.tf_replica_statuses)
-        print(f"{j.metadata.namespace:<12} {j.metadata.name:<32} "
+        print(f"{j.metadata.namespace:<12} {tenant_of(j):<12} "
+              f"{j.metadata.name:<32} "
               f"{phase:<12} {reason:<28} {step:<10} {rate:<10} "
               f"{qps:<8} {ttft:<9} "
               f"{restarts:<9} {_shard_cell(j, lease):<6} {kinds}")
@@ -417,6 +423,7 @@ def cmd_describe(args) -> int:
     print(f"Name:      {j.metadata.name}")
     print(f"Namespace: {j.metadata.namespace}")
     print(f"RuntimeID: {j.spec.runtime_id}")
+    _describe_tenant(cluster, j)
     lease = _fetch_lease(cluster)
     if lease is not None:
         print(f"Leader:    {_leader_line(lease).removeprefix('leader: ')}")
@@ -464,6 +471,64 @@ def cmd_describe(args) -> int:
             age = _age(now - (e.last_timestamp or e.first_timestamp))
             print(f"  {age:>6}  {e.type:<8} {e.reason:<18} x{e.count}  {e.message}")
     return 0
+
+
+def _tenant_gauges(cluster) -> dict:
+    """Per-tenant scheduler gauges scraped from /metrics: tenant ->
+    {"share": float, "borrowed": int}.  Empty when the serve process
+    predates the tenancy plane or metrics are unreachable."""
+    import re
+
+    out: dict = {}
+    try:
+        text = cluster.metrics_text()
+    except (APIError, AttributeError):
+        return out
+    pat = re.compile(
+        r'^kctpu_sched_tenant_(share|borrowed_slices)'
+        r'\{tenant="([^"]+)"\}\s+([0-9.eE+-]+)')
+    for line in text.splitlines():
+        m = pat.match(line)
+        if not m:
+            continue
+        kind, tenant, val = m.group(1), m.group(2), float(m.group(3))
+        d = out.setdefault(tenant, {})
+        if kind == "share":
+            d["share"] = val
+        else:
+            d["borrowed"] = int(val)
+    return out
+
+
+def _describe_tenant(cluster, j) -> None:
+    """Quota/Share section: the job's resolved tenant, its TenantQuota
+    spec (when one exists), and the scheduler's live dominant share +
+    borrowed-slice count for that tenant (scraped from /metrics)."""
+    tenant = tenant_of(j)
+    print(f"Tenant:    {tenant}")
+    quota = None
+    try:
+        for q in cluster.tenantquotas.list(None):
+            if q.metadata.name == tenant:
+                quota = q
+                break
+    except (APIError, AttributeError):
+        pass
+    if quota is not None:
+        sp = quota.spec
+        caps = []
+        if sp.slices:
+            caps.append(f"slices={sp.slices}")
+        if sp.serving_replicas:
+            caps.append(f"serving={sp.serving_replicas}")
+        print(f"Quota:     weight={sp.weight:g}"
+              + ("".join(" " + c for c in caps))
+              + ("" if sp.borrowable else "  (non-borrowable)"))
+    g = _tenant_gauges(cluster).get(tenant)
+    if g is not None:
+        borrowed = g.get("borrowed", 0)
+        print(f"Share:     dominant={g.get('share', 0.0):.3f}"
+              + (f"  borrowed={borrowed} slice(s)" if borrowed else ""))
 
 
 def _describe_placement(j) -> None:
@@ -701,6 +766,44 @@ def _print_shard_depths(cluster, jobs, lease) -> None:
     print(f"shards: active jobs {cells}")
 
 
+def _print_tenant_rollup(cluster, jobs) -> None:
+    """One rollup line per scheduler tenant when the cluster is actually
+    multi-tenant: job count, summed training throughput, occupied-weighted
+    goodput (client-side, same weighting as the kctpu_tenant_goodput_ratio
+    gauge), and the scheduler's live dominant share / borrowed slices."""
+    agg: dict = {}
+    for j in jobs:
+        t = tenant_of(j)
+        row = agg.setdefault(t, {"jobs": 0, "rate": 0.0,
+                                 "good": 0.0, "occ": 0.0})
+        row["jobs"] += 1
+        p = j.status.progress
+        if p is not None:
+            row["rate"] += p.examples_per_sec
+        gp = j.status.goodput
+        if gp is not None:
+            row["good"] += gp.goodput_s
+            row["occ"] += gp.occupied_s
+    gauges = _tenant_gauges(cluster)
+    if len(set(agg) | set(gauges)) < 2:
+        return  # single-tenant: the per-job rows already tell the story
+    cells = []
+    for t in sorted(set(agg) | set(gauges)):
+        row = agg.get(t, {"jobs": 0, "rate": 0.0, "good": 0.0, "occ": 0.0})
+        cell = f"{t}:{row['jobs']}j"
+        if row["rate"]:
+            cell += f" {row['rate']:g}ex/s"
+        if row["occ"] > 0:
+            cell += f" good={row['good'] / row['occ']:.0%}"
+        g = gauges.get(t)
+        if g is not None:
+            cell += f" share={g.get('share', 0.0):.2f}"
+            if g.get("borrowed"):
+                cell += f" borrowed={g['borrowed']}"
+        cells.append(cell)
+    print("tenants: " + "  ".join(cells))
+
+
 def cmd_top(args) -> int:
     """kubectl-top analog for TFJobs: live training-plane progress, one
     row per job — step, throughput, straggler lag, stall state, heartbeat
@@ -719,6 +822,7 @@ def cmd_top(args) -> int:
         if lease is not None:
             print(_leader_line(lease))
             _print_shard_depths(cluster, jobs, lease)
+        _print_tenant_rollup(cluster, jobs)
         print(f"{'NAMESPACE':<12} {'NAME':<32} {'PHASE':<10} {'STEP':<10} "
               f"{'RATE':<10} {'QPS':<8} {'TTFT':<9} {'OCC':<5} "
               f"{'GWQPS':<7} {'HIT':<5} {'GOODPUT':<8} "
@@ -807,6 +911,29 @@ def cmd_goodput(args) -> int:
     if not rows:
         print("No goodput ledgers found (controller attaches status."
               "goodput once jobs have run for a few seconds).")
+        return 0
+    if args.tenant:
+        # Per-tenant rollup: same occupied-time weighting as the
+        # kctpu_tenant_goodput_ratio gauge, computed client-side off the
+        # per-job status ledgers so it works against any server.
+        agg: dict = {}
+        for j, gp in rows:
+            t = tenant_of(j)
+            row = agg.setdefault(t, {"jobs": 0, "good": 0, "occ": 0,
+                                     "wall": 0})
+            row["jobs"] += 1
+            row["good"] += gp.goodput_s
+            row["occ"] += gp.occupied_s
+            row["wall"] += gp.wall_s
+        print(f"{'TENANT':<16} {'JOBS':>5} {'GOODPUT':<8} {'GOOD_S':>8} "
+              f"{'OCC_S':>8} {'WALL_S':>8}")
+        ranked = sorted(agg.items(),
+                        key=lambda kv: (kv[1]["good"] / kv[1]["occ"]
+                                        if kv[1]["occ"] else 1.0))
+        for t, row in ranked:
+            ratio = row["good"] / row["occ"] if row["occ"] else 1.0
+            print(f"{t:<16} {row['jobs']:>5} {ratio:<8.0%} "
+                  f"{row['good']:>8} {row['occ']:>8} {row['wall']:>8}")
         return 0
     print(f"{'NAMESPACE':<12} {'NAME':<32} {'GOODPUT':<8} {'GOOD_S':>8} "
           f"{'OCC_S':>8} {'WALL_S':>8}  TOP-BADPUT")
@@ -1270,6 +1397,9 @@ def build_parser() -> argparse.ArgumentParser:
     g = sub.add_parser("get", help="list TFJobs (REST mode: pass -master)")
     g.add_argument("-n", "--namespace", default="",
                    help="namespace filter (default: all)")
+    g.add_argument("--tenant", default="", metavar="T",
+                   help="only jobs whose resolved tenant (tenant label, "
+                        "else namespace) is T")
 
     d = sub.add_parser("describe", help="describe one TFJob + its events "
                                         "(REST mode: pass -master)")
@@ -1298,6 +1428,9 @@ def build_parser() -> argparse.ArgumentParser:
     gp.add_argument("--job", default="", metavar="NAME",
                     help="per-bucket breakdown for one job instead of the "
                          "fleet table")
+    gp.add_argument("--tenant", action="store_true",
+                    help="aggregate the fleet table per tenant "
+                         "(occupied-weighted, the gauge's weighting)")
 
     de = sub.add_parser("delete", help="delete a TFJob (REST mode: pass -master)")
     de.add_argument("name")

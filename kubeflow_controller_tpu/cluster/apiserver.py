@@ -22,7 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple, Type
 from urllib.parse import parse_qs, urlparse
 
-from ..api.core import EventObject, Lease, Pod, Service
+from ..api.core import EventObject, Lease, Pod, Service, TenantQuota
 from ..api.tfjob import TFJob
 from ..obs.metrics import REGISTRY
 from ..utils import locks, serde
@@ -48,11 +48,49 @@ _KINDS: Dict[str, Tuple[Type, str, str]] = {
     # core prefix for routing simplicity — the fake API server does not
     # model API groups beyond the tfjobs CRD split.
     "leases": (Lease, "coordination.k8s.io/v1", "Lease"),
+    # Per-tenant fair-share contract (api/core.py TenantQuotaSpec); like
+    # leases, served under the core prefix for routing simplicity.
+    "tenantquotas": (TenantQuota, "kubeflow.caicloud.io/v1alpha1",
+                     "TenantQuota"),
 }
 
 #: Fencing token header (docs/HA.md): writes from a fenced REST client
 #: carry the leader generation; the store rejects stale tokens.
 FENCE_HEADER = "X-Kctpu-Fence"
+
+#: Tenant identity header on write requests: lets the apiserver bill a
+#: mutating request to the caller's tenant even when the object path's
+#: namespace is not the tenant (multi-tenant namespaces).  Absent, the
+#: route namespace is billed.
+TENANT_HEADER = "X-Kctpu-Tenant"
+
+#: HTTP methods the per-tenant write throttle gates.  Reads stay
+#: unthrottled: list/watch pressure is the informer plane's problem and
+#: already bounded by the watch cache.
+_WRITE_METHODS = frozenset({"POST", "PUT", "PATCH", "DELETE"})
+
+
+class _TokenBucket:
+    """One tenant's write budget: ``rate`` tokens/s up to ``burst``.
+    Monotonic-clock refill; take() returns 0.0 on admit, else the
+    seconds until one token is available (the Retry-After hint)."""
+
+    __slots__ = ("rate", "burst", "tokens", "last")
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = rate
+        self.burst = burst
+        self.tokens = burst
+        self.last = time.monotonic()
+
+    def take(self) -> float:
+        now = time.monotonic()
+        self.tokens = min(self.burst, self.tokens + (now - self.last) * self.rate)
+        self.last = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return 0.0
+        return (1.0 - self.tokens) / self.rate if self.rate > 0 else 1.0
 
 
 def _parse_selector(q: Dict[str, list]) -> Optional[Dict[str, str]]:
@@ -157,7 +195,8 @@ class FakeAPIServer:
 
     def __init__(self, store: Optional[ObjectStore] = None, token: str = "",
                  port: int = 0, kubelet=None, registry=None, tracer=None,
-                 latency_s: float = 0.0, bookmark_interval_s: float = 5.0):
+                 latency_s: float = 0.0, bookmark_interval_s: float = 5.0,
+                 write_qps: float = 0.0, write_burst: float = 0.0):
         self.store = store or ObjectStore()
         self.token = token
         self.port = port  # 0 = ephemeral
@@ -188,6 +227,21 @@ class FakeAPIServer:
         self._c_list_bytes = REGISTRY.counter(
             "kctpu_apiserver_list_bytes_total",
             "Response-body bytes served by collection LIST requests")
+        # Per-tenant write-path isolation: each tenant gets its own token
+        # bucket (write_qps tokens/s, write_burst deep; 0 = disabled), so
+        # a submission storm from tenant A turns into A's own 429s + Retry-
+        # After instead of queueing delay for every other tenant's writes.
+        # The tenant is the TENANT_HEADER if present, else the route
+        # namespace (the default tenant identity, api/tenant.py).
+        self.write_qps = write_qps
+        self.write_burst = write_burst if write_burst > 0 else max(
+            1.0, 2.0 * write_qps)
+        self._buckets: Dict[str, _TokenBucket] = {}
+        self._buckets_lock = locks.named_lock("apiserver.buckets")
+        self._c_throttled = REGISTRY.counter(
+            "kctpu_apiserver_throttled_total",
+            "Write requests rejected 429 by the per-tenant token bucket",
+            ("tenant",))
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         # Live watch-stream watchers, so stop() can close every stream
@@ -287,6 +341,25 @@ class FakeAPIServer:
                 if r is None:
                     self._send(*_status(404, "NotFound", f"no route {u.path}"))
                     return
+                if method in _WRITE_METHODS and outer.write_qps > 0:
+                    tenant = (self.headers.get(TENANT_HEADER)
+                              or r.namespace or "default")
+                    retry_after = outer._throttle(tenant)
+                    if retry_after > 0:
+                        code, body = _status(
+                            429, "TooManyRequests",
+                            f"tenant {tenant!r} write budget exhausted")
+                        data = json.dumps(body).encode()
+                        self.send_response(code)
+                        self.send_header("Content-Type", "application/json")
+                        # Ceil to whole seconds but keep sub-second budgets
+                        # honest: a 0 here would mean "retry immediately".
+                        self.send_header("Retry-After",
+                                         str(max(1, int(retry_after + 0.999))))
+                        self.send_header("Content-Length", str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data)
+                        return
                 try:
                     outer._handle(self, method, r)
                 except APIError as e:
@@ -345,6 +418,21 @@ class FakeAPIServer:
         """Close every active watch stream (clients must reconnect and
         re-list).  Chaos/regression hook for the watch-gap path."""
         self._watch_gen += 1
+
+    def _throttle(self, tenant: str) -> float:
+        """Charge one write to ``tenant``'s bucket: 0.0 = admitted, else
+        the Retry-After seconds.  Buckets materialize lazily per tenant
+        (every tenant gets the same qps/burst: isolation, not quota —
+        capacity policy lives in the scheduler's TenantQuota ledger)."""
+        with self._buckets_lock:
+            b = self._buckets.get(tenant)
+            if b is None:
+                b = self._buckets[tenant] = _TokenBucket(
+                    self.write_qps, self.write_burst)
+            wait = b.take()
+        if wait > 0:
+            self._c_throttled.labels(tenant).inc()
+        return wait
 
     # -- observability surface -------------------------------------------------
 

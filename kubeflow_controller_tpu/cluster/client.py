@@ -27,6 +27,7 @@ PODS = "pods"
 SERVICES = "services"
 EVENTS = "events"
 LEASES = LEASES_KIND
+TENANTQUOTAS = "tenantquotas"
 
 #: Fence provider signature: () -> Optional[int] (the lease generation).
 FenceProvider = Callable[[], Optional[int]]
@@ -127,6 +128,14 @@ class LeaseClient(_TypedClient):
         return self._store.get(self.kind, namespace, name)
 
 
+class TenantQuotaClient(_TypedClient):
+    """TenantQuota fair-share contracts (api/core.py), stored/watched
+    like leases: the scheduler's tenant ledger watches this collection
+    and re-keys its share heap on every spec change."""
+
+    kind = TENANTQUOTAS
+
+
 class Cluster:
     """One handle bundling the store and its typed clients (the analog of
     building both clientsets in cmd/controller/main.go:52-60).
@@ -145,6 +154,7 @@ class Cluster:
         self.services = ServiceClient(self.store, self._fence)
         self.events = EventClient(self.store, self._fence)
         self.leases = LeaseClient(self.store, self._fence)
+        self.tenantquotas = TenantQuotaClient(self.store, self._fence)
 
     def _fence(self) -> Optional[int]:
         fp = self._fence_provider

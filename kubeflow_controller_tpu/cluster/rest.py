@@ -45,7 +45,7 @@ from typing import Any, Callable, Dict, List, Optional, Type
 
 from ..obs.metrics import REGISTRY
 
-from ..api.core import EventObject, Lease, Pod, Service
+from ..api.core import EventObject, Lease, Pod, Service, TenantQuota
 from ..api.meta import ObjectMeta
 from ..api.tfjob import TFJob
 from ..utils import locks, serde
@@ -141,6 +141,17 @@ class Kubeconfig:
 # ---------------------------------------------------------------------------
 # Low-level HTTP
 # ---------------------------------------------------------------------------
+
+class TooManyRequests(APIError):
+    """HTTP 429 from the per-tenant write throttle (apiserver write-path
+    isolation): the transport already honored Retry-After with bounded
+    in-flight backoff before raising; ``retry_after`` is the server's
+    last hint, for callers that requeue instead of blocking."""
+
+    def __init__(self, message: str, retry_after: float = 1.0):
+        super().__init__(message)
+        self.retry_after = retry_after
+
 
 def _status_error(code: int, body: bytes) -> APIError:
     reason, message = "", ""
@@ -319,6 +330,15 @@ class RestTransport:
         # server rejects tokens below its fence floor (409 Conflict), so
         # a deposed leader's in-flight REST writes cannot land.
         self.fence_provider = None  # Optional[Callable[[], Optional[int]]]
+        # Multi-tenant write billing: when set, every mutating request
+        # carries the caller's tenant as an X-Kctpu-Tenant header so the
+        # server's per-tenant token bucket bills the right tenant even
+        # when the object's namespace is not the tenant.
+        self.tenant_provider = None  # Optional[Callable[[], Optional[str]]]
+        self._c_throttle_waits = REGISTRY.counter(
+            "kctpu_rest_throttle_waits_total",
+            "429 responses honored in-flight (slept Retry-After and "
+            "replayed the write)")
         # Whether watch streams reconnect with their last-seen RV
         # (RestWatcher resume) or gap on every drop.  False is the
         # pre-resumption baseline (bench.py --churn --no-resume).
@@ -350,6 +370,10 @@ class RestTransport:
             fence = self.fence_provider()
             if fence is not None:
                 h["X-Kctpu-Fence"] = str(fence)
+        if method not in _SAFE_METHODS and self.tenant_provider is not None:
+            tenant = self.tenant_provider()
+            if tenant:
+                h["X-Kctpu-Tenant"] = tenant
         return h
 
     def _request(self, method: str, path: str,
@@ -369,6 +393,10 @@ class RestTransport:
         # stale-keep-alive reconnect below is budgeted separately and is
         # bounded by the idle-set size (each loop turn consumes one).
         safe_retries = 1 if method in _SAFE_METHODS else 0
+        # Per-tenant write throttle (429): honor Retry-After in-flight a
+        # bounded number of times — a throttled write was NOT applied, so
+        # replaying it is always safe (unlike the connection-error case).
+        throttle_retries = 3
         while True:
             if stream:
                 # Dedicated connection: the response owns the socket for its
@@ -390,6 +418,24 @@ class RestTransport:
                     safe_retries -= 1
                     continue
                 raise APIError(f"{method} {url}: {e!r}") from None
+            if resp.status == 429:
+                err_body = resp.read()
+                raw_ra = resp.headers.get("Retry-After", "")
+                self._done(conn, resp)
+                try:
+                    retry_after = max(0.05, float(raw_ra))
+                except ValueError:
+                    retry_after = 1.0
+                if throttle_retries > 0 and not stream:
+                    throttle_retries -= 1
+                    self._c_throttle_waits.inc()
+                    # Cap each wait so a hostile/huge hint cannot wedge a
+                    # sync worker; the budget above bounds the total.
+                    time.sleep(min(retry_after, 5.0))
+                    continue
+                raise TooManyRequests(
+                    err_body[:300].decode(errors="replace")
+                    or "write budget exhausted", retry_after=retry_after)
             if resp.status >= 400:
                 err_body = resp.read()
                 self._done(conn, resp)
@@ -838,6 +884,13 @@ class RestLeaseClient(_RestTypedClient):
     kind_name = "Lease"
 
 
+class RestTenantQuotaClient(_RestTypedClient):
+    cls = TenantQuota
+    plural = "tenantquotas"
+    api_version = f"{TFJOB_GROUP}/{TFJOB_VERSION}"
+    kind_name = "TenantQuota"
+
+
 class RestCluster:
     """Drop-in for cluster.Cluster backed by HTTP — what ``-kubeconfig``
     selects in the CLI.  No ``.store``: there is no in-process substrate,
@@ -853,6 +906,13 @@ class RestCluster:
         self.services = RestServiceClient(self.transport)
         self.events = RestEventClient(self.transport)
         self.leases = RestLeaseClient(self.transport)
+        self.tenantquotas = RestTenantQuotaClient(self.transport)
+
+    def set_tenant_provider(self, tp) -> None:
+        """Stamp every write from this cluster handle with the given
+        tenant provider (() -> tenant str) so the server's per-tenant
+        write throttle bills the right principal."""
+        self.transport.tenant_provider = tp
 
     def set_fence_provider(self, fp) -> None:
         """Stamp every write from this cluster handle with the given

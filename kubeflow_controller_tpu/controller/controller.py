@@ -37,6 +37,7 @@ from typing import Dict, List, Optional
 from ..api.core import Pod, Service
 from ..api.labels import LABEL_JOB_TYPE, job_selector
 from ..api.meta import get_controller_of, key_of, split_key
+from ..api.tenant import tenant_of
 from ..api.tfjob import (
     KIND,
     JobGoodput,
@@ -255,14 +256,20 @@ class Controller:
         self._owns_recorder = recorder is None
         self.recorder = recorder or EventRecorder(
             sink=getattr(cluster, "events", None))
+        # Key -> tenant cache for the workqueue's per-tenant fresh tier,
+        # filled from watch edges (the label-aware tenant, not just the
+        # namespace).  Plain dict: single-item get/set only.
+        self._tenant_by_key: Dict[str, str] = {}
         if self.controller_shards > 1:
             from ..ha.shards import ShardedWorkQueue
 
             self.queue = ShardedWorkQueue(
                 self.controller_shards, name="tfJobs",
-                uid_fn=self._shard_uid, on_handoff=self._on_shard_handoff)
+                uid_fn=self._shard_uid, on_handoff=self._on_shard_handoff,
+                tenant_of=self._tenant_for_key)
         else:
-            self.queue = RateLimitingQueue(name="tfJobs")
+            self.queue = RateLimitingQueue(name="tfJobs",
+                                           tenant_of=self._tenant_for_key)
         self.expectations = ControllerExpectations()
         self.metrics = ReconcileMetrics()
         # Incremental rollup: memoizes compute_status per job, keyed by the
@@ -304,6 +311,21 @@ class Controller:
             on_update=lambda old, new: self._on_child_update(old, new),
             on_delete=lambda s: self._on_child_delete(s),
         )
+        # Tenant fair-share contracts: mirror TenantQuota specs into the
+        # scheduler's DRF ledger (live weight changes re-key its share
+        # heap on the next admission pass).  Wired only when the cluster
+        # exposes the collection and the inventory is scheduler-shaped —
+        # a bare TPUInventory has no ledger and needs no watch.
+        self.tenantquota_informer = None
+        tq_client = getattr(cluster, "tenantquotas", None)
+        if tq_client is not None and hasattr(inventory, "set_tenant_quota"):
+            self.tenantquota_informer = SharedInformer(
+                tq_client, resync_period_s, "tenantquotas")
+            self.tenantquota_informer.add_event_handler(
+                on_add=self._on_tenantquota_set,
+                on_update=lambda old, new: self._on_tenantquota_set(new),
+                on_delete=self._on_tenantquota_delete,
+            )
 
         self._workers: List[threading.Thread] = []
         self._stop = threading.Event()
@@ -323,9 +345,12 @@ class Controller:
         (ref: controller.go:174-198; threadiness=2 at main.go:70)."""
         logger.info("starting TFJob controller")
         self._threadiness = threadiness
-        for inf in (self.tfjob_informer, self.pod_informer, self.service_informer):
+        infs = [self.tfjob_informer, self.pod_informer, self.service_informer]
+        if self.tenantquota_informer is not None:
+            infs.append(self.tenantquota_informer)
+        for inf in infs:
             inf.start()
-        for inf in (self.tfjob_informer, self.pod_informer, self.service_informer):
+        for inf in infs:
             if not inf.wait_for_cache_sync(wait_sync_timeout):
                 raise TimeoutError(f"timed out waiting for {inf.name} cache sync")
         if self.controller_shards > 1:
@@ -419,7 +444,10 @@ class Controller:
         if self._slo_engine is not None:
             self._slo_engine.set_notifier(None)
         self.queue.shut_down()
-        for inf in (self.tfjob_informer, self.pod_informer, self.service_informer):
+        infs = [self.tfjob_informer, self.pod_informer, self.service_informer]
+        if self.tenantquota_informer is not None:
+            infs.append(self.tenantquota_informer)
+        for inf in infs:
             inf.stop()
         with self._manage_pool_lock:
             pool, self._manage_pool = self._manage_pool, None
@@ -498,8 +526,29 @@ class Controller:
 
     # --------------------------------------------------------------- events
 
+    def _tenant_for_key(self, key: str) -> str:
+        """Workqueue tenant resolver: the label-aware tenant cached off
+        the job's watch edges, else the key's namespace (the same default
+        api/tenant.tenant_of applies)."""
+        t = self._tenant_by_key.get(key)
+        if t:
+            return t
+        return key.split("/", 1)[0] if "/" in key else "default"
+
+    def _on_tenantquota_set(self, quota) -> None:
+        spec = quota.spec
+        self.inventory.set_tenant_quota(
+            quota.metadata.name, weight=spec.weight, slices=spec.slices,
+            serving_replicas=spec.serving_replicas,
+            borrowable=spec.borrowable)
+
+    def _on_tenantquota_delete(self, quota) -> None:
+        self.inventory.remove_tenant_quota(quota.metadata.name)
+
     def _enqueue(self, job: TFJob) -> None:
-        self.queue.add(key_of(job.metadata))
+        key = key_of(job.metadata)
+        self._tenant_by_key[key] = tenant_of(job)
+        self.queue.add(key)
 
     def _on_tfjob_update(self, old: TFJob, new: TFJob) -> None:
         """Enqueue on real edges; on same-RV resyncs (the level-triggered
@@ -532,6 +581,9 @@ class Controller:
         if self.inventory is not None and is_tpu_job(job):
             self.inventory.release_gang(gang_name(job))
         self.queue.add(key)  # final sync performs cleanup if needed
+        # Drop the tenant cache AFTER the final add: the queue resolves
+        # tenancy at push time, so the final sync still files correctly.
+        self._tenant_by_key.pop(key, None)
 
     def _resolve_controller_ref(self, obj) -> Optional[str]:
         """ref: resolveControllerRef at controller.go:608-624 — owner key iff
@@ -998,6 +1050,7 @@ class Controller:
                     stalled=rname in stalled,
                 ))
         self.goodput_tracker.observe(ns, name, observations, now)
+        self.goodput_tracker.set_tenant(ns, name, tenant_of(job))
         terminal = status.phase in (TFJobPhase.SUCCEEDED, TFJobPhase.FAILED)
         with self._stalled_lock:
             last = self._goodput_pub.get(key, 0.0)

@@ -14,6 +14,12 @@ pkg/controller/controller.go:132, 639):
   periodic level-triggered backstop would otherwise interleave with (and
   at scale, bury) the watch-edge work that actually advances jobs.  A
   fresh ``add`` of an item sitting in the low tier promotes it;
+- **per-tenant fairness**: the fresh tier is one FIFO *per tenant*
+  (``tenant_of(item)``; default: the key's namespace), drained
+  round-robin — one tenant churning 10k watch edges cannot bury another
+  tenant's single edge behind them, so a victim tenant's reconcile
+  latency stays flat under a noisy neighbor's storm (``bench.py
+  --tenants`` gates the p99);
 - **rate limiting**: ``add_rate_limited`` delays re-adds with per-item
   exponential backoff (base*2^failures up to a cap — the
   ItemExponentialFailureRateLimiter); ``forget`` resets the failure count
@@ -31,7 +37,15 @@ import collections
 import heapq
 import threading
 import time
-from typing import Deque, Dict, List, Optional, Set
+from typing import Callable, Deque, Dict, List, Optional, Set
+
+
+def _namespace_tenant(item: str) -> str:
+    """Default tenant resolver for "namespace/name" keys.  The controller
+    overrides this with a label-aware resolver (api/tenant.tenant_of on
+    the watched job); the namespace is the same default that resolver
+    falls back to."""
+    return item.split("/", 1)[0] if "/" in item else "default"
 
 from ..obs import metrics as obs_metrics
 from ..utils import locks
@@ -93,10 +107,12 @@ class ItemExponentialFailureRateLimiter:
 class RateLimitingQueue:
     def __init__(self, rate_limiter: Optional[ItemExponentialFailureRateLimiter] = None,
                  name: str = "tfJobs",
-                 registry: Optional[obs_metrics.Registry] = None):
+                 registry: Optional[obs_metrics.Registry] = None,
+                 tenant_of: Optional[Callable[[str], str]] = None):
         self.name = name
         self._limiter = rate_limiter or ItemExponentialFailureRateLimiter()
         self._metrics = _QueueMetrics(name, registry)
+        self._tenant_of = tenant_of or _namespace_tenant
         # One lock, two wait-sets: workers blocked in get() wait on _cond;
         # the delay thread waits on _delay_cond until the earliest deadline
         # or an add_after() notify.  Separate conditions so a notify can
@@ -107,9 +123,15 @@ class RateLimitingQueue:
         self._cond = locks.named_condition(f"workqueue:{name}", self._lock)
         self._delay_cond = locks.named_condition(f"workqueue:{name}",
                                                  self._lock)
-        # FIFO of ready items: deque, so the get() hot path is O(1)
-        # popleft instead of list.pop(0)'s O(depth) shift per item.
-        self._queue: Deque[str] = collections.deque()
+        # Fresh tier: one FIFO deque PER TENANT plus a round-robin ring of
+        # tenant names, so the pop hot path stays O(1) (deque popleft +
+        # ring rotate) while no tenant's storm can sit in front of another
+        # tenant's single item.  A tenant appears in the ring at most once
+        # (_rr_set guards); emptied tenants drop out of the ring lazily.
+        self._fresh: Dict[str, Deque[str]] = {}
+        self._rr: Deque[str] = collections.deque()
+        self._rr_set: Set[str] = set()
+        self._fresh_n = 0
         # LOW tier (resyncs / stall-timer backstops).  Items present here
         # are tracked in _low; promotion leaves a stale deque entry behind
         # that get() skips (lazy deletion — O(1) promote, no deque scan).
@@ -145,7 +167,7 @@ class RateLimitingQueue:
                     self._low.discard(item)
                     self._low_pending.discard(item)
                     if item not in self._processing:
-                        self._queue.append(item)
+                        self._push_fresh_locked(item)
                         self._cond.notify()
                 return
             self._dirty.add(item)
@@ -158,30 +180,65 @@ class RateLimitingQueue:
                 self._low.add(item)
                 self._queue_low.append(item)
             else:
-                self._queue.append(item)
+                self._push_fresh_locked(item)
             self._enqueued_at.setdefault(item, time.time())
             self._metrics.depth.set(self._depth_locked())
             self._cond.notify()
 
     def _depth_locked(self) -> int:
-        return len(self._queue) + len(self._low)
+        return self._fresh_n + len(self._low)
+
+    def _push_fresh_locked(self, item: str) -> None:
+        tenant = self._tenant_of(item)
+        self._fresh.setdefault(tenant, collections.deque()).append(item)
+        self._fresh_n += 1
+        if tenant not in self._rr_set:
+            self._rr_set.add(tenant)
+            self._rr.append(tenant)
+
+    def _pop_fresh_locked(self) -> Optional[str]:
+        """Round-robin across tenant FIFOs: pop the front tenant's oldest
+        item, rotate the tenant to the back if it still has work, drop it
+        from the ring if not."""
+        while self._rr:
+            tenant = self._rr.popleft()
+            dq = self._fresh.get(tenant)
+            if not dq:
+                self._rr_set.discard(tenant)
+                continue
+            item = dq.popleft()
+            self._fresh_n -= 1
+            if dq:
+                self._rr.append(tenant)
+            else:
+                self._rr_set.discard(tenant)
+            return item
+        return None
+
+    def _pop_low_locked(self) -> Optional[str]:
+        dq = self._queue_low
+        while dq:
+            item = dq.popleft()
+            if item not in self._low:
+                continue  # promoted or claimed: stale entry
+            self._low.discard(item)
+            return item
+        return None
 
     def _pop_locked(self) -> Optional[str]:
         """Next ready item across tiers: fresh first, low when fresh is
         empty — except every 8th pop prefers low, so a sustained storm of
         fresh edges cannot starve the level-triggered backstop forever."""
         self._gets += 1
-        order = ((self._queue_low, self._queue)
-                 if (self._gets & 7) == 0 else (self._queue, self._queue_low))
-        for dq in order:
-            while dq:
-                item = dq.popleft()
-                if dq is self._queue_low:
-                    if item not in self._low:
-                        continue  # promoted or claimed: stale entry
-                    self._low.discard(item)
-                return item
-        return None
+        if (self._gets & 7) == 0:
+            item = self._pop_low_locked()
+            if item is None:
+                item = self._pop_fresh_locked()
+        else:
+            item = self._pop_fresh_locked()
+            if item is None:
+                item = self._pop_low_locked()
+        return item
 
     def get(self, timeout: Optional[float] = None) -> Optional[str]:
         """Blocks for the next item; None on timeout; raises ShutDown when
@@ -215,7 +272,7 @@ class RateLimitingQueue:
                     self._low.add(item)
                     self._queue_low.append(item)
                 else:
-                    self._queue.append(item)
+                    self._push_fresh_locked(item)
                 self._enqueued_at.setdefault(item, time.time())
                 self._metrics.depth.set(self._depth_locked())
                 self._metrics.requeues.inc()
@@ -262,9 +319,9 @@ class RateLimitingQueue:
                     self._dirty.add(item)
                     self._metrics.adds.inc()
                     if item not in self._processing:
-                        self._queue.append(item)
+                        self._push_fresh_locked(item)
                         self._enqueued_at.setdefault(item, time.time())
-                        self._metrics.depth.set(len(self._queue))
+                        self._metrics.depth.set(self._depth_locked())
                         self._cond.notify()
                 timeout = None
                 if self._waiting:
@@ -285,10 +342,29 @@ class RateLimitingQueue:
         owner re-adds the claimed keys and per-key ordering is preserved
         by waiting out the in-flight syncs before the re-add."""
         with self._cond:
-            out = [(item, 0.0) for item in self._queue]
+            out = []
+            # Fresh items in the same tenant-interleaved order a worker
+            # would have drained them (ring order, one per tenant per
+            # round) so the new owner preserves inter-tenant fairness.
+            fresh = {t: collections.deque(dq)
+                     for t, dq in self._fresh.items() if dq}
+            ring = collections.deque(t for t in self._rr if t in fresh)
+            seen = set(ring)
+            ring.extend(t for t in fresh if t not in seen)
+            while ring:
+                t = ring.popleft()
+                dq = fresh[t]
+                if not dq:
+                    continue
+                out.append((dq.popleft(), 0.0))
+                if dq:
+                    ring.append(t)
             out.extend((item, 0.0) for item in self._queue_low
                        if item in self._low)
-            self._queue.clear()
+            self._fresh.clear()
+            self._rr.clear()
+            self._rr_set.clear()
+            self._fresh_n = 0
             self._queue_low.clear()
             self._low.clear()
             self._low_pending.clear()

@@ -64,12 +64,16 @@ class ShardedWorkQueue:
 
     def __init__(self, shards: int, name: str = "tfJobs",
                  uid_fn: Optional[Callable[[str], Optional[str]]] = None,
-                 on_handoff: Optional[Callable[[str], None]] = None):
+                 on_handoff: Optional[Callable[[str], None]] = None,
+                 tenant_of: Optional[Callable[[str], str]] = None):
         if shards < 1:
             raise ValueError("shards must be >= 1")
         self.name = name
         self._uid_fn = uid_fn
         self._on_handoff = on_handoff
+        # Per-tenant fresh-tier resolver, handed to every shard queue so
+        # tenant round-robin fairness holds within each shard too.
+        self._tenant_of = tenant_of
         self._limiter = ItemExponentialFailureRateLimiter()
         # Router lock: membership + routing + intake.  Never held while
         # calling back into the controller or waiting on a sync; the
@@ -199,7 +203,8 @@ class ShardedWorkQueue:
         while len(self._queues) < n:
             i = len(self._queues)
             q = RateLimitingQueue(rate_limiter=self._limiter,
-                                  name=f"{self.name}-shard-{i}")
+                                  name=f"{self.name}-shard-{i}",
+                                  tenant_of=self._tenant_of)
             self._queues.append(q)
             self._ring.add(str(i))
             self._g_depth.labels(str(i)).set_function(lambda q=q: len(q))

@@ -309,7 +309,11 @@ class GoodputTracker:
       cumulative non-goodput occupied seconds per bucket (monotonic:
       published as increments over the last published value);
     - ``kctpu_cluster_goodput_ratio`` gauge — scrape-time callback over
-      every live ledger (``Gauge.set_function``), no per-job fan-out.
+      every live ledger (``Gauge.set_function``), no per-job fan-out;
+    - ``kctpu_tenant_goodput_ratio{tenant}`` gauge — same scrape-time
+      aggregation restricted to one tenant's jobs (one series per live
+      tenant, registered on first attribution, removed with the
+      tenant's last ledger).
     """
 
     def __init__(self, registry: Optional[metrics_mod.Registry] = None
@@ -317,6 +321,10 @@ class GoodputTracker:
         reg = registry if registry is not None else metrics_mod.REGISTRY
         self._lock = locks.named_lock("obs.goodput")
         self._jobs: Dict[str, JobLedger] = {}
+        # Job key -> tenant, attributed by the controller sync loop (the
+        # label-aware tenant; namespace fallback when never attributed).
+        self._tenant_by_key: Dict[str, str] = {}
+        self._tenants_registered: set = set()
         # Last cumulative badput published per (key, bucket): the delta
         # source for the monotonic counter.
         self._published: Dict[Tuple[str, str], float] = {}
@@ -332,6 +340,10 @@ class GoodputTracker:
             "kctpu_cluster_goodput_ratio",
             "Cluster-wide goodput ratio over all live job ledgers")
         self._g_cluster.set_function(self.cluster_ratio)
+        self._g_tenant = reg.gauge(
+            "kctpu_tenant_goodput_ratio",
+            "Per-tenant goodput ratio over the tenant's live job ledgers",
+            ("tenant",))
 
     # -- observation ------------------------------------------------------
 
@@ -371,6 +383,67 @@ class GoodputTracker:
     def has_job(self, namespace: str, name: str) -> bool:
         with self._lock:
             return f"{namespace}/{name}" in self._jobs
+
+    # -- tenancy -----------------------------------------------------------
+
+    def set_tenant(self, namespace: str, name: str, tenant: str) -> None:
+        """Attribute a job's ledger to a tenant (controller sync loop,
+        api/tenant.tenant_of).  First attribution of a new tenant
+        registers its scrape-time gauge series."""
+        key = f"{namespace}/{name}"
+        register = False
+        with self._lock:
+            self._tenant_by_key[key] = tenant
+            if tenant not in self._tenants_registered:
+                self._tenants_registered.add(tenant)
+                register = True
+        if register:
+            # Instrument call outside our lock (never nest under it).
+            self._g_tenant.labels(tenant).set_function(
+                lambda t=tenant: self.tenant_ratio(t))
+
+    def _tenant_of_key(self, key: str) -> str:
+        t = self._tenant_by_key.get(key)
+        if t:
+            return t
+        return key.split("/", 1)[0] if "/" in key else "default"
+
+    def tenant_ratio(self, tenant: str) -> float:
+        """Occupied-time-weighted goodput over one tenant's live ledgers
+        (the ``kctpu_tenant_goodput_ratio`` scrape callback); 1.0 under
+        warmup, same convention as the cluster rollup."""
+        import time as _t
+        now = _t.time()
+        good = occupied = 0.0
+        with self._lock:
+            for key, job in self._jobs.items():
+                if self._tenant_of_key(key) != tenant:
+                    continue
+                s = job.summary(now)
+                good += s.goodput_s
+                occupied += s.occupied_s
+        if occupied < RATIO_WARMUP_S:
+            return 1.0
+        return min(1.0, max(0.0, good / occupied))
+
+    def tenant_rollup(self, now: float) -> Dict[str, Dict[str, float]]:
+        """Per-tenant aggregation for ``kctpu goodput --tenant``: jobs,
+        goodput/occupied seconds, occupied-weighted ratio."""
+        agg: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            for key, job in self._jobs.items():
+                t = self._tenant_of_key(key)
+                s = job.summary(now)
+                row = agg.setdefault(
+                    t, {"jobs": 0.0, "goodput_s": 0.0, "occupied_s": 0.0})
+                row["jobs"] += 1
+                row["goodput_s"] += s.goodput_s
+                row["occupied_s"] += s.occupied_s
+        for row in agg.values():
+            o = row["occupied_s"]
+            row["ratio"] = (1.0 if o < RATIO_WARMUP_S
+                            else min(1.0, max(0.0, row["goodput_s"] / o)))
+        return agg
 
     # -- rollups ----------------------------------------------------------
 
@@ -462,11 +535,20 @@ class GoodputTracker:
     def drop(self, namespace: str, name: str) -> None:
         """Series + state die with the job (delete handler/finalizer)."""
         key = f"{namespace}/{name}"
+        dead_tenant = None
         with self._lock:
             self._jobs.pop(key, None)
+            tenant = self._tenant_by_key.pop(key, None)
+            if (tenant is not None
+                    and not any(self._tenant_by_key.get(k) == tenant
+                                for k in self._jobs)):
+                self._tenants_registered.discard(tenant)
+                dead_tenant = tenant
             stale = [k for k in self._published if k[0] == key]
             for k in stale:
                 del self._published[k]
         self._g_ratio.remove(namespace, name)
+        if dead_tenant is not None:
+            self._g_tenant.remove(dead_tenant)
         for b in ALL_BUCKETS:
             self._c_badput.remove(namespace, name, b)

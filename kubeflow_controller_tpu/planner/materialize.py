@@ -35,10 +35,12 @@ from ..api.labels import (
     ANNOTATION_NUM_SLICES,
     ANNOTATION_PRIORITY_CLASS,
     ANNOTATION_SLICE_INDEX,
+    ANNOTATION_TENANT,
     LABEL_INDEX,
     selector_for,
 )
 from ..api.core import RESOURCE_TPU
+from ..api.tenant import tenant_of
 from ..api.tfjob import (
     ReplicaType,
     TFJob,
@@ -284,6 +286,13 @@ def make_pod(job: TFJob, spec: TFReplicaSpec, index: int) -> Pod:
     pod.metadata.generate_name = f"{job.metadata.name}-{typ.value.lower()}-{index}-"
     pod.metadata.labels = {**pod.metadata.labels, **labels_for(job, typ),
                            LABEL_INDEX: str(index)}
+    # Resolved tenant identity rides every member pod so the scheduler's
+    # DRF ledger and the apiserver's write accounting never need a TFJob
+    # lookup (api/tenant.py is the only resolver).
+    pod.metadata.annotations = {
+        **pod.metadata.annotations,
+        ANNOTATION_TENANT: tenant_of(job),
+    }
     c = pod.spec.containers[0]
     for name, value in _dir_env(job).items():
         c.set_env(name, value)

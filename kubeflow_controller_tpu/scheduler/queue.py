@@ -40,6 +40,19 @@ class GangEntry:
     num_slices: int = 1
     priority_class: str = DEFAULT_CLASS
     priority: int = PRIORITY_CLASSES[DEFAULT_CLASS]
+    # Tenant the gang bills to (api/tenant.tenant_of): the upper level
+    # of the two-level queue picks tenants by DRF share before this
+    # entry's (priority, fairness) order is consulted at all.
+    tenant: str = "default"
+    # True for serving replica gangs: they charge the ledger's
+    # serving-replica axis instead of the training-slice axis.
+    serving: bool = False
+    # What this gang has actually charged to the tenant ledger — kept on
+    # the entry so every release path credits exactly what was charged,
+    # even after harvests shrink the binding (conservation invariant,
+    # tests/test_tenancy.py).
+    charged_slices: int = 0
+    charged_serving: int = 0
     # First-ever enqueue (the FIFO fairness clock; survives preemption).
     fairness_at: float = field(default_factory=time.time)
     # This round's enqueue (what the queue-wait histogram measures).

@@ -53,11 +53,14 @@ from ..api.labels import (
     ANNOTATION_PRIORITY_CLASS,
     ANNOTATION_SLICE_INDEX,
     ANNOTATION_TRACE_CONTEXT,
+    LABEL_JOB_TYPE,
 )
+from ..api.tenant import tenant_of_pod
 from ..obs import trace
 from ..obs.metrics import REGISTRY
 from ..planner.materialize import pod_index
 from .queue import GangEntry, PRIORITY_CLASSES, normalize_class, priority_for, sorted_waiting
+from .tenants import TenantLedger
 
 # Pod failure-reason prefixes the updater/controller key off (the pod status
 # is the channel that carries queue state to a controller in another
@@ -98,19 +101,35 @@ class GangScheduler:
         self.policy = policy or SchedulerPolicy()
         self._lock = locks.named_lock("scheduler.gang-queue")
         self._gangs: Dict[str, GangEntry] = {}
-        # gang name -> first-ever enqueue time; survives entry deletion so
-        # a preempted-then-replaced gang keeps its queue position.
-        self._fairness: Dict[str, float] = {}
+        # (tenant, gang name) -> first-ever enqueue time; survives entry
+        # deletion so a preempted-then-replaced gang keeps its queue
+        # position.  Keyed by tenant TOO: two tenants may legitimately
+        # collide on gang name (spec.runtime_id is user-settable), and a
+        # name-only clock would hand one tenant's queue seniority to the
+        # other's same-named gang.
+        self._fairness: Dict[Tuple[str, str], float] = {}
+        # gang name -> tenant, so release paths that run after the entry
+        # is gone (preempted-then-completed gangs) can still find and
+        # drop the fairness clock above.
+        self._tenant_of_gang: Dict[str, str] = {}
         self._idle_candidates: set = set()
         self._dirty = True
         self._seen_version = -1
-        # Queue-head index: per accelerator type, a min-heap of
-        # (-priority, fairness_at, name) over the waiting gangs — finding
-        # (and re-finding, pass after pass) the admission head is O(log n)
-        # instead of sorting the whole queue.  Entries are invalidated
-        # lazily: admission/removal leaves the tuple behind and the peek
-        # loop discards tuples whose gang is gone, admitted, or re-keyed.
-        self._heaps: Dict[str, List[Tuple[int, float, str]]] = {}
+        # Per-tenant DRF ledger — the upper level of the two-level queue.
+        # Normalized by total cluster slices; lives entirely under the
+        # scheduler lock (no lock of its own, like the inventory calls).
+        self._ledger = TenantLedger(
+            lambda: len(getattr(inventory, "slices", ()) or ()))
+        # Queue-head index: per accelerator type, per TENANT, a min-heap
+        # of (-priority, fairness_at, name) over the waiting gangs —
+        # finding (and re-finding, pass after pass) the admission head is
+        # O(log n) instead of sorting the whole queue, and the tenant
+        # split is what makes the DRF pick O(log tenants): the ledger
+        # orders tenants, each tenant's heap orders its gangs.  Entries
+        # are invalidated lazily: admission/removal leaves the tuple
+        # behind and the peek loop discards tuples whose gang is gone,
+        # admitted, or re-keyed.
+        self._heaps: Dict[str, Dict[str, List[Tuple[int, float, str]]]] = {}
         # Waiting-gang count per priority class, maintained incrementally
         # (the depth gauge used to rescan every gang per pass).
         self._depth: Dict[str, int] = dict.fromkeys(PRIORITY_CLASSES, 0)
@@ -157,9 +176,64 @@ class GangScheduler:
             "kctpu_slice_utilization",
             "Bound fraction of healthy TPU slices (scrape-time)")
         g_util.set_function(inventory.utilization_now)
+        g_borrowed = REGISTRY.gauge(
+            "kctpu_sched_borrowed_slices",
+            "Slices tenants hold beyond their declared TenantQuota "
+            "(scrape-time; 0 while no quota exists)")
+        g_borrowed.set_function(self._ledger.total_borrowed)
+        # Per-tenant scrape-time series, registered as tenants appear —
+        # how the CLI's describe Quota/Share section reads the ledger
+        # from another process (via GET /metrics).
+        self._g_tshare = REGISTRY.gauge(
+            "kctpu_sched_tenant_share",
+            "Dominant-resource share per tenant "
+            "(max(slices,serving)/capacity/weight, scrape-time)",
+            ("tenant",))
+        self._g_tborrowed = REGISTRY.gauge(
+            "kctpu_sched_tenant_borrowed_slices",
+            "Slices one tenant holds beyond its declared quota "
+            "(scrape-time)", ("tenant",))
+        self._tenant_series: set = set()
 
     def set_evictor(self, fn: Callable[[List[str], str], None]) -> None:
         self._evictor = fn
+
+    # -------------------------------------------------------------- tenancy
+
+    def set_tenant_quota(self, tenant: str, weight: float = 1.0,
+                         slices: int = 0, serving_replicas: int = 0,
+                         borrowable: bool = True) -> None:
+        """Apply a TenantQuota spec (controller informer callback).  Live
+        weight changes re-key the share heap immediately — the very next
+        admission pass sees the new order."""
+        with self._lock:
+            self._ledger.set_quota(tenant, weight=weight, slices=slices,
+                                   serving_replicas=serving_replicas,
+                                   borrowable=borrowable)
+            self._register_tenant_locked(tenant)
+            self._dirty = True
+
+    def remove_tenant_quota(self, tenant: str) -> None:
+        with self._lock:
+            self._ledger.remove_quota(tenant)
+            self._dirty = True
+
+    def tenant_shares(self) -> Dict[str, Dict[str, object]]:
+        """Per-tenant usage/quota/share snapshot for the CLI and bench."""
+        with self._lock:
+            return self._ledger.snapshot()
+
+    def _register_tenant_locked(self, tenant: str) -> None:
+        """First sighting of a tenant: bind its scrape-time gauge series.
+        The callbacks read plain ledger fields without the scheduler lock
+        (scrape holds only the instrument lock — no inversion)."""
+        if tenant in self._tenant_series:
+            return
+        self._tenant_series.add(tenant)
+        self._g_tshare.labels(tenant).set_function(
+            lambda t=tenant: self._ledger.share_of(t))
+        self._g_tborrowed.labels(tenant).set_function(
+            lambda t=tenant: self._ledger.borrowed(t))
 
     # ------------------------------------------------------------- admission
 
@@ -179,6 +253,7 @@ class GangScheduler:
         key = f"{pod.metadata.namespace}/{pod.metadata.name}"
         now = time.time()
         evictions: List[Tuple[List[str], str]] = []
+        tenant = tenant_of_pod(pod)
         with self._lock:
             e = self._gangs.get(gang_name)
             if e is None:
@@ -190,9 +265,16 @@ class GangScheduler:
                     num_slices=int(ann.get(ANNOTATION_NUM_SLICES, "1") or "1"),
                     priority_class=cls,
                     priority=priority_for(cls),
-                    fairness_at=self._fairness.setdefault(gang_name, now),
+                    fairness_at=self._fairness.setdefault(
+                        (tenant, gang_name), now),
+                    tenant=tenant,
+                    serving=(pod.metadata.labels or {}).get(
+                        LABEL_JOB_TYPE, "") == "Serving",
                 )
                 self._gangs[gang_name] = e
+                self._tenant_of_gang[gang_name] = tenant
+                self._ledger.touch(tenant)
+                self._register_tenant_locked(tenant)
             e.pods[key] = pod
             # Elastic floor rides the pods (refreshed every offer: a new
             # generation may carry a new width/floor).  The pipeline span
@@ -217,6 +299,8 @@ class GangScheduler:
                         return False  # contention not cleared yet: hold
                     e.slice_names = e.slice_names + extra
                     e.num_slices = len(e.slice_names)
+                    self._ledger.charge(e.tenant, slices=len(extra))
+                    e.charged_slices += len(extra)
                     self._dirty = True
             if not e.admitted:
                 if len(e.pods) < e.size:
@@ -261,8 +345,10 @@ class GangScheduler:
     def _enter_queue_locked(self, e: GangEntry) -> None:
         """Index a gang that became waiting (first enqueue, or un-admitted
         by a mid-admission failure / unstarted preemption)."""
-        heapq.heappush(self._heaps.setdefault(e.accelerator_type, []),
-                       (-e.priority, e.fairness_at, e.name))
+        heapq.heappush(
+            self._heaps.setdefault(e.accelerator_type, {})
+                .setdefault(e.tenant, []),
+            (-e.priority, e.fairness_at, e.name))
         self._depth[e.priority_class] = self._depth.get(e.priority_class, 0) + 1
         self._pos_dirty = True
 
@@ -279,12 +365,12 @@ class GangScheduler:
         if e.queued and not e.admitted:
             self._leave_queue_locked(e)
 
-    def _valid_waiting(self, accel: str, key: Tuple[int, float, str]
-                       ) -> Optional[GangEntry]:
+    def _valid_waiting(self, accel: str, tenant: str,
+                       key: Tuple[int, float, str]) -> Optional[GangEntry]:
         negp, fairness_at, name = key
         e = self._gangs.get(name)
         if (e is None or not e.queued or e.admitted
-                or e.accelerator_type != accel
+                or e.accelerator_type != accel or e.tenant != tenant
                 or e.priority != -negp or e.fairness_at != fairness_at):
             return None  # stale tuple: gang gone, admitted, or re-keyed
         return e
@@ -294,42 +380,102 @@ class GangScheduler:
         if not self._dirty and self.inventory.version == self._seen_version:
             return
         self._dirty = False
-        # Per accelerator type: admit from the heap head until it blocks
-        # (types are independent — they draw on disjoint slice sets, and a
-        # typeless "" gang draws through its own "" bucket exactly as the
-        # full-sort pass ordered it).  Gangs behind a blocked-but-not-yet-
-        # starving head may backfill, scanned in queue order up to
-        # BACKFILL_SCAN candidates.
-        for accel, heap in self._heaps.items():
-            while heap:
-                e = self._valid_waiting(accel, heap[0])
+        # Per accelerator type (types are independent — they draw on
+        # disjoint slice sets, and a typeless "" gang draws through its
+        # own "" bucket): a two-level admission pass, tenants by DRF
+        # share then gangs by (priority, fairness) within the tenant.
+        for accel, tenant_heaps in self._heaps.items():
+            self._schedule_accel_locked(accel, tenant_heaps, now, evictions)
+        self._seen_version = self.inventory.version
+        self._update_depth_locked()
+
+    def _head_locked(self, accel: str, tenant: str,
+                     heap: List[Tuple[int, float, str]]
+                     ) -> Optional[GangEntry]:
+        """Valid admission head of one tenant's heap (lazy-discard)."""
+        while heap:
+            e = self._valid_waiting(accel, tenant, heap[0])
+            if e is not None:
+                return e
+            heapq.heappop(heap)
+        return None
+
+    def _schedule_accel_locked(self, accel: str,
+                               tenant_heaps: Dict[str, List],
+                               now: float,
+                               evictions: List[Tuple[List[str], str]]
+                               ) -> None:
+        """Two-level admission for one accelerator type.
+
+        Upper level: tenants in ascending dominant-share order (the
+        ledger heap — O(log tenants) per pick, never a rescan).  Lower
+        level: the tenant's own (priority class, fairness FIFO) heap,
+        exactly the pre-tenancy order.  Every admission changes the
+        shares, so the tenant order is re-derived after each one; a
+        tenant whose head cannot fit is skipped and the next-share
+        tenant gets its turn (work conservation — idle capacity is never
+        held for a tenant that cannot use it), until a head has starved
+        long enough that the queue must drain for it."""
+        while True:
+            admitted_one = False
+            blocked: List[Tuple[GangEntry, List]] = []
+            for tenant in self._ledger.ordered():
+                heap = tenant_heaps.get(tenant)
+                if not heap:
+                    continue
+                e = self._head_locked(accel, tenant, heap)
                 if e is None:
-                    heapq.heappop(heap)
+                    continue
+                if not self._ledger.may_take(
+                        e.tenant,
+                        slices=0 if e.serving else e.num_slices,
+                        serving=1 if e.serving else 0):
+                    # Quota-pinned (borrowable=False tenant at its cap):
+                    # not contention — no preemption, no starvation
+                    # drain; a smaller gang of the same tenant may still
+                    # fit under the cap via the backfill scan below.
+                    blocked.append((e, heap))
                     continue
                 if self._try_admit_locked(e, now):
                     heapq.heappop(heap)
-                    continue
+                    admitted_one = True
+                    break  # shares moved: re-derive the tenant order
                 if self.policy.preemption and self._preempt_for_locked(
                         e, now, evictions):
                     if self._try_admit_locked(e, now):
                         heapq.heappop(heap)
-                        continue
-                # Blocked head: backfill behind it unless it is starving.
-                if (self.policy.backfill
-                        and now - e.enqueued_at < self.policy.starvation_s):
+                        admitted_one = True
+                        break
+                if now - e.enqueued_at >= self.policy.starvation_s:
+                    # Starving head: stop the pass cold — no backfill
+                    # past it, no lower-share tenant admissions; the
+                    # queue drains until this gang fits (the
+                    # no-starvation guarantee, now tenant-wide).
+                    return
+                blocked.append((e, heap))
+            if admitted_one:
+                continue
+            # Every tenant head is blocked (none starving): bounded
+            # intra-tenant backfill behind each head, tenants still in
+            # share order (``blocked`` preserves it).
+            if self.policy.backfill:
+                for e, heap in blocked:
                     seen = {e.name}
                     for key in heapq.nsmallest(self.BACKFILL_SCAN, heap):
-                        cand = self._valid_waiting(accel, key)
+                        cand = self._valid_waiting(accel, e.tenant, key)
                         if cand is None or cand.name in seen:
                             continue
                         seen.add(cand.name)
                         self._try_admit_locked(cand, now, backfill=True)
-                break
-        self._seen_version = self.inventory.version
-        self._update_depth_locked()
+            return
 
     def _try_admit_locked(self, e: GangEntry, now: float,
                           backfill: bool = False) -> bool:
+        if not self._ledger.may_take(
+                e.tenant,
+                slices=0 if e.serving else e.num_slices,
+                serving=1 if e.serving else 0):
+            return False  # borrowable=False tenant at its declared cap
         slices = self.inventory.bind_gang(
             e.name, e.accelerator_type, e.num_slices, size=e.size, pods=e.pods)
         if slices is None:
@@ -338,6 +484,17 @@ class GangScheduler:
         e.admitted_at = now
         e.slice_names = slices
         e.coordinator_started = False
+        # Bill the tenant at bind time: serving replica gangs charge the
+        # serving axis, training gangs the slice axis.  The charge is
+        # remembered on the entry so every release path credits exactly
+        # what was charged (conservation), whatever later harvests do to
+        # slice_names.
+        if e.serving:
+            self._ledger.charge(e.tenant, serving=len(slices))
+            e.charged_serving = len(slices)
+        else:
+            self._ledger.charge(e.tenant, slices=len(slices))
+            e.charged_slices = len(slices)
         self._leave_queue_locked(e)
         self._h_wait.labels(e.priority_class).observe(
             max(0.0, now - e.enqueued_at))
@@ -382,21 +539,52 @@ class GangScheduler:
         accounting), and the controller's width engine re-shards each
         victim down — it keeps training.  Victim order matches
         preemption (lowest class, youngest first); returns slices
-        gained."""
+        gained.
+
+        Tenancy extends WHO is harvestable: when the claimant is
+        entitled (inside its declared TenantQuota), gangs of OTHER
+        tenants running on borrowed capacity become victims even at
+        equal or higher priority — borrowed capacity is reclaimed at
+        pp_span granularity, capped at what the victim tenant actually
+        borrowed, so the lender gets its quota back without anyone being
+        shot whole.  With no quotas declared the predicate never fires
+        and this is exactly the pre-tenancy harvest."""
         free = self.inventory.free_slice_count(e.accelerator_type)
         need = e.num_slices
         gained = 0
+        reclaim = self._ledger.entitled(
+            e.tenant,
+            slices=0 if e.serving else e.num_slices,
+            serving=1 if e.serving else 0)
+        ledger = self._ledger
+
+        def _eligible(v: GangEntry) -> bool:
+            if v.priority < e.priority:
+                return True
+            return (reclaim and v.tenant != e.tenant
+                    and ledger.is_borrowing(v.tenant))
+
         victims = sorted(
             (v for v in self._gangs.values()
-             if v.admitted and v.started and v.priority < e.priority
+             if v.admitted and v.started and _eligible(v)
              and v.min_slices > 0 and len(v.slice_names) > v.min_slices
              and (not e.accelerator_type
                   or v.accelerator_type in ("", e.accelerator_type))),
-            key=lambda v: (v.priority, -v.fairness_at))
+            # Borrowers give back first (deepest borrower first), then
+            # the pre-tenancy order; with no borrowers the leading keys
+            # are constant and this IS the old (class, youngest) order.
+            key=lambda v: (0 if ledger.is_borrowing(v.tenant) else 1,
+                           -ledger.borrowed(v.tenant),
+                           v.priority, -v.fairness_at))
         for v in victims:
             if free + gained >= need:
                 break
             surplus = len(v.slice_names) - v.min_slices
+            if v.priority >= e.priority:
+                # Pure reclaim victim: only its BORROWED share is
+                # takeable — its entitled slices are untouchable at
+                # equal/higher priority.
+                surplus = min(surplus, ledger.borrowed(v.tenant))
             take = min(surplus, need - free - gained)
             # Mesh integrity: a pipelined victim (pp_span > 1) loses whole
             # inter-slice dp replicas or nothing — taking a partial span
@@ -422,6 +610,8 @@ class GangScheduler:
             released_pos = {i for i, nm in enumerate(before) if nm in rel}
             v.slice_names = [nm for nm in before if nm not in rel]
             v.num_slices = len(v.slice_names)
+            self._ledger.credit(v.tenant, slices=len(released))
+            v.charged_slices = max(0, v.charged_slices - len(released))
             self._c_harvest.labels(v.priority_class).inc(len(released))
             self._dirty = True
             # Fail exactly the members on the released slices; survivors
@@ -449,16 +639,35 @@ class GangScheduler:
         """Evict enough strictly-lower-priority admitted gangs for ``e`` to
         fit — after first HARVESTING width from elastic victims (which
         keeps them training at reduced width; whole-gang eviction is the
-        last resort): lowest class first, youngest first within a class."""
+        last resort): lowest class first, youngest first within a class.
+
+        For an entitled claimant the victim set also includes other
+        tenants' gangs running on borrowed capacity (any priority) —
+        the whole-gang FALLBACK of the width-harvest reclaim above, for
+        borrowers that are inelastic or already at their floor."""
         self._harvest_for_locked(e, now, evictions)
         free = self.inventory.free_slice_count(e.accelerator_type)
         need = e.num_slices
+        reclaim = self._ledger.entitled(
+            e.tenant,
+            slices=0 if e.serving else e.num_slices,
+            serving=1 if e.serving else 0)
+        ledger = self._ledger
+
+        def _eligible(v: GangEntry) -> bool:
+            if v.priority < e.priority:
+                return True
+            return (reclaim and v.tenant != e.tenant
+                    and ledger.is_borrowing(v.tenant))
+
         victims = sorted(
             (v for v in self._gangs.values()
-             if v.admitted and v.priority < e.priority
+             if v.admitted and _eligible(v)
              and (not e.accelerator_type
                   or v.accelerator_type in ("", e.accelerator_type))),
-            key=lambda v: (v.priority, -v.fairness_at))
+            key=lambda v: (0 if ledger.is_borrowing(v.tenant) else 1,
+                           -ledger.borrowed(v.tenant),
+                           v.priority, -v.fairness_at))
         picked: List[GangEntry] = []
         gain = 0
         for v in victims:
@@ -475,6 +684,7 @@ class GangScheduler:
     def _preempt_locked(self, v: GangEntry, preemptor: GangEntry,
                         evictions: List[Tuple[List[str], str]]) -> None:
         self.inventory.release_gang(v.name)
+        self._credit_entry_locked(v)
         self._c_preempt.labels(v.priority_class).inc()
         self._dirty = True
         if not v.started:
@@ -494,6 +704,26 @@ class GangScheduler:
         del self._gangs[v.name]
         self._idle_candidates.discard(v.name)
         evictions.append((list(v.pods), reason))
+
+    def _credit_entry_locked(self, e: GangEntry) -> None:
+        """Give the tenant back EXACTLY what this gang charged (bind-time
+        charge minus harvest credits) — crediting the remembered amount,
+        not len(slice_names), is what makes borrow-then-reclaim conserve
+        slices with no leak and no double-count."""
+        if e.charged_slices:
+            self._ledger.credit(e.tenant, slices=e.charged_slices)
+            e.charged_slices = 0
+        if e.charged_serving:
+            self._ledger.credit(e.tenant, serving=e.charged_serving)
+            e.charged_serving = 0
+
+    def _drop_fairness_locked(self, gang_name: str) -> None:
+        """Forget a gang's fairness clock and tenant mapping for good
+        (job finished/vanished — as opposed to preemption, which keeps
+        both so the replacement gang rejoins at its old position)."""
+        tenant = self._tenant_of_gang.pop(gang_name, None)
+        if tenant is not None:
+            self._fairness.pop((tenant, gang_name), None)
 
     def _run_evictions(self, evictions: List[Tuple[List[str], str]]) -> None:
         if not evictions or self._evictor is None:
@@ -564,6 +794,8 @@ class GangScheduler:
                 if e is not None:
                     e.slice_names = e.slice_names + list(grown)
                     e.num_slices = len(e.slice_names)
+                    self._ledger.charge(e.tenant, slices=len(grown))
+                    e.charged_slices += len(grown)
                 self._dirty = True
         return grown
 
@@ -584,7 +816,8 @@ class GangScheduler:
             e = self._gangs.pop(gang_name, None)
             if e is not None:
                 self._forget_entry_locked(e)
-            self._fairness.pop(gang_name, None)
+                self._credit_entry_locked(e)
+            self._drop_fairness_locked(gang_name)
             self._idle_candidates.discard(gang_name)
             self._dirty = True
         self.inventory.release_gang(gang_name)
@@ -605,7 +838,8 @@ class GangScheduler:
                 gone = self._gangs.pop(n, None)
                 if gone is not None:
                     self._forget_entry_locked(gone)
-                self._fairness.pop(n, None)
+                    self._credit_entry_locked(gone)
+                self._drop_fairness_locked(n)
             if confirmed:
                 self._dirty = True
         released = set(self.inventory.release_idle_gangs(active_pod_keys))
@@ -625,6 +859,7 @@ class GangScheduler:
             e = self._gangs.get(bound) if bound else None
             if e is None:
                 return keys
+            self._credit_entry_locked(e)
             if e.admitted and not e.started:
                 e.admitted = False
                 e.admitted_at = 0.0

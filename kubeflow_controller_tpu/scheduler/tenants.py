@@ -1,0 +1,224 @@
+"""Per-tenant DRF ledger: dominant-resource shares on a share-keyed heap.
+
+The two-level queue's upper level (docs/PERF.md "Multi-tenant
+contention"): tenants are picked by smallest *dominant share* — the
+larger of their normalized training-slice usage and serving-replica
+usage, divided by their TenantQuota weight (classic DRF, Ghodsi et al.;
+TF-Replicator's multi-user cluster assumption in PAPERS.md).  Usage is
+accounted **incrementally** on bind/release (never recomputed by
+rescanning gangs), and the next-tenant pick is O(log tenants) via a
+lazily-invalidated share heap — the same stale-tuple-discard pattern as
+the scheduler's gang heaps, so tenancy stays off the PR 14 hot path.
+
+Thread-safety: the ledger has no lock of its own — every call nests
+under the scheduler's gang-queue lock, exactly like the inventory.
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from ..api.tenant import DEFAULT_TENANT
+
+
+@dataclass
+class TenantState:
+    """One tenant's quota contract + live usage."""
+
+    name: str
+    weight: float = 1.0
+    quota_slices: int = 0
+    quota_serving: int = 0
+    borrowable: bool = True
+    #: Training slices currently bound to this tenant's gangs.
+    used_slices: int = 0
+    #: Serving replicas currently admitted for this tenant.
+    used_serving: int = 0
+    #: True once a TenantQuota object declared this tenant (a tenant
+    #: seen only through its jobs has no entitlement to reclaim by).
+    has_quota: bool = False
+    #: Heap-tuple generation: tuples carrying an older seq are stale.
+    seq: int = 0
+
+
+class TenantLedger:
+    """Incremental DRF accounting over every tenant the scheduler has
+    seen.  ``capacity_fn`` supplies the normalization denominator (total
+    cluster slices; serving replicas each occupy one slice, so the same
+    denominator serves both axes)."""
+
+    def __init__(self, capacity_fn: Optional[Callable[[], int]] = None):
+        self._capacity_fn = capacity_fn
+        self._tenants: Dict[str, TenantState] = {}
+        # Share-keyed heap of (share, seq, tenant); lazy invalidation.
+        self._heap: List[Tuple[float, int, str]] = []
+        self._next_seq = 0
+        # True once ANY TenantQuota was declared: with no quotas at all
+        # the cluster is effectively single-tenant and the borrow/reclaim
+        # machinery stays inert (no surprise harvests in quota-less runs).
+        self._any_quota = False
+
+    # -- capacity ------------------------------------------------------------
+
+    def _capacity(self) -> float:
+        cap = 0
+        if self._capacity_fn is not None:
+            cap = int(self._capacity_fn() or 0)
+        return float(max(1, cap))
+
+    # -- membership / quota --------------------------------------------------
+
+    def touch(self, tenant: str) -> TenantState:
+        """Get-or-create: a tenant exists from its first queued gang."""
+        t = self._tenants.get(tenant)
+        if t is None:
+            t = TenantState(name=tenant or DEFAULT_TENANT)
+            self._tenants[t.name] = t
+            self._rekey(t)
+        return t
+
+    def set_quota(self, tenant: str, weight: float = 1.0, slices: int = 0,
+                  serving_replicas: int = 0, borrowable: bool = True) -> None:
+        """Apply a TenantQuota spec (idempotent; live weight changes
+        re-key the share heap immediately)."""
+        t = self.touch(tenant)
+        t.weight = max(weight, 1e-9)
+        t.quota_slices = max(0, int(slices))
+        t.quota_serving = max(0, int(serving_replicas))
+        t.borrowable = bool(borrowable)
+        t.has_quota = True
+        self._any_quota = True
+        self._rekey(t)
+
+    def remove_quota(self, tenant: str) -> None:
+        """TenantQuota deleted: back to the quota-less default (weight 1,
+        no entitlement); usage is untouched — the gangs are still bound."""
+        t = self._tenants.get(tenant)
+        if t is None:
+            return
+        t.weight = 1.0
+        t.quota_slices = 0
+        t.quota_serving = 0
+        t.borrowable = True
+        t.has_quota = False
+        self._any_quota = any(s.has_quota for s in self._tenants.values())
+        self._rekey(t)
+
+    # -- usage accounting (incremental; bind/release only) -------------------
+
+    def charge(self, tenant: str, slices: int = 0, serving: int = 0) -> None:
+        t = self.touch(tenant)
+        t.used_slices += max(0, slices)
+        t.used_serving += max(0, serving)
+        self._rekey(t)
+
+    def credit(self, tenant: str, slices: int = 0, serving: int = 0) -> None:
+        t = self.touch(tenant)
+        t.used_slices = max(0, t.used_slices - max(0, slices))
+        t.used_serving = max(0, t.used_serving - max(0, serving))
+        self._rekey(t)
+
+    # -- DRF shares ----------------------------------------------------------
+
+    def share_of(self, tenant: str) -> float:
+        t = self._tenants.get(tenant)
+        return self._share(t) if t is not None else 0.0
+
+    def _share(self, t: TenantState) -> float:
+        cap = self._capacity()
+        dominant = max(t.used_slices / cap, t.used_serving / cap)
+        return dominant / max(t.weight, 1e-9)
+
+    def _rekey(self, t: TenantState) -> None:
+        self._next_seq += 1
+        t.seq = self._next_seq
+        heapq.heappush(self._heap, (self._share(t), t.seq, t.name))
+
+    def ordered(self) -> Iterator[str]:
+        """Tenants in ascending dominant-share order, O(log T) per step
+        via the lazy heap.  Valid tuples popped during iteration are
+        re-pushed on generator close, so an early ``break`` (the common
+        case: the first tenant with an admissible gang wins) costs only
+        what it consumed."""
+        popped: List[Tuple[float, int, str]] = []
+        try:
+            while self._heap:
+                share, seq, name = heapq.heappop(self._heap)
+                t = self._tenants.get(name)
+                if t is None or t.seq != seq:
+                    continue  # stale tuple: usage/quota changed since push
+                popped.append((share, seq, name))
+                yield name
+        finally:
+            for item in popped:
+                heapq.heappush(self._heap, item)
+
+    # -- borrow / reclaim policy ---------------------------------------------
+
+    def entitled(self, tenant: str, slices: int = 0, serving: int = 0) -> bool:
+        """True iff ``tenant`` declared a quota and the ask fits inside
+        it — the precondition for reclaiming borrowed capacity from
+        other tenants (a quota-less or over-quota tenant waits its DRF
+        turn like everyone else)."""
+        t = self._tenants.get(tenant)
+        if t is None or not t.has_quota:
+            return False
+        if slices and t.used_slices + slices > t.quota_slices:
+            return False
+        if serving and t.used_serving + serving > t.quota_serving:
+            return False
+        return True
+
+    def may_take(self, tenant: str, slices: int = 0, serving: int = 0) -> bool:
+        """Work-conserving borrow gate.  Always True except for a tenant
+        whose TenantQuota set ``borrowable: false`` — such a tenant opted
+        out of borrowing entirely and is hard-capped at its declared
+        quota (it can then never become a reclaim victim either)."""
+        t = self._tenants.get(tenant)
+        if t is None or not t.has_quota or t.borrowable:
+            return True
+        if slices and t.used_slices + slices > t.quota_slices:
+            return False
+        if serving and t.used_serving + serving > t.quota_serving:
+            return False
+        return True
+
+    def borrowed(self, tenant: str) -> int:
+        """Slices this tenant holds beyond its declared quota (0 for
+        quota-less tenants when no quota exists anywhere — then there is
+        no lender to give back to)."""
+        t = self._tenants.get(tenant)
+        if t is None or not self._any_quota:
+            return 0
+        return max(0, t.used_slices - t.quota_slices)
+
+    def is_borrowing(self, tenant: str) -> bool:
+        return self.borrowed(tenant) > 0
+
+    def total_borrowed(self) -> int:
+        """Cluster-wide borrowed-slice count — the scrape-time value of
+        ``kctpu_sched_borrowed_slices``."""
+        if not self._any_quota:
+            return 0
+        return sum(self.borrowed(name) for name in self._tenants)
+
+    # -- introspection (CLI / bench) -----------------------------------------
+
+    def snapshot(self) -> Dict[str, Dict[str, object]]:
+        """Per-tenant view for ``kctpu describe`` and the bench gates."""
+        return {
+            name: {
+                "weight": t.weight,
+                "quota_slices": t.quota_slices,
+                "quota_serving": t.quota_serving,
+                "borrowable": t.borrowable,
+                "used_slices": t.used_slices,
+                "used_serving": t.used_serving,
+                "borrowed": self.borrowed(name),
+                "dominant_share": self._share(t),
+                "has_quota": t.has_quota,
+            }
+            for name, t in self._tenants.items()
+        }

@@ -1,0 +1,16 @@
+"""Vet fixture: raw tenant label/annotation reads outside the shared
+resolver (all BAD — tenant-label)."""
+from kubeflow_controller_tpu.api.labels import ANNOTATION_TENANT, LABEL_TENANT
+
+
+def queue_key(job):
+    # BAD: skips the label-override -> namespace-default chain.
+    return (job.metadata.labels or {}).get(LABEL_TENANT, "default")
+
+
+def bill_to(pod):
+    return pod.metadata.annotations[ANNOTATION_TENANT]  # BAD: raw read
+
+
+def throttle_bucket(job):
+    return job.metadata.labels["tenant"]  # BAD: literal key, same bug

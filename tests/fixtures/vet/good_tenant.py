@@ -1,0 +1,21 @@
+"""Vet fixture: tenancy resolved through the shared resolver (GOOD)."""
+from kubeflow_controller_tpu.api.tenant import tenant_of, tenant_of_pod
+
+
+def queue_key(job):
+    return tenant_of(job)
+
+
+def bill_to(pod):
+    return tenant_of_pod(pod)
+
+
+def stamp(md, job):
+    # WRITING the annotation (the planner's job) is not a raw read.
+    md.annotations["kctpu.io/tenant"] = tenant_of(job)
+    return {"kctpu.io/tenant": tenant_of(job)}
+
+
+def unrelated(job):
+    # Non-tenant label reads stay out of scope.
+    return (job.metadata.labels or {}).get("job-type", "")

@@ -115,6 +115,27 @@ class TestVetRules:
             root=REPO_ROOT, skip_catalogue=True)
         assert not [f for f in findings if f.rule == "sim-thread-per-object"]
 
+    def test_tenant_label_bad(self):
+        findings, rules = vet_rules("bad_tenant.py")
+        assert rules == {"tenant-label"}
+        # guarded .get(LABEL_TENANT), annotation subscript, literal key
+        assert len(findings) == 3
+        assert all("tenant_of" in f.message for f in findings)
+
+    def test_tenant_label_good(self):
+        """Resolver calls, annotation WRITES (the planner's stamping),
+        and non-tenant label reads all pass."""
+        findings, _ = vet_rules("good_tenant.py")
+        assert findings == []
+
+    def test_tenant_label_resolver_itself_exempt(self):
+        """api/tenant.py is the one place allowed to read the raw label."""
+        findings = vet.run(
+            [os.path.join(REPO_ROOT, "kubeflow_controller_tpu", "api",
+                          "tenant.py")],
+            root=REPO_ROOT, skip_catalogue=True)
+        assert not [f for f in findings if f.rule == "tenant-label"]
+
     def test_lockgraph_bad_cycle_and_blocking(self):
         """The whole-program rule: an inversion split across two call
         chains and a blocking call one hop away — each function is

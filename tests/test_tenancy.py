@@ -1,0 +1,533 @@
+"""Multi-tenant fair-share plane: DRF ledger edge cases, two-level
+scheduling, borrow-then-reclaim conservation, the (tenant, gang) fairness
+clock, per-tenant workqueue round-robin, apiserver write-path isolation
+(429 + Retry-After), and the tenant CLI surfaces."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import Container, PodTemplateSpec, TenantQuota, TenantQuotaSpec
+from kubeflow_controller_tpu.api.meta import ObjectMeta
+from kubeflow_controller_tpu.api.tenant import tenant_of, tenant_of_pod
+from kubeflow_controller_tpu.api.tfjob import (
+    ElasticSpec,
+    JobGoodput,
+    ReplicaType,
+    TFJob,
+    TFJobPhase,
+    TFReplicaSpec,
+    TPUSpec,
+)
+from kubeflow_controller_tpu.cluster import Cluster, TPUInventory, TPUSlice
+from kubeflow_controller_tpu.cluster.apiserver import FakeAPIServer
+from kubeflow_controller_tpu.cluster.rest import Kubeconfig, RestCluster
+from kubeflow_controller_tpu.controller.workqueue import RateLimitingQueue
+from kubeflow_controller_tpu.obs.metrics import REGISTRY
+from kubeflow_controller_tpu.planner.materialize import make_pod
+from kubeflow_controller_tpu.scheduler import GangScheduler, SchedulerPolicy
+from kubeflow_controller_tpu.scheduler.tenants import TenantLedger
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def mk_tpu_job(name, ns="default", num_slices=1, priority="",
+               elastic_min=0, runtime_id="rid"):
+    job = TFJob(metadata=ObjectMeta(name=name, namespace=ns))
+    job.metadata.uid = f"uid-{ns}-{name}"
+    job.spec.runtime_id = runtime_id
+    if priority:
+        job.spec.priority_class_name = priority
+    t = PodTemplateSpec()
+    t.spec.containers.append(Container(name="c", image="img"))
+    t.spec.restart_policy = "OnFailure"
+    if elastic_min:
+        job.spec.elastic = ElasticSpec(min_width=elastic_min)
+    job.spec.tf_replica_specs = [TFReplicaSpec(
+        replicas=2 * num_slices, tf_replica_type=ReplicaType.TPU, template=t,
+        tpu=TPUSpec(accelerator_type="v5e-8", num_hosts=2,
+                    num_slices=num_slices))]
+    return job
+
+
+def slices(n):
+    return [TPUSlice(f"s{i}", "v5e-8", num_hosts=2) for i in range(n)]
+
+
+def mk_pods(job):
+    """Materialized member pods, named the way the controller would."""
+    n = job.spec.tf_replica_specs[0].replicas
+    pods = [make_pod(job, job.spec.tf_replica_specs[0], i) for i in range(n)]
+    for i, p in enumerate(pods):
+        p.metadata.name = f"{job.metadata.name}-{i}"
+    return pods
+
+
+def admit(sched, job):
+    """Offer every pod of the job's gang, start the coordinator, offer
+    again; returns (pods, offer results of the second pass)."""
+    pods = mk_pods(job)
+    for p in pods:
+        sched.offer(p)
+    sched.pod_started(pods[0])
+    return pods, [sched.offer(p) for p in pods]
+
+
+def counter_total(name, labels=("priority_class",)):
+    c = REGISTRY.counter(name, "", labels)
+    with c._lock:
+        return sum(c._values.values())
+
+
+def rig(n_slices):
+    inv = TPUInventory(slices(n_slices))
+    sched = GangScheduler(inv, SchedulerPolicy())
+    evictions = []
+    sched.set_evictor(lambda keys, reason: evictions.append(
+        (sorted(keys), reason)))
+    return inv, sched, evictions
+
+
+# ---------------------------------------------------------------------------
+# DRF ledger edge cases
+# ---------------------------------------------------------------------------
+
+class TestTenantLedger:
+    def test_zero_usage_tenants_order_first(self):
+        led = TenantLedger(lambda: 4)
+        led.charge("busy", slices=3)
+        led.touch("idle")
+        assert next(iter(led.ordered())) == "idle"
+        # Early break re-pushes what it consumed: a second iteration
+        # still sees every tenant, same order.
+        assert list(led.ordered()) == ["idle", "busy"]
+
+    def test_dominant_resource_is_the_max_axis(self):
+        led = TenantLedger(lambda: 4)
+        led.charge("serve-only", serving=3)      # share 0.75
+        led.charge("train-only", slices=2)       # share 0.50
+        led.charge("mixed", slices=1, serving=1)  # share 0.25 (both axes)
+        assert list(led.ordered()) == ["mixed", "train-only", "serve-only"]
+        assert led.share_of("serve-only") == pytest.approx(0.75)
+        assert led.share_of("mixed") == pytest.approx(0.25)
+
+    def test_live_weight_change_reorders_immediately(self):
+        led = TenantLedger(lambda: 4)
+        led.charge("a", slices=2)   # 0.5
+        led.charge("b", slices=1)   # 0.25
+        assert list(led.ordered()) == ["b", "a"]
+        led.set_quota("a", weight=4.0)   # 0.5 / 4 = 0.125
+        assert list(led.ordered()) == ["a", "b"]
+
+    def test_borrowed_inert_without_any_quota(self):
+        led = TenantLedger(lambda: 4)
+        led.charge("a", slices=3)
+        assert led.borrowed("a") == 0 and led.total_borrowed() == 0
+        # The first TenantQuota anywhere defines entitlements for all.
+        led.set_quota("b", slices=1)
+        assert led.borrowed("a") == 3
+        led.remove_quota("b")
+        assert led.borrowed("a") == 0
+
+    def test_entitled_requires_quota_headroom(self):
+        led = TenantLedger(lambda: 8)
+        led.set_quota("q", slices=2)
+        led.charge("q", slices=1)
+        assert led.entitled("q", slices=1)
+        assert not led.entitled("q", slices=2)
+        assert not led.entitled("noquota", slices=1)
+
+    def test_may_take_hard_caps_only_non_borrowable(self):
+        led = TenantLedger(lambda: 8)
+        led.set_quota("soft", slices=1)                    # borrowable
+        led.set_quota("hard", slices=1, borrowable=False)  # opted out
+        led.charge("soft", slices=1)
+        led.charge("hard", slices=1)
+        assert led.may_take("soft", slices=5)
+        assert not led.may_take("hard", slices=1)
+        assert led.may_take("neverseen", slices=5)
+
+    def test_credit_clamps_at_zero(self):
+        led = TenantLedger(lambda: 4)
+        led.charge("a", slices=1)
+        led.credit("a", slices=5)
+        assert led.snapshot()["a"]["used_slices"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Two-level DRF scheduling
+# ---------------------------------------------------------------------------
+
+class TestDRFScheduling:
+    def test_idle_tenant_beats_older_waiter_of_busy_tenant(self):
+        _, sched, _ = rig(2)
+        admit(sched, mk_tpu_job("a1", ns="alpha"))
+        admit(sched, mk_tpu_job("a2", ns="alpha"))
+        # alpha queues ANOTHER gang first (older fairness clock), beta
+        # queues one after: single-level FIFO would admit a3.
+        a3 = mk_tpu_job("a3", ns="alpha")
+        a3_pods = mk_pods(a3)
+        b1 = mk_tpu_job("b1", ns="beta")
+        b1_pods = mk_pods(b1)
+        assert not any(sched.offer(p) for p in a3_pods)
+        assert not any(sched.offer(p) for p in b1_pods)
+        sched.release_gang("a1-rid")
+        # beta's dominant share (0) < alpha's (1/2): beta wins the slice.
+        assert any(sched.offer(p) for p in b1_pods)
+        assert not any(sched.offer(p) for p in a3_pods)
+
+    def test_weights_scale_the_share(self):
+        _, sched, _ = rig(4)
+        sched.set_tenant_quota("heavy", weight=4.0)
+        sched.set_tenant_quota("light", weight=1.0)
+        for name in ("h1", "h2", "h3"):
+            admit(sched, mk_tpu_job(name, ns="heavy"))
+        admit(sched, mk_tpu_job("l1", ns="light"))
+        # light queues first; after release: heavy 2/4/4=0.125 < light
+        # 1/4/1=0.25, so heavy's YOUNGER waiter wins.
+        l2 = mk_tpu_job("l2", ns="light")
+        l2_pods = mk_pods(l2)
+        h4 = mk_tpu_job("h4", ns="heavy")
+        h4_pods = mk_pods(h4)
+        assert not any(sched.offer(p) for p in l2_pods)
+        assert not any(sched.offer(p) for p in h4_pods)
+        sched.release_gang("h1-rid")
+        assert any(sched.offer(p) for p in h4_pods)
+        assert not any(sched.offer(p) for p in l2_pods)
+
+    def test_serving_gangs_charge_the_serving_axis(self):
+        from kubeflow_controller_tpu.api.labels import LABEL_JOB_TYPE
+
+        _, sched, _ = rig(2)
+        job = mk_tpu_job("svc", ns="infer")
+        pod = make_pod(job, job.spec.tf_replica_specs[0], 0)
+        pod.metadata.labels[LABEL_JOB_TYPE] = "Serving"
+        # Width-1 serving gang: rewrite the gang annotations.
+        from kubeflow_controller_tpu.api.labels import (
+            ANNOTATION_GANG_NAME,
+            ANNOTATION_GANG_SIZE,
+            ANNOTATION_NUM_SLICES,
+        )
+        pod.metadata.annotations[ANNOTATION_GANG_NAME] = "svc-rid-serve-0"
+        pod.metadata.annotations[ANNOTATION_GANG_SIZE] = "1"
+        pod.metadata.annotations[ANNOTATION_NUM_SLICES] = "1"
+        assert sched.offer(pod)
+        snap = sched.tenant_shares()["infer"]
+        assert snap["used_serving"] == 1
+        assert snap["used_slices"] == 0
+
+    def test_borrow_then_reclaim_conserves_every_slice(self):
+        """The tentpole gate in miniature: an over-quota elastic tenant
+        is width-harvested (never whole-gang preempted) down to what an
+        entitled claimant needs, and the ledger never leaks or
+        double-counts a slice across the reclaim."""
+        inv, sched, evictions = rig(4)
+        sched.set_tenant_quota("lo", slices=2)
+        sched.set_tenant_quota("hi", slices=2)
+        admit(sched, mk_tpu_job("big", ns="lo", num_slices=4, elastic_min=2))
+        assert len(sched.gang_slices("big-rid")) == 4
+        assert sched.tenant_shares()["lo"]["borrowed"] == 2
+        before = counter_total("kctpu_sched_preemptions_total")
+
+        _, results = admit(sched, mk_tpu_job("claim", ns="hi", num_slices=2))
+        assert any(results)
+        assert len(sched.gang_slices("claim-rid")) == 2
+        assert len(sched.gang_slices("big-rid")) == 2  # floor, not gone
+        assert len(evictions) == 1
+        assert evictions[0][1].startswith("WidthHarvested")
+        assert counter_total("kctpu_sched_preemptions_total") == before
+
+        snap = sched.tenant_shares()
+        assert snap["lo"]["used_slices"] == 2 and snap["lo"]["borrowed"] == 0
+        assert snap["hi"]["used_slices"] == 2
+        bound = sum(len(sched.gang_slices(g)) for g in ("big-rid", "claim-rid"))
+        assert bound == 4 == (snap["lo"]["used_slices"]
+                              + snap["hi"]["used_slices"])
+        # Releases give back exactly the remembered charge: no negative
+        # clamp hiding a double-count, no residue.
+        sched.release_gang("claim-rid")
+        sched.release_gang("big-rid")
+        snap = sched.tenant_shares()
+        assert snap["lo"]["used_slices"] == 0
+        assert snap["hi"]["used_slices"] == 0
+        assert inv.free_slice_count("v5e-8") == 4
+
+    def test_non_borrowable_tenant_pins_at_quota_without_deadlock(self):
+        _, sched, _ = rig(2)
+        sched.set_tenant_quota("capped", slices=1, borrowable=False)
+        admit(sched, mk_tpu_job("c1", ns="capped"))
+        c2 = mk_tpu_job("c2", ns="capped")
+        c2_pods = mk_pods(c2)
+        # A slice is free, but the hard cap holds c2 back...
+        assert not any(sched.offer(p) for p in c2_pods)
+        # ...and the pinned head must NOT drain admissions for others.
+        _, results = admit(sched, mk_tpu_job("f1", ns="free"))
+        assert any(results)
+        # Once c1 releases, c2 fits inside quota again.
+        sched.release_gang("c1-rid")
+        assert any(sched.offer(p) for p in c2_pods)
+
+
+# ---------------------------------------------------------------------------
+# Fairness clock keyed by (tenant, gang) — the PR 7 fix
+# ---------------------------------------------------------------------------
+
+class TestFairnessClockTenantKey:
+    def test_same_gang_name_across_tenants_gets_fresh_clock(self):
+        """runtime_id is user-settable, so gang names collide across
+        tenants.  A preempted tenant keeps its fairness seniority for its
+        OWN comeback; another tenant reusing the name must not inherit
+        it and queue-jump its own older waiters."""
+        _, sched, _ = rig(1)
+        admit(sched, mk_tpu_job("x", ns="a", priority="low"))
+        t_a = sched._fairness[("a", "x-rid")]
+        time.sleep(0.01)
+        # b's first waiter (the senior one).
+        old = mk_tpu_job("old", ns="b")
+        old_pods = mk_pods(old)
+        for p in old_pods:
+            sched.offer(p)
+        # b preempts a's started low gang with a high one...
+        _, results = admit(sched, mk_tpu_job("hi", ns="b", priority="high"))
+        assert any(results)
+        assert ("a", "x-rid") in sched._fairness  # seniority survives
+        time.sleep(0.01)
+        # ...then b submits its OWN job named x with the same runtime id.
+        bx = mk_tpu_job("x", ns="b")
+        bx_pods = mk_pods(bx)
+        for p in bx_pods:
+            sched.offer(p)
+        assert sched._fairness[("b", "x-rid")] > t_a
+        # Behavioral check: on release, b's senior waiter wins — with the
+        # old name-only key, b's "x" would have inherited a's clock and
+        # jumped the line.
+        sched.release_gang("hi-rid")
+        assert any(sched.offer(p) for p in old_pods)
+        assert not any(sched.offer(p) for p in bx_pods)
+
+
+# ---------------------------------------------------------------------------
+# Workqueue per-tenant fresh tier
+# ---------------------------------------------------------------------------
+
+class TestWorkqueueTenantRoundRobin:
+    def test_fresh_tier_interleaves_tenants(self):
+        q = RateLimitingQueue(name="rrq")
+        for k in ("a/1", "a/2", "b/1", "a/3"):
+            q.add(k)
+        got = [q.get(timeout=1.0) for _ in range(4)]
+        assert got == ["a/1", "b/1", "a/2", "a/3"]
+        q.shut_down()
+
+    def test_custom_tenant_resolver(self):
+        q = RateLimitingQueue(name="rrq1", tenant_of=lambda k: "one")
+        for k in ("a/1", "a/2", "b/1"):
+            q.add(k)
+        assert [q.get(timeout=1.0) for _ in range(3)] == ["a/1", "a/2", "b/1"]
+        q.shut_down()
+
+    def test_drain_pending_preserves_interleave(self):
+        q = RateLimitingQueue(name="rrq2")
+        for k in ("a/1", "a/2", "b/1"):
+            q.add(k)
+        drained = [k for k, _ in q.drain_pending()]
+        assert drained == ["a/1", "b/1", "a/2"]
+        assert len(q) == 0
+        q.shut_down()
+
+
+# ---------------------------------------------------------------------------
+# Apiserver write-path isolation
+# ---------------------------------------------------------------------------
+
+def _post_job(url, ns, name, tenant):
+    body = {"apiVersion": "kubeflow.caicloud.io/v1alpha1", "kind": "TFJob",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"runtimeId": "r"}}
+    req = urllib.request.Request(
+        f"{url}/apis/kubeflow.caicloud.io/v1alpha1/namespaces/{ns}/tfjobs",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Kctpu-Tenant": tenant},
+        method="POST")
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, dict(r.headers)
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code, dict(e.headers)
+
+
+class TestApiserverWriteThrottle:
+    def test_429_isolated_per_tenant_with_retry_after(self):
+        cluster = Cluster()
+        srv = FakeAPIServer(cluster.store, write_qps=0.5, write_burst=1)
+        url = srv.start()
+        try:
+            c = REGISTRY.counter("kctpu_apiserver_throttled_total", "",
+                                 ("tenant",))
+            with c._lock:
+                before = dict(c._values)
+            code1, _ = _post_job(url, "ns1", "j1", "noisy")
+            assert code1 < 400
+            code2, hdrs = _post_job(url, "ns1", "j2", "noisy")
+            assert code2 == 429
+            assert int(hdrs.get("Retry-After", "0")) >= 1
+            # The noisy tenant's storm is its own problem: a different
+            # tenant's bucket is untouched.
+            code3, _ = _post_job(url, "ns2", "j3", "quiet")
+            assert code3 < 400
+            with c._lock:
+                after = dict(c._values)
+            assert after.get(("noisy",), 0) == before.get(("noisy",), 0) + 1
+            assert after.get(("quiet",), 0) == before.get(("quiet",), 0)
+        finally:
+            srv.stop()
+
+    def test_typed_client_honors_retry_after(self):
+        cluster = Cluster()
+        srv = FakeAPIServer(cluster.store, write_qps=5.0, write_burst=1)
+        url = srv.start()
+        rest = RestCluster(Kubeconfig(server=url))
+        rest.set_tenant_provider(lambda: "bursty")
+        try:
+            waits_before = counter_total("kctpu_rest_throttle_waits_total",
+                                         labels=())
+            for i in range(3):
+                job = mk_tpu_job(f"burst{i}", ns="bursty")
+                rest.tfjobs.create(job)
+            # Every write landed despite throttling (in-flight Retry-After
+            # sleeps), and the client counted at least one honored wait.
+            assert len(rest.tfjobs.list("bursty")) == 3
+            assert counter_total("kctpu_rest_throttle_waits_total",
+                                 labels=()) > waits_before
+        finally:
+            rest.close()
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# CLI tenant surfaces
+# ---------------------------------------------------------------------------
+
+def mk_status_job(cluster, name, ns, tenant_label="", goodput=None):
+    t = PodTemplateSpec()
+    t.spec.containers.append(Container(name="w", image="img"))
+    job = TFJob(metadata=ObjectMeta(name=name, namespace=ns))
+    if tenant_label:
+        job.metadata.labels["tenant"] = tenant_label  # kctpu: vet-ok(tenant-label) - test fixture seeds the raw label
+    job.spec.tf_replica_specs.append(TFReplicaSpec(
+        replicas=2, tf_replica_type=ReplicaType.WORKER, template=t))
+    cluster.tfjobs.create(job)
+    j = cluster.tfjobs.get(ns, name)
+    j.status.phase = TFJobPhase.RUNNING
+    j.status.goodput = goodput
+    cluster.tfjobs.update_status(j)
+
+
+class TestCLITenantSurfaces:
+    @pytest.fixture
+    def served(self):
+        cluster = Cluster()
+        srv = FakeAPIServer(cluster.store)
+        url = srv.start()
+        mk_status_job(cluster, "t1", "teama", goodput=JobGoodput(
+            goodput_s=90, occupied_s=100, wall_s=120, ratio=0.9,
+            buckets={"train": 90, "queued": 20, "rendezvous": 10}))
+        mk_status_job(cluster, "t2", "teamb", goodput=JobGoodput(
+            goodput_s=50, occupied_s=100, wall_s=120, ratio=0.5,
+            buckets={"train": 50, "rendezvous": 50}))
+        # Label override: lives in teamb's namespace, billed to teama.
+        mk_status_job(cluster, "t3", "teamb", tenant_label="teama")
+        cluster.tenantquotas.create(TenantQuota(
+            metadata=ObjectMeta(name="teama", namespace="default"),
+            spec=TenantQuotaSpec(weight=4.0, slices=2)))
+        yield url
+        srv.stop()
+
+    def row(self, out, name):
+        hdr = next(ln for ln in out.splitlines() if ln.startswith("NAMESPACE")
+                   or ln.startswith("TENANT"))
+        row = next(ln for ln in out.splitlines()
+                   if f" {name} " in f"{ln} " and not ln.startswith("TENANT"))
+        return hdr, row
+
+    def test_get_has_aligned_tenant_column_and_filter(self, served, capsys):
+        from kubeflow_controller_tpu.cli.main import main
+
+        assert main(["-master", served, "get"]) == 0
+        out = capsys.readouterr().out
+        hdr, row = self.row(out, "t1")
+        at = hdr.index("TENANT")
+        assert row[at:at + 12].strip() == "teama"
+        # The label override resolves, not the namespace.
+        _, r3 = self.row(out, "t3")
+        assert r3[at:at + 12].strip() == "teama"
+        # Columns right of TENANT stay put.
+        assert row[hdr.index("PHASE"):].startswith("Running")
+        # --tenant filters on the resolved identity (t3 rides along).
+        assert main(["-master", served, "get", "--tenant", "teama"]) == 0
+        out = capsys.readouterr().out
+        assert " t1 " in out and " t3 " in out and " t2 " not in out
+
+    def test_describe_quota_share_section(self, served, capsys):
+        from kubeflow_controller_tpu.cli.main import main
+
+        assert main(["-master", served, "describe", "t1",
+                     "-n", "teama"]) == 0
+        out = capsys.readouterr().out
+        assert "Tenant:    teama" in out
+        assert "Quota:     weight=4 slices=2" in out
+        # No quota object -> tenant line only.
+        assert main(["-master", served, "describe", "t2",
+                     "-n", "teamb"]) == 0
+        out = capsys.readouterr().out
+        assert "Tenant:    teamb" in out
+        assert "Quota:" not in out
+
+    def test_goodput_tenant_rollup_table(self, served, capsys):
+        from kubeflow_controller_tpu.cli.main import main
+
+        assert main(["-master", served, "goodput", "--tenant"]) == 0
+        out = capsys.readouterr().out
+        hdr = next(ln for ln in out.splitlines() if ln.startswith("TENANT"))
+        assert "GOODPUT" in hdr and "OCC_S" in hdr
+        rows = {ln.split()[0]: ln.split() for ln in out.splitlines()
+                if ln.startswith("team")}
+        # t3 has no ledger -> doesn't pollute teama's rollup.
+        assert rows["teama"][1:4] == ["1", "90%", "90"]
+        assert rows["teamb"][1:4] == ["1", "50%", "50"]
+        # Worst ratio sorts first.
+        assert out.index("teamb") < out.index("teama")
+
+    def test_top_prints_tenant_rollup_line(self, served, capsys):
+        from kubeflow_controller_tpu.cli.main import main
+
+        assert main(["-master", served, "top"]) == 0
+        out = capsys.readouterr().out
+        line = next(ln for ln in out.splitlines()
+                    if ln.startswith("tenants: "))
+        assert "teama:2j" in line and "teamb:1j" in line
+        assert "good=90%" in line  # teama's occupied-weighted ratio
+
+
+# ---------------------------------------------------------------------------
+# Tenant identity resolution
+# ---------------------------------------------------------------------------
+
+class TestTenantResolution:
+    def test_label_overrides_namespace(self):
+        job = mk_tpu_job("j", ns="nsx")
+        assert tenant_of(job) == "nsx"
+        job.metadata.labels["tenant"] = "acme"  # kctpu: vet-ok(tenant-label) - test fixture seeds the raw label
+        assert tenant_of(job) == "acme"
+
+    def test_pod_annotation_wins(self):
+        job = mk_tpu_job("j", ns="nsx")
+        pod = make_pod(job, job.spec.tf_replica_specs[0], 0)
+        assert tenant_of_pod(pod) == "nsx"  # materialize stamped it
